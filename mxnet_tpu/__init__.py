@@ -1,0 +1,22 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with MXNet 1.5's
+capabilities, built on JAX/XLA/Pallas.
+
+This is not a port of Apache MXNet: the C++ engine/NNVM/executor machinery of
+the reference (see SURVEY.md) is replaced by JAX tracing + XLA compilation,
+and the distributed parameter server by XLA collectives over device meshes.
+The *API surface* mirrors MXNet so reference scripts run with
+``import mxnet_tpu as mx``.
+"""
+from . import base  # noqa: F401
+from .base import MXNetError, __version__  # noqa: F401
+from .context import (  # noqa: F401
+    Context, cpu, cpu_pinned, current_context, gpu, num_gpus, num_tpus, tpu,
+)
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from . import autograd  # noqa: F401
+from . import random  # noqa: F401
+
+from .ndarray import op_namespaces as _ns
+
+_ns.random.seed = random.seed

@@ -1,0 +1,113 @@
+"""Base utilities: dtype registry, attribute marshaling, errors.
+
+TPU-native rebuild of the roles played by the reference's
+``python/mxnet/base.py`` (lib loading, handle types, string marshaling of
+``dmlc::Parameter`` attrs — see reference ``python/mxnet/base.py:579`` and
+``src/c_api``).  There is no C ABI here: ops are pure JAX functions, so the
+"marshaling" layer reduces to parsing the MXNet-style stringified attribute
+values (``"(2, 2)"``, ``"True"``, ``"float32"``) that user scripts and the
+Symbol JSON format still pass around.
+"""
+from __future__ import annotations
+
+import ast
+import numpy as _np
+
+__version__ = "0.1.0"
+
+
+class MXNetError(RuntimeError):
+    """Error raised by framework routines (reference: ``base.py:MXNetError``)."""
+
+
+# ---------------------------------------------------------------------------
+# dtype handling.  The reference maps mshadow type enums <-> numpy dtypes
+# (reference ``python/mxnet/base.py`` / ``include/mxnet/base.h``).  We keep the
+# same integer codes for checkpoint compatibility with the dmlc NDArray save
+# format, and add bfloat16 (the TPU-native training dtype).
+# ---------------------------------------------------------------------------
+import ml_dtypes as _ml_dtypes
+
+bfloat16 = _np.dtype(_ml_dtypes.bfloat16)
+
+_DTYPE_NP_TO_MX = {
+    _np.dtype(_np.float32): 0,
+    _np.dtype(_np.float64): 1,
+    _np.dtype(_np.float16): 2,
+    _np.dtype(_np.uint8): 3,
+    _np.dtype(_np.int32): 4,
+    _np.dtype(_np.int8): 5,
+    _np.dtype(_np.int64): 6,
+    _np.dtype(_np.bool_): 7,
+    bfloat16: 12,  # matches mshadow's kBfloat16 slot in later MXNet versions
+}
+_DTYPE_MX_TO_NP = {v: k for k, v in _DTYPE_NP_TO_MX.items()}
+
+
+def np_dtype(dtype) -> _np.dtype:
+    """Normalize a user-provided dtype (str | np.dtype | type | int code)."""
+    if dtype is None:
+        return _np.dtype(_np.float32)
+    if isinstance(dtype, int):
+        return _DTYPE_MX_TO_NP[dtype]
+    if isinstance(dtype, str) and dtype == "bfloat16":
+        return bfloat16
+    return _np.dtype(dtype)
+
+
+def dtype_code(dtype) -> int:
+    return _DTYPE_NP_TO_MX[np_dtype(dtype)]
+
+
+# ---------------------------------------------------------------------------
+# Attribute parsing (dmlc::Parameter string forms).
+# ---------------------------------------------------------------------------
+def parse_bool(v, default=False):
+    if v is None:
+        return default
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return bool(v)
+    s = str(v).strip().lower()
+    return s in ("1", "true", "yes")
+
+
+def parse_tuple(v, ndim=None, default=None):
+    """Parse ``(2, 2)`` / ``[2, 2]`` / ``2`` / ``"(2,2)"`` into a tuple of int.
+
+    Mirrors dmlc TShape string parsing used by every op's ``*-inl.h`` param
+    struct in the reference.
+    """
+    if v is None:
+        if default is None:
+            return None
+        v = default
+    if isinstance(v, str):
+        v = ast.literal_eval(v)
+    if isinstance(v, (int, _np.integer)):
+        v = (int(v),) * (ndim or 1)
+    t = tuple(int(x) for x in v)
+    if ndim is not None and len(t) == 1 and ndim > 1:
+        t = t * ndim
+    return t
+
+
+def parse_int(v, default=None):
+    if v is None:
+        return default
+    return int(v)
+
+
+def parse_float(v, default=None):
+    if v is None:
+        return default
+    return float(v)
+
+
+_UID = [0]
+
+
+def uid() -> int:
+    _UID[0] += 1
+    return _UID[0]

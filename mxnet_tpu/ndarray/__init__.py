@@ -1,0 +1,27 @@
+"""``mx.nd`` namespace: NDArray plus generated op functions.
+
+Reference: ``python/mxnet/ndarray/__init__.py`` re-exporting the generated op
+modules (``gen_*``) and the NDArray class.
+"""
+import sys as _sys
+
+from .ndarray import (  # noqa: F401
+    NDArray, add, arange, array, concatenate, divide, empty, equal, eye, full,
+    greater, greater_equal, invoke, invoke_fn, invoke_op, lesser, lesser_equal,
+    load, logical_and, logical_or, logical_xor, maximum, minimum, modulo,
+    moveaxis, multiply, not_equal, ones, ones_like, power, save, stack,
+    subtract, transpose, waitall, zeros, zeros_like, _as_nd, _wrap,
+)
+from . import register as _register
+
+_CURRENT = _sys.modules[__name__]
+_OPS = _register.populate(_CURRENT)
+
+# mx.nd.random / mx.nd.linalg / mx.nd.contrib / mx.nd.image sub-namespaces
+from . import op_namespaces as _ns  # noqa: E402
+
+random = _ns.random
+linalg = _ns.linalg
+contrib = _ns.contrib
+image = _ns.image
+sparse = _ns.sparse

@@ -1,0 +1,53 @@
+"""Sub-namespaces of mx.nd: random, linalg, contrib, image, sparse.
+
+Reference: ``python/mxnet/ndarray/{random,linalg,contrib,image,sparse}.py`` —
+thin façades over the same generated op table with prefix stripping
+(``_random_*`` → ``nd.random.*``, ``_linalg_*`` → ``nd.linalg.*``, …).
+"""
+from __future__ import annotations
+
+import types
+
+from ..ops import registry as _reg
+from .register import make_op_func
+
+
+def _facade(name, prefixes, extra=()):
+    mod = types.ModuleType(f"mxnet_tpu.ndarray.{name}")
+    for opname in _reg.all_names():
+        for p in prefixes:
+            if opname.startswith(p):
+                short = opname[len(p):]
+                if short and not hasattr(mod, short):
+                    setattr(mod, short, make_op_func(_reg.get(opname)))
+    for opname in extra:
+        op = _reg.get(opname)
+        if op is not None:
+            setattr(mod, opname, make_op_func(op))
+    return mod
+
+
+random = _facade("random", ("_random_", "_sample_"),
+                 extra=("shuffle",))
+# mx.nd.random.multinomial naming
+random.multinomial = make_op_func(_reg.get("_sample_multinomial"))
+random.seed = None  # set by mxnet_tpu/__init__ to mx.random.seed
+
+linalg = _facade("linalg", ("_linalg_",))
+contrib = _facade("contrib", ("_contrib_",))
+image = _facade("image", ("_image_",))
+
+sparse = types.ModuleType("mxnet_tpu.ndarray.sparse")
+sparse.__doc__ = (
+    "Sparse storage compatibility layer. XLA/TPU has no native sparse tensor "
+    "support; row_sparse and csr arrays are represented densely (the "
+    "reference's own dense-fallback mechanism, "
+    "src/executor/attach_op_execs_pass.cc:46, is the precedent). "
+    "See SURVEY.md §7 hard-part 4.")
+
+
+def _todense(x):
+    return x
+
+
+sparse.todense = _todense

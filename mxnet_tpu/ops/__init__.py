@@ -1,0 +1,14 @@
+"""Operator implementations.  Importing this package registers all ops."""
+from . import registry  # noqa: F401
+from . import elemwise  # noqa: F401
+from . import broadcast_reduce  # noqa: F401
+from . import matrix  # noqa: F401
+from . import nn  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import init_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import linalg_ops  # noqa: F401
+from . import image_ops  # noqa: F401
+from . import contrib_ops  # noqa: F401
+
+from .registry import get, list_ops, register, require  # noqa: F401

@@ -1,0 +1,174 @@
+"""Broadcasting binary ops and reductions.
+
+Reference being rebuilt: ``src/operator/tensor/broadcast_reduce_op_value.cc``,
+``elemwise_binary_broadcast_op_*.cc``.  MXNet reductions support ``axis=None``
+(all), tuple axes, ``keepdims`` and ``exclude`` (reduce over the complement of
+``axis``); comparison outputs keep the input dtype (not bool), matching the
+reference's kernels.
+"""
+from __future__ import annotations
+
+import ast
+
+import jax.numpy as jnp
+
+from ..base import parse_bool
+from .registry import register
+
+
+def _axes(axis, ndim, exclude=False):
+    if isinstance(axis, str):
+        axis = ast.literal_eval(axis)
+    if axis is None:
+        return None if not exclude else ()
+    if isinstance(axis, (int,)):
+        axis = (axis,)
+    axis = tuple(a % ndim for a in axis)
+    if parse_bool(exclude):
+        axis = tuple(a for a in range(ndim) if a not in axis)
+    return axis
+
+
+def _binary(name, jfn, cast_back=False):
+    def fn(lhs, rhs):
+        out = jfn(lhs, rhs)
+        if cast_back:
+            out = out.astype(lhs.dtype)
+        return out
+    fn.__name__ = name
+    register(name)(fn)
+    return fn
+
+
+_binary("broadcast_add", jnp.add)
+_binary("broadcast_plus", jnp.add)
+_binary("broadcast_sub", jnp.subtract)
+_binary("broadcast_minus", jnp.subtract)
+_binary("broadcast_mul", jnp.multiply)
+_binary("broadcast_div", jnp.divide)
+_binary("broadcast_mod", jnp.mod)
+_binary("broadcast_power", jnp.power)
+_binary("broadcast_maximum", jnp.maximum)
+_binary("broadcast_minimum", jnp.minimum)
+_binary("broadcast_hypot", jnp.hypot)
+_binary("broadcast_equal", jnp.equal, cast_back=True)
+_binary("broadcast_not_equal", jnp.not_equal, cast_back=True)
+_binary("broadcast_greater", jnp.greater, cast_back=True)
+_binary("broadcast_greater_equal", jnp.greater_equal, cast_back=True)
+_binary("broadcast_lesser", jnp.less, cast_back=True)
+_binary("broadcast_lesser_equal", jnp.less_equal, cast_back=True)
+_binary("broadcast_logical_and", lambda a, b: ((a != 0) & (b != 0)), cast_back=True)
+_binary("broadcast_logical_or", lambda a, b: ((a != 0) | (b != 0)), cast_back=True)
+_binary("broadcast_logical_xor", lambda a, b: ((a != 0) ^ (b != 0)), cast_back=True)
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@register("broadcast_to")
+def broadcast_to(x, shape=None):
+    from ..base import parse_tuple
+    shape = parse_tuple(shape)
+    # MXNet allows 0 to mean "keep this dim"
+    shape = tuple(s if s != 0 else x.shape[i] for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(x, axis=None, size=None):
+    from ..base import parse_tuple
+    axis = parse_tuple(axis)
+    size = parse_tuple(size)
+    shape = list(x.shape)
+    for a, s in zip(axis, size):
+        shape[a] = s
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def _reduce(name, jfn, int_out=False):
+    def fn(data, axis=None, keepdims=False, exclude=False):
+        ax = _axes(axis, data.ndim, exclude)
+        return jfn(data, axis=ax, keepdims=parse_bool(keepdims))
+    fn.__name__ = name
+    fn.__doc__ = f"Reduction {name} (reference src/operator/tensor/broadcast_reduce_op_value.cc)."
+    register(name)(fn)
+    return fn
+
+
+_reduce("sum", jnp.sum)
+_reduce("nansum", jnp.nansum)
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("nanprod", jnp.nanprod)
+_reduce("max", jnp.max)
+_reduce("min", jnp.min)
+
+
+@register("sum_axis")
+def sum_axis(data, axis=None, keepdims=False, exclude=False):
+    return jnp.sum(data, axis=_axes(axis, data.ndim, exclude),
+                   keepdims=parse_bool(keepdims))
+
+
+@register("norm")
+def norm(data, ord=2, axis=None, keepdims=False, out_dtype=None):
+    """Reference ``norm`` (broadcast_reduce_op_value.cc): L1/L2 only."""
+    ax = _axes(axis, data.ndim)
+    ordv = int(ord) if ord is not None else 2
+    if ordv == 1:
+        out = jnp.sum(jnp.abs(data), axis=ax, keepdims=parse_bool(keepdims))
+    else:
+        out = jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=parse_bool(keepdims)))
+    if out_dtype is not None:
+        from ..base import np_dtype
+        out = out.astype(np_dtype(out_dtype))
+    return out
+
+
+def _arg_reduce(name, jfn):
+    def fn(data, axis=None, keepdims=False):
+        if axis is None:
+            out = jfn(jnp.reshape(data, (-1,)), axis=0)
+            if parse_bool(keepdims):
+                out = jnp.reshape(out, (1,) * data.ndim)
+        else:
+            out = jfn(data, axis=int(axis))
+            if parse_bool(keepdims):
+                out = jnp.expand_dims(out, int(axis))
+        return out.astype(data.dtype)  # MXNet returns indices in input dtype
+    fn.__name__ = name
+    register(name)(fn)
+    return fn
+
+
+_arg_reduce("argmax", jnp.argmax)
+_arg_reduce("argmin", jnp.argmin)
+
+
+@register("argmax_channel")
+def argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(data.dtype)
+
+
+@register("pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    """Reference ``pick`` (broadcast_reduce_op_index.cc): select one element
+    along ``axis`` per position given by ``index``."""
+    ax = int(axis) % data.ndim
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[ax] - 1)
+    idx_exp = jnp.expand_dims(idx, ax)
+    out = jnp.take_along_axis(data, idx_exp, axis=ax)
+    if not parse_bool(keepdims):
+        out = jnp.squeeze(out, ax)
+    return out
+
+
+@register("moments")
+def moments(data, axes=None, keepdims=False):
+    """Reference ``moments`` (src/operator/nn/moments.cc)."""
+    ax = _axes(axes, data.ndim)
+    mean = jnp.mean(data, axis=ax, keepdims=parse_bool(keepdims))
+    var = jnp.var(data, axis=ax, keepdims=parse_bool(keepdims))
+    return mean, var

@@ -1,0 +1,480 @@
+"""Contrib operators: detection suite (SSD/RCNN), resize/pooling extras.
+
+Reference: ``src/operator/contrib/`` — ``bounding_box.cc`` (box_iou/box_nms),
+``multibox_prior.cc`` / ``multibox_target.cc`` / ``multibox_detection.cc``
+(SSD), ``roi_align.cc`` + ``src/operator/roi_pooling.cc`` (RCNN),
+``bilinear_resize.cc``, ``adaptive_avg_pooling.cc``, ``quadratic_op.cc``.
+
+TPU-native notes: NMS is implemented as a fixed-iteration greedy mask over a
+top-k-sorted candidate set (static shapes — jittable), instead of the
+reference's dynamic CPU/GPU loops.  Everything stays O(k²) on the candidate
+set which the MXU/VPU handles easily for k ≤ a few thousand.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import parse_bool, parse_float, parse_int, parse_tuple
+from .registry import register
+
+
+def _ftuple(v, default=()):
+    import ast
+    if v is None:
+        return default
+    if isinstance(v, str):
+        v = ast.literal_eval(v)
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+# ---------------------------------------------------------------------------
+# box_iou / box_nms
+# ---------------------------------------------------------------------------
+def _iou_corner(a, b):
+    """IoU between (..., M, 4) and (..., N, 4) corner boxes -> (..., M, N)."""
+    ax1, ay1, ax2, ay2 = [a[..., i] for i in range(4)]
+    bx1, by1, bx2, by2 = [b[..., i] for i in range(4)]
+    ix1 = jnp.maximum(ax1[..., :, None], bx1[..., None, :])
+    iy1 = jnp.maximum(ay1[..., :, None], by1[..., None, :])
+    ix2 = jnp.minimum(ax2[..., :, None], bx2[..., None, :])
+    iy2 = jnp.minimum(ay2[..., :, None], by2[..., None, :])
+    iw = jnp.maximum(ix2 - ix1, 0.0)
+    ih = jnp.maximum(iy2 - iy1, 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum((ax2 - ax1) * (ay2 - ay1), 0.0)
+    area_b = jnp.maximum((bx2 - bx1) * (by2 - by1), 0.0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _to_corner(b):
+    cx, cy, w, h = [b[..., i] for i in range(4)]
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+
+
+@register("_contrib_box_iou", aliases=("box_iou",))
+def box_iou(lhs, rhs, format="corner"):
+    a = lhs if format == "corner" else _to_corner(lhs)
+    b = rhs if format == "corner" else _to_corner(rhs)
+    return _iou_corner(a, b)
+
+
+def _greedy_nms_mask(boxes, scores, valid, thresh, force=None, cls_id=None):
+    """Greedy NMS over score-sorted boxes.  Returns keep mask (same order)."""
+    n = boxes.shape[0]
+    iou = _iou_corner(boxes, boxes)
+    if cls_id is not None and not force:
+        same = cls_id[:, None] == cls_id[None, :]
+        iou = jnp.where(same, iou, 0.0)
+    suppress_seed = jnp.zeros((n,), bool)
+
+    def body(i, keep):
+        alive_i = valid[i] & ~keep[i]
+        row = (iou[i] > thresh) & valid
+        row = row.at[i].set(False)
+        newly = jnp.where(alive_i, row, jnp.zeros_like(row))
+        return keep | newly
+
+    suppressed = lax.fori_loop(0, n, body, suppress_seed)
+    return valid & ~suppressed
+
+
+@register("_contrib_box_nms", aliases=("box_nms",))
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+            score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    """Reference ``box_nms`` (src/operator/contrib/bounding_box.cc): input
+    (..., N, K) rows [id?, score, x1,y1,x2,y2,...]; suppressed rows get -1."""
+    thr = parse_float(overlap_thresh, 0.5)
+    vthr = parse_float(valid_thresh, 0.0)
+    cs, si = parse_int(coord_start, 2), parse_int(score_index, 1)
+    ii = parse_int(id_index, -1)
+    bg = parse_float(background_id, -1)
+    force = parse_bool(force_suppress)
+    k = parse_int(topk, -1)
+
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:])
+
+    def one(batch):
+        scores = batch[:, si]
+        boxes = batch[:, cs:cs + 4]
+        if in_format == "center":
+            boxes = _to_corner(boxes)
+        valid = scores > vthr
+        if ii >= 0:
+            valid = valid & (batch[:, ii] != bg)
+        order = jnp.argsort(-scores)
+        b_sorted = boxes[order]
+        s_sorted = scores[order]
+        v_sorted = valid[order]
+        if k > 0:
+            kmask = jnp.arange(batch.shape[0]) < k
+            v_sorted = v_sorted & kmask
+        cls_sorted = batch[order, ii] if ii >= 0 else None
+        keep = _greedy_nms_mask(b_sorted, s_sorted, v_sorted, thr,
+                                force=force, cls_id=cls_sorted)
+        rows = batch[order]
+        rows = jnp.where(keep[:, None], rows, -jnp.ones_like(rows))
+        return rows
+
+    out = jax.vmap(one)(flat)
+    return out.reshape(shape)
+
+
+@register("_contrib_bipartite_matching", aliases=("bipartite_matching",))
+def bipartite_matching(data, is_ascend=False, threshold=0.5, topk=-1):
+    """Greedy bipartite matching (reference bounding_box.cc)."""
+    thr = parse_float(threshold, 0.5)
+    asc = parse_bool(is_ascend)
+
+    def one(mat):
+        m, n = mat.shape
+        score = mat if not asc else -mat
+
+        def body(carry, _):
+            row_match, col_used, s = carry
+            flat_idx = jnp.argmax(jnp.where(col_used[None, :] | (row_match >= 0)[:, None],
+                                            -jnp.inf, s))
+            r, c = flat_idx // n, flat_idx % n
+            val = s[r, c]
+            ok = val > (thr if not asc else -thr)
+            row_match = jnp.where(ok, row_match.at[r].set(c), row_match)
+            col_used = jnp.where(ok, col_used.at[c].set(True), col_used)
+            return (row_match, col_used, s), None
+
+        init = (jnp.full((m,), -1, jnp.int32), jnp.zeros((n,), bool), score)
+        (row_match, col_used, _), _ = lax.scan(body, init, None, length=min(m, n))
+        return row_match.astype(mat.dtype), jnp.where(col_used, 1.0, -1.0).astype(mat.dtype)
+
+    if data.ndim == 2:
+        return one(data)
+    return jax.vmap(one)(data)
+
+
+# ---------------------------------------------------------------------------
+# SSD multibox suite
+# ---------------------------------------------------------------------------
+@register("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",))
+def multibox_prior(data, sizes="(1,)", ratios="(1,)", clip=False, steps="(-1,-1)",
+                   offsets="(0.5, 0.5)"):
+    """Reference ``MultiBoxPrior`` (src/operator/contrib/multibox_prior.cc):
+    anchors for an (N, C, H, W) feature map, output (1, H*W*A, 4) corners."""
+    szs = _ftuple(sizes, (1.0,))
+    rts = _ftuple(ratios, (1.0,))
+    stps = _ftuple(steps, (-1.0, -1.0))
+    offs = _ftuple(offsets, (0.5, 0.5))
+    h, w = data.shape[2], data.shape[3]
+    step_y = stps[0] if stps[0] > 0 else 1.0 / h
+    step_x = stps[1] if stps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offs[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offs[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([cxg, cyg], axis=-1).reshape(-1, 2)  # (HW, 2) as (x, y)
+    whs = []
+    for i, s in enumerate(szs):
+        r = rts[0]
+        whs.append((s * (r ** 0.5), s / (r ** 0.5)))
+    for r in rts[1:]:
+        s = szs[0]
+        whs.append((s * (r ** 0.5), s / (r ** 0.5)))
+    wh = jnp.asarray(whs, jnp.float32)  # (A, 2)
+    a = wh.shape[0]
+    c = jnp.repeat(centers[:, None, :], a, axis=1)  # (HW, A, 2)
+    half = wh[None, :, :] / 2
+    boxes = jnp.concatenate([c - half, c + half], axis=-1).reshape(1, -1, 4)
+    if parse_bool(clip):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+@register("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",))
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances="(0.1, 0.1, 0.2, 0.2)"):
+    """Reference ``MultiBoxTarget`` (src/operator/contrib/multibox_target.cc):
+    anchor (1, A, 4) corners, label (B, M, 5) [cls, x1, y1, x2, y2] with -1
+    padding, cls_pred (B, num_cls+1, A).  Outputs loc_target (B, A*4),
+    loc_mask (B, A*4), cls_target (B, A)."""
+    thr = parse_float(overlap_threshold, 0.5)
+    var = _ftuple(variances, (0.1, 0.1, 0.2, 0.2))
+    nmr = parse_float(negative_mining_ratio, -1.0)
+    nmt = parse_float(negative_mining_thresh, 0.5)
+    anchors = anchor.reshape(-1, 4)  # (A, 4)
+    A = anchors.shape[0]
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def one(lab, cpred):
+        valid_gt = lab[:, 0] >= 0
+        gt_boxes = lab[:, 1:5]
+        iou = _iou_corner(anchors, gt_boxes)  # (A, M)
+        iou = jnp.where(valid_gt[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)  # per anchor
+        best_iou = jnp.max(iou, axis=1)
+        # force-match: best anchor per gt
+        best_anchor = jnp.argmax(iou, axis=0)  # (M,)
+        forced = jnp.zeros((A,), bool)
+        forced = forced.at[best_anchor].set(valid_gt)
+        forced_gt = jnp.zeros((A,), jnp.int32)
+        forced_gt = forced_gt.at[best_anchor].set(jnp.arange(lab.shape[0], dtype=jnp.int32))
+        matched = forced | (best_iou >= thr)
+        match_gt = jnp.where(forced, forced_gt, best_gt)
+        gt = gt_boxes[match_gt]
+        gcx = (gt[:, 0] + gt[:, 2]) / 2
+        gcy = (gt[:, 1] + gt[:, 3]) / 2
+        gw = jnp.maximum(gt[:, 2] - gt[:, 0], 1e-8)
+        gh = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-8)
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / var[0]
+        ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / var[1]
+        tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / var[2]
+        th = jnp.log(gh / jnp.maximum(ah, 1e-8)) / var[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=-1)
+        loc_t = jnp.where(matched[:, None], loc_t, 0.0)
+        loc_m = jnp.where(matched[:, None], jnp.ones_like(loc_t), jnp.zeros_like(loc_t))
+        cls_t = jnp.where(matched, lab[match_gt, 0] + 1, 0.0)
+        if nmr > 0:
+            # hard negative mining: rank negatives by background prob deficit
+            probs = jax.nn.softmax(cpred, axis=0)  # (num_cls+1, A)
+            bg_prob = probs[0]
+            neg_cand = (~matched) & (best_iou < nmt)
+            num_neg = jnp.maximum(jnp.sum(matched) * nmr,
+                                  float(parse_int(minimum_negative_samples, 0)))
+            score = jnp.where(neg_cand, 1.0 - bg_prob, -1.0)
+            order = jnp.argsort(-score)
+            rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A, dtype=jnp.int32))
+            selected_neg = neg_cand & (rank < num_neg)
+            cls_t = jnp.where(selected_neg, 0.0,
+                              jnp.where(matched, cls_t, parse_float(ignore_label, -1.0)))
+        return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(label, cls_pred)
+    return loc_t, loc_m, cls_t
+
+
+@register("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",))
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5, force_suppress=False,
+                       variances="(0.1, 0.1, 0.2, 0.2)", nms_topk=-1):
+    """Reference ``MultiBoxDetection`` (multibox_detection.cc): decode loc
+    predictions against anchors, take per-anchor argmax class, NMS.
+    cls_prob (B, num_cls+1, A), loc_pred (B, A*4), anchor (1, A, 4).
+    Output (B, A, 6): [cls_id, score, x1, y1, x2, y2], suppressed = -1."""
+    var = _ftuple(variances, (0.1, 0.1, 0.2, 0.2))
+    thr = parse_float(threshold, 0.01)
+    nthr = parse_float(nms_threshold, 0.5)
+    bg = parse_int(background_id, 0)
+    anchors = anchor.reshape(-1, 4)
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def one(cp, lp):
+        loc = lp.reshape(-1, 4)
+        cx = loc[:, 0] * var[0] * aw + acx
+        cy = loc[:, 1] * var[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * var[2]) * aw
+        h = jnp.exp(loc[:, 3] * var[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        if parse_bool(clip, True):
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        scores_all = cp  # (C+1, A)
+        mask = jnp.arange(cp.shape[0]) != bg
+        fg = jnp.where(mask[:, None], scores_all, -1.0)
+        cls_id = jnp.argmax(fg, axis=0)
+        score = jnp.max(fg, axis=0)
+        valid = score > thr
+        out_id = jnp.where(valid, (cls_id - (1 if bg == 0 else 0)).astype(jnp.float32), -1.0)
+        rows = jnp.concatenate([out_id[:, None], score[:, None], boxes], axis=-1)
+        order = jnp.argsort(-score)
+        rows_s = rows[order]
+        v_sorted = valid[order]
+        k = parse_int(nms_topk, -1)
+        if k and k > 0:
+            v_sorted = v_sorted & (jnp.arange(rows.shape[0]) < k)
+        keep = _greedy_nms_mask(rows_s[:, 2:6], rows_s[:, 1], v_sorted, nthr,
+                                force=parse_bool(force_suppress),
+                                cls_id=rows_s[:, 0])
+        return jnp.where(keep[:, None], rows_s, -jnp.ones_like(rows_s))
+
+    return jax.vmap(one)(cls_prob, loc_pred.reshape(cls_prob.shape[0], -1))
+
+
+# ---------------------------------------------------------------------------
+# ROI ops
+# ---------------------------------------------------------------------------
+@register("ROIPooling")
+def roi_pooling(data, rois, pooled_size=None, spatial_scale=1.0):
+    """Reference ``ROIPooling`` (src/operator/roi_pooling.cc): rois (R, 5) =
+    [batch_idx, x1, y1, x2, y2] in image coords."""
+    ph, pw = parse_tuple(pooled_size, 2)
+    scale = parse_float(spatial_scale, 1.0)
+    n, c, h, w = data.shape
+
+    def one(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = data[bi]  # (C, H, W)
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+
+        def pool_cell(iy, ix):
+            hstart = y1 + (iy * rh) // ph
+            hend = y1 + ((iy + 1) * rh + ph - 1) // ph
+            wstart = x1 + (ix * rw) // pw
+            wend = x1 + ((ix + 1) * rw + pw - 1) // pw
+            m = ((ys[None, :, None] >= hstart) & (ys[None, :, None] < jnp.minimum(hend, h)) &
+                 (xs[None, None, :] >= wstart) & (xs[None, None, :] < jnp.minimum(wend, w)))
+            vals = jnp.where(m, img, -jnp.inf)
+            out = jnp.max(vals, axis=(1, 2))
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+
+        cells = [[pool_cell(iy, ix) for ix in range(pw)] for iy in range(ph)]
+        return jnp.stack([jnp.stack(r, -1) for r in cells], -2)  # (C, ph, pw)
+
+    return jax.vmap(one)(rois)
+
+
+@register("_contrib_ROIAlign", aliases=("ROIAlign",))
+def roi_align(data, rois, pooled_size=None, spatial_scale=1.0, sample_ratio=-1,
+              position_sensitive=False, aligned=False):
+    """Reference ``ROIAlign`` (src/operator/contrib/roi_align.cc): bilinear
+    sampling average pooling."""
+    ph, pw = parse_tuple(pooled_size, 2)
+    scale = parse_float(spatial_scale, 1.0)
+    sratio = parse_int(sample_ratio, -1)
+    n, c, h, w = data.shape
+    offset = 0.5 if parse_bool(aligned) else 0.0
+    s = sratio if sratio > 0 else 2
+
+    def one(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1 = roi[1] * scale - offset
+        y1 = roi[2] * scale - offset
+        x2 = roi[3] * scale - offset
+        y2 = roi[4] * scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        iy = jnp.arange(ph)
+        ix = jnp.arange(pw)
+        sy = jnp.arange(s)
+        sx = jnp.arange(s)
+        yy = y1 + (iy[:, None] + (sy[None, :] + 0.5) / s) * bin_h  # (ph, s)
+        xx = x1 + (ix[:, None] + (sx[None, :] + 0.5) / s) * bin_w  # (pw, s)
+        yy = jnp.clip(yy, 0.0, h - 1.0)
+        xx = jnp.clip(xx, 0.0, w - 1.0)
+        img = data[bi]
+
+        def bilinear(yv, xv):
+            y0 = jnp.floor(yv).astype(jnp.int32)
+            x0 = jnp.floor(xv).astype(jnp.int32)
+            y1_ = jnp.minimum(y0 + 1, h - 1)
+            x1_ = jnp.minimum(x0 + 1, w - 1)
+            wy = yv - y0
+            wx = xv - x0
+            v00 = img[:, y0, x0]
+            v01 = img[:, y0, x1_]
+            v10 = img[:, y1_, x0]
+            v11 = img[:, y1_, x1_]
+            return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                    v10 * wy * (1 - wx) + v11 * wy * wx)
+
+        # gather all sample points: (ph, s, pw, s)
+        yb = jnp.broadcast_to(yy[:, :, None, None], (ph, s, pw, s))
+        xb = jnp.broadcast_to(xx[None, None, :, :], (ph, s, pw, s))
+        vals = jax.vmap(lambda yv, xv: bilinear(yv, xv))(yb.reshape(-1), xb.reshape(-1))
+        vals = vals.reshape(ph, s, pw, s, c)
+        return jnp.transpose(jnp.mean(vals, axis=(1, 3)), (2, 0, 1))  # (C, ph, pw)
+
+    return jax.vmap(one)(rois)
+
+
+# ---------------------------------------------------------------------------
+# Resize / adaptive pooling / misc
+# ---------------------------------------------------------------------------
+@register("_contrib_BilinearResize2D", aliases=("BilinearResize2D",))
+def bilinear_resize2d(data, *like, height=1, width=1, scale_height=None,
+                      scale_width=None, mode="size"):
+    n, c, h, w = data.shape
+    if scale_height is not None:
+        oh = int(round(h * parse_float(scale_height)))
+        ow = int(round(w * parse_float(scale_width)))
+    elif like:
+        oh, ow = like[0].shape[2], like[0].shape[3]
+    else:
+        oh, ow = parse_int(height), parse_int(width)
+    out = jax.image.resize(data, (n, c, oh, ow), method="bilinear")
+    return out.astype(data.dtype)
+
+
+@register("_contrib_AdaptiveAvgPooling2D", aliases=("AdaptiveAvgPooling2D",))
+def adaptive_avg_pooling2d(data, output_size=None):
+    n, c, h, w = data.shape
+    if output_size is None:
+        oh = ow = 1
+    else:
+        t = parse_tuple(output_size)
+        oh, ow = (t[0], t[0]) if len(t) == 1 else t
+    # exact adaptive pooling: averages over variable-size windows
+    out = jnp.zeros((n, c, oh, ow), data.dtype)
+    rows = []
+    for i in range(oh):
+        h0, h1 = (i * h) // oh, ((i + 1) * h + oh - 1) // oh
+        cols = []
+        for j in range(ow):
+            w0, w1 = (j * w) // ow, ((j + 1) * w + ow - 1) // ow
+            cols.append(jnp.mean(data[:, :, h0:h1, w0:w1], axis=(2, 3)))
+        rows.append(jnp.stack(cols, -1))
+    return jnp.stack(rows, -2)
+
+
+@register("_contrib_quadratic", aliases=("quadratic",))
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """Reference example op (src/operator/contrib/quadratic_op.cc)."""
+    return parse_float(a, 0.0) * jnp.square(data) + parse_float(b, 0.0) * data + \
+        parse_float(c, 0.0)
+
+
+@register("_contrib_div_sqrt_dim", aliases=("div_sqrt_dim",))
+def div_sqrt_dim(data):
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+@register("_contrib_fft", aliases=("fft",))
+def fft(data, compute_size=128):
+    """Reference cuFFT op (src/operator/contrib/fft.cc): returns interleaved
+    real/imag like the reference layout."""
+    out = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    inter = jnp.stack([out.real, out.imag], axis=-1)
+    return inter.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(data.dtype)
+
+
+@register("_contrib_ifft", aliases=("ifft",))
+def ifft(data, compute_size=128):
+    n = data.shape[-1] // 2
+    comp = data.reshape(data.shape[:-1] + (n, 2))
+    z = comp[..., 0] + 1j * comp[..., 1]
+    return jnp.fft.ifft(z, axis=-1).real.astype(data.dtype) * n
+
+
+@register("_contrib_count_sketch", aliases=("count_sketch",))
+def count_sketch(data, h, s, out_dim=None, processing_batch_size=32):
+    d = parse_int(out_dim)
+    hh = h.astype(jnp.int32) % d
+    ss = s
+    out = jnp.zeros(data.shape[:-1] + (d,), data.dtype)
+    return out.at[..., hh].add(data * ss)

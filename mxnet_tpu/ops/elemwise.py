@@ -1,0 +1,274 @@
+"""Elementwise unary/binary/scalar operators.
+
+Reference being rebuilt: ``src/operator/tensor/elemwise_unary_op_basic.cc``,
+``elemwise_binary_op_basic.cc``, ``elemwise_binary_scalar_op_*.cc`` and the
+scalar functor zoo ``src/operator/mshadow_op.h``.  Each op here is one pure
+JAX function; XLA fuses chains of them into single TPU kernels, which is why
+there is no hand-written kernel layer (the mshadow expression templates'
+entire job is done by the compiler).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import parse_bool, parse_float
+from .registry import register
+
+
+def _unary(name, jfn, aliases=()):
+    def fn(x):
+        return jfn(x)
+    fn.__name__ = name
+    fn.__doc__ = f"Elementwise {name} (reference src/operator/tensor/elemwise_unary_op_basic.cc / mshadow_op.h)."
+    register(name, aliases=aliases)(fn)
+    return fn
+
+
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("round", jnp.round)
+_unary("rint", jnp.rint)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("trunc", jnp.trunc)
+_unary("fix", jnp.trunc)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log10", jnp.log10)
+_unary("log2", jnp.log2)
+_unary("log1p", jnp.log1p)
+_unary("expm1", jnp.expm1)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("negative", jnp.negative, aliases=("_np_negative",))
+_unary("reciprocal", lambda x: 1.0 / x)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("softsign", jax.nn.soft_sign)
+_unary("relu", jax.nn.relu)
+_unary("erf", jax.scipy.special.erf)
+_unary("erfinv", jax.scipy.special.erfinv)
+_unary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+_unary("gammaln", jax.scipy.special.gammaln)
+_unary("logical_not", lambda x: (x == 0).astype(x.dtype))
+_unary("isnan", jnp.isnan)
+_unary("isinf", jnp.isinf)
+_unary("isfinite", jnp.isfinite)
+_unary("size_array", lambda x: jnp.asarray([x.size], dtype=jnp.int64))
+_unary("shape_array", lambda x: jnp.asarray(x.shape, dtype=jnp.int64))
+
+
+@register("_copy", aliases=("identity",))
+def _copy(x):
+    """Identity copy (reference ``_copy`` op)."""
+    return jnp.asarray(x)
+
+
+@register("BlockGrad", aliases=("stop_gradient",))
+def block_grad(x):
+    """Stops gradient flow (reference ``BlockGrad``,
+    src/operator/tensor/elemwise_unary_op_basic.cc)."""
+    return jax.lax.stop_gradient(x)
+
+
+@register("make_loss")
+def make_loss(x):
+    """Head-gradient source (reference ``make_loss`` / ``MakeLoss``):
+    forward identity; gradient of the output w.r.t. input is all-ones
+    regardless of the incoming cotangent."""
+    @jax.custom_vjp
+    def _f(v):
+        return v
+
+    def _fwd(v):
+        return v, None
+
+    def _bwd(res, g):
+        return (jnp.ones_like(g),)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(x)
+
+
+@register("clip")
+def clip(x, a_min=None, a_max=None):
+    """Reference ``clip`` (src/operator/tensor/matrix_op.cc); gradient is zero
+    outside the clip range, matching the reference's backward."""
+    return jnp.clip(x, parse_float(a_min), parse_float(a_max))
+
+
+@register("LeakyReLU")
+def leaky_relu(x, *args, act_type="leaky", slope=0.25, lower_bound=0.125,
+               upper_bound=0.334):
+    """Reference ``LeakyReLU`` (src/operator/leaky_relu.cc): leaky/elu/prelu/
+    selu/gelu variants.  ``prelu`` takes gamma as a second input."""
+    slope = parse_float(slope, 0.25)
+    if act_type == "leaky":
+        return jnp.where(x > 0, x, slope * x)
+    if act_type == "elu":
+        return jnp.where(x > 0, x, slope * jnp.expm1(x))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "prelu":
+        gamma = args[0]
+        gamma = jnp.reshape(gamma, (1, -1) + (1,) * (x.ndim - 2)) if gamma.ndim == 1 else gamma
+        return jnp.where(x > 0, x, gamma * x)
+    if act_type == "rrelu":
+        slope = (parse_float(lower_bound, 0.125) + parse_float(upper_bound, 0.334)) / 2
+        return jnp.where(x > 0, x, slope * x)
+    raise ValueError(f"unknown LeakyReLU act_type {act_type}")
+
+
+@register("Activation")
+def activation(x, act_type="relu"):
+    """Reference ``Activation`` (src/operator/nn/activation.cc)."""
+    if act_type == "relu":
+        return jax.nn.relu(x)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return jax.nn.softplus(x)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(x)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register("hard_sigmoid")
+def hard_sigmoid(x, alpha=0.2, beta=0.5):
+    return jnp.clip(parse_float(alpha, 0.2) * x + parse_float(beta, 0.5), 0, 1)
+
+
+@register("softplus")
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+# ---------------------------------------------------------------------------
+# Elementwise binary (same-shape) — reference elemwise_binary_op_basic.cc.
+# The broadcast_* family (mx's general case) lives in broadcast_reduce.py;
+# these are registered separately to keep name parity.
+# ---------------------------------------------------------------------------
+def _binary(name, jfn, aliases=()):
+    def fn(lhs, rhs):
+        return jfn(lhs, rhs)
+    fn.__name__ = name
+    register(name, aliases=aliases)(fn)
+    return fn
+
+
+_binary("elemwise_add", jnp.add, aliases=("_plus", "_add"))
+_binary("elemwise_sub", jnp.subtract, aliases=("_minus", "_sub"))
+_binary("elemwise_mul", jnp.multiply, aliases=("_mul",))
+_binary("elemwise_div", jnp.divide, aliases=("_div",))
+_binary("_maximum", jnp.maximum)
+_binary("_minimum", jnp.minimum)
+_binary("_hypot", jnp.hypot)
+_binary("_power", jnp.power, aliases=("_Power",))
+
+
+@register("add_n", wrap_list=True, aliases=("ElementWiseSum", "_sum"))
+def add_n(*args):
+    """Sum of N arrays (reference ``add_n``/``ElementWiseSum``,
+    src/operator/tensor/elemwise_sum.cc)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Scalar ops — reference elemwise_binary_scalar_op_*.cc.  ``scalar`` is kept a
+# *traced* argument would cause recompiles in jit caches keyed on attrs; since
+# eager execution doesn't jit per-op, a plain Python float is fine and jit
+# users (CachedOp) bake the scalar into the compiled graph exactly like the
+# reference bakes it into the op node.
+# ---------------------------------------------------------------------------
+def _scalar(name, jfn):
+    def fn(x, scalar=1.0):
+        return jfn(x, parse_float(scalar, 1.0))
+    fn.__name__ = name
+    register(name)(fn)
+    return fn
+
+
+_scalar("_plus_scalar", lambda x, s: x + jnp.asarray(s, x.dtype))
+_scalar("_minus_scalar", lambda x, s: x - jnp.asarray(s, x.dtype))
+_scalar("_rminus_scalar", lambda x, s: jnp.asarray(s, x.dtype) - x)
+_scalar("_mul_scalar", lambda x, s: x * jnp.asarray(s, x.dtype))
+_scalar("_div_scalar", lambda x, s: x / jnp.asarray(s, x.dtype))
+_scalar("_rdiv_scalar", lambda x, s: jnp.asarray(s, x.dtype) / x)
+_scalar("_mod_scalar", lambda x, s: jnp.mod(x, jnp.asarray(s, x.dtype)))
+_scalar("_rmod_scalar", lambda x, s: jnp.mod(jnp.asarray(s, x.dtype), x))
+_scalar("_power_scalar", lambda x, s: jnp.power(x, jnp.asarray(s, x.dtype)))
+_scalar("_rpower_scalar", lambda x, s: jnp.power(jnp.asarray(s, x.dtype), x))
+_scalar("_maximum_scalar", lambda x, s: jnp.maximum(x, jnp.asarray(s, x.dtype)))
+_scalar("_minimum_scalar", lambda x, s: jnp.minimum(x, jnp.asarray(s, x.dtype)))
+_scalar("_hypot_scalar", lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)))
+_scalar("_equal_scalar", lambda x, s: (x == s).astype(x.dtype))
+_scalar("_not_equal_scalar", lambda x, s: (x != s).astype(x.dtype))
+_scalar("_greater_scalar", lambda x, s: (x > s).astype(x.dtype))
+_scalar("_greater_equal_scalar", lambda x, s: (x >= s).astype(x.dtype))
+_scalar("_lesser_scalar", lambda x, s: (x < s).astype(x.dtype))
+_scalar("_lesser_equal_scalar", lambda x, s: (x <= s).astype(x.dtype))
+_scalar("_logical_and_scalar", lambda x, s: ((x != 0) & (s != 0)).astype(x.dtype))
+_scalar("_logical_or_scalar", lambda x, s: ((x != 0) | (s != 0)).astype(x.dtype))
+_scalar("_logical_xor_scalar", lambda x, s: ((x != 0) ^ (s != 0)).astype(x.dtype))
+_scalar("smooth_l1", lambda x, s: jnp.where(jnp.abs(x) < 1.0 / (s * s),
+                                            0.5 * s * s * x * x,
+                                            jnp.abs(x) - 0.5 / (s * s)))
+
+
+@register("cast", aliases=("Cast", "amp_cast"))
+def cast(x, dtype="float32"):
+    """Reference ``Cast`` (elemwise_unary_op_basic.cc) and ``amp_cast``
+    (src/operator/tensor/amp_cast.cc)."""
+    from ..base import np_dtype
+    return x.astype(np_dtype(dtype))
+
+
+@register("amp_multicast", wrap_list=True)
+def amp_multicast(*args, num_outputs=None, cast_narrow=False):
+    """Reference ``amp_multicast``: cast all inputs to the widest (or
+    narrowest) dtype among them."""
+    dts = [a.dtype for a in args]
+    target = jnp.result_type(*dts) if not parse_bool(cast_narrow) else min(
+        dts, key=lambda d: jnp.finfo(d).bits if jnp.issubdtype(d, jnp.floating) else 64)
+    return tuple(a.astype(target) for a in args)
+
+
+@register("where")
+def where(condition, x, y):
+    """Reference ``where`` (src/operator/tensor/control_flow_op.cc)."""
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("zeros_like")
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like")
+def ones_like(x):
+    return jnp.ones_like(x)

@@ -1,0 +1,134 @@
+"""Image operators backing ``mx.image`` and ``gluon.data.vision.transforms``.
+
+Reference: ``src/operator/image/`` (image_random-inl.h, resize-inl.h,
+crop-inl.h) — to_tensor, normalize, resize, crop, flips, color jitter.
+HWC uint8/float inputs like the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import parse_bool, parse_float, parse_int, parse_tuple
+from .registry import register
+from .random_ops import _register_random
+
+
+@register("_image_to_tensor", aliases=("image_to_tensor", "to_tensor"))
+def to_tensor(data):
+    """HWC [0,255] -> CHW [0,1] float32 (reference image_random-inl.h)."""
+    x = data.astype(jnp.float32) / 255.0
+    if data.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+def _ftuple(v, default=(0.0,)):
+    import ast
+    if v is None:
+        return default
+    if isinstance(v, str):
+        v = ast.literal_eval(v)
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+@register("_image_normalize", aliases=("image_normalize",))
+def normalize(data, mean=None, std=None):
+    c = data.shape[0] if data.ndim == 3 else data.shape[1]
+    mean_a = jnp.resize(jnp.asarray(_ftuple(mean, (0.0,)), jnp.float32), (c,))
+    std_a = jnp.resize(jnp.asarray(_ftuple(std, (1.0,)), jnp.float32), (c,))
+    shape = (c, 1, 1) if data.ndim == 3 else (1, c, 1, 1)
+    return (data - mean_a.reshape(shape)) / std_a.reshape(shape)
+
+
+@register("_image_resize", aliases=("image_resize",))
+def resize(data, size=None, keep_ratio=False, interp=1):
+    """Reference ``image.resize`` (resize-inl.h); HWC or NHWC."""
+    sz = parse_tuple(size)
+    ih, iw = (data.shape[0], data.shape[1]) if data.ndim == 3 else (data.shape[1], data.shape[2])
+    if len(sz) == 1:
+        if parse_bool(keep_ratio):
+            # shorter side -> size, preserve aspect ratio (reference resize-inl.h)
+            if ih < iw:
+                sz = (int(round(iw * sz[0] / ih)), sz[0])
+            else:
+                sz = (sz[0], int(round(ih * sz[0] / iw)))
+        else:
+            sz = (sz[0], sz[0])
+    w, h = sz  # MXNet size is (w, h)
+    method = "bilinear" if parse_int(interp, 1) != 0 else "nearest"
+    if data.ndim == 3:
+        out_shape = (h, w, data.shape[2])
+    else:
+        out_shape = (data.shape[0], h, w, data.shape[3])
+    out = jax.image.resize(data.astype(jnp.float32), out_shape, method=method)
+    return out.astype(data.dtype) if jnp.issubdtype(data.dtype, jnp.integer) else out
+
+
+@register("_image_crop", aliases=("image_crop",))
+def crop(data, x=0, y=0, width=1, height=1):
+    xx, yy = parse_int(x, 0), parse_int(y, 0)
+    w, h = parse_int(width), parse_int(height)
+    if data.ndim == 3:
+        return data[yy:yy + h, xx:xx + w, :]
+    return data[:, yy:yy + h, xx:xx + w, :]
+
+
+@register("_image_flip_left_right", aliases=("image_flip_left_right",))
+def flip_left_right(data):
+    return jnp.flip(data, -2)
+
+
+@register("_image_flip_top_bottom", aliases=("image_flip_top_bottom",))
+def flip_top_bottom(data):
+    return jnp.flip(data, -3)
+
+
+@_register_random("_image_random_flip_left_right",
+                  aliases=("image_random_flip_left_right",))
+def random_flip_left_right(key, data):
+    return jnp.where(jax.random.bernoulli(key), jnp.flip(data, -2), data)
+
+
+@_register_random("_image_random_flip_top_bottom",
+                  aliases=("image_random_flip_top_bottom",))
+def random_flip_top_bottom(key, data):
+    return jnp.where(jax.random.bernoulli(key), jnp.flip(data, -3), data)
+
+
+@_register_random("_image_random_brightness", aliases=("image_random_brightness",))
+def random_brightness(key, data, min_factor=0.0, max_factor=0.0):
+    f = jax.random.uniform(key, (), jnp.float32, parse_float(min_factor, 0.0),
+                           parse_float(max_factor, 0.0))
+    return data * f
+
+
+@_register_random("_image_random_contrast", aliases=("image_random_contrast",))
+def random_contrast(key, data, min_factor=0.0, max_factor=0.0):
+    f = jax.random.uniform(key, (), jnp.float32, parse_float(min_factor, 0.0),
+                           parse_float(max_factor, 0.0))
+    gray = jnp.mean(data.astype(jnp.float32), axis=(-3, -2, -1), keepdims=True)
+    return f * data + (1 - f) * gray
+
+
+@_register_random("_image_random_saturation", aliases=("image_random_saturation",))
+def random_saturation(key, data, min_factor=0.0, max_factor=0.0):
+    f = jax.random.uniform(key, (), jnp.float32, parse_float(min_factor, 0.0),
+                           parse_float(max_factor, 0.0))
+    coef = jnp.asarray([0.299, 0.587, 0.114], jnp.float32)
+    gray = jnp.sum(data.astype(jnp.float32) * coef, axis=-1, keepdims=True)
+    return f * data + (1 - f) * gray
+
+
+@register("_image_adjust_lighting", aliases=("image_adjust_lighting",))
+def adjust_lighting(data, alpha=None):
+    """AlexNet-style PCA lighting (reference image_random-inl.h)."""
+    a = jnp.asarray(_ftuple(alpha), jnp.float32)
+    eigval = jnp.asarray([55.46, 4.794, 1.148], jnp.float32)
+    eigvec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.8140],
+                          [-0.5836, -0.6948, 0.4203]], jnp.float32)
+    delta = jnp.dot(eigvec * a, eigval)
+    return data + delta

@@ -1,0 +1,52 @@
+"""Creation operators (used by both namespaces and the Symbol executor).
+
+Reference: ``src/operator/tensor/init_op.cc`` (_zeros/_ones/_full/_arange/
+_eye/_linspace, zeros_like/ones_like).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import np_dtype, parse_float, parse_int, parse_tuple
+from .registry import register
+
+
+@register("_zeros")
+def _zeros(shape=None, ctx=None, dtype="float32"):
+    return jnp.zeros(parse_tuple(shape), np_dtype(dtype))
+
+
+@register("_ones")
+def _ones(shape=None, ctx=None, dtype="float32"):
+    return jnp.ones(parse_tuple(shape), np_dtype(dtype))
+
+
+@register("_full")
+def _full(shape=None, value=0.0, ctx=None, dtype="float32"):
+    return jnp.full(parse_tuple(shape), parse_float(value, 0.0), np_dtype(dtype))
+
+
+@register("_arange")
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, infer_range=False,
+            ctx=None, dtype="float32"):
+    out = jnp.arange(parse_float(start, 0.0),
+                     parse_float(stop) if stop is not None else None,
+                     parse_float(step, 1.0), np_dtype(dtype))
+    r = parse_int(repeat, 1)
+    if r > 1:
+        out = jnp.repeat(out, r)
+    return out
+
+
+@register("_linspace")
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, ctx=None, dtype="float32"):
+    from ..base import parse_bool
+    return jnp.linspace(parse_float(start), parse_float(stop), parse_int(num, 50),
+                        endpoint=parse_bool(endpoint, True), dtype=np_dtype(dtype))
+
+
+@register("_eye")
+def _eye(N=0, M=0, k=0, ctx=None, dtype="float32"):
+    n = parse_int(N)
+    m = parse_int(M, 0) or n
+    return jnp.eye(n, m, parse_int(k, 0), dtype=np_dtype(dtype))

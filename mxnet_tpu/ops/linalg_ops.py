@@ -1,0 +1,146 @@
+"""Linear-algebra operator suite.
+
+Reference: ``src/operator/tensor/la_op.cc`` — ``linalg_{gemm,gemm2,potrf,
+potri,trsm,trmm,syrk,gelqf,syevd,inverse,det,slogdet,makediag,extractdiag,
+maketrian,extracttrian,sumlogdiag}`` on cuBLAS/LAPACK (``src/operator/linalg.h``).
+TPU-native: ``jnp.linalg`` / ``lax.linalg`` lowerings.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import parse_bool, parse_float, parse_int
+from .registry import register
+
+
+@register("_linalg_gemm", aliases=("linalg_gemm",))
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if parse_bool(transpose_a) else A
+    b = jnp.swapaxes(B, -1, -2) if parse_bool(transpose_b) else B
+    return parse_float(alpha, 1.0) * jnp.matmul(a, b) + parse_float(beta, 1.0) * C
+
+
+@register("_linalg_gemm2", aliases=("linalg_gemm2",))
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if parse_bool(transpose_a) else A
+    b = jnp.swapaxes(B, -1, -2) if parse_bool(transpose_b) else B
+    return parse_float(alpha, 1.0) * jnp.matmul(a, b)
+
+
+@register("_linalg_potrf", aliases=("linalg_potrf",))
+def linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_potri", aliases=("linalg_potri",))
+def linalg_potri(A):
+    """Inverse from Cholesky factor: given L, compute (L Lᵀ)⁻¹."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    linv = lax.linalg.triangular_solve(A, eye, left_side=True, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("_linalg_trsm", aliases=("linalg_trsm",))
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    out = lax.linalg.triangular_solve(
+        A, parse_float(alpha, 1.0) * B,
+        left_side=not parse_bool(rightside),
+        lower=parse_bool(lower, True),
+        transpose_a=parse_bool(transpose))
+    return out
+
+
+@register("_linalg_trmm", aliases=("linalg_trmm",))
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    tri = jnp.tril(A) if parse_bool(lower, True) else jnp.triu(A)
+    if parse_bool(transpose):
+        tri = jnp.swapaxes(tri, -1, -2)
+    if parse_bool(rightside):
+        return parse_float(alpha, 1.0) * jnp.matmul(B, tri)
+    return parse_float(alpha, 1.0) * jnp.matmul(tri, B)
+
+
+@register("_linalg_syrk", aliases=("linalg_syrk",))
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if parse_bool(transpose) else A
+    return parse_float(alpha, 1.0) * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
+
+
+@register("_linalg_gelqf", aliases=("linalg_gelqf",))
+def linalg_gelqf(A):
+    """LQ factorization (A = L Q with Q orthonormal rows)."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("_linalg_syevd", aliases=("linalg_syevd",))
+def linalg_syevd(A):
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("_linalg_inverse", aliases=("linalg_inverse",))
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("_linalg_det", aliases=("linalg_det",))
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("_linalg_slogdet", aliases=("linalg_slogdet",))
+def linalg_slogdet(A):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet
+
+
+@register("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
+def linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("_linalg_makediag", aliases=("linalg_makediag",))
+def linalg_makediag(A, offset=0):
+    k = parse_int(offset, 0)
+    n = A.shape[-1] + abs(k)
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    idx = jnp.arange(A.shape[-1])
+    r = idx + max(-k, 0)
+    c = idx + max(k, 0)
+    return out.at[..., r, c].set(A)
+
+
+@register("_linalg_extractdiag", aliases=("linalg_extractdiag",))
+def linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, parse_int(offset, 0), axis1=-2, axis2=-1)
+
+
+@register("_linalg_maketrian", aliases=("linalg_maketrian",))
+def linalg_maketrian(A, offset=0, lower=True):
+    k = parse_int(offset, 0)
+    lower_ = parse_bool(lower, True)
+    # A holds packed triangle rows; reconstruct dense triangular matrix
+    m = A.shape[-1]
+    # n(n+1)/2 = m  ->  n
+    n = int((-1 + (1 + 8 * m) ** 0.5) / 2)
+    out = jnp.zeros(A.shape[:-1] + (n + abs(k), n + abs(k)), A.dtype)
+    rows, cols = jnp.tril_indices(n)
+    if not lower_:
+        rows, cols = cols, rows
+    if k:
+        if (k < 0) == lower_:
+            rows = rows + abs(k) if lower_ else rows
+            cols = cols + abs(k) if not lower_ else cols
+    return out.at[..., rows, cols].set(A)
+
+
+@register("_linalg_extracttrian", aliases=("linalg_extracttrian",))
+def linalg_extracttrian(A, offset=0, lower=True):
+    n = A.shape[-1]
+    rows, cols = jnp.tril_indices(n)
+    if not parse_bool(lower, True):
+        rows, cols = cols, rows
+    return A[..., rows, cols]

@@ -1,0 +1,491 @@
+"""Shape-manipulation, indexing, and matrix operators.
+
+Reference being rebuilt: ``src/operator/tensor/matrix_op.cc`` (+``-inl.h``),
+``indexing_op.cc/h``, ``dot-inl.h``, ``ordering_op.cc``, ``init_op.cc``,
+``diag_op.cc``, ``histogram.cc``.  All static-shape transforms lower to XLA
+reshape/transpose/gather/scatter, which are free or fused on TPU; ``dot`` and
+``batch_dot`` land on the MXU via ``jnp.matmul``/``lax.dot_general``.
+"""
+from __future__ import annotations
+
+import ast
+
+import jax
+import jax.numpy as jnp
+
+from ..base import np_dtype, parse_bool, parse_int, parse_tuple
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# Reshape family
+# ---------------------------------------------------------------------------
+@register("reshape", aliases=("Reshape",))
+def reshape(data, shape=None, reverse=False, target_shape=None, keep_highest=False):
+    """Reference ``Reshape`` (matrix_op.cc) incl. the special codes:
+    0 (copy dim), -1 (infer), -2 (copy rest), -3 (merge two), -4 (split)."""
+    if target_shape is not None and shape is None:
+        shape = target_shape
+    shape = parse_tuple(shape)
+    src = list(data.shape)
+    if parse_bool(reverse):
+        src = src[::-1]
+        shape = tuple(reversed(shape))
+    out, si = [], 0
+    it = iter(range(len(shape)))
+    i = 0
+    while i < len(shape):
+        s = shape[i]
+        if s == 0:
+            out.append(src[si]); si += 1
+        elif s == -1:
+            out.append(-1); si += 1
+        elif s == -2:
+            out.extend(src[si:]); si = len(src)
+        elif s == -3:
+            out.append(src[si] * src[si + 1]); si += 2
+        elif s == -4:
+            d1, d2 = shape[i + 1], shape[i + 2]
+            if d1 == -1:
+                d1 = src[si] // d2
+            if d2 == -1:
+                d2 = src[si] // d1
+            out.extend([d1, d2]); si += 1; i += 2
+        else:
+            out.append(s)
+            if si < len(src):
+                si += 1
+        i += 1
+    if parse_bool(reverse):
+        out = out[::-1]
+    return jnp.reshape(data, tuple(out))
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None, rhs_end=None):
+    if lhs_begin is None and rhs_begin is None:
+        return jnp.reshape(lhs, rhs.shape)
+    lb = parse_int(lhs_begin, 0) or 0
+    le = parse_int(lhs_end, lhs.ndim)
+    rb = parse_int(rhs_begin, 0) or 0
+    re_ = parse_int(rhs_end, rhs.ndim)
+    new_shape = lhs.shape[:lb] + rhs.shape[rb:re_] + lhs.shape[le:]
+    return jnp.reshape(lhs, new_shape)
+
+
+@register("Flatten", aliases=("flatten",))
+def flatten(data):
+    """Reference ``Flatten``: collapse all but the first axis."""
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose")
+def transpose(data, axes=None):
+    axes = parse_tuple(axes) if axes else None
+    return jnp.transpose(data, axes)
+
+
+@register("expand_dims")
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, parse_int(axis, 0))
+
+
+@register("squeeze")
+def squeeze(data, axis=None):
+    ax = parse_tuple(axis) if axis is not None else None
+    return jnp.squeeze(data, ax)
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, parse_int(dim1, 0), parse_int(dim2, 0))
+
+
+@register("depth_to_space")
+def depth_to_space(data, block_size=1):
+    b = parse_int(block_size)
+    n, c, h, w = data.shape
+    x = jnp.reshape(data, (n, b, b, c // (b * b), h, w))
+    x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+    return jnp.reshape(x, (n, c // (b * b), h * b, w * b))
+
+
+@register("space_to_depth")
+def space_to_depth(data, block_size=1):
+    b = parse_int(block_size)
+    n, c, h, w = data.shape
+    x = jnp.reshape(data, (n, c, h // b, b, w // b, b))
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return jnp.reshape(x, (n, c * b * b, h // b, w // b))
+
+
+# ---------------------------------------------------------------------------
+# Slicing / concat / stack / split
+# ---------------------------------------------------------------------------
+def _norm_slice(v, ndim):
+    if v is None:
+        return [None] * ndim
+    v = parse_tuple_allow_none(v)
+    return list(v) + [None] * (ndim - len(v))
+
+
+def parse_tuple_allow_none(v):
+    if isinstance(v, str):
+        v = ast.literal_eval(v)
+    if isinstance(v, int):
+        return (v,)
+    return tuple(v)
+
+
+@register("slice", aliases=("crop",))
+def slice_op(data, begin=None, end=None, step=None):
+    """Reference ``slice`` (matrix_op.cc)."""
+    b = _norm_slice(begin, data.ndim)
+    e = _norm_slice(end, data.ndim)
+    s = _norm_slice(step, data.ndim)
+    idx = tuple(slice(bb, ee, ss if ss else None) for bb, ee, ss in zip(b, e, s))
+    return data[idx]
+
+
+@register("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None):
+    ax = parse_int(axis, 0) % data.ndim
+    idx = [slice(None)] * data.ndim
+    end_v = parse_int(end) if end is not None else None
+    idx[ax] = slice(parse_int(begin, 0), end_v)
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(data, shape_like, axes=None):
+    axes = parse_tuple(axes) if axes else tuple(range(data.ndim))
+    idx = [slice(None)] * data.ndim
+    for a in axes:
+        idx[a % data.ndim] = slice(0, shape_like.shape[a % data.ndim])
+    return data[tuple(idx)]
+
+
+@register("Concat", aliases=("concat",), wrap_list=True)
+def concat(*args, dim=1, num_args=None):
+    """Reference ``Concat`` (src/operator/nn/concat.cc)."""
+    return jnp.concatenate(args, axis=parse_int(dim, 1))
+
+
+@register("stack", wrap_list=True)
+def stack(*args, axis=0, num_args=None):
+    return jnp.stack(args, axis=parse_int(axis, 0))
+
+
+@register("split", aliases=("SliceChannel",), wrap_list=False)
+def split(data, num_outputs=1, axis=1, squeeze_axis=False):
+    """Reference ``SliceChannel``/``split`` (src/operator/slice_channel.cc)."""
+    n = parse_int(num_outputs, 1)
+    ax = parse_int(axis, 1)
+    parts = jnp.split(data, n, axis=ax)
+    if parse_bool(squeeze_axis):
+        parts = [jnp.squeeze(p, ax) for p in parts]
+    return tuple(parts) if n > 1 else parts[0]
+
+
+@register("split_v2")
+def split_v2(data, indices=None, axis=1, squeeze_axis=False, sections=0):
+    ax = parse_int(axis, 1)
+    sections = parse_int(sections, 0)
+    if sections:
+        parts = jnp.split(data, sections, axis=ax)
+    else:
+        parts = jnp.split(data, list(parse_tuple(indices)), axis=ax)
+    if parse_bool(squeeze_axis):
+        parts = [jnp.squeeze(p, ax) for p in parts]
+    return tuple(parts)
+
+
+@register("tile")
+def tile(data, reps=None):
+    return jnp.tile(data, parse_tuple(reps))
+
+
+@register("repeat")
+def repeat(data, repeats=1, axis=None):
+    ax = parse_int(axis) if axis is not None else None
+    out = jnp.repeat(data, parse_int(repeats, 1), axis=ax)
+    return out
+
+
+@register("reverse", aliases=("flip",))
+def reverse(data, axis=None):
+    ax = parse_tuple(axis)
+    return jnp.flip(data, ax)
+
+
+@register("Pad", aliases=("pad",))
+def pad(data, mode="constant", pad_width=None, constant_value=0):
+    """Reference ``Pad`` (src/operator/pad.cc): pad_width is a flat 2*ndim
+    tuple (before, after per axis)."""
+    pw = parse_tuple(pad_width)
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    if mode == "constant":
+        return jnp.pad(data, pairs, constant_values=float(constant_value))
+    if mode == "edge":
+        return jnp.pad(data, pairs, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(data, pairs, mode="reflect")
+    raise ValueError(f"unknown pad mode {mode}")
+
+
+# ---------------------------------------------------------------------------
+# Indexing
+# ---------------------------------------------------------------------------
+@register("take")
+def take(a, indices, axis=0, mode="clip"):
+    """Reference ``take`` (indexing_op.cc)."""
+    ax = parse_int(axis, 0)
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[ax])
+    else:
+        idx = jnp.clip(idx, 0, a.shape[ax] - 1)
+    return jnp.take(a, idx, axis=ax)
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    idx = jnp.clip(indices.astype(jnp.int32), 0, a.shape[1] - 1)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register("Embedding")
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False):
+    """Reference ``Embedding`` (indexing_op.cc): row gather; on TPU this is a
+    single XLA gather and its VJP is the scatter-add the reference implements
+    by hand (``AddTakeGrad``)."""
+    idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("one_hot")
+def one_hot(indices, depth=None, on_value=1.0, off_value=0.0, dtype="float32"):
+    d = parse_int(depth)
+    idx = indices.astype(jnp.int32)
+    eye = jax.nn.one_hot(idx, d, dtype=np_dtype(dtype))
+    on_v, off_v = float(on_value), float(off_value)
+    if on_v != 1.0 or off_v != 0.0:
+        eye = eye * (on_v - off_v) + off_v
+    return eye
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    """Reference ``gather_nd`` (indexing_op.cc): indices shape (M, ...) where
+    M leading index dims address data axes."""
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, shape=None):
+    shp = parse_tuple(shape)
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shp, data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register("_scatter_set_nd")
+def scatter_set_nd(lhs, rhs, indices, shape=None):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return lhs.at[tuple(idx[i] for i in range(m))].set(rhs)
+
+
+@register("_backward_gather_nd", aliases=("scatter_nd_add",))
+def gather_nd_backward(data, indices, shape=None):
+    shp = parse_tuple(shape)
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shp, data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].add(data)
+
+
+@register("boolean_mask")
+def boolean_mask(data, index, axis=0):
+    """Reference ``_contrib_boolean_mask`` — dynamic output shape; eager-only
+    on TPU (not jittable), mirroring the reference's dynamic-shape ops."""
+    import numpy as _onp
+    mask = _onp.asarray(index).astype(bool)
+    return jnp.compress(mask, data, axis=parse_int(axis, 0))
+
+
+# ---------------------------------------------------------------------------
+# dot / batch_dot / linalg-lite
+# ---------------------------------------------------------------------------
+@register("dot")
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
+    """Reference ``dot`` (dot-inl.h): contracts last axis of lhs with first
+    axis of rhs (after optional transposes).  Lowers to an MXU matmul."""
+    ta, tb = parse_bool(transpose_a), parse_bool(transpose_b)
+    a = jnp.transpose(lhs) if ta else lhs
+    b = jnp.transpose(rhs) if tb else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return jnp.tensordot(a, b, axes=1)
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
+    """Reference ``batch_dot``: (B, M, K) x (B, K, N) -> (B, M, N)."""
+    a = jnp.swapaxes(lhs, -1, -2) if parse_bool(transpose_a) else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if parse_bool(transpose_b) else rhs
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao", wrap_list=True)
+def khatri_rao(*args):
+    """Column-wise Kronecker product (reference src/operator/contrib/krprod.cc)."""
+    a = args[0]
+    for b in args[1:]:
+        a = jnp.einsum("ik,jk->ijk", a, b).reshape(-1, a.shape[1])
+    return a
+
+
+# ---------------------------------------------------------------------------
+# Ordering ops
+# ---------------------------------------------------------------------------
+@register("sort")
+def sort(data, axis=-1, is_ascend=True):
+    ax = parse_int(axis, -1)
+    out = jnp.sort(data, axis=ax)
+    if not parse_bool(is_ascend, True):
+        out = jnp.flip(out, ax)
+    return out
+
+
+@register("argsort")
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    ax = parse_int(axis, -1)
+    key = data if parse_bool(is_ascend, True) else -data
+    return jnp.argsort(key, axis=ax).astype(np_dtype(dtype))
+
+
+@register("topk")
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """Reference ``topk`` (ordering_op.cc)."""
+    ax = parse_int(axis, -1) if axis is not None else None
+    kk = parse_int(k, 1)
+    if ax is None:
+        data = jnp.reshape(data, (-1,))
+        ax = 0
+    ax = ax % data.ndim
+    key = data if not parse_bool(is_ascend) else -data
+    moved = jnp.moveaxis(key, ax, -1)
+    vals, idxs = jax.lax.top_k(moved, kk)
+    src_vals = jnp.moveaxis(data, ax, -1)
+    vals = jnp.take_along_axis(src_vals, idxs, axis=-1)
+    vals = jnp.moveaxis(vals, -1, ax)
+    idxs = jnp.moveaxis(idxs, -1, ax)
+    rt = ret_typ
+    if rt == "indices":
+        return idxs.astype(np_dtype(dtype))
+    if rt == "value":
+        return vals
+    if rt == "both":
+        return vals, idxs.astype(np_dtype(dtype))
+    if rt == "mask":
+        onehots = jax.nn.one_hot(jnp.moveaxis(idxs, ax, -1), data.shape[ax], dtype=data.dtype)
+        mask = onehots.sum(-2)
+        return jnp.moveaxis(mask, -1, ax)
+    raise ValueError(f"unknown ret_typ {rt}")
+
+
+# ---------------------------------------------------------------------------
+# Misc
+# ---------------------------------------------------------------------------
+@register("diag")
+def diag(data, k=0, axis1=0, axis2=1):
+    kk = parse_int(k, 0)
+    if data.ndim == 1:
+        return jnp.diag(data, kk)
+    return jnp.diagonal(data, kk, parse_int(axis1, 0), parse_int(axis2, 1))
+
+
+@register("histogram", aliases=("_histogram",))
+def histogram(data, bins=None, bin_cnt=None, range=None):
+    if bins is not None and not isinstance(bins, (int, str)):
+        hist, edges = jnp.histogram(data, bins=bins)
+    else:
+        cnt = parse_int(bin_cnt, 10)
+        rng = parse_tuple(range) if range is not None else None
+        hist, edges = jnp.histogram(data, bins=cnt,
+                                    range=tuple(float(x) for x in rng) if rng else None)
+    return hist, edges
+
+
+@register("ravel_multi_index", aliases=("_ravel_multi_index",))
+def ravel_multi_index(data, shape=None):
+    shp = parse_tuple(shape)
+    idx = data.astype(jnp.int64)
+    out = jnp.zeros(idx.shape[1:], jnp.int64)
+    for i, s in enumerate(shp):
+        out = out * s + idx[i]
+    return out.astype(data.dtype)
+
+
+@register("unravel_index", aliases=("_unravel_index",))
+def unravel_index(data, shape=None):
+    shp = parse_tuple(shape)
+    idx = data.astype(jnp.int64)
+    outs = []
+    rem = idx
+    for s in reversed(shp):
+        outs.append(rem % s)
+        rem = rem // s
+    return jnp.stack(list(reversed(outs)), axis=0).astype(data.dtype)
+
+
+@register("SequenceMask")
+def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
+    """Reference ``SequenceMask`` (src/operator/sequence_mask.cc): data is
+    (seq, batch, ...) for axis=0."""
+    if not parse_bool(use_sequence_length) or sequence_length is None:
+        return data
+    ax = parse_int(axis, 0)
+    seq_len = data.shape[ax]
+    pos = jnp.arange(seq_len)
+    shape = [1] * data.ndim
+    shape[ax] = seq_len
+    pos = jnp.reshape(pos, shape)
+    batch_axis = 1 - ax
+    lshape = [1] * data.ndim
+    lshape[batch_axis] = data.shape[batch_axis]
+    lens = jnp.reshape(sequence_length.astype(jnp.int32), lshape)
+    mask = pos < lens
+    return jnp.where(mask, data, jnp.asarray(float(value), data.dtype))
+
+
+@register("SequenceLast")
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    ax = parse_int(axis, 0)
+    if not parse_bool(use_sequence_length) or sequence_length is None:
+        return jnp.take(data, data.shape[ax] - 1, axis=ax)
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    moved = jnp.moveaxis(data, ax, 0)  # (seq, batch, ...)
+    return jnp.take_along_axis(
+        moved, idx.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0)[0]
+
+
+@register("SequenceReverse")
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    ax = parse_int(axis, 0)
+    if not parse_bool(use_sequence_length) or sequence_length is None:
+        return jnp.flip(data, ax)
+    moved = jnp.moveaxis(data, ax, 0)
+    seq = moved.shape[0]
+    pos = jnp.arange(seq)[:, None]
+    lens = sequence_length.astype(jnp.int32)[None, :]
+    rev_idx = jnp.where(pos < lens, lens - 1 - pos, pos)
+    bidx = jnp.broadcast_to(rev_idx.reshape(rev_idx.shape + (1,) * (moved.ndim - 2)),
+                            moved.shape).astype(jnp.int32)
+    out = jnp.take_along_axis(moved, bidx, axis=0)
+    return jnp.moveaxis(out, 0, ax)

@@ -1,0 +1,243 @@
+"""Optimizer update operators.
+
+Reference being rebuilt: ``src/operator/optimizer_op.cc:47-893`` — sgd_update,
+sgd_mom_update, multi-precision (mp_) variants with fp32 master weights,
+adam/ftml/nag/rmsprop/rmspropalex/ftrl/signsgd/signum/adagrad updates, plus
+the aggregated ``multi_sgd_*`` family.
+
+TPU-native redesign: each update is a pure function returning the new weight
+(and new state); the frontend rebinds the NDArray handles in place to preserve
+MXNet's mutate-the-weight semantics.  Under ``jax.jit`` (fused trainer step)
+XLA fuses these into the gradient computation — the hand-written "aggregated"
+multi-tensor kernels are unnecessary, but the ops are kept for API parity.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import parse_bool, parse_float
+from .registry import register
+
+# Ops whose outputs must be written back into their input NDArrays by the
+# imperative frontend: name -> list of (input_index, output_index).
+INPLACE_UPDATES = {}
+
+
+def _register_update(name, writeback, aliases=()):
+    def deco(fn):
+        register(name, aliases=aliases)(fn)
+        INPLACE_UPDATES[name] = writeback
+        for a in aliases:
+            INPLACE_UPDATES[a] = writeback
+        return fn
+    return deco
+
+
+def _apply_wd(grad, weight, wd, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+@_register_update("sgd_update", [(0, 0)])
+def sgd_update(weight, grad, lr=None, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    """Reference ``sgd_update`` (optimizer_op.cc:47 region)."""
+    g = _apply_wd(grad, weight, parse_float(wd, 0.0), parse_float(rescale_grad, 1.0),
+                  parse_float(clip_gradient))
+    return weight - parse_float(lr) * g
+
+
+@_register_update("sgd_mom_update", [(0, 0), (2, 1)])
+def sgd_mom_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd(grad, weight, parse_float(wd, 0.0), parse_float(rescale_grad, 1.0),
+                  parse_float(clip_gradient))
+    new_mom = parse_float(momentum, 0.0) * mom - parse_float(lr) * g
+    return weight + new_mom, new_mom
+
+
+@_register_update("mp_sgd_update", [(0, 0), (2, 1)])
+def mp_sgd_update(weight, grad, weight32, lr=None, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    """Multi-precision SGD (fp16 weight + fp32 master copy) — reference
+    ``mp_sgd_update``."""
+    g32 = grad.astype(jnp.float32)
+    g = _apply_wd(g32, weight32, parse_float(wd, 0.0), parse_float(rescale_grad, 1.0),
+                  parse_float(clip_gradient))
+    new_w32 = weight32 - parse_float(lr) * g
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@_register_update("mp_sgd_mom_update", [(0, 0), (2, 1), (3, 2)])
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr=None, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    g32 = grad.astype(jnp.float32)
+    g = _apply_wd(g32, weight32, parse_float(wd, 0.0), parse_float(rescale_grad, 1.0),
+                  parse_float(clip_gradient))
+    new_mom = parse_float(momentum, 0.0) * mom - parse_float(lr) * g
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@_register_update("adam_update", [(0, 0), (2, 1), (3, 2)])
+def adam_update(weight, grad, mean, var, lr=None, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    """Reference ``adam_update`` (optimizer_op.cc)."""
+    b1, b2 = parse_float(beta1, 0.9), parse_float(beta2, 0.999)
+    g = _apply_wd(grad, weight, parse_float(wd, 0.0), parse_float(rescale_grad, 1.0),
+                  parse_float(clip_gradient))
+    new_mean = b1 * mean + (1 - b1) * g
+    new_var = b2 * var + (1 - b2) * jnp.square(g)
+    new_w = weight - parse_float(lr) * new_mean / (jnp.sqrt(new_var) + parse_float(epsilon, 1e-8))
+    return new_w, new_mean, new_var
+
+
+@_register_update("nag_mom_update", [(0, 0), (2, 1)])
+def nag_mom_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, parse_float(wd, 0.0), parse_float(rescale_grad, 1.0),
+                  parse_float(clip_gradient))
+    mu = parse_float(momentum, 0.0)
+    new_mom = mu * mom + g
+    return weight - parse_float(lr) * (g + mu * new_mom), new_mom
+
+
+@_register_update("rmsprop_update", [(0, 0), (2, 1)])
+def rmsprop_update(weight, grad, n, lr=None, gamma1=0.95, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0):
+    g = _apply_wd(grad, weight, parse_float(wd, 0.0), parse_float(rescale_grad, 1.0),
+                  parse_float(clip_gradient))
+    g1 = parse_float(gamma1, 0.95)
+    new_n = (1 - g1) * jnp.square(g) + g1 * n
+    new_w = weight - parse_float(lr) * g / jnp.sqrt(new_n + parse_float(epsilon, 1e-8))
+    cw = parse_float(clip_weights)
+    if cw is not None and cw > 0:
+        new_w = jnp.clip(new_w, -cw, cw)
+    return new_w, new_n
+
+
+@_register_update("rmspropalex_update", [(0, 0), (2, 1), (3, 2), (4, 3)])
+def rmspropalex_update(weight, grad, n, g, delta, lr=None, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    gr = _apply_wd(grad, weight, parse_float(wd, 0.0), parse_float(rescale_grad, 1.0),
+                   parse_float(clip_gradient))
+    g1, g2 = parse_float(gamma1, 0.95), parse_float(gamma2, 0.9)
+    new_n = (1 - g1) * jnp.square(gr) + g1 * n
+    new_g = (1 - g1) * gr + g1 * g
+    new_delta = parse_float(gamma2, 0.9) * delta - parse_float(lr) * gr / \
+        jnp.sqrt(new_n - jnp.square(new_g) + parse_float(epsilon, 1e-8))
+    return weight + new_delta, new_n, new_g, new_delta
+
+
+@_register_update("ftrl_update", [(0, 0), (2, 1), (3, 2)])
+def ftrl_update(weight, grad, z, n, lr=None, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * parse_float(rescale_grad, 1.0)
+    cg = parse_float(clip_gradient)
+    if cg is not None and cg > 0:
+        g = jnp.clip(g, -cg, cg)
+    lr_, l1, b, wd_ = parse_float(lr), parse_float(lamda1, 0.01), \
+        parse_float(beta, 1.0), parse_float(wd, 0.0)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr_
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) > l1,
+        -(new_z - jnp.sign(new_z) * l1) / ((b + jnp.sqrt(new_n)) / lr_ + wd_),
+        jnp.zeros_like(weight))
+    return new_w, new_z, new_n
+
+
+@_register_update("signsgd_update", [(0, 0)])
+def signsgd_update(weight, grad, lr=None, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = grad * parse_float(rescale_grad, 1.0)
+    cg = parse_float(clip_gradient)
+    if cg is not None and cg > 0:
+        g = jnp.clip(g, -cg, cg)
+    return weight - parse_float(lr) * (jnp.sign(g) + parse_float(wd, 0.0) * weight)
+
+
+@_register_update("signum_update", [(0, 0), (2, 1)])
+def signum_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = grad * parse_float(rescale_grad, 1.0)
+    cg = parse_float(clip_gradient)
+    if cg is not None and cg > 0:
+        g = jnp.clip(g, -cg, cg)
+    mu = parse_float(momentum, 0.0)
+    new_mom = mu * mom - (1 - mu) * g
+    new_w = weight + parse_float(lr) * (jnp.sign(new_mom) -
+                                        parse_float(wd_lh, 0.0) * weight)
+    return new_w, new_mom
+
+
+@_register_update("ftml_update", [(0, 0), (2, 1), (3, 2), (4, 3)])
+def ftml_update(weight, grad, d, v, z, lr=None, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                clip_grad=-1.0):
+    b1, b2 = parse_float(beta1, 0.6), parse_float(beta2, 0.999)
+    eps, tt = parse_float(epsilon, 1e-8), parse_float(t, 1)
+    g = _apply_wd(grad, weight, parse_float(wd, 0.0), parse_float(rescale_grad, 1.0),
+                  parse_float(clip_grad))
+    new_v = b2 * v + (1 - b2) * jnp.square(g)
+    d_t = (1 - b1 ** tt) / parse_float(lr) * \
+        (jnp.sqrt(new_v / (1 - b2 ** tt)) + eps)
+    sigma_t = d_t - b1 * d
+    new_z = b1 * z + (1 - b1) * g - sigma_t * weight
+    new_w = -new_z / d_t
+    return new_w, d_t, new_v, new_z
+
+
+@_register_update("_sparse_adagrad_update", [(0, 0), (2, 1)],
+                  aliases=("adagrad_update",))
+def adagrad_update(weight, grad, history, lr=None, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, parse_float(wd, 0.0), parse_float(rescale_grad, 1.0),
+                  parse_float(clip_gradient))
+    new_hist = history + jnp.square(g)
+    return weight - parse_float(lr) * g / (jnp.sqrt(new_hist) + parse_float(epsilon, 1e-7)), new_hist
+
+
+@_register_update("adamw_update", [(0, 0), (2, 1), (3, 2)])
+def adamw_update(weight, grad, mean, var, rescale_grad=None, lr=None, eta=1.0,
+                 beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                 clip_gradient=-1.0):
+    """Reference ``_contrib_adamw_update`` (src/operator/contrib/adamw.cc):
+    decoupled weight decay."""
+    b1, b2 = parse_float(beta1, 0.9), parse_float(beta2, 0.999)
+    rs = rescale_grad if rescale_grad is not None else 1.0
+    if hasattr(rs, "shape"):
+        g = grad * rs
+    else:
+        g = grad * parse_float(rs, 1.0)
+    cg = parse_float(clip_gradient)
+    if cg is not None and cg > 0:
+        g = jnp.clip(g, -cg, cg)
+    new_mean = b1 * mean + (1 - b1) * g
+    new_var = b2 * var + (1 - b2) * jnp.square(g)
+    upd = new_mean / (jnp.sqrt(new_var) + parse_float(epsilon, 1e-8)) + \
+        parse_float(wd, 0.0) * weight
+    new_w = weight - parse_float(eta, 1.0) * parse_float(lr) * upd
+    return new_w, new_mean, new_var
+
+
+@register("all_finite", wrap_list=True)
+def all_finite(*arrays, init_output=True):
+    """Reference ``all_finite`` (src/operator/contrib/all_finite.cc): AMP
+    gradient-overflow scan."""
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok = ok & jnp.all(jnp.isfinite(a.astype(jnp.float32)))
+    return ok.astype(jnp.float32).reshape(1)
+
+
+@register("multi_all_finite", wrap_list=True)
+def multi_all_finite(*arrays, num_arrays=1, init_output=True):
+    return all_finite(*arrays)

@@ -1,0 +1,159 @@
+"""Random sampling operators.
+
+Reference being rebuilt: ``src/operator/random/sample_op.cc`` (uniform/normal/
+gamma/exponential/poisson/negative_binomial/generalized_negative_binomial),
+``multisample_op.cc``, ``shuffle_op.cc``, ``unique_sample_op.cc``; backed by
+per-device ``RandomGenerator`` resources (``include/mxnet/random_generator.h``).
+
+TPU-native redesign: every stochastic op takes an explicit ``jax.random`` key
+as its first array input (functional randomness — the TPU-correct model).  The
+frontend (``ndarray/register.py``) splits a process-global key per call so the
+MXNet-visible API (global seed via ``mx.random.seed``) is preserved, and jitted
+graphs thread keys as ordinary inputs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import np_dtype, parse_float, parse_int, parse_tuple
+from .registry import register
+
+STOCHASTIC_OPS = set()
+
+
+def _register_random(name, aliases=()):
+    def deco(fn):
+        register(name, aliases=aliases)(fn)
+        STOCHASTIC_OPS.add(name)
+        for a in aliases:
+            STOCHASTIC_OPS.add(a)
+        return fn
+    return deco
+
+
+def _shape_dtype(shape, dtype):
+    shape = parse_tuple(shape) if shape is not None else (1,)
+    dt = np_dtype(dtype if dtype not in (None, "None") else "float32")
+    return shape, dt
+
+
+@_register_random("_random_uniform", aliases=("uniform", "random_uniform"))
+def random_uniform(key, low=0.0, high=1.0, shape=None, dtype=None, ctx=None):
+    shape, dt = _shape_dtype(shape, dtype)
+    return jax.random.uniform(key, shape, dt, parse_float(low, 0.0), parse_float(high, 1.0))
+
+
+@_register_random("_random_normal", aliases=("normal", "random_normal"))
+def random_normal(key, loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None):
+    shape, dt = _shape_dtype(shape, dtype)
+    return jax.random.normal(key, shape, dt) * parse_float(scale, 1.0) + parse_float(loc, 0.0)
+
+
+@_register_random("_random_gamma", aliases=("gamma", "random_gamma"))
+def random_gamma(key, alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None):
+    shape, dt = _shape_dtype(shape, dtype)
+    return jax.random.gamma(key, parse_float(alpha, 1.0), shape, dt) * parse_float(beta, 1.0)
+
+
+@_register_random("_random_exponential", aliases=("exponential", "random_exponential"))
+def random_exponential(key, lam=1.0, shape=None, dtype=None, ctx=None):
+    shape, dt = _shape_dtype(shape, dtype)
+    return jax.random.exponential(key, shape, dt) / parse_float(lam, 1.0)
+
+
+@_register_random("_random_poisson", aliases=("poisson", "random_poisson"))
+def random_poisson(key, lam=1.0, shape=None, dtype=None, ctx=None):
+    shape, dt = _shape_dtype(shape, dtype)
+    return jax.random.poisson(key, parse_float(lam, 1.0), shape).astype(dt)
+
+
+@_register_random("_random_negative_binomial",
+                  aliases=("negative_binomial", "random_negative_binomial"))
+def random_negative_binomial(key, k=1, p=1.0, shape=None, dtype=None, ctx=None):
+    shape, dt = _shape_dtype(shape, dtype)
+    kk, pp = parse_float(k, 1), parse_float(p, 1.0)
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, kk, shape) * (1 - pp) / pp
+    return jax.random.poisson(k2, lam, shape).astype(dt)
+
+
+@_register_random("_random_generalized_negative_binomial",
+                  aliases=("generalized_negative_binomial",
+                           "random_generalized_negative_binomial"))
+def random_gen_neg_binomial(key, mu=1.0, alpha=1.0, shape=None, dtype=None, ctx=None):
+    shape, dt = _shape_dtype(shape, dtype)
+    mu_, a_ = parse_float(mu, 1.0), parse_float(alpha, 1.0)
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, 1.0 / a_, shape) * a_ * mu_
+    return jax.random.poisson(k2, lam, shape).astype(dt)
+
+
+@_register_random("_random_randint", aliases=("randint", "random_randint"))
+def random_randint(key, low=0, high=1, shape=None, dtype=None, ctx=None):
+    shape, _ = _shape_dtype(shape, dtype)
+    dt = np_dtype(dtype if dtype not in (None, "None") else "int32")
+    return jax.random.randint(key, shape, parse_int(low, 0), parse_int(high, 1), dt)
+
+
+@_register_random("_sample_uniform", aliases=("sample_uniform",))
+def sample_uniform(key, low, high, shape=None, dtype=None):
+    shape = parse_tuple(shape) if shape else ()
+    out_shape = low.shape + shape
+    u = jax.random.uniform(key, out_shape, np_dtype(dtype or "float32"))
+    low_b = jnp.reshape(low, low.shape + (1,) * len(shape))
+    high_b = jnp.reshape(high, high.shape + (1,) * len(shape))
+    return low_b + u * (high_b - low_b)
+
+
+@_register_random("_sample_normal", aliases=("sample_normal",))
+def sample_normal(key, mu, sigma, shape=None, dtype=None):
+    shape = parse_tuple(shape) if shape else ()
+    out_shape = mu.shape + shape
+    n = jax.random.normal(key, out_shape, np_dtype(dtype or "float32"))
+    mu_b = jnp.reshape(mu, mu.shape + (1,) * len(shape))
+    s_b = jnp.reshape(sigma, sigma.shape + (1,) * len(shape))
+    return mu_b + n * s_b
+
+
+@_register_random("_sample_gamma", aliases=("sample_gamma",))
+def sample_gamma(key, alpha, beta, shape=None, dtype=None):
+    shape = parse_tuple(shape) if shape else ()
+    out_shape = alpha.shape + shape
+    a_b = jnp.broadcast_to(jnp.reshape(alpha, alpha.shape + (1,) * len(shape)), out_shape)
+    b_b = jnp.broadcast_to(jnp.reshape(beta, beta.shape + (1,) * len(shape)), out_shape)
+    return jax.random.gamma(key, a_b) * b_b
+
+
+@_register_random("_sample_multinomial", aliases=("sample_multinomial",))
+def sample_multinomial(key, data, shape=None, get_prob=False, dtype="int32"):
+    """Reference ``sample_multinomial`` (multisample_op.cc): data is a
+    (batch..., k) probability tensor."""
+    from ..base import parse_bool
+    n = parse_tuple(shape)[0] if shape else 1
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    batch_shape = data.shape[:-1]
+    out = jax.random.categorical(key, logits, axis=-1,
+                                 shape=(n,) + batch_shape)
+    out = jnp.moveaxis(out, 0, -1)
+    if shape is None:
+        out = out[..., 0]
+    out = out.astype(np_dtype(dtype))
+    if parse_bool(get_prob):
+        lp = jnp.take_along_axis(jax.nn.log_softmax(logits, -1),
+                                 out[..., None] if shape is None else out,
+                                 axis=-1)
+        return out, lp.squeeze(-1) if shape is None else lp
+    return out
+
+
+@_register_random("_shuffle", aliases=("shuffle",))
+def shuffle(key, data):
+    """Reference ``_shuffle`` (shuffle_op.cc): permute along first axis."""
+    return jax.random.permutation(key, data, axis=0)
+
+
+@_register_random("_random_bernoulli", aliases=("sample_bernoulli",))
+def random_bernoulli(key, p=0.5, shape=None, dtype=None, ctx=None):
+    shape, dt = _shape_dtype(shape, dtype)
+    return jax.random.bernoulli(key, parse_float(p, 0.5), shape).astype(dt)

@@ -1,0 +1,89 @@
+"""Operator registry — the single op table behind ``mx.nd.*`` and ``mx.sym.*``.
+
+Reference design being rebuilt: MXNet registers ~190 forward ops through
+``NNVM_REGISTER_OP`` with ``FCompute`` kernels (``include/mxnet/op_attr_types.h:207``),
+then code-generates Python functions for both the NDArray and Symbol namespaces
+at import time (``python/mxnet/base.py:579 _init_op_module``,
+``python/mxnet/ndarray/register.py:158``).
+
+TPU-native redesign: an op is a *pure JAX function* ``fn(*arrays, **attrs)``.
+There are no per-device kernels — XLA lowers the single definition for TPU and
+CPU — and no C ABI: the registry itself is the op table from which the ``nd``
+and ``sym`` namespaces are materialized (mirroring ``_init_op_module``).
+Gradients come from ``jax.vjp`` of the same pure function instead of registered
+backward ops (reference ``src/nnvm/gradient.cc:275``).
+"""
+from __future__ import annotations
+
+
+from typing import Callable, Dict, Optional
+
+_OP_TABLE: Dict[str, "OpDef"] = {}
+
+
+class OpDef:
+    """A registered operator.
+
+    Attributes
+    ----------
+    name : canonical op name (MXNet-compatible, e.g. ``FullyConnected``).
+    fn : pure function ``(*jax_arrays, **attrs) -> array | tuple``.
+    aliases : alternative registered names (MXNet registers many, e.g.
+        ``_plus`` / ``elemwise_add``).
+    wrap_list : if True, the op takes a variable-length list of arrays as its
+        leading inputs (e.g. ``concat``, ``add_n``); the generated frontend
+        accepts ``*args``.
+    """
+
+    __slots__ = ("name", "fn", "aliases", "wrap_list", "num_inputs", "doc")
+
+    def __init__(self, name, fn, aliases=(), wrap_list=False, num_inputs=None):
+        self.name = name
+        self.fn = fn
+        self.aliases = tuple(aliases)
+        self.wrap_list = wrap_list
+        self.num_inputs = num_inputs
+        self.doc = fn.__doc__
+
+    def __repr__(self):
+        return f"OpDef({self.name})"
+
+
+def register(name: str, aliases=(), wrap_list: bool = False, num_inputs=None):
+    """Decorator: register a pure JAX function as a framework operator."""
+
+    def deco(fn: Callable):
+        op = OpDef(name, fn, aliases=aliases, wrap_list=wrap_list, num_inputs=num_inputs)
+        _OP_TABLE[name] = op
+        for a in aliases:
+            _OP_TABLE[a] = op
+        return fn
+
+    return deco
+
+
+def get(name: str) -> Optional[OpDef]:
+    return _OP_TABLE.get(name)
+
+
+def require(name: str) -> OpDef:
+    op = _OP_TABLE.get(name)
+    if op is None:
+        raise KeyError(f"operator {name!r} is not registered")
+    return op
+
+
+def list_ops():
+    """Canonical op names (deduplicated), mirroring ``MXListAllOpNames``."""
+    seen, out = set(), []
+    for name, op in _OP_TABLE.items():
+        if id(op) not in seen:
+            seen.add(id(op))
+            out.append(op.name)
+    return out
+
+
+def all_names():
+    return list(_OP_TABLE)
+
+

@@ -1,0 +1,42 @@
+"""Global random state bridging MXNet's seeded-RNG API to JAX keys.
+
+Reference: ``python/mxnet/random.py`` (``mx.random.seed``) backed by
+per-device ``RandomGenerator`` resources (``include/mxnet/random_generator.h``)
+handed to ops via ``ResourceRequest::kRandom`` (``include/mxnet/resource.h:42``).
+
+TPU-native redesign: a process-global ``jax.random`` key, split once per
+stochastic op invocation.  Determinism follows from the seed alone (keys are
+counter-based), which is *stronger* than the reference's per-thread generators
+— re-running a seeded program yields bitwise-identical streams regardless of
+engine scheduling, subsuming ``MXNET_ENFORCE_DETERMINISM``.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_lock = threading.Lock()
+_key = [jax.random.PRNGKey(0)]
+
+
+def seed(seed_state, ctx="all"):
+    """Reset the global key (reference ``mx.random.seed``)."""
+    with _lock:
+        _key[0] = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split one subkey off the global stream (called by the op frontend for
+    each stochastic op invocation)."""
+    with _lock:
+        _key[0], sub = jax.random.split(_key[0])
+        return sub
+
+
+def current_key():
+    return _key[0]
+
+
+# The user-facing sampling functions (mx.random.uniform etc.) are installed by
+# ndarray/register.py from the op table; this module also re-exports them.

@@ -1,0 +1,43 @@
+"""``mx.sym`` namespace: Symbol plus generated op functions.
+
+Reference: ``python/mxnet/symbol/__init__.py`` (generated op namespaces from
+the same registry as ``nd`` — here literally the same table).
+"""
+import sys as _sys
+import types as _types
+
+from .symbol import (  # noqa: F401
+    Group, Symbol, Variable, load, load_json, make_sym_func, var,
+)
+from ..ops import registry as _reg
+
+_CURRENT = _sys.modules[__name__]
+for _name in _reg.all_names():
+    _op = _reg.get(_name)
+    if not hasattr(_CURRENT, _name):
+        setattr(_CURRENT, _name, make_sym_func(_op))
+
+
+def _facade(name, prefixes):
+    mod = _types.ModuleType(f"mxnet_tpu.symbol.{name}")
+    for opname in _reg.all_names():
+        for p in prefixes:
+            if opname.startswith(p):
+                short = opname[len(p):]
+                if short and not hasattr(mod, short):
+                    setattr(mod, short, make_sym_func(_reg.get(opname)))
+    return mod
+
+
+random = _facade("random", ("_random_", "_sample_"))
+linalg = _facade("linalg", ("_linalg_",))
+contrib = _facade("contrib", ("_contrib_",))
+image = _facade("image", ("_image_",))
+
+
+def zeros(shape, dtype=None, **kwargs):
+    return getattr(_CURRENT, "_zeros")(shape=shape, dtype=dtype or "float32")
+
+
+def ones(shape, dtype=None, **kwargs):
+    return getattr(_CURRENT, "_ones")(shape=shape, dtype=dtype or "float32")

@@ -1,0 +1,641 @@
+"""Symbol: the lazy/declarative graph API over the same op table as ``nd``.
+
+Reference being rebuilt: ``python/mxnet/symbol/`` + the NNVM ``Symbol``/
+``Graph`` C++ machinery (``src/nnvm/``, ``src/c_api/c_api_symbolic.cc``) and
+the executor bind family (``src/executor/graph_executor.cc:376 Init``,
+``c_api_executor.cc:555 SimpleBindEx``).
+
+TPU-native redesign: a Symbol is a pure-Python DAG node referencing ops from
+the single op table.  There are no NNVM passes — binding traces the graph into
+one JAX function and ``jax.jit`` replaces the whole pass pipeline:
+gradient generation (``MXGradient``) ≙ ``jax.vjp``; memory planning
+(``MXPlanMemory``) ≙ XLA buffer assignment; shape/type inference ≙
+``jax.eval_shape``; op fusion/bulking ≙ XLA fusion.  ``infer_shape`` and the
+JSON round-trip survive as *API*, computed from the traced graph.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as _np
+
+from ..base import uid
+from ..ops import registry as _reg
+from ..ops.random_ops import STOCHASTIC_OPS
+
+# Ops with auxiliary-state inputs (position -> aux name suffix); mirrors the
+# reference's mutable aux inputs (NDArray aux_states in executor bind).
+AUX_INPUTS = {"BatchNorm": {3: "moving_mean", 4: "moving_var"}}
+
+# Ops whose behavior depends on is_train (OpContext ctx.is_train in reference)
+MODE_DEPENDENT = {"Dropout", "BatchNorm"}
+
+
+class _Node:
+    """One op instantiation in the graph (or a variable if ``op is None``)."""
+
+    __slots__ = ("op", "name", "inputs", "attrs", "num_outputs", "attr_dict")
+
+    def __init__(self, op, name, inputs, attrs, num_outputs=1, attr_dict=None):
+        self.op = op            # OpDef or None for variables
+        self.name = name
+        self.inputs = inputs    # list[(Symbol-producing _Node, out_index)]
+        self.attrs = attrs
+        self.num_outputs = num_outputs
+        self.attr_dict = attr_dict or {}
+
+
+class Symbol:
+    """A set of outputs of a graph node (MXNet Symbols are output lists)."""
+
+    def __init__(self, outputs):
+        self._outputs = outputs  # list[(_Node, int)]
+
+    # ------------------------------------------------------------- structure
+    @property
+    def name(self):
+        node, idx = self._outputs[0]
+        if len(self._outputs) == 1:
+            if node.op is None or node.num_outputs == 1:
+                return node.name
+            return f"{node.name}_output{idx}"
+        return None
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            names = self.list_outputs()
+            idx = names.index(idx)
+        return Symbol([self._outputs[idx]])
+
+    def __iter__(self):
+        for i in range(len(self._outputs)):
+            yield self[i]
+
+    def get_internals(self):
+        """All intermediate outputs (reference ``Symbol.get_internals``)."""
+        outs = []
+        for node in self._topo():
+            if node.op is None:
+                outs.append((node, 0))
+            else:
+                for i in range(node.num_outputs):
+                    outs.append((node, i))
+        return Symbol(outs)
+
+    def get_children(self):
+        node, _ = self._outputs[0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    def _topo(self):
+        seen, order = set(), []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for (p, _i) in node.inputs:
+                visit(p)
+            order.append(node)
+
+        for (n, _i) in self._outputs:
+            visit(n)
+        return order
+
+    # ---------------------------------------------------------------- listing
+    def list_arguments(self):
+        args = []
+        for node in self._topo():
+            if node.op is None and not node.attr_dict.get("__aux__"):
+                args.append(node.name)
+        return args
+
+    def list_outputs(self):
+        names = []
+        for (node, idx) in self._outputs:
+            if node.op is None:
+                names.append(node.name)
+            elif node.num_outputs == 1:
+                names.append(node.name + "_output")
+            else:
+                names.append(f"{node.name}_output{idx}")
+        return names
+
+    def list_auxiliary_states(self):
+        auxs = []
+        for node in self._topo():
+            if node.op is None and node.attr_dict.get("__aux__"):
+                auxs.append(node.name)
+        return auxs
+
+    def list_attr(self):
+        return dict(self._outputs[0][0].attr_dict)
+
+    def attr(self, key):
+        return self._outputs[0][0].attr_dict.get(key)
+
+    def attr_dict(self):
+        out = {}
+        for node in self._topo():
+            d = {k: v for k, v in node.attr_dict.items() if not k.startswith("__")}
+            d.update({k: str(v) for k, v in (node.attrs or {}).items()})
+            if d:
+                out[node.name] = d
+        return out
+
+    def _set_attr(self, **kwargs):
+        self._outputs[0][0].attr_dict.update(kwargs)
+
+    # ------------------------------------------------------------- inference
+    def infer_shape(self, *args, **kwargs):
+        """Shape inference via ``jax.eval_shape`` (replaces the reference's
+        InferShape pass, src/executor/infer_graph_attr_pass.cc)."""
+        import jax
+
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        shapes = {}
+        if args:
+            for n, s in zip(arg_names, args):
+                if s is not None:
+                    shapes[n] = s
+        shapes.update({k: v for k, v in kwargs.items() if v is not None})
+
+        # aux shapes are derivable once args are known: trace with structs
+        known = dict(shapes)
+        # iterate: infer aux from the op attrs is hard generically; require
+        # caller to give data shapes and propagate
+        try:
+            specs = self._make_arg_specs(known)
+        except KeyError as e:
+            return None, None, None
+        fn, all_names = self._build_fn(is_train=False, with_aux_updates=False)
+        out = jax.eval_shape(lambda kv: fn(kv), {n: specs[n] for n in all_names})
+        out_shapes = [tuple(o.shape) for o in out]
+        arg_shapes = [tuple(specs[n].shape) for n in arg_names]
+        aux_shapes = [tuple(specs[n].shape) for n in aux_names]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, *args, **kwargs):
+        try:
+            return self.infer_shape(*args, **kwargs)
+        except Exception:
+            return None, None, None
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        dt = _np.float32
+        return [dt] * len(arg_names), [dt] * len(self._outputs), \
+            [dt] * len(self.list_auxiliary_states())
+
+    def _make_arg_specs(self, shapes, dtypes=None):
+        """Resolve ShapeDtypeStructs for every variable, inferring aux/weight
+        shapes where the op semantics determine them (deferred-init analog)."""
+        import jax
+
+        dtypes = dtypes or {}
+        specs = {}
+        for node in self._topo():
+            if node.op is None:
+                if node.name not in shapes:
+                    raise KeyError(node.name)
+                specs[node.name] = jax.ShapeDtypeStruct(
+                    tuple(shapes[node.name]),
+                    _np.dtype(dtypes.get(node.name, _np.float32)))
+        return specs
+
+    # ------------------------------------------------------------ build/exec
+    def _build_fn(self, is_train, with_aux_updates=True):
+        """Build a pure function ``fn({name: array}) -> [outputs]`` (+ aux
+        updates when requested).  This is the single trace that replaces the
+        reference's GraphExecutor::Init pass pipeline."""
+        import jax
+
+        order = self._topo()
+        var_names = [n.name for n in order if n.op is None]
+
+        def fn(env, rng_key=None):
+            vals = {}  # id(node) -> tuple of outputs
+            aux_updates = {}
+            key = rng_key
+            for node in order:
+                if node.op is None:
+                    vals[id(node)] = (env[node.name],)
+                    continue
+                ins = [vals[id(p)][i] for (p, i) in node.inputs]
+                attrs = dict(node.attrs)
+                if node.op.name in MODE_DEPENDENT:
+                    attrs["__training__"] = is_train
+                if node.op.name in STOCHASTIC_OPS or node.op.name == "Dropout":
+                    if key is None:
+                        import jax.numpy as jnp
+                        k = jax.random.PRNGKey(0)
+                    else:
+                        key, k = jax.random.split(key)
+                    ins = [k] + ins
+                out = node.op.fn(*ins, **attrs)
+                if not isinstance(out, (tuple, list)):
+                    out = (out,)
+                if node.op.name == "BatchNorm" and is_train and with_aux_updates:
+                    from ..base import parse_bool, parse_float
+                    if not parse_bool(node.attrs.get("use_global_stats", False)):
+                        mom = parse_float(node.attrs.get("momentum", 0.9), 0.9)
+                        for pos, suffix in AUX_INPUTS["BatchNorm"].items():
+                            pnode, pidx = node.inputs[pos]
+                            new_stat = out[1] if suffix == "moving_mean" else out[2]
+                            old = vals[id(pnode)][pidx]
+                            aux_updates[pnode.name] = mom * old + (1 - mom) * \
+                                new_stat.astype(old.dtype)
+                vals[id(node)] = tuple(out)
+            outputs = [vals[id(n)][i] for (n, i) in self._outputs]
+            if with_aux_updates:
+                return outputs, aux_updates
+            return outputs
+
+        return fn, var_names
+
+    # ------------------------------------------------------------------ bind
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        """Allocate arrays and bind (reference ``c_api_executor.cc:555``)."""
+        from ..executor import Executor
+        from ..ndarray import zeros
+
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        shapes = dict(kwargs)
+        arg_shapes, _, aux_shapes = self.infer_shape(**shapes)
+        if arg_shapes is None:
+            raise ValueError("cannot infer shapes from the provided inputs; "
+                             f"need shapes for {arg_names}")
+        type_dict = type_dict or {}
+        args = {n: zeros(s, ctx=ctx, dtype=type_dict.get(n, _np.float32))
+                for n, s in zip(arg_names, arg_shapes)}
+        auxs = {n: zeros(s, ctx=ctx) for n, s in zip(aux_names, aux_shapes)}
+        if isinstance(grad_req, str):
+            reqs = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            reqs = dict(zip(arg_names, grad_req))
+        else:
+            reqs = dict(grad_req)
+        grads = {n: zeros(s, ctx=ctx) for n, s in zip(arg_names, arg_shapes)
+                 if reqs.get(n, "write") != "null"}
+        return Executor(self, ctx, args, grads, reqs, auxs)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        """Reference ``Executor::Bind`` (include/mxnet/executor.h)."""
+        from ..executor import Executor
+        from ..ndarray import zeros
+
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        args_grad = args_grad or {}
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(aux_names, aux_states))
+        aux_states = aux_states or {}
+        if isinstance(grad_req, str):
+            reqs = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            reqs = dict(zip(arg_names, grad_req))
+        else:
+            reqs = dict(grad_req)
+        for n in aux_names:
+            if n not in aux_states:
+                shape = None
+                raise ValueError(f"aux state {n} must be provided to bind")
+        return Executor(self, ctx, dict(args), dict(args_grad), reqs,
+                        dict(aux_states))
+
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx=ctx, args=kwargs, grad_req="null")
+        return ex.forward(is_train=False)
+
+    # ------------------------------------------------------------- serialize
+    def tojson(self):
+        """MXNet-compatible graph JSON (reference ``MXSymbolSaveToJSON``,
+        src/c_api/c_api_symbolic.cc:465)."""
+        order = self._topo()
+        node_index = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        arg_nodes = []
+        for i, node in enumerate(order):
+            if node.op is None:
+                arg_nodes.append(i)
+                nodes.append({"op": "null", "name": node.name,
+                              "attrs": {k: str(v) for k, v in node.attr_dict.items()
+                                        if not k.startswith("__")},
+                              "inputs": []})
+            else:
+                nodes.append({
+                    "op": node.op.name,
+                    "name": node.name,
+                    "attrs": {k: str(v) for k, v in node.attrs.items()},
+                    "inputs": [[node_index[id(p)], idx, 0] for (p, idx) in node.inputs],
+                })
+        heads = [[node_index[id(n)], i, 0] for (n, i) in self._outputs]
+        return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
+                           "node_row_ptr": list(range(len(nodes) + 1)),
+                           "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 10500]}}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # ------------------------------------------------------------ arithmetic
+    def __add__(self, other):
+        return _binary_sym("broadcast_add", "_plus_scalar", self, other)
+
+    def __radd__(self, other):
+        return _binary_sym("broadcast_add", "_plus_scalar", self, other)
+
+    def __sub__(self, other):
+        return _binary_sym("broadcast_sub", "_minus_scalar", self, other)
+
+    def __rsub__(self, other):
+        if isinstance(other, Symbol):
+            return other.__sub__(self)
+        return _scalar_sym("_rminus_scalar", self, other)
+
+    def __mul__(self, other):
+        return _binary_sym("broadcast_mul", "_mul_scalar", self, other)
+
+    def __rmul__(self, other):
+        return _binary_sym("broadcast_mul", "_mul_scalar", self, other)
+
+    def __truediv__(self, other):
+        return _binary_sym("broadcast_div", "_div_scalar", self, other)
+
+    def __rtruediv__(self, other):
+        if isinstance(other, Symbol):
+            return other.__truediv__(self)
+        return _scalar_sym("_rdiv_scalar", self, other)
+
+    def __pow__(self, other):
+        return _binary_sym("broadcast_power", "_power_scalar", self, other)
+
+    def __neg__(self):
+        return _scalar_sym("_mul_scalar", self, -1.0)
+
+    def __repr__(self):
+        name = self.name
+        return f"<Symbol {name if name else 'Grouped'}>"
+
+    # method shortcuts mirroring NDArray
+    def reshape(self, shape):
+        return _invoke_sym(_reg.require("reshape"), [self], {"shape": shape})
+
+    def astype(self, dtype):
+        return _invoke_sym(_reg.require("cast"), [self], {"dtype": str(dtype)})
+
+    def sum(self, axis=None, keepdims=False):
+        return _invoke_sym(_reg.require("sum"), [self],
+                           {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return _invoke_sym(_reg.require("mean"), [self],
+                           {"axis": axis, "keepdims": keepdims})
+
+    def transpose(self, axes=None):
+        return _invoke_sym(_reg.require("transpose"), [self], {"axes": axes})
+
+
+# ---------------------------------------------------------------------------
+# Construction helpers
+# ---------------------------------------------------------------------------
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs):
+    """Reference ``mx.sym.Variable``."""
+    ad = dict(attr or {})
+    if shape is not None:
+        ad["__shape__"] = str(tuple(shape))
+    if dtype is not None:
+        ad["__dtype__"] = str(dtype)
+    if lr_mult is not None:
+        ad["lr_mult"] = str(lr_mult)
+    if wd_mult is not None:
+        ad["wd_mult"] = str(wd_mult)
+    if init is not None:
+        ad["__init__"] = init if isinstance(init, str) else init.dumps()
+    node = _Node(None, name, [], {}, 1, ad)
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def load_json(json_str):
+    """Rebuild a Symbol from MXNet graph JSON."""
+    g = json.loads(json_str)
+    nodes = []
+    for spec in g["nodes"]:
+        if spec["op"] == "null":
+            node = _Node(None, spec["name"], [], {}, 1,
+                         dict(spec.get("attrs", {})))
+        else:
+            op = _reg.get(spec["op"])
+            if op is None:
+                raise ValueError(f"unknown op in JSON: {spec['op']}")
+            inputs = [(nodes[i], oi) for (i, oi, _v) in spec["inputs"]]
+            node = _Node(op, spec["name"], inputs,
+                         dict(spec.get("attrs", spec.get("param", {}))), 1)
+            # fix num_outputs for known multi-output ops
+            if op.name == "BatchNorm":
+                node.num_outputs = 3
+            elif op.name in ("split", "SliceChannel"):
+                from ..base import parse_int
+                node.num_outputs = parse_int(node.attrs.get("num_outputs", 1), 1)
+        nodes.append(node)
+    heads = [(nodes[i], oi) for (i, oi, _v) in g["heads"]]
+    return Symbol(heads)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# ---------------------------------------------------------------------------
+# Op function generation for the sym namespace
+# ---------------------------------------------------------------------------
+_NAME_COUNTER = {}
+
+
+def _auto_name(opname):
+    base = opname.lower().lstrip("_")
+    c = _NAME_COUNTER.get(base, 0)
+    _NAME_COUNTER[base] = c + 1
+    return f"{base}{c}"
+
+
+def _num_outputs_of(op, attrs, n_inputs):
+    from ..base import parse_bool, parse_int
+
+    if op.name == "BatchNorm":
+        # The op computes (out, mean, var) but only `out` is composable —
+        # matching the reference's num_visible_outputs=1 for BatchNorm.
+        return 1
+    if op.name in ("split", "SliceChannel"):
+        return parse_int(attrs.get("num_outputs", 1), 1)
+    if op.name == "split_v2":
+        sections = parse_int(attrs.get("sections", 0), 0)
+        if sections:
+            return sections
+        from ..base import parse_tuple
+        return len(parse_tuple(attrs.get("indices", ()))) + 1
+    if op.name in ("_linalg_slogdet", "moments", "_linalg_gelqf", "_linalg_syevd"):
+        return 2
+    if op.name == "RNN":
+        if parse_bool(attrs.get("state_outputs", False)):
+            return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+        return 1
+    if op.name == "topk" and attrs.get("ret_typ") == "both":
+        return 2
+    if op.name == "_contrib_MultiBoxTarget":
+        return 3
+    if op.name == "histogram":
+        return 2
+    return 1
+
+
+def _invoke_sym(op, sym_inputs, attrs, name=None):
+    inputs = []
+    for s in sym_inputs:
+        if not isinstance(s, Symbol):
+            raise TypeError(f"symbol op {op.name} requires Symbol inputs, got {type(s)}")
+        inputs.extend(s._outputs)
+    attrs = {k: v for k, v in attrs.items() if v is not None}
+    nm = name or _auto_name(op.name)
+    node = _Node(op, nm, inputs, attrs,
+                 _num_outputs_of(op, attrs, len(inputs)))
+    return Symbol([(node, i) for i in range(node.num_outputs)]) \
+        if node.num_outputs > 1 else Symbol([(node, 0)])
+
+
+def _scalar_sym(opname, s, scalar):
+    return _invoke_sym(_reg.require(opname), [s], {"scalar": float(scalar)})
+
+
+def _binary_sym(opname, scalar_opname, lhs, rhs):
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return _invoke_sym(_reg.require(opname), [lhs, rhs], {})
+    if isinstance(lhs, Symbol):
+        return _scalar_sym(scalar_opname, lhs, rhs)
+    return _scalar_sym(scalar_opname, rhs, lhs)
+
+
+def make_sym_func(op):
+    from ..ndarray.register import _attr_param_names
+
+    attr_names = _attr_param_names(op, op.name in STOCHASTIC_OPS)
+
+    def fn(*args, name=None, attr=None, **kwargs):
+        sym_inputs = []
+        i = 0
+        while i < len(args) and isinstance(args[i], Symbol):
+            sym_inputs.append(args[i])
+            i += 1
+        attrs = {}
+        for v, pname in zip(args[i:], attr_names):
+            attrs.setdefault(pname, v)
+        # separate Symbol kwargs (named inputs like data=, weight=) from attrs
+        named_inputs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                named_inputs[k] = v
+            else:
+                attrs[k] = v
+        auto = name if name is not None else _auto_name(op.name)
+        if op.name in LAYER_INPUTS:
+            # layer-like op: fixed input list; auto-create missing weight/aux
+            # variables named `<opname>_<slot>` (the reference's ListArguments
+            # + simple_bind deferred allocation behavior)
+            order = LAYER_INPUTS[op.name](attrs)
+            supplied = dict(zip(order, sym_inputs))
+            supplied.update(named_inputs)
+            ins = []
+            for k in order:
+                if k not in supplied:
+                    v = Variable(f"{auto}_{k}")
+                    if k in AUX_INPUTS_BY_NAME.get(op.name, ()):
+                        v._outputs[0][0].attr_dict["__aux__"] = True
+                    supplied[k] = v
+                ins.append(supplied[k])
+            return _invoke_sym(op, ins, attrs, name=auto)
+        if named_inputs:
+            order = _input_order(op, named_inputs)
+            return _invoke_sym(op, sym_inputs + [named_inputs[k] for k in order],
+                               attrs, name=auto)
+        return _invoke_sym(op, sym_inputs, attrs, name=auto)
+
+    fn.__name__ = op.name
+    fn.__doc__ = op.doc
+    return fn
+
+
+# Named-input declarations for layer-like ops (reference: each op's
+# ``ListArguments`` — e.g. FullyConnected lists data/weight/bias).
+def _fc_inputs(attrs):
+    from ..base import parse_bool
+    return ["data", "weight"] if parse_bool(attrs.get("no_bias", False)) \
+        else ["data", "weight", "bias"]
+
+
+def _conv_inputs(attrs):
+    from ..base import parse_bool
+    return ["data", "weight"] if parse_bool(attrs.get("no_bias", False)) \
+        else ["data", "weight", "bias"]
+
+
+def _deconv_inputs(attrs):
+    from ..base import parse_bool
+    return ["data", "weight"] if parse_bool(attrs.get("no_bias", True)) \
+        else ["data", "weight", "bias"]
+
+
+LAYER_INPUTS = {
+    "FullyConnected": _fc_inputs,
+    "Convolution": _conv_inputs,
+    "Deconvolution": _deconv_inputs,
+    "BatchNorm": lambda a: ["data", "gamma", "beta", "moving_mean", "moving_var"],
+    "LayerNorm": lambda a: ["data", "gamma", "beta"],
+    "InstanceNorm": lambda a: ["data", "gamma", "beta"],
+    "Embedding": lambda a: ["data", "weight"],
+    "LeakyReLU": lambda a: (["data", "gamma"] if a.get("act_type") == "prelu"
+                            else ["data"]),
+    "SoftmaxOutput": lambda a: ["data", "label"],
+    "LinearRegressionOutput": lambda a: ["data", "label"],
+    "LogisticRegressionOutput": lambda a: ["data", "label"],
+    "MAERegressionOutput": lambda a: ["data", "label"],
+    "SVMOutput": lambda a: ["data", "label"],
+}
+
+AUX_INPUTS_BY_NAME = {"BatchNorm": {"moving_mean", "moving_var"}}
+
+
+def _input_order(op, named_inputs):
+    if op.name in LAYER_INPUTS:
+        # build a dummy attrs view: caller attrs already merged
+        return LAYER_INPUTS[op.name]({})
+    # generic: alphabetical? use common conventions
+    common = ["data", "lhs", "rhs", "label", "weight", "bias", "index",
+              "indices", "condition", "x", "y", "a", "b"]
+    keys = list(named_inputs.keys())
+    return sorted(keys, key=lambda k: common.index(k) if k in common else 99)

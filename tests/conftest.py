@@ -1,0 +1,35 @@
+"""Test configuration: run the suite on a virtual 8-device CPU mesh.
+
+Mirrors the reference's trick of one op suite re-run per backend
+(tests/python/gpu/test_operator_gpu.py:37-45 does set_default_context +
+re-import): here the suite runs on CPU with 8 virtual devices so that all
+sharding/collective paths compile and execute without TPU hardware; the same
+tests run unmodified on a real TPU chip.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon sitecustomize (TPU tunnel) sets jax_platforms="axon,cpu" via
+# jax.config at interpreter start, which overrides the env var — force CPU
+# through the config API so the suite never tries to claim the TPU chip.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    """Deterministic seeds per test (reference tests/python/unittest/common.py
+    @with_seed)."""
+    import mxnet_tpu as mx
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    yield

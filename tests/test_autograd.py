@@ -1,0 +1,180 @@
+"""Autograd tests (modeled on reference tests/python/unittest/test_autograd.py
+and test_higher_order_grad.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain():
+    x = nd.array(np.random.rand(3, 4).astype("float32"))
+    w = nd.array(np.random.rand(5, 4).astype("float32"))
+    x.attach_grad(); w.attach_grad()
+    with autograd.record():
+        y = nd.FullyConnected(x, w, no_bias=True, num_hidden=5)
+        loss = (y * y).sum()
+    loss.backward()
+    expect_w = 2 * (x.asnumpy().T @ (x.asnumpy() @ w.asnumpy().T)).T
+    assert np.allclose(w.grad.asnumpy(), expect_w, atol=1e-4)
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 20.0]))
+    assert np.allclose(x.grad.asnumpy(), [30.0, 60.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), 4 * x.asnumpy())
+
+
+def test_pause_and_training_flags():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+            z = x * 2  # not recorded
+        y = x * 3
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [3.0])
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_mark_variables():
+    x = nd.array([2.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * x * x
+    y.backward()
+    assert np.allclose(g.asnumpy(), [12.0])
+
+
+def test_multiple_heads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y1 = x * 2
+        y2 = x * 3
+    autograd.backward([y1, y2])
+    assert np.allclose(x.grad.asnumpy(), [5.0, 5.0])
+
+
+def test_grad_function():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    dx = autograd.grad(y, x)
+    assert np.allclose(dx.asnumpy(), [6.0])
+    # x.grad untouched by grad()
+    assert np.allclose(x.grad.asnumpy(), [0.0])
+
+
+def test_higher_order():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sin(x).sum()
+        g1 = autograd.grad(y, x, create_graph=True)
+        z = g1.sum()
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), -np.sin(x.asnumpy()), atol=1e-5)
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            x, = self.saved_tensors
+            return 2 * x * dy
+
+    x = nd.array([1.0, 4.0])
+    x.attach_grad()
+    with autograd.record():
+        y = Square()(x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_detach_blocks_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y.detach() * x
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_stop_gradient_op():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.BlockGrad(x * 3) * x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_softmax_output_loss_grad():
+    x = nd.array(np.random.rand(4, 3).astype("float32"))
+    label = nd.array([0, 1, 2, 1])
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, label)
+    out.backward()
+    sm = np.exp(x.asnumpy()) / np.exp(x.asnumpy()).sum(1, keepdims=True)
+    oh = np.eye(3, dtype="float32")[label.asnumpy().astype(int)]
+    assert np.allclose(x.grad.asnumpy(), sm - oh, atol=1e-5)
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    # predict mode: identity
+    y = nd.Dropout(x, p=0.5)
+    assert np.allclose(y.asnumpy(), x.asnumpy())
+    with autograd.record():
+        z = nd.Dropout(x, p=0.5)
+    frac = (z.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+
+
+def test_batchnorm_moving_stats_update():
+    x = nd.array(np.random.randn(8, 4, 5, 5).astype("float32") * 3 + 1)
+    gamma, beta = nd.ones((4,)), nd.zeros((4,))
+    mm, mv = nd.zeros((4,)), nd.ones((4,))
+    with autograd.record():
+        y = nd.BatchNorm(x, gamma, beta, mm, mv, momentum=0.5, fix_gamma=False)
+    # moving stats were updated toward batch stats
+    assert not np.allclose(mm.asnumpy(), 0)
+    # normalized output in training mode
+    assert abs(y.asnumpy().mean()) < 0.1
+    # inference mode uses moving stats
+    y2 = nd.BatchNorm(x, gamma, beta, nd.zeros((4,)), nd.ones((4,)),
+                      fix_gamma=False, eps=1e-10)
+    assert np.allclose(y2.asnumpy(), x.asnumpy(), atol=1e-3)

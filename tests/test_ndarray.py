@@ -1,0 +1,226 @@
+"""NDArray contract tests (modeled on reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    assert a.size == 4
+    assert a.ndim == 2
+    z = nd.zeros((3, 4))
+    assert z.asnumpy().sum() == 0
+    o = nd.ones((2, 3), dtype="float16")
+    assert o.dtype == np.float16
+    f = nd.full((2, 2), 7)
+    assert (f.asnumpy() == 7).all()
+    r = nd.arange(0, 10, 2)
+    assert (r.asnumpy() == np.arange(0, 10, 2)).all()
+
+
+def test_default_dtype_is_float32():
+    a = nd.array(np.ones((2, 2)))  # float64 numpy input
+    assert a.dtype == np.float32
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    assert np.allclose((a + b).asnumpy(), a.asnumpy() + b.asnumpy())
+    assert np.allclose((a - b).asnumpy(), a.asnumpy() - b.asnumpy())
+    assert np.allclose((a * b).asnumpy(), a.asnumpy() * b.asnumpy())
+    assert np.allclose((a / b).asnumpy(), a.asnumpy() / b.asnumpy())
+    assert np.allclose((a ** 2).asnumpy(), a.asnumpy() ** 2)
+    assert np.allclose((2 + a).asnumpy(), 2 + a.asnumpy())
+    assert np.allclose((2 - a).asnumpy(), 2 - a.asnumpy())
+    assert np.allclose((2 / a).asnumpy(), 2 / a.asnumpy())
+    assert np.allclose((-a).asnumpy(), -a.asnumpy())
+
+
+def test_inplace_ops():
+    a = nd.ones((2, 2))
+    a += 1
+    assert (a.asnumpy() == 2).all()
+    a *= 3
+    assert (a.asnumpy() == 6).all()
+    a -= 1
+    assert (a.asnumpy() == 5).all()
+    a /= 5
+    assert (a.asnumpy() == 1).all()
+
+
+def test_comparison():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    assert ((a > b).asnumpy() == [0, 0, 1]).all()
+    assert ((a >= b).asnumpy() == [0, 1, 1]).all()
+    assert ((a == b).asnumpy() == [0, 1, 0]).all()
+    assert ((a != 2).asnumpy() == [1, 0, 1]).all()
+    # dtype preserved (MXNet semantics: not bool)
+    assert (a > b).dtype == np.float32
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a[1].shape == (3, 4)
+    assert a[0, 1, 2].asscalar() == 6
+    assert a[:, 1].shape == (2, 4)
+    assert a[1, 0:2].shape == (2, 4)
+    a[0] = 0
+    assert a.asnumpy()[0].sum() == 0
+    a[1, 2, 3] = 99
+    assert a[1, 2, 3].asscalar() == 99
+
+
+def test_reshape_transpose():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    assert a.reshape((4, 3)).shape == (4, 3)
+    assert a.reshape((-1,)).shape == (12,)
+    assert a.reshape((2, -1)).shape == (2, 6)
+    assert a.T.shape == (4, 3)
+    assert nd.reshape(a, (0, -1)).shape == (3, 4)
+    assert a.reshape((-4, 1, 3, 0)).shape == (1, 3, 4)
+    b = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert b.transpose((2, 0, 1)).shape == (4, 2, 3)
+    assert b.swapaxes(0, 2).shape == (4, 3, 2)
+    assert b.flatten().shape == (2, 12)
+    assert b.expand_dims(1).shape == (2, 1, 3, 4)
+
+
+def test_reduce():
+    a = nd.array(np.arange(12, dtype="float32").reshape(3, 4))
+    assert a.sum().asscalar() == 66
+    assert np.allclose(a.sum(axis=0).asnumpy(), a.asnumpy().sum(0))
+    assert np.allclose(a.mean(axis=1).asnumpy(), a.asnumpy().mean(1))
+    assert a.max().asscalar() == 11
+    assert a.min().asscalar() == 0
+    assert a.sum(axis=0, keepdims=True).shape == (1, 4)
+    # exclude semantics
+    s = nd.sum(a, axis=0, exclude=True)
+    assert np.allclose(s.asnumpy(), a.asnumpy().sum(1))
+    assert a.argmax(axis=1).dtype == np.float32
+
+
+def test_broadcast():
+    a = nd.ones((1, 4))
+    assert a.broadcast_to((3, 4)).shape == (3, 4)
+    b = nd.ones((3, 1))
+    c = nd.broadcast_add(a, b)
+    assert c.shape == (3, 4)
+    assert (c.asnumpy() == 2).all()
+
+
+def test_concat_stack_split():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(nd.array(np.arange(12).reshape(2, 6)), num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+
+
+def test_astype_copy():
+    a = nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.copy()
+    c[0] = 100
+    assert a[0].asscalar() == 1.5
+    d = nd.zeros((2,))
+    a.copyto(d)
+    assert np.allclose(d.asnumpy(), a.asnumpy())
+
+
+def test_context():
+    a = nd.ones((2, 2), ctx=mx.cpu())
+    assert a.context.device_type == "cpu"
+    b = a.as_in_context(mx.cpu(0))
+    assert b.context == mx.cpu(0)
+
+
+def test_wait_sync():
+    a = nd.ones((100, 100))
+    b = nd.dot(a, a)
+    b.wait_to_read()
+    nd.waitall()
+    assert b.asnumpy()[0, 0] == 100
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays.bin")
+    a, b = nd.ones((2, 2)), nd.arange(0, 4)
+    nd.save(fname, [a, b])
+    loaded = nd.load(fname)
+    assert np.allclose(loaded[0].asnumpy(), a.asnumpy())
+    nd.save(fname, {"x": a, "y": b})
+    d = nd.load(fname)
+    assert set(d) == {"x", "y"}
+    assert np.allclose(d["y"].asnumpy(), b.asnumpy())
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4).astype("float32"))
+    b = nd.array(np.random.rand(4, 5).astype("float32"))
+    c = nd.dot(a, b)
+    assert np.allclose(c.asnumpy(), a.asnumpy() @ b.asnumpy(), atol=1e-5)
+    ct = nd.dot(a, b.T, transpose_b=True)
+    assert np.allclose(ct.asnumpy(), a.asnumpy() @ b.asnumpy(), atol=1e-5)
+    x = nd.array(np.random.rand(2, 3, 4).astype("float32"))
+    y = nd.array(np.random.rand(2, 4, 5).astype("float32"))
+    z = nd.batch_dot(x, y)
+    assert z.shape == (2, 3, 5)
+
+
+def test_scalar_conversions():
+    a = nd.array([3.5])
+    assert float(a) == 3.5
+    assert int(a) == 3
+    assert a.asscalar() == 3.5
+    with pytest.raises(ValueError):
+        nd.ones((2,)).asscalar()
+
+
+def test_take_pick_onehot():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    t = nd.take(a, nd.array([0, 2]), axis=0)
+    assert t.shape == (2, 4)
+    p = nd.pick(a, nd.array([0, 1, 2]), axis=1)
+    assert np.allclose(p.asnumpy(), [0, 5, 10])
+    oh = nd.one_hot(nd.array([0, 2]), 3)
+    assert np.allclose(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_topk_sort():
+    a = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    idx = nd.topk(a, k=2)
+    assert idx.shape == (2, 2)
+    assert np.allclose(idx.asnumpy(), [[0, 2], [1, 2]])
+    v = nd.topk(a, k=1, ret_typ="value")
+    assert np.allclose(v.asnumpy(), [[3], [5]])
+    s = nd.sort(a, axis=1)
+    assert np.allclose(s.asnumpy(), np.sort(a.asnumpy(), 1))
+    ags = nd.argsort(a, axis=1)
+    assert np.allclose(ags.asnumpy(), np.argsort(a.asnumpy(), 1))
+
+
+def test_where_clip():
+    cond = nd.array([1.0, 0.0, 1.0])
+    x = nd.array([1.0, 2.0, 3.0])
+    y = nd.array([-1.0, -2.0, -3.0])
+    w = nd.where(cond, x, y)
+    assert np.allclose(w.asnumpy(), [1, -2, 3])
+    c = nd.clip(nd.array([-2.0, 0.5, 2.0]), 0.0, 1.0)
+    assert np.allclose(c.asnumpy(), [0, 0.5, 1])
+
+
+def test_iteration():
+    a = nd.array(np.arange(6).reshape(3, 2))
+    rows = [r.asnumpy() for r in a]
+    assert len(rows) == 3
+    assert np.allclose(rows[1], [2, 3])
