@@ -16,6 +16,14 @@ from . import ndarray  # noqa: F401
 from . import ndarray as nd  # noqa: F401
 from . import autograd  # noqa: F401
 from . import random  # noqa: F401
+from . import name  # noqa: F401
+from . import initializer  # noqa: F401
+from . import initializer as init  # noqa: F401
+from . import lr_scheduler  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import optimizer as optimizer_  # noqa: F401
+from . import metric  # noqa: F401
+from . import gluon  # noqa: F401
 
 from .ndarray import op_namespaces as _ns
 

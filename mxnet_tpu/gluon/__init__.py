@@ -1,0 +1,11 @@
+"""Gluon: the imperative/hybrid neural-network API (reference
+``python/mxnet/gluon/``)."""
+from . import block  # noqa: F401
+from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from . import parameter  # noqa: F401
+from .parameter import Constant, Parameter, ParameterDict  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import trainer  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,266 @@
+"""Gluon Trainer (reference ``python/mxnet/gluon/trainer.py:27`` — applies an
+Optimizer to a set of Parameters; kvstore setup logic ``trainer.py:169-248``,
+``step:305``, ``allreduce_grads:334``, ``update:366``, state save/load
+``:436,465``).
+
+TPU-native notes: with one logical (possibly mesh-sharded) array per
+parameter, the reference's per-context replica loop collapses; gradient
+reduction across data-parallel devices is the mesh's ``psum`` (KVStore 'tpu'
+type — ``mxnet_tpu/kvstore.py``), entered when a kvstore is requested and
+more than one device participates.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..ndarray import NDArray
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        param_list = []
+        if isinstance(params, (dict,)) or hasattr(params, "items"):
+            for key in sorted(list(params.keys())):
+                param_list.append(params[key])
+            params = param_list
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                f"got {type(params)}.")
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    f"got list of {type(param)}.")
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._contexts = self._check_contexts()
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {
+            "kvstore": kvstore,
+            "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = []
+        self._reset_kvstore()
+
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx() if param._data is not None or param._deferred_init \
+                else [None]
+            assert contexts is None or contexts == ctx, \
+                f"All Parameters must be initialized on the same set of contexts, " \
+                f"but Parameter {param.name} is initialized on {str(ctx)} while " \
+                f"previous Parameters are initialized on {str(contexts)}."
+            contexts = ctx
+        return contexts or []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an Optimizer " \
+                "instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _init_params(self):
+        """Push uninitialized-at-construction params into the kvstore once
+        ready (reference ``trainer.py:129``)."""
+        assert self._kv_initialized, \
+            "Cannot initialize parameters in KVStore when KVStore is not " \
+            "initialized."
+        params_to_init = []
+        if self._kvstore:
+            for param in self._params_to_init:
+                if param._deferred_init:
+                    params_to_init.append(param)
+                else:
+                    param_arrays = param._check_and_get()
+                    idx = self._param2idx[param.name]
+                    self._kvstore.init(idx, param_arrays)
+                    if param._stype == "default" and self._update_on_kvstore:
+                        pass
+        self._params_to_init = params_to_init
+
+    def _reset_kvstore(self):
+        if self._kvstore and "dist" in self._kvstore.type:
+            raise RuntimeError("Cannot reset distributed KVStore.")
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = [param for param in self._params]
+
+    def _init_kvstore(self):
+        """Create the kvstore per config (reference ``trainer.py:169``)."""
+        config = self._kvstore_params
+        arg_arrays = {}
+        update_on_kvstore = config["update_on_kvstore"]
+        kvstore = None
+        if config["kvstore"] is not None and len(self._contexts) > 1:
+            try:
+                from .. import kvstore as kvs
+            except ImportError:
+                kvs = None
+            if kvs is not None:
+                kvstore = kvs.create(config["kvstore"]) \
+                    if isinstance(config["kvstore"], str) else config["kvstore"]
+        if kvstore is None:
+            update_on_kvstore = False
+        else:
+            if self._compression_params:
+                kvstore.set_gradient_compression(self._compression_params)
+            if update_on_kvstore is None:
+                update_on_kvstore = True
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+        self._kvstore = kvstore
+        self._update_on_kvstore = bool(update_on_kvstore)
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning(
+                "Optimizer has to be defined before its learning rate can be "
+                "accessed.")
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning(
+                "Optimizer has to be defined before its learning rate is "
+                "mutated.")
+        self._optimizer.set_learning_rate(lr)
+
+    def _row_sparse_pull(self, parameter, out, row_id, full_idx=False):
+        """Row-sparse pull hook (dense on TPU — a no-op copy)."""
+        if out is not parameter._data:
+            out._data = parameter.data()._data
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """One optimization step: allreduce grads then update (reference
+        ``trainer.py:305``)."""
+        rescale_grad = self._scale / batch_size
+        self._check_and_rescale_grad(rescale_grad)
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def _check_and_rescale_grad(self, scale):
+        if self._update_on_kvstore and self._kvstore and self._kv_initialized:
+            if self._optimizer.rescale_grad != scale:
+                raise UserWarning(
+                    "Possible change in the `batch_size` from previous "
+                    "`step` detected. Optimizer gradient normalizing factor "
+                    "will not change w.r.t new batch_size when "
+                    "update_on_kvstore=True")
+        self._optimizer.rescale_grad = scale
+
+    def allreduce_grads(self):
+        """Reduce gradients over devices — use when splitting step() into
+        stages (reference ``trainer.py:334``)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            "allreduce_grads() when parameters are updated on kvstore " \
+            "is not supported. Try setting `update_on_kvstore` to False " \
+            "when creating trainer."
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore:
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    self._kvstore.push(i, param.list_grad(), priority=-i)
+                    if not self._update_on_kvstore:
+                        self._kvstore.pull(i, param.list_grad(), priority=-i,
+                                           ignore_sparse=False)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Apply the optimizer assuming grads are already reduced (reference
+        ``trainer.py:366``)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            "update() when parameters are updated on kvstore " \
+            "is not supported. Try setting `update_on_kvstore` to False " \
+            "when creating trainer."
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        updates = [[] for _ in self._updaters]
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if not ignore_stale_grad:
+                pass  # version tracking subsumed by tape: grads written by backward
+            if self._kvstore and self._update_on_kvstore:
+                if param._stype == "default":
+                    self._kvstore.pull(i, param.list_data(), priority=-i)
+                continue
+            for upd, arr, grad in zip(updates, param.list_data(),
+                                      param.list_grad()):
+                upd.append((i, grad, arr))
+        if not (self._kvstore and self._update_on_kvstore):
+            for updater, upd in zip(self._updaters, updates):
+                if upd:
+                    i, g, w = zip(*upd)
+                    updater(i, g, w)
+
+    def save_states(self, fname):
+        """Save optimizer/updater states (reference ``trainer.py:436``)."""
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore:
+            assert not self._params_to_init, \
+                "Cannot save trainer states when some parameters are not yet " \
+                "initialized in kvstore."
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        """Load optimizer/updater states (reference ``trainer.py:465``)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            for updater in self._updaters:
+                updater.set_states(states)
+                updater.optimizer = self._updaters[0].optimizer
+            self._optimizer = self._updaters[0].optimizer
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        self._optimizer.param_dict = param_dict

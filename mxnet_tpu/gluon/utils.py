@@ -1,0 +1,145 @@
+"""Gluon utilities (reference ``python/mxnet/gluon/utils.py``: split_data /
+split_and_load / clip_global_norm / download / check_sha1 / _indent).
+
+TPU-native note: ``split_and_load`` keeps its reference semantics (slice a
+batch across contexts) for single-process multi-device data parallelism; the
+mesh-based path (``mxnet_tpu.parallel``) supersedes it for real scale, where
+one sharded array replaces N per-device slices.
+"""
+from __future__ import annotations
+
+import hashlib
+import math
+import os
+
+import numpy as _np
+
+from .. import ndarray
+from ..ndarray import NDArray
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split an NDArray into `num_slice` slices along `batch_axis` (reference
+    ``utils.py:36``)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}. Use a batch size "
+            f"that's multiple of {num_slice} or set even_split=False to allow "
+            "uneven partitioning of data.")
+    if not even_split and size < num_slice:
+        num_slice = size
+    step = size // num_slice
+    if batch_axis == 0:
+        slices = [data[i * step:(i + 1) * step] if i < num_slice - 1 else
+                  data[i * step:size] for i in range(num_slice)]
+    else:
+        slices = [ndarray.slice_axis(data, batch_axis, i * step,
+                                     (i + 1) * step if i < num_slice - 1 else size)
+                  for i in range(num_slice)]
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split and load each slice to one context (reference ``utils.py:84``)."""
+    if not isinstance(data, NDArray):
+        data = ndarray.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so total L2 norm <= max_norm (reference
+    ``utils.py:115``)."""
+
+    def _norm(array):
+        if array.stype == "default":
+            x = array.reshape((-1,))
+            return ndarray.dot(x, x)
+        return array.norm().square()
+
+    assert len(arrays) > 0
+    ctx = arrays[0].context
+    total_norm = ndarray.add_n(*[_norm(arr).as_in_context(ctx) for arr in arrays])
+    total_norm = ndarray.sqrt(total_norm)
+    if check_isfinite:
+        total_norm_val = float(total_norm.asscalar())
+        if not math.isfinite(total_norm_val):
+            import warnings
+            warnings.warn(
+                UserWarning("nan or inf is detected. Clipping results will be "
+                            "undefined."), stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    scale = ndarray.minimum(scale, ndarray.ones(1, ctx=ctx))
+    for arr in arrays:
+        arr *= scale.as_in_context(arr.context)
+    if check_isfinite:
+        return total_norm_val
+    return total_norm
+
+
+def _indent(s_, numSpaces):
+    """Indent string (reference ``utils.py:161``)."""
+    s = s_.split("\n")
+    if len(s) == 1:
+        return s_
+    first = s.pop(0)
+    s = [first] + [(numSpaces * " ") + line for line in s]
+    return "\n".join(s)
+
+
+def check_sha1(filename, sha1_hash):
+    """Check file against expected sha1 (reference ``utils.py:172``)."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Download a file (reference ``utils.py:193``).  This build has no
+    network egress; the function only succeeds when the target already exists
+    locally (pre-seeded caches), otherwise raises."""
+    if path is None:
+        fname = url.split("/")[-1]
+        assert fname, f"Can't construct file-name from this URL. Please set the " \
+                      f"`path` option manually: {url}"
+        path = fname
+    else:
+        path = os.path.expanduser(path)
+        if os.path.isdir(path):
+            path = os.path.join(path, url.split("/")[-1])
+        fname = path
+    if not overwrite and os.path.exists(fname) and \
+            (not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    raise RuntimeError(
+        f"cannot download {url}: this environment has no network egress. "
+        f"Place the file at {fname} manually.")
+
+
+def shape_is_known(shape):
+    """Check whether a shape is completely known (reference
+    ``utils.py:~410``)."""
+    if shape is None:
+        return False
+    for dim_size in shape:
+        if dim_size in (0, None, -1):
+            return False
+    return True
+
+
+def _check_same_symbol_type(symbols):
+    return type(symbols[0])
+
+
+def _check_all_np_ndarrays(out):
+    pass

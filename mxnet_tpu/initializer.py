@@ -1,0 +1,350 @@
+"""Weight initializers.
+
+Reference being rebuilt: ``python/mxnet/initializer.py`` (752 LoC) — an
+``Initializer`` registry keyed by lowercase alias, name-pattern dispatch
+(``_init_weight``/``_init_bias``/... chosen from the parameter-name suffix),
+and an ``InitDesc`` carrying per-parameter attrs.
+
+TPU-native notes: initialization is host-side numpy (tiny, one-time); the
+resulting arrays are device_put by the caller (Parameter).  Determinism comes
+from the process numpy seed like the reference's global RNG.
+"""
+from __future__ import annotations
+
+import json
+import math
+
+import numpy as _np
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    """Register an initializer under its lowercased class name (reference
+    ``initializer.py register`` / ``mx.init.registry``)."""
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def alias(*names):
+    """Extra registry names (reference ``@mx.init.register @alias('zeros')``)."""
+
+    def deco(klass):
+        for n in names:
+            _INIT_REGISTRY[n.lower()] = klass
+        return register(klass)
+
+    return deco
+
+
+class InitDesc(str):
+    """Parameter name + attrs descriptor (reference ``initializer.py:94``)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base class (reference ``initializer.py:104``): callable on
+    ``(InitDesc, NDArray-like)``; dispatches on name patterns."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        self._print_func = print_func
+        return self
+
+    def dumps(self):
+        """JSON [name, kwargs] — the reference's serialization used to ship
+        initializers across the kvstore (``initializer.py:182``)."""
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            raise TypeError("first argument must be a parameter name (InitDesc)")
+        init = getattr(desc, "attrs", {}).get("__init__", "")
+        if init:
+            create(init)._init_weight(desc, arr)
+            return
+        name = str(desc)
+        if name.startswith("upsampling"):
+            self._init_bilinear(name, arr)
+        elif name.startswith("stn_loc") and name.endswith("weight"):
+            self._init_zero(name, arr)
+        elif name.startswith("stn_loc") and name.endswith("bias"):
+            self._init_loc_bias(name, arr)
+        elif name.endswith("bias"):
+            self._init_bias(name, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(name, arr)
+        elif name.endswith("beta"):
+            self._init_beta(name, arr)
+        elif name.endswith("weight"):
+            self._init_weight(name, arr)
+        elif name.endswith("moving_mean") or name.endswith("running_mean"):
+            self._init_zero(name, arr)
+        elif name.endswith("moving_var") or name.endswith("running_var"):
+            self._init_one(name, arr)
+        elif name.endswith("moving_inv_var"):
+            self._init_zero(name, arr)
+        elif name.endswith("moving_avg"):
+            self._init_zero(name, arr)
+        elif name.endswith("min") or name.endswith("max"):
+            self._init_zero(name, arr)
+        else:
+            self._init_default(name, arr)
+
+    # -- helpers writing into arr (NDArray-like with [:] assignment) -------
+    def _set(self, arr, value):
+        arr[:] = value.astype(_np.dtype(arr.dtype)) if hasattr(value, "astype") else value
+
+    def _init_bilinear(self, name, arr):
+        shape = arr.shape
+        weight = _np.zeros(int(_np.prod(shape)), dtype=_np.float32)
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._set(arr, weight.reshape(shape))
+
+    def _init_loc_bias(self, name, arr):
+        assert arr.shape[0] == 6
+        self._set(arr, _np.array([1.0, 0, 0, 0, 1.0, 0], dtype=_np.float32))
+
+    def _init_zero(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_bias(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError("subclass must implement _init_weight")
+
+    def _init_default(self, name, arr):
+        self._init_weight(name, arr)
+
+    def __eq__(self, other):
+        return (self.__class__ is other.__class__ and
+                self._kwargs == other._kwargs)
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self._kwargs})"
+
+
+def create(init, **kwargs):
+    """Initializer factory accepting an instance, name string, or JSON dump
+    (reference ``registry.py`` create path)."""
+    if isinstance(init, Initializer):
+        return init
+    if callable(init):
+        return init
+    if isinstance(init, str):
+        s = init.strip()
+        if s.startswith("["):
+            name, kw = json.loads(s)
+            return _INIT_REGISTRY[name.lower()](**kw)
+        return _INIT_REGISTRY[s.lower()](**kwargs)
+    raise TypeError(f"cannot create initializer from {init!r}")
+
+
+@alias("zeros")
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+
+
+@alias("ones")
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 1.0
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr[:] = self.value
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) (reference ``initializer.py:461``)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        self._set(arr, _np.random.uniform(-self.scale, self.scale,
+                                          arr.shape).astype(_np.float32))
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma) (reference ``initializer.py:487``)."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        self._set(arr, _np.random.normal(0, self.sigma,
+                                         arr.shape).astype(_np.float32))
+
+
+@register
+class Orthogonal(Initializer):
+    """Orthogonal matrix init (reference ``initializer.py:513``)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        self._set(arr, (self.scale * q).reshape(arr.shape).astype(_np.float32))
+
+
+@register
+class Xavier(Initializer):
+    """Glorot init (reference ``initializer.py:552``): factor from fan-in/out,
+    magnitude scaled; uniform or gaussian."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError(f"Xavier requires ndim>=2, got {shape} for {name}")
+        if len(shape) > 2:
+            hw_scale = _np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = 1.0
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = _np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            w = _np.random.uniform(-scale, scale, shape)
+        elif self.rnd_type == "gaussian":
+            w = _np.random.normal(0, scale, shape)
+        else:
+            raise ValueError("Unknown random type")
+        self._set(arr, w.astype(_np.float32))
+
+
+@register
+class MSRAPrelu(Xavier):
+    """Kaiming init (reference ``initializer.py:624``)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        Initializer._init_bilinear(self, name, arr)
+
+
+@register
+class LSTMBias(Initializer):
+    """Zero bias with forget gate set (reference ``initializer.py:660``)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = _np.zeros(arr.shape, dtype=_np.float32)
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        self._set(arr, b)
+
+
+class Load:
+    """Init from a dict of arrays with fallback (reference
+    ``initializer.py:690``)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {}
+        for name, arr in param.items():
+            if name.startswith("arg:") or name.startswith("aux:"):
+                name = name[4:]
+            self.param[name] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            src = self.param[name]
+            src_shape = tuple(src.shape)
+            if tuple(arr.shape) != src_shape:
+                raise ValueError(f"Parameter {name} cannot be initialized from "
+                                 f"loading. Needs shape {tuple(arr.shape)} but "
+                                 f"loaded {src_shape}")
+            arr[:] = src
+        else:
+            if self.default_init is None:
+                raise ValueError(f"Cannot Initialize parameter {name}. Not found "
+                                 "in loaded param and no default initializer")
+            self.default_init(name, arr)
+
+
+class Mixed:
+    """Pattern-matched mix of initializers (reference ``initializer.py:730``)."""
+
+    def __init__(self, patterns, initializers):
+        import re
+        assert len(patterns) == len(initializers)
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(str(name)):
+                init(name, arr)
+                return
+        raise ValueError(f"Parameter name {name} did not match any pattern; "
+                         'add a ".*" pattern for a default initializer')

@@ -1,0 +1,68 @@
+"""Automatic naming (reference ``python/mxnet/name.py``: ``NameManager`` with
+per-hint counters and ``Prefix`` scope)."""
+from __future__ import annotations
+
+import threading
+
+
+class NameManager:
+    """Assigns unique names like ``dense0`` per type hint (reference
+    ``name.py:28``)."""
+
+    _current = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old_manager = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = f"{hint}{self._counter[hint]}"
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        current()  # ensure a root manager exists
+        self._old_manager = NameManager._current.value
+        NameManager._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_manager
+        NameManager._current.value = self._old_manager
+
+
+class Prefix(NameManager):
+    """Prepends a prefix to all names (reference ``name.py:70``)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name
+
+
+def current():
+    """The active NameManager (reference thread-local ``NameManager.current``)."""
+    if not hasattr(NameManager._current, "value"):
+        NameManager._current.value = NameManager()
+    return NameManager._current.value
+
+
+class _Current:
+    """Accessor object so ``NameManager.current.get(...)`` works like the
+    reference classattr."""
+
+    def get(self, name, hint):
+        return current().get(name, hint)
+
+    def __getattr__(self, item):
+        return getattr(current(), item)
+
+
+NameManager.current = _Current()

@@ -60,6 +60,25 @@ def _attr_param_names(op, stochastic):
     return names
 
 
+def _input_param_names(op, stochastic):
+    """Ordered names of required array inputs, so callers may pass them as
+    keywords (MXNet convention: ``nd.LayerNorm(x, gamma=g, beta=b)``)."""
+    try:
+        sig = inspect.signature(op.fn)
+    except (TypeError, ValueError):
+        return []
+    names = []
+    for p in sig.parameters.values():
+        if p.kind in (inspect.Parameter.VAR_POSITIONAL, inspect.Parameter.VAR_KEYWORD):
+            continue
+        if p.default is not inspect.Parameter.empty:
+            continue
+        names.append(p.name)
+    if stochastic and names and names[0] == "key":
+        names = names[1:]
+    return names
+
+
 _ARRAY_TYPES = (NDArray, _np.ndarray)
 
 
@@ -70,6 +89,7 @@ def make_op_func(op):
     writeback = INPLACE_UPDATES.get(name)
     is_bn = name == "BatchNorm"
     attr_names = _attr_param_names(op, stochastic)
+    input_names = _input_param_names(op, stochastic)
 
     def fn(*args, out=None, name=None, ctx=None, **kwargs):
         # split positional args into array inputs and positional attrs
@@ -82,6 +102,14 @@ def make_op_func(op):
                 i += 1
             else:
                 break
+        # named array inputs passed as keywords fill remaining input slots
+        if len(nd_inputs) < len(input_names):
+            for pname in input_names[len(nd_inputs):]:
+                if pname in kwargs and (isinstance(kwargs[pname], _ARRAY_TYPES)
+                                        or hasattr(kwargs[pname], "shape")):
+                    nd_inputs.append(_as_nd(kwargs.pop(pname)))
+                else:
+                    break
         attrs = dict(kwargs)
         for v, pname in zip(args[i:], attr_names):
             attrs.setdefault(pname, v)

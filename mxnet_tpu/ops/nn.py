@@ -177,14 +177,19 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False,
         return ps
 
     pads = _pads()
+    # NOTE: init values must be plain scalars matching the monoid identity so
+    # JAX lowers to the differentiable reduce_window_max/sum primitives (a
+    # traced init falls back to the generic reduce_window with no VJP).
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else int(jnp.iinfo(data.dtype).min)
         padded = jnp.pad(data, pads, constant_values=init)
-        return lax.reduce_window(padded, jnp.asarray(init, data.dtype), lax.max,
+        return lax.reduce_window(padded, init, lax.max,
                                  window, strides, "VALID")
     if pool_type in ("avg", "sum"):
         padded = jnp.pad(data, pads)
-        s = lax.reduce_window(padded, jnp.asarray(0, data.dtype), lax.add,
+        zero = 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0
+        s = lax.reduce_window(padded, zero, lax.add,
                               window, strides, "VALID")
         if pool_type == "sum":
             return s
@@ -194,14 +199,13 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False,
                 denom *= k
             return s / jnp.asarray(denom, data.dtype)
         ones = jnp.pad(jnp.ones_like(data), pads)
-        cnt = lax.reduce_window(ones, jnp.asarray(0, data.dtype), lax.add,
+        cnt = lax.reduce_window(ones, zero, lax.add,
                                 window, strides, "VALID")
         return s / cnt
     if pool_type == "lp":
         p = parse_float(p_value, 2)
         padded = jnp.pad(data, pads)
-        s = lax.reduce_window(jnp.power(jnp.abs(padded), p),
-                              jnp.asarray(0, data.dtype), lax.add,
+        s = lax.reduce_window(jnp.power(jnp.abs(padded), p), 0.0, lax.add,
                               window, strides, "VALID")
         return jnp.power(s, 1.0 / p)
     raise ValueError(f"unknown pool_type {pool_type}")
@@ -298,7 +302,7 @@ def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
     half = n // 2
     padded = jnp.pad(sq, [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2))
     window = (1, n) + (1,) * (data.ndim - 2)
-    ssum = lax.reduce_window(padded, jnp.asarray(0, data.dtype), lax.add,
+    ssum = lax.reduce_window(padded, 0.0, lax.add,
                              window, (1,) * data.ndim, "VALID")
     return data / jnp.power(k_ + alpha_ / n * ssum, beta_)
 

@@ -1,0 +1,5 @@
+"""Optimizer package (reference ``python/mxnet/optimizer/__init__.py``)."""
+from .optimizer import *  # noqa: F401,F403
+from . import optimizer  # noqa: F401
+
+__all__ = optimizer.__all__

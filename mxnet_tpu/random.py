@@ -26,9 +26,38 @@ def seed(seed_state, ctx="all"):
         _key[0] = jax.random.PRNGKey(int(seed_state))
 
 
+_tls = threading.local()
+
+
+class key_scope:
+    """Thread-local override of the key stream: inside the scope, ``next_key``
+    splits from the given (possibly traced) key instead of the process-global
+    one.  This is how jit-traced composite calls (CachedOp — the analog of
+    Gluon ``hybridize()``) thread randomness: the key is a *dynamic argument*
+    of the compiled function, so replays draw fresh masks while staying
+    deterministic under ``mx.random.seed``."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self._key)
+        return self
+
+    def __exit__(self, *a):
+        _tls.stack.pop()
+
+
 def next_key():
-    """Split one subkey off the global stream (called by the op frontend for
+    """Split one subkey off the active stream (called by the op frontend for
     each stochastic op invocation)."""
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        stack[-1], sub = jax.random.split(stack[-1])
+        return sub
     with _lock:
         _key[0], sub = jax.random.split(_key[0])
         return sub

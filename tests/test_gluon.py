@@ -1,0 +1,399 @@
+"""Gluon layer tests, mirroring reference tests/python/unittest/test_gluon.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert len(p.list_data()) == 1
+    assert len(p.list_grad()) == 1
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(RuntimeError):
+        p.data()
+    with pytest.raises(RuntimeError):
+        p.grad()
+
+
+def test_parameter_dict():
+    ctx = mx.current_context()
+    params0 = gluon.ParameterDict("net_")
+    params0.get("w0", shape=(10, 10))
+    params0.get("w1", shape=(10, 10), stype="default")
+    all_row_ids = nd.arange(0, 10)
+    params0.initialize(ctx=ctx)
+    params1 = gluon.ParameterDict("net_")
+    params1.get("w0", shape=(10, 10))
+    params1.get("w1", shape=(10, 10))
+    assert list(params0.keys()) == ["net_w0", "net_w1"]
+
+
+def test_constant():
+    class Test(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.value = np.asarray([[1, 2], [3, 4]])
+            self.const = self.params.get_constant("const", self.value)
+
+        def hybrid_forward(self, F, x, const):
+            return x + const
+
+    test = Test()
+    test.initialize()
+    trainer = gluon.Trainer(test.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "momentum": 0.5},
+                            kvstore=None)
+    with autograd.record():
+        x = nd.ones((2, 2))
+        x.attach_grad()
+        y = test(x)
+        y.backward()
+    trainer.step(1)
+    assert (test.const.data().asnumpy() == test.value).all()
+    assert (x.grad.asnumpy() == 1).all()
+
+
+def test_basic():
+    model = nn.Sequential()
+    model.add(nn.Dense(128, activation="tanh", in_units=10, flatten=False))
+    model.add(nn.Dropout(0.5))
+    model.add(nn.Dense(64, activation="tanh", in_units=256),
+              nn.Dense(32, in_units=64))
+    model.add(nn.Activation("relu"))
+    # symbol-free eager run
+    model.initialize()
+    x = nd.zeros((32, 2, 10))
+    assert model(x).shape == (32, 32)
+    # save/load params
+    assert len(model.collect_params()) == 6
+
+
+def test_dense():
+    model = nn.Dense(128, activation="tanh", in_units=10, flatten=False,
+                     prefix="test_")
+    inputs = mx.nd.zeros((2, 3, 10))
+    assert set(model.collect_params().keys()) == {"test_weight", "test_bias"}
+    model.initialize()
+    outputs = model(inputs)
+    assert outputs.shape == (2, 3, 128)
+
+    model = nn.Dense(128, activation="relu", in_units=30, flatten=True,
+                     prefix="test2_")
+    inputs = mx.nd.zeros((17, 2, 5, 3))
+    model.initialize()
+    outputs = model(inputs)
+    assert outputs.shape == (17, 128)
+
+
+def test_dense_deferred_shape():
+    model = nn.Dense(8)
+    model.initialize()
+    x = nd.ones((4, 3))
+    y = model(x)
+    assert y.shape == (4, 8)
+    assert model.weight.shape == (8, 3)
+
+
+@pytest.mark.parametrize("hybridize", [False, True])
+def test_conv_pool_stack(hybridize):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(4, kernel_size=3, padding=1, activation="relu"),
+                nn.MaxPool2D(2, 2),
+                nn.Conv2D(8, kernel_size=3),
+                nn.AvgPool2D(2, 2),
+                nn.Flatten(),
+                nn.Dense(10))
+    net.initialize()
+    if hybridize:
+        net.hybridize()
+    x = nd.array(np.random.randn(2, 3, 16, 16).astype(np.float32))
+    y = net(x)
+    assert y.shape == (2, 10)
+
+
+def test_conv_groups():
+    net = nn.Conv2D(8, kernel_size=3, groups=2, in_channels=4)
+    net.initialize()
+    x = nd.ones((1, 4, 8, 8))
+    assert net(x).shape == (1, 8, 6, 6)
+    assert net.weight.shape == (8, 2, 3, 3)
+
+
+def test_deconv():
+    net = nn.Conv2DTranspose(4, kernel_size=4, strides=2, padding=1,
+                             in_channels=3)
+    net.initialize()
+    x = nd.ones((2, 3, 8, 8))
+    y = net(x)
+    assert y.shape == (2, 4, 16, 16)
+
+
+def test_pool_shapes():
+    x = nd.ones((2, 3, 8, 8))
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    assert nn.GlobalMaxPool2D()(x).shape == (2, 3, 1, 1)
+    p = nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=True)
+    assert p(x).shape == (2, 3, 4, 4)
+    p = nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=False)
+    assert p(x).shape == (2, 3, 3, 3)
+
+
+def test_batchnorm_train_stats():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = nd.array(np.random.randn(8, 4, 3, 3).astype(np.float32) * 2 + 1)
+    with autograd.record():
+        y = bn(x)
+    mm = bn.running_mean.data().asnumpy()
+    assert np.abs(mm).sum() > 0  # moving mean moved toward batch mean
+    # inference path uses running stats
+    y2 = bn(x)
+    assert y2.shape == x.shape
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(in_channels=10)
+    ln.initialize()
+    x = nd.array(np.random.randn(4, 10).astype(np.float32))
+    y = ln(x).asnumpy()
+    assert np.allclose(y.mean(axis=-1), 0, atol=1e-5)
+    assert np.allclose(y.std(axis=-1), 1, atol=1e-2)
+
+
+def test_embedding():
+    layer = nn.Embedding(10, 100)
+    layer.initialize()
+    x = nd.array([3, 4, 2, 0, 1])
+    with autograd.record():
+        y = layer(x)
+        y.backward()
+    assert (layer.weight.grad().asnumpy()[:5] != 0).sum() > 0
+    assert (layer.weight.grad().asnumpy()[5:] == 0).all()
+
+
+def test_flatten_lambda():
+    fl = nn.Flatten()
+    x = nd.ones((2, 3, 4))
+    assert fl(x).shape == (2, 12)
+    lam = nn.HybridLambda("relu")
+    assert lam(nd.array([-1.0, 1.0])).asnumpy().tolist() == [0.0, 1.0]
+    lam2 = nn.Lambda(lambda x: x * 2)
+    assert lam2(nd.ones((2,))).asnumpy().tolist() == [2.0, 2.0]
+
+
+def test_activations():
+    point_to_validate = nd.array([-0.1, 0.1] * 3)
+
+    swish = nn.Swish()
+    swish.initialize()
+    elu = nn.ELU()
+    elu.initialize()
+    selu = nn.SELU()
+    selu.initialize()
+    prelu = nn.PReLU()
+    prelu.initialize()
+    gelu = nn.GELU()
+    gelu.initialize()
+
+    def swish_test(x):
+        return x * (1.0 / (1.0 + np.exp(-x)))
+
+    for test_point, ref_point in zip(swish_test(point_to_validate.asnumpy()),
+                                     swish(point_to_validate).asnumpy()):
+        assert np.isclose(test_point, ref_point, atol=1e-6)
+
+    def elu_test(x):
+        return [1.0 * (np.exp(y) - 1) if y < 0 else y for y in x]
+
+    for test_point, ref_point in zip(elu_test(point_to_validate.asnumpy()),
+                                     elu(point_to_validate).asnumpy()):
+        assert np.isclose(test_point, ref_point, atol=1e-6)
+
+    out = prelu(point_to_validate).asnumpy()
+    expected = [x if x >= 0 else 0.25 * x for x in point_to_validate.asnumpy()]
+    assert np.allclose(out, expected, atol=1e-6)
+
+
+@pytest.mark.parametrize("hybridize", [False, True])
+def test_lenet_training_decreases_loss(hybridize):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(6, kernel_size=5, activation="relu"),
+                nn.MaxPool2D(2, 2),
+                nn.Flatten(),
+                nn.Dense(32, activation="relu"),
+                nn.Dense(10))
+    net.initialize()
+    if hybridize:
+        net.hybridize()
+    x = nd.array(np.random.randn(8, 1, 16, 16).astype(np.float32))
+    label = nd.array(np.arange(8) % 10)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore=None)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(5):
+        with autograd.record():
+            loss = loss_fn(net(x), label)
+        autograd.backward(loss)
+        trainer.step(8)
+        losses.append(float(loss.mean().asscalar()))
+    assert losses[-1] < losses[0]
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, in_units=8), nn.Dense(4, in_units=16))
+    net.initialize()
+    x = nd.ones((2, 8))
+    y0 = net(x).asnumpy()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(16, in_units=8), nn.Dense(4, in_units=16))
+    net2.load_parameters(f)
+    assert np.allclose(net2(x).asnumpy(), y0, atol=1e-6)
+
+
+def test_hybrid_matches_eager():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8), nn.Dense(4))
+    net.initialize()
+    x = nd.array(np.random.randn(2, 8).astype(np.float32))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_hybrid = net(x).asnumpy()
+    assert np.allclose(y_eager, y_hybrid, atol=1e-5)
+
+
+def test_hybrid_grad_matches_eager():
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="tanh", in_units=8), nn.Dense(4))
+        net.initialize()
+        return net
+
+    np.random.seed(7)
+    x = nd.array(np.random.randn(2, 8).astype(np.float32))
+
+    np.random.seed(42)
+    net_a = build()
+    with autograd.record():
+        loss = net_a(x).sum()
+    autograd.backward(loss)
+    g_a = [p.grad().asnumpy() for p in net_a.collect_params().values()
+           if p.grad_req != "null"]
+
+    np.random.seed(42)
+    net_b = build()
+    net_b.hybridize()
+    with autograd.record():
+        loss = net_b(x).sum()
+    autograd.backward(loss)
+    g_b = [p.grad().asnumpy() for p in net_b.collect_params().values()
+           if p.grad_req != "null"]
+    for a, b in zip(g_a, g_b):
+        assert np.allclose(a, b, atol=1e-5)
+
+
+def test_losses():
+    pred = nd.array(np.random.randn(4, 5).astype(np.float32))
+    label_idx = nd.array(np.array([0, 1, 2, 3]))
+    label_dense = nd.array(np.random.rand(4, 5).astype(np.float32))
+
+    l2 = gluon.loss.L2Loss()(pred, label_dense)
+    ref = 0.5 * ((pred.asnumpy() - label_dense.asnumpy()) ** 2).mean(axis=1)
+    assert np.allclose(l2.asnumpy(), ref, atol=1e-6)
+
+    l1 = gluon.loss.L1Loss()(pred, label_dense)
+    ref = np.abs(pred.asnumpy() - label_dense.asnumpy()).mean(axis=1)
+    assert np.allclose(l1.asnumpy(), ref, atol=1e-6)
+
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label_idx)
+    p = pred.asnumpy()
+    logsm = p - p.max(axis=1, keepdims=True)
+    logsm = logsm - np.log(np.exp(logsm).sum(axis=1, keepdims=True))
+    ref = -logsm[np.arange(4), label_idx.asnumpy().astype(int)]
+    assert np.allclose(sce.asnumpy(), ref, atol=1e-5)
+
+    bce = gluon.loss.SigmoidBCELoss()(pred, label_dense)
+    x = pred.asnumpy()
+    z = label_dense.asnumpy()
+    ref = (np.maximum(x, 0) - x * z + np.log1p(np.exp(-np.abs(x)))).mean(axis=1)
+    assert np.allclose(bce.asnumpy(), ref, atol=1e-5)
+
+    hinge = gluon.loss.HingeLoss()(pred, label_dense)
+    assert hinge.shape == (4,)
+
+    huber = gluon.loss.HuberLoss()(pred, label_dense)
+    assert huber.shape == (4,)
+
+
+def test_sequential_indexing():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8), nn.Dense(4), nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    assert len(list(iter(net))) == 3
+
+
+def test_block_repr_and_collect():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4))
+    params = net.collect_params()
+    names = list(params.keys())
+    assert all(n.startswith("model_") for n in names)
+    assert "weight" in names[0]
+    r = repr(net)
+    assert "Dense" in r
+
+
+def test_trainer_lr_and_states(tmp_path):
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5, "momentum": 0.9},
+                            kvstore=None)
+    assert trainer.learning_rate == 0.5
+    trainer.set_learning_rate(0.25)
+    assert trainer.learning_rate == 0.25
+    x = nd.ones((2, 3))
+    with autograd.record():
+        loss = net(x).sum()
+    autograd.backward(loss)
+    trainer.step(2)
+    f = str(tmp_path / "trainer.states")
+    trainer.save_states(f)
+    trainer.load_states(f)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((3,)) * 2, nd.ones((2,)) * 3]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    norm = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert norm <= 1.0 + 1e-5
+
+
+def test_split_and_load():
+    ctx = [mx.current_context()]
+    data = nd.arange(12).reshape((4, 3))
+    splits = gluon.utils.split_and_load(data, ctx)
+    assert len(splits) == 1
+    assert splits[0].shape == (4, 3)

@@ -1,0 +1,127 @@
+"""Metric tests, mirroring reference tests/python/unittest/test_metric.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric, nd
+
+
+def check_metric(m, *args, **kwargs):
+    m = metric.create(m, *args, **kwargs)
+    m.get_config()
+    str(m)
+
+
+def test_metrics_create():
+    check_metric("acc", axis=0)
+    check_metric("f1")
+    check_metric("mcc")
+    check_metric("perplexity", -1)
+    check_metric("pearsonr")
+    check_metric("nll_loss")
+    check_metric("loss")
+    composite = metric.create(["acc", "f1"])
+    check_metric(composite)
+
+
+def test_accuracy():
+    acc = metric.Accuracy()
+    pred = nd.array([[0.3, 0.7], [0, 1.], [0.4, 0.6]])
+    label = nd.array([0, 1, 1])
+    acc.update([label], [pred])
+    name, value = acc.get()
+    assert name == "accuracy"
+    assert value == pytest.approx(2.0 / 3)
+
+
+def test_top_k_accuracy():
+    acc = metric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.1, 0.5, 0.4], [0.6, 0.3, 0.1], [0.1, 0.1, 0.8]])
+    label = nd.array([2, 1, 2])
+    acc.update([label], [pred])
+    _, value = acc.get()
+    assert value == pytest.approx(3.0 / 3)
+
+
+def test_f1_mcc():
+    pred = nd.array([[0.7, 0.3], [0.2, 0.8], [0.4, 0.6], [0.9, 0.1]])
+    label = nd.array([0, 1, 0, 0])
+    f1 = metric.F1()
+    f1.update([label], [pred])
+    _, v = f1.get()
+    # tp=1 fp=1 fn=0 -> precision 0.5, recall 1 -> f1 = 2/3
+    assert v == pytest.approx(2.0 / 3, abs=1e-6)
+    mcc = metric.MCC()
+    mcc.update([label], [pred])
+    _, v = mcc.get()
+    assert -1.0 <= v <= 1.0
+
+
+def test_mse_mae_rmse():
+    pred = nd.array([[1.0], [2.0]])
+    label = nd.array([[1.5], [1.0]])
+    mse = metric.MSE()
+    mse.update([label], [pred])
+    assert mse.get()[1] == pytest.approx((0.25 + 1.0) / 2)
+    mae = metric.MAE()
+    mae.update([label], [pred])
+    assert mae.get()[1] == pytest.approx((0.5 + 1.0) / 2)
+    rmse = metric.RMSE()
+    rmse.update([label], [pred])
+    assert rmse.get()[1] == pytest.approx(np.sqrt((0.25 + 1.0) / 2))
+
+
+def test_perplexity():
+    pred = nd.array([[0.8, 0.2], [0.2, 0.8], [0.5, 0.5]])
+    label = nd.array([0, 1, 0])
+    ppl = metric.Perplexity(ignore_label=None)
+    ppl.update([label], [pred])
+    _, v = ppl.get()
+    ref = np.exp(-(np.log(0.8) + np.log(0.8) + np.log(0.5)) / 3)
+    assert v == pytest.approx(ref, rel=1e-5)
+
+
+def test_pearson():
+    pred = nd.array([[0.7], [0.3], [0.6]])
+    label = nd.array([[0.8], [0.2], [0.5]])
+    p = metric.PearsonCorrelation()
+    p.update([label], [pred])
+    _, v = p.get()
+    ref = np.corrcoef(pred.asnumpy().ravel(), label.asnumpy().ravel())[0, 1]
+    assert v == pytest.approx(ref)
+
+
+def test_loss_metric():
+    m = metric.Loss()
+    m.update(None, [nd.array([1.0, 2.0, 3.0])])
+    assert m.get()[1] == pytest.approx(2.0)
+
+
+def test_custom_metric():
+    def feval(label, pred):
+        return float(np.abs(label - pred).mean())
+
+    m = metric.CustomMetric(feval)
+    m.update([nd.array([1.0])], [nd.array([2.0])])
+    assert m.get()[1] == pytest.approx(1.0)
+
+
+def test_composite():
+    m = metric.CompositeEvalMetric([metric.Accuracy(), metric.MAE()])
+    pred = nd.array([[0.3, 0.7], [0.6, 0.4]])
+    label = nd.array([1, 0])
+    m.update([label], [pred])
+    names, values = m.get()
+    assert len(names) == 2
+    assert values[0] == pytest.approx(1.0)
+
+
+def test_reset():
+    acc = metric.Accuracy()
+    pred = nd.array([[0.3, 0.7]])
+    label = nd.array([1])
+    acc.update([label], [pred])
+    acc.reset()
+    assert acc.num_inst == 0
+    name, val = acc.get()
+    assert np.isnan(val)

@@ -1,0 +1,149 @@
+"""Optimizer tests, mirroring reference tests/python/unittest/test_optimizer.py
+(numerical update checks vs a numpy reference implementation)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import optimizer as opt
+
+
+def _run_updates(optimizer, w0, g, steps=3):
+    weight = nd.array(w0.copy())
+    state = optimizer.create_state(0, weight)
+    for _ in range(steps):
+        grad = nd.array(g.copy())
+        optimizer.update(0, weight, grad, state)
+    return weight.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    w0 = np.random.rand(4, 3).astype(np.float32)
+    g = np.random.rand(4, 3).astype(np.float32)
+    out = _run_updates(opt.SGD(learning_rate=0.1, momentum=0.9, wd=0.01), w0, g)
+
+    # numpy reference (reference sgd_mom_update semantics)
+    w = w0.copy()
+    mom = np.zeros_like(w)
+    for _ in range(3):
+        gg = g + 0.01 * w
+        mom = 0.9 * mom - 0.1 * gg
+        w = w + mom
+    assert np.allclose(out, w, atol=1e-5)
+
+
+def test_sgd_no_momentum():
+    w0 = np.random.rand(5).astype(np.float32)
+    g = np.random.rand(5).astype(np.float32)
+    out = _run_updates(opt.SGD(learning_rate=0.5), w0, g, steps=1)
+    assert np.allclose(out, w0 - 0.5 * g, atol=1e-6)
+
+
+def test_adam_matches_numpy():
+    w0 = np.random.rand(6).astype(np.float32)
+    g = np.random.rand(6).astype(np.float32)
+    o = opt.Adam(learning_rate=0.01)
+    out = _run_updates(o, w0, g, steps=2)
+
+    w = w0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t in range(1, 3):
+        lr = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w = w - lr * m / (np.sqrt(v) + eps)
+    assert np.allclose(out, w, atol=1e-5)
+
+
+def test_rmsprop():
+    w0 = np.random.rand(6).astype(np.float32)
+    g = np.random.rand(6).astype(np.float32)
+    out = _run_updates(opt.RMSProp(learning_rate=0.01), w0, g, steps=2)
+    assert out.shape == w0.shape
+    assert not np.allclose(out, w0)
+    out_c = _run_updates(opt.RMSProp(learning_rate=0.01, centered=True),
+                         w0, g, steps=2)
+    assert not np.allclose(out_c, w0)
+
+
+@pytest.mark.parametrize("name", ["sgd", "adam", "adagrad", "rmsprop",
+                                  "adadelta", "ftrl", "adamax", "nadam",
+                                  "nag", "signum", "ftml", "sgld", "dcasgd"])
+def test_all_optimizers_update(name):
+    np.random.seed(0)
+    w0 = np.random.rand(4, 3).astype(np.float32)
+    g = (np.random.rand(4, 3).astype(np.float32) - 0.5)
+    o = opt.create(name)
+    out = _run_updates(o, w0, g, steps=2)
+    assert out.shape == w0.shape
+    assert np.isfinite(out).all()
+    assert not np.allclose(out, w0)
+
+
+def test_multi_precision_sgd():
+    w0 = np.random.rand(4).astype(np.float16)
+    g = np.random.rand(4).astype(np.float16)
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    weight = nd.array(w0, dtype=np.float16)
+    state = o.create_state_multi_precision(0, weight)
+    assert state[0].dtype == np.float32  # master weights
+    o.update_multi_precision(0, weight, nd.array(g, dtype=np.float16), state)
+    assert weight.dtype == np.float16
+    assert not np.allclose(weight.asnumpy(), w0)
+
+
+def test_lr_wd_mult():
+    o = opt.SGD(learning_rate=1.0,
+                param_idx2name={0: "w0_weight", 1: "w1_bias"}, wd=0.1)
+    o.set_lr_mult({"w0_weight": 0.5})
+    assert o._get_lr(0) == 0.5
+    assert o._get_lr(1) == 1.0
+    # bias gets wd 0 by default
+    assert o._get_wd(1) == 0.0
+    assert o._get_wd(0) == pytest.approx(0.1)
+
+
+def test_lr_scheduler():
+    from mxnet_tpu import lr_scheduler
+    s = lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(11) == pytest.approx(0.5)
+    m = lr_scheduler.MultiFactorScheduler(step=[5, 10], factor=0.1, base_lr=1.0)
+    assert m(1) == 1.0
+    assert m(6) == pytest.approx(0.1)
+    assert m(11) == pytest.approx(0.01)
+    p = lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=1)
+    assert p(0) == pytest.approx(1.0)
+    assert p(100) == pytest.approx(0.0, abs=1e-6)
+    c = lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0)
+    assert c(0) == pytest.approx(1.0)
+    assert c(100) == pytest.approx(0.0, abs=1e-6)
+    w = lr_scheduler.FactorScheduler(step=100, base_lr=1.0,
+                                     warmup_steps=10, warmup_begin_lr=0.1)
+    assert w(0) == pytest.approx(0.1)
+    assert w(5) == pytest.approx(0.1 + 0.9 * 0.5)
+
+
+def test_scheduler_in_optimizer():
+    from mxnet_tpu import lr_scheduler
+    sched = lr_scheduler.FactorScheduler(step=2, factor=0.5, base_lr=1.0)
+    o = opt.SGD(learning_rate=1.0, lr_scheduler=sched)
+    w = nd.ones((2,))
+    g = nd.ones((2,))
+    for _ in range(6):
+        o.update(0, w, g, None)
+    assert o.learning_rate < 1.0
+
+
+def test_updater_serialization():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    u = opt.get_updater(o)
+    w = nd.ones((3,))
+    g = nd.ones((3,))
+    u(0, g, w)
+    states = u.get_states()
+    u2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    u2.set_states(states)
+    assert 0 in u2.states
