@@ -19,6 +19,7 @@ from collections import OrderedDict
 import numpy as _np
 
 from .. import autograd, initializer
+from .utils import _indent
 from ..context import Context, current_context, cpu
 from ..ndarray import NDArray
 from .. import ndarray as nd
@@ -550,16 +551,6 @@ class ParameterDict:
                 continue
             self[name]._load_init(arg_dict[name], ctx, cast_dtype=cast_dtype,
                                   dtype_source=dtype_source)
-
-
-def _indent(s_, num_spaces):
-    lines = s_.split("\n")
-    if len(lines) == 1:
-        return s_
-    first = lines.pop(0)
-    return first + "\n" + "\n".join(" " * num_spaces + line for line in lines)
-
-
 def _brief_print_list(lst, limit=7):
     lst = list(lst)
     if len(lst) > limit:
