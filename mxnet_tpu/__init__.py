@@ -23,6 +23,8 @@ from . import lr_scheduler  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import optimizer as optimizer_  # noqa: F401
 from . import metric  # noqa: F401
+from . import kvstore  # noqa: F401
+from . import kvstore as kv  # noqa: F401
 from . import gluon  # noqa: F401
 
 from .ndarray import op_namespaces as _ns

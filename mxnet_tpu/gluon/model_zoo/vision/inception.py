@@ -82,20 +82,6 @@ def _make_D(prefix):
     return out
 
 
-class _SplitConcat(HybridBlock):
-    """One input → two parallel convs → channel concat (the 3x3 split in E)."""
-
-    def __init__(self, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
-        self.paths = HybridConcurrent(axis=1, prefix="")
-
-    def add(self, block):
-        self.paths.add(block)
-
-    def hybrid_forward(self, F, x):
-        return self.paths(x)
-
-
 def _make_E(prefix):
     out = HybridConcurrent(axis=1, prefix=prefix)
     with out.name_scope():

@@ -1,0 +1,262 @@
+"""KVStore — key-value parameter synchronization.
+
+Reference being rebuilt: ``python/mxnet/kvstore.py`` (client:
+``init/push/pull/row_sparse_pull`` ``kvstore.py:116-314``, ``set_optimizer:450``)
+over the C++ stores in ``src/kvstore/`` — ``KVStoreLocal`` (group keys, reduce
+via a Comm strategy, run updater, broadcast — ``kvstore_local.h:184-257``),
+``KVStoreNCCL`` (``kvstore_nccl.h:62``) and the ps-lite-based ``KVStoreDist``
+(``kvstore_dist.h``, ``kvstore_dist_server.h``).
+
+TPU-native redesign (SURVEY.md §5.8): there is no parameter-server process and
+no ZMQ.  Within one process, device-to-device reduction is a sum over
+``jax.Array``s (XLA issues the transfers; on TPU hardware these ride ICI — the
+role of the reference's ``CommDevice``/``CommDeviceTree`` P2P machinery, whose
+topology awareness maps to XLA's built-in torus routing).  Across processes
+(``dist_*`` types) the store spans hosts via ``jax.distributed`` process
+groups: rank/num_workers come from the JAX runtime instead of
+``ps::Postoffice`` (``kvstore_dist.h:115-117``), and reduction is a global
+`allreduce <jax.make_array_from_single_device_arrays + psum>` when multiple
+processes exist; with one process it degenerates to the local path so the
+same scripts run anywhere.
+
+The ``Push/Pull`` call surface, default-updater semantics (sum-into-store),
+custom updaters and server-side optimizers (``set_optimizer``) are preserved
+so ``Trainer``/``Module`` call sites run unchanged.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from .ndarray import NDArray
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _group_kv(keys, values):
+    """Normalize (key, value) into (list-of-keys, list-of-value-lists).
+
+    Mirrors ``KVStoreLocal::GroupKVPairs`` (``src/kvstore/kvstore_local.h``):
+    a single key may carry one value or a list of per-device values; a list of
+    keys carries a parallel list of values (each possibly itself a list).
+    """
+    single = not isinstance(keys, (list, tuple))
+    if single:
+        keys = [keys]
+        values = [values]
+    if len(keys) != len(values):
+        # values may be flat with len(values) % len(keys) == 0 (reference
+        # allows e.g. 2 keys x 4 devices as a flat list of 8)
+        if len(values) % len(keys) == 0:
+            per = len(values) // len(keys)
+            values = [values[i * per:(i + 1) * per] for i in range(len(keys))]
+        else:
+            raise ValueError("unmatched keys/values lengths")
+    out = []
+    for v in values:
+        if isinstance(v, NDArray):
+            out.append([v])
+        else:
+            out.append(list(v))
+    return list(keys), out
+
+
+class KVStore:
+    """In-process key-value store with MXNet semantics on the JAX runtime.
+
+    Covers types ``local``, ``device``, ``nccl``, ``tpu`` (aliases for the
+    same single-process implementation — device selection is handled by XLA)
+    and ``dist_sync`` / ``dist_device_sync`` / ``dist_async`` (multi-process
+    via ``jax.distributed``; synchronous in v1 — the reference's async server
+    path ``kvstore_dist_server.h:348`` has no clean collective analog, see
+    SURVEY.md hard-part #5).
+    """
+
+    def __init__(self, type_="local"):
+        self._type = type_
+        self._store = {}        # key -> NDArray (merged copy)
+        self._updater = None
+        self._str_key_check = None
+        self._compression_params = None
+        self._optimizer = None
+
+    # ------------------------------------------------------------------ util
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        """Worker rank (reference ``kvstore.py:591``; ps rank →
+        ``jax.process_index()``)."""
+        if "dist" in self._type:
+            import jax
+            return jax.process_index()
+        return 0
+
+    @property
+    def num_workers(self):
+        if "dist" in self._type:
+            import jax
+            return jax.process_count()
+        return 1
+
+    def _check_keys(self, keys):
+        kt = all(isinstance(k, str) for k in keys)
+        it = all(isinstance(k, (int, np.integer)) for k in keys)
+        if not (kt or it):
+            raise TypeError("keys must be all int or all str")
+        if self._str_key_check is None:
+            self._str_key_check = kt
+        elif self._str_key_check != kt:
+            raise TypeError("mixing int and str keys is not allowed")
+
+    # ------------------------------------------------------------- lifecycle
+    def init(self, key, value):
+        """Initialize key(s) with value(s) (reference ``kvstore.py:116``)."""
+        keys, vals = _group_kv(key, value)
+        self._check_keys(keys)
+        for k, vs in zip(keys, vals):
+            if k in self._store:
+                raise ValueError(f"duplicate init of key {k}")
+            self._store[k] = vs[0].copy()
+
+    def _reduce(self, vs):
+        """Sum per-device values into one array on the first value's device —
+        the ``CommDevice::Reduce`` role (``src/kvstore/comm.h:451``)."""
+        merged = vs[0]
+        if len(vs) > 1:
+            dev = merged.context
+            acc = merged.copy()
+            for v in vs[1:]:
+                acc += v.as_in_context(dev)
+            merged = acc
+        if "dist" in self._type and self.num_workers > 1:
+            merged = self._global_allreduce(merged)
+        return merged
+
+    def _global_allreduce(self, arr):
+        """Cross-process sum over all workers (replaces ps-lite ZPush/ZPull +
+        server aggregation, ``kvstore_dist_server.h:346-358``)."""
+        import jax
+        from jax.experimental import multihost_utils
+        summed = multihost_utils.process_allgather(arr._data)
+        return NDArray(summed.sum(axis=0))
+
+    def push(self, key, value, priority=0):
+        """Reduce value(s) into the stored copy (reference
+        ``kvstore.py:160``): values from multiple devices are summed, then
+        with an updater ``updater(key, merged, stored)`` runs; without one the
+        sum is assigned into the store (``kvstore_local.h`` else-branch does a
+        plain ``CopyFromTo``)."""
+        keys, vals = _group_kv(key, value)
+        self._check_keys(keys)
+        # priority mirrors the engine's comm/compute overlap hint; XLA's async
+        # dispatch already overlaps transfers, so it is accepted and ignored.
+        for k, vs in zip(keys, vals):
+            if k not in self._store:
+                raise ValueError(f"key {k} has not been initialized")
+            merged = self._reduce(vs)
+            stored = self._store[k]
+            if self._updater is not None:
+                self._updater(k, merged, stored)
+                self._store[k] = stored
+            else:
+                newv = merged.as_in_context(stored.context)
+                if newv is vs[0]:
+                    # _reduce returns the caller's array untouched for a
+                    # single value; the store must own its copy (reference
+                    # CopyFromTo), not alias a live gradient buffer.
+                    newv = newv.copy()
+                self._store[k] = newv
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Copy the stored value into out array(s) (reference
+        ``kvstore.py:240``)."""
+        assert out is not None
+        keys, outs = _group_kv(key, out)
+        self._check_keys(keys)
+        for k, os_ in zip(keys, outs):
+            stored = self._store[k]
+            for o in os_:
+                stored.copyto(o)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (MXNet 1.5 ``kvstore.py`` byteps-style surface)."""
+        self.push(key, value, priority=priority)
+        self.pull(key, out if out is not None else value, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Dense fallback: gather requested rows (reference
+        ``kvstore.py:285``; sparse storage is layered on gather/scatter on
+        TPU — SURVEY.md hard-part #4). If ``out`` is sized for the requested
+        rows, only those rows are gathered; a full-size ``out`` (the
+        ``Trainer._row_sparse_pull`` call pattern) receives the whole array."""
+        assert out is not None and row_ids is not None
+        keys, outs = _group_kv(key, out)
+        self._check_keys(keys)
+        if isinstance(row_ids, NDArray):
+            row_ids = [row_ids] * len(keys)
+        for k, os_, rid in zip(keys, outs, row_ids):
+            stored = self._store[k]
+            for o in os_:
+                if o.shape != stored.shape:
+                    stored.take(rid.as_in_context(stored.context)).copyto(o)
+                else:
+                    stored.copyto(o)
+
+    # ------------------------------------------------------------- optimizer
+    def set_updater(self, updater):
+        """Custom updater run at push time (reference ``kvstore.py:420``)."""
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        """Run this optimizer store-side on every push (reference
+        ``kvstore.py:450`` pickles the optimizer to the servers; here the
+        "server" is in-process, but the pickle round-trip is preserved so
+        custom optimizers must be picklable exactly as before)."""
+        if "dist" in self._type:
+            optimizer = pickle.loads(pickle.dumps(optimizer))
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        """2-bit gradient compression existed for PCIe-bound clusters
+        (``src/kvstore/gradient_compression.h``); over ICI it is a pessimum,
+        so the setting is recorded and reduction stays exact (documented
+        deviation, SURVEY.md §2.3)."""
+        self._compression_params = dict(compression_params)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "updater is not initialized"
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer=dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "updater is not initialized"
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        """Global barrier (ps ``Postoffice`` barrier → JAX sync)."""
+        if "dist" in self._type and self.num_workers > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
+
+
+_VALID = ("local", "device", "nccl", "tpu", "local_allreduce_cpu",
+          "local_allreduce_device", "dist_sync", "dist_device_sync",
+          "dist_async", "dist_sync_device", "dist")
+
+
+def create(name="local"):
+    """Factory (reference ``src/kvstore/kvstore.cc:40`` parses the type
+    string)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    base = name.split("://")[0]
+    if base not in _VALID:
+        raise ValueError(f"unknown KVStore type {name!r}")
+    return KVStore(name)

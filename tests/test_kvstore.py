@@ -1,0 +1,137 @@
+"""KVStore contract tests.
+
+Mirrors reference ``tests/python/unittest/test_kvstore.py`` — init/push/pull
+single and list keys, aggregation over per-device values, custom updaters,
+str keys, and the type factory.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def check_diff_to_scalar(arr, x):
+    assert np.allclose(arr.asnumpy(), x), (arr.asnumpy(), x)
+
+
+def test_single_kv_pair():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones(SHAPE))
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 1)
+    kv.push(3, mx.nd.ones(SHAPE) * 4)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 4)
+
+
+def test_list_kv_pair():
+    kv = mx.kv.create("device")
+    kv.init(KEYS, [mx.nd.ones(SHAPE) * k for k in KEYS])
+    val = [mx.nd.empty(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=val)
+    for v, k in zip(val, KEYS):
+        check_diff_to_scalar(v, k)
+
+
+def test_push_copies_value():
+    """The store must not alias the caller's gradient buffer."""
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.zeros(SHAPE))
+    g = mx.nd.ones(SHAPE)
+    kv.push(3, g)
+    g *= 5
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    check_diff_to_scalar(out, 1)
+
+
+def test_row_sparse_pull_gathers_rows():
+    kv = mx.kv.create("local")
+    w = mx.nd.array(np.arange(12, dtype="float32").reshape(4, 3))
+    kv.init("emb", w)
+    out = mx.nd.empty((2, 3))
+    kv.row_sparse_pull("emb", out=out, row_ids=mx.nd.array([1, 3]))
+    assert np.allclose(out.asnumpy(), w.asnumpy()[[1, 3]])
+
+
+def test_aggregator():
+    """Push from multiple 'devices' sums (CommDevice::Reduce semantics)."""
+    kv = mx.kv.create("device")
+    kv.init(3, mx.nd.ones(SHAPE))
+    num_devs = 4
+    vals = [mx.nd.ones(SHAPE) for _ in range(num_devs)]
+    kv.push(3, vals)
+    outs = [mx.nd.empty(SHAPE) for _ in range(num_devs)]
+    kv.pull(3, out=outs)
+    for o in outs:
+        check_diff_to_scalar(o, num_devs)
+
+
+def test_updater():
+    """set_updater runs at push time (reference test_updater)."""
+    def updater(key, recv, local):
+        local += recv
+
+    kv = mx.kv.create("local")
+    kv.set_updater(updater)
+    kv.init(3, mx.nd.ones(SHAPE))
+    kv.push(3, mx.nd.ones(SHAPE) * 4)
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 5)
+    # repeated push accumulates through the updater
+    kv.push(3, [mx.nd.ones(SHAPE)] * 4)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 9)
+
+
+def test_str_keys():
+    kv = mx.kv.create("local")
+    kv.init("w0", mx.nd.ones(SHAPE))
+    val = mx.nd.empty(SHAPE)
+    kv.pull("w0", out=val)
+    check_diff_to_scalar(val, 1)
+    with pytest.raises(TypeError):
+        kv.init(3, mx.nd.ones(SHAPE))
+
+
+def test_get_type_and_factory():
+    for t in ("local", "device", "nccl", "tpu"):
+        assert mx.kv.create(t).type == t
+    with pytest.raises(ValueError):
+        mx.kv.create("nonsense")
+    assert mx.kv.create("local").rank == 0
+    assert mx.kv.create("local").num_workers == 1
+
+
+def test_set_optimizer_states_roundtrip(tmp_path):
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.ones(SHAPE))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv.push(0, mx.nd.ones(SHAPE))
+    fname = str(tmp_path / "states")
+    kv.save_optimizer_states(fname, dump_optimizer=True)
+    kv2 = mx.kv.create("local")
+    kv2.init(0, mx.nd.ones(SHAPE))
+    kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv2.load_optimizer_states(fname)
+    assert 0 in kv2._updater.states
+
+
+def test_trainer_with_kvstore_multidevice():
+    """Trainer over split_and_load replicas reduces grads through the store."""
+    net = mx.gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1}, kvstore="device")
+    x = mx.nd.ones((4, 3))
+    with mx.autograd.record():
+        y = net(x)
+        loss = y.sum()
+    loss.backward()
+    trainer.step(4)
+    assert net.weight.data().shape == (2, 3)
