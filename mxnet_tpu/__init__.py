@@ -26,6 +26,7 @@ from . import metric  # noqa: F401
 from . import kvstore  # noqa: F401
 from . import kvstore as kv  # noqa: F401
 from . import gluon  # noqa: F401
+from . import parallel  # noqa: F401
 
 from .ndarray import op_namespaces as _ns
 

@@ -102,6 +102,10 @@ def parse_int(v, default=None):
 def parse_float(v, default=None):
     if v is None:
         return default
+    if hasattr(v, "dtype"):
+        # traced/device scalar (e.g. a bias-corrected lr inside a jitted
+        # train step) — keep it symbolic, the kernels are jnp-native.
+        return v
     return float(v)
 
 

@@ -1,0 +1,125 @@
+"""Functional optimizers for the SPMD training path.
+
+The eager :mod:`mxnet_tpu.optimizer` layer mutates NDArray weights through
+the update *operators* (``mxnet_tpu/ops/optimizer_ops.py`` — the rebuild of
+``src/operator/optimizer_op.cc``).  The SPMD trainer needs the same math as a
+pure ``(params, grads, state) -> (params', state')`` transform living inside
+one jitted step, so XLA fuses the update into the backward pass — this
+subsumes the reference's hand-written aggregated multi-tensor kernels
+(``optimizer_op.cc`` ``multi_sgd_*``), which existed precisely to amortize
+per-tensor kernel launches that XLA does not have.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import optimizer_ops as K
+
+__all__ = ["FunctionalOptimizer"]
+
+
+class FunctionalOptimizer:
+    """Pure-functional mirror of :class:`mxnet_tpu.optimizer.Optimizer`.
+
+    Parameters mirror the eager optimizer's (learning_rate, momentum, wd,
+    beta1/2, ...); ``from_optimizer`` adapts an eager instance so
+    ``Trainer``-style configs transfer verbatim.
+    """
+
+    def __init__(self, name="sgd", learning_rate=0.01, momentum=0.0, wd=0.0,
+                 beta1=0.9, beta2=0.999, epsilon=1e-8, gamma1=0.95,
+                 rescale_grad=1.0, clip_gradient=-1.0):
+        name = name.lower()
+        if name not in ("sgd", "nag", "adam", "adamw", "rmsprop", "adagrad",
+                        "signum", "signsgd"):
+            raise ValueError(f"no functional form for optimizer {name!r}")
+        self.name = name
+        self.learning_rate = learning_rate
+        self.momentum = momentum
+        self.wd = wd
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon = epsilon
+        self.gamma1 = gamma1
+        self.rescale_grad = rescale_grad
+        self.clip_gradient = clip_gradient
+
+    @classmethod
+    def from_optimizer(cls, optimizer):
+        """Adapt an eager :class:`~mxnet_tpu.optimizer.Optimizer`."""
+        kw = dict(learning_rate=optimizer.learning_rate,
+                  wd=optimizer.wd,
+                  rescale_grad=optimizer.rescale_grad,
+                  clip_gradient=optimizer.clip_gradient
+                  if optimizer.clip_gradient is not None else -1.0)
+        for f in ("momentum", "beta1", "beta2", "epsilon", "gamma1"):
+            if hasattr(optimizer, f):
+                kw[f] = getattr(optimizer, f)
+        name = type(optimizer).__name__.lower()
+        return cls(name, **kw)
+
+    # ------------------------------------------------------------------ state
+    def init_state(self, params):
+        """State pytree matching ``params`` (a dict name → array).
+
+        Momentum/second-moment slots are zeros sharded like their weight
+        (``jnp.zeros_like`` inherits sharding under jit)."""
+        def zeros(p):
+            return jnp.zeros(p.shape, dtype=p.dtype)
+
+        n_slots = {"sgd": 1 if self.momentum else 0, "nag": 1, "signum": 1,
+                   "signsgd": 0, "adagrad": 1, "rmsprop": 1,
+                   "adam": 2, "adamw": 2}[self.name]
+        return {k: tuple(zeros(p) for _ in range(n_slots))
+                for k, p in params.items()}
+
+    # ----------------------------------------------------------------- update
+    def update_one(self, weight, grad, slots, lr):
+        kw = dict(lr=lr, wd=self.wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=self.clip_gradient)
+        if self.name == "sgd":
+            if self.momentum:
+                w, m = K.sgd_mom_update(weight, grad, slots[0],
+                                        momentum=self.momentum, **kw)
+                return w, (m,)
+            return K.sgd_update(weight, grad, **kw), ()
+        if self.name == "nag":
+            w, m = K.nag_mom_update(weight, grad, slots[0],
+                                    momentum=self.momentum, **kw)
+            return w, (m,)
+        if self.name == "signum":
+            w, m = K.signum_update(weight, grad, slots[0],
+                                   momentum=self.momentum, **kw)
+            return w, (m,)
+        if self.name == "signsgd":
+            return K.signsgd_update(weight, grad, **kw), ()
+        if self.name == "adagrad":
+            w, h = K.adagrad_update(weight, grad, slots[0],
+                                    epsilon=self.epsilon, **kw)
+            return w, (h,)
+        if self.name == "rmsprop":
+            w, n = K.rmsprop_update(weight, grad, slots[0],
+                                    gamma1=self.gamma1,
+                                    epsilon=self.epsilon, **kw)
+            return w, (n,)
+        if self.name in ("adam", "adamw"):
+            fn = K.adam_update if self.name == "adam" else K.adamw_update
+            w, m, v = fn(weight, grad, slots[0], slots[1], beta1=self.beta1,
+                         beta2=self.beta2, epsilon=self.epsilon, **kw)
+            return w, (m, v)
+        raise AssertionError(self.name)
+
+    def update(self, params, grads, state, t=None):
+        """Apply one step over the whole param dict.  ``t`` (0-based step) is
+        used for Adam bias correction the way the eager path does it
+        (reference ``optimizer.py:1146`` scales lr by the correction)."""
+        lr = self.learning_rate
+        if self.name in ("adam", "adamw") and t is not None:
+            tt = t + 1
+            lr = lr * jnp.sqrt(1.0 - self.beta2 ** tt) / (1.0 - self.beta1 ** tt)
+        new_params, new_state = {}, {}
+        for k in params:
+            w, s = self.update_one(params[k], grads[k], state[k], lr)
+            new_params[k] = w
+            new_state[k] = s
+        return new_params, new_state

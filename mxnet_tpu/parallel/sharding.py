@@ -1,0 +1,70 @@
+"""Parameter sharding rules.
+
+The reference's only model-parallel mechanism is manual per-layer context
+assignment (``AttrScope(ctx_group=...)`` + ``group2ctx`` →
+``src/executor/graph_executor.cc:984 AssignContext``).  Here sharding is
+declarative: regex rules map parameter names to ``PartitionSpec``s, with a
+Megatron-style default for common layer shapes.  Any assignment is *correct*
+under ``jax.jit`` (XLA inserts the collectives a placement implies); rules
+only steer performance.
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["PartitionRule", "infer_param_specs", "named_sharding"]
+
+
+class PartitionRule:
+    """``(name_regex, spec)`` pair; first matching rule wins."""
+
+    def __init__(self, pattern, spec):
+        self.pattern = re.compile(pattern)
+        self.spec = spec
+
+    def match(self, name):
+        return self.pattern.search(name) is not None
+
+
+def _default_spec(name, shape, mesh, tp_axis):
+    """Heuristic Megatron-ish default: shard the largest weight axis that
+    divides by the tp axis size; replicate small/1-D params (biases, norms)."""
+    from jax.sharding import PartitionSpec as P
+
+    tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get(tp_axis, 1)
+    if tp <= 1 or len(shape) < 2 or min(shape) == 0:
+        return P()
+    # pick the largest axis divisible by tp; prefer the output axis (0 for
+    # MXNet dense (units, in_units) / conv (out_c, in_c, kh, kw) layouts →
+    # column-parallel by default, matching Megatron's first-matmul split.
+    order = sorted(range(len(shape)), key=lambda i: (-shape[i], i))
+    for ax in order:
+        if shape[ax] % tp == 0 and shape[ax] >= tp:
+            spec = [None] * len(shape)
+            spec[ax] = tp_axis
+            return P(*spec)
+    return P()
+
+
+def infer_param_specs(param_shapes, mesh, rules=None, tp_axis="tp"):
+    """Map ``{param_name: shape}`` → ``{param_name: PartitionSpec}``.
+
+    ``rules`` is an ordered list of :class:`PartitionRule`; unmatched names
+    fall back to the heuristic default.
+    """
+    specs = {}
+    for name, shape in param_shapes.items():
+        spec = None
+        for rule in rules or ():
+            if rule.match(name):
+                spec = rule.spec
+                break
+        if spec is None:
+            spec = _default_spec(name, shape, mesh, tp_axis)
+        specs[name] = spec
+    return specs
+
+
+def named_sharding(mesh, spec):
+    from jax.sharding import NamedSharding
+    return NamedSharding(mesh, spec)
