@@ -1,0 +1,67 @@
+"""Headline benchmark: ResNet-50 synthetic training throughput on one chip.
+
+Mirrors the reference's synthetic harnesses
+(``example/image-classification/benchmark_score.py`` and
+``train_imagenet.py --benchmark 1`` — random data, no IO) for the
+BASELINE.json headline metric.  Baseline: 298.51 img/s — ResNet-50 training,
+batch 32, fp32, 1× V100 (``docs/faq/perf.md:239``; see BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMGS_PER_SEC = 298.51  # ResNet-50 train bs32 fp32, 1x V100
+BATCH = 32
+WARMUP = 5
+ITERS = 50
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import SPMDTrainer, FunctionalOptimizer, make_mesh
+
+    # run on the accelerator when present, else host CPU (dev runs)
+    accel = [d for d in jax.devices() if d.platform != "cpu"]
+    ctx = mx.gpu(0) if accel else mx.cpu(0)
+
+    from __graft_entry__ import _resnet
+    net = _resnet(classes=1000, ctx=ctx)
+    mesh = make_mesh(n_devices=1, dp=1)
+    trainer = SPMDTrainer(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          FunctionalOptimizer("sgd", 0.1, momentum=0.9),
+                          mesh)
+
+    rng = np.random.RandomState(0)
+    import jax.numpy as jnp
+    dev = list(mesh.devices.flat)[0]
+    x = jax.device_put(rng.randn(BATCH, 3, 224, 224).astype("float32"), dev)
+    y = jax.device_put(rng.randint(0, 1000, size=(BATCH,)).astype("float32"),
+                       dev)
+
+    for _ in range(WARMUP):
+        trainer.step(x, y)
+    jax.block_until_ready(trainer._state)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        trainer.step(x, y)
+    # block on the whole updated state (weights + optimizer slots), not just
+    # the loss — the loss is ready after the forward pass alone.
+    jax.block_until_ready(trainer._state)
+    dt = time.perf_counter() - t0
+    imgs_per_sec = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_bs32_fp32",
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
