@@ -25,6 +25,8 @@ from . import optimizer as optimizer_  # noqa: F401
 from . import metric  # noqa: F401
 from . import kvstore  # noqa: F401
 from . import kvstore as kv  # noqa: F401
+from . import recordio  # noqa: F401
+from . import io  # noqa: F401
 from . import gluon  # noqa: F401
 from . import parallel  # noqa: F401
 

@@ -1,0 +1,163 @@
+"""DataLoader (reference ``python/mxnet/gluon/data/dataloader.py``).
+
+The reference forks worker processes that pass NDArrays back through POSIX
+shared memory (``CPUSharedStorageManager`` + ForkingPickler rebuild,
+``dataloader.py:55-120``).  TPU-native redesign: workers are *host-only* —
+they produce numpy batches (decode/augment on CPU), and the parent does one
+host→device transfer per batch (the HBM staging path).  Workers are spawned
+(not forked) with ``JAX_PLATFORMS=cpu`` pinned in their environment so a
+child can never touch the TPU runtime the parent owns.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import os
+
+import numpy as np
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference ``dataloader.py:126``)."""
+    if isinstance(data[0], NDArray):
+        return nd.stack(*data) if len(data) > 1 else data[0].reshape(
+            (1,) + data[0].shape)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return nd.array(data)
+
+
+def _np_batchify_fn(data):
+    """Worker-side batchify: pure numpy so nothing device-touching happens in
+    a child process."""
+    if isinstance(data[0], NDArray):
+        data = [d.asnumpy() for d in data]
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return tuple(_np_batchify_fn(i) for i in data)
+    return np.asarray(data)
+
+
+_worker_dataset = None
+_worker_batchify = None
+
+
+def _worker_init(dataset, batchify_fn):
+    global _worker_dataset, _worker_batchify
+    _worker_dataset = dataset
+    _worker_batchify = batchify_fn
+
+
+def _worker_fn(samples):
+    return _worker_batchify([_worker_dataset[i] for i in samples])
+
+
+def _to_ndarray(batch):
+    if isinstance(batch, np.ndarray):
+        return nd.array(batch)
+    if isinstance(batch, (list, tuple)):
+        return [_to_ndarray(b) for b in batch]
+    return batch
+
+
+class DataLoader:
+    """Loads batches from a Dataset (reference ``dataloader.py:159``)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False):
+        self._dataset = dataset
+        self._pin_memory = pin_memory  # accepted; XLA owns staging
+        self._thread_pool = thread_pool
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler "
+                                 "is specified")
+            batch_sampler = BatchSampler(
+                sampler, batch_size, last_batch if last_batch else "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise ValueError("batch_size, shuffle, sampler and last_batch "
+                             "must not be specified if batch_sampler is "
+                             "specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+        if batchify_fn is None:
+            self._batchify_fn = default_batchify_fn
+            self._worker_batchify = _np_batchify_fn
+        else:
+            self._batchify_fn = batchify_fn
+            self._worker_batchify = batchify_fn
+        self._pool = None
+        if self._num_workers > 0:
+            self._pool = self._make_pool()
+
+    def _make_pool(self):
+        if self._thread_pool:
+            from multiprocessing.pool import ThreadPool
+            return ThreadPool(self._num_workers,
+                              initializer=_worker_init,
+                              initargs=(self._dataset, self._worker_batchify))
+        # spawned children must never see the accelerator: pin them to the
+        # CPU platform via env inherited at spawn time
+        old = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            ctx = multiprocessing.get_context("spawn")
+            pool = ctx.Pool(self._num_workers, initializer=_worker_init,
+                            initargs=(self._dataset, self._worker_batchify))
+        finally:
+            if old is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = old
+        return pool
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[i] for i in batch])
+            return
+        # pipelined: keep up to `prefetch` batches in flight
+        results = []
+        it = iter(self._batch_sampler)
+        try:
+            for _ in range(self._prefetch + 1):
+                results.append(self._pool.apply_async(_worker_fn, (next(it),)))
+        except StopIteration:
+            pass
+        while results:
+            out = results.pop(0).get()
+            try:
+                results.append(self._pool.apply_async(_worker_fn, (next(it),)))
+            except StopIteration:
+                pass
+            batch = _to_ndarray(out)
+            if isinstance(batch, list):
+                batch = tuple(batch)
+            yield batch
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()

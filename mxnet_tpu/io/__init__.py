@@ -1,0 +1,14 @@
+"""Data IO: iterators feeding the training loop.
+
+Reference being rebuilt: ``python/mxnet/io/io.py`` (DataDesc/DataBatch/
+DataIter/NDArrayIter/ResizeIter/PrefetchingIter) and the C++ iterator layer
+``src/io/`` (``MXNET_REGISTER_IO_ITER``: ImageRecordIter, MNISTIter, CSVIter
+— SURVEY.md §2.1 "Data IO (native)").  The C++ iterators' OMP decode pipeline
+and dmlc ThreadedIter double-buffering become Python-thread decode pools and
+a threaded prefetcher; batches land as host numpy and transfer to device once
+per batch (the host→HBM staging role of the reference's pinned-memory path).
+"""
+from .io import (  # noqa: F401
+    DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter, PrefetchingIter,
+)
+from .iterators import CSVIter, MNISTIter, ImageRecordIter, LibSVMIter  # noqa: F401

@@ -122,6 +122,61 @@ def random_saturation(key, data, min_factor=0.0, max_factor=0.0):
     return f * data + (1 - f) * gray
 
 
+@_register_random("_image_random_hue", aliases=("image_random_hue",))
+def random_hue(key, data, min_factor=0.0, max_factor=0.0):
+    """Hue rotation in YIQ space (reference image_random-inl.h RandomHue)."""
+    f = jax.random.uniform(key, (), jnp.float32, parse_float(min_factor, 0.0),
+                           parse_float(max_factor, 0.0))
+    alpha = jnp.cos(f * jnp.pi)
+    beta = jnp.sin(f * jnp.pi)
+    tyiq = jnp.asarray([[0.299, 0.587, 0.114],
+                        [0.596, -0.274, -0.321],
+                        [0.211, -0.523, 0.311]], jnp.float32)
+    ityiq = jnp.asarray([[1.0, 0.956, 0.621],
+                         [1.0, -0.272, -0.647],
+                         [1.0, -1.107, 1.705]], jnp.float32)
+    rot = jnp.stack([jnp.asarray([1.0, 0.0, 0.0], jnp.float32),
+                     jnp.stack([jnp.float32(0.0), alpha, -beta]),
+                     jnp.stack([jnp.float32(0.0), beta, alpha])])
+    m = ityiq @ rot @ tyiq
+    return data.astype(jnp.float32) @ m.T
+
+
+@_register_random("_image_random_lighting", aliases=("image_random_lighting",))
+def random_lighting(key, data, alpha_std=0.05):
+    """PCA lighting with gaussian alpha (reference RandomLighting)."""
+    a = jax.random.normal(key, (3,), jnp.float32) * parse_float(alpha_std, 0.05)
+    eigval = jnp.asarray([55.46, 4.794, 1.148], jnp.float32)
+    eigvec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.8140],
+                          [-0.5836, -0.6948, 0.4203]], jnp.float32)
+    delta = jnp.dot(eigvec * a, eigval)
+    return data + delta
+
+
+@_register_random("_image_random_color_jitter",
+                  aliases=("image_random_color_jitter",))
+def random_color_jitter(key, data, brightness=0.0, contrast=0.0,
+                        saturation=0.0, hue=0.0):
+    """Apply brightness/contrast/saturation/hue jitter in sequence
+    (reference RandomColorJitter)."""
+    kb, kc, ks, kh = jax.random.split(key, 4)
+    b = parse_float(brightness, 0.0)
+    c = parse_float(contrast, 0.0)
+    s = parse_float(saturation, 0.0)
+    h = parse_float(hue, 0.0)
+    out = data.astype(jnp.float32)
+    if b > 0:
+        out = random_brightness(kb, out, max(0.0, 1 - b), 1 + b)
+    if c > 0:
+        out = random_contrast(kc, out, max(0.0, 1 - c), 1 + c)
+    if s > 0:
+        out = random_saturation(ks, out, max(0.0, 1 - s), 1 + s)
+    if h > 0:
+        out = random_hue(kh, out, -h, h)
+    return out
+
+
 @register("_image_adjust_lighting", aliases=("image_adjust_lighting",))
 def adjust_lighting(data, alpha=None):
     """AlexNet-style PCA lighting (reference image_random-inl.h)."""

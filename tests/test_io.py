@@ -1,0 +1,261 @@
+"""Data IO tests.
+
+Mirrors reference ``tests/python/unittest/test_io.py`` (NDArrayIter pad/
+discard/roll_over, CSVIter) and ``test_recordio.py`` (framing round-trip,
+indexed access, IRHeader pack/unpack).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+
+# ----------------------------------------------------------------- recordio
+def test_recordio_roundtrip(tmp_path):
+    frec = str(tmp_path / "test.rec")
+    N = 255
+    writer = recordio.MXRecordIO(frec, "w")
+    for i in range(N):
+        writer.write(bytes(str(i), "utf-8"))
+    del writer
+    reader = recordio.MXRecordIO(frec, "r")
+    for i in range(N):
+        res = reader.read()
+        assert res == bytes(str(i), "utf-8")
+    assert reader.read() is None
+
+
+def test_indexed_recordio(tmp_path):
+    fidx = str(tmp_path / "test.idx")
+    frec = str(tmp_path / "test.rec")
+    N = 255
+    writer = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    for i in range(N):
+        writer.write_idx(i, bytes(str(i), "utf-8"))
+    writer.close()
+    reader = recordio.MXIndexedRecordIO(fidx, frec, "r")
+    keys = list(reader.keys)
+    assert sorted(keys) == list(range(N))
+    for i in np.random.permutation(N)[:50]:
+        assert reader.read_idx(int(i)) == bytes(str(i), "utf-8")
+
+
+def test_irheader_pack_unpack():
+    # scalar label
+    h = recordio.IRHeader(0, 3.0, 7, 0)
+    s = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"payload" and h2.label == 3.0 and h2.id == 7
+    # vector label sets flag
+    h = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0]), 9, 0)
+    h2, payload = recordio.unpack(recordio.pack(h, b"x"))
+    assert h2.flag == 3
+    np.testing.assert_allclose(h2.label, [1, 2, 3])
+
+
+def test_pack_img_unpack_img():
+    img = (np.random.rand(32, 24, 3) * 255).astype(np.uint8)
+    s = recordio.pack_img(recordio.IRHeader(0, 5.0, 1, 0), img, quality=100,
+                          img_fmt=".png")
+    header, img2 = recordio.unpack_img(s)
+    assert header.label == 5.0
+    np.testing.assert_array_equal(img, img2)
+
+
+# ---------------------------------------------------------------- NDArrayIter
+def test_ndarrayiter():
+    data = np.ones([1000, 2, 2])
+    labels = np.ones([1000, 1])
+    for i in range(1000):
+        data[i] = i / 100
+        labels[i] = i / 100
+    it = mx.io.NDArrayIter(data, labels, 128, True,
+                           last_batch_handle="pad")
+    batch_count = 0
+    labels_copy = []
+    for batch in it:
+        labels_copy.append(batch.label[0].asnumpy())
+        batch_count += 1
+    assert batch_count == 8
+    # shuffled but complete (pad wraps)
+    all_labels = np.concatenate(labels_copy).ravel()[:1000]
+    assert len(all_labels) == 1000
+
+
+def test_ndarrayiter_discard():
+    data = np.arange(100).reshape(100, 1)
+    it = mx.io.NDArrayIter(data, batch_size=30, shuffle=False,
+                           last_batch_handle="discard")
+    batches = list(it)
+    assert len(batches) == 3
+    np.testing.assert_array_equal(batches[2].data[0].asnumpy().ravel(),
+                                  np.arange(60, 90))
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_ndarrayiter_pad():
+    data = np.arange(10).reshape(10, 1)
+    it = mx.io.NDArrayIter(data, batch_size=4, shuffle=False,
+                           last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[2].pad == 2
+    np.testing.assert_array_equal(batches[2].data[0].asnumpy().ravel(),
+                                  [8, 9, 0, 1])
+
+
+def test_ndarrayiter_dict_and_provide():
+    data = {"a": np.zeros((10, 2)), "b": np.zeros((10, 3))}
+    it = mx.io.NDArrayIter(data, np.zeros(10), batch_size=5)
+    descs = it.provide_data
+    assert sorted(d.name for d in descs) == ["a", "b"]
+    assert it.provide_label[0].shape == (5,)
+
+
+# -------------------------------------------------------------------- CSVIter
+def test_csviter(tmp_path):
+    data_path = str(tmp_path / "data.csv")
+    label_path = str(tmp_path / "label.csv")
+    arr = np.random.rand(30, 4)
+    lab = np.arange(30)
+    np.savetxt(data_path, arr, delimiter=",")
+    np.savetxt(label_path, lab, delimiter=",")
+    it = mx.io.CSVIter(data_csv=data_path, data_shape=(4,),
+                       label_csv=label_path, label_shape=(1,), batch_size=10)
+    batches = list(it)
+    assert len(batches) == 3
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), arr[:10],
+                               rtol=1e-5)
+    # string-typed shape like reference scripts pass
+    it2 = mx.io.CSVIter(data_csv=data_path, data_shape="(4,)", batch_size=10)
+    assert next(iter(it2)).data[0].shape == (10, 4)
+
+
+# ------------------------------------------------------------ ImageRecordIter
+def _write_img_rec(tmp_path, n=24, hw=(40, 36)):
+    import cv2  # noqa: F401
+    fidx = str(tmp_path / "img.idx")
+    frec = str(tmp_path / "img.rec")
+    w = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = (rng.rand(hw[0], hw[1], 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 10), i, 0), img, img_fmt=".png"))
+    w.close()
+    return frec, fidx
+
+
+def test_image_record_iter(tmp_path):
+    frec, fidx = _write_img_rec(tmp_path)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=frec, path_imgidx=fidx, data_shape=(3, 32, 32),
+        batch_size=8, shuffle=True, rand_mirror=True, rand_crop=True,
+        mean_r=123.0, mean_g=117.0, mean_b=104.0, preprocess_threads=2)
+    count = 0
+    labels = []
+    for batch in it:
+        assert batch.data[0].shape == (8, 3, 32, 32)
+        labels.extend(batch.label[0].asnumpy().tolist())
+        count += 1
+    assert count == 3
+    assert sorted(set(int(l) for l in labels)) == list(range(10))
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_image_record_dataset(tmp_path):
+    frec, _ = _write_img_rec(tmp_path, n=6)
+    ds = mx.gluon.data.vision.ImageRecordDataset(frec)
+    assert len(ds) == 6
+    img, label = ds[3]
+    assert img.shape == (40, 36, 3)
+    assert label == 3.0
+
+
+# ------------------------------------------------------------- gluon.data
+def test_array_dataset_and_loader():
+    X = np.random.uniform(size=(16, 3))
+    y = np.arange(16, dtype="float32")
+    ds = mx.gluon.data.ArrayDataset(X, y)
+    assert len(ds) == 16
+    loader = mx.gluon.data.DataLoader(ds, batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 4
+    np.testing.assert_allclose(batches[1][0].asnumpy(), X[4:8], rtol=1e-5)
+    np.testing.assert_allclose(batches[1][1].asnumpy(), y[4:8])
+
+
+def test_dataloader_last_batch():
+    X = np.random.uniform(size=(10, 2))
+    ds = mx.gluon.data.ArrayDataset(X)
+    assert len(list(mx.gluon.data.DataLoader(ds, 4, last_batch="keep"))) == 3
+    assert len(list(mx.gluon.data.DataLoader(ds, 4, last_batch="discard"))) == 2
+    loader = mx.gluon.data.DataLoader(ds, 4, last_batch="rollover")
+    assert len(list(loader)) == 2
+    assert len(list(loader)) == 3  # rolled-over remainder joins next epoch
+
+
+def test_dataset_transform_and_filter():
+    ds = mx.gluon.data.SimpleDataset(list(range(10)))
+    doubled = ds.transform(lambda x: 2 * x)
+    assert doubled[3] == 6
+    evens = ds.filter(lambda x: x % 2 == 0)
+    assert len(evens) == 5
+    taken = ds.take(3)
+    assert len(taken) == 3
+
+
+def test_samplers():
+    s = mx.gluon.data.SequentialSampler(5)
+    assert list(s) == [0, 1, 2, 3, 4]
+    r = mx.gluon.data.RandomSampler(100)
+    assert sorted(list(r)) == list(range(100))
+    b = mx.gluon.data.BatchSampler(s, 2, "keep")
+    assert list(b) == [[0, 1], [2, 3], [4]]
+    assert len(b) == 3
+
+
+def test_transforms_pipeline():
+    from mxnet_tpu.gluon.data.vision import transforms
+    img = mx.nd.array((np.random.rand(36, 36, 3) * 255).astype("uint8"),
+                      dtype="uint8")
+    fn = transforms.Compose([
+        transforms.Resize(32),
+        transforms.CenterCrop(28),
+        transforms.ToTensor(),
+        transforms.Normalize(mean=(0.5, 0.5, 0.5), std=(0.2, 0.2, 0.2)),
+    ])
+    out = fn(img)
+    assert out.shape == (3, 28, 28)
+    assert out.dtype == np.float32
+
+
+def test_transforms_random():
+    from mxnet_tpu.gluon.data.vision import transforms
+    img = mx.nd.array((np.random.rand(32, 32, 3) * 255).astype("float32"))
+    for t in (transforms.RandomFlipLeftRight(),
+              transforms.RandomBrightness(0.3),
+              transforms.RandomContrast(0.3),
+              transforms.RandomSaturation(0.3),
+              transforms.RandomColorJitter(0.1, 0.1, 0.1, 0.1),
+              transforms.RandomLighting(0.1),
+              transforms.RandomResizedCrop(16)):
+        out = t(img)
+        assert np.isfinite(out.asnumpy()).all(), type(t).__name__
+
+
+def test_dataloader_multiworker():
+    X = np.random.uniform(size=(32, 3)).astype("float32")
+    y = np.arange(32, dtype="float32")
+    ds = mx.gluon.data.ArrayDataset(X, y)
+    loader = mx.gluon.data.DataLoader(ds, batch_size=8, num_workers=2,
+                                      thread_pool=True)
+    batches = list(loader)
+    assert len(batches) == 4
+    got = np.concatenate([b[1].asnumpy() for b in batches])
+    np.testing.assert_allclose(np.sort(got), y)
