@@ -107,12 +107,17 @@ class Executor:
     def forward(self, is_train=False, **kwargs):
         """Reference ``GraphExecutor::Forward`` (graph_executor.cc:66)."""
         for k, v in kwargs.items():
-            if isinstance(v, NDArray):
-                self.arg_dict[k]._data = v._data.astype(self.arg_dict[k].dtype) \
-                    if v.dtype != self.arg_dict[k].dtype else v._data
-            else:
+            if not isinstance(v, NDArray):
                 from .ndarray import array
-                self.arg_dict[k]._data = array(v)._data
+                v = array(v)
+            dat = v._data.astype(self.arg_dict[k].dtype) \
+                if v.dtype != self.arg_dict[k].dtype else v._data
+            # stage the batch onto the executor's device (host→HBM transfer;
+            # the reference's _load_data scatter, executor_group.py:437)
+            buf_dev = list(self.arg_dict[k]._data.devices())[0]
+            if list(dat.devices())[0] != buf_dev:
+                dat = jax.device_put(dat, buf_dev)
+            self.arg_dict[k]._data = dat
         run = self._compiled_fwd(is_train)
         outs, aux_updates = run(self._env(), _rnd.next_key())
         if is_train:

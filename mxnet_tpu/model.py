@@ -1,0 +1,44 @@
+"""Checkpoint helpers + BatchEndParam (reference ``python/mxnet/model.py``).
+
+The artifact format is the reference's dual-file contract (SURVEY.md §5.4):
+``prefix-symbol.json`` (graph JSON, ``MXSymbolSaveToJSON``) +
+``prefix-####.params`` (NDArray map with ``arg:``/``aux:`` prefixes,
+``MXNDArraySave``) — files written here load in stock MXNet and vice versa.
+"""
+from __future__ import annotations
+
+import logging
+from collections import namedtuple
+
+from . import ndarray as nd
+from . import symbol as sym_mod
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Reference ``model.py:394``."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def load_checkpoint(prefix, epoch):
+    """Reference ``model.py:426`` → (symbol, arg_params, aux_params)."""
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        if tp == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params

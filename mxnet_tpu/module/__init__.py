@@ -1,0 +1,13 @@
+"""Module API — the legacy symbolic training interface (reference
+``python/mxnet/module/``).
+
+TPU-native note: the reference's ``DataParallelExecutorGroup`` slices each
+batch across GPU executors (``executor_group.py:282-304``) and reduces
+gradients via KVStore; here one jit-compiled Executor runs the whole batch
+and multi-device data parallelism is the SPMD mesh's job
+(``mxnet_tpu.parallel``) — the Module surface (bind/fit/forward/backward/
+update) is preserved verbatim so reference training scripts run unchanged.
+"""
+from .base_module import BaseModule  # noqa: F401
+from .module import Module  # noqa: F401
+from .bucketing_module import BucketingModule  # noqa: F401

@@ -1,0 +1,380 @@
+"""Module — symbolic training over one jit-compiled executor (reference
+``python/mxnet/module/module.py:40``)."""
+from __future__ import annotations
+
+import logging
+import warnings
+
+from .. import context as ctx_mod
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..initializer import InitDesc
+from ..io import DataDesc
+from .base_module import BaseModule, _parse_data_desc
+
+
+class Module(BaseModule):
+    """Wraps a Symbol + one Executor (reference ``module.py:40``; the
+    per-device ``DataParallelExecutorGroup`` collapses into a single XLA
+    computation — SURVEY.md §2.3 row "Data parallelism")."""
+
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = [ctx_mod.current_context()]
+        if isinstance(context, ctx_mod.Context):
+            context = [context]
+        self._context = context
+        if work_load_list is not None and len(context) > 1:
+            warnings.warn("work_load_list ignored: one SPMD executor runs the "
+                          "whole batch")
+        self._symbol = symbol
+        data_names = list(data_names) if data_names is not None else []
+        label_names = list(label_names) if label_names is not None else []
+        state_names = list(state_names) if state_names is not None else []
+        fixed_param_names = list(fixed_param_names) \
+            if fixed_param_names is not None else []
+        _check_input_names(symbol, data_names, "data", True)
+        _check_input_names(symbol, label_names, "label", False)
+        _check_input_names(symbol, state_names, "state", True)
+        _check_input_names(symbol, fixed_param_names, "fixed_param", True)
+        arg_names = symbol.list_arguments()
+        input_names = data_names + label_names + state_names
+        self._param_names = [x for x in arg_names if x not in input_names]
+        self._fixed_param_names = fixed_param_names
+        self._aux_names = symbol.list_auxiliary_states()
+        self._data_names = data_names
+        self._label_names = label_names
+        self._state_names = state_names
+        self._output_names = symbol.list_outputs()
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+        self._compression_params = compression_params
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._grad_req = None
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Create from a saved checkpoint (reference ``module.py:119``)."""
+        from ..model import load_checkpoint
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        remove_amp_cast=True):
+        """Save symbol + params (+ optimizer states) (reference
+        ``module.py:147``)."""
+        self._symbol.save("%s-symbol.json" % prefix)
+        param_name = "%s-%04d.params" % (prefix, epoch)
+        self.save_params(param_name)
+        logging.info("Saved checkpoint to \"%s\"", param_name)
+        if save_optimizer_states:
+            state_name = "%s-%04d.states" % (prefix, epoch)
+            self.save_optimizer_states(state_name)
+            logging.info("Saved optimizer state to \"%s\"", state_name)
+
+    # ------------------------------------------------------------ properties
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        shapes = {d.name: d.shape for d in self._data_shapes}
+        if self._label_shapes:
+            shapes.update({l.name: l.shape for l in self._label_shapes})
+        _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+        return list(zip(self._output_names, out_shapes))
+
+    # ------------------------------------------------------------------ bind
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """Allocate the executor (reference ``module.py:364`` →
+        ``simple_bind``)."""
+        if force_rebind:
+            self._reset_bind()
+        if self.binded:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        assert not (not for_training and inputs_need_grad)
+
+        self._data_shapes, self._label_shapes = _parse_data_desc(
+            self.data_names, self.label_names, data_shapes, label_shapes)
+
+        shapes = {d.name: d.shape for d in self._data_shapes}
+        if self._label_shapes:
+            shapes.update({l.name: l.shape for l in self._label_shapes})
+        if isinstance(grad_req, str):
+            reqs = {}
+            for name in self._symbol.list_arguments():
+                if name in self._data_names:
+                    reqs[name] = "write" if inputs_need_grad else "null"
+                elif name in self._label_names or name in self._state_names:
+                    reqs[name] = "null"
+                elif name in self._fixed_param_names:
+                    reqs[name] = "null"
+                else:
+                    reqs[name] = grad_req if for_training else "null"
+        else:
+            reqs = grad_req
+        self._grad_req = reqs
+        self._exec = self._symbol.simple_bind(
+            ctx=self._context[0], grad_req=reqs, **shapes)
+        self.binded = True
+        if shared_module is not None and shared_module.params_initialized:
+            self.set_params(*shared_module.get_params())
+        elif self.params_initialized:
+            # bound after load: push loaded params into the executor
+            self._exec.copy_params_from(self._arg_params, self._aux_params)
+
+    def _reset_bind(self):
+        self.binded = False
+        self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
+
+    # ------------------------------------------------------------ parameters
+    def init_params(self, initializer="default", arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        """Initialize parameters (reference ``module.py:539``; default
+        initializer Uniform(0.01) like ``BaseModule.init_params``)."""
+        if initializer == "default":
+            from ..initializer import Uniform
+            initializer = Uniform(0.01)
+        if self.params_initialized and not force_init:
+            warnings.warn("Parameters already initialized and force_init=False. "
+                          "init_params call ignored.", stacklevel=2)
+            return
+        assert self.binded, "call bind before initializing the parameters"
+        attrs = self._symbol.attr_dict()
+        for name in self._param_names:
+            desc = InitDesc(name, attrs.get(name, {}))
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arg_params[name].copyto(arr)
+            else:
+                if arg_params is not None and not allow_missing:
+                    raise RuntimeError(f"{name} is not presented")
+                if initializer is not None:
+                    initializer(desc, arr)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                aux_params[name].copyto(arr)
+            else:
+                if aux_params is not None and not allow_missing:
+                    raise RuntimeError(f"{name} is not presented")
+                if initializer is not None:
+                    initializer(InitDesc(name, attrs.get(name, {})), arr)
+        self.params_initialized = True
+        self._params_dirty = False
+        self._sync_params_from_exec()
+
+    def _sync_params_from_exec(self):
+        self._arg_params = {n: self._exec.arg_dict[n]
+                            for n in self._param_names}
+        self._aux_params = {n: self._exec.aux_dict[n]
+                            for n in self._aux_names}
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        self._sync_params_from_exec()
+        return ({k: v.copy() for k, v in self._arg_params.items()},
+                {k: v.copy() for k, v in self._aux_params.items()})
+
+    # ------------------------------------------------------------- optimizer
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """Reference ``module.py:474``: decides update_on_kvstore and wires
+        the updater.  With one SPMD executor there is no per-device gradient
+        list, so the kvstore (when requested) holds one copy per key."""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring...")
+            return
+
+        from ..kvstore import KVStore, create as kv_create
+        if isinstance(optimizer, str):
+            batch_size = self._data_shapes[0].shape[0]
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                # reference module.py:498: normalize by the effective batch
+                optimizer_params["rescale_grad"] = 1.0 / batch_size
+            optimizer = opt.create(optimizer, param_idx2name=idx2name,
+                                   **optimizer_params)
+        self._optimizer = optimizer
+        kv = None
+        if kvstore:
+            kv = kvstore if isinstance(kvstore, KVStore) else kv_create(kvstore)
+        self._kvstore = kv
+        self._update_on_kvstore = bool(kv) and "dist" not in (kv.type if kv else "")
+        self._updater = opt.get_updater(optimizer)
+        if kv:
+            for i, name in enumerate(self._param_names):
+                kv.init(i, self._exec.arg_dict[name])
+            if self._update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+        self.optimizer_initialized = True
+        if hasattr(self, "_preload_opt_states") and self._preload_opt_states:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    # -------------------------------------------------------------- forward
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feeds[name] = arr
+        if self._label_shapes and data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feeds[name] = arr
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply one optimizer step (reference ``module.py:646``)."""
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        self._params_dirty = True
+        if self._kvstore and self._update_on_kvstore:
+            for i, name in enumerate(self._param_names):
+                if self._grad_req.get(name, "write") == "null":
+                    continue
+                grad = self._exec.grad_dict.get(name)
+                if grad is None:
+                    continue
+                weight = self._exec.arg_dict[name]
+                self._kvstore.push(i, grad, priority=-i)
+                self._kvstore.pull(i, weight, priority=-i)
+        else:
+            if self._kvstore:
+                for i, name in enumerate(self._param_names):
+                    grad = self._exec.grad_dict.get(name)
+                    if grad is None:
+                        continue
+                    self._kvstore.push(i, grad, priority=-i)
+                    self._kvstore.pull(i, grad, priority=-i)
+            for i, name in enumerate(self._param_names):
+                if self._grad_req.get(name, "write") == "null":
+                    continue
+                grad = self._exec.grad_dict.get(name)
+                if grad is None:
+                    continue
+                self._updater(i, grad, self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized \
+            and self.inputs_need_grad
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update_dict(
+            dict(zip(self._label_names, labels)),
+            dict(zip(self._output_names, self.get_outputs())))
+
+    def install_monitor(self, mon):
+        assert self.binded
+        mon.install(self._exec)
+
+    # ----------------------------------------------------- optimizer states
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore and self._kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore and self._kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+
+    def reshape(self, data_shapes, label_shapes=None):
+        """Re-bind for new shapes; jit caching makes this cheap (the
+        reference reuses buffers — ``module.py:453``)."""
+        assert self.binded
+        arg_params, aux_params = self.get_params()
+        self._reset_bind()
+        self.bind(data_shapes, label_shapes, self.for_training,
+                  self.inputs_need_grad, force_rebind=True)
+        self.set_params(arg_params, aux_params)
+
+    def borrow_optimizer(self, shared_module):
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
+
+
+def _check_input_names(symbol, names, typename, throw):
+    """Reference ``base_module.py:33 _check_input_names``."""
+    args = symbol.list_arguments()
+    for name in names:
+        if name in args:
+            continue
+        candidates = [arg for arg in args if not arg.endswith("_weight")
+                      and not arg.endswith("_bias") and not arg.endswith("_gamma")
+                      and not arg.endswith("_beta")]
+        msg = "\033[91mYou created Module with Module(..., %s_names=%s) but " \
+              "input with name '%s' is not found in symbol.list_arguments(). " \
+              "Did you mean one of:\n\t%s\033[0m" % (
+                  typename, str(names), name, "\n\t".join(candidates))
+        if throw:
+            raise ValueError(msg)
+        logging.warning(msg)

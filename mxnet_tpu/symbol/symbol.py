@@ -193,19 +193,56 @@ class Symbol:
             [dt] * len(self.list_auxiliary_states())
 
     def _make_arg_specs(self, shapes, dtypes=None):
-        """Resolve ShapeDtypeStructs for every variable, inferring aux/weight
-        shapes where the op semantics determine them (deferred-init analog)."""
+        """Resolve ShapeDtypeStructs for every variable, inferring parameter
+        shapes the way the reference's InferShape pass does
+        (``src/executor/infer_graph_attr_pass.cc``): walk the graph in topo
+        order, fill in each layer's weight/bias/aux shapes from its op attrs
+        + known input shapes, and shape-evaluate each node via
+        ``jax.eval_shape``."""
         import jax
 
         dtypes = dtypes or {}
-        specs = {}
+        specs = {}          # variable name -> ShapeDtypeStruct
+        out_specs = {}      # (id(node), out_idx) -> ShapeDtypeStruct
+
+        def var_spec(name, shape):
+            s = jax.ShapeDtypeStruct(
+                tuple(int(x) for x in shape),
+                _np.dtype(dtypes.get(name, _np.float32)))
+            specs[name] = s
+            return s
+
         for node in self._topo():
             if node.op is None:
-                if node.name not in shapes:
-                    raise KeyError(node.name)
-                specs[node.name] = jax.ShapeDtypeStruct(
-                    tuple(shapes[node.name]),
-                    _np.dtype(dtypes.get(node.name, _np.float32)))
+                if node.name in shapes:
+                    out_specs[(id(node), 0)] = var_spec(node.name,
+                                                        shapes[node.name])
+                # else: leave unknown — may be inferable at its consumer
+                continue
+            _infer_layer_param_shapes(node, out_specs, var_spec)
+            in_specs = []
+            for p, i in node.inputs:
+                s = out_specs.get((id(p), i))
+                if s is None:
+                    raise KeyError(p.name)
+                in_specs.append(s)
+            attrs = {k: v for k, v in node.attrs.items()}
+            if node.op.name in MODE_DEPENDENT:
+                attrs["__training__"] = False
+            if node.op.name in STOCHASTIC_OPS or node.op.name == "Dropout":
+                key = jax.random.PRNGKey(0)
+                outs = jax.eval_shape(
+                    lambda *a, _at=attrs, _op=node.op, _k=key:
+                        _op.fn(_k, *a, **_at), *in_specs)
+            else:
+                outs = jax.eval_shape(
+                    lambda *a, _at=attrs, _op=node.op: _op.fn(*a, **_at),
+                    *in_specs)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            for i, o in enumerate(outs):
+                out_specs[(id(node), i)] = jax.ShapeDtypeStruct(tuple(o.shape),
+                                                                o.dtype)
         return specs
 
     # ------------------------------------------------------------ build/exec
@@ -628,6 +665,74 @@ LAYER_INPUTS = {
 }
 
 AUX_INPUTS_BY_NAME = {"BatchNorm": {"moving_mean", "moving_var"}}
+
+
+def _infer_layer_param_shapes(node, out_specs, var_spec):
+    """Fill unknown variable-input shapes of a layer node from op attrs —
+    the per-op shape rules of the reference's FInferShape registrations
+    (e.g. FullyConnected weight = (num_hidden, in_features),
+    src/operator/nn/fully_connected.cc)."""
+    from ..base import parse_bool, parse_int, parse_tuple
+
+    op_name = node.op.name
+    if op_name not in LAYER_INPUTS:
+        return
+    roles = LAYER_INPUTS[op_name](node.attrs)
+    data_spec = out_specs.get((id(node.inputs[0][0]), node.inputs[0][1]))
+    if data_spec is None:
+        return
+    dshape = data_spec.shape
+    a = node.attrs
+
+    def fill(pos, shape):
+        if pos >= len(node.inputs):
+            return
+        p, i = node.inputs[pos]
+        if p.op is None and out_specs.get((id(p), i)) is None:
+            out_specs[(id(p), i)] = var_spec(p.name, shape)
+
+    if op_name == "FullyConnected":
+        nh = parse_int(a.get("num_hidden"))
+        flatten = parse_bool(a.get("flatten", True), True)
+        in_feat = int(_np.prod(dshape[1:])) if flatten else int(dshape[-1])
+        fill(roles.index("weight"), (nh, in_feat))
+        if "bias" in roles:
+            fill(roles.index("bias"), (nh,))
+    elif op_name in ("Convolution", "Deconvolution"):
+        kernel = parse_tuple(a.get("kernel"))
+        nf = parse_int(a.get("num_filter"))
+        ng = parse_int(a.get("num_group", 1), 1)
+        cin = int(dshape[1])
+        if op_name == "Convolution":
+            wshape = (nf, cin // ng) + tuple(kernel)
+        else:  # Deconvolution stores (in_c, nf/g, *kernel)
+            wshape = (cin, nf // ng) + tuple(kernel)
+        fill(roles.index("weight"), wshape)
+        if "bias" in roles:
+            fill(roles.index("bias"), (nf,))
+    elif op_name == "BatchNorm":
+        axis = parse_int(a.get("axis", 1), 1)
+        c = int(dshape[axis])
+        for r in ("gamma", "beta", "moving_mean", "moving_var"):
+            fill(roles.index(r), (c,))
+    elif op_name in ("LayerNorm", "InstanceNorm"):
+        axis = parse_int(a.get("axis", -1 if op_name == "LayerNorm" else 1),
+                         -1 if op_name == "LayerNorm" else 1)
+        c = int(dshape[axis])
+        fill(roles.index("gamma"), (c,))
+        fill(roles.index("beta"), (c,))
+    elif op_name == "Embedding":
+        fill(roles.index("weight"), (parse_int(a.get("input_dim")),
+                                     parse_int(a.get("output_dim"))))
+    elif op_name == "LeakyReLU" and "gamma" in roles:
+        fill(roles.index("gamma"), (int(dshape[1]),))
+    elif op_name in ("SoftmaxOutput", "SVMOutput"):
+        multi = parse_bool(node.attrs.get("multi_output", False))
+        fill(roles.index("label"),
+             (int(dshape[0]),) + ((tuple(dshape[2:])) if multi else ()))
+    elif op_name in ("LinearRegressionOutput", "LogisticRegressionOutput",
+                     "MAERegressionOutput"):
+        fill(roles.index("label"), tuple(int(x) for x in dshape))
 
 
 def _input_order(op, named_inputs):

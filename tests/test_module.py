@@ -1,0 +1,175 @@
+"""Module API tests (reference ``tests/python/unittest/test_module.py`` and
+``tests/python/train/test_mlp.py``)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp_sym(nclass=4):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=32)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=nclass)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _toy_data(n=200, dim=8, nclass=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(nclass, dim) * 3
+    y = rng.randint(0, nclass, n)
+    x = centers[y] + rng.randn(n, dim) * 0.5
+    return x.astype("float32"), y.astype("float32")
+
+
+def test_module_dtype_and_shapes():
+    sym = _mlp_sym()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (10, 8))],
+             label_shapes=[("softmax_label", (10,))])
+    assert mod.data_shapes[0].shape == (10, 8)
+    assert mod.label_shapes[0].shape == (10,)
+    mod.init_params()
+    assert mod.output_shapes[0][1] == (10, 4)
+
+
+def test_module_input_grads():
+    sym = _mlp_sym()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (10, 8))],
+             label_shapes=[("softmax_label", (10,))],
+             inputs_need_grad=True)
+    mod.init_params()
+    x, y = _toy_data(10)
+    batch = mx.io.DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    (din,) = mod.get_input_grads()
+    assert din.shape == (10, 8)
+    assert np.abs(din.asnumpy()).sum() > 0
+
+
+def test_module_fit_converges():
+    x, y = _toy_data(240)
+    it = mx.io.NDArrayIter(x, y, batch_size=40, shuffle=True)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=12,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.3})
+    score = mod.score(mx.io.NDArrayIter(x, y, batch_size=40), "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_predict_and_outputs():
+    x, y = _toy_data(100)
+    it = mx.io.NDArrayIter(x, y, batch_size=25)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (100, 4)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1), np.ones(100),
+                               rtol=1e-4)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    x, y = _toy_data(80)
+    it = mx.io.NDArrayIter(x, y, batch_size=20)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0002.params")
+
+    mod2 = mx.mod.Module.load(prefix, 2, context=mx.cpu())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    out1 = mod.predict(mx.io.NDArrayIter(x, y, batch_size=20)).asnumpy()
+    out2 = mod2.predict(mx.io.NDArrayIter(x, y, batch_size=20)).asnumpy()
+    np.testing.assert_allclose(out1, out2, rtol=1e-5, atol=1e-6)
+
+
+def test_module_set_get_params():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (10, 8))],
+             label_shapes=[("softmax_label", (10,))])
+    mod.init_params(initializer=mx.init.Zero())
+    args, auxs = mod.get_params()
+    assert float(args["fc1_weight"].asnumpy().sum()) == 0.0
+    args["fc1_weight"][:] = 1.0
+    mod.set_params(args, auxs)
+    got, _ = mod.get_params()
+    assert float(got["fc1_weight"].asnumpy().mean()) == 1.0
+
+
+def test_module_update_with_kvstore():
+    x, y = _toy_data(80)
+    it = mx.io.NDArrayIter(x, y, batch_size=20)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=3, kvstore="device", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3})
+    score = mod.score(mx.io.NDArrayIter(x, y, batch_size=20), "acc")
+    assert score[0][1] > 0.8, score
+
+
+def test_bucketing_module():
+    """Variable-length 'sequences' via buckets (reference
+    ``tests/python/train/test_bucketing.py`` shape)."""
+    FEAT = 5
+
+    def sym_gen(seq_len):
+        # params are shape-invariant across buckets (like RNN cells): mean
+        # over the variable-length axis, then shared dense layers
+        data = mx.sym.Variable("data")
+        net = mx.sym.mean(data, axis=1)
+        net = mx.sym.FullyConnected(net, name="fc1", num_hidden=16)
+        net = mx.sym.Activation(net, name="relu1", act_type="relu")
+        net = mx.sym.FullyConnected(net, name="fc2", num_hidden=2)
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 context=mx.cpu())
+    rng = np.random.RandomState(0)
+
+    def batch_for(seq, bs=16):
+        x = rng.randn(bs, seq, FEAT).astype("float32")
+        y = (x.mean(axis=(1, 2)) > 0).astype("float32")
+        return mx.io.DataBatch(
+            data=[mx.nd.array(x)], label=[mx.nd.array(y)], bucket_key=seq,
+            provide_data=[mx.io.DataDesc("data", (bs, seq, FEAT))],
+            provide_label=[mx.io.DataDesc("softmax_label", (bs,))])
+
+    mod.bind(data_shapes=[("data", (16, 8, FEAT))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    fixed = [batch_for(dim) for dim in (8, 4, 6)]
+    for i in range(40):
+        for b in fixed:
+            mod.forward(b, is_train=True)
+            mod.backward()
+            mod.update()
+    # weights are shared across buckets: every bucket fits its batch
+    m = mx.metric.Accuracy()
+    for b in fixed:
+        mod.forward(b, is_train=False)
+        mod.update_metric(m, b.label)
+    assert m.get()[1] > 0.9, m.get()
+    # parameter arrays are literally shared (reference shared-memory pool)
+    assert mod._buckets[4]._exec.arg_dict["fc1_weight"] is \
+        mod._buckets[8]._exec.arg_dict["fc1_weight"]
+
+
+def test_speedometer_and_callbacks(caplog):
+    import logging
+    caplog.set_level(logging.INFO)
+    x, y = _toy_data(80)
+    it = mx.io.NDArrayIter(x, y, batch_size=20)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            batch_end_callback=mx.callback.Speedometer(20, 2))
+    assert any("Speed" in r.message for r in caplog.records)
