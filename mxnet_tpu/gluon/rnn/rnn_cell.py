@@ -1,0 +1,465 @@
+"""Recurrent cells (reference ``python/mxnet/gluon/rnn/rnn_cell.py``).
+
+Cells are fine-grained recurrent units composed/unrolled step-by-step; the
+fused layers (``rnn_layer.py``) are the performance path (one ``lax.scan``),
+while ``unroll`` here is the flexible path matching the reference's
+step-wise semantics.
+"""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ..block import Block, HybridBlock
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "DropoutCell", "ModifierCell",
+           "ZoneoutCell", "ResidualCell", "BidirectionalCell"]
+
+
+def _cells_state_info(cells, batch_size):
+    return sum([c.state_info(batch_size) for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+def _format_sequence(length, inputs, layout, merge, in_layout=None):
+    """Normalize inputs to a list of per-step arrays or a merged tensor
+    (reference ``rnn_cell.py:48``)."""
+    assert inputs is not None
+    axis = layout.find("T")
+    batch_axis = layout.find("N")
+    if isinstance(inputs, nd.NDArray):
+        batch_size = inputs.shape[batch_axis]
+        if merge is False:
+            assert length is None or length == inputs.shape[axis]
+            inputs = [x.squeeze(axis=axis) for x in
+                      nd.split(inputs, num_outputs=inputs.shape[axis],
+                               axis=axis, squeeze_axis=False)]
+    else:
+        assert length is None or len(inputs) == length
+        batch_size = inputs[0].shape[batch_axis]
+        if merge is True:
+            inputs = [nd.expand_dims(i, axis=axis) for i in inputs]
+            inputs = nd.concat(*inputs, dim=axis)
+    return inputs, axis, batch_size
+
+
+def _mask_sequence_variable_length(data, length, valid_length, time_axis,
+                                   merge):
+    assert valid_length is not None
+    if not isinstance(data, nd.NDArray):
+        data = nd.concat(*[nd.expand_dims(x, axis=time_axis) for x in data],
+                         dim=time_axis)
+    outputs = nd.SequenceMask(data, sequence_length=valid_length,
+                              use_sequence_length=True, axis=time_axis)
+    if not merge:
+        outputs = [x.squeeze(axis=time_axis) for x in
+                   nd.split(outputs, num_outputs=data.shape[time_axis],
+                            axis=time_axis, squeeze_axis=False)]
+    return outputs
+
+
+class RecurrentCell(Block):
+    """Abstract cell (reference ``rnn_cell.py:98``)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError()
+
+    @property
+    def _curr_prefix(self):
+        return "%st%d_" % (self.prefix, self._counter)
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        """Initial states (reference ``rnn_cell.py:133``)."""
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called " \
+            "directly. Call the modifier cell instead."
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            info = dict(info or {})
+            info.pop("__layout__", None)
+            info.update(kwargs)
+            states.append(func(**info))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll the cell ``length`` steps (reference ``rnn_cell.py:173``)."""
+        self.reset()
+        inputs, axis, batch_size = _format_sequence(length, inputs, layout,
+                                                    False)
+        begin_state = self._get_begin_state(inputs, begin_state, batch_size)
+        states = begin_state
+        outputs = []
+        all_states = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+            if valid_length is not None:
+                all_states.append(states)
+        if valid_length is not None:
+            states = [nd.SequenceLast(nd.stack(*ele_list, axis=0),
+                                      sequence_length=valid_length,
+                                      use_sequence_length=True, axis=0)
+                      for ele_list in zip(*all_states)]
+            outputs = _mask_sequence_variable_length(outputs, length,
+                                                     valid_length, axis, True)
+        if merge_outputs:
+            if isinstance(outputs, (list, tuple)):
+                outputs = nd.concat(*[nd.expand_dims(o, axis=axis)
+                                      for o in outputs], dim=axis)
+        elif merge_outputs is None and valid_length is not None \
+                and isinstance(outputs, nd.NDArray):
+            pass
+        return outputs, states
+
+    def _get_begin_state(self, inputs, begin_state, batch_size):
+        if begin_state is None:
+            ctx = inputs.context if isinstance(inputs, nd.NDArray) \
+                else inputs[0].context
+            begin_state = self.begin_state(batch_size, ctx=ctx)
+        return begin_state
+
+    def forward(self, inputs, states):
+        self._counter += 1
+        return self._forward_step(inputs, states)
+
+    def _forward_step(self, inputs, states):
+        raise NotImplementedError()
+
+
+class HybridRecurrentCell(RecurrentCell):
+    """Cells whose step is a pure function of params — jit-able through
+    ``hybridize()`` on an enclosing block."""
+
+
+class _BaseRNNCell(HybridRecurrentCell):
+    def __init__(self, hidden_size, gates, input_size,
+                 i2h_weight_initializer, h2h_weight_initializer,
+                 i2h_bias_initializer, h2h_bias_initializer,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = gates
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(ng * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(ng * hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(ng * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(ng * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+        self._gates = ng
+
+    def _finish_shapes(self, inputs):
+        if self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (self._gates * self._hidden_size,
+                                     inputs.shape[-1])
+
+    def _dense(self, x, w, b, n_out):
+        return nd.FullyConnected(x, w.data(x.context), b.data(x.context),
+                                 num_hidden=n_out, flatten=False)
+
+
+class RNNCell(_BaseRNNCell):
+    """Elman cell: h' = act(W x + b + R h + rb) (reference
+    ``rnn_cell.py:344``)."""
+
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(hidden_size, 1, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, prefix=prefix, params=params)
+        self._activation = activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def _forward_step(self, inputs, states):
+        self._finish_shapes(inputs)
+        h = self._hidden_size
+        i2h = self._dense(inputs, self.i2h_weight, self.i2h_bias, h)
+        h2h = self._dense(states[0], self.h2h_weight, self.h2h_bias, h)
+        output = nd.Activation(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class LSTMCell(_BaseRNNCell):
+    """LSTM cell (reference ``rnn_cell.py:444``; gate order i, f, g, o —
+    the reference's in-gate/forget/transform/out)."""
+
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(hidden_size, 4, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, prefix=prefix, params=params)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def _forward_step(self, inputs, states):
+        self._finish_shapes(inputs)
+        h = self._hidden_size
+        gates = self._dense(inputs, self.i2h_weight, self.i2h_bias, 4 * h) + \
+            self._dense(states[0], self.h2h_weight, self.h2h_bias, 4 * h)
+        i, f, g, o = [x for x in nd.split(gates, num_outputs=4, axis=-1)]
+        i = nd.sigmoid(i)
+        f = nd.sigmoid(f)
+        g = nd.tanh(g)
+        o = nd.sigmoid(o)
+        c = f * states[1] + i * g
+        h_out = o * nd.tanh(c)
+        return h_out, [h_out, c]
+
+
+class GRUCell(_BaseRNNCell):
+    """GRU cell, cuDNN formulation (reference ``rnn_cell.py:556``)."""
+
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(hidden_size, 3, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, prefix=prefix, params=params)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def _forward_step(self, inputs, states):
+        self._finish_shapes(inputs)
+        h = self._hidden_size
+        i2h = self._dense(inputs, self.i2h_weight, self.i2h_bias, 3 * h)
+        h2h = self._dense(states[0], self.h2h_weight, self.h2h_bias, 3 * h)
+        i2h_r, i2h_z, i2h_n = [x for x in nd.split(i2h, num_outputs=3,
+                                                   axis=-1)]
+        h2h_r, h2h_z, h2h_n = [x for x in nd.split(h2h, num_outputs=3,
+                                                   axis=-1)]
+        r = nd.sigmoid(i2h_r + h2h_r)
+        z = nd.sigmoid(i2h_z + h2h_z)
+        n = nd.tanh(i2h_n + r * h2h_n)
+        out = (1 - z) * n + z * states[0]
+        return out, [out]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells (reference ``rnn_cell.py:652``)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._layers = []
+
+    def add(self, cell):
+        self._layers.append(cell)
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._layers, batch_size)
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._layers, batch_size=batch_size,
+                                  func=func, **kwargs)
+
+    def __len__(self):
+        return len(self._layers)
+
+    def __getitem__(self, i):
+        return self._layers[i]
+
+    def _forward_step(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._layers:
+            n = len(cell.state_info())
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+
+class DropoutCell(HybridRecurrentCell):
+    """Dropout on cell outputs (reference ``rnn_cell.py:721``)."""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert isinstance(rate, (int, float))
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def _alias(self):
+        return "dropout"
+
+    def _forward_step(self, inputs, states):
+        if self._rate > 0:
+            inputs = nd.Dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ModifierCell(HybridRecurrentCell):
+    """Base for cells wrapping another cell (reference ``rnn_cell.py:768``)."""
+
+    def __init__(self, base_cell):
+        assert not base_cell._modified, \
+            "Cell %s is already modified. One cell cannot be modified twice" \
+            % base_cell.name
+        base_cell._modified = True
+        super().__init__(prefix=base_cell.prefix + self._alias(),
+                         params=None)
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        return self.base_cell.params
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size, func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (reference ``rnn_cell.py:810``)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, BidirectionalCell), \
+            "BidirectionalCell doesn't support zoneout. " \
+            "Please add ZoneoutCell to the cells underneath instead."
+        self._zoneout_outputs = zoneout_outputs
+        self._zoneout_states = zoneout_states
+        super().__init__(base_cell)
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def _forward_step(self, inputs, states):
+        cell = self.base_cell
+        next_output, next_states = cell(inputs, states)
+        po, ps = self._zoneout_outputs, self._zoneout_states
+
+        def mask(p, like):
+            return nd.Dropout(nd.ones_like(like), p=p)
+
+        prev_output = self._prev_output
+        if prev_output is None:
+            prev_output = nd.zeros_like(next_output)
+        output = nd.where(mask(po, next_output), next_output, prev_output) \
+            if po != 0.0 else next_output
+        new_states = [nd.where(mask(ps, new_s), new_s, old_s)
+                      for new_s, old_s in zip(next_states, states)] \
+            if ps != 0.0 else next_states
+        self._prev_output = output
+        return output, new_states
+
+
+class ResidualCell(ModifierCell):
+    """Residual connection over a cell (reference ``rnn_cell.py:870``)."""
+
+    def _alias(self):
+        return "residual"
+
+    def _forward_step(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = output + inputs
+        return output, states
+
+
+class BidirectionalCell(HybridRecurrentCell):
+    """Run two cells over opposite directions (reference
+    ``rnn_cell.py:910``); only usable via ``unroll``."""
+
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+        self._output_prefix = output_prefix
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "Bidirectional cannot be stepped. Please use unroll")
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(),
+                                  batch_size=batch_size, func=func, **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        inputs, axis, batch_size = _format_sequence(length, inputs, layout,
+                                                    False)
+        begin_state = self._get_begin_state(inputs, begin_state, batch_size)
+        states = begin_state
+        l_cell, r_cell = self._children.values()
+        n_l = len(l_cell.state_info(batch_size))
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs, begin_state=states[:n_l], layout=layout,
+            merge_outputs=False, valid_length=valid_length)
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=list(reversed(inputs)),
+            begin_state=states[n_l:], layout=layout, merge_outputs=False,
+            valid_length=valid_length)
+        if valid_length is not None:
+            r_outputs = _mask_sequence_variable_length(
+                list(reversed(r_outputs)), length, valid_length, axis, False)
+        else:
+            r_outputs = list(reversed(r_outputs))
+        outputs = [nd.concat(l_o, r_o, dim=1)
+                   for l_o, r_o in zip(l_outputs, r_outputs)]
+        if merge_outputs:
+            outputs = nd.concat(*[nd.expand_dims(o, axis=axis)
+                                  for o in outputs], dim=axis)
+        states = l_states + r_states
+        return outputs, states

@@ -1,0 +1,177 @@
+"""Fused recurrent layers (reference ``python/mxnet/gluon/rnn/rnn_layer.py``).
+
+The layers own per-layer/direction ``{l,r}N_{i2h,h2h}_{weight,bias}``
+Parameters (the reference's ``_unfuse``-compatible naming) and call the fused
+``RNN`` operator (rebuild of ``src/operator/rnn.cc:636`` — here a
+``lax.scan`` whose gate matmuls XLA pipelines onto the MXU) with the flat
+parameter vector in cuDNN canonical order: all (W, R) matrices
+layer-major/direction-minor, then all (bw, br) biases.
+"""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ...context import current_context
+from ..block import Block
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(Block):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, projection_size=None,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert layout in ("TNC", "NTC"), \
+            f"Invalid layout {layout}; must be one of ['TNC', 'NTC']"
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in ["l", "r"][:self._dir]:
+                self._register_param(f"{j}{i}_i2h_weight", (ng * nh, ni),
+                                     i2h_weight_initializer)
+                self._register_param(f"{j}{i}_h2h_weight", (ng * nh, nh),
+                                     h2h_weight_initializer)
+                self._register_param(f"{j}{i}_i2h_bias", (ng * nh,),
+                                     i2h_bias_initializer)
+                self._register_param(f"{j}{i}_h2h_bias", (ng * nh,),
+                                     h2h_bias_initializer)
+            ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        shape = self.l0_i2h_weight.shape
+        mapping = "{0} -> {1}".format(
+            shape[1] if shape[1] else None, shape[0] // self._gates)
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        """Initial states (reference ``rnn_layer.py:167``)."""
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(func(shape=info["shape"], **kwargs))
+        return states
+
+    def forward(self, inputs, states=None):
+        """Run the fused kernel; accepts TNC/NTC per ``layout``."""
+        if self._layout == "NTC":
+            inputs = nd.swapaxes(inputs, 0, 1)
+        batch_size = inputs.shape[1]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size, ctx=inputs.context,
+                                      dtype=inputs.dtype)
+        if isinstance(states, nd.NDArray):
+            states = [states]
+        if self._input_size == 0:
+            # deferred shapes resolve from the first batch
+            ni = inputs.shape[2]
+            for j in ["l", "r"][:self._dir]:
+                getattr(self, f"{j}0_i2h_weight").shape = \
+                    (self._gates * self._hidden_size, ni)
+            self._input_size = ni
+
+        flat = []
+        for group in ("weight", "bias"):
+            for i in range(self._num_layers):
+                for j in ["l", "r"][:self._dir]:
+                    for conn in ("i2h", "h2h"):
+                        p = getattr(self, f"{j}{i}_{conn}_{group}")
+                        flat.append(p.data(inputs.context).reshape((-1,)))
+        params = nd.concat(*flat, dim=0) if len(flat) > 1 else flat[0]
+
+        rnn_args = [inputs, params] + states
+        out = nd.RNN(*rnn_args, state_size=self._hidden_size,
+                     num_layers=self._num_layers,
+                     bidirectional=self._dir == 2, mode=self._mode,
+                     p=self._dropout, state_outputs=True)
+        if self._mode == "lstm":
+            outputs, states = out[0], [out[1], out[2]]
+        else:
+            outputs, states = out[0], [out[1]]
+        if self._layout == "NTC":
+            outputs = nd.swapaxes(outputs, 0, 1)
+        if skip_states:
+            return outputs
+        return outputs, states
+
+
+class RNN(_RNNLayer):
+    """Elman RNN layer (reference ``rnn_layer.py:324``)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """LSTM layer (reference ``rnn_layer.py:411``)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 projection_size=None, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm",
+                         projection_size=projection_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """GRU layer (cuDNN formulation, reference ``rnn_layer.py:519``)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

@@ -1,0 +1,144 @@
+"""Gluon RNN tests (reference ``tests/python/unittest/test_gluon_rnn.py``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def test_rnn_cell_shapes():
+    cell = gluon.rnn.RNNCell(100, prefix="rnn_")
+    inputs = [mx.nd.ones((10, 50)) for _ in range(3)]
+    assert sorted(cell.collect_params().keys()) == \
+        ["rnn_h2h_bias", "rnn_h2h_weight", "rnn_i2h_bias", "rnn_i2h_weight"]
+    cell.initialize()
+    outputs, _ = cell.unroll(3, inputs)
+    assert [o.shape for o in outputs] == [(10, 100)] * 3
+
+
+def test_lstm_cell():
+    cell = gluon.rnn.LSTMCell(64, prefix="lstm_")
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(8, 32))
+    states = cell.begin_state(8)
+    out, new_states = cell(x, states)
+    assert out.shape == (8, 64)
+    assert len(new_states) == 2
+    assert new_states[0].shape == (8, 64)
+    np.testing.assert_allclose(out.asnumpy(), new_states[0].asnumpy())
+
+
+def test_gru_cell_unroll_merge():
+    cell = gluon.rnn.GRUCell(16, prefix="gru_")
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(4, 5, 8))  # NTC
+    outputs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (4, 5, 16)
+    assert states[0].shape == (4, 16)
+
+
+def test_sequential_stack():
+    stack = gluon.rnn.SequentialRNNCell()
+    for i in range(3):
+        stack.add(gluon.rnn.LSTMCell(20, prefix=f"lstm{i}_"))
+    stack.initialize()
+    x = [mx.nd.ones((2, 10)) for _ in range(4)]
+    outputs, states = stack.unroll(4, x)
+    assert outputs[-1].shape == (2, 20)
+    assert len(states) == 6  # 2 per LSTM layer
+
+
+def test_residual_and_dropout_cells():
+    base = gluon.rnn.RNNCell(12, input_size=12, prefix="base_")
+    cell = gluon.rnn.ResidualCell(base)
+    cell.initialize()
+    x = mx.nd.ones((3, 12))
+    out, _ = cell(x, cell.begin_state(3))
+    assert out.shape == (3, 12)
+    d = gluon.rnn.DropoutCell(0.5)
+    out2, st = d(x, [])
+    assert out2.shape == x.shape
+
+
+def test_bidirectional_cell():
+    cell = gluon.rnn.BidirectionalCell(
+        gluon.rnn.LSTMCell(10, prefix="l_"), gluon.rnn.LSTMCell(10, prefix="r_"))
+    cell.initialize()
+    x = [mx.nd.ones((2, 6)) for _ in range(3)]
+    outputs, states = cell.unroll(3, x)
+    assert [o.shape for o in outputs] == [(2, 20)] * 3
+    with pytest.raises(NotImplementedError):
+        cell(x[0], states)
+
+
+@pytest.mark.parametrize("layer_cls,mode", [
+    (gluon.rnn.RNN, "rnn"), (gluon.rnn.LSTM, "lstm"), (gluon.rnn.GRU, "gru")])
+def test_fused_layers_shapes(layer_cls, mode):
+    layer = layer_cls(32, num_layers=2, bidirectional=True)
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(7, 4, 16))  # TNC
+    out = layer(x)
+    assert out.shape == (7, 4, 64)
+    states = layer.begin_state(4)
+    out, new_states = layer(x, states)
+    assert out.shape == (7, 4, 64)
+    assert new_states[0].shape == (4, 4, 32)
+
+
+def test_lstm_layer_vs_cell():
+    """Fused LSTM must match the step-wise LSTMCell numerically."""
+    T, N, C, H = 5, 3, 8, 16
+    layer = gluon.rnn.LSTM(H, input_size=C)
+    layer.initialize()
+    cell = gluon.rnn.LSTMCell(H, input_size=C, prefix="c_")
+    cell.initialize()
+    # copy layer params into cell
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    x = mx.nd.random.uniform(shape=(T, N, C))
+    fused = layer(x).asnumpy()
+    outs, _ = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    np.testing.assert_allclose(fused, np.swapaxes(outs.asnumpy(), 0, 1)
+                               if outs.shape[0] == N else outs.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ntc_layout():
+    layer = gluon.rnn.GRU(12, layout="NTC")
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(4, 9, 6))
+    out = layer(x)
+    assert out.shape == (4, 9, 12)
+
+
+def test_rnn_layer_trains():
+    """A tiny sequence-sum regression learns through the fused LSTM."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(6, 32, 4).astype("float32")  # TNC
+    y = x.sum(axis=(0, 2)).astype("float32")
+
+    class Model(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            self.rnn = gluon.rnn.LSTM(16)
+            self.out = gluon.nn.Dense(1)
+        def forward(self, x):
+            h = self.rnn(x)
+            return self.out(h[-1])
+    model = Model()
+    model.initialize()
+    trainer = gluon.Trainer(model.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    loss_fn = gluon.loss.L2Loss()
+    first = None
+    for i in range(60):
+        with mx.autograd.record():
+            loss = loss_fn(model(mx.nd.array(x)), mx.nd.array(y.reshape(-1, 1)))
+        loss.backward()
+        trainer.step(32)
+        v = float(loss.mean().asscalar())
+        if first is None:
+            first = v
+    assert v < first * 0.5, (first, v)
