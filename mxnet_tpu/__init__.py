@@ -38,6 +38,15 @@ from . import module  # noqa: F401
 from . import module as mod  # noqa: F401
 from . import gluon  # noqa: F401
 from . import parallel  # noqa: F401
+from . import image  # noqa: F401
+from . import profiler  # noqa: F401
+from . import runtime  # noqa: F401
+from . import test_utils  # noqa: F401
+from . import visualization  # noqa: F401
+from . import visualization as viz  # noqa: F401
+from . import attribute  # noqa: F401
+from .attribute import AttrScope  # noqa: F401
+from . import contrib  # noqa: F401
 
 from .ndarray import op_namespaces as _ns
 

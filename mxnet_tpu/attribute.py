@@ -1,0 +1,51 @@
+"""AttrScope — scoped symbol attributes (reference
+``python/mxnet/attribute.py``; used for ``ctx_group`` model-parallel hints,
+``__wd_mult__`` etc.)."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+
+class AttrScope:
+    """Attach attributes to all symbols created in scope (reference
+    ``attribute.py:28``)."""
+
+    _current = threading.local()
+
+    def __init__(self, **kwargs):
+        self._old_scope = None
+        for value in kwargs.values():
+            assert isinstance(value, str), \
+                "Attributes need to be a string, for mx.AttrScope"
+        self._attr = kwargs
+
+    def get(self, attr):
+        """Merge scope attrs into ``attr`` (reference ``attribute.py:45``)."""
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        if not hasattr(AttrScope._current, "value"):
+            AttrScope._current.value = AttrScope()
+        self._old_scope = AttrScope._current.value
+        attr = AttrScope._current.value._attr.copy()
+        attr.update(self._attr)
+        self._attr = attr
+        AttrScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_scope
+        AttrScope._current.value = self._old_scope
+
+
+def current():
+    if not hasattr(AttrScope._current, "value"):
+        AttrScope._current.value = AttrScope()
+    return AttrScope._current.value

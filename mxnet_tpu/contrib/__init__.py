@@ -1,0 +1,3 @@
+"""Contrib: experimental / auxiliary surfaces (reference
+``python/mxnet/contrib/``)."""
+from . import amp  # noqa: F401

@@ -1,0 +1,162 @@
+"""AMP core (reference ``python/mxnet/contrib/amp/amp.py``).
+
+The reference patches every op function in the ``mx.nd``/``mx.sym``
+namespaces to insert ``amp_cast``/``amp_multicast`` (``amp.py:160-194``).
+TPU-native redesign: one hook on the single imperative dispatch path
+(``ndarray.invoke``) rewrites op inputs — identical semantics, and because
+Gluon's CachedOp traces through the same path, hybridized/jitted graphs get
+the same casts fused by XLA for free (replacing the reference's NNVM
+``low_precision_pass.cc`` graph rewrite).
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import warnings
+
+import numpy as np
+
+from . import lists
+from .loss_scaler import LossScaler
+
+_state = {"initialized": False, "target_dtype": None,
+          "lp16": set(), "fp32": set()}
+
+
+def list_lp16_ops(target_dtype="bfloat16"):
+    return list(lists.TARGET_DTYPE_OPS)
+
+
+def list_fp32_ops(target_dtype="bfloat16"):
+    return list(lists.FP32_OPS)
+
+
+def _names_of(op):
+    return (op.name,) + tuple(op.aliases)
+
+
+def _amp_hook(op, raw):
+    import jax.numpy as jnp
+
+    names = _names_of(op)
+    tgt = _state["target_dtype"]
+    if any(n in _state["lp16"] for n in names):
+        return [r.astype(tgt) if r.dtype == jnp.float32 else r for r in raw]
+    if any(n in _state["fp32"] for n in names):
+        return [r.astype(jnp.float32) if r.dtype == tgt else r for r in raw]
+    return raw
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP (reference ``amp.py:init``).  ``float16`` requests are
+    honored as bfloat16 on TPU (documented deviation: bf16 is the MXU's
+    native low-precision type; fp16 has no advantage and needs loss
+    scaling)."""
+    import jax.numpy as jnp
+    from ... import ndarray as nd_mod
+
+    if _state["initialized"]:
+        return
+    if str(target_dtype) in ("float16", "fp16", "np.float16"):
+        warnings.warn("AMP on TPU uses bfloat16; float16 request mapped to "
+                      "bfloat16 (same API, wider exponent range).")
+    _state["target_dtype"] = jnp.bfloat16
+    _state["lp16"] = set(lists.TARGET_DTYPE_OPS) | set(target_precision_ops or ())
+    _state["fp32"] = set(lists.FP32_OPS) | set(fp32_ops or ())
+    _state["initialized"] = True
+    nd_mod.ndarray._AMP_HOOK = _amp_hook
+    logging.info("AMP initialized (target dtype bfloat16)")
+
+
+def deinit():
+    """Testing helper: remove the hook."""
+    from ... import ndarray as nd_mod
+    nd_mod.ndarray._AMP_HOOK = None
+    _state["initialized"] = False
+
+
+def init_trainer(optimizer_or_trainer):
+    """Attach a dynamic loss scaler to a Trainer (reference
+    ``amp.py:init_trainer``)."""
+    from ...gluon.trainer import Trainer
+    if isinstance(optimizer_or_trainer, Trainer):
+        optimizer_or_trainer._amp_loss_scaler = LossScaler()
+        optimizer_or_trainer._amp_original_scale = optimizer_or_trainer._scale
+    else:
+        raise TypeError("optimizer_or_trainer should be a Gluon Trainer; "
+                        f"got {type(optimizer_or_trainer)}")
+
+
+def unscale(optimizer_or_trainer):
+    """Divide gradients by the current loss scale (reference
+    ``amp.py:unscale``)."""
+    scaler = getattr(optimizer_or_trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    for param in optimizer_or_trainer._params:
+        if param.grad_req != "null" and param._grad is not None:
+            param._grad[:] = param._grad / scaler.loss_scale
+
+
+@contextlib.contextmanager
+def scale_loss(loss, optimizer_or_trainer):
+    """Scale the loss for backward; on exit, set the trainer's rescale so
+    ``step`` unscales, and update the dynamic scale from gradient finiteness
+    (reference ``amp.py:scale_loss``)."""
+    scaler = getattr(optimizer_or_trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        yield loss
+        return
+    optimizer_or_trainer._scale = (optimizer_or_trainer._amp_original_scale /
+                                   scaler.loss_scale)
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+    overflow = scaler.has_overflow(optimizer_or_trainer._params)
+    if overflow:
+        for param in optimizer_or_trainer._params:
+            if param.grad_req != "null" and param._grad is not None:
+                param._grad[:] = 0
+    scaler.update_scale(overflow)
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  target_dtype_ops=None, fp32_ops=None,
+                  conditional_fp32_ops=None, excluded_sym_names=None,
+                  cast_optional_params=False):
+    """Convert a symbolic checkpoint for low-precision inference (reference
+    ``amp.py:convert_model`` → ``low_precision_pass.cc``).  With the dispatch
+    hook applying casts at run time, the graph itself needs no rewrite; the
+    parameters of LP16 layers are cast so weights live in bf16 HBM."""
+    import jax.numpy as jnp
+    excluded = set(excluded_sym_names or ())
+    lp16_layers = set(target_dtype_ops or lists.TARGET_DTYPE_OPS)
+    lp16_params = set()
+    for node in sym._topo():
+        if node.op is not None and node.op.name in lp16_layers \
+                and node.name not in excluded:
+            for p, _ in node.inputs:
+                if p.op is None:
+                    lp16_params.add(p.name)
+    new_args = {}
+    for k, v in arg_params.items():
+        new_args[k] = v.astype(jnp.bfloat16) if k in lp16_params else v
+    return sym, new_args, dict(aux_params)
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16",
+                         target_dtype_ops=None, fp32_ops=None,
+                         conditional_fp32_ops=None, excluded_sym_names=None,
+                         ctx=None, cast_optional_params=False):
+    """Cast a Gluon block's MXU-layer weights to bf16 (reference
+    ``amp.py:convert_hybrid_block``): dense/conv weights (≥2-D float32
+    params) move to bf16 HBM; biases/norm params stay fp32."""
+    import jax.numpy as jnp
+    for name, param in block.collect_params().items():
+        if param._data is not None and len(param.shape) >= 2 and \
+                param.dtype == np.float32:
+            param._data._data = param._data._data.astype(jnp.bfloat16)
+            param._dtype = "bfloat16"
+    return block

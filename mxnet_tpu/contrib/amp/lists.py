@@ -1,0 +1,35 @@
+"""AMP op lists (reference ``python/mxnet/contrib/amp/lists/symbol.py``).
+
+On TPU the low-precision type is **bfloat16**: same exponent range as fp32,
+so the reference's fp16 overflow machinery (loss scaling) is rarely needed —
+kept for API parity.  LP16 ops are the MXU-bound ones; FP32 ops are
+reduction/transcendental ops where precision matters; everything else runs
+in the widest input type (XLA's natural promotion).
+"""
+
+# matmul/conv-heavy → bfloat16 on the MXU
+TARGET_DTYPE_OPS = [
+    "FullyConnected", "Convolution", "Deconvolution", "dot", "batch_dot",
+    "RNN",
+]
+
+# numerically sensitive → force float32
+FP32_OPS = [
+    "softmax", "log_softmax", "SoftmaxOutput", "SoftmaxActivation",
+    "softmin", "Softmax",
+    "exp", "log", "log2", "log10", "log1p", "expm1", "rsqrt", "erfinv",
+    "norm", "L2Normalization", "LayerNorm", "InstanceNorm", "BatchNorm",
+    "mean", "sum", "nansum", "prod", "nanprod",
+    "linalg_gemm", "linalg_gemm2", "linalg_potrf", "linalg_trsm",
+    "linalg_trmm", "linalg_sumlogdiag", "linalg_syrk",
+    "smooth_l1", "CTCLoss", "ctc_loss", "make_loss", "MakeLoss",
+    "LinearRegressionOutput", "LogisticRegressionOutput",
+    "MAERegressionOutput", "SVMOutput", "Perplexity",
+]
+
+# conditionally fp16-safe in the reference; on TPU they follow their inputs
+FP16_FP32_OPS = [
+    "Activation", "Pooling", "Dropout", "Flatten", "Reshape", "reshape",
+    "transpose", "concat", "Concat", "elemwise_add", "elemwise_mul",
+    "relu", "sigmoid", "tanh",
+]

@@ -1,0 +1,40 @@
+"""Dynamic loss scaler (reference ``python/mxnet/contrib/amp/loss_scaler.py``).
+
+Kept for API parity: with bfloat16 (fp32 exponent range) overflow is rare, so
+the scaler usually sits at its initial value — but fp16-style dynamics
+(halve on overflow, double every ``scale_window`` clean steps) are preserved
+for scripts that tune it.
+"""
+from __future__ import annotations
+
+import logging
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.**16, scale_factor=2., scale_window=2000,
+                 tolerance=0.05):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any gradient is non-finite (the reference launches the
+        ``multi_all_finite`` kernel; one fused jnp check here)."""
+        import jax.numpy as jnp
+        for param in params:
+            if param.grad_req != "null" and param._grad is not None:
+                if not bool(jnp.isfinite(param._grad._data).all()):
+                    return True
+        return False
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1)
+            self._unskipped = 0
+            logging.info("AMP: decreasing loss scale to %f", self.loss_scale)
+        else:
+            self._unskipped += 1
+        if self._unskipped == self._scale_window:
+            self.loss_scale *= self._scale_factor
+            self._unskipped = 0

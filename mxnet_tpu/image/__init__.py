@@ -1,0 +1,10 @@
+"""Image API (reference ``python/mxnet/image/``)."""
+from .image import (  # noqa: F401
+    imread, imdecode, imresize, scale_down, resize_short, fixed_crop,
+    center_crop, random_crop, random_size_crop, color_normalize,
+    Augmenter, SequentialAug, RandomOrderAug, ResizeAug, ForceResizeAug,
+    CastAug, RandomCropAug, CenterCropAug, RandomSizedCropAug,
+    HorizontalFlipAug, BrightnessJitterAug, ContrastJitterAug,
+    SaturationJitterAug, HueJitterAug, ColorJitterAug, LightingAug,
+    ColorNormalizeAug, CreateAugmenter, ImageIter,
+)

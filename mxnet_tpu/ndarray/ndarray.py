@@ -466,9 +466,18 @@ def invoke_op(name, nd_inputs, attrs, out=None):
     return invoke(op, nd_inputs, attrs, out=out)
 
 
+# AMP hook: when set (contrib.amp.init), rewrites raw op inputs — the
+# TPU-native analog of the reference's namespace-patching cast insertion
+# (python/mxnet/contrib/amp/amp.py:160-194).  Because this sits on the single
+# imperative dispatch path, the same casts apply inside CachedOp/jit traces.
+_AMP_HOOK = None
+
+
 def invoke(op, nd_inputs, attrs, out=None):
     nd_inputs = [x if isinstance(x, NDArray) else _as_nd(x) for x in nd_inputs]
     raw = [x._data for x in nd_inputs]
+    if _AMP_HOOK is not None:
+        raw = _AMP_HOOK(op, raw)
     result = op.fn(*raw, **attrs)
     single = not isinstance(result, (tuple, list))
     outs = [result] if single else list(result)

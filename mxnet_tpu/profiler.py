@@ -1,0 +1,200 @@
+"""Profiler (reference ``python/mxnet/profiler.py`` over ``src/profiler/``).
+
+The reference's engine-integrated profiler records per-op events into a
+chrome://tracing JSON plus an aggregate per-op table (``aggregate_stats.cc``).
+TPU-native mapping: ``jax.profiler`` emits XPlane/perfetto traces of the real
+XLA executables (the honest per-op story once fusion exists), and this module
+keeps the reference's control surface — ``set_config/start/stop/dump`` and
+scoped ``Task/Frame/Marker`` annotations that land in the trace via
+``jax.profiler.TraceAnnotation`` — plus a wall-clock aggregate table for the
+``dumps()`` UX.
+"""
+from __future__ import annotations
+
+import os
+import time
+import warnings
+
+_config = {"profile_all": False, "profile_symbolic": True,
+           "profile_imperative": True, "profile_memory": False,
+           "profile_api": False, "filename": "profile.json",
+           "aggregate_stats": False}
+_state = {"running": False, "dir": None}
+_aggregate = {}
+
+
+def set_config(**kwargs):
+    """Reference ``profiler.py:set_config``; ``filename`` decides the trace
+    output directory."""
+    _config.update(kwargs)
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """Deprecated alias (reference keeps it)."""
+    warnings.warn("profiler.profiler_set_config is deprecated; use set_config")
+    _config["filename"] = filename
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        start()
+    else:
+        stop()
+
+
+def start(profile_process="worker"):
+    """Start tracing (reference ``profiler.py:start``)."""
+    import jax
+    if _state["running"]:
+        return
+    logdir = os.path.splitext(_config["filename"])[0] + "_trace"
+    os.makedirs(logdir, exist_ok=True)
+    try:
+        jax.profiler.start_trace(logdir)
+        _state["dir"] = logdir
+    except Exception as e:  # tracing backend unavailable (e.g. in tests)
+        warnings.warn(f"jax.profiler trace unavailable: {e}")
+        _state["dir"] = None
+    _state["running"] = True
+
+
+def stop(profile_process="worker"):
+    import jax
+    if not _state["running"]:
+        return
+    if _state["dir"] is not None:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+    _state["running"] = False
+
+
+def pause(profile_process="worker"):
+    stop()
+
+
+def resume(profile_process="worker"):
+    start()
+
+
+def dump(finished=True, profile_process="worker"):
+    """Finalize the trace (the XPlane files under ``<filename>_trace`` are
+    the chrome://tracing analog — open with TensorBoard/perfetto)."""
+    stop()
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Aggregate per-scope wall-clock table (reference aggregate_stats)."""
+    rows = sorted(_aggregate.items(), key=lambda kv: -kv[1][1])
+    lines = ["%-40s %10s %14s" % ("Name", "Calls", "Total(ms)")]
+    for name, (calls, total) in rows:
+        lines.append("%-40s %10d %14.3f" % (name, calls, total * 1e3))
+    if reset:
+        _aggregate.clear()
+    return "\n".join(lines)
+
+
+class _Scope:
+    """Timed, trace-annotated scope."""
+
+    def __init__(self, name):
+        self._name = name
+        self._t0 = None
+        self._ann = None
+
+    def start(self):
+        import jax
+        self._t0 = time.perf_counter()
+        try:
+            self._ann = jax.profiler.TraceAnnotation(self._name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+
+    def stop(self):
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        calls, total = _aggregate.get(self._name, (0, 0.0))
+        _aggregate[self._name] = (calls + 1, total + dt)
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        self._t0 = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class Domain:
+    """Profiling domain (reference ``profiler.py:Domain``)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class Task(_Scope):
+    def __init__(self, domain, name):
+        super().__init__(f"{domain.name}::{name}")
+        self.name = name
+
+
+class Frame(_Scope):
+    def __init__(self, domain, name):
+        super().__init__(f"{domain.name}::{name}")
+        self.name = name
+
+
+class Event(_Scope):
+    def __init__(self, name):
+        super().__init__(name)
+        self.name = name
+
+
+class Counter:
+    def __init__(self, domain, name, value=None):
+        self.name = f"{domain.name}::{name}"
+        self.value = value or 0
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.name = f"{domain.name}::{name}"
+
+    def mark(self, scope="process"):
+        calls, total = _aggregate.get(self.name, (0, 0.0))
+        _aggregate[self.name] = (calls + 1, total)

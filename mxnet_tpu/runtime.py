@@ -1,0 +1,61 @@
+"""Runtime feature detection (reference ``python/mxnet/runtime.py`` over
+``src/libinfo.cc`` — compile-time feature flags surfaced at run time)."""
+from __future__ import annotations
+
+__all__ = ["Features", "Feature", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return f"{'✔' if self.enabled else '✖'} {self.name}"
+
+
+def _detect():
+    import jax
+    feats = {
+        "TPU": any(d.platform != "cpu" for d in jax.devices()),
+        "XLA": True,
+        "CUDA": False, "CUDNN": False, "NCCL": False, "TENSORRT": False,
+        "MKLDNN": False, "OPENMP": False, "BLAS_OPEN": False,
+        "DIST_KVSTORE": True,   # jax.distributed-backed dist types
+        "INT64_TENSOR_SIZE": True,
+        "F16C": False,
+        "SIGNAL_HANDLER": False,
+        "PROFILER": True,
+        "OPENCV": _has("cv2"),
+        "PALLAS": True,
+    }
+    return feats
+
+
+def _has(mod):
+    import importlib.util
+    return importlib.util.find_spec(mod) is not None
+
+
+class Features(dict):
+    """Mapping name → Feature (reference ``runtime.py:57``)."""
+
+    instance = None
+
+    def __init__(self):
+        super().__init__([(k, Feature(k, v)) for k, v in _detect().items()])
+
+    def __repr__(self):
+        return str(list(self.values()))
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError(f"Feature '{feature_name}' is unknown, "
+                               f"known features are: {list(self.keys())}")
+        return self[feature_name].enabled
+
+
+def feature_list():
+    """List of runtime features (reference ``runtime.py:68``)."""
+    return list(Features().values())

@@ -1,0 +1,92 @@
+"""Network visualization (reference ``python/mxnet/visualization.py``):
+``print_summary`` ASCII table and ``plot_network`` graphviz rendering."""
+from __future__ import annotations
+
+from .symbol import Symbol
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64,
+                                                                  .74, 1.)):
+    """Layer-by-layer summary table (reference ``visualization.py:40``)."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be Symbol")
+    arg_shapes = {}
+    if shape is not None:
+        arg_sh, _, _ = symbol.infer_shape(**shape)
+        arg_shapes = dict(zip(symbol.list_arguments(), arg_sh))
+
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(f, pos):
+        line = ""
+        for i, field in enumerate(f):
+            line += str(field)
+            line = line[:pos[i]]
+            line += " " * (pos[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(fields, positions)
+    print("=" * line_length)
+    total_params = 0
+    for node in symbol._topo():
+        if node.op is None:
+            continue
+        params = 0
+        prev = []
+        for p, _ in node.inputs:
+            if p.op is None:
+                sh = arg_shapes.get(p.name)
+                if sh and p.name != "data" and not p.name.endswith("label"):
+                    n = 1
+                    for d in sh:
+                        n *= d
+                    params += n
+            else:
+                prev.append(p.name)
+        total_params += params
+        print_row([f"{node.name} ({node.op.name})", "", params,
+                   ",".join(prev[:2])], positions)
+    print("=" * line_length)
+    print(f"Total params: {total_params}")
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Graphviz digraph of the network (reference ``visualization.py:206``);
+    requires the optional ``graphviz`` package."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("Draw network requires graphviz library")
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+    dot = Digraph(name=title, format=save_format)
+    hidden = set()
+    if hide_weights:
+        for node in symbol._topo():
+            if node.op is not None:
+                for p, _ in node.inputs:
+                    if p.op is None and (p.name.endswith("_weight") or
+                                         p.name.endswith("_bias") or
+                                         p.name.endswith("_gamma") or
+                                         p.name.endswith("_beta") or
+                                         "moving_" in p.name):
+                        hidden.add(p.name)
+    for node in symbol._topo():
+        if node.name in hidden:
+            continue
+        if node.op is None:
+            dot.node(node.name, node.name, shape="oval")
+        else:
+            dot.node(node.name, f"{node.name}\n{node.op.name}", shape="box")
+    for node in symbol._topo():
+        if node.op is None:
+            continue
+        for p, _ in node.inputs:
+            if p.name not in hidden:
+                dot.edge(p.name, node.name)
+    return dot

@@ -1,0 +1,176 @@
+"""AMP / profiler / image / test_utils / runtime / visualization tests."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+# ----------------------------------------------------------------------- AMP
+def test_amp_init_casts_matmul_inputs():
+    import jax.numpy as jnp
+    from mxnet_tpu.contrib import amp
+    try:
+        amp.init()
+        x = mx.nd.ones((4, 8))
+        w = mx.nd.ones((16, 8))
+        b = mx.nd.zeros((16,))
+        out = mx.nd.FullyConnected(x, w, b, num_hidden=16)
+        assert out.dtype == jnp.bfloat16
+        # fp32-list op keeps float32
+        s = mx.nd.softmax(mx.nd.ones((2, 3)))
+        assert s.dtype == np.float32
+    finally:
+        amp.amp.deinit()
+
+
+def test_amp_trainer_and_scale_loss():
+    from mxnet_tpu.contrib import amp
+    net = mx.gluon.nn.Dense(4, in_units=8)
+    net.initialize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    assert trainer._amp_loss_scaler is not None
+    x = mx.nd.ones((2, 8))
+    with mx.autograd.record():
+        out = net(x)
+        loss = out.sum()
+        with amp.scale_loss(loss, trainer) as scaled:
+            scaled.backward()
+    trainer.step(2)
+    assert np.isfinite(net.weight.data().asnumpy()).all()
+
+
+def test_amp_convert_model():
+    import jax.numpy as jnp
+    from mxnet_tpu.contrib import amp
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=8)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    args = {"fc1_weight": mx.nd.ones((8, 4)), "fc1_bias": mx.nd.zeros((8,))}
+    sym2, args2, _ = amp.convert_model(net, args, {})
+    assert args2["fc1_weight"].dtype == jnp.bfloat16
+
+
+# ------------------------------------------------------------------- profiler
+def test_profiler_scopes_and_dumps():
+    from mxnet_tpu import profiler
+    profiler.set_config(aggregate_stats=True)
+    d = profiler.Domain("test")
+    with d.new_task("work"):
+        _ = mx.nd.ones((4, 4)).sum().asscalar()
+    marker = d.new_marker("tick")
+    marker.mark()
+    table = profiler.dumps()
+    assert "test::work" in table
+    c = d.new_counter("n", 5)
+    c += 3
+    assert c.value == 8
+
+
+# -------------------------------------------------------------------- image
+def test_image_roundtrip(tmp_path):
+    import cv2
+    img = (np.random.rand(40, 32, 3) * 255).astype(np.uint8)
+    path = str(tmp_path / "x.png")
+    cv2.imwrite(path, img[:, :, ::-1])
+    loaded = mx.image.imread(path)
+    np.testing.assert_array_equal(loaded.asnumpy(), img)
+    resized = mx.image.imresize(loaded, 16, 20)
+    assert resized.shape == (20, 16, 3)
+    short = mx.image.resize_short(loaded, 24)
+    assert min(short.shape[:2]) == 24
+
+
+def test_image_augmenters():
+    src = mx.nd.array((np.random.rand(50, 40, 3) * 255).astype("float32"))
+    out, rect = mx.image.random_crop(src, (24, 20))
+    assert out.shape == (20, 24, 3)
+    out, _ = mx.image.center_crop(src, (24, 20))
+    assert out.shape == (20, 24, 3)
+    augs = mx.image.CreateAugmenter((3, 24, 24), rand_crop=True,
+                                    rand_mirror=True, mean=True, std=True,
+                                    brightness=0.1)
+    for a in augs:
+        src = a(src)
+    assert src.shape == (24, 24, 3)
+
+
+def test_image_iter_from_rec(tmp_path):
+    from mxnet_tpu import recordio
+    fidx, frec = str(tmp_path / "d.idx"), str(tmp_path / "d.rec")
+    w = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = (rng.rand(36, 36, 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(recordio.IRHeader(0, float(i), i, 0),
+                                         img, img_fmt=".png"))
+    w.close()
+    it = mx.image.ImageIter(4, (3, 32, 32), path_imgrec=frec,
+                            path_imgidx=fidx, rand_crop=True)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 32, 32)
+
+
+# ---------------------------------------------------------------- test_utils
+def test_assert_almost_equal():
+    from mxnet_tpu import test_utils as tu
+    tu.assert_almost_equal(np.ones(3), np.ones(3))
+    with pytest.raises(AssertionError):
+        tu.assert_almost_equal(np.ones(3), np.ones(3) * 2)
+
+
+def test_check_numeric_gradient():
+    from mxnet_tpu import test_utils as tu
+    data = mx.sym.Variable("data")
+    out = mx.sym.sum(data * data)
+    loc = {"data": np.random.randn(3, 2).astype("float32")}
+    tu.check_numeric_gradient(out, loc, rtol=0.05, atol=1e-2)
+
+
+def test_check_symbolic_forward_backward():
+    from mxnet_tpu import test_utils as tu
+    lhs = mx.sym.Variable("lhs")
+    rhs = mx.sym.Variable("rhs")
+    out = lhs + rhs
+    a, b = np.random.rand(3, 3), np.random.rand(3, 3)
+    tu.check_symbolic_forward(out, {"lhs": a, "rhs": b}, [a + b])
+    tu.check_symbolic_backward(out, {"lhs": a, "rhs": b},
+                               [np.ones((3, 3))],
+                               {"lhs": np.ones((3, 3)),
+                                "rhs": np.ones((3, 3))})
+
+
+def test_check_consistency():
+    from mxnet_tpu import test_utils as tu
+    sym = mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc",
+                                num_hidden=4)
+    tu.check_consistency(sym, [{"ctx": mx.cpu(), "data": (5, 3)},
+                               {"ctx": mx.cpu(), "data": (5, 3)}])
+
+
+# ------------------------------------------------------- runtime / attribute
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("XLA")
+    assert not feats.is_enabled("CUDA")
+    with pytest.raises(RuntimeError):
+        feats.is_enabled("NOPE")
+    assert len(mx.runtime.feature_list()) > 5
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        attrs = mx.attribute.current().get({"x": "y"})
+    assert attrs == {"ctx_group": "dev1", "x": "y"}
+    assert mx.attribute.current().get(None) == {}
+
+
+def test_print_summary(capsys):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    total = mx.viz.print_summary(net, shape={"data": (1, 8)})
+    out = capsys.readouterr().out
+    assert "fc1" in out
+    assert total == 16 * 8 + 16
