@@ -24,4 +24,8 @@ random = _ns.random
 linalg = _ns.linalg
 contrib = _ns.contrib
 image = _ns.image
-sparse = _ns.sparse
+
+from . import sparse  # noqa: E402, F401
+from .sparse import (  # noqa: F401
+    BaseSparseNDArray, CSRNDArray, RowSparseNDArray,
+)

@@ -1,0 +1,144 @@
+"""Control-flow operators (reference ``src/operator/control_flow.cc:1089-1255``
+``_foreach``/``_while_loop``/``_cond`` + the Python wrappers in
+``python/mxnet/ndarray/contrib.py``).
+
+TPU-native mapping (SURVEY.md §7 translation table): ``foreach`` compiles to
+one ``lax.scan`` recorded on the autograd tape as a single composite op (the
+reference registers the whole loop as one stateful op for exactly the same
+reason); ``while_loop`` runs the Python loop eagerly — data-dependent
+iteration counts are the one thing a shape-specialized compiler cannot trace,
+so inside ``jit`` use ``max_iterations``-padded ``foreach`` instead;
+``cond`` evaluates the predicate eagerly and runs one branch.
+"""
+from __future__ import annotations
+
+from . import ndarray as nd_core
+from .ndarray import NDArray
+
+__all__ = ["foreach", "while_loop", "cond", "isfinite", "isnan", "isinf"]
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def foreach(body, data, init_states):
+    """Scan ``body`` over the leading axis of ``data`` (reference
+    ``contrib.py:foreach``): ``body(data_t, states) -> (out_t, new_states)``.
+    Compiled to ``lax.scan`` — grads flow through the whole loop as one op.
+    """
+    import jax
+    from jax import lax
+    from .. import autograd as _ag
+
+    data_list = _as_list(data)
+    state_list = _as_list(init_states)
+    n_data = len(data_list)
+    data_is_list = isinstance(data, (list, tuple))
+    states_are_list = isinstance(init_states, (list, tuple))
+    out_struct = {}
+
+    def pure(*raw):
+        xs = list(raw[:n_data])
+        ss = list(raw[n_data:])
+
+        def step(carry, x_t):
+            with _ag.pause():
+                xs_nd = [nd_core._wrap(x) for x in
+                         (x_t if isinstance(x_t, tuple) else (x_t,))]
+                ss_nd = [nd_core._wrap(s) for s in carry]
+                out, new_states = body(
+                    xs_nd if data_is_list else xs_nd[0],
+                    ss_nd if states_are_list else ss_nd[0])
+                out_l = _as_list(out)
+                ns_l = _as_list(new_states)
+                out_struct["n_out"] = len(out_l)
+                out_struct["out_is_list"] = isinstance(out, (list, tuple))
+            return tuple(s._data for s in ns_l), \
+                tuple(o._data for o in out_l)
+
+        carry, ys = lax.scan(step, tuple(ss), tuple(xs) if n_data > 1
+                             else xs[0])
+        return tuple(ys) + tuple(carry)
+
+    raws = data_list + state_list
+    outs = nd_core.invoke_fn(pure, raws)
+    if not isinstance(outs, list):
+        outs = [outs]
+    n_out = out_struct["n_out"]
+    out_arrays = outs[:n_out]
+    final_states = outs[n_out:]
+    out = out_arrays if out_struct["out_is_list"] else out_arrays[0]
+    states = final_states if states_are_list else final_states[0]
+    return out, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Run ``func`` while ``cond`` holds (reference ``contrib.py:while_loop``):
+    returns (stacked step outputs padded to ``max_iterations``, final
+    loop_vars).  Eager-only — the step count is data-dependent."""
+    from .. import ndarray as nd
+
+    if max_iterations is None:
+        raise ValueError("max_iterations must be specified")
+    import jax
+
+    loop_vars = _as_list(loop_vars)
+    if any(isinstance(v._data, jax.core.Tracer) for v in loop_vars):
+        raise NotImplementedError(
+            "while_loop with traced inputs: use foreach/max_iterations "
+            "padding inside jit (XLA requires static shapes)")
+    steps = 0
+    outputs = []
+    out_fmt = None
+    while steps < max_iterations and \
+            bool(cond(*loop_vars).asscalar()):
+        step_out, loop_vars = func(*loop_vars)
+        step_out = _as_list(step_out)
+        out_fmt = len(step_out)
+        outputs.append(step_out)
+        loop_vars = _as_list(loop_vars)
+        steps += 1
+    if outputs:
+        stacked = []
+        for i in range(out_fmt):
+            arrs = [o[i] for o in outputs]
+            s = nd.stack(*arrs, axis=0)
+            if steps < max_iterations:
+                pad_shape = (max_iterations - steps,) + tuple(s.shape[1:])
+                s = nd.concat(s, nd.zeros(pad_shape, dtype=s.dtype,
+                                          ctx=s.context), dim=0)
+            stacked.append(s)
+        out = stacked if out_fmt > 1 else stacked[0]
+    else:
+        out = None
+    return out, loop_vars
+
+
+def cond(pred, then_func, else_func):
+    """Run one branch by predicate (reference ``contrib.py:cond``); the
+    predicate is evaluated eagerly (a sync point, like the reference's
+    ``_cond`` op evaluating its scalar input)."""
+    p = pred() if callable(pred) else pred
+    if isinstance(p, NDArray):
+        p = bool(p.asscalar())
+    return then_func() if p else else_func()
+
+
+def isfinite(data):
+    """Reference ``contrib.isfinite``."""
+    import jax.numpy as jnp
+    return nd_core.invoke_fn(lambda x: jnp.isfinite(x).astype(jnp.float32),
+                             [data])
+
+
+def isnan(data):
+    import jax.numpy as jnp
+    return nd_core.invoke_fn(lambda x: jnp.isnan(x).astype(jnp.float32),
+                             [data])
+
+
+def isinf(data):
+    import jax.numpy as jnp
+    return nd_core.invoke_fn(lambda x: jnp.isinf(x).astype(jnp.float32),
+                             [data])

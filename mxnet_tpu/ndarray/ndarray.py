@@ -66,7 +66,13 @@ class NDArray:
 
     @property
     def context(self):
-        devs = list(self._data.devices())
+        try:
+            devs = list(self._data.devices())
+        except jax.errors.ConcretizationTypeError:
+            # traced value (inside jit/scan): placement is the compiler's,
+            # report the ambient default context
+            from ..context import current_context
+            return current_context()
         return context_from_jax_device(devs[0])
 
     ctx = context
@@ -167,11 +173,13 @@ class NDArray:
         return jax.dlpack.to_dlpack(self._data)
 
     def tostype(self, stype):
-        if stype != "default":
-            raise NotImplementedError(
-                "sparse storage types are represented as dense on TPU; see "
-                "mxnet_tpu.ndarray.sparse for the compatibility layer")
-        return self
+        if stype == "default":
+            return self
+        from .sparse import CSRNDArray, RowSparseNDArray
+        cls = {"csr": CSRNDArray, "row_sparse": RowSparseNDArray}.get(stype)
+        if cls is None:
+            raise ValueError(f"unknown storage type {stype!r}")
+        return cls(self._data)
 
     # --------------------------------------------------------------- autograd
     def attach_grad(self, grad_req="write", stype=None):

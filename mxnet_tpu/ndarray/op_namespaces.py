@@ -37,17 +37,12 @@ linalg = _facade("linalg", ("_linalg_",))
 contrib = _facade("contrib", ("_contrib_",))
 image = _facade("image", ("_image_",))
 
-sparse = types.ModuleType("mxnet_tpu.ndarray.sparse")
-sparse.__doc__ = (
-    "Sparse storage compatibility layer. XLA/TPU has no native sparse tensor "
-    "support; row_sparse and csr arrays are represented densely (the "
-    "reference's own dense-fallback mechanism, "
-    "src/executor/attach_op_execs_pass.cc:46, is the precedent). "
-    "See SURVEY.md §7 hard-part 4.")
+from . import contrib_ctrl as _ctrl  # noqa: E402
 
+contrib.foreach = _ctrl.foreach
+contrib.while_loop = _ctrl.while_loop
+contrib.cond = _ctrl.cond
+contrib.isfinite = _ctrl.isfinite
+contrib.isnan = _ctrl.isnan
+contrib.isinf = _ctrl.isinf
 
-def _todense(x):
-    return x
-
-
-sparse.todense = _todense

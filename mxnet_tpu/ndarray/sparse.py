@@ -1,0 +1,192 @@
+"""Sparse NDArray compatibility layer (reference
+``python/mxnet/ndarray/sparse.py`` — ``CSRNDArray``/``RowSparseNDArray``).
+
+TPU-native policy (SURVEY.md §7 hard-part 4): XLA has no native sparse
+tensors, so sparse arrays are **densely backed** — the compressed views
+(``data``/``indices``/``indptr``) are derived on demand, construction from
+compressed buffers scatters into dense HBM, and every operator works because
+the payload is an ordinary dense array.  This is the reference's own
+dense-fallback mechanism (``src/executor/attach_op_execs_pass.cc:46``)
+promoted to the *only* path; true O(nnz) compute (embedding-style workloads)
+should use ``take``/gather ops which are TPU-native.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .ndarray import NDArray, _as_nd, _to_jax_device, zeros as _dense_zeros
+
+__all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "csr_matrix", "row_sparse_array", "array", "zeros", "empty",
+           "todense", "dot"]
+
+
+class BaseSparseNDArray(NDArray):
+    _storage_type = "default"
+
+    def __init__(self, data):
+        super().__init__(data if not isinstance(data, NDArray) else data._data)
+
+    @property
+    def stype(self):
+        return self._storage_type
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data)
+        if stype == self._storage_type:
+            return self
+        cls = {"csr": CSRNDArray, "row_sparse": RowSparseNDArray}[stype]
+        return cls(self._data)
+
+    def asscipy(self):
+        import scipy.sparse as sp
+        if self._storage_type == "csr":
+            return sp.csr_matrix(self.asnumpy())
+        raise ValueError("asscipy is only supported for csr")
+
+    def __repr__(self):
+        return f"\n{self.asnumpy()}\n<{type(self).__name__} " \
+               f"{ 'x'.join(str(d) for d in self.shape)} @{self.context}>"
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """CSR matrix view over a dense payload (reference ``sparse.py:86``)."""
+
+    _storage_type = "csr"
+
+    @property
+    def data(self):
+        arr = self.asnumpy()
+        return _as_nd(arr[arr != 0])
+
+    @property
+    def indices(self):
+        arr = self.asnumpy()
+        return _as_nd(_np.nonzero(arr)[1].astype(_np.int64))
+
+    @property
+    def indptr(self):
+        arr = self.asnumpy()
+        counts = (arr != 0).sum(axis=1)
+        return _as_nd(_np.concatenate([[0], _np.cumsum(counts)])
+                      .astype(_np.int64))
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse view over a dense payload (reference ``sparse.py:560``)."""
+
+    _storage_type = "row_sparse"
+
+    @property
+    def data(self):
+        arr = self.asnumpy()
+        rows = _np.nonzero((arr != 0).reshape(arr.shape[0], -1).any(axis=1))[0]
+        return _as_nd(arr[rows])
+
+    @property
+    def indices(self):
+        arr = self.asnumpy()
+        rows = _np.nonzero((arr != 0).reshape(arr.shape[0], -1).any(axis=1))[0]
+        return _as_nd(rows.astype(_np.int64))
+
+    def retain(self, rows):
+        """Keep only the requested rows (reference ``sparse.retain``)."""
+        import jax.numpy as jnp
+        rows = rows.asnumpy().astype(_np.int64) if isinstance(rows, NDArray) \
+            else _np.asarray(rows, dtype=_np.int64)
+        mask = _np.zeros(self.shape[0], dtype=bool)
+        mask[rows] = True
+        out = jnp.where(jnp.asarray(mask).reshape((-1,) + (1,) *
+                                                  (len(self.shape) - 1)),
+                        self._data, 0)
+        return RowSparseNDArray(out)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray (reference ``sparse.py:csr_matrix``): from a dense
+    array, a scipy matrix, or a ``(data, indices, indptr)`` tuple."""
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = _np.asarray(data.asnumpy() if isinstance(data, NDArray)
+                           else data, dtype=dtype or _np.float32).ravel()
+        indices = _np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                              else indices, dtype=_np.int64).ravel()
+        indptr = _np.asarray(indptr.asnumpy() if isinstance(indptr, NDArray)
+                             else indptr, dtype=_np.int64).ravel()
+        assert shape is not None, "shape is required for (data,indices,indptr)"
+        dense = _np.zeros(shape, dtype=data.dtype)
+        for row in range(shape[0]):
+            for k in range(indptr[row], indptr[row + 1]):
+                dense[row, indices[k]] = data[k]
+    elif hasattr(arg1, "tocsr"):  # scipy sparse
+        dense = _np.asarray(arg1.todense(), dtype=dtype or _np.float32)
+    else:
+        dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
+                            else arg1, dtype=dtype or _np.float32)
+        if shape is not None:
+            dense = dense.reshape(shape)
+    return CSRNDArray(jax.device_put(jnp.asarray(dense),
+                                     _to_jax_device(ctx)))
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray (reference ``sparse.py:row_sparse_array``):
+    from a dense array or ``(data, indices)``."""
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(arg1, tuple) and len(arg1) == 2 and not _np.isscalar(arg1[0]):
+        data, indices = arg1
+        data = _np.asarray(data.asnumpy() if isinstance(data, NDArray)
+                           else data, dtype=dtype or _np.float32)
+        indices = _np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                              else indices, dtype=_np.int64).ravel()
+        assert shape is not None, "shape is required for (data, indices)"
+        dense = _np.zeros(shape, dtype=data.dtype)
+        dense[indices] = data
+    else:
+        dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
+                            else arg1, dtype=dtype or _np.float32)
+        if shape is not None:
+            dense = dense.reshape(shape)
+    return RowSparseNDArray(jax.device_put(jnp.asarray(dense),
+                                           _to_jax_device(ctx)))
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    base = _dense_zeros(shape, ctx=ctx, dtype=dtype)
+    if stype == "default":
+        return base
+    cls = {"csr": CSRNDArray, "row_sparse": RowSparseNDArray}[stype]
+    return cls(base._data)
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Sparse-aware array(): preserves the source's storage type."""
+    if isinstance(source_array, BaseSparseNDArray):
+        cls = type(source_array)
+        return cls(source_array._data)
+    if hasattr(source_array, "tocsr"):
+        return csr_matrix(source_array, ctx=ctx, dtype=dtype)
+    from .ndarray import array as _dense_array
+    return _dense_array(source_array, ctx=ctx, dtype=dtype)
+
+
+def todense(x):
+    return NDArray(x._data) if isinstance(x, NDArray) else _as_nd(x)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """sparse.dot — dense matmul underneath (reference dispatches to the
+    sparse dot kernels, ``src/operator/tensor/dot-inl.h``)."""
+    from . import dot as _dense_dot
+    return _dense_dot(lhs, rhs, transpose_a=transpose_a,
+                      transpose_b=transpose_b)

@@ -1,0 +1,91 @@
+"""Control flow tests (reference
+``tests/python/unittest/test_contrib_control_flow.py``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_foreach_cumsum():
+    def step(data, states):
+        out = data + states[0]
+        return out, [out]
+
+    data = mx.nd.array(np.arange(5, dtype="float32"))
+    out, states = mx.nd.contrib.foreach(step, data, [mx.nd.array(0.0)])
+    np.testing.assert_allclose(out.asnumpy(), np.cumsum(np.arange(5)))
+    assert float(states[0].asscalar()) == 10.0
+
+
+def test_foreach_multi_data_and_grad():
+    def step(data, states):
+        x, y = data
+        s = states[0]
+        new_s = s + x * y
+        return new_s, [new_s]
+
+    x = mx.nd.array(np.arange(4, dtype="float32").reshape(4, 1))
+    y = mx.nd.array(np.ones((4, 1), dtype="float32") * 2)
+    s0 = mx.nd.zeros((1,))
+    x.attach_grad()
+    with mx.autograd.record():
+        out, states = mx.nd.contrib.foreach(step, [x, y], [s0])
+        loss = states[0].sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full((4, 1), 2.0))
+
+
+def test_foreach_rnn_like():
+    """The canonical use: scan an RNN cell (reference test_foreach)."""
+    cell = mx.gluon.rnn.RNNCell(8, input_size=4, prefix="c_")
+    cell.initialize()
+
+    def step(data, states):
+        return cell(data, states)
+
+    x = mx.nd.random.uniform(shape=(6, 2, 4))  # TNC
+    h0 = mx.nd.zeros((2, 8))
+    out, states = mx.nd.contrib.foreach(step, x, [h0])
+    assert out.shape == (6, 2, 8)
+    assert states[0].shape == (2, 8)
+    # agrees with explicit unroll
+    outs2, states2 = cell.unroll(6, x, begin_state=[h0], layout="TNC",
+                                 merge_outputs=True)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.swapaxes(outs2.asnumpy(), 0, 1)
+                               if outs2.shape[0] == 2 else outs2.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_while_loop():
+    def cond(i, s):
+        return i < 5
+
+    def func(i, s):
+        return (i,), (i + 1, s + i)
+
+    out, (i_f, s_f) = mx.nd.contrib.while_loop(
+        cond, func, [mx.nd.array([0.0]), mx.nd.array([0.0])],
+        max_iterations=10)
+    assert float(i_f.asscalar()) == 5
+    assert float(s_f.asscalar()) == 10  # 0+1+2+3+4
+    assert out.shape[0] == 10  # padded to max_iterations
+
+
+def test_cond():
+    x = mx.nd.array([3.0])
+    out = mx.nd.contrib.cond(x.sum() > 2,
+                             lambda: x * 2,
+                             lambda: x - 1)
+    assert float(out.asscalar()) == 6.0
+    out = mx.nd.contrib.cond(x.sum() > 5,
+                             lambda: x * 2,
+                             lambda: x - 1)
+    assert float(out.asscalar()) == 2.0
+
+
+def test_isfinite_isnan():
+    x = mx.nd.array([1.0, float("inf"), float("nan")])
+    np.testing.assert_allclose(mx.nd.contrib.isfinite(x).asnumpy(), [1, 0, 0])
+    np.testing.assert_allclose(mx.nd.contrib.isnan(x).asnumpy(), [0, 0, 1])
+    np.testing.assert_allclose(mx.nd.contrib.isinf(x).asnumpy(), [0, 1, 0])

@@ -1,0 +1,84 @@
+"""Sparse NDArray compat tests (reference
+``tests/python/unittest/test_sparse_ndarray.py`` — dense-backed on TPU)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+
+
+def test_csr_creation_from_dense():
+    dense = np.array([[0, 1, 0], [2, 0, 3]], dtype="float32")
+    a = sparse.csr_matrix(dense)
+    assert a.stype == "csr"
+    np.testing.assert_array_equal(a.asnumpy(), dense)
+    np.testing.assert_array_equal(a.data.asnumpy(), [1, 2, 3])
+    np.testing.assert_array_equal(a.indices.asnumpy(), [1, 0, 2])
+    np.testing.assert_array_equal(a.indptr.asnumpy(), [0, 1, 3])
+
+
+def test_csr_creation_from_buffers():
+    a = sparse.csr_matrix((np.array([1., 2., 3.]), np.array([1, 0, 2]),
+                           np.array([0, 1, 3])), shape=(2, 3))
+    np.testing.assert_array_equal(a.asnumpy(),
+                                  [[0, 1, 0], [2, 0, 3]])
+
+
+def test_csr_scipy_roundtrip():
+    import scipy.sparse as sp
+    m = sp.random(5, 4, density=0.4, format="csr", dtype=np.float32,
+                  random_state=0)
+    a = sparse.csr_matrix(m)
+    np.testing.assert_allclose(a.asnumpy(), m.todense())
+    back = a.asscipy()
+    np.testing.assert_allclose(np.asarray(back.todense()),
+                               np.asarray(m.todense()))
+
+
+def test_row_sparse():
+    data = np.array([[1, 2], [3, 4]], dtype="float32")
+    a = sparse.row_sparse_array((data, [1, 3]), shape=(4, 2))
+    assert a.stype == "row_sparse"
+    np.testing.assert_array_equal(a.indices.asnumpy(), [1, 3])
+    np.testing.assert_array_equal(a.data.asnumpy(), data)
+    assert a.asnumpy()[0].sum() == 0
+    kept = a.retain(mx.nd.array([1]))
+    assert kept.asnumpy()[3].sum() == 0
+    np.testing.assert_array_equal(kept.asnumpy()[1], [1, 2])
+
+
+def test_tostype_roundtrip():
+    x = mx.nd.array([[0, 1], [2, 0]])
+    c = x.tostype("csr")
+    assert c.stype == "csr"
+    d = c.tostype("default")
+    assert d.stype == "default"
+    np.testing.assert_array_equal(d.asnumpy(), x.asnumpy())
+    r = x.tostype("row_sparse")
+    assert r.stype == "row_sparse"
+
+
+def test_sparse_ops_dense_backed():
+    """Sparse arrays flow through ordinary operators."""
+    a = sparse.csr_matrix(np.array([[0, 1], [2, 0]], dtype="float32"))
+    b = mx.nd.ones((2, 2))
+    out = sparse.dot(a, b)
+    np.testing.assert_array_equal(out.asnumpy(), [[1, 1], [2, 2]])
+    s = (a + a).asnumpy()
+    np.testing.assert_array_equal(s, [[0, 2], [4, 0]])
+
+
+def test_sparse_zeros_and_array():
+    z = sparse.zeros("row_sparse", (3, 2))
+    assert z.stype == "row_sparse" and z.asnumpy().sum() == 0
+    z2 = sparse.zeros("default", (3, 2))
+    assert z2.stype == "default"
+    a = sparse.array(z)
+    assert a.stype == "row_sparse"
+
+
+def test_rand_ndarray_sparse():
+    from mxnet_tpu import test_utils as tu
+    arr = tu.rand_ndarray((20, 10), stype="row_sparse", density=0.3)
+    frac = (arr.asnumpy() != 0).mean()
+    assert 0.05 < frac < 0.6
