@@ -1,0 +1,133 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference keeps its IO/runtime layer in C++ behind a flat C ABI
+(``include/mxnet/c_api.h``); this package does the same for the TPU-native
+rebuild — ``src/io/recordio_reader.cc`` is the first component (RecordIO
+framing scan + batched reads, the role of dmlc-core recordio + the chunk
+readers in ``src/io/iter_image_recordio_2.cc``).  The library is compiled on
+first use with the in-image toolchain (g++; CMakeLists provided for
+production builds) and cached next to this file; every entry point has a
+pure-Python fallback so the framework works without a compiler.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "..", "..", "src", "io", "recordio_reader.cc")
+_LIB_PATH = os.path.join(_DIR, "libmxnet_tpu_io.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+           os.path.abspath(_SRC), "-o", _LIB_PATH]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load():
+    """The ctypes library, building it on first call; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_LIB_PATH) or \
+                    os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.rio_build_index.restype = ctypes.c_int64
+            lib.rio_build_index.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64))]
+            lib.rio_free.argtypes = [ctypes.c_void_p]
+            lib.rio_read_record.restype = ctypes.c_int64
+            lib.rio_read_record.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64]
+            lib.rio_read_batch.restype = ctypes.c_int64
+            lib.rio_read_batch.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)]
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def available():
+    return load() is not None
+
+
+def build_index(path):
+    """Scan a .rec file → (offsets, lengths) uint64 arrays, or None if the
+    native library is unavailable (caller falls back to Python scanning)."""
+    lib = load()
+    if lib is None:
+        return None
+    off = ctypes.POINTER(ctypes.c_uint64)()
+    lens = ctypes.POINTER(ctypes.c_uint64)()
+    n = lib.rio_build_index(path.encode(), ctypes.byref(off),
+                            ctypes.byref(lens))
+    if n < 0:
+        raise IOError(f"native recordio scan failed on {path} (code {n})")
+    try:
+        offsets = np.ctypeslib.as_array(off, shape=(n,)).copy()
+        lengths = np.ctypeslib.as_array(lens, shape=(n,)).copy()
+    finally:
+        lib.rio_free(off)
+        lib.rio_free(lens)
+    return offsets, lengths
+
+
+def read_record(path, offset, length_hint):
+    """Read one logical record at ``offset`` → bytes."""
+    lib = load()
+    if lib is None:
+        return None
+    cap = max(int(length_hint), 4096)
+    buf = (ctypes.c_uint8 * cap)()
+    n = lib.rio_read_record(path.encode(), int(offset), buf, cap)
+    if n == -4:  # capacity underestimate (multipart longer than hint)
+        cap *= 8
+        buf = (ctypes.c_uint8 * cap)()
+        n = lib.rio_read_record(path.encode(), int(offset), buf, cap)
+    if n < 0:
+        raise IOError(f"native recordio read failed (code {n})")
+    return bytes(bytearray(buf[:n]))
+
+
+def read_batch(path, offsets, lengths):
+    """Read many records in one native call → list[bytes]."""
+    lib = load()
+    if lib is None:
+        return None
+    offsets = np.ascontiguousarray(offsets, dtype=np.uint64)
+    total = int(np.asarray(lengths, dtype=np.uint64).sum())
+    out = np.empty(total, dtype=np.uint8)
+    out_lens = np.zeros(len(offsets), dtype=np.uint64)
+    n = lib.rio_read_batch(
+        path.encode(),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(offsets),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        total,
+        out_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+    if n < 0:
+        raise IOError(f"native recordio batch read failed (code {n})")
+    recs = []
+    pos = 0
+    for ln in out_lens:
+        ln = int(ln)
+        recs.append(out[pos:pos + ln].tobytes())
+        pos += ln
+    return recs

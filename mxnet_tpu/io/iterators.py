@@ -233,23 +233,35 @@ class ImageRecordIter(DataIter):
         self._round_batch = bool(round_batch)
         self._threads = int(preprocess_threads)
 
+        self._path_imgrec = path_imgrec
         if path_imgidx and os.path.isfile(path_imgidx):
             self._rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
             keys = list(self._rec.keys)
         else:
-            # no index: scan once to collect record offsets
+            # no index: scan once to collect record offsets — native C++
+            # scanner when available (src/io/recordio_reader.cc), Python
+            # framing walk otherwise
             self._rec = recordio.MXRecordIO(path_imgrec, "r")
             keys = None
         if keys is None:
-            offsets = []
-            f = self._rec.record
-            while True:
-                pos = f.tell()
-                if self._rec.read() is None:
-                    break
-                offsets.append(pos)
-            self._offsets = offsets
-            self._keys = list(range(len(offsets)))
+            from .. import _native
+            scanned = _native.build_index(path_imgrec) \
+                if _native.available() else None
+            if scanned is not None:
+                offsets, lengths = scanned
+                self._offsets = offsets.tolist()
+                self._lengths = lengths.tolist()
+            else:
+                offsets = []
+                f = self._rec.record
+                while True:
+                    pos = f.tell()
+                    if self._rec.read() is None:
+                        break
+                    offsets.append(pos)
+                self._offsets = offsets
+                self._lengths = None
+            self._keys = list(range(len(self._offsets)))
             self._indexed = False
         else:
             if num_parts > 1:
@@ -296,6 +308,18 @@ class ImageRecordIter(DataIter):
         self._rec.record.seek(self._offsets[i])
         return self._rec.read()
 
+    def _read_many(self, sel):
+        """Batched record reads — one native call when the C++ reader is
+        available and lengths are known; sequential Python IO otherwise."""
+        if not self._indexed and getattr(self, "_lengths", None) is not None:
+            from .. import _native
+            if _native.available():
+                return _native.read_batch(
+                    self._path_imgrec,
+                    [self._offsets[i] for i in sel],
+                    [self._lengths[i] for i in sel])
+        return [self._read_raw(i) for i in sel]
+
     def _decode_one(self, raw, mirror_flip, crop_xy):
         import cv2
         from .. import recordio
@@ -340,7 +364,7 @@ class ImageRecordIter(DataIter):
         else:
             pad = end - self.num_data
             sel = np.concatenate([self._order[start:], self._order[:pad]])
-        raws = [self._read_raw(i) for i in sel]  # file IO is sequential
+        raws = self._read_many(sel)
         flips = self._rng.rand(len(sel)) < 0.5 if self._rand_mirror \
             else np.zeros(len(sel), dtype=bool)
         crops = self._rng.rand(len(sel), 2)

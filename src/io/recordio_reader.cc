@@ -1,0 +1,167 @@
+// Native RecordIO scanner/reader.
+//
+// TPU-native rebuild of the reference's C++ IO layer role (reference
+// src/io/iter_image_recordio_2.cc reads RecordIO in chunks on dedicated
+// threads; dmlc-core recordio.h defines the framing).  The framing protocol:
+//   u32 magic = 0xced7230a
+//   u32 lrec  = (cflag << 29) | payload_len      cflag: 0 whole record,
+//   payload, zero-pad to 4-byte boundary                1 start, 2 middle,
+//                                                       3 end of multipart
+// Exposed as a flat C ABI consumed from Python via ctypes
+// (mxnet_tpu/_native/__init__.py) — the same boundary style as the
+// reference's include/mxnet/c_api.h, without the ring of ~400 entry points.
+//
+// Build: cc/build.py (g++ -O2 -shared -fPIC) or the CMakeLists next to it.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+struct Rec {
+  uint64_t offset;   // byte offset of the record's first frame header
+  uint64_t length;   // total payload length (multipart merged)
+};
+
+// Scan the full file, returning one entry per *logical* record.
+int ScanFile(const char* path, std::vector<Rec>* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  uint64_t pos = 0;
+  uint32_t hdr[2];
+  Rec cur{0, 0};
+  bool in_multi = false;
+  while (std::fread(hdr, sizeof(uint32_t), 2, f) == 2) {
+    if (hdr[0] != kMagic) {
+      std::fclose(f);
+      return -2;  // corrupt framing
+    }
+    const uint32_t cflag = hdr[1] >> 29;
+    const uint64_t len = hdr[1] & kLenMask;
+    const uint64_t padded = (len + 3u) & ~uint64_t(3);
+    switch (cflag) {
+      case 0:
+        out->push_back({pos, len});
+        break;
+      case 1:
+        cur = {pos, len};
+        in_multi = true;
+        break;
+      case 2:
+        if (!in_multi) { std::fclose(f); return -2; }
+        cur.length += len;
+        break;
+      case 3:
+        if (!in_multi) { std::fclose(f); return -2; }
+        cur.length += len;
+        out->push_back(cur);
+        in_multi = false;
+        break;
+    }
+    if (std::fseek(f, static_cast<long>(padded), SEEK_CUR) != 0) break;
+    pos += 8 + padded;
+  }
+  std::fclose(f);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Build an offset index. Returns record count (>=0) or a negative errno-like
+// code. *offsets / *lengths are malloc'd; free with rio_free.
+int64_t rio_build_index(const char* path, uint64_t** offsets,
+                        uint64_t** lengths) {
+  std::vector<Rec> recs;
+  const int rc = ScanFile(path, &recs);
+  if (rc != 0) return rc;
+  const size_t n = recs.size();
+  *offsets = static_cast<uint64_t*>(std::malloc(n * sizeof(uint64_t)));
+  *lengths = static_cast<uint64_t*>(std::malloc(n * sizeof(uint64_t)));
+  if (!*offsets || !*lengths) return -3;
+  for (size_t i = 0; i < n; ++i) {
+    (*offsets)[i] = recs[i].offset;
+    (*lengths)[i] = recs[i].length;
+  }
+  return static_cast<int64_t>(n);
+}
+
+void rio_free(void* p) { std::free(p); }
+
+// Read one logical record starting at `offset` into `out` (capacity
+// `out_cap`). Returns payload bytes written, or negative on error.
+int64_t rio_read_record(const char* path, uint64_t offset, uint8_t* out,
+                        uint64_t out_cap) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  if (std::fseek(f, static_cast<long>(offset), SEEK_SET) != 0) {
+    std::fclose(f);
+    return -1;
+  }
+  uint64_t written = 0;
+  uint32_t hdr[2];
+  bool more = true;
+  while (more && std::fread(hdr, sizeof(uint32_t), 2, f) == 2) {
+    if (hdr[0] != kMagic) { std::fclose(f); return -2; }
+    const uint32_t cflag = hdr[1] >> 29;
+    const uint64_t len = hdr[1] & kLenMask;
+    if (written + len > out_cap) { std::fclose(f); return -4; }
+    if (std::fread(out + written, 1, len, f) != len) {
+      std::fclose(f);
+      return -2;
+    }
+    written += len;
+    const uint64_t pad = (4 - (len & 3)) & 3;
+    if (pad) std::fseek(f, static_cast<long>(pad), SEEK_CUR);
+    more = (cflag == 1 || cflag == 2);
+  }
+  std::fclose(f);
+  return static_cast<int64_t>(written);
+}
+
+// Batched read: n records into one contiguous buffer laid out back-to-back;
+// out_lengths[i] receives each record's payload size. One file handle, in
+// caller-supplied offset order (sort ascending for sequential IO).
+int64_t rio_read_batch(const char* path, const uint64_t* offsets, int64_t n,
+                       uint8_t* out, uint64_t out_cap,
+                       uint64_t* out_lengths) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  uint64_t written = 0;
+  uint32_t hdr[2];
+  for (int64_t i = 0; i < n; ++i) {
+    if (std::fseek(f, static_cast<long>(offsets[i]), SEEK_SET) != 0) {
+      std::fclose(f);
+      return -1;
+    }
+    uint64_t rec_len = 0;
+    bool more = true;
+    while (more && std::fread(hdr, sizeof(uint32_t), 2, f) == 2) {
+      if (hdr[0] != kMagic) { std::fclose(f); return -2; }
+      const uint32_t cflag = hdr[1] >> 29;
+      const uint64_t len = hdr[1] & kLenMask;
+      if (written + len > out_cap) { std::fclose(f); return -4; }
+      if (std::fread(out + written, 1, len, f) != len) {
+        std::fclose(f);
+        return -2;
+      }
+      written += len;
+      rec_len += len;
+      const uint64_t pad = (4 - (len & 3)) & 3;
+      if (pad) std::fseek(f, static_cast<long>(pad), SEEK_CUR);
+      more = (cflag == 1 || cflag == 2);
+    }
+    out_lengths[i] = rec_len;
+  }
+  std::fclose(f);
+  return static_cast<int64_t>(written);
+}
+
+}  // extern "C"
