@@ -1,0 +1,6 @@
+"""Model families beyond the vision zoo (BASELINE.json configs)."""
+from . import bert  # noqa: F401
+from .bert import (  # noqa: F401
+    BERTModel, BERTEncoder, BERTClassifier, MultiHeadAttention,
+    PositionwiseFFN, TransformerEncoderCell, get_bert_model,
+)
