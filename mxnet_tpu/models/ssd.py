@@ -1,0 +1,174 @@
+"""SSD object detection (BASELINE.json config 4: "SSD-300 VGG16 —
+multibox/NMS custom ops"; reference ``example/ssd/`` + the MultiBox operators
+``src/operator/contrib/multibox_*.cc`` rebuilt in
+``mxnet_tpu/ops/contrib_ops.py``).
+
+TPU-first notes: every prediction head is a 3×3 conv (MXU); anchors are
+computed once per input shape by ``MultiBoxPrior``; training targets come
+from ``MultiBoxTarget`` (matching runs in XLA, not on host); inference
+decodes + NMS via ``MultiBoxDetection``/``box_nms`` — compiled ``lax`` sort
+loops rather than the reference's CUDA kernels.
+"""
+from __future__ import annotations
+
+from .. import ndarray as nd
+from ..gluon import Block, HybridBlock, nn
+
+__all__ = ["SSD", "VGG16Base", "ssd_300_vgg16", "ssd_512_vgg16",
+           "MultiBoxLoss"]
+
+
+def _conv_block(out, num, channels, kernel=3, pad=1, dilation=1):
+    for _ in range(num):
+        out.add(nn.Conv2D(channels, kernel_size=kernel, padding=pad,
+                          dilation=dilation, activation="relu"))
+
+
+class VGG16Base(HybridBlock):
+    """Reduced VGG16 backbone (SSD convention: fc6/fc7 → dilated convs);
+    returns the conv4_3 and fc7 feature maps."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.stage1 = nn.HybridSequential(prefix="s1_")
+            _conv_block(self.stage1, 2, 64)
+            self.stage2 = nn.HybridSequential(prefix="s2_")
+            _conv_block(self.stage2, 2, 128)
+            self.stage3 = nn.HybridSequential(prefix="s3_")
+            _conv_block(self.stage3, 3, 256)
+            self.stage4 = nn.HybridSequential(prefix="s4_")
+            _conv_block(self.stage4, 3, 512)
+            self.stage5 = nn.HybridSequential(prefix="s5_")
+            _conv_block(self.stage5, 3, 512)
+            # fc6 (dilated) + fc7
+            self.fc = nn.HybridSequential(prefix="fc_")
+            self.fc.add(nn.Conv2D(1024, kernel_size=3, padding=6, dilation=6,
+                                  activation="relu"))
+            self.fc.add(nn.Conv2D(1024, kernel_size=1, activation="relu"))
+            self.pool = nn.MaxPool2D(pool_size=2, strides=2)
+            self.pool5 = nn.MaxPool2D(pool_size=3, strides=1, padding=1)
+
+    def hybrid_forward(self, F, x):
+        x = self.pool(self.stage1(x))
+        x = self.pool(self.stage2(x))
+        x = self.pool(self.stage3(x))
+        x = self.stage4(x)
+        conv4_3 = x
+        x = self.pool(x)
+        x = self.pool5(self.stage5(x))
+        fc7 = self.fc(x)
+        return conv4_3, fc7
+
+
+class _ExtraLayer(HybridBlock):
+    def __init__(self, c1, c2, stride, padding, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.conv1 = nn.Conv2D(c1, kernel_size=1, activation="relu")
+            self.conv2 = nn.Conv2D(c2, kernel_size=3, strides=stride,
+                                   padding=padding, activation="relu")
+
+    def hybrid_forward(self, F, x):
+        return self.conv2(self.conv1(x))
+
+
+class SSD(HybridBlock):
+    """Single-shot detector over a backbone producing multi-scale features.
+
+    ``forward(x)`` → ``(cls_preds (B, A, classes+1), loc_preds (B, A*4),
+    anchors (1, A, 4))``.
+    """
+
+    def __init__(self, num_classes, base=None,
+                 sizes=((0.1, 0.141), (0.2, 0.272), (0.37, 0.447),
+                        (0.54, 0.619), (0.71, 0.79), (0.88, 0.961)),
+                 ratios=((1, 2, 0.5),) * 6, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self._sizes = sizes
+        self._ratios = ratios
+        num_scales = len(sizes)
+        with self.name_scope():
+            self.base = base if base is not None else VGG16Base(prefix="vgg_")
+            self.extras = nn.HybridSequential(prefix="extra_")
+            extra_cfg = [(256, 512, 2, 1), (128, 256, 2, 1),
+                         (128, 256, 1, 0), (128, 256, 1, 0)]
+            for i, (c1, c2, s, p) in enumerate(extra_cfg[:max(0, num_scales - 2)]):
+                self.extras.add(_ExtraLayer(c1, c2, s, p, prefix=f"e{i}_"))
+            self.class_predictors = nn.HybridSequential(prefix="cls_")
+            self.box_predictors = nn.HybridSequential(prefix="loc_")
+            for i in range(num_scales):
+                a = len(sizes[i]) + len(ratios[i]) - 1
+                self.class_predictors.add(
+                    nn.Conv2D(a * (num_classes + 1), kernel_size=3, padding=1))
+                self.box_predictors.add(
+                    nn.Conv2D(a * 4, kernel_size=3, padding=1))
+
+    def hybrid_forward(self, F, x):
+        conv4_3, fc7 = self.base(x)
+        feats = [conv4_3, fc7]
+        y = fc7
+        for blk in self.extras._children.values():
+            y = blk(y)
+            feats.append(y)
+        feats = feats[:len(self._sizes)]
+
+        cls_preds, loc_preds, anchors = [], [], []
+        cls_blocks = list(self.class_predictors._children.values())
+        loc_blocks = list(self.box_predictors._children.values())
+        for i, feat in enumerate(feats):
+            cp = cls_blocks[i](feat)      # (B, A*(C+1), H, W)
+            lp = loc_blocks[i](feat)
+            cls_preds.append(F.flatten(F.transpose(cp, axes=(0, 2, 3, 1))))
+            loc_preds.append(F.flatten(F.transpose(lp, axes=(0, 2, 3, 1))))
+            anchors.append(F.contrib.MultiBoxPrior(
+                feat, sizes=self._sizes[i], ratios=self._ratios[i]))
+        cls_pred = F.concat(*cls_preds, dim=1)
+        loc_pred = F.concat(*loc_preds, dim=1)
+        anchor = F.concat(*anchors, dim=1)
+        cls_pred = F.reshape(cls_pred, shape=(0, -1, self.num_classes + 1))
+        return cls_pred, loc_pred, anchor
+
+
+class MultiBoxLoss(Block):
+    """SSD training loss: softmax CE on matched classes + SmoothL1 on
+    offsets, targets from ``MultiBoxTarget`` (reference example/ssd
+    train/metric pattern)."""
+
+    def __init__(self, negative_mining_ratio=3.0, **kwargs):
+        super().__init__(**kwargs)
+        self._ratio = negative_mining_ratio
+
+    def forward(self, cls_pred, loc_pred, anchor, labels):
+        # cls_pred (B, A, C+1) — MultiBoxTarget wants (B, C+1, A)
+        cls_t = nd.transpose(cls_pred, axes=(0, 2, 1))
+        loc_target, loc_mask, cls_target = nd.contrib.MultiBoxTarget(
+            anchor, labels, cls_t,
+            negative_mining_ratio=self._ratio, overlap_threshold=0.5)
+        from ..gluon.loss import SoftmaxCrossEntropyLoss, HuberLoss
+        cls_loss = SoftmaxCrossEntropyLoss()(
+            cls_pred.reshape((-1, cls_pred.shape[-1])),
+            cls_target.reshape((-1,)))
+        loc_loss = HuberLoss()(loc_pred * loc_mask, loc_target * loc_mask)
+        return cls_loss.mean() + loc_loss.mean(), cls_target, loc_target
+
+
+def ssd_300_vgg16(num_classes=20, **kwargs):
+    return SSD(num_classes, **kwargs)
+
+
+def ssd_512_vgg16(num_classes=20, **kwargs):
+    sizes = ((0.07, 0.1025), (0.15, 0.2121), (0.3, 0.3674), (0.45, 0.5196),
+             (0.6, 0.6708), (0.75, 0.8216), (0.9, 0.9721))
+    return SSD(num_classes, sizes=sizes, ratios=((1, 2, 0.5),) * 7, **kwargs)
+
+
+def detect(net, x, nms_threshold=0.45, force_suppress=False, nms_topk=400):
+    """Inference decode: softmax → MultiBoxDetection (reference
+    ``example/ssd/demo.py`` path)."""
+    cls_pred, loc_pred, anchor = net(x)
+    probs = nd.softmax(nd.transpose(cls_pred, axes=(0, 2, 1)), axis=1)
+    return nd.contrib.MultiBoxDetection(
+        probs, loc_pred, anchor, nms_threshold=nms_threshold,
+        force_suppress=force_suppress, nms_topk=nms_topk)

@@ -1,0 +1,162 @@
+"""Custom operators defined in Python (reference ``python/mxnet/operator.py``
++ ``src/operator/custom/custom-inl.h``).
+
+The reference runs Python callbacks on a dedicated worker pool so they never
+block engine threads; in the TPU-native design eager custom ops simply run
+inline (eager NDArray math is host-driven anyway), and inside ``jit`` traces
+the callback becomes a ``jax.pure_callback`` — correct but host-synchronous,
+the same performance caveat the reference documents for CustomOp
+(SURVEY.md §7 hard-part 6).
+
+Supported surface: ``CustomOp``/``CustomOpProp`` + ``@register`` and
+``mx.nd.Custom(..., op_type=...)``; the legacy ``NDArrayOp``/``NativeOp``
+pre-Gluon shims are intentionally not carried forward.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for operator implementations (reference
+    ``operator.py:CustomOp``)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the grad request."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise ValueError(f"invalid req {req}")
+
+
+class CustomOpProp:
+    """Operator metadata/factory (reference ``operator.py:CustomOpProp``)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def infer_storage_type(self, stype):
+        return stype, ["default"] * len(self.list_outputs()), \
+            ["default"] * len(self.list_auxiliary_states())
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Register a CustomOpProp under ``op_type`` (reference
+    ``operator.py:register``)."""
+    def do_register(prop_cls):
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_all_registered():
+    return dict(_REGISTRY)
+
+
+def _invoke_custom(op_type, inputs, kwargs):
+    """The ``mx.nd.Custom`` path: instantiate prop+op, run forward eagerly,
+    and record a tape node whose backward calls the op's ``backward``."""
+    from . import autograd as _ag
+
+    prop_cls = _REGISTRY.get(op_type)
+    if prop_cls is None:
+        raise ValueError(f"custom op type {op_type!r} is not registered")
+    import inspect
+    sig = inspect.signature(prop_cls.__init__)
+    accepted = {k: v for k, v in kwargs.items()
+                if k in sig.parameters or any(
+                    p.kind == inspect.Parameter.VAR_KEYWORD
+                    for p in sig.parameters.values())}
+    prop = prop_cls(**{k: str(v) for k, v in accepted.items()})
+    in_shapes = [list(x.shape) for x in inputs]
+    out_shapes = prop.infer_shape(in_shapes)[1]
+    in_types = [x.dtype for x in inputs]
+    out_types = prop.infer_type(in_types)[1]
+    op = prop.create_operator(None, in_shapes, in_types)
+
+    out_data = [nd.zeros(tuple(s), dtype=t)
+                for s, t in zip(out_shapes, out_types)]
+    aux = [nd.zeros(tuple(s))
+           for s in prop.infer_shape(in_shapes)[2]]
+    training = _ag.is_training() or _ag.is_recording()
+    with _ag.pause():
+        op.forward(training, ["write"] * len(out_data),
+                   [x.detach() for x in inputs], out_data, aux)
+
+    if _ag.is_recording():
+        import jax
+
+        parents = [getattr(x, "_ag_node", None) for x in inputs]
+        if any(p is not None for p in parents):
+            in_detached = [x.detach() for x in inputs]
+            node = _ag.AGNode(fn=None, attrs={}, in_nds=list(inputs),
+                              parents=parents, n_out=len(out_data))
+            node.out_avals = [jax.typeof(o._data) for o in out_data]
+
+            def custom_vjp(gout_nds):
+                in_grad = [nd.zeros(x.shape, dtype=x.dtype)
+                           for x in in_detached]
+                with _ag.pause():
+                    op.backward(["write"] * len(in_grad), list(gout_nds),
+                                in_detached, out_data, in_grad, aux)
+                return in_grad
+
+            node.custom_vjp = custom_vjp
+            for i, o in enumerate(out_data):
+                o._ag_node = (node, i)
+    return out_data if len(out_data) > 1 else out_data[0]
+
+
+def _custom_entry(*inputs, op_type=None, **kwargs):
+    """``mx.nd.Custom`` (reference generates it from the C op registry)."""
+    assert op_type is not None, "op_type is required"
+    nd_inputs = [x if isinstance(x, NDArray) else nd.array(x) for x in inputs]
+    return _invoke_custom(op_type, nd_inputs, kwargs)
+
+
+# surface as mx.nd.Custom
+nd.Custom = _custom_entry

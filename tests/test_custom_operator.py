@@ -1,0 +1,93 @@
+"""Custom operator tests (reference ``tests/python/unittest/test_operator.py``
+test_custom_op — the Sigmoid example from the docs)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+@mx.operator.register("mysigmoid")
+class MySigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shapes):
+        return in_shapes, [in_shapes[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return MySigmoid()
+
+
+class MySigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = 1.0 / (1.0 + np.exp(-x))
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        gy = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], mx.nd.array(gy * y * (1 - y)))
+
+
+def test_custom_forward():
+    x = mx.nd.array([0.0, 1.0, -1.0])
+    out = mx.nd.Custom(x, op_type="mysigmoid")
+    np.testing.assert_allclose(out.asnumpy(),
+                               1 / (1 + np.exp(-x.asnumpy())), rtol=1e-6)
+
+
+def test_custom_backward():
+    x = mx.nd.array(np.random.randn(4, 3).astype("float32"))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(x, op_type="mysigmoid")
+        loss = y.sum()
+    loss.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_custom_unregistered_raises():
+    with pytest.raises(ValueError):
+        mx.nd.Custom(mx.nd.ones((2,)), op_type="nope")
+
+
+@mx.operator.register("addn2")
+class AddNProp(mx.operator.CustomOpProp):
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def infer_shape(self, in_shapes):
+        return in_shapes, [in_shapes[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return AddN()
+
+
+class AddN(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] + in_data[1])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0])
+        self.assign(in_grad[1], req[1], out_grad[0])
+
+
+def test_custom_multi_input_grads():
+    a = mx.nd.ones((3,)) * 2
+    b = mx.nd.ones((3,)) * 5
+    a.attach_grad()
+    b.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.Custom(a, b, op_type="addn2")
+        out.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), np.ones(3))
+    np.testing.assert_allclose(b.grad.asnumpy(), np.ones(3))

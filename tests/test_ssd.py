@@ -1,0 +1,69 @@
+"""SSD model tests (BASELINE config 4; reference example/ssd +
+multibox op contracts)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import ssd as ssd_mod
+
+
+def _tiny_ssd(num_classes=3):
+    # 4 scales so a 64px input keeps valid feature maps (8, 4, 2, 1)
+    return ssd_mod.SSD(num_classes,
+                       sizes=((0.2, 0.272), (0.37, 0.447), (0.54, 0.619),
+                              (0.71, 0.79)),
+                       ratios=((1, 2, 0.5),) * 4)
+
+
+def test_ssd_forward_shapes():
+    net = _tiny_ssd()
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3, 64, 64))
+    cls_pred, loc_pred, anchors = net(x)
+    A = anchors.shape[1]
+    assert anchors.shape == (1, A, 4)
+    assert cls_pred.shape == (2, A, 4)  # 3 classes + background
+    assert loc_pred.shape == (2, A * 4)
+    # 4 anchors per position over 8^2+4^2+2^2+1 positions
+    assert A == 4 * (64 + 16 + 4 + 1)
+
+
+def test_ssd_train_step():
+    net = _tiny_ssd(num_classes=2)
+    net.initialize()
+    loss_fn = ssd_mod.MultiBoxLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.01})
+    x = mx.nd.random.uniform(shape=(2, 3, 64, 64))
+    # one ground-truth box per image: [cls, x1, y1, x2, y2]
+    labels = mx.nd.array(np.array(
+        [[[0, 0.1, 0.1, 0.5, 0.5]], [[1, 0.4, 0.4, 0.9, 0.9]]],
+        dtype="float32"))
+    with mx.autograd.record():
+        cls_pred, loc_pred, anchors = net(x)
+        loss, cls_t, loc_t = loss_fn(cls_pred, loc_pred, anchors, labels)
+    loss.backward()
+    trainer.step(2)
+    assert np.isfinite(float(loss.asscalar()))
+    # at least one anchor matched per image
+    assert (cls_t.asnumpy() > 0).sum() >= 2
+
+
+def test_ssd_detect():
+    net = _tiny_ssd(num_classes=2)
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(1, 3, 64, 64))
+    out = ssd_mod.detect(net, x, nms_threshold=0.45)
+    assert out.shape[0] == 1 and out.shape[2] == 6
+    ids = out.asnumpy()[0, :, 0]
+    assert ((ids >= -1) & (ids < 2)).all()
+
+
+def test_ssd_300_builds():
+    net = ssd_mod.ssd_300_vgg16(num_classes=20)
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(1, 3, 300, 300))
+    cls_pred, loc_pred, anchors = net(x)
+    # canonical SSD-300 anchor count: 38²·4 + 19²·6 + 10²·6 + 5²·6 + 3²·4 + 1·4
+    assert cls_pred.shape[1] == anchors.shape[1]
+    assert cls_pred.shape[2] == 21
