@@ -23,11 +23,13 @@ __all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
 class MultiHeadAttention(HybridBlock):
     """Self-attention: fused QKV projection, (B,H,T,D) batch_dot scores."""
 
-    def __init__(self, units, num_heads, dropout=0.0, **kwargs):
+    def __init__(self, units, num_heads, dropout=0.0,
+                 use_flash_attention=True, **kwargs):
         super().__init__(**kwargs)
         assert units % num_heads == 0
         self._units = units
         self._num_heads = num_heads
+        self._use_flash = use_flash_attention
         with self.name_scope():
             self.qkv = nn.Dense(units * 3, flatten=False, use_bias=True,
                                 prefix="qkv_")
@@ -47,6 +49,12 @@ class MultiHeadAttention(HybridBlock):
                                                        self._num_heads))
         k = self._split_heads(F, k)
         v = self._split_heads(F, v)
+        if mask is None and self._use_flash and not self.dropout._rate:
+            # unmasked path: the Pallas blockwise kernel — no T×T scores
+            ctx = F.contrib.flash_attention(q, k, v, scale=1.0)
+            ctx = F.transpose(ctx, axes=(0, 2, 1, 3))
+            ctx = F.reshape(ctx, shape=(0, 0, -3))
+            return self.proj(ctx)
         # scores: (B, H, T, T) — one MXU batch_dot
         scores = F.batch_dot(F.reshape(q, shape=(-3, 0, 0)),
                              F.reshape(k, shape=(-3, 0, 0)),

@@ -10,5 +10,21 @@ from . import optimizer_ops  # noqa: F401
 from . import linalg_ops  # noqa: F401
 from . import image_ops  # noqa: F401
 from . import contrib_ops  # noqa: F401
+from . import pallas_kernels  # noqa: F401
 
 from .registry import get, list_ops, register, require  # noqa: F401
+
+# flash attention as a contrib op (nd.contrib.flash_attention) — wrapper
+# maps string/kwarg attrs onto the custom_vjp function's positional-only
+# signature
+def _flash_attention_op(q, k, v, causal=False, scale=None, block_q=128,
+                        block_k=128, interpret=None):
+    from ..base import parse_bool, parse_int
+    return pallas_kernels.flash_attention(
+        q, k, v, parse_bool(causal),
+        None if scale in (None, "None") else float(scale),
+        parse_int(block_q, 128), parse_int(block_k, 128), interpret)
+
+
+register("_contrib_flash_attention",
+         aliases=("flash_attention",))(_flash_attention_op)
