@@ -1,3 +1,4 @@
 """Contrib: experimental / auxiliary surfaces (reference
 ``python/mxnet/contrib/``)."""
 from . import amp  # noqa: F401
+from . import quantization  # noqa: F401

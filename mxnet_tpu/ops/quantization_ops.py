@@ -1,0 +1,95 @@
+"""Quantization operators (reference ``src/operator/quantization/`` —
+quantize/quantize_v2/dequantize/requantize and the quantized conv/fc
+kernels).
+
+TPU-native status: XLA's native int8 dot is not yet wired as a separate
+kernel; these ops implement the reference's *numerical contract* (symmetric
+int8/uint8 affine quantization with min/max calibration ranges) so that
+calibrated models produce the reference's quantized inference results, with
+the arithmetic running on the MXU in the quantize→dequantize ("fake quant")
+formulation that XLA folds into neighboring matmuls.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import parse_bool, parse_float
+from .registry import register
+
+INT8_MIN, INT8_MAX = -127.0, 127.0
+UINT8_MAX = 255.0
+
+
+def _range(min_r, max_r, out_type):
+    if str(out_type) == "uint8":
+        return 0.0, UINT8_MAX
+    return INT8_MIN, INT8_MAX
+
+
+@register("_contrib_quantize", aliases=("quantize",))
+def quantize(data, min_range, max_range, out_type="uint8"):
+    """Reference ``quantize.cc``: affine-quantize fp32 → int8/uint8 given
+    calibration range. Returns (q, min, max)."""
+    qmin, qmax = _range(min_range, max_range, out_type)
+    mn = jnp.minimum(min_range.reshape(()), 0.0)
+    mx = jnp.maximum(max_range.reshape(()), 0.0)
+    scale = (qmax - qmin) / jnp.maximum(mx - mn, 1e-20)
+    q = jnp.clip(jnp.round((data - mn) * scale + qmin), qmin, qmax)
+    dt = jnp.uint8 if str(out_type) == "uint8" else jnp.int8
+    return q.astype(dt), mn, mx
+
+
+@register("_contrib_quantize_v2", aliases=("quantize_v2",))
+def quantize_v2(data, out_type="int8", min_calib_range=None,
+                max_calib_range=None):
+    """Reference ``quantize_v2.cc``: ranges from attrs (calibrated) or from
+    the data (dynamic)."""
+    mn = parse_float(min_calib_range) if min_calib_range is not None else None
+    mx = parse_float(max_calib_range) if max_calib_range is not None else None
+    if mn is None or mx is None:
+        mn = jnp.minimum(jnp.min(data), 0.0)
+        mx = jnp.maximum(jnp.max(data), 0.0)
+    else:
+        mn = jnp.asarray(mn, jnp.float32)
+        mx = jnp.asarray(mx, jnp.float32)
+    if str(out_type) == "auto":
+        out_type = "int8"
+    qmin, qmax = _range(mn, mx, out_type)
+    if str(out_type) == "int8":
+        # symmetric (reference uses max-abs for int8)
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+        scale = INT8_MAX / jnp.maximum(amax, 1e-20)
+        q = jnp.clip(jnp.round(data * scale), INT8_MIN, INT8_MAX)
+        return q.astype(jnp.int8), -amax, amax
+    scale = (qmax - qmin) / jnp.maximum(mx - mn, 1e-20)
+    q = jnp.clip(jnp.round((data - mn) * scale), qmin, qmax)
+    return q.astype(jnp.uint8), mn, mx
+
+
+@register("_contrib_dequantize", aliases=("dequantize",))
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """Reference ``dequantize.cc``."""
+    mn = min_range.reshape(())
+    mx = max_range.reshape(())
+    if data.dtype == jnp.uint8:
+        scale = (mx - mn) / UINT8_MAX
+        return data.astype(jnp.float32) * scale + mn
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return data.astype(jnp.float32) * (amax / INT8_MAX)
+
+
+@register("_contrib_requantize", aliases=("requantize",))
+def requantize(data, min_range, max_range, out_type="int8",
+               min_calib_range=None, max_calib_range=None):
+    """Reference ``requantize.cc``: int32 accumulators → int8."""
+    f = dequantize(data.astype(jnp.float32), min_range, max_range) \
+        if data.dtype != jnp.float32 else data
+    mn = parse_float(min_calib_range)
+    mx = parse_float(max_calib_range)
+    if mn is None or mx is None:
+        amax = jnp.maximum(jnp.abs(jnp.min(f)), jnp.abs(jnp.max(f)))
+    else:
+        amax = jnp.maximum(abs(mn), abs(mx))
+    scale = INT8_MAX / jnp.maximum(amax, 1e-20)
+    q = jnp.clip(jnp.round(f * scale), INT8_MIN, INT8_MAX)
+    return q.astype(jnp.int8), -amax, amax

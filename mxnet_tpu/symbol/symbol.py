@@ -212,14 +212,7 @@ class Symbol:
             specs[name] = s
             return s
 
-        for node in self._topo():
-            if node.op is None:
-                if node.name in shapes:
-                    out_specs[(id(node), 0)] = var_spec(node.name,
-                                                        shapes[node.name])
-                # else: leave unknown — may be inferable at its consumer
-                continue
-            _infer_layer_param_shapes(node, out_specs, var_spec)
+        def eval_node(node):
             in_specs = []
             for p, i in node.inputs:
                 s = out_specs.get((id(p), i))
@@ -241,8 +234,36 @@ class Symbol:
             if not isinstance(outs, (tuple, list)):
                 outs = (outs,)
             for i, o in enumerate(outs):
-                out_specs[(id(node), i)] = jax.ShapeDtypeStruct(tuple(o.shape),
-                                                                o.dtype)
+                out_specs[(id(node), i)] = jax.ShapeDtypeStruct(
+                    tuple(o.shape), o.dtype)
+
+        pending = []
+        for node in self._topo():
+            if node.op is None:
+                if node.name in shapes:
+                    out_specs[(id(node), 0)] = var_spec(node.name,
+                                                        shapes[node.name])
+                # else: leave unknown — may be inferable at a consumer
+                continue
+            pending.append(node)
+        # fixpoint sweeps: a layer node can name the shape of a parameter
+        # variable sitting *behind* shape-preserving ops (e.g. the
+        # quantize→dequantize chains the INT8 rewrite inserts), which
+        # unblocks those earlier nodes on the next sweep.
+        progress = True
+        while pending and progress:
+            progress = False
+            still = []
+            for node in pending:
+                _infer_layer_param_shapes(node, out_specs, var_spec)
+                try:
+                    eval_node(node)
+                    progress = True
+                except KeyError:
+                    still.append(node)
+            pending = still
+        if pending:
+            raise KeyError(pending[0].inputs[0][0].name)
         return specs
 
     # ------------------------------------------------------------ build/exec
@@ -539,6 +560,9 @@ def _num_outputs_of(op, attrs, n_inputs):
         return len(parse_tuple(attrs.get("indices", ()))) + 1
     if op.name in ("_linalg_slogdet", "moments", "_linalg_gelqf", "_linalg_syevd"):
         return 2
+    if op.name in ("_contrib_quantize", "_contrib_quantize_v2",
+                   "_contrib_requantize"):
+        return 3
     if op.name == "RNN":
         if parse_bool(attrs.get("state_outputs", False)):
             return 3 if attrs.get("mode", "lstm") == "lstm" else 2
@@ -684,10 +708,18 @@ def _infer_layer_param_shapes(node, out_specs, var_spec):
     dshape = data_spec.shape
     a = node.attrs
 
+    # ops a parameter may sit behind without changing shape (AMP casts,
+    # INT8 fake-quant chains, stop-gradient)
+    _SHAPE_PRESERVING = {"_contrib_quantize", "_contrib_quantize_v2",
+                         "_contrib_dequantize", "amp_cast", "Cast", "cast",
+                         "_copy", "identity", "BlockGrad", "stop_gradient"}
+
     def fill(pos, shape):
         if pos >= len(node.inputs):
             return
         p, i = node.inputs[pos]
+        while p.op is not None and p.op.name in _SHAPE_PRESERVING and i == 0:
+            p, i = p.inputs[0]
         if p.op is None and out_specs.get((id(p), i)) is None:
             out_specs[(id(p), i)] = var_spec(p.name, shape)
 

@@ -1,0 +1,65 @@
+"""Quantization tests (reference ``tests/python/quantization/``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import quantization as qz
+
+
+def test_quantize_dequantize_roundtrip_int8():
+    x = mx.nd.array(np.linspace(-3, 5, 64, dtype="float32").reshape(8, 8))
+    q, mn, mx_ = mx.nd.contrib.quantize_v2(x, out_type="int8")
+    assert q.dtype == np.int8
+    back = mx.nd.contrib.dequantize(q, mn, mx_)
+    # quantization error bounded by one step
+    step = 5.0 / 127
+    assert np.max(np.abs(back.asnumpy() - x.asnumpy())) <= step + 1e-6
+
+
+def test_quantize_uint8_with_ranges():
+    x = mx.nd.array(np.random.RandomState(0).rand(4, 4).astype("float32"))
+    q, mn, mx_ = mx.nd.contrib.quantize(x, mx.nd.array([0.0]),
+                                        mx.nd.array([1.0]),
+                                        out_type="uint8")
+    assert q.dtype == np.uint8
+    back = mx.nd.contrib.dequantize(q, mn, mx_)
+    assert np.max(np.abs(back.asnumpy() - x.asnumpy())) <= 1 / 255 + 1e-6
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=4)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_quantize_model_close_to_fp32():
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 8).astype("float32")
+    sym = _mlp()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    it = mx.io.NDArrayIter(x, np.zeros(64, "float32"), batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    arg_params, aux_params = mod.get_params()
+    fp32_out = mod.predict(it).asnumpy()
+
+    qsym, qargs, qauxs = qz.quantize_model(
+        sym, arg_params, aux_params, calib_mode="naive", calib_data=it,
+        num_calib_examples=32)
+    qmod = mx.mod.Module(qsym, context=mx.cpu())
+    qmod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+              for_training=False)
+    qmod.set_params(qargs, qauxs)
+    int8_out = qmod.predict(it).asnumpy()
+    # int8 inference tracks fp32 closely on this toy net
+    assert np.max(np.abs(int8_out - fp32_out)) < 0.05
+    assert (int8_out.argmax(1) == fp32_out.argmax(1)).mean() > 0.95
+
+
+def test_quantize_model_excluded_layers():
+    sym = _mlp()
+    qsym = qz.quantize_graph(sym, {}, {}, excluded_sym_names=["fc1", "fc2"])
+    names = [n.op.name for n in qsym._topo() if n.op is not None]
+    assert "_contrib_quantize_v2" not in names  # everything excluded
