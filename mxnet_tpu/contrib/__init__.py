@@ -2,3 +2,4 @@
 ``python/mxnet/contrib/``)."""
 from . import amp  # noqa: F401
 from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401

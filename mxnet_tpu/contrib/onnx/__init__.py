@@ -1,0 +1,40 @@
+"""ONNX import/export (reference ``python/mxnet/contrib/onnx/``).
+
+Gated: the ``onnx`` protobuf package is not present in this zero-egress
+image, so these entry points raise with instructions instead of failing at
+import time.  The graph machinery they need (Symbol topo walk + op table,
+``mxnet_tpu/symbol``) is in place; the converter tables are the remaining
+work once the dependency is available.
+"""
+from __future__ import annotations
+
+__all__ = ["import_model", "export_model", "get_model_metadata"]
+
+_MSG = ("ONNX support requires the 'onnx' package, which is not available "
+        "in this environment (no network access). Install onnx and re-run; "
+        "the converter operates on mxnet_tpu.symbol graphs.")
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(_MSG) from e
+
+
+def import_model(model_file):
+    """Reference ``onnx2mx/import_model.py``."""
+    _require_onnx()
+    raise NotImplementedError(_MSG)
+
+
+def export_model(sym, params, input_shape, input_type=None,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Reference ``mx2onnx/export_model.py``."""
+    _require_onnx()
+    raise NotImplementedError(_MSG)
+
+
+def get_model_metadata(model_file):
+    _require_onnx()
+    raise NotImplementedError(_MSG)

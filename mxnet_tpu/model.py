@@ -42,3 +42,94 @@ def load_checkpoint(prefix, epoch):
         if tp == "aux":
             aux_params[name] = v
     return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """The pre-Module training wrapper (reference ``model.py:FeedForward``,
+    long deprecated but still the API of the oldest examples).  Internally a
+    thin adapter over :class:`mxnet_tpu.module.Module` — behaviorally
+    equivalent, one jitted executor underneath."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from . import initializer as init_mod
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.optimizer = optimizer
+        self.initializer = initializer if initializer is not None \
+            else init_mod.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    def _as_iter(self, X, y=None, batch_size=None):
+        from .io import DataIter, NDArrayIter
+        if isinstance(X, DataIter):
+            return X
+        import numpy as _np
+        return NDArrayIter(X, y if y is not None
+                           else _np.zeros(len(X), dtype="float32"),
+                           batch_size or self.numpy_batch_size)
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        """Reference ``model.py:FeedForward.fit``."""
+        from .module import Module
+        train = self._as_iter(X, y)
+        label_names = [d.name for d in (train.provide_label or [])]
+        self._module = Module(self.symbol, context=self.ctx,
+                              label_names=label_names or None)
+        self._module.fit(
+            train, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            optimizer=self.optimizer,
+            optimizer_params=self.kwargs or (("learning_rate", 0.01),),
+            initializer=self.initializer, arg_params=self.arg_params,
+            aux_params=self.aux_params, begin_epoch=self.begin_epoch,
+            num_epoch=self.num_epoch, monitor=monitor)
+        self.arg_params, self.aux_params = self._module.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        """Reference ``model.py:FeedForward.predict``."""
+        assert self._module is not None, "call fit first"
+        it = self._as_iter(X)
+        out = self._module.predict(it, num_batch=num_batch, reset=reset)
+        return out.asnumpy() if not isinstance(out, list) \
+            else [o.asnumpy() for o in out]
+
+    def score(self, X, y=None, eval_metric="acc", num_batch=None):
+        assert self._module is not None, "call fit first"
+        it = self._as_iter(X, y)
+        return self._module.score(it, eval_metric, num_batch=num_batch)[0][1]
+
+    def save(self, prefix, epoch=None):
+        epoch = epoch if epoch is not None else self.num_epoch or 0
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, **kwargs):
+        """Reference ``model.py:FeedForward.create``: construct + fit."""
+        fit_kwargs = {k: kwargs.pop(k) for k in
+                      ("eval_data", "eval_metric", "epoch_end_callback",
+                       "batch_end_callback", "kvstore", "logger")
+                      if k in kwargs}
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch, **kwargs)
+        return model.fit(X, y, **fit_kwargs)

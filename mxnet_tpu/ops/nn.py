@@ -654,6 +654,10 @@ def rnn(data, parameters, state, *rest, state_size=None, num_layers=1,
             W, R, bw, br = Ws[li], Rs[li], Bw[li], Br[li]
             h0 = state[li]
             c0 = state_cell[li] if state_cell is not None else jnp.zeros_like(h0)
+            if h0.shape[0] != batch:
+                # size-1 batch placeholder (legacy begin_state) broadcasts
+                h0 = jnp.broadcast_to(h0, (batch, h0.shape[-1]))
+                c0 = jnp.broadcast_to(c0, (batch, c0.shape[-1]))
             xs = x if d == 0 else jnp.flip(x, 0)
 
             def step(carry, x_t, W=W, R=R, bw=bw, br=br):

@@ -1,0 +1,10 @@
+"""Legacy symbolic RNN API (reference ``python/mxnet/rnn/``)."""
+from .rnn_cell import (  # noqa: F401
+    BaseRNNCell, RNNCell, LSTMCell, GRUCell, FusedRNNCell,
+    SequentialRNNCell, BidirectionalCell, DropoutCell, ModifierCell,
+    ZoneoutCell, ResidualCell, RNNParams,
+)
+from .io import BucketSentenceIter  # noqa: F401
+from .rnn import (  # noqa: F401
+    save_rnn_checkpoint, load_rnn_checkpoint, do_rnn_checkpoint,
+)

@@ -679,6 +679,9 @@ LAYER_INPUTS = {
     "LayerNorm": lambda a: ["data", "gamma", "beta"],
     "InstanceNorm": lambda a: ["data", "gamma", "beta"],
     "Embedding": lambda a: ["data", "weight"],
+    "RNN": lambda a: (["data", "parameters", "state", "state_cell"]
+                      if str(a.get("mode", "lstm")) == "lstm"
+                      else ["data", "parameters", "state"]),
     "LeakyReLU": lambda a: (["data", "gamma"] if a.get("act_type") == "prelu"
                             else ["data"]),
     "SoftmaxOutput": lambda a: ["data", "label"],
@@ -765,6 +768,20 @@ def _infer_layer_param_shapes(node, out_specs, var_spec):
     elif op_name in ("LinearRegressionOutput", "LogisticRegressionOutput",
                      "MAERegressionOutput"):
         fill(roles.index("label"), tuple(int(x) for x in dshape))
+    elif op_name == "RNN":
+        # flat cuDNN-canonical parameter vector (see ops/nn.py rnn):
+        # per layer/dir W(G·H×in) + R(G·H×H), then biases 2·G·H each
+        h = parse_int(a.get("state_size"))
+        layers = parse_int(a.get("num_layers", 1), 1)
+        d = 2 if parse_bool(a.get("bidirectional", False)) else 1
+        g = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4,
+             "gru": 3}[str(a.get("mode", "lstm"))]
+        cin = int(dshape[2])
+        total = 0
+        for layer in range(layers):
+            in_sz = cin if layer == 0 else h * d
+            total += d * (g * h * in_sz + g * h * h + 2 * g * h)
+        fill(1, (total,))
 
 
 def _input_order(op, named_inputs):

@@ -1,0 +1,61 @@
+"""FeedForward + im2rec + ONNX-gating tests."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=2)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_feedforward_fit_predict(tmp_path):
+    rng = np.random.RandomState(0)
+    x = rng.randn(120, 6).astype("float32")
+    y = (x.sum(axis=1) > 0).astype("float32")
+    model = mx.model.FeedForward(_mlp(), ctx=mx.cpu(), num_epoch=10,
+                                 optimizer="sgd", learning_rate=1.0,
+                                 numpy_batch_size=30)
+    model.fit(x, y)
+    preds = model.predict(x)
+    acc = ((preds.argmax(1) == y).mean())
+    assert acc > 0.9, acc
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, 8)
+    loaded = mx.model.FeedForward.load(prefix, 8, ctx=mx.cpu())
+    assert "fc1_weight" in loaded.arg_params
+
+
+def test_im2rec_roundtrip(tmp_path):
+    import cv2
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools import im2rec
+
+    root = tmp_path / "imgs"
+    for cls in ("cats", "dogs"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            img = (np.random.rand(24, 24, 3) * 255).astype(np.uint8)
+            cv2.imwrite(str(root / cls / f"{i}.png"), img)
+    prefix = str(tmp_path / "data")
+    im2rec.main([prefix, str(root), "--list", "--recursive"])
+    assert os.path.exists(prefix + ".lst")
+    im2rec.main([prefix, str(root), "--encoding", ".png"])
+    assert os.path.exists(prefix + ".rec")
+    ds = mx.gluon.data.vision.ImageRecordDataset(prefix + ".rec")
+    assert len(ds) == 6
+    img, label = ds[0]
+    assert img.shape == (24, 24, 3)
+    assert label in (0.0, 1.0)
+
+
+def test_onnx_gated():
+    with pytest.raises((ImportError, NotImplementedError)):
+        mx.contrib.onnx.import_model("x.onnx")

@@ -1,0 +1,132 @@
+"""Legacy symbolic RNN tests (reference ``tests/python/unittest/test_rnn.py``
++ ``tests/python/train/test_bucketing.py``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_rnn_cell_unroll_symbolic():
+    cell = mx.rnn.RNNCell(16, prefix="rnn_")
+    data = mx.sym.Variable("data")
+    outputs, states = cell.unroll(3, data, layout="NTC", merge_outputs=False)
+    args = set()
+    for o in outputs:
+        args.update(o.list_arguments())
+    assert {"rnn_i2h_weight", "rnn_h2h_weight", "rnn_i2h_bias",
+            "rnn_h2h_bias", "data"} <= args
+
+
+def test_lstm_cell_executes():
+    cell = mx.rnn.LSTMCell(8, prefix="lstm_")
+    data = mx.sym.Variable("data")
+    outputs, states = cell.unroll(4, data, layout="NTC", merge_outputs=True)
+    exe = outputs.simple_bind(ctx=mx.cpu(), data=(2, 4, 5))
+    for name, arr in exe.arg_dict.items():
+        if name != "data":
+            arr[:] = np.random.RandomState(0).randn(*arr.shape) * 0.1
+    exe.arg_dict["data"][:] = np.random.RandomState(1).randn(2, 4, 5)
+    out = exe.forward()[0]
+    assert out.shape == (2, 4, 8)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_fused_cell_matches_unfused():
+    """FusedRNNCell(RNN op) vs step-wise LSTMCell with shared weights."""
+    T, N, C, H = 4, 3, 5, 8
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="f_",
+                                get_next_state=True)
+    sym_f, _ = fused.unroll(T, mx.sym.Variable("data"), layout="NTC",
+                            merge_outputs=True)
+    exe_f = sym_f.simple_bind(ctx=mx.cpu(), data=(N, T, C))
+    rng = np.random.RandomState(0)
+    x = rng.randn(N, T, C).astype("float32")
+    # flat param vector: W (4H, C), R (4H, H), bw, br
+    W = rng.randn(4 * H, C).astype("float32") * 0.2
+    R = rng.randn(4 * H, H).astype("float32") * 0.2
+    bw = rng.randn(4 * H).astype("float32") * 0.1
+    br = rng.randn(4 * H).astype("float32") * 0.1
+    flat = np.concatenate([W.ravel(), R.ravel(), bw, br])
+    exe_f.arg_dict["f_parameters"][:] = flat
+    exe_f.arg_dict["data"][:] = x
+    out_f = exe_f.forward()[0].asnumpy()
+
+    cell = mx.rnn.LSTMCell(H, prefix="u_")
+    sym_u, _ = cell.unroll(T, mx.sym.Variable("data"), layout="NTC",
+                           merge_outputs=True)
+    exe_u = sym_u.simple_bind(ctx=mx.cpu(), data=(N, T, C))
+    exe_u.arg_dict["u_i2h_weight"][:] = W
+    exe_u.arg_dict["u_h2h_weight"][:] = R
+    exe_u.arg_dict["u_i2h_bias"][:] = bw
+    exe_u.arg_dict["u_h2h_bias"][:] = br
+    exe_u.arg_dict["data"][:] = x
+    out_u = exe_u.forward()[0].asnumpy()
+    np.testing.assert_allclose(out_f, out_u, rtol=1e-4, atol=1e-5)
+
+
+def test_bucket_sentence_iter():
+    rng = np.random.RandomState(0)
+    sentences = [list(rng.randint(1, 20, size=l))
+                 for l in rng.randint(2, 9, size=100)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=8,
+                                   buckets=[4, 8], invalid_label=0)
+    batches = list(it)
+    assert len(batches) > 0
+    for b in batches:
+        assert b.bucket_key in (4, 8)
+        assert b.data[0].shape == (8, b.bucket_key)
+        # label is data shifted left
+        d = b.data[0].asnumpy()
+        l = b.label[0].asnumpy()
+        np.testing.assert_array_equal(d[:, 1:], l[:, :-1])
+
+
+def test_bucketing_training_lstm():
+    """The reference's test_bucketing.py shape: char-level LM over buckets."""
+    rng = np.random.RandomState(0)
+    vocab = 16
+    # zipf-ish marginal so there is something to learn
+    p = 1.0 / np.arange(1, vocab)
+    p /= p.sum()
+    sentences = [list(rng.choice(np.arange(1, vocab), size=l, p=p))
+                 for l in rng.randint(3, 9, size=200)]
+    buckets = [4, 8]
+    batch_size = 16
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size, buckets=buckets,
+                                   invalid_label=0)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=8,
+                                 name="embed")
+        cell = mx.rnn.LSTMCell(16, prefix="lstm_")
+        outputs, _ = cell.unroll(seq_len, embed, layout="NTC",
+                                 merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, 16))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+        label_f = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label_f, name="softmax",
+                                    use_ignore=True, ignore_label=0)
+        return pred, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    ppl = mx.metric.Perplexity(ignore_label=0)
+    last = None
+    for epoch in range(3):
+        it.reset()
+        ppl.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            mod.update_metric(ppl, batch.label)
+        last = ppl.get()[1]
+    # zipf marginal entropy ≈ exp(2.1) ≈ 8.3; uniform would be 15
+    assert last < 12, last

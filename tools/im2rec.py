@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""im2rec — pack an image folder/list into RecordIO (reference
+``tools/im2rec.py``; same .lst and .rec/.idx formats, so datasets packed here
+load in stock MXNet and vice versa)."""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def list_image(root, recursive, exts):
+    """Yield (index, relpath, label) walking the folder (reference
+    ``im2rec.py:list_image``)."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and (suffix in exts):
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+        for k in sorted(cat.keys()):
+            print(os.path.relpath(k, root), cat[k])
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and (suffix in exts):
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, item in enumerate(image_list):
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            line = [i.strip() for i in line.strip().split("\t")]
+            if len(line) < 3:
+                continue
+            yield (int(line[0]), line[-1],
+                   [float(i) for i in line[1:-1]])
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    N = len(image_list)
+    chunk_size = (N + args.chunks - 1) // args.chunks
+    for i in range(args.chunks):
+        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        str_chunk = "_%d" % i if args.chunks > 1 else ""
+        sep = int(chunk_size * args.train_ratio)
+        sep_test = int(chunk_size * args.test_ratio)
+        if args.train_ratio == 1.0:
+            write_list(args.prefix + str_chunk + ".lst", chunk)
+        else:
+            if args.test_ratio:
+                write_list(args.prefix + str_chunk + "_test.lst",
+                           chunk[:sep_test])
+            if args.train_ratio + args.test_ratio < 1.0:
+                write_list(args.prefix + str_chunk + "_val.lst",
+                           chunk[sep_test + sep:])
+            write_list(args.prefix + str_chunk + "_train.lst",
+                       chunk[sep_test:sep_test + sep])
+
+
+def im2rec(args, path_lst, path_root):
+    import cv2
+    import numpy as np
+    from mxnet_tpu import recordio
+
+    out_rec = os.path.splitext(path_lst)[0] + ".rec"
+    out_idx = os.path.splitext(path_lst)[0] + ".idx"
+    record = recordio.MXIndexedRecordIO(out_idx, out_rec, "w")
+    count = 0
+    for idx, fname, labels in read_list(path_lst):
+        fpath = os.path.join(path_root, fname)
+        img = cv2.imread(fpath, args.color)
+        if img is None:
+            print("imread error:", fpath)
+            continue
+        if args.center_crop:
+            if img.shape[0] > img.shape[1]:
+                margin = (img.shape[0] - img.shape[1]) // 2
+                img = img[margin:margin + img.shape[1], :]
+            else:
+                margin = (img.shape[1] - img.shape[0]) // 2
+                img = img[:, margin:margin + img.shape[0]]
+        if args.resize:
+            if img.shape[0] > img.shape[1]:
+                newsize = (args.resize,
+                           img.shape[0] * args.resize // img.shape[1])
+            else:
+                newsize = (img.shape[1] * args.resize // img.shape[0],
+                           args.resize)
+            img = cv2.resize(img, newsize)
+        label = labels[0] if len(labels) == 1 else np.asarray(labels)
+        header = recordio.IRHeader(0, label, idx, 0)
+        packed = recordio.pack_img(header, img, quality=args.quality,
+                                   img_fmt=args.encoding)
+        record.write_idx(idx, packed)
+        count += 1
+    record.close()
+    print("wrote %d records to %s" % (count, out_rec))
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Create an image list and/or RecordIO file.")
+    parser.add_argument("prefix", help="prefix of .lst/.rec files")
+    parser.add_argument("root", help="image root folder")
+    parser.add_argument("--list", action="store_true",
+                        help="create image list")
+    parser.add_argument("--exts", nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    parser.add_argument("--chunks", type=int, default=1)
+    parser.add_argument("--train-ratio", type=float, default=1.0)
+    parser.add_argument("--test-ratio", type=float, default=0)
+    parser.add_argument("--recursive", action="store_true")
+    parser.add_argument("--shuffle", type=bool, default=True)
+    parser.add_argument("--resize", type=int, default=0)
+    parser.add_argument("--center-crop", action="store_true")
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--encoding", default=".jpg")
+    parser.add_argument("--color", type=int, default=1)
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.list:
+        make_list(args)
+    else:
+        for fname in sorted(os.listdir(os.path.dirname(
+                os.path.abspath(args.prefix)) or ".")):
+            fpath = os.path.join(os.path.dirname(
+                os.path.abspath(args.prefix)), fname)
+            base = os.path.basename(args.prefix)
+            if fname.startswith(base) and fname.endswith(".lst"):
+                im2rec(args, fpath, args.root)
+
+
+if __name__ == "__main__":
+    main()
