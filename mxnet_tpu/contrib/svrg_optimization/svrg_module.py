@@ -1,0 +1,116 @@
+"""SVRGModule (reference
+``python/mxnet/contrib/svrg_optimization/svrg_module.py``): stochastic
+variance-reduced gradient — every ``update_freq`` epochs a snapshot of the
+weights w̃ and the full-dataset gradient ∇f(w̃) are taken; each step then
+uses ``g = ∇f_i(w) − ∇f_i(w̃) + ∇f(w̃)``."""
+from __future__ import annotations
+
+import logging
+
+from ... import ndarray as nd
+from ...module import Module
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, update_freq=2, **kwargs):
+        super().__init__(symbol, data_names, label_names, logger=logger,
+                         context=context, **kwargs)
+        self.update_freq = update_freq
+        self._mod_aux = Module(symbol, data_names, label_names,
+                               logger=logger, context=context, **kwargs)
+        self._param_dict = None
+        self._ctx_len = 1
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module, grad_req)
+        if for_training:
+            self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                               inputs_need_grad, force_rebind, shared_module,
+                               grad_req)
+
+    def init_params(self, initializer="default", arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        super().init_params(initializer, arg_params, aux_params,
+                            allow_missing, force_init, allow_extra)
+        self._mod_aux.set_params(*self.get_params())
+
+    def update_full_grads(self, train_data):
+        """Snapshot w̃ and accumulate ∇f(w̃) over the whole dataset
+        (reference ``svrg_module.py:update_full_grads``)."""
+        self._mod_aux.set_params(*self.get_params())
+        self._full_grads = {n: nd.zeros(self._mod_aux._exec.arg_dict[n].shape)
+                            for n in self._param_names}
+        nbatch = 0
+        train_data.reset()
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            for name in self._param_names:
+                g = self._mod_aux._exec.grad_dict.get(name)
+                if g is not None:
+                    self._full_grads[name] += g
+            nbatch += 1
+        for name in self._full_grads:
+            self._full_grads[name] /= max(nbatch, 1)
+
+    def forward_backward(self, data_batch):
+        """Gradient with variance reduction (reference
+        ``svrg_module.py:forward_backward``)."""
+        super().forward(data_batch, is_train=True)
+        super().backward()
+        if getattr(self, "_full_grads", None) is not None:
+            # gradient at the snapshot weights on the same batch
+            self._mod_aux.forward(data_batch, is_train=True)
+            self._mod_aux.backward()
+            for name in self._param_names:
+                g = self._exec.grad_dict.get(name)
+                g_snap = self._mod_aux._exec.grad_dict.get(name)
+                if g is not None and g_snap is not None:
+                    g[:] = g - g_snap + self._full_grads[name]
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None, **kwargs):
+        """SVRG fit loop: refresh full gradients every ``update_freq``
+        epochs (reference ``svrg_module.py:fit``)."""
+        from ... import metric as metric_mod
+        from ...initializer import Uniform
+        assert num_epoch is not None
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label, for_training=True,
+                  force_rebind=force_rebind)
+        self.init_params(initializer=initializer or Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        for epoch in range(begin_epoch, num_epoch):
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            train_data.reset()
+            eval_metric.reset()
+            for batch in train_data:
+                self.forward_backward(batch)
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            if eval_data:
+                res = self.score(eval_data, eval_metric)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
+                                     name, val)

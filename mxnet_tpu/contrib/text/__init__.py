@@ -1,0 +1,4 @@
+"""Text utilities (reference ``python/mxnet/contrib/text/``)."""
+from . import utils  # noqa: F401
+from .vocab import Vocabulary  # noqa: F401
+from . import embedding  # noqa: F401

@@ -1,0 +1,145 @@
+"""Token embeddings (reference ``python/mxnet/contrib/text/embedding.py``).
+
+Zero-egress: the pretrained GloVe/fastText downloads are gated; embeddings
+load from local files in the standard text format (``token v1 v2 ...`` per
+line) via :class:`CustomEmbedding`.
+"""
+from __future__ import annotations
+
+import io
+import logging
+
+import numpy as np
+
+from ... import ndarray as nd
+
+__all__ = ["register", "create", "CustomEmbedding", "CompositeEmbedding",
+           "get_pretrained_file_names"]
+
+_REG = {}
+
+
+def register(cls):
+    _REG[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(embedding_name, **kwargs):
+    name = embedding_name.lower()
+    if name not in _REG:
+        raise KeyError(
+            f"embedding {embedding_name!r} not registered; pretrained "
+            "downloads (glove/fasttext) are unavailable in this zero-egress "
+            "environment — load local vectors with CustomEmbedding.")
+    return _REG[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Reference lists downloadable archives; none here (no egress)."""
+    return {} if embedding_name is None else []
+
+
+class _TokenEmbedding:
+    def __init__(self, unknown_token="<unk>"):
+        self._unknown_token = unknown_token
+        self._idx_to_token = [unknown_token]
+        self._token_to_idx = {unknown_token: 0}
+        self._idx_to_vec = None
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def vec_len(self):
+        return self._idx_to_vec.shape[1]
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    def _load_embedding_txt(self, file_path, elem_delim=" ",
+                            encoding="utf8"):
+        vecs = []
+        with io.open(file_path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                elems = line.rstrip().split(elem_delim)
+                if len(elems) <= 2:
+                    logging.warning("line %d: skipped (too few fields)",
+                                    line_num)
+                    continue
+                token, vec = elems[0], elems[1:]
+                if token in self._token_to_idx:
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                vecs.append(np.asarray(vec, dtype=np.float32))
+        dim = vecs[0].shape[0] if vecs else 0
+        all_vecs = np.vstack([np.zeros((1, dim), dtype=np.float32)] + vecs)
+        self._idx_to_vec = nd.array(all_vecs)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Reference ``embedding.py:get_vecs_by_tokens``."""
+        single = isinstance(tokens, str)
+        if single:
+            tokens = [tokens]
+        indices = []
+        for t in tokens:
+            if t in self._token_to_idx:
+                indices.append(self._token_to_idx[t])
+            elif lower_case_backup and t.lower() in self._token_to_idx:
+                indices.append(self._token_to_idx[t.lower()])
+            else:
+                indices.append(0)
+        vecs = self._idx_to_vec.take(nd.array(indices, dtype="int32"))
+        return vecs[0] if single else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Reference ``embedding.py:update_token_vectors``."""
+        if isinstance(tokens, str):
+            tokens = [tokens]
+        arr = np.array(self._idx_to_vec.asnumpy())
+        nv = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else np.asarray(new_vectors)
+        if nv.ndim == 1:
+            nv = nv[None, :]
+        for t, v in zip(tokens, nv):
+            if t not in self._token_to_idx:
+                raise ValueError(f"token {t!r} is unknown")
+            arr[self._token_to_idx[t]] = v
+        self._idx_to_vec = nd.array(arr)
+
+
+@register
+class CustomEmbedding(_TokenEmbedding):
+    """Load embeddings from a local text file (reference
+    ``embedding.py:CustomEmbedding``)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding_txt(pretrained_file_path, elem_delim, encoding)
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference
+    ``embedding.py:CompositeEmbedding``)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        super().__init__(unknown_token=vocabulary.unknown_token)
+        self._vocab = vocabulary
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        parts = []
+        for emb in token_embeddings:
+            parts.append(emb.get_vecs_by_tokens(self._idx_to_token).asnumpy())
+        self._idx_to_vec = nd.array(np.concatenate(parts, axis=1))

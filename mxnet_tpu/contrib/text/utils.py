@@ -1,0 +1,20 @@
+"""Text helpers (reference ``python/mxnet/contrib/text/utils.py``)."""
+from __future__ import annotations
+
+import collections
+import re
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Count tokens (reference ``utils.py:count_tokens_from_str``)."""
+    source_str = re.sub(f"[{token_delim}{seq_delim}]+", " ", source_str)
+    if to_lower:
+        source_str = source_str.lower()
+    tokens = source_str.split()
+    if counter_to_update is None:
+        return collections.Counter(tokens)
+    counter_to_update.update(tokens)
+    return counter_to_update

@@ -1,0 +1,79 @@
+"""Vocabulary (reference ``python/mxnet/contrib/text/vocab.py``)."""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    """Indexed vocabulary from a token counter (reference
+    ``vocab.py:Vocabulary``)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        assert min_freq > 0
+        if reserved_tokens is not None:
+            assert unknown_token not in reserved_tokens
+            assert len(set(reserved_tokens)) == len(reserved_tokens), \
+                "reserved_tokens cannot contain duplicates"
+        self._unknown_token = unknown_token
+        self._idx_to_token = [unknown_token] + list(reserved_tokens or [])
+        self._reserved_tokens = list(reserved_tokens) \
+            if reserved_tokens else None
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        assert isinstance(counter, collections.Counter)
+        unknown_and_reserved = set(self._idx_to_token)
+        pairs = sorted(counter.items(), key=lambda x: (-x[1], x[0]))
+        count = 0
+        for token, freq in pairs:
+            if freq < min_freq or (most_freq_count is not None
+                                   and count >= most_freq_count):
+                break
+            if token in unknown_and_reserved:
+                continue
+            self._token_to_idx[token] = len(self._idx_to_token)
+            self._idx_to_token.append(token)
+            count += 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Tokens → indices; unknown → 0 (reference ``vocab.py:to_indices``)."""
+        single = isinstance(tokens, str)
+        if single:
+            tokens = [tokens]
+        idx = [self._token_to_idx.get(t, 0) for t in tokens]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        if single:
+            indices = [indices]
+        for i in indices:
+            if not 0 <= i < len(self):
+                raise ValueError(f"token index {i} out of range [0, "
+                                 f"{len(self)})")
+        toks = [self._idx_to_token[i] for i in indices]
+        return toks[0] if single else toks

@@ -1,0 +1,7 @@
+"""Estimator API (reference ``python/mxnet/gluon/contrib/estimator/``)."""
+from .estimator import Estimator  # noqa: F401
+from .event_handler import (  # noqa: F401
+    TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin, BatchEnd,
+    StoppingHandler, MetricHandler, ValidationHandler, LoggingHandler,
+    CheckpointHandler, EarlyStoppingHandler,
+)

@@ -1,0 +1,183 @@
+"""Estimator — the high-level Gluon fit loop (reference
+``python/mxnet/gluon/contrib/estimator/estimator.py:34,230``)."""
+from __future__ import annotations
+
+import copy
+import logging
+import warnings
+
+from .... import autograd, metric as metric_mod
+from ....ndarray import NDArray
+from ...trainer import Trainer
+from .event_handler import (
+    BatchBegin, BatchEnd, EpochBegin, EpochEnd, LoggingHandler,
+    MetricHandler, StoppingHandler, TrainBegin, TrainEnd, ValidationHandler,
+)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    """Train a Gluon net with event handlers (reference
+    ``estimator.py:34``)."""
+
+    def __init__(self, net, loss, metrics=None, initializer=None,
+                 trainer=None, context=None):
+        self.net = net
+        self.loss = self._check_loss(loss)
+        self.train_metrics = self._check_metrics(metrics)
+        self.max_epoch = None
+        self.max_batch = None
+        if initializer is not None:
+            self.net.initialize(init=initializer, force_reinit=True)
+        else:
+            try:
+                self.net.collect_params()
+                # initialize lazily if needed
+                for p in self.net.collect_params().values():
+                    if p._data is None and not p._deferred_init:
+                        self.net.initialize()
+                        break
+            except Exception:
+                pass
+        self.trainer = trainer if trainer is not None else Trainer(
+            self.net.collect_params(), "adam", {"learning_rate": 1e-3})
+
+    @staticmethod
+    def _check_loss(loss):
+        from ...loss import Loss
+        if isinstance(loss, Loss):
+            return [loss]
+        if isinstance(loss, list) and all(isinstance(l, Loss) for l in loss):
+            return loss
+        raise ValueError("loss must be a Loss or a list of Loss, "
+                         f"refer to gluon.loss; got {loss}")
+
+    @staticmethod
+    def _check_metrics(metrics):
+        if metrics is None:
+            return [metric_mod.Accuracy()]
+        if isinstance(metrics, metric_mod.EvalMetric):
+            return [metrics]
+        if isinstance(metrics, list) and \
+                all(isinstance(m, metric_mod.EvalMetric) for m in metrics):
+            return list(metrics)
+        raise ValueError("metrics must be an EvalMetric or a list of them; "
+                         f"got {metrics}")
+
+    @property
+    def val_metrics(self):
+        if not hasattr(self, "_val_metrics"):
+            self._val_metrics = [copy.deepcopy(m) for m in self.train_metrics]
+        return self._val_metrics
+
+    def evaluate(self, val_data, val_metrics=None, batch_axis=0):
+        """One validation sweep (reference ``estimator.py:170``)."""
+        val_metrics = val_metrics or self.val_metrics
+        for metric in val_metrics:
+            metric.reset()
+        for batch in val_data:
+            data, label = self._unpack_batch(batch)
+            pred = self.net(data)
+            for metric in val_metrics:
+                metric.update([label], [pred])
+        return [m.get() for m in val_metrics]
+
+    def _unpack_batch(self, batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return batch[0], batch[1]
+        if hasattr(batch, "data"):
+            return batch.data[0], batch.label[0]
+        raise ValueError("cannot unpack batch of type %s" % type(batch))
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None, batch_axis=0):
+        """The event-driven fit loop (reference ``estimator.py:230``)."""
+        self.max_epoch = epochs
+        self.max_batch = batches
+        if not epochs and not batches:
+            raise ValueError("please specify number of epochs or batches")
+
+        event_handlers = self._prepare_default_handlers(val_data,
+                                                        event_handlers)
+        train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
+            train_end = self._categorize_handlers(event_handlers)
+        stop_handlers = [h for h in event_handlers
+                         if hasattr(h, "stop_training")]
+
+        for handler in train_begin:
+            handler.train_begin(self)
+        stop = False
+        while not stop:
+            for handler in epoch_begin:
+                handler.epoch_begin(self)
+            for batch in train_data:
+                data, label = self._unpack_batch(batch)
+                for handler in batch_begin:
+                    handler.batch_begin(self, batch=batch)
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = [l(pred, label) for l in self.loss]
+                for l in loss:
+                    l.backward()
+                bs = data.shape[batch_axis]
+                self.trainer.step(bs)
+                for handler in batch_end:
+                    handler.batch_end(self, batch=batch, pred=[pred],
+                                      label=[label], loss=loss)
+                if any(h.stop_training for h in stop_handlers):
+                    stop = True
+                    break
+            if hasattr(train_data, "reset"):
+                train_data.reset()
+            if not stop:
+                for handler in epoch_end:
+                    handler.epoch_end(self)
+                stop = any(h.stop_training for h in stop_handlers)
+        for handler in train_end:
+            handler.train_end(self)
+
+    def _prepare_default_handlers(self, val_data, event_handlers):
+        event_handlers = list(event_handlers or [])
+        added = []
+        if not any(isinstance(h, StoppingHandler) for h in event_handlers):
+            event_handlers.append(StoppingHandler(self.max_epoch,
+                                                  self.max_batch))
+        if not any(isinstance(h, MetricHandler) for h in event_handlers):
+            event_handlers.append(MetricHandler(self.train_metrics))
+            added.append("MetricHandler")
+        if val_data is not None and \
+                not any(isinstance(h, ValidationHandler)
+                        for h in event_handlers):
+            event_handlers.append(ValidationHandler(
+                val_data=val_data, eval_fn=self.evaluate))
+            added.append("ValidationHandler")
+        if not any(isinstance(h, LoggingHandler) for h in event_handlers):
+            event_handlers.append(LoggingHandler(
+                metrics=self.train_metrics))
+            added.append("LoggingHandler")
+        if added:
+            warnings.warn("No handlers specified; default handlers added: "
+                          + ", ".join(added))
+        event_handlers.sort(key=lambda h: getattr(h, "priority", 0))
+        return event_handlers
+
+    @staticmethod
+    def _categorize_handlers(event_handlers):
+        train_begin, epoch_begin, batch_begin = [], [], []
+        batch_end, epoch_end, train_end = [], [], []
+        for handler in event_handlers:
+            if isinstance(handler, TrainBegin):
+                train_begin.append(handler)
+            if isinstance(handler, EpochBegin):
+                epoch_begin.append(handler)
+            if isinstance(handler, BatchBegin):
+                batch_begin.append(handler)
+            if isinstance(handler, BatchEnd):
+                batch_end.append(handler)
+            if isinstance(handler, EpochEnd):
+                epoch_end.append(handler)
+            if isinstance(handler, TrainEnd):
+                train_end.append(handler)
+        return (train_begin, epoch_begin, batch_begin, batch_end, epoch_end,
+                train_end)

@@ -1,0 +1,265 @@
+"""Estimator event handlers (reference
+``python/mxnet/gluon/contrib/estimator/event_handler.py:32``)."""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+import numpy as np
+
+__all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
+           "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop after max_epoch/max_batch (reference ``event_handler.py:78``)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.max_epoch = estimator.max_epoch
+        self.max_batch = estimator.max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch == self.max_batch:
+            self.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch == self.max_epoch:
+            self.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Update/reset train metrics (reference ``event_handler.py:127``)."""
+
+    def __init__(self, train_metrics):
+        self.train_metrics = train_metrics or []
+        self.priority = -np.inf
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for metric in self.train_metrics:
+            metric.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs["pred"]
+        label = kwargs["label"]
+        loss = kwargs["loss"]
+        for metric in self.train_metrics:
+            if metric.name and "loss" in metric.name:
+                metric.update(0, loss)
+            else:
+                metric.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run validation periodically (reference ``event_handler.py:182``)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.priority = priority
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+                     BatchEnd):
+    """Log metrics per epoch/batch (reference ``event_handler.py:248``)."""
+
+    def __init__(self, log_interval="epoch", metrics=None, priority=np.inf):
+        self.metrics = metrics or []
+        self.log_interval = log_interval
+        self.priority = priority
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.processed_samples = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        logging.info("Training begin: using optimizer %s with lr %s",
+                     type(estimator.trainer._optimizer).__name__,
+                     estimator.trainer.learning_rate)
+
+    def train_end(self, estimator, *args, **kwargs):
+        train_time = time.time() - self.train_start
+        msg = "Train finished using total %ds with %d epochs. " % (
+            train_time, self.current_epoch)
+        for metric in self.metrics:
+            name, value = metric.get()
+            msg += "%s: %.4f, " % (name, value)
+        logging.info(msg.rstrip(", "))
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        if self.log_interval is not None:
+            self.epoch_start = time.time()
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        if self.log_interval is not None:
+            epoch_time = time.time() - self.epoch_start
+            msg = "Epoch %d finished in %.3fs: " % (self.current_epoch,
+                                                    epoch_time)
+            for metric in self.metrics:
+                name, value = metric.get()
+                msg += "%s: %.4f, " % (name, value)
+            logging.info(msg.rstrip(", "))
+        self.current_epoch += 1
+        self.batch_index = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        if isinstance(self.log_interval, int):
+            batch_size = kwargs["batch"].data[0].shape[0] \
+                if hasattr(kwargs.get("batch"), "data") else 0
+            self.processed_samples += batch_size
+            if self.batch_index % self.log_interval == 0:
+                msg = "[Epoch %d][Batch %d] " % (self.current_epoch,
+                                                 self.batch_index)
+                for metric in self.metrics:
+                    name, value = metric.get()
+                    msg += "%s: %.4f, " % (name, value)
+                logging.info(msg.rstrip(", "))
+        self.batch_index += 1
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save parameters periodically (reference ``event_handler.py:358``)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5,
+                 resume_from_checkpoint=False):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.current_epoch = 0
+        self.current_batch = 0
+        os.makedirs(model_dir, exist_ok=True)
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_epoch = 0
+        self.current_batch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self._save(estimator, "batch%d" % self.current_batch)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self._save(estimator, "epoch%d" % self.current_epoch)
+
+    def _save(self, estimator, tag):
+        path = os.path.join(self.model_dir,
+                            "%s-%s.params" % (self.model_prefix, tag))
+        estimator.net.save_parameters(path)
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop when a monitored metric stops improving (reference
+    ``event_handler.py:557``)."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.baseline = baseline
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+        name = monitor.get()[0] if hasattr(monitor, "get") else str(monitor)
+        if mode == "min" or (mode == "auto" and "acc" not in name):
+            self.monitor_op = np.less
+            self.min_delta *= -1
+        else:
+            self.monitor_op = np.greater
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+        self.best = np.inf if self.monitor_op == np.less else -np.inf
+        if self.baseline is not None:
+            self.best = self.baseline
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        monitor_name, monitor_value = self.monitor.get()
+        if monitor_value is None or np.isnan(monitor_value):
+            self.current_epoch += 1
+            return
+        if self.monitor_op(monitor_value - self.min_delta, self.best):
+            self.best = monitor_value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = self.current_epoch
+                self.stop_training = True
+        self.current_epoch += 1
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch > 0:
+            logging.info("Epoch %d: early stopping due to %s",
+                         self.stopped_epoch, self.monitor.get()[0])

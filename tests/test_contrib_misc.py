@@ -1,0 +1,108 @@
+"""Estimator / launcher / rtc / text / SVRG tests."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.contrib.estimator import (
+    Estimator, EarlyStoppingHandler, CheckpointHandler,
+)
+
+
+def _toy(n=128, dim=6, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, dim).astype("float32")
+    y = (x.sum(axis=1) > 0).astype("float32")
+    return x, y
+
+
+def test_estimator_fit_and_evaluate():
+    x, y = _toy()
+    net = mx.gluon.nn.Dense(2, in_units=6)
+    net.initialize()
+    est = Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy(),
+                    trainer=mx.gluon.Trainer(net.collect_params(), "adam",
+                                             {"learning_rate": 0.05}))
+    loader = mx.gluon.data.DataLoader(mx.gluon.data.ArrayDataset(x, y),
+                                      batch_size=32)
+    with pytest.warns(UserWarning):
+        est.fit(loader, epochs=10)
+    res = est.evaluate(loader)
+    assert res[0][1] > 0.9, res
+
+
+def test_estimator_early_stopping_and_checkpoint(tmp_path):
+    x, y = _toy(64)
+    net = mx.gluon.nn.Dense(2, in_units=6)
+    net.initialize()
+    est = Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss())
+    loader = mx.gluon.data.DataLoader(mx.gluon.data.ArrayDataset(x, y),
+                                      batch_size=32)
+    handlers = [EarlyStoppingHandler(est.train_metrics[0], patience=1),
+                CheckpointHandler(str(tmp_path), epoch_period=1)]
+    est.fit(loader, epochs=5, event_handlers=handlers)
+    assert any(f.endswith(".params") for f in os.listdir(tmp_path))
+
+
+def test_launch_local(tmp_path):
+    """tools/launch.py spawns N workers with the coordinator env."""
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os\n"
+        "print('rank', os.environ['JAX_PROCESS_ID'],\n"
+        "      'of', os.environ['JAX_NUM_PROCESSES'])\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "tools", "launch.py"),
+         "-n", "2", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "rank 0 of 2" in out.stdout and "rank 1 of 2" in out.stdout
+
+
+def test_rtc_compat():
+    with pytest.raises(NotImplementedError):
+        mx.rtc.CudaModule("__global__ void k() {}")
+    src = "def double_k(x_ref, o_ref):\n    o_ref[:] = x_ref[:] * 2.0\n"
+    fn = mx.rtc.compile_pallas(src, "double_k", ((8, 128), "float32"))
+    import jax.numpy as jnp
+    out = fn(jnp.ones((8, 128), jnp.float32))
+    assert float(out.sum()) == 2 * 8 * 128
+
+
+def test_text_vocab_and_embedding(tmp_path):
+    from mxnet_tpu.contrib import text
+    counter = text.utils.count_tokens_from_str("a b b c c c\nd d d d")
+    vocab = text.Vocabulary(counter, min_freq=2, reserved_tokens=["<pad>"])
+    assert vocab.to_indices("d") == 2  # most frequent after unk/pad
+    assert vocab.to_tokens(0) == "<unk>"
+    assert len(vocab) == 5  # unk, pad, d, c, b
+
+    emb_file = tmp_path / "emb.txt"
+    emb_file.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = text.embedding.CustomEmbedding(str(emb_file))
+    v = emb.get_vecs_by_tokens(["hello", "nope"])
+    np.testing.assert_allclose(v.asnumpy(), [[1, 2, 3], [0, 0, 0]])
+    emb.update_token_vectors("world", mx.nd.array([9.0, 9.0, 9.0]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("world").asnumpy(), [9, 9, 9])
+    with pytest.raises(KeyError):
+        text.embedding.create("glove")
+
+
+def test_svrg_module_converges():
+    from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+    x, y = _toy(120)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc", num_hidden=2)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(x, y, batch_size=30, shuffle=True)
+    mod = SVRGModule(net, context=mx.cpu(), update_freq=2)
+    mod.fit(it, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 1.0})
+    score = mod.score(mx.io.NDArrayIter(x, y, batch_size=30), "acc")
+    assert score[0][1] > 0.9, score

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Distributed job launcher (reference ``tools/launch.py`` → dmlc_tracker).
+
+TPU-native redesign (SURVEY.md §2.3 "Cluster launcher"): there is no
+parameter-server role split — every process is a worker in one
+``jax.distributed`` job.  Local mode forks N processes on this host with the
+coordinator env protocol (the analog of the reference's ``DMLC_ROLE``/
+``DMLC_PS_ROOT_URI`` envs); on real TPU pods the runtime sets these
+automatically and this launcher is only needed for CPU emulation /
+multi-host GPU-style setups.
+
+Usage:  python tools/launch.py -n 4 [--launcher local] python train.py ...
+Inside train.py, ``mxnet_tpu`` picks up the env and ``kvstore='dist_sync'``
+spans the processes.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def launch_local(num_workers, command, port=29500):
+    """Spawn num_workers local processes in one jax.distributed job."""
+    procs = []
+    for rank in range(num_workers):
+        env = dict(os.environ)
+        env.update({
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": str(num_workers),
+            "JAX_PROCESS_ID": str(rank),
+            # reference-compatible aliases some scripts read
+            "DMLC_ROLE": "worker",
+            "DMLC_NUM_WORKER": str(num_workers),
+            "DMLC_WORKER_ID": str(rank),
+        })
+        procs.append(subprocess.Popen(command, env=env))
+
+    def _terminate(signum, frame):
+        for p in procs:
+            p.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, _terminate)
+    signal.signal(signal.SIGTERM, _terminate)
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed job (jax.distributed backend).")
+    parser.add_argument("-n", "--num-workers", type=int, required=True,
+                        help="number of worker processes")
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="ignored: there are no parameter servers on "
+                             "TPU — reduction is XLA collectives over ICI")
+    parser.add_argument("--launcher", default="local",
+                        choices=["local", "ssh", "mpi", "sge", "yarn"])
+    parser.add_argument("--port", type=int, default=29500)
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    if args.num_servers:
+        print("note: -s/--num-servers is ignored (no PS role on TPU)")
+    if args.launcher != "local":
+        raise NotImplementedError(
+            f"launcher {args.launcher!r}: multi-host jobs use the TPU pod "
+            "runtime (every host runs the same script; "
+            "jax.distributed.initialize discovers peers). The ssh/mpi/yarn "
+            "trackers of the reference are replaced by that runtime.")
+    assert args.command, "no command given"
+    return launch_local(args.num_workers, args.command, args.port)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
