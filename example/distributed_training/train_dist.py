@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Distributed data-parallel training (reference
+``example/distributed_training/`` — BASELINE config 5,
+``kvstore='dist_device_sync'``).
+
+Two ways to scale (SURVEY.md §5.8):
+
+1. **SPMD (recommended)** — one process per host, a global mesh over all
+   chips; XLA inserts the gradient allreduce over ICI/DCN.  On a TPU pod
+   every host runs this same script.
+2. **KVStore surface** — ``kvstore='dist_device_sync'`` keeps Trainer call
+   sites identical to the reference; ranks come from ``jax.distributed``.
+
+CPU emulation of 8 chips:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+      python example/distributed_training/train_dist.py
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def main():
+    import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # some site configs pin the accelerator platform via jax.config,
+        # which overrides the env var — honor the user's explicit request
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import (SPMDTrainer, FunctionalOptimizer,
+                                    make_mesh)
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--network", default="resnet18_v1")
+    parser.add_argument("--batch-size", type=int, default=64,
+                        help="GLOBAL batch size over the mesh")
+    parser.add_argument("--iters", type=int, default=30)
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel axis size")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    if "JAX_COORDINATOR_ADDRESS" in os.environ and \
+            int(os.environ.get("JAX_NUM_PROCESSES", "1")) > 1:
+        jax.distributed.initialize()
+    n = len(jax.devices())
+    logging.info("process %d/%d, %d devices total",
+                 jax.process_index(), jax.process_count(), n)
+
+    net = mx.gluon.model_zoo.vision.get_model(args.network, classes=100)
+    net.initialize()
+    net(mx.nd.zeros((1, 3, 32, 32)))
+    mesh = make_mesh(dp=n // args.tp, tp=args.tp)
+    trainer = SPMDTrainer(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          FunctionalOptimizer("sgd", 0.1, momentum=0.9),
+                          mesh)
+    rng = np.random.RandomState(jax.process_index())
+    x = rng.randn(args.batch_size, 3, 32, 32).astype("float32")
+    y = rng.randint(0, 100, size=(args.batch_size,)).astype("float32")
+    import time
+    loss = trainer.step(x, y)
+    jax.block_until_ready(trainer._state)
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        loss = trainer.step(x, y)
+    jax.block_until_ready(trainer._state)
+    dt = time.perf_counter() - t0
+    logging.info("%.1f imgs/sec over %d devices (dp=%d tp=%d), last loss "
+                 "%.4f", args.batch_size * args.iters / dt, n,
+                 n // args.tp, args.tp, float(loss.asnumpy()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Gluon MNIST (reference ``example/gluon/mnist/mnist.py`` — BASELINE
+config 1: LeNet/MLP via Gluon).  Uses local MNIST idx files when present
+under ``--data-dir``; otherwise synthetic digits so the script always runs
+in this zero-egress environment."""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def load_data(data_dir, batch_size):
+    import mxnet_tpu as mx
+    train_img = os.path.join(data_dir, "train-images-idx3-ubyte")
+    if os.path.exists(train_img) or os.path.exists(train_img + ".gz"):
+        train = mx.io.MNISTIter(
+            image=train_img,
+            label=os.path.join(data_dir, "train-labels-idx1-ubyte"),
+            batch_size=batch_size, shuffle=True)
+        val = mx.io.MNISTIter(
+            image=os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+            label=os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+            batch_size=batch_size, shuffle=False)
+        return train, val
+    logging.warning("MNIST files not found under %s; using synthetic "
+                    "blob-digit data", data_dir)
+    rng = np.random.RandomState(0)
+    n = 2048
+    y = rng.randint(0, 10, n)
+    x = np.zeros((n, 1, 28, 28), dtype="float32")
+    for i, cls in enumerate(y):
+        cy, cx = divmod(cls, 4)
+        x[i, 0, 4 + cy * 6:10 + cy * 6, 4 + cx * 6:10 + cx * 6] = 1.0
+    x += rng.rand(*x.shape).astype("float32") * 0.3
+    train = mx.io.NDArrayIter(x[:1536], y[:1536].astype("float32"),
+                              batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(x[1536:], y[1536:].astype("float32"), batch_size)
+    return train, val
+
+
+def build_net(kind):
+    from mxnet_tpu import gluon
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        if kind == "mlp":
+            net.add(gluon.nn.Dense(128, activation="relu"),
+                    gluon.nn.Dense(64, activation="relu"),
+                    gluon.nn.Dense(10))
+        else:  # lenet
+            net.add(gluon.nn.Conv2D(20, kernel_size=5, activation="relu"),
+                    gluon.nn.MaxPool2D(2, 2),
+                    gluon.nn.Conv2D(50, kernel_size=5, activation="relu"),
+                    gluon.nn.MaxPool2D(2, 2),
+                    gluon.nn.Dense(500, activation="relu"),
+                    gluon.nn.Dense(10))
+    return net
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--network", default="lenet", choices=["mlp", "lenet"])
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.02)
+    parser.add_argument("--data-dir", default=os.path.expanduser(
+        "~/.mxnet/datasets/mnist"))
+    parser.add_argument("--hybridize", action="store_true", default=True)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    train, val = load_data(args.data_dir, args.batch_size)
+    net = build_net(args.network)
+    ctx = mx.gpu(0) if mx.num_gpus() else mx.cpu(0)
+    net.initialize(ctx=ctx)
+    if args.hybridize:
+        net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        train.reset()
+        metric.reset()
+        for batch in train:
+            data = batch.data[0].as_in_context(ctx)
+            label = batch.label[0].as_in_context(ctx)
+            with mx.autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update([label], [out])
+        logging.info("epoch %d train acc %.4f", epoch, metric.get()[1])
+    val.reset()
+    metric.reset()
+    for batch in val:
+        out = net(batch.data[0].as_in_context(ctx))
+        metric.update([batch.label[0]], [out])
+    logging.info("validation acc %.4f", metric.get()[1])
+    return metric.get()[1]
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Inference throughput over the model zoo (reference
+``example/image-classification/benchmark_score.py`` — synthetic data)."""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def score(network, batch_size, image_shape=(3, 224, 224), iters=30):
+    import jax
+    import mxnet_tpu as mx
+
+    net = mx.gluon.model_zoo.vision.get_model(network, classes=1000)
+    ctx = mx.gpu(0) if mx.num_gpus() else mx.cpu(0)
+    net.initialize(ctx=ctx)
+    net(mx.nd.zeros((1,) + image_shape, ctx=ctx))
+    net.hybridize(static_alloc=True)
+    x = mx.nd.array(np.random.rand(batch_size, *image_shape), ctx=ctx)
+    out = net(x)
+    out.wait_to_read()  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = net(x)
+    out.wait_to_read()
+    dt = time.perf_counter() - t0
+    return batch_size * iters / dt
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--networks", nargs="+",
+                        default=["alexnet", "vgg16", "resnet50_v1",
+                                 "resnet152_v1", "inception_v3"])
+    parser.add_argument("--batch-sizes", nargs="+", type=int,
+                        default=[1, 32])
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    for network in args.networks:
+        shape = (3, 299, 299) if network == "inception_v3" else (3, 224, 224)
+        for bs in args.batch_sizes:
+            speed = score(network, bs, shape)
+            logging.info("network: %s batch: %d  %.1f images/sec",
+                         network, bs, speed)
+
+
+if __name__ == "__main__":
+    main()

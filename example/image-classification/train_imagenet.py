@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""ImageNet training (reference
+``example/image-classification/train_imagenet.py`` — BASELINE config 2).
+
+Same CLI shape as the reference: ``--network``, ``--batch-size``,
+``--num-epochs``, ``--kv-store``, and ``--benchmark 1`` for synthetic data
+(no IO).  Real data uses ``--data-train`` pointing at a RecordIO pack made
+with ``tools/im2rec.py``.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(description="train imagenet",
+                                     formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--network", type=str, default="resnet50_v1",
+                        help="model zoo name (e.g. resnet50_v1, vgg16)")
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--num-examples", type=int, default=1281167)
+    parser.add_argument("--image-shape", type=str, default="3,224,224")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-epochs", type=int, default=1)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--mom", type=float, default=0.9)
+    parser.add_argument("--wd", type=float, default=1e-4)
+    parser.add_argument("--kv-store", type=str, default="device")
+    parser.add_argument("--benchmark", type=int, default=0,
+                        help="1 = synthetic random data, no IO")
+    parser.add_argument("--benchmark-iters", type=int, default=50)
+    parser.add_argument("--data-train", type=str, default=None,
+                        help=".rec file from tools/im2rec.py")
+    parser.add_argument("--data-val", type=str, default=None)
+    parser.add_argument("--dtype", type=str, default="float32",
+                        choices=["float32", "bfloat16"])
+    parser.add_argument("--model-prefix", type=str, default=None)
+    parser.add_argument("--disp-batches", type=int, default=20)
+    return parser.parse_args()
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import (SPMDTrainer, FunctionalOptimizer,
+                                    make_mesh)
+
+    args = parse_args()
+    logging.basicConfig(level=logging.INFO)
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+
+    net = mx.gluon.model_zoo.vision.get_model(args.network,
+                                              classes=args.num_classes)
+    ctx = mx.gpu(0) if mx.num_gpus() else mx.cpu(0)
+    net.initialize(ctx=ctx)
+    net(mx.nd.zeros((1,) + shape, ctx=ctx))  # materialize deferred shapes
+    if args.dtype == "bfloat16":
+        from mxnet_tpu.contrib import amp
+        amp.init()
+
+    import jax
+    mesh = make_mesh(dp=len(jax.devices()))
+    trainer = SPMDTrainer(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+        FunctionalOptimizer("sgd", args.lr, momentum=args.mom, wd=args.wd),
+        mesh)
+
+    if args.benchmark:
+        import time
+        rng = np.random.RandomState(0)
+        x = rng.randn(args.batch_size, *shape).astype("float32")
+        y = rng.randint(0, args.num_classes,
+                        size=(args.batch_size,)).astype("float32")
+        trainer.step(x, y)  # compile
+        jax.block_until_ready(trainer._state)
+        t0 = time.perf_counter()
+        for i in range(args.benchmark_iters):
+            trainer.step(x, y)
+        jax.block_until_ready(trainer._state)
+        dt = time.perf_counter() - t0
+        logging.info("benchmark: %.2f images/sec",
+                     args.batch_size * args.benchmark_iters / dt)
+        return
+
+    assert args.data_train, "--data-train (or --benchmark 1) is required"
+    it = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train, data_shape=shape,
+        batch_size=args.batch_size, shuffle=True, rand_mirror=True,
+        rand_crop=True, resize=256, mean_r=123.68, mean_g=116.779,
+        mean_b=103.939, std_r=58.393, std_g=57.12, std_b=57.375)
+    metric = mx.metric.Accuracy()
+    for epoch in range(args.num_epochs):
+        it.reset()
+        metric.reset()
+        for i, batch in enumerate(it):
+            loss = trainer.step(batch.data[0], batch.label[0])
+            if i % args.disp_batches == 0:
+                logging.info("epoch %d batch %d loss %.4f", epoch, i,
+                             float(loss.asnumpy()))
+        trainer.sync_to_block()
+        if args.model_prefix:
+            net.save_parameters("%s-%04d.params" % (args.model_prefix,
+                                                    epoch + 1))
+
+
+if __name__ == "__main__":
+    main()

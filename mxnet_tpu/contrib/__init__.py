@@ -5,3 +5,5 @@ from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
 from . import text  # noqa: F401
 from . import svrg_optimization  # noqa: F401
+from . import tensorboard  # noqa: F401
+from . import tensorrt  # noqa: F401

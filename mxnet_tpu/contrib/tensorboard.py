@@ -1,0 +1,34 @@
+"""TensorBoard logging (reference ``python/mxnet/contrib/tensorboard.py``)."""
+from __future__ import annotations
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Batch-end callback streaming metrics to a SummaryWriter (reference
+    ``tensorboard.py:LogMetricsCallback``).  Works with any writer exposing
+    ``add_scalar`` (tensorboardX / torch.utils.tensorboard)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self.summary_writer = SummaryWriter(logging_dir)
+        except ImportError:
+            try:
+                from tensorboardX import SummaryWriter
+                self.summary_writer = SummaryWriter(logging_dir)
+            except ImportError as e:
+                raise ImportError(
+                    "LogMetricsCallback requires torch.utils.tensorboard or "
+                    "tensorboardX") from e
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)

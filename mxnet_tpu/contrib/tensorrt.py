@@ -1,0 +1,27 @@
+"""TensorRT integration (reference ``python/mxnet/contrib/tensorrt.py``).
+
+Not applicable on TPU: the reference's TRT subgraph path
+(``src/operator/subgraph/tensorrt``) exists to hand NVIDIA inference
+subgraphs to a faster engine; on TPU *XLA is that engine* — ``hybridize()``
+/ ``simple_bind`` already compile whole graphs.  These entry points explain
+rather than fail cryptically.
+"""
+from __future__ import annotations
+
+__all__ = ["set_use_fp16", "get_use_fp16", "init_tensorrt_params"]
+
+_MSG = ("TensorRT has no TPU role: graphs are already whole-program "
+        "compiled by XLA (hybridize()/simple_bind). For low precision use "
+        "contrib.amp (bfloat16); for INT8 use contrib.quantization.")
+
+
+def set_use_fp16(status):
+    raise NotImplementedError(_MSG)
+
+
+def get_use_fp16():
+    raise NotImplementedError(_MSG)
+
+
+def init_tensorrt_params(sym, arg_params, aux_params):
+    raise NotImplementedError(_MSG)

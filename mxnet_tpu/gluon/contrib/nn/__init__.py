@@ -1,0 +1,5 @@
+"""Gluon contrib layers (reference ``python/mxnet/gluon/contrib/nn/``)."""
+from .basic_layers import (  # noqa: F401
+    Concurrent, HybridConcurrent, Identity, SparseEmbedding, SyncBatchNorm,
+    PixelShuffle1D, PixelShuffle2D, PixelShuffle3D,
+)

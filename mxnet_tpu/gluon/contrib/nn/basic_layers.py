@@ -1,0 +1,155 @@
+"""Contrib layers (reference
+``python/mxnet/gluon/contrib/nn/basic_layers.py``)."""
+from __future__ import annotations
+
+from ... import nn
+from ...block import Block, HybridBlock
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
+
+
+class Concurrent(nn.Sequential):
+    """Run children on one input, concat outputs (reference
+    ``basic_layers.py:Concurrent``)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(HybridBlock):
+    """Hybridizable Concurrent (reference
+    ``basic_layers.py:HybridConcurrent``)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, block):
+        self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Identity mapping, for skip connections in Concurrent (reference
+    ``basic_layers.py:Identity``)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding with row-sparse gradients in the reference
+    (``basic_layers.py:SparseEmbedding``); on TPU gradients are dense and
+    XLA scatters efficiently, so this is Embedding with the sparse contract
+    documented away (SURVEY.md hard-part 4)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        with self.name_scope():
+            self._embed = nn.Embedding(input_dim, output_dim, dtype=dtype,
+                                       weight_initializer=weight_initializer)
+
+    def forward(self, x):
+        return self._embed(x)
+
+    def __repr__(self):
+        return f"SparseEmbedding({self._input_dim} -> {self._output_dim})"
+
+
+class SyncBatchNorm(nn.BatchNorm):
+    """Cross-device synchronized BatchNorm (reference
+    ``basic_layers.py:SyncBatchNorm`` / ``sync_batch_norm.cc``).
+
+    Under the SPMD trainer the batch axis is sharded over the mesh and XLA
+    computes batch statistics *globally* by construction — so the plain
+    BatchNorm already is a SyncBatchNorm there; this subclass keeps the
+    explicit name/arg surface (``num_devices`` is accepted and unused).
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=running_variance_initializer,
+                         in_channels=in_channels, **kwargs)
+
+
+class _PixelShuffle(HybridBlock):
+    def __init__(self, factor, ndim, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(factor, int):
+            factor = (factor,) * ndim
+        self._factor = tuple(int(f) for f in factor)
+        self._ndim = ndim
+
+    def hybrid_forward(self, F, x):
+        from .... import ndarray as nd_mod
+        import jax.numpy as jnp
+
+        f = self._factor
+        nd = self._ndim
+
+        def shuffle(a):
+            n, c = a.shape[0], a.shape[1]
+            spatial = a.shape[2:]
+            prod = 1
+            for x_ in f:
+                prod *= x_
+            c_out = c // prod
+            a = a.reshape((n, c_out) + f + tuple(spatial))
+            # interleave: (n, c_out, f1.., s1..) -> (n, c_out, s1, f1, ...)
+            perm = [0, 1]
+            for i in range(nd):
+                perm.extend([2 + nd + i, 2 + i])
+            a = jnp.transpose(a, perm)
+            out_spatial = tuple(s * ff for s, ff in zip(spatial, f))
+            return a.reshape((n, c_out) + out_spatial)
+
+        return nd_mod.invoke_fn(shuffle, [x]) \
+            if isinstance(x, nd_mod.NDArray) else shuffle(x)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(factor={self._factor})"
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, C·f, W) → (N, C, W·f) (reference ``basic_layers.py``)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 1, **kwargs)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, C·f1·f2, H, W) → (N, C, H·f1, W·f2)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 2, **kwargs)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, C·f1·f2·f3, D, H, W) → (N, C, D·f1, H·f2, W·f3)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 3, **kwargs)

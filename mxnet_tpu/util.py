@@ -1,0 +1,71 @@
+"""Misc utilities (reference ``python/mxnet/util.py``)."""
+from __future__ import annotations
+
+import functools
+import os
+
+__all__ = ["makedirs", "TemporaryDirectory", "use_np_shape", "is_np_shape",
+           "set_np_shape", "np_shape", "get_gpu_count", "get_gpu_memory"]
+
+
+def makedirs(d):
+    """Create directory recursively if not exists (reference
+    ``util.py:makedirs``)."""
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+from tempfile import TemporaryDirectory  # noqa: E402,F401  (py3 builtin)
+
+_np_shape = [True]  # zero-dim/zero-size shapes are native in this framework
+
+
+def is_np_shape():
+    """NumPy shape semantics flag (reference ``util.py:is_np_shape``).
+    Always-on here: jax arrays are numpy-semantic natively."""
+    return _np_shape[0]
+
+
+def set_np_shape(active):
+    prev = _np_shape[0]
+    _np_shape[0] = bool(active)
+    return prev
+
+
+class np_shape:
+    """Scope for numpy shape semantics (reference ``util.py:np_shape``)."""
+
+    def __init__(self, active=True):
+        self._active = active
+
+    def __enter__(self):
+        self._prev = set_np_shape(self._active)
+        return self
+
+    def __exit__(self, *a):
+        set_np_shape(self._prev)
+
+
+def use_np_shape(func):
+    """Decorator form (reference ``util.py:use_np_shape``)."""
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with np_shape(True):
+            return func(*args, **kwargs)
+    return wrapper
+
+
+def get_gpu_count():
+    from .context import num_gpus
+    return num_gpus()
+
+
+def get_gpu_memory(gpu_dev_id=0):
+    """Reference queries cudaMemGetInfo; XLA owns HBM accounting — report
+    via jax memory stats when available."""
+    import jax
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if not devs:
+        raise ValueError("no accelerator device")
+    stats = devs[gpu_dev_id % len(devs)].memory_stats() or {}
+    free = stats.get("bytes_limit", 0) - stats.get("bytes_in_use", 0)
+    return free, stats.get("bytes_limit", 0)
