@@ -133,12 +133,30 @@ def make_op_func(op):
                     result = out
         elif writeback:
             outs = result if isinstance(result, list) else [result]
-            for in_idx, out_idx in writeback:
-                nd_inputs[in_idx]._data = outs[out_idx]._data
-            result = nd_inputs[writeback[0][0]]
+            if isinstance(writeback, tuple) and writeback[0] == "strided":
+                # multi-tensor updates: per-group (in_off, out_off) pairs
+                # repeated every (in_stride, out_stride) tensors
+                _, in_stride, out_stride, pairs = writeback
+                ngroups = len(outs) // out_stride
+                updated = []
+                for g in range(ngroups):
+                    for io, oo in pairs:
+                        nd_inputs[g * in_stride + io]._data = \
+                            outs[g * out_stride + oo]._data
+                    updated.append(nd_inputs[g * in_stride + pairs[0][0]])
+                result = updated if len(updated) > 1 else updated[0]
+            else:
+                for in_idx, out_idx in writeback:
+                    nd_inputs[in_idx]._data = outs[out_idx]._data
+                result = nd_inputs[writeback[0][0]]
             if out is not None:
-                out._data = result._data
-                result = out
+                if isinstance(result, list):
+                    for o, r in zip(out, result):
+                        o._data = r._data
+                    result = out
+                else:
+                    out._data = result._data
+                    result = out
         if ctx is not None and isinstance(result, NDArray) and not nd_inputs:
             result = result.as_in_context(ctx)
         return result

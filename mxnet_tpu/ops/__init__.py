@@ -11,6 +11,7 @@ from . import linalg_ops  # noqa: F401
 from . import image_ops  # noqa: F401
 from . import contrib_ops  # noqa: F401
 from . import quantization_ops  # noqa: F401
+from . import extra_ops  # noqa: F401
 from . import pallas_kernels  # noqa: F401
 
 from .registry import get, list_ops, register, require  # noqa: F401

@@ -1,0 +1,439 @@
+"""Remaining reference operators: legacy aliases, spatial sampling ops,
+multi-tensor optimizer updates, quantized-op wrappers.
+
+Closes the gap against the reference's ``NNVM_REGISTER_OP`` /
+``MXNET_REGISTER_OP_PROPERTY`` inventory (SURVEY.md §2.1).  Deliberately
+absent: the DGL graph-sampling suite, MKL-DNN/TensorRT subgraph internals,
+and cross-device copy ops (no meaning under XLA; SURVEY.md §7).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import parse_bool, parse_float, parse_int, parse_tuple
+from . import optimizer_ops as K
+from . import quantization_ops as Q
+from .registry import get, register
+from .optimizer_ops import INPLACE_UPDATES
+
+
+def _alias(new_name, old_name, extra=()):
+    op = get(old_name)
+    assert op is not None, old_name
+    register(new_name, aliases=extra, wrap_list=op.wrap_list)(op.fn)
+    if old_name in INPLACE_UPDATES:
+        INPLACE_UPDATES[new_name] = INPLACE_UPDATES[old_name]
+
+
+# ---------------------------------------------------------------- aliases
+_alias("_split_v2", "split_v2")
+_alias("_contrib_boolean_mask", "boolean_mask")
+_alias("BatchNorm_v1", "BatchNorm")        # legacy pre-NNVM registrations
+_alias("Convolution_v1", "Convolution")
+_alias("Pooling_v1", "Pooling")
+_alias("_rnn_param_concat", "concat")
+_alias("_contrib_SyncBatchNorm", "BatchNorm")  # stats are global under SPMD
+_alias("_contrib_SparseEmbedding", "Embedding")
+
+
+@register("_identity_with_attr_like_rhs")
+def identity_with_attr_like_rhs(lhs, rhs):
+    return lhs
+
+
+@register("_zeros_without_dtype")
+def zeros_without_dtype(shape=None, ctx=None, dtype=None):
+    return jnp.zeros(parse_tuple(shape) or (), jnp.float32)
+
+
+@register("cast_storage")
+def cast_storage(data, stype="default"):
+    """Dense↔sparse storage cast (reference ``cast_storage-inl.h``) —
+    payloads are dense on TPU, so this is the identity; the frontend
+    classes carry the stype tag (ndarray/sparse.py)."""
+    return data
+
+
+@register("_sparse_retain", aliases=("sparse_retain",))
+def sparse_retain(data, indices):
+    """Keep only the requested rows (reference sparse_retain)."""
+    mask = jnp.zeros((data.shape[0],), dtype=bool).at[
+        indices.astype(jnp.int32)].set(True)
+    return jnp.where(mask.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0)
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    """Reference ``softmax_cross_entropy`` op: summed CE over the batch."""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    picked = jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None],
+                                 axis=-1)
+    return -jnp.sum(picked)
+
+
+@register("MakeLoss", aliases=("make_loss",))
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    """Loss terminal (reference ``make_loss.cc``): forward identity, backward
+    ignores the incoming cotangent and emits ``grad_scale`` (optionally
+    normalized)."""
+    gs = parse_float(grad_scale, 1.0)
+    norm = str(normalization)
+
+    @jax.custom_vjp
+    def _f(x):
+        return x
+
+    def _fwd(x):
+        return x, x.shape
+
+    def _bwd(shape, g):
+        scale = gs
+        if norm == "batch":
+            scale = scale / shape[0]
+        elif norm == "valid":
+            scale = scale / max(1, int(jnp.prod(jnp.asarray(shape))))
+        return (jnp.full(shape, scale, dtype=jnp.float32),)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(data)
+
+
+# ------------------------------------------------------- spatial sampling
+def _bilinear_sample(data, gx, gy):
+    """Sample NCHW ``data`` at pixel coords (gx, gy) with zero padding
+    (the cuDNN BilinearSampler contract, src/operator/bilinear_sampler.cc)."""
+    n, c, h, w = data.shape
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    x1, y1 = x0 + 1, y0 + 1
+
+    def gather(yy, xx):
+        inside = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        # (N, Ho, Wo) index maps applied per batch via take_along_axis
+        flat = data.reshape(n, c, h * w)
+        idx = (yc * w + xc).reshape(n, 1, -1)
+        vals = jnp.take_along_axis(flat, idx, axis=2)
+        vals = vals.reshape(n, c, *gx.shape[1:])
+        return vals * inside[:, None].astype(data.dtype)
+
+    wx1 = (gx - x0)[:, None]
+    wy1 = (gy - y0)[:, None]
+    out = (gather(y0, x0) * (1 - wx1) * (1 - wy1) +
+           gather(y0, x1) * wx1 * (1 - wy1) +
+           gather(y1, x0) * (1 - wx1) * wy1 +
+           gather(y1, x1) * wx1 * wy1)
+    return out
+
+
+@register("BilinearSampler")
+def bilinear_sampler(data, grid, cudnn_off=False):
+    """Reference ``bilinear_sampler.cc``: grid (N, 2, Ho, Wo) in [-1, 1]
+    (x, y) order; zero padding outside."""
+    _, _, h, w = data.shape
+    gx = (grid[:, 0] + 1) * (w - 1) / 2
+    gy = (grid[:, 1] + 1) * (h - 1) / 2
+    return _bilinear_sample(data, gx, gy)
+
+
+@register("GridGenerator")
+def grid_generator(data, transform_type="affine", target_shape="(0, 0)"):
+    """Reference ``grid_generator.cc``: affine (N,6) θ → sampling grid, or
+    warp flow (N,2,H,W) → grid; output normalized to [-1,1]."""
+    tt = str(transform_type)
+    if tt == "affine":
+        th, tw = parse_tuple(target_shape)
+        n = data.shape[0]
+        theta = data.reshape(n, 2, 3)
+        ys = jnp.linspace(-1, 1, th)
+        xs = jnp.linspace(-1, 1, tw)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], 0).reshape(3, -1)  # (3, H*W)
+        out = jnp.einsum("nij,jk->nik", theta, base)  # (N, 2, H*W)
+        return out.reshape(n, 2, th, tw)
+    # warp: flow field added to the identity grid, renormalized
+    n, _, h, w = data.shape
+    gy, gx = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
+                          jnp.arange(w, dtype=jnp.float32), indexing="ij")
+    x = gx[None] + data[:, 0]
+    y = gy[None] + data[:, 1]
+    xn = 2 * x / jnp.maximum(w - 1, 1) - 1
+    yn = 2 * y / jnp.maximum(h - 1, 1) - 1
+    return jnp.stack([xn, yn], 1)
+
+
+@register("SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=None,
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=False):
+    """Reference ``spatial_transformer.cc``: affine grid from ``loc`` then
+    bilinear sampling."""
+    grid = grid_generator(loc, "affine", target_shape)
+    return bilinear_sampler(data, grid)
+
+
+@register("UpSampling", wrap_list=True)
+def upsampling(*args, scale=1, sample_type="nearest", num_args=1,
+               num_filter=0, multi_input_mode="concat", workspace=None):
+    """Reference ``upsampling.cc``: nearest (repeat) or bilinear resize of
+    NCHW inputs; multiple inputs upsample to the first's scaled size then
+    concat."""
+    s = parse_int(scale, 1)
+    data = args[0]
+    n, c, h, w = data.shape
+    th, tw = h * s, w * s
+    outs = []
+    for x in args:
+        if str(sample_type) == "nearest":
+            out = jnp.repeat(jnp.repeat(x, th // x.shape[2], axis=2),
+                             tw // x.shape[3], axis=3)
+        else:
+            out = jax.image.resize(x.astype(jnp.float32),
+                                   (x.shape[0], x.shape[1], th, tw),
+                                   method="bilinear").astype(x.dtype)
+        outs.append(out)
+    if len(outs) == 1:
+        return outs[0]
+    if str(multi_input_mode) == "sum":
+        return sum(outs)
+    return jnp.concatenate(outs, axis=1)
+
+
+@register("Crop", aliases=("crop_v1",))
+def crop_legacy(*args, offset="(0, 0)", h_w="(0, 0)", num_args=1,
+                center_crop=False):
+    """Legacy ``Crop`` op (src/operator/crop.cc): crop args[0] to h_w (or to
+    args[1]'s spatial size when two inputs are given)."""
+    data = args[0]
+    if len(args) > 1:
+        th, tw = args[1].shape[2], args[1].shape[3]
+    else:
+        th, tw = parse_tuple(h_w)
+    if parse_bool(center_crop):
+        y0 = (data.shape[2] - th) // 2
+        x0 = (data.shape[3] - tw) // 2
+    else:
+        y0, x0 = parse_tuple(offset)
+    return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+@register("_contrib_index_copy", aliases=("index_copy",))
+def index_copy(old, index, new):
+    """Reference ``index_copy.cc``: rows of ``old`` at ``index`` replaced."""
+    return old.at[index.astype(jnp.int32)].set(new)
+
+
+@register("_contrib_index_array", aliases=("index_array",))
+def index_array(data, axes=None):
+    """Reference ``index_array.cc``: per-element N-d indices."""
+    shape = data.shape
+    axes_t = parse_tuple(axes) if axes is not None else tuple(
+        range(len(shape)))
+    comps = [jax.lax.broadcasted_iota(jnp.int64, shape, ax) for ax in axes_t]
+    return jnp.stack(comps, axis=-1)
+
+
+@register("_contrib_arange_like", aliases=("arange_like",))
+def arange_like(data, start=0.0, step=1.0, repeat=1, ctx=None, axis=None):
+    """Reference ``arange_like``: arange shaped like data (or its axis)."""
+    st = parse_float(start, 0.0)
+    sp = parse_float(step, 1.0)
+    if axis is not None:
+        n = data.shape[parse_int(axis)]
+        return st + sp * jnp.arange(n, dtype=jnp.float32)
+    n = data.size
+    return (st + sp * jnp.arange(n, dtype=jnp.float32)).reshape(data.shape)
+
+
+# ------------------------------------------------ multi-tensor optimizers
+def _ftuple(v):
+    import ast
+    if isinstance(v, str):
+        v = ast.literal_eval(v)
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+def _multi_update(arrays, num_weights, lrs, wds, step_fn, tensors_per, mom=None):
+    """Shared driver for the ``multi_sgd_*`` family (reference
+    optimizer_op.cc aggregated updates): interleaved
+    (weight, grad[, mom][, weight32]) × num_weights."""
+    lrs = _ftuple(lrs)
+    wds = _ftuple(wds)
+    outs = []
+    for i in range(num_weights):
+        group = arrays[i * tensors_per:(i + 1) * tensors_per]
+        outs.extend(step_fn(i, group, lrs[i], wds[i]))
+    return tuple(outs)
+
+
+@register("multi_sgd_update", wrap_list=True)
+def multi_sgd_update(*arrays, lrs=None, wds=None, rescale_grad=1.0,
+                     clip_gradient=-1.0, num_weights=1):
+    num_weights = parse_int(num_weights, 1)
+
+    def step(i, group, lr, wd):
+        w, g = group
+        return [K.sgd_update(w, g, lr=lr, wd=wd, rescale_grad=rescale_grad,
+                             clip_gradient=clip_gradient)]
+    return _multi_update(arrays, num_weights, lrs, wds, step, 2)
+
+
+@register("multi_sgd_mom_update", wrap_list=True)
+def multi_sgd_mom_update(*arrays, lrs=None, wds=None, momentum=0.0,
+                         rescale_grad=1.0, clip_gradient=-1.0,
+                         num_weights=1):
+    num_weights = parse_int(num_weights, 1)
+
+    def step(i, group, lr, wd):
+        w, g, m = group
+        return list(K.sgd_mom_update(w, g, m, lr=lr, momentum=momentum,
+                                     wd=wd, rescale_grad=rescale_grad,
+                                     clip_gradient=clip_gradient))
+    return _multi_update(arrays, num_weights, lrs, wds, step, 3)
+
+
+@register("multi_mp_sgd_update", wrap_list=True)
+def multi_mp_sgd_update(*arrays, lrs=None, wds=None, rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=1):
+    num_weights = parse_int(num_weights, 1)
+
+    def step(i, group, lr, wd):
+        w, g, w32 = group
+        return list(K.mp_sgd_update(w, g, w32, lr=lr, wd=wd,
+                                    rescale_grad=rescale_grad,
+                                    clip_gradient=clip_gradient))
+    return _multi_update(arrays, num_weights, lrs, wds, step, 3)
+
+
+@register("multi_mp_sgd_mom_update", wrap_list=True)
+def multi_mp_sgd_mom_update(*arrays, lrs=None, wds=None, momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0,
+                            num_weights=1):
+    num_weights = parse_int(num_weights, 1)
+
+    def step(i, group, lr, wd):
+        w, g, m, w32 = group
+        return list(K.mp_sgd_mom_update(w, g, m, w32, lr=lr,
+                                        momentum=momentum, wd=wd,
+                                        rescale_grad=rescale_grad,
+                                        clip_gradient=clip_gradient))
+    return _multi_update(arrays, num_weights, lrs, wds, step, 4)
+
+
+@register("mp_nag_mom_update")
+def mp_nag_mom_update(weight, grad, mom, weight32, lr=None, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """fp32 master-weight NAG (reference optimizer_op.cc)."""
+    w32, m = K.nag_mom_update(weight32, grad.astype(jnp.float32), mom,
+                              lr=lr, momentum=momentum, wd=wd,
+                              rescale_grad=rescale_grad,
+                              clip_gradient=clip_gradient)
+    return w32.astype(weight.dtype), m, w32
+
+
+@register("_mp_adamw_update", aliases=("mp_adamw_update",))
+def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad=None,
+                    lr=None, eta=1.0, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                    wd=0.0, clip_gradient=-1.0):
+    w32, m, v = K.adamw_update(weight32, grad.astype(jnp.float32), mean, var,
+                               rescale_grad=rescale_grad, lr=lr, eta=eta,
+                               beta1=beta1, beta2=beta2, epsilon=epsilon,
+                               wd=wd, clip_gradient=clip_gradient)
+    return w32.astype(weight.dtype), m, v, w32
+
+
+@register("_contrib_group_adagrad_update", aliases=("group_adagrad_update",))
+def group_adagrad_update(weight, grad, history, lr=None, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5):
+    """Row-wise AdaGrad (reference contrib group_adagrad: one accumulator
+    per row)."""
+    g = grad * parse_float(rescale_grad, 1.0)
+    cg = parse_float(clip_gradient)
+    if cg is not None and cg > 0:
+        g = jnp.clip(g, -cg, cg)
+    sq = jnp.mean(jnp.square(g), axis=tuple(range(1, g.ndim)))
+    new_hist = history + sq
+    denom = jnp.sqrt(new_hist) + parse_float(epsilon, 1e-5)
+    shape = (-1,) + (1,) * (g.ndim - 1)
+    return weight - parse_float(lr) * g / denom.reshape(shape), new_hist
+
+
+# register the in-place writeback contracts for the frontend
+INPLACE_UPDATES.update({
+    "multi_sgd_update": ("strided", 2, 1, [(0, 0)]),
+    "multi_sgd_mom_update": ("strided", 3, 2, [(0, 0), (2, 1)]),
+    "multi_mp_sgd_update": ("strided", 3, 2, [(0, 0), (2, 1)]),
+    "multi_mp_sgd_mom_update": ("strided", 4, 3,
+                                [(0, 0), (2, 1), (3, 2)]),
+    "mp_nag_mom_update": [(0, 0), (2, 1), (3, 2)],
+    "_mp_adamw_update": [(0, 0), (2, 1), (3, 2), (4, 3)],
+    "mp_adamw_update": [(0, 0), (2, 1), (3, 2), (4, 3)],
+    "_contrib_group_adagrad_update": [(0, 0), (2, 1)],
+    "group_adagrad_update": [(0, 0), (2, 1)],
+})
+
+
+# ------------------------------------------------------- quantized ops
+def _dequant(q, mn, mx):
+    return Q.dequantize(q, mn, mx)
+
+
+def _requant_out(f):
+    amax = jnp.maximum(jnp.abs(jnp.min(f)), jnp.abs(jnp.max(f)))
+    scale = 127.0 / jnp.maximum(amax, 1e-20)
+    q = jnp.clip(jnp.round(f * scale), -127, 127).astype(jnp.int8)
+    return q, -amax, amax
+
+
+def _quantized_wrapper(float_op_name, n_tensors):
+    """Quantized op = dequantize inputs → float kernel → requantize
+    (the reference's int8 kernels with identical numerical contract;
+    SURVEY.md §2.1 quantization row — XLA folds the dq/q pairs)."""
+    fop = get(float_op_name)
+
+    def fn(*args, **attrs):
+        tensors = args[:n_tensors]
+        ranges = args[n_tensors:]
+        deq = [_dequant(t, ranges[2 * i], ranges[2 * i + 1])
+               if t.dtype in (jnp.int8, jnp.uint8) else t
+               for i, t in enumerate(tensors)]
+        out = fop.fn(*deq, **attrs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return _requant_out(out)
+    return fn
+
+
+register("_contrib_quantized_fully_connected",
+         aliases=("quantized_fully_connected",))(
+    _quantized_wrapper("FullyConnected", 3))
+register("_contrib_quantized_conv", aliases=("quantized_conv",))(
+    _quantized_wrapper("Convolution", 3))
+register("_contrib_quantized_pooling", aliases=("quantized_pooling",))(
+    _quantized_wrapper("Pooling", 1))
+register("_contrib_quantized_act", aliases=("quantized_act",))(
+    _quantized_wrapper("Activation", 1))
+register("_contrib_quantized_flatten", aliases=("quantized_flatten",))(
+    _quantized_wrapper("Flatten", 1))
+
+
+@register("_contrib_quantized_elemwise_add", aliases=("quantized_elemwise_add",))
+def quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    f = _dequant(lhs, lhs_min, lhs_max) + _dequant(rhs, rhs_min, rhs_max)
+    return _requant_out(f)
+
+
+@register("_contrib_quantized_concat", aliases=("quantized_concat",),
+          wrap_list=True)
+def quantized_concat(*args, num_args=1, dim=1):
+    n = parse_int(num_args, 1)
+    tensors = args[:n]
+    ranges = args[n:]
+    deq = [_dequant(t, ranges[2 * i], ranges[2 * i + 1])
+           for i, t in enumerate(tensors)]
+    return _requant_out(jnp.concatenate(deq, axis=parse_int(dim, 1)))

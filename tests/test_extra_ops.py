@@ -1,0 +1,121 @@
+"""Tests for the remaining reference operators (extra_ops.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_legacy_aliases_exist():
+    for name in ("BatchNorm_v1", "Convolution_v1", "Pooling_v1",
+                 "_split_v2", "_rnn_param_concat"):
+        from mxnet_tpu.ops import registry
+        assert registry.get(name) is not None, name
+
+
+def test_upsampling_nearest_and_bilinear():
+    x = mx.nd.array(np.arange(4, dtype="float32").reshape(1, 1, 2, 2))
+    up = mx.nd.UpSampling(x, scale=2, sample_type="nearest")
+    assert up.shape == (1, 1, 4, 4)
+    np.testing.assert_array_equal(up.asnumpy()[0, 0],
+                                  [[0, 0, 1, 1], [0, 0, 1, 1],
+                                   [2, 2, 3, 3], [2, 2, 3, 3]])
+    up2 = mx.nd.UpSampling(x, scale=2, sample_type="bilinear", num_filter=1)
+    assert up2.shape == (1, 1, 4, 4)
+
+
+def test_spatial_transformer_identity():
+    """Identity affine θ = [1,0,0,0,1,0] reproduces the input."""
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.rand(2, 3, 8, 8).astype("float32"))
+    theta = mx.nd.array(np.tile([1, 0, 0, 0, 1, 0], (2, 1)).astype("float32"))
+    out = mx.nd.SpatialTransformer(x, theta, target_shape=(8, 8),
+                                   transform_type="affine",
+                                   sampler_type="bilinear")
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_bilinear_sampler_shift():
+    """Grid shifted fully off-image samples zeros (border padding off)."""
+    x = mx.nd.ones((1, 1, 4, 4))
+    grid = mx.nd.array(np.full((1, 2, 4, 4), 5.0, dtype="float32"))
+    out = mx.nd.BilinearSampler(x, grid)
+    assert float(out.asnumpy().sum()) == 0.0
+
+
+def test_grid_generator_warp():
+    flow = mx.nd.zeros((1, 2, 4, 4))
+    grid = mx.nd.GridGenerator(flow, transform_type="warp")
+    g = grid.asnumpy()
+    assert g[0, 0, 0, 0] == -1 and g[0, 0, -1, -1] == 1
+
+
+def test_make_loss_gradient():
+    x = mx.nd.array(np.random.rand(4, 3).astype("float32"))
+    x.attach_grad()
+    with mx.autograd.record():
+        loss = mx.nd.MakeLoss(x * 2, grad_scale=3.0)
+    loss.backward()
+    # d/dx (2x) with loss-grad 3 → 6
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full((4, 3), 6.0),
+                               rtol=1e-5)
+
+
+def test_softmax_cross_entropy():
+    data = mx.nd.array([[10.0, 0.0], [0.0, 10.0]])
+    label = mx.nd.array([0.0, 1.0])
+    out = mx.nd.softmax_cross_entropy(data, label)
+    assert float(out.asscalar()) < 0.01
+
+
+def test_index_copy_and_index_array():
+    old = mx.nd.zeros((4, 2))
+    new = mx.nd.ones((2, 2)) * 7
+    out = mx.nd.contrib.index_copy(old, mx.nd.array([1, 3], dtype="int32"),
+                                   new)
+    assert out.asnumpy()[1, 0] == 7 and out.asnumpy()[0, 0] == 0
+    ia = mx.nd.contrib.index_array(mx.nd.zeros((2, 3)))
+    assert ia.shape == (2, 3, 2)
+    assert ia.asnumpy()[1, 2].tolist() == [1, 2]
+
+
+def test_arange_like():
+    x = mx.nd.zeros((2, 3))
+    out = mx.nd.contrib.arange_like(x)
+    np.testing.assert_array_equal(out.asnumpy().ravel(), np.arange(6))
+    out2 = mx.nd.contrib.arange_like(x, axis=1, start=5)
+    np.testing.assert_array_equal(out2.asnumpy(), [5, 6, 7])
+
+
+def test_multi_sgd_update():
+    w1, w2 = mx.nd.ones((3,)), mx.nd.ones((2, 2))
+    g1, g2 = mx.nd.ones((3,)), mx.nd.ones((2, 2))
+    out = mx.nd.multi_sgd_update(w1, g1, w2, g2, lrs=(0.1, 0.5),
+                                 wds=(0.0, 0.0), num_weights=2)
+    np.testing.assert_allclose(out[0].asnumpy(), np.full(3, 0.9), rtol=1e-6)
+    np.testing.assert_allclose(out[1].asnumpy(), np.full((2, 2), 0.5),
+                               rtol=1e-6)
+    # in-place writeback into the weight NDArrays
+    np.testing.assert_allclose(w1.asnumpy(), np.full(3, 0.9), rtol=1e-6)
+
+
+def test_quantized_fully_connected():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8).astype("float32")
+    w = rng.randn(16, 8).astype("float32")
+    b = rng.randn(16).astype("float32")
+    qx, xmn, xmx = mx.nd.contrib.quantize_v2(mx.nd.array(x), out_type="int8")
+    qw, wmn, wmx = mx.nd.contrib.quantize_v2(mx.nd.array(w), out_type="int8")
+    qb, bmn, bmx = mx.nd.contrib.quantize_v2(mx.nd.array(b), out_type="int8")
+    qo, omn, omx = mx.nd.contrib.quantized_fully_connected(
+        qx, qw, qb, xmn, xmx, wmn, wmx, bmn, bmx, num_hidden=16)
+    out = mx.nd.contrib.dequantize(qo, omn, omx).asnumpy()
+    ref = x @ w.T + b
+    assert np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6) < 0.05
+
+
+def test_sparse_retain_op():
+    data = mx.nd.array(np.arange(8, dtype="float32").reshape(4, 2))
+    out = mx.nd.sparse_retain(data, mx.nd.array([0, 2]))
+    assert out.asnumpy()[1].sum() == 0
+    np.testing.assert_array_equal(out.asnumpy()[2], [4, 5])
