@@ -1,0 +1,319 @@
+"""RCNN-family detection operators (reference ``src/operator/contrib/``:
+``proposal.cc``/``multi_proposal.cc``, ``psroi_pooling.cc``,
+``deformable_convolution.cc``, and top-level ``correlation.cc``).
+
+TPU-native notes: everything is fixed-shape and branch-free so XLA can
+compile it — NMS is the same iterative-suppression `lax` loop as
+``box_nms``; deformable convolution is im2col with *sampled* (bilinear)
+taps, which lowers to gathers + one MXU matmul.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import parse_bool, parse_float, parse_int, parse_tuple
+from .registry import register
+
+
+def _ftuple(v, default):
+    import ast
+    if v is None:
+        return default
+    if isinstance(v, str):
+        v = ast.literal_eval(v)
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+# ---------------------------------------------------------------- proposal
+def _generate_anchors(base_size, scales, ratios):
+    """Standard RCNN anchor generation (reference rcnn anchor.py logic)."""
+    base = jnp.asarray([0, 0, base_size - 1, base_size - 1], jnp.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    anchors = []
+    for r in ratios:
+        size = w * h
+        ws = jnp.round(jnp.sqrt(size / r))
+        hs = jnp.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            anchors.append(jnp.stack([cx - 0.5 * (wss - 1),
+                                      cy - 0.5 * (hss - 1),
+                                      cx + 0.5 * (wss - 1),
+                                      cy + 0.5 * (hss - 1)]))
+    return jnp.stack(anchors)  # (A, 4)
+
+
+def _decode_bbox(anchors, deltas):
+    """Apply (dx, dy, dw, dh) regression deltas to corner-format anchors."""
+    w = anchors[:, 2] - anchors[:, 0] + 1
+    h = anchors[:, 3] - anchors[:, 1] + 1
+    cx = anchors[:, 0] + 0.5 * (w - 1)
+    cy = anchors[:, 1] + 0.5 * (h - 1)
+    ncx = deltas[:, 0] * w + cx
+    ncy = deltas[:, 1] * h + cy
+    nw = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * w
+    nh = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * h
+    return jnp.stack([ncx - 0.5 * (nw - 1), ncy - 0.5 * (nh - 1),
+                      ncx + 0.5 * (nw - 1), ncy + 0.5 * (nh - 1)], axis=1)
+
+
+def _nms_keep(boxes, scores, thresh, n_keep):
+    """Iterative NMS returning indices (−1 padded)."""
+    n = boxes.shape[0]
+    areas = jnp.maximum(boxes[:, 2] - boxes[:, 0] + 1, 0) * \
+        jnp.maximum(boxes[:, 3] - boxes[:, 1] + 1, 0)
+
+    def iou_with(i):
+        x1 = jnp.maximum(boxes[i, 0], boxes[:, 0])
+        y1 = jnp.maximum(boxes[i, 1], boxes[:, 1])
+        x2 = jnp.minimum(boxes[i, 2], boxes[:, 2])
+        y2 = jnp.minimum(boxes[i, 3], boxes[:, 3])
+        inter = jnp.maximum(x2 - x1 + 1, 0) * jnp.maximum(y2 - y1 + 1, 0)
+        return inter / jnp.maximum(areas[i] + areas - inter, 1e-10)
+
+    def body(k, carry):
+        live, keep = carry
+        masked = jnp.where(live, scores, -jnp.inf)
+        i = jnp.argmax(masked)
+        ok = masked[i] > -jnp.inf
+        keep = keep.at[k].set(jnp.where(ok, i, -1))
+        sup = iou_with(i) > thresh
+        live = live & ~sup & ok
+        return live, keep
+
+    live0 = jnp.ones((n,), dtype=bool)
+    keep0 = jnp.full((n_keep,), -1, dtype=jnp.int32)
+    _, keep = lax.fori_loop(0, n_keep, body, (live0, keep0))
+    return keep
+
+
+def _proposal_one(score, bbox_deltas, im_info, anchors, feature_stride,
+                  rpn_pre_nms_top_n, rpn_post_nms_top_n, threshold,
+                  rpn_min_size):
+    """One image: scores (2A, H, W), deltas (4A, H, W) → (post_n, 5)."""
+    A = anchors.shape[0]
+    h, w = score.shape[1], score.shape[2]
+    fg = score[A:].reshape(A, h, w)  # foreground scores
+    shift_x = jnp.arange(w, dtype=jnp.float32) * feature_stride
+    shift_y = jnp.arange(h, dtype=jnp.float32) * feature_stride
+    sy, sx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 4)  # (HW, 4)
+    all_anchors = (anchors[None, :, :] + shifts[:, None, :]).reshape(-1, 4)
+    deltas = bbox_deltas.reshape(A, 4, h, w).transpose(2, 3, 0, 1) \
+        .reshape(-1, 4)
+    scores_flat = fg.transpose(1, 2, 0).reshape(-1)
+
+    boxes = _decode_bbox(all_anchors, deltas)
+    boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, im_info[1] - 1),
+                       jnp.clip(boxes[:, 1], 0, im_info[0] - 1),
+                       jnp.clip(boxes[:, 2], 0, im_info[1] - 1),
+                       jnp.clip(boxes[:, 3], 0, im_info[0] - 1)], axis=1)
+    ws = boxes[:, 2] - boxes[:, 0] + 1
+    hs = boxes[:, 3] - boxes[:, 1] + 1
+    min_size = rpn_min_size * im_info[2]
+    valid = (ws >= min_size) & (hs >= min_size)
+    scores_flat = jnp.where(valid, scores_flat, -jnp.inf)
+
+    pre_n = min(rpn_pre_nms_top_n, boxes.shape[0]) \
+        if rpn_pre_nms_top_n > 0 else boxes.shape[0]
+    top_scores, order = lax.top_k(scores_flat, pre_n)
+    top_boxes = boxes[order]
+    keep = _nms_keep(top_boxes, top_scores, threshold, rpn_post_nms_top_n)
+    safe = jnp.maximum(keep, 0)
+    out_boxes = jnp.where(keep[:, None] >= 0, top_boxes[safe], 0.0)
+    out_scores = jnp.where(keep >= 0, top_scores[safe], 0.0)
+    return out_boxes, out_scores
+
+
+@register("_contrib_Proposal", aliases=("Proposal",))
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales="(4, 8, 16, 32)", ratios="(0.5, 1, 2)",
+             feature_stride=16, output_score=False, iou_loss=False):
+    """RPN proposals (reference ``proposal.cc``): cls_prob (N, 2A, H, W),
+    bbox_pred (N, 4A, H, W), im_info (N, 3) → rois (N*post_n, 5) with batch
+    index in column 0 (+ scores when ``output_score``)."""
+    scs = _ftuple(scales, (4., 8., 16., 32.))
+    rts = _ftuple(ratios, (0.5, 1., 2.))
+    stride = parse_int(feature_stride, 16)
+    pre_n = parse_int(rpn_pre_nms_top_n, 6000)
+    post_n = parse_int(rpn_post_nms_top_n, 300)
+    thr = parse_float(threshold, 0.7)
+    min_sz = parse_float(rpn_min_size, 16)
+    anchors = _generate_anchors(stride, scs, rts)
+    n = cls_prob.shape[0]
+    rois, scores = [], []
+    for b in range(n):  # N is small and static — unrolled into the graph
+        bx, sc = _proposal_one(cls_prob[b], bbox_pred[b], im_info[b],
+                               anchors, stride, pre_n, post_n, thr, min_sz)
+        rois.append(jnp.concatenate(
+            [jnp.full((post_n, 1), float(b), jnp.float32), bx], axis=1))
+        scores.append(sc)
+    rois = jnp.concatenate(rois, axis=0)
+    if parse_bool(output_score):
+        return rois, jnp.concatenate(scores)[:, None]
+    return rois
+
+
+register("_contrib_MultiProposal", aliases=("MultiProposal",))(proposal)
+
+
+# ------------------------------------------------------------ PSROIPooling
+@register("_contrib_PSROIPooling", aliases=("PSROIPooling",))
+def psroi_pooling(data, rois, spatial_scale=0.0625, output_dim=None,
+                  pooled_size=None, group_size=0):
+    """Position-sensitive ROI pooling (reference ``psroi_pooling.cc``):
+    data (N, output_dim*group², H, W), rois (R, 5) → (R, output_dim, p, p)."""
+    scale = parse_float(spatial_scale, 0.0625)
+    od = parse_int(output_dim)
+    p = parse_int(pooled_size)
+    g = parse_int(group_size, 0) or p
+    n, c, h, w = data.shape
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * scale
+        y1 = roi[2] * scale
+        x2 = (roi[3] + 1) * scale
+        y2 = (roi[4] + 1) * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / p, rh / p
+        img = data[bidx]  # (C, H, W)
+
+        # average-pool each bin from its position-sensitive channel group
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+
+        def bin_val(ph, pw, ch):
+            y0 = y1 + ph * bin_h
+            x0 = x1 + pw * bin_w
+            in_y = (ys >= jnp.floor(y0)) & (ys < jnp.ceil(y0 + bin_h))
+            in_x = (xs >= jnp.floor(x0)) & (xs < jnp.ceil(x0 + bin_w))
+            mask = in_y[:, None] & in_x[None, :]
+            cnt = jnp.maximum(mask.sum(), 1)
+            gh = (ph * g) // p
+            gw = (pw * g) // p
+            chan = ch * g * g + gh * g + gw
+            return jnp.sum(img[chan] * mask) / cnt
+
+        out = jnp.stack([
+            jnp.stack([
+                jnp.stack([bin_val(ph, pw, ch) for pw in range(p)])
+                for ph in range(p)])
+            for ch in range(od)])
+        return out
+
+    return jax.vmap(one_roi)(rois)
+
+
+# -------------------------------------------------------------- correlation
+@register("Correlation")
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation layer (reference ``correlation.cc``): one output
+    channel per displacement, each a local dot product of the two feature
+    maps (static displacement loop → fused multiply-reduces)."""
+    k = parse_int(kernel_size, 1)
+    md = parse_int(max_displacement, 1)
+    s1 = parse_int(stride1, 1)
+    s2 = parse_int(stride2, 1)
+    pad = parse_int(pad_size, 0)
+    mult = parse_bool(is_multiply, True)
+    n, c, h, w = data1.shape
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    hh, ww = h + 2 * pad, w + 2 * pad
+    disp = range(-md, md + 1, s2)
+    outs = []
+    for dy in disp:
+        for dx in disp:
+            shifted = jnp.roll(p2, (-dy, -dx), axis=(2, 3))
+            if mult:
+                prod = p1 * shifted
+            else:
+                prod = jnp.abs(p1 - shifted)
+            # kernel window average over channels (k=1 common case)
+            val = prod.mean(axis=1)
+            if k > 1:
+                val = lax.reduce_window(val, 0.0, lax.add,
+                                        (1, k, k), (1, 1, 1), "SAME") / (k * k)
+            outs.append(val)
+    out = jnp.stack(outs, axis=1)  # (N, D², HH, WW)
+    out = out[:, :, pad:hh - pad:s1, pad:ww - pad:s1]
+    return out
+
+
+# ------------------------------------------------- deformable convolution
+@register("_contrib_DeformableConvolution", aliases=("DeformableConvolution",))
+def deformable_convolution(data, offset, weight, bias=None, kernel=None,
+                           stride="(1, 1)", dilate="(1, 1)", pad="(0, 0)",
+                           num_filter=None, num_group=1,
+                           num_deformable_group=1, workspace=None,
+                           no_bias=False, layout=None):
+    """Deformable conv v1 (reference ``deformable_convolution.cc``):
+    im2col with per-position learned offsets and bilinear taps, then one
+    MXU matmul."""
+    kh, kw = parse_tuple(kernel, 2)
+    sh, sw = parse_tuple(stride, 2, (1, 1))
+    dh, dw = parse_tuple(dilate, 2, (1, 1))
+    ph, pw = parse_tuple(pad, 2, (0, 0))
+    nf = parse_int(num_filter)
+    n, c, h, w = data.shape
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    padded = jnp.pad(data, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    hh, ww = h + 2 * ph, w + 2 * pw
+
+    base_y = jnp.arange(oh, dtype=jnp.float32)[:, None] * sh
+    base_x = jnp.arange(ow, dtype=jnp.float32)[None, :] * sw
+
+    # offset channels interleave per tap: [dy0, dx0, dy1, dx1, ...]
+    # (reference deformable_im2col layout); one deformable group used
+    off = offset.reshape(n, -1, kh * kw, 2, oh, ow)[:, 0]
+
+    cols = []
+    for ki in range(kh):
+        for kj in range(kw):
+            t = ki * kw + kj
+            oy = off[:, t, 0]  # (N, oh, ow)
+            ox = off[:, t, 1]
+            gy = base_y[None] + ki * dh + oy
+            gx = base_x[None] + kj * dw + ox
+            y0 = jnp.floor(gy)
+            x0 = jnp.floor(gx)
+
+            def gather(yy, xx):
+                inside = (yy >= 0) & (yy < hh) & (xx >= 0) & (xx < ww)
+                yc = jnp.clip(yy, 0, hh - 1).astype(jnp.int32)
+                xc = jnp.clip(xx, 0, ww - 1).astype(jnp.int32)
+                flat = padded.reshape(n, c, hh * ww)
+                idx = (yc * ww + xc).reshape(n, 1, -1)
+                vals = jnp.take_along_axis(flat, idx, axis=2)
+                return vals.reshape(n, c, oh, ow) * \
+                    inside[:, None].astype(data.dtype)
+
+            wx = (gx - x0)[:, None]
+            wy = (gy - y0)[:, None]
+            tap = (gather(y0, x0) * (1 - wx) * (1 - wy) +
+                   gather(y0, x0 + 1) * wx * (1 - wy) +
+                   gather(y0 + 1, x0) * (1 - wx) * wy +
+                   gather(y0 + 1, x0 + 1) * wx * wy)
+            cols.append(tap)
+    col = jnp.stack(cols, axis=2)  # (N, C, kh*kw, oh, ow)
+    col = col.reshape(n, c * kh * kw, oh * ow)
+    wmat = weight.reshape(nf, -1)  # (nf, C*kh*kw)
+    out = jnp.einsum("fk,nkp->nfp", wmat, col,
+                     preferred_element_type=jnp.float32).astype(data.dtype)
+    out = out.reshape(n, nf, oh, ow)
+    if bias is not None and not parse_bool(no_bias):
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out

@@ -1,0 +1,92 @@
+"""RCNN-family op tests (reference tests for proposal/psroi/deformable)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_proposal_shapes_and_validity():
+    rng = np.random.RandomState(0)
+    N, A, H, W = 1, 12, 8, 8  # 4 scales x 3 ratios
+    cls_prob = mx.nd.array(rng.rand(N, 2 * A, H, W).astype("float32"))
+    bbox_pred = mx.nd.array(rng.randn(N, 4 * A, H, W).astype("float32") * 0.1)
+    im_info = mx.nd.array([[128.0, 128.0, 1.0]])
+    rois = mx.nd.contrib.Proposal(cls_prob, bbox_pred, im_info,
+                                  rpn_pre_nms_top_n=200,
+                                  rpn_post_nms_top_n=50)
+    assert rois.shape == (50, 5)
+    r = rois.asnumpy()
+    assert (r[:, 0] == 0).all()          # batch index
+    assert (r[:, 1:] >= 0).all()         # clipped to image
+    assert (r[:, 3] <= 128).all() and (r[:, 4] <= 128).all()
+    # with scores
+    rois2, scores = mx.nd.contrib.Proposal(
+        cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=200,
+        rpn_post_nms_top_n=50, output_score=True)
+    assert scores.shape == (50, 1)
+
+
+def test_psroi_pooling_uniform_input():
+    """Uniform feature maps pool to the channel means regardless of bins."""
+    od, p = 2, 3
+    data = np.zeros((1, od * p * p, 16, 16), dtype="float32")
+    for ch in range(od * p * p):
+        data[0, ch] = ch
+    rois = mx.nd.array([[0, 2, 2, 10, 10]], dtype="float32")
+    out = mx.nd.contrib.PSROIPooling(mx.nd.array(data), rois,
+                                     spatial_scale=1.0, output_dim=od,
+                                     pooled_size=p)
+    assert out.shape == (1, od, p, p)
+    o = out.asnumpy()
+    # bin (ph, pw) of output channel ch reads channel ch*9 + ph*3 + pw
+    for ch in range(od):
+        for ph in range(p):
+            for pw in range(p):
+                assert o[0, ch, ph, pw] == ch * 9 + ph * 3 + pw
+
+
+def test_correlation_matches_numpy():
+    rng = np.random.RandomState(0)
+    a = rng.rand(1, 4, 6, 6).astype("float32")
+    b = rng.rand(1, 4, 6, 6).astype("float32")
+    out = mx.nd.Correlation(mx.nd.array(a), mx.nd.array(b), kernel_size=1,
+                            max_displacement=1, stride1=1, stride2=1,
+                            pad_size=1)
+    assert out.shape == (1, 9, 6, 6)
+    o = out.asnumpy()[0]
+    # zero-displacement channel (index 4) = per-pixel channel-mean product
+    expected_center = (a[0] * b[0]).mean(axis=0)
+    np.testing.assert_allclose(o[4, :, :], expected_center, rtol=1e-5,
+                               atol=1e-6)
+    # displacement (dy=1, dx=0) → channel 7 compares a[y] with b[y+1]
+    expected = (a[0, :, :5, :] * b[0, :, 1:, :]).mean(axis=0)
+    np.testing.assert_allclose(o[7, :5, :], expected, rtol=1e-5, atol=1e-6)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    """Zero offsets reduce deformable conv to ordinary convolution."""
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(2, 3, 8, 8).astype("float32"))
+    w = mx.nd.array(rng.randn(4, 3, 3, 3).astype("float32"))
+    b = mx.nd.array(np.zeros(4, dtype="float32"))
+    offset = mx.nd.zeros((2, 2 * 9, 6, 6))
+    out_d = mx.nd.contrib.DeformableConvolution(
+        x, offset, w, b, kernel=(3, 3), num_filter=4)
+    out_c = mx.nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4)
+    np.testing.assert_allclose(out_d.asnumpy(), out_c.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deformable_conv_shifted_offset():
+    """A constant integer offset equals sampling the shifted image."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 1, 10, 10).astype("float32")
+    w = np.zeros((1, 1, 1, 1), dtype="float32")
+    w[0, 0, 0, 0] = 1.0
+    offset = np.zeros((1, 2, 10, 10), dtype="float32")
+    offset[:, 0] = 1.0  # shift sampling down by one row
+    out = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(offset), mx.nd.array(w),
+        kernel=(1, 1), num_filter=1, no_bias=True, pad=(0, 0))
+    np.testing.assert_allclose(out.asnumpy()[0, 0, :9],
+                               x[0, 0, 1:10], rtol=1e-5)
