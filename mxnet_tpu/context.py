@@ -41,19 +41,22 @@ class Context:
         present (so reference scripts using ``mx.gpu(0)`` run on TPU); ``cpu``
         resolves to host CPU devices.
         """
+        # local_devices: under jax.distributed every process sees the global
+        # device list, but may only place data on its own (addressable) ones
         if self.device_type in ("gpu", "tpu"):
             for platform in ("tpu", "axon", "gpu", None):
                 try:
-                    devs = jax.devices(platform) if platform else jax.devices()
+                    devs = jax.local_devices(backend=platform) if platform \
+                        else jax.local_devices()
                     if devs:
                         return devs[self.device_id % len(devs)]
                 except RuntimeError:
                     continue
             raise RuntimeError("no accelerator device available")
         try:
-            devs = jax.devices("cpu")
+            devs = jax.local_devices(backend="cpu")
         except RuntimeError:
-            devs = jax.devices()
+            devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
     # -- equality / hashing ----------------------------------------------------

@@ -140,10 +140,10 @@ class KVStore:
     def _global_allreduce(self, arr):
         """Cross-process sum over all workers (replaces ps-lite ZPush/ZPull +
         server aggregation, ``kvstore_dist_server.h:346-358``)."""
-        import jax
+        import jax.numpy as jnp
         from jax.experimental import multihost_utils
         summed = multihost_utils.process_allgather(arr._data)
-        return NDArray(summed.sum(axis=0))
+        return NDArray(jnp.asarray(summed).sum(axis=0))
 
     def push(self, key, value, priority=0):
         """Reduce value(s) into the stored copy (reference

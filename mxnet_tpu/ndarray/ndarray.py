@@ -642,26 +642,160 @@ def transpose(data, axes=None):
     return invoke_op("transpose", [data], {"axes": axes})
 
 
-def save(fname, data):
-    """Save NDArrays (reference ``MXNDArraySave``, src/c_api/c_api.cc:316).
+# ---------------------------------------------------------------------------
+# dmlc-stream NDArray serialization — the reference's .params format
+# (src/ndarray/ndarray.cc:1584-1860), byte-compatible so checkpoints
+# interoperate with stock MXNet in both directions.
+# ---------------------------------------------------------------------------
+_ND_LIST_MAGIC = 0x112
+_ND_V1_MAGIC = 0xF993FAC8
+_ND_V2_MAGIC = 0xF993FAC9
+_ND_V3_MAGIC = 0xF993FACA
+_TYPE_FLAGS = {0: _np.float32, 1: _np.float64, 2: _np.float16, 3: _np.uint8,
+               4: _np.int32, 5: _np.int8, 6: _np.int64}
+_FLAG_OF = {_np.dtype(v): k for k, v in _TYPE_FLAGS.items()}
 
-    The on-disk format is a portable ``.npz``-based container rather than the
-    dmlc binary stream; ``load`` accepts what ``save`` writes.
-    """
+
+def _write_shape(f, shape):
+    import struct
+    f.write(struct.pack("<I", len(shape)))
+    for d in shape:
+        f.write(struct.pack("<q", d))
+
+
+def _save_one(f, arr):
+    import struct
+    a = _np.ascontiguousarray(arr.asnumpy())
+    if a.dtype == _np.float64:
+        pass  # float64 is a legal type flag
+    f.write(struct.pack("<I", _ND_V2_MAGIC))
+    f.write(struct.pack("<i", 0))                     # kDefaultStorage
+    _write_shape(f, a.shape)
+    f.write(struct.pack("<ii", 1, 0))                 # Context: cpu(0)
+    flag = _FLAG_OF.get(a.dtype)
+    if flag is None:
+        a = a.astype(_np.float32)
+        flag = 0
+    f.write(struct.pack("<i", flag))
+    f.write(a.tobytes())
+
+
+def _read_shape(f, int64_dims=True):
+    import struct
+    (ndim,) = struct.unpack("<I", f.read(4))
+    if int64_dims:
+        return tuple(struct.unpack("<%dq" % ndim, f.read(8 * ndim)))
+    return tuple(struct.unpack("<%dI" % ndim, f.read(4 * ndim)))
+
+
+def _load_one(f):
+    import struct
+    (magic,) = struct.unpack("<I", f.read(4))
+    if magic in (_ND_V2_MAGIC, _ND_V3_MAGIC):
+        (stype,) = struct.unpack("<i", f.read(4))
+        aux_shapes = []
+        nad = {1: 1, 2: 2}.get(stype, 0)  # row_sparse: idx; csr: indptr+idx
+        if nad > 0:
+            storage_shape = _read_shape(f)
+        shape = _read_shape(f)
+        if len(shape) == 0:
+            return array(_np.zeros(()))
+        struct.unpack("<ii", f.read(8))  # context
+        (flag,) = struct.unpack("<i", f.read(4))
+        aux_types = []
+        if nad > 0:
+            for _ in range(nad):
+                (aflag,) = struct.unpack("<i", f.read(4))
+                aux_types.append(aflag)
+                aux_shapes.append(_read_shape(f))
+        dt = _np.dtype(_TYPE_FLAGS[flag])
+        data_shape = storage_shape if nad > 0 else shape
+        n = int(_np.prod(data_shape)) if data_shape else 1
+        data = _np.frombuffer(f.read(n * dt.itemsize), dtype=dt) \
+            .reshape(data_shape)
+        if nad == 0:
+            return array(data.copy())
+        auxes = []
+        for at, ash in zip(aux_types, aux_shapes):
+            adt = _np.dtype(_TYPE_FLAGS[at])
+            cnt = int(_np.prod(ash))
+            auxes.append(_np.frombuffer(f.read(cnt * adt.itemsize),
+                                        dtype=adt).reshape(ash))
+        # densify sparse payloads (TPU sparse policy)
+        dense = _np.zeros(shape, dtype=dt)
+        if stype == 1:    # row_sparse: aux = [indices]
+            dense[auxes[0].astype(_np.int64)] = data
+        elif stype == 2:  # csr: aux = [indptr, indices]
+            indptr, indices = auxes
+            for r in range(shape[0]):
+                for k in range(int(indptr[r]), int(indptr[r + 1])):
+                    dense[r, int(indices[k])] = data[k]
+        return array(dense)
+    # legacy: V1 (dmlc TShape, uint32 dims) or pre-V1 (magic == ndim)
+    if magic == _ND_V1_MAGIC:
+        shape = _read_shape(f, int64_dims=False)
+    else:
+        ndim = magic
+        shape = tuple(struct.unpack("<%dI" % ndim, f.read(4 * ndim)))
+    if len(shape) == 0:
+        return array(_np.zeros(()))
+    struct.unpack("<ii", f.read(8))  # context
+    (flag,) = struct.unpack("<i", f.read(4))
+    dt = _np.dtype(_TYPE_FLAGS[flag])
+    n = int(_np.prod(shape))
+    data = _np.frombuffer(f.read(n * dt.itemsize), dtype=dt).reshape(shape)
+    return array(data.copy())
+
+
+def save(fname, data):
+    """Save NDArrays in the reference's dmlc-stream format
+    (``MXNDArraySave``, src/c_api/c_api.cc:316 → ndarray.cc:1821): files
+    written here load in stock MXNet and vice versa."""
+    import struct
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, dict):
         names = list(data.keys())
         arrays = [data[k] for k in names]
     else:
-        names = [f"arr_{i}" for i in range(len(data))]
+        names = []
         arrays = list(data)
+    for a in arrays:
+        assert isinstance(a, NDArray), "only NDArrays can be saved"
     with open(fname, "wb") as f:
-        _np.savez(f, __mx_names__=_np.array(names, dtype=object),
-                  **{f"a{i}": a.asnumpy() for i, a in enumerate(arrays)})
+        f.write(struct.pack("<QQ", _ND_LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for a in arrays:
+            _save_one(f, a)
+        f.write(struct.pack("<Q", len(names)))
+        for n in names:
+            b = n.encode("utf-8")
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
 
 
 def load(fname):
+    """Load NDArrays (dmlc format incl. legacy versions; `.npz` files from
+    earlier dev builds still load)."""
+    import struct
+    with open(fname, "rb") as f:
+        head = f.read(16)
+        if len(head) == 16:
+            magic, _reserved = struct.unpack("<QQ", head)
+        else:
+            magic = None
+        if magic == _ND_LIST_MAGIC:
+            (count,) = struct.unpack("<Q", f.read(8))
+            arrays = [_load_one(f) for _ in range(count)]
+            (n_names,) = struct.unpack("<Q", f.read(8))
+            names = []
+            for _ in range(n_names):
+                (ln,) = struct.unpack("<Q", f.read(8))
+                names.append(f.read(ln).decode("utf-8"))
+            if names:
+                return dict(zip(names, arrays))
+            return arrays
+    # fallback: .npz container from earlier builds
     d = _np.load(fname, allow_pickle=True)
     names = [str(n) for n in d["__mx_names__"]]
     arrays = [array(d[f"a{i}"]) for i in range(len(names))]

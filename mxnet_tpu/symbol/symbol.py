@@ -30,6 +30,29 @@ AUX_INPUTS = {"BatchNorm": {3: "moving_mean", 4: "moving_var"}}
 # Ops whose behavior depends on is_train (OpContext ctx.is_train in reference)
 MODE_DEPENDENT = {"Dropout", "BatchNorm"}
 
+_SIG_CACHE = {}
+
+
+def _filter_attrs(op, attrs):
+    """Drop generic symbol attributes (ctx_group, __lr_mult__, …) that the
+    kernel function doesn't accept — MXNet JSON stores them alongside op
+    hyperparameters (the reference strips them in ``legacy_json_util.cc``
+    and via dmlc-param 'unknown field' tolerance)."""
+    import inspect
+    key = id(op.fn)
+    sig = _SIG_CACHE.get(key)
+    if sig is None:
+        params = inspect.signature(op.fn).parameters
+        has_var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD
+                         for p in params.values())
+        sig = (set(params.keys()), has_var_kw)
+        _SIG_CACHE[key] = sig
+    names, has_var_kw = sig
+    if has_var_kw:
+        return attrs
+    return {k: v for k, v in attrs.items()
+            if k in names or k == "__training__"}
+
 
 class _Node:
     """One op instantiation in the graph (or a variable if ``op is None``)."""
@@ -219,7 +242,7 @@ class Symbol:
                 if s is None:
                     raise KeyError(p.name)
                 in_specs.append(s)
-            attrs = {k: v for k, v in node.attrs.items()}
+            attrs = _filter_attrs(node.op, dict(node.attrs))
             if node.op.name in MODE_DEPENDENT:
                 attrs["__training__"] = False
             if node.op.name in STOCHASTIC_OPS or node.op.name == "Dropout":
@@ -285,7 +308,7 @@ class Symbol:
                     vals[id(node)] = (env[node.name],)
                     continue
                 ins = [vals[id(p)][i] for (p, i) in node.inputs]
-                attrs = dict(node.attrs)
+                attrs = _filter_attrs(node.op, dict(node.attrs))
                 if node.op.name in MODE_DEPENDENT:
                     attrs["__training__"] = is_train
                 if node.op.name in STOCHASTIC_OPS or node.op.name == "Dropout":
@@ -500,28 +523,47 @@ def Group(symbols):
 
 
 def load_json(json_str):
-    """Rebuild a Symbol from MXNet graph JSON."""
+    """Rebuild a Symbol from MXNet graph JSON — current format and the
+    legacy pre-1.0 one (2-element input entries, ``attr``/``param`` keys;
+    the reference upgrades these in ``src/nnvm/legacy_json_util.cc``)."""
     g = json.loads(json_str)
+
+    def entry(e):
+        return (e[0], e[1])  # (node_id, out_idx); v3 adds a version field
+
     nodes = []
     for spec in g["nodes"]:
+        # legacy nodes may carry both "param" (op hyperparameters) and
+        # "attr" (generic attributes); the modern format merges as "attrs"
+        attrs = {}
+        attrs.update(spec.get("param") or {})
+        attrs.update(spec.get("attr") or {})
+        attrs.update(spec.get("attrs") or {})
         if spec["op"] == "null":
-            node = _Node(None, spec["name"], [], {}, 1,
-                         dict(spec.get("attrs", {})))
+            node = _Node(None, spec["name"], [], {}, 1, attrs)
         else:
             op = _reg.get(spec["op"])
             if op is None:
                 raise ValueError(f"unknown op in JSON: {spec['op']}")
-            inputs = [(nodes[i], oi) for (i, oi, _v) in spec["inputs"]]
-            node = _Node(op, spec["name"], inputs,
-                         dict(spec.get("attrs", spec.get("param", {}))), 1)
+            inputs = [(nodes[i], oi) for (i, oi) in map(entry, spec["inputs"])]
+            node = _Node(op, spec["name"], inputs, attrs, 1)
             # fix num_outputs for known multi-output ops
             if op.name == "BatchNorm":
+                if len(inputs) == 3:
+                    # legacy graphs omit aux-state inputs; the reference
+                    # appends them on load (legacy_json_util.cc).  NOTE:
+                    # the synthesized vars must NOT join ``nodes`` — that
+                    # list mirrors the JSON numbering used by input refs.
+                    for suffix in ("moving_mean", "moving_var"):
+                        aux = _Node(None, f"{spec['name']}_{suffix}", [], {},
+                                    1, {"__aux__": "1"})
+                        inputs.append((aux, 0))
                 node.num_outputs = 3
             elif op.name in ("split", "SliceChannel"):
                 from ..base import parse_int
                 node.num_outputs = parse_int(node.attrs.get("num_outputs", 1), 1)
         nodes.append(node)
-    heads = [(nodes[i], oi) for (i, oi, _v) in g["heads"]]
+    heads = [(nodes[i], oi) for (i, oi) in map(entry, g["heads"])]
     return Symbol(heads)
 
 

@@ -1,0 +1,41 @@
+"""Worker script for the multi-process dist kvstore test (the analog of
+``tests/nightly/dist_sync_kvstore.py`` — run via tools/launch.py)."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+    num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+    process_id=int(os.environ["JAX_PROCESS_ID"]))
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank = kv.rank
+    nw = kv.num_workers
+    assert nw == int(os.environ["JAX_NUM_PROCESSES"])
+
+    shape = (4, 3)
+    kv.init(7, mx.nd.zeros(shape))
+    # every worker pushes (rank+1) * ones → store should hold sum = nw(nw+1)/2
+    kv.push(7, mx.nd.ones(shape) * (rank + 1))
+    out = mx.nd.empty(shape)
+    kv.pull(7, out=out)
+    expected = nw * (nw + 1) / 2
+    got = float(out.asnumpy().mean())
+    assert got == expected, (got, expected)
+    kv.barrier()
+    print(f"WORKER_OK rank={rank} sum={got}")
+
+
+if __name__ == "__main__":
+    main()

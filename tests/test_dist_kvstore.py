@@ -1,0 +1,24 @@
+"""Multi-process distributed KVStore test (the reference runs the real PS
+stack as local processes via the same launcher users use —
+``tests/nightly/test_all.sh:55``; here the same trick over
+``jax.distributed``)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def test_dist_sync_kvstore_two_workers():
+    root = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # one device per worker process
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "launch.py"),
+         "-n", "2", "--port", "29731",
+         sys.executable, os.path.join(root, "tests",
+                                      "dist_sync_kvstore_worker.py")],
+        capture_output=True, text=True, timeout=280, env=env)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    assert out.stdout.count("WORKER_OK") == 2, out.stdout

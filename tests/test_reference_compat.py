@@ -1,0 +1,72 @@
+"""Cross-version artifact compatibility (the reference's
+``model_backwards_compatibility_check`` role): load checkpoints produced by
+stock MXNet, write checkpoints it can read back."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+REF = "/root/reference/tests/python/unittest"
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_load_legacy_symbol_json_and_run():
+    sym = mx.sym.load(os.path.join(REF, "save_000800.json"))
+    assert sym.list_outputs() == ["softmax_output"]
+    assert "batchnorm0_moving_mean" in sym.list_auxiliary_states()
+    exe = sym.simple_bind(ctx=mx.cpu(), data=(2, 10), grad_req="null")
+    rng = np.random.RandomState(0)
+    for k, v in exe.arg_dict.items():
+        if k != "data":
+            v[:] = rng.rand(*v.shape) * 0.1
+    for k, v in exe.aux_dict.items():
+        v[:] = 1.0 if "var" in k else 0.0
+    exe.arg_dict["data"][:] = rng.rand(2, 10)
+    out = exe.forward()
+    np.testing.assert_allclose(out[0].asnumpy().sum(axis=1), np.ones(2),
+                               rtol=1e-4)
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_load_legacy_ndarray_v0():
+    arrs = mx.nd.load(os.path.join(REF, "legacy_ndarray.v0"))
+    assert len(arrs) == 6
+    for a in arrs:
+        assert a.shape == (128,)
+        assert np.isfinite(a.asnumpy()).all()
+
+
+def test_params_binary_layout(tmp_path):
+    """The written file carries the dmlc list magic + V2 array records —
+    the exact layout stock MXNet's MXNDArrayLoad expects."""
+    path = str(tmp_path / "x.params")
+    mx.nd.save(path, {"arg:w": mx.nd.ones((2, 3))})
+    raw = open(path, "rb").read()
+    magic, reserved = struct.unpack("<QQ", raw[:16])
+    assert magic == 0x112 and reserved == 0
+    (count,) = struct.unpack("<Q", raw[16:24])
+    assert count == 1
+    (nd_magic,) = struct.unpack("<I", raw[24:28])
+    assert nd_magic == 0xF993FAC9  # NDARRAY_V2_MAGIC
+    # name table at the end
+    assert raw.endswith(b"arg:w")
+
+
+def test_save_load_roundtrip_dtypes(tmp_path):
+    path = str(tmp_path / "r.params")
+    data = {"f32": mx.nd.array(np.random.rand(4, 5).astype("float32")),
+            "u8": mx.nd.array(np.arange(6, dtype="uint8").reshape(2, 3),
+                              dtype="uint8"),
+            "scalar_shape": mx.nd.ones((1,))}
+    mx.nd.save(path, data)
+    back = mx.nd.load(path)
+    for k in data:
+        np.testing.assert_array_equal(back[k].asnumpy(),
+                                      data[k].asnumpy(), err_msg=k)
+    # list form (no names)
+    mx.nd.save(path, [mx.nd.ones((2,)), mx.nd.zeros((3,))])
+    lst = mx.nd.load(path)
+    assert isinstance(lst, list) and len(lst) == 2
