@@ -50,6 +50,7 @@ from . import operator  # noqa: F401
 from . import rnn  # noqa: F401
 from . import rtc  # noqa: F401
 from . import util  # noqa: F401
+from . import config  # noqa: F401
 from . import contrib  # noqa: F401
 from . import models  # noqa: F401
 

@@ -1,0 +1,87 @@
+"""Runtime configuration (SURVEY.md §5.6).
+
+The reference reads ~70 ``MXNET_*`` env vars ad hoc via ``dmlc::GetEnv``
+(catalog: ``docs/faq/env_var.md``).  Here configuration is one typed module:
+every knob has a declared type/default, reads are centralized
+(``config.get``), and the reference's env names keep working.  Knobs whose
+machinery doesn't exist on TPU (engine thread counts, GPU memory pools,
+cuDNN autotune) are **accepted and ignored** with a debug log — scripts that
+set them keep running; the behaviors they tuned belong to XLA now.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["get", "set", "describe", "KNOBS"]
+
+# name -> (type, default, meaning, active?)   inactive = accepted+ignored
+KNOBS = {
+    # active knobs
+    "MXNET_ENFORCE_DETERMINISM": (bool, False,
+                                  "seeded, deterministic kernels", True),
+    "MXNET_STORAGE_FALLBACK_LOG_VERBOSE": (bool, True,
+                                           "log dense fallbacks", True),
+    "MXNET_PROFILER_AUTOSTART": (bool, False, "start profiler at import",
+                                 True),
+    "MXNET_TEST_SEED": (int, None, "test seed override", True),
+    "MXNET_MODULE_SEED": (int, None, "module seed override", True),
+    "MXNET_SUBGRAPH_BACKEND": (str, None,
+                               "graph partitioner (XLA owns fusion)", False),
+    # accepted-and-ignored (engine/memory knobs subsumed by XLA)
+    "MXNET_ENGINE_TYPE": (str, "ThreadedEnginePerDevice", "engine impl",
+                          False),
+    "MXNET_CPU_WORKER_NTHREADS": (int, 1, "engine CPU workers", False),
+    "MXNET_GPU_WORKER_NTHREADS": (int, 2, "engine GPU workers", False),
+    "MXNET_GPU_MEM_POOL_RESERVE": (int, 5, "GPU pool reserve %", False),
+    "MXNET_GPU_MEM_POOL_TYPE": (str, "Naive", "GPU pool type", False),
+    "MXNET_EXEC_BULK_EXEC_TRAIN": (bool, True, "op bulking (train)", False),
+    "MXNET_EXEC_BULK_EXEC_INFERENCE": (bool, True, "op bulking (infer)",
+                                       False),
+    "MXNET_BACKWARD_DO_MIRROR": (bool, False,
+                                 "recompute-for-memory (use jax.checkpoint)",
+                                 False),
+    "MXNET_CUDNN_AUTOTUNE_DEFAULT": (int, 1, "cuDNN autotune", False),
+    "MXNET_KVSTORE_BIGARRAY_BOUND": (int, 1000000, "PS shard bound", False),
+    "MXNET_KVSTORE_USETREE": (bool, False, "tree reduce (XLA torus routing)",
+                              False),
+    "MXNET_ENABLE_CYTHON": (bool, False, "cython bindings", False),
+    "MXNET_SAFE_ACCUMULATION": (bool, False,
+                                "fp32 accumulation (XLA default)", False),
+}
+
+_warned = set()
+
+
+def get(name, default=None):
+    """Typed read of a knob; unknown names read the raw env."""
+    spec = KNOBS.get(name)
+    raw = os.environ.get(name)
+    if spec is None:
+        return raw if raw is not None else default
+    typ, knob_default, _desc, active = spec
+    if raw is None:
+        val = knob_default if default is None else default
+    elif typ is bool:
+        val = raw not in ("0", "false", "False", "")
+    else:
+        val = typ(raw)
+    if raw is not None and not active and name not in _warned:
+        _warned.add(name)
+        logging.debug("%s is accepted but has no effect on TPU (XLA owns "
+                      "this behavior)", name)
+    return val
+
+
+def set(name, value):
+    os.environ[name] = str(value)
+
+
+def describe():
+    """Human-readable knob catalog (the env_var.md role)."""
+    lines = []
+    for name, (typ, default, desc, active) in sorted(KNOBS.items()):
+        state = "active" if active else "accepted, no-op on TPU"
+        lines.append(f"{name} ({typ.__name__}, default={default}) — {desc} "
+                     f"[{state}]")
+    return "\n".join(lines)

@@ -23,3 +23,6 @@ from .trainer import SPMDTrainer, make_train_step  # noqa: F401
 from .ring_attention import (  # noqa: F401
     ring_attention, ring_self_attention, blockwise_attention_reference,
 )
+from .checkpoint import (  # noqa: F401
+    save_spmd_checkpoint, load_spmd_checkpoint, SPMDCheckpointManager,
+)

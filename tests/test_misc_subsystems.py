@@ -174,3 +174,15 @@ def test_print_summary(capsys):
     out = capsys.readouterr().out
     assert "fc1" in out
     assert total == 16 * 8 + 16
+
+
+# --------------------------------------------------------------------- config
+def test_config_knobs(monkeypatch):
+    from mxnet_tpu import config
+    assert config.get("MXNET_ENFORCE_DETERMINISM") is False
+    monkeypatch.setenv("MXNET_ENFORCE_DETERMINISM", "1")
+    assert config.get("MXNET_ENFORCE_DETERMINISM") is True
+    monkeypatch.setenv("MXNET_CPU_WORKER_NTHREADS", "8")
+    assert config.get("MXNET_CPU_WORKER_NTHREADS") == 8  # accepted, no-op
+    assert "MXNET_ENGINE_TYPE" in config.describe()
+    assert config.get("SOME_UNKNOWN", "fallback") == "fallback"
