@@ -24,7 +24,7 @@ from .ndarray import NDArray, _as_nd, _wrap, invoke
 
 # Ops whose behavior depends on autograd train/test mode (reference: ops read
 # ``ctx.is_train`` from the OpContext, include/mxnet/op_attr_types.h).
-MODE_DEPENDENT = {"Dropout", "BatchNorm"}
+MODE_DEPENDENT = {"Dropout", "BatchNorm", "RNN"}
 
 _MOMENTUM_DEFAULT = 0.9
 

@@ -570,8 +570,11 @@ def dropout(key, data, p=0.5, mode="training", axes=None, cudnn_off=False,
 # ---------------------------------------------------------------------------
 # Fused RNN op (vanilla/LSTM/GRU) — reference src/operator/rnn.cc:636
 # ---------------------------------------------------------------------------
-@register("RNN")
-def rnn(data, parameters, state, *rest, state_size=None, num_layers=1,
+from .random_ops import _register_random
+
+
+@_register_random("RNN")
+def rnn(key, data, parameters, state, *rest, state_size=None, num_layers=1,
         bidirectional=False, mode="lstm", p=0.0, state_outputs=False,
         projection_size=None, lstm_state_clip_min=None,
         lstm_state_clip_max=None, lstm_state_clip_nan=False,
@@ -672,6 +675,14 @@ def rnn(data, parameters, state, *rest, state_size=None, num_layers=1,
             h_finals.append(hf)
             c_finals.append(cf)
         x = outs_dir[0] if D == 1 else jnp.concatenate(outs_dir, axis=-1)
+        drop = parse_float(p, 0.0)
+        if parse_bool(__training__) and drop > 0 and layer < L - 1:
+            # inter-layer dropout (reference rnn-inl.h applies it between
+            # stacked layers, never on the final output)
+            key, sub = jax.random.split(key)
+            keep = 1.0 - drop
+            mask = jax.random.bernoulli(sub, keep, x.shape)
+            x = jnp.where(mask, x / keep, 0).astype(x.dtype)
 
     out = x
     if parse_bool(state_outputs):

@@ -28,7 +28,7 @@ from ..ops.random_ops import STOCHASTIC_OPS
 AUX_INPUTS = {"BatchNorm": {3: "moving_mean", 4: "moving_var"}}
 
 # Ops whose behavior depends on is_train (OpContext ctx.is_train in reference)
-MODE_DEPENDENT = {"Dropout", "BatchNorm"}
+MODE_DEPENDENT = {"Dropout", "BatchNorm", "RNN"}
 
 _SIG_CACHE = {}
 

@@ -142,3 +142,17 @@ def test_rnn_layer_trains():
         if first is None:
             first = v
     assert v < first * 0.5, (first, v)
+
+
+def test_rnn_interlayer_dropout():
+    """Dropout applies between stacked layers in train mode only."""
+    layer = gluon.rnn.LSTM(16, num_layers=2, dropout=0.5)
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(5, 3, 8))
+    out_eval1 = layer(x).asnumpy()
+    out_eval2 = layer(x).asnumpy()
+    np.testing.assert_allclose(out_eval1, out_eval2)  # eval: deterministic
+    with mx.autograd.record():
+        out_tr1 = layer(x).asnumpy()
+        out_tr2 = layer(x).asnumpy()
+    assert np.abs(out_tr1 - out_tr2).max() > 1e-6  # train: stochastic
