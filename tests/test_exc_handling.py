@@ -1,0 +1,60 @@
+"""Error-surfacing semantics (the role of reference
+``tests/python/unittest/test_exc_handling.py``).
+
+The reference defers op errors to engine threads and rethrows them at sync
+points (``WaitToRead``/``waitall``); in the TPU-native design eager dispatch
+validates at the call site — errors surface *earlier*, never later, and
+``waitall`` after a failure is safe.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_shape_mismatch_raises_at_call():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.ones((4, 5))
+    with pytest.raises(Exception):
+        mx.nd.dot(a, b)
+    # the failure leaves the runtime usable (reference: engine keeps running)
+    out = mx.nd.dot(mx.nd.ones((2, 3)), mx.nd.ones((3, 2)))
+    assert out.shape == (2, 2)
+    mx.nd.waitall()
+
+
+def test_invalid_op_params_raise():
+    with pytest.raises(Exception):
+        # input has 3 channels, weight expects 5
+        mx.nd.Convolution(mx.nd.ones((1, 3, 8, 8)), mx.nd.ones((4, 5, 3, 3)),
+                          mx.nd.zeros((4,)), kernel=(3, 3), num_filter=4)
+    with pytest.raises(Exception):
+        mx.nd.concat(mx.nd.ones((2, 2)), mx.nd.ones((3, 3)), dim=0)
+
+
+def test_backward_without_record_raises():
+    x = mx.nd.ones((2, 2))
+    with pytest.raises(Exception):
+        x.backward()
+
+
+def test_exception_inside_jitted_hybrid_block():
+    """Errors in traced (hybridized) graphs surface at trace/compile time."""
+    class Bad(mx.gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.dot(x, F.ones((5, 5)))  # inner dims mismatch
+
+    net = Bad()
+    net.initialize()
+    net.hybridize()
+    with pytest.raises(Exception):
+        net(mx.nd.ones((2, 3)))
+
+
+def test_waitall_after_error_is_clean():
+    try:
+        mx.nd.dot(mx.nd.ones((2, 3)), mx.nd.ones((4, 4)))
+    except Exception:
+        pass
+    mx.nd.waitall()  # must not rethrow (stricter-than-reference semantics)
+    assert float(mx.nd.ones((1,)).asscalar()) == 1.0
