@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""BERT pretraining (BASELINE config 3: "BERT-base pretraining — Gluon
+hybridize; exercises embedding + layernorm + matmul kernels").
+
+Synthetic corpus (no egress); masked-LM + next-sentence objectives; runs the
+fused SPMD step over all visible devices, dp×tp mesh.  CPU-mesh dry run:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+      python example/bert/pretrain.py --model bert_tiny --iters 10
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def synth_batch(rng, batch, seq, vocab, n_masked):
+    tokens = rng.randint(4, vocab, (batch, seq))
+    segments = (np.arange(seq)[None, :] >= seq // 2).astype("int32") * \
+        np.ones((batch, 1), "int32")
+    valid = np.ones((batch, seq), dtype="float32")
+    positions = np.stack([rng.choice(seq, n_masked, replace=False)
+                          for _ in range(batch)])
+    mlm_labels = np.take_along_axis(tokens, positions, axis=1)
+    tokens_masked = tokens.copy()
+    np.put_along_axis(tokens_masked, positions, 3, axis=1)  # [MASK]=3
+    nsp_labels = rng.randint(0, 2, (batch,))
+    return (tokens_masked.astype("int32"), segments, valid,
+            positions.astype("int32"), mlm_labels.astype("float32"),
+            nsp_labels.astype("float32"))
+
+
+def main():
+    import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import get_bert_model
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="bert_base",
+                        choices=["bert_tiny", "bert_mini", "bert_small",
+                                 "bert_base", "bert_large"])
+    parser.add_argument("--vocab-size", type=int, default=30522)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-masked", type=int, default=20)
+    parser.add_argument("--iters", type=int, default=50)
+    parser.add_argument("--lr", type=float, default=1e-4)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = get_bert_model(args.model, vocab_size=args.vocab_size,
+                         max_length=args.seq_len)
+    ctx = mx.gpu(0) if mx.num_gpus() else mx.cpu(0)
+    net.initialize(ctx=ctx)
+    net.hybridize()
+    sce = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": args.lr})
+    rng = np.random.RandomState(0)
+    tok, seg, val, pos, mlm_y, nsp_y = synth_batch(
+        rng, args.batch_size, args.seq_len, args.vocab_size, args.num_masked)
+    tok, seg, val, pos = (mx.nd.array(tok, dtype="int32", ctx=ctx),
+                          mx.nd.array(seg, dtype="int32", ctx=ctx),
+                          mx.nd.array(val, ctx=ctx),
+                          mx.nd.array(pos, dtype="int32", ctx=ctx))
+    mlm_y = mx.nd.array(mlm_y, ctx=ctx)
+    nsp_y = mx.nd.array(nsp_y, ctx=ctx)
+
+    def step():
+        with mx.autograd.record():
+            _, _, mlm, nsp = net(tok, seg, val, pos)
+            loss = sce(mlm.reshape((-1, args.vocab_size)),
+                       mlm_y.reshape((-1,))).mean() + sce(nsp, nsp_y).mean()
+        loss.backward()
+        trainer.step(args.batch_size)
+        return loss
+
+    loss = step()  # compile
+    loss.wait_to_read()
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        loss = step()
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+    logging.info("%s: %.1f sequences/sec, final loss %.4f", args.model,
+                 args.batch_size * args.iters / dt, float(loss.asscalar()))
+
+
+if __name__ == "__main__":
+    main()

@@ -243,8 +243,25 @@ def _acc(a, b, create_graph):
     return _wrap(a._data + b._data)
 
 
+_VJP_CACHE = {}
+
+
+def _attrs_key(attrs):
+    try:
+        return tuple(sorted((k, v if not isinstance(v, (list, dict))
+                             else repr(v)) for k, v in attrs.items()))
+    except TypeError:
+        return repr(sorted(attrs.items(), key=lambda kv: kv[0]))
+
+
 def _node_vjp(node, gout_nds, create_graph):
-    """Input cotangents (as NDArrays) for one tape node."""
+    """Input cotangents (as NDArrays) for one tape node.
+
+    The per-(fn, attrs) backward is jit-compiled and cached — without this,
+    replaying a CachedOp's forward inside ``jax.vjp`` would run op-by-op
+    eagerly (ruinous on TPU); with it, one XLA executable per recorded op
+    shape (the role of the reference's cached backward graph,
+    ``cached_op.cc:1128``)."""
     from .ndarray.ndarray import invoke_fn, _wrap
 
     if node.custom_vjp is not None:
@@ -254,17 +271,36 @@ def _node_vjp(node, gout_nds, create_graph):
     n_in = len(node.in_nds)
     multi = node.out_tuple
 
-    def bwd(*args):
-        xs, gs = args[:n_in], args[n_in:]
-        _, pb = jax.vjp(lambda *zz: fn(*zz, **attrs), *xs)
-        cot = tuple(gs) if multi else gs[0]
-        res = pb(cot)
-        return tuple(res)
+    # array-valued attrs (PRNG keys) become jit ARGUMENTS — as cache-key
+    # constants they would force a recompile every step
+    static_attrs = {k: v for k, v in attrs.items()
+                    if not hasattr(v, "shape")}
+    arr_names = tuple(sorted(k for k in attrs if hasattr(attrs[k], "shape")))
+    n_arr = len(arr_names)
+    key = (id(fn), _attrs_key(static_attrs), arr_names, n_in, multi)
+    bwd = _VJP_CACHE.get(key)
+    if bwd is None:
+        def bwd(*args):
+            arr_vals = args[:n_arr]
+            xs = args[n_arr:n_arr + n_in]
+            gs = args[n_arr + n_in:]
+            at = dict(static_attrs)
+            at.update(zip(arr_names, arr_vals))
+            _, pb = jax.vjp(lambda *zz: fn(*zz, **at), *xs)
+            cot = tuple(gs) if multi else gs[0]
+            res = pb(cot)
+            return tuple(res)
+        bwd = jax.jit(bwd)
+        _VJP_CACHE[key] = bwd
+        if len(_VJP_CACHE) > 4096:  # bound the cache (keyed on live fns)
+            _VJP_CACHE.clear()
 
+    arr_vals = [attrs[k] for k in arr_names]
     if create_graph:
-        out = invoke_fn(bwd, list(node.in_nds) + list(gout_nds))
+        out = invoke_fn(bwd, arr_vals + list(node.in_nds) + list(gout_nds))
         return out if isinstance(out, list) else [out]
-    raw = bwd(*[x._data for x in node.in_nds], *[g._data for g in gout_nds])
+    raw = bwd(*arr_vals, *[x._data for x in node.in_nds],
+              *[g._data for g in gout_nds])
     return [_wrap(r) for r in raw]
 
 

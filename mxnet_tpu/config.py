@@ -20,6 +20,8 @@ KNOBS = {
     # active knobs
     "MXNET_ENFORCE_DETERMINISM": (bool, False,
                                   "seeded, deterministic kernels", True),
+    "MXNET_EAGER_JIT": (bool, True,
+                        "per-op jit caching on the eager path", True),
     "MXNET_STORAGE_FALLBACK_LOG_VERBOSE": (bool, True,
                                            "log dense fallbacks", True),
     "MXNET_PROFILER_AUTOSTART": (bool, False, "start profiler at import",

@@ -20,6 +20,8 @@ TPU-native redesign:
 """
 from __future__ import annotations
 
+import os
+
 import numpy as _np
 
 import jax
@@ -481,12 +483,73 @@ def invoke_op(name, nd_inputs, attrs, out=None):
 _AMP_HOOK = None
 
 
+# Eager op-by-op jit cache (SURVEY.md §7 hard-part 1: "the eager path needs
+# op-by-op jit caching"): each (op, attrs) pair compiles once and replays as
+# one XLA executable — uncompiled jnp dispatch per elementary op is ruinous
+# on TPU.  Ops with value-dependent output shapes (dynamic size) fall back to
+# direct execution permanently after the first failed trace.
+_EAGER_JIT = {}
+_EAGER_NOJIT = set()
+_EAGER_MISSES = {}
+_EAGER_MISS_LIMIT = 2  # ops with per-call attr churn (e.g. Adam's
+                       # bias-corrected lr) stop jitting instead of
+                       # recompiling every step
+
+
+def _never_jit(op):
+    # optimizer updates: tiny elementwise kernels whose lr/wd attrs churn
+    # per step — direct dispatch beats a compile-per-step
+    from ..ops.optimizer_ops import INPLACE_UPDATES
+    return op.name in INPLACE_UPDATES
+
+
+def _eager_attrs_key(attrs):
+    try:
+        return tuple(sorted((k, v) for k, v in attrs.items()))
+    except TypeError:
+        return None
+
+
+_EAGER_JIT_ENABLED = os.environ.get("MXNET_EAGER_JIT", "1") not in ("0", "false")
+
+
+def _call_op(op, raw, attrs):
+    if not _EAGER_JIT_ENABLED or id(op.fn) in _EAGER_NOJIT or _never_jit(op):
+        return op.fn(*raw, **attrs)
+    akey = _eager_attrs_key(attrs)
+    if akey is None or any(isinstance(r, jax.core.Tracer) for r in raw):
+        # unhashable attrs (arrays) or already inside a trace: call direct
+        return op.fn(*raw, **attrs)
+    key = (id(op.fn), akey)
+    fn = _EAGER_JIT.get(key)
+    if fn is None:
+        misses = _EAGER_MISSES.get(id(op.fn), 0) + 1
+        _EAGER_MISSES[id(op.fn)] = misses
+        if misses > _EAGER_MISS_LIMIT:
+            _EAGER_NOJIT.add(id(op.fn))
+            return op.fn(*raw, **attrs)
+        fn = jax.jit(lambda *a, _f=op.fn, _at=dict(attrs): _f(*a, **_at))
+        try:
+            result = fn(*raw)
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerBoolConversionError,
+                jax.errors.NonConcreteBooleanIndexError,
+                jax.errors.TracerArrayConversionError):
+            _EAGER_NOJIT.add(id(op.fn))
+            return op.fn(*raw, **attrs)
+        _EAGER_JIT[key] = fn
+        if len(_EAGER_JIT) > 16384:
+            _EAGER_JIT.clear()
+        return result
+    return fn(*raw)
+
+
 def invoke(op, nd_inputs, attrs, out=None):
     nd_inputs = [x if isinstance(x, NDArray) else _as_nd(x) for x in nd_inputs]
     raw = [x._data for x in nd_inputs]
     if _AMP_HOOK is not None:
         raw = _AMP_HOOK(op, raw)
-    result = op.fn(*raw, **attrs)
+    result = _call_op(op, raw, attrs)
     single = not isinstance(result, (tuple, list))
     outs = [result] if single else list(result)
     nd_outs = [_wrap(r) for r in outs]
