@@ -26,3 +26,4 @@ from .ring_attention import (  # noqa: F401
 from .checkpoint import (  # noqa: F401
     save_spmd_checkpoint, load_spmd_checkpoint, SPMDCheckpointManager,
 )
+from .pipeline import gpipe, pipeline_stage_loop  # noqa: F401

@@ -1,0 +1,101 @@
+"""Pipeline parallelism — GPipe-style microbatching over a ``pp`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.3: closest is
+``PartialForward`` staging); this is a TPU-first design: homogeneous stages
+(e.g. transformer blocks) live one-per-device along the ``pp`` axis, their
+parameters stacked on a leading stage axis and sharded over it, and
+microbatch activations flow device-to-device via ``lax.ppermute`` (one ICI
+hop per tick).  The whole schedule — fill, steady state, drain — is a single
+``lax.fori_loop`` inside ``shard_map``, so forward *and* backward compile to
+one XLA program and ``jax.grad`` differentiates straight through the
+collectives.
+
+Requirements: every stage maps activations of shape S → S (stack-of-blocks
+models), and the leading dimension of each stacked parameter equals the
+``pp`` axis size.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["gpipe", "pipeline_stage_loop"]
+
+
+def pipeline_stage_loop(stage_fn, stage_params, x_micro, axis_name):
+    """Per-device body (call inside shard_map).
+
+    ``stage_params``: this device's stage parameters (leading stage axis
+    already stripped to size 1 by the sharding — squeezed here).
+    ``x_micro``: (n_micro, mb, ...) microbatched input, replicated.
+    Returns (n_micro, mb, ...) outputs, replicated (psum'd off the last
+    stage).
+    """
+    n_stage = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    params = jax.tree.map(lambda p: p[0], stage_params)
+    n_micro = x_micro.shape[0]
+    steps = n_micro + n_stage - 1
+    perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+    probe = stage_fn(params, x_micro[0])
+    carry0 = jnp.zeros_like(probe)
+    outputs0 = jnp.zeros((n_micro,) + probe.shape, probe.dtype)
+    # accumulators must carry the same varying-axes type as the loop values
+    carry0 = carry0 + lax.psum(jnp.zeros([], probe.dtype), axis_name) * 0
+    outputs0 = outputs0 + carry0 * 0
+
+    def body(t, state):
+        carry, outputs = state
+        inject = x_micro[jnp.clip(t, 0, n_micro - 1)].astype(probe.dtype)
+        inp = jnp.where(idx == 0, inject, carry)
+        # fill/drain ticks run with garbage on idle devices; their results
+        # are never written (masked below) — branch-free schedule
+        out = stage_fn(params, inp)
+        widx = t - (n_stage - 1)
+        is_last = idx == n_stage - 1
+        write = is_last & (widx >= 0)
+        wclip = jnp.clip(widx, 0, n_micro - 1)
+        outputs = outputs.at[wclip].set(
+            jnp.where(write, out, outputs[wclip]))
+        carry = lax.ppermute(out, axis_name, perm)
+        return carry, outputs
+
+    _, outputs = lax.fori_loop(0, steps, body, (carry0, outputs0))
+    # broadcast the last stage's outputs to every device (replicated result)
+    mask = (idx == n_stage - 1).astype(outputs.dtype)
+    return lax.psum(outputs * mask, axis_name)
+
+
+def gpipe(stage_fn, stacked_params, x, mesh, n_microbatches, pp_axis="pp"):
+    """Run a stack of homogeneous stages as a pipeline.
+
+    - ``stage_fn(params, x) -> y`` with ``y.shape == x.shape``
+    - ``stacked_params``: pytree whose leaves stack the per-stage values on
+      axis 0 (length = pp axis size)
+    - ``x``: (batch, ...); batch must divide by ``n_microbatches``
+    """
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    b = x.shape[0]
+    assert b % n_microbatches == 0, \
+        f"batch {b} not divisible by n_microbatches {n_microbatches}"
+    x_micro = x.reshape((n_microbatches, b // n_microbatches) + x.shape[1:])
+
+    fn = functools.partial(pipeline_stage_loop, stage_fn,
+                           axis_name=pp_axis)
+    param_specs = jax.tree.map(lambda _: P(pp_axis), stacked_params)
+    out = shard_map(
+        lambda p, xm: fn(p, xm),
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+    )(stacked_params, x_micro)
+    return out.reshape((b,) + out.shape[2:])

@@ -1,0 +1,73 @@
+"""Pipeline parallelism tests (GPipe schedule over the pp mesh axis)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import device_mesh, gpipe
+
+
+def _stage(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _stacked(n_stage, d, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"w": jnp.asarray(rng.randn(n_stage, d, d) * 0.3, jnp.float32),
+            "b": jnp.asarray(rng.randn(n_stage, d) * 0.1, jnp.float32)}
+
+
+def _sequential(params, x):
+    for i in range(params["w"].shape[0]):
+        x = _stage({"w": params["w"][i], "b": params["b"][i]}, x)
+    return x
+
+
+@pytest.mark.parametrize("n_micro", [1, 2, 4])
+def test_gpipe_matches_sequential(n_micro):
+    n_stage, d, batch = 4, 16, 8
+    mesh = device_mesh({"dp": 2, "pp": 4})
+    params = _stacked(n_stage, d)
+    x = jnp.asarray(np.random.RandomState(1).randn(batch, d), jnp.float32)
+    out = gpipe(_stage, params, x, mesh, n_micro)
+    ref = _sequential(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_gpipe_eight_stages():
+    mesh = device_mesh({"pp": 8})
+    params = _stacked(8, 8)
+    x = jnp.ones((4, 8), jnp.float32) * 0.1
+    out = gpipe(_stage, params, x, mesh, 2)
+    ref = _sequential(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_gpipe_gradients_match():
+    n_stage, d, batch = 4, 8, 8
+    mesh = device_mesh({"dp": 2, "pp": 4})
+    params = _stacked(n_stage, d)
+    x = jnp.asarray(np.random.RandomState(2).randn(batch, d), jnp.float32)
+
+    def loss_pipe(p):
+        return gpipe(_stage, p, x, mesh, 2).sum()
+
+    def loss_seq(p):
+        return _sequential(p, x).sum()
+
+    gp = jax.grad(loss_pipe)(params)
+    gs = jax.grad(loss_seq)(params)
+    np.testing.assert_allclose(np.asarray(gp["w"]), np.asarray(gs["w"]),
+                               rtol=5e-4, atol=5e-5)
+    np.testing.assert_allclose(np.asarray(gp["b"]), np.asarray(gs["b"]),
+                               rtol=5e-4, atol=5e-5)
+
+
+def test_gpipe_batch_divisibility_check():
+    mesh = device_mesh({"pp": 8})
+    params = _stacked(8, 4)
+    with pytest.raises(AssertionError):
+        gpipe(_stage, params, jnp.ones((5, 4)), mesh, 2)
