@@ -27,3 +27,4 @@ from .checkpoint import (  # noqa: F401
     save_spmd_checkpoint, load_spmd_checkpoint, SPMDCheckpointManager,
 )
 from .pipeline import gpipe, pipeline_stage_loop  # noqa: F401
+from .moe import moe_layer, switch_moe_local  # noqa: F401
