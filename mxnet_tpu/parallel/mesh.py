@@ -15,6 +15,16 @@ import numpy as np
 _current = []
 
 
+def shard_map_fn():
+    """The shard_map entry point across jax versions (one shim, used by
+    ring_attention/pipeline/moe)."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
 def device_mesh(axes, devices=None):
     """Build a ``jax.sharding.Mesh`` from ``{axis_name: size}``.
 

@@ -60,12 +60,16 @@ def moe_layer(expert_fn, gate_w, expert_params, x, mesh, ep_axis="ep",
     """SPMD entry: x (B, D) sharded over ``ep`` (token-parallel), experts
     sharded one-per-device; returns (B, D) with the same sharding."""
     from jax.sharding import PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from .mesh import shard_map_fn
+    shard_map = shard_map_fn()
 
-    E = dict(zip(mesh.axis_names, mesh.devices.shape))[ep_axis]
+    E = mesh.shape[ep_axis]
+    assert gate_w.shape[-1] == E, \
+        f"gate width {gate_w.shape[-1]} != ep axis size {E} (one expert " \
+        "per device: tokens routed past the mesh would silently misroute)"
+    for leaf in jax.tree.leaves(expert_params):
+        assert leaf.shape[0] == E, \
+            f"expert param leading axis {leaf.shape[0]} != ep axis size {E}"
     b = x.shape[0]
     t_local = b // E
     capacity = max(1, math.ceil(t_local / E * capacity_factor))

@@ -79,10 +79,8 @@ def gpipe(stage_fn, stacked_params, x, mesh, n_microbatches, pp_axis="pp"):
     - ``x``: (batch, ...); batch must divide by ``n_microbatches``
     """
     from jax.sharding import PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from .mesh import shard_map_fn
+    shard_map = shard_map_fn()
 
     b = x.shape[0]
     assert b % n_microbatches == 0, \

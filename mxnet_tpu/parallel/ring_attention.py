@@ -95,10 +95,8 @@ def ring_self_attention(q, k, v, mesh, sp_axis="sp", dp_axis="dp",
     """SPMD entry point: (B, H, T, D) arrays, T sharded over ``sp`` and B
     over ``dp``.  Returns attention output with the same sharding."""
     from jax.sharding import PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from .mesh import shard_map_fn
+    shard_map = shard_map_fn()
 
     spec = P(dp_axis, None, sp_axis, None)
     fn = functools.partial(ring_attention, axis_name=sp_axis, causal=causal,

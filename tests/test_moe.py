@@ -66,3 +66,12 @@ def test_moe_gradients_flow():
     assert np.isfinite(np.asarray(g_gate)).all()
     assert np.isfinite(np.asarray(g_exp["w"])).all()
     assert np.abs(np.asarray(g_exp["w"])).sum() > 0
+
+
+def test_moe_mismatched_gate_raises():
+    gate_w, expert_params, x = _setup(E=4)
+    mesh = device_mesh({"dp": 2, "ep": 4})
+    import jax.numpy as jnp
+    bad_gate = jnp.zeros((x.shape[-1], 16), jnp.float32)
+    with pytest.raises(AssertionError):
+        moe_layer(_expert, bad_gate, expert_params, x, mesh)
