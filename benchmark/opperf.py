@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Per-operator benchmark harness (reference ``benchmark/opperf/`` — per-op
+forward/backward latency over the full registry).
+
+Times each op's jitted forward (and backward where differentiable) on the
+default device.  ``--ops`` selects a subset; default sweeps a representative
+basket.  Output: one line per op with p50 latency, plus a JSON summary.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+DEFAULT_OPS = [
+    # (op name, input shapes, attrs)
+    ("FullyConnected", [(64, 512), (1024, 512), (1024,)],
+     {"num_hidden": 1024}),
+    ("Convolution", [(16, 64, 56, 56), (128, 64, 3, 3), (128,)],
+     {"kernel": (3, 3), "num_filter": 128, "pad": (1, 1)}),
+    ("BatchNorm", [(32, 64, 28, 28), (64,), (64,), (64,), (64,)], {}),
+    ("Activation", [(32, 128, 28, 28)], {"act_type": "relu"}),
+    ("softmax", [(128, 1000)], {}),
+    ("dot", [(512, 512), (512, 512)], {}),
+    ("batch_dot", [(32, 128, 64), (32, 64, 128)], {}),
+    ("sum", [(64, 128, 128)], {"axis": (1, 2)}),
+    ("broadcast_add", [(64, 128, 128), (64, 1, 128)], {}),
+    ("transpose", [(64, 128, 128)], {"axes": (0, 2, 1)}),
+    ("LayerNorm", [(64, 512), (512,), (512,)], {}),
+    ("Embedding", [(64, 128), (10000, 256)],
+     {"input_dim": 10000, "output_dim": 256}),
+    ("take", [(10000, 256), (4096,)], {}),
+    ("topk", [(64, 1000)], {"k": 5, "ret_typ": "value"}),
+    ("_contrib_flash_attention", [(2, 8, 512, 64)] * 3, {}),
+]
+
+
+def bench_op(name, shapes, attrs, iters, warmup=3):
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.ops import registry
+
+    op = registry.get(name)
+    if op is None:
+        return None
+    rng = np.random.RandomState(0)
+    ctx = mx.gpu(0) if mx.num_gpus() else mx.cpu(0)
+    args = []
+    for i, s in enumerate(shapes):
+        if name in ("Embedding", "take") and i == (0 if name == "take" else 0):
+            # integer index inputs where applicable
+            pass
+        args.append(mx.nd.array(rng.rand(*s).astype("float32"), ctx=ctx))
+    if name == "Embedding":
+        args[0] = mx.nd.array(rng.randint(0, attrs["input_dim"],
+                                          shapes[0]).astype("float32"),
+                              ctx=ctx)
+    if name == "take":
+        args[1] = mx.nd.array(rng.randint(0, shapes[0][0],
+                                          shapes[1]).astype("float32"),
+                              ctx=ctx)
+
+    fwd = getattr(mx.nd, name)
+
+    def run_fwd():
+        out = fwd(*args, **attrs)
+        (out[0] if isinstance(out, list) else out).wait_to_read()
+
+    for _ in range(warmup):
+        run_fwd()
+    t = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        run_fwd()
+        t.append(time.perf_counter() - t0)
+    fwd_ms = float(np.median(t) * 1e3)
+
+    bwd_ms = None
+    try:
+        x = args[0]
+        x.attach_grad()
+        with mx.autograd.record():
+            out = fwd(*args, **attrs)
+            head = (out[0] if isinstance(out, list) else out)
+            loss = head.sum()
+        loss.backward()
+        for _ in range(warmup):
+            with mx.autograd.record():
+                out = fwd(*args, **attrs)
+                loss = (out[0] if isinstance(out, list) else out).sum()
+            loss.backward()
+            x.grad.wait_to_read()
+        t = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            with mx.autograd.record():
+                out = fwd(*args, **attrs)
+                loss = (out[0] if isinstance(out, list) else out).sum()
+            loss.backward()
+            x.grad.wait_to_read()
+            t.append(time.perf_counter() - t0)
+        bwd_ms = float(np.median(t) * 1e3)
+    except Exception:
+        pass
+    return {"op": name, "fwd_ms": round(fwd_ms, 4),
+            "fwd_bwd_ms": round(bwd_ms, 4) if bwd_ms else None}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--ops", nargs="*", default=None,
+                        help="subset of op names (default: basket)")
+    parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args()
+    basket = DEFAULT_OPS if not args.ops else \
+        [c for c in DEFAULT_OPS if c[0] in args.ops]
+    results = []
+    for name, shapes, attrs in basket:
+        res = bench_op(name, shapes, attrs, args.iters)
+        if res is None:
+            print(f"{name:-32s} NOT REGISTERED")
+            continue
+        results.append(res)
+        bwd = f"{res['fwd_bwd_ms']:.3f}" if res["fwd_bwd_ms"] else "-"
+        print(f"{name:32s} fwd {res['fwd_ms']:8.3f} ms   fwd+bwd {bwd:>8s} ms")
+    if args.json:
+        print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -58,7 +58,7 @@ def test_launch_local(tmp_path):
     out = subprocess.run(
         [sys.executable, os.path.join(os.path.dirname(__file__), "..",
                                       "tools", "launch.py"),
-         "-n", "2", sys.executable, str(script)],
+         "-n", "2", "--port", "29745", sys.executable, str(script)],
         capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, out.stderr
     assert "rank 0 of 2" in out.stdout and "rank 1 of 2" in out.stdout
