@@ -51,17 +51,20 @@ def test_estimator_early_stopping_and_checkpoint(tmp_path):
 def test_launch_local(tmp_path):
     """tools/launch.py spawns N workers with the coordinator env."""
     script = tmp_path / "w.py"
+    # per-rank files: concurrent stdout lines can interleave mid-line
     script.write_text(
         "import os\n"
-        "print('rank', os.environ['JAX_PROCESS_ID'],\n"
-        "      'of', os.environ['JAX_NUM_PROCESSES'])\n")
+        f"open(os.path.join({str(tmp_path)!r}, "
+        "'rank%s' % os.environ['JAX_PROCESS_ID']), 'w').write(\n"
+        "    os.environ['JAX_NUM_PROCESSES'])\n")
     out = subprocess.run(
         [sys.executable, os.path.join(os.path.dirname(__file__), "..",
                                       "tools", "launch.py"),
          "-n", "2", "--port", "29745", sys.executable, str(script)],
         capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, out.stderr
-    assert "rank 0 of 2" in out.stdout and "rank 1 of 2" in out.stdout
+    assert (tmp_path / "rank0").read_text() == "2"
+    assert (tmp_path / "rank1").read_text() == "2"
 
 
 def test_rtc_compat():
