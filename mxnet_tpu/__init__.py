@@ -51,6 +51,10 @@ from . import rnn  # noqa: F401
 from . import rtc  # noqa: F401
 from . import util  # noqa: F401
 from . import config  # noqa: F401
+from . import engine  # noqa: F401
+from . import libinfo  # noqa: F401
+from . import log  # noqa: F401
+from . import kvstore_server  # noqa: F401
 from . import contrib  # noqa: F401
 from . import models  # noqa: F401
 

@@ -1,0 +1,31 @@
+"""Engine control surface (reference ``python/mxnet/engine.py`` —
+``bulk``/``set_bulk_size`` batch engine ops to amortize dispatch).
+
+TPU-native: XLA fusion + the eager per-op jit cache subsume op bulking; the
+knobs are accepted so reference scripts run, and the ``bulk`` scope is kept
+as a (behaviorally inert) context manager.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["set_bulk_size", "bulk"]
+
+_bulk_size = [0]
+
+
+def set_bulk_size(size):
+    """Reference ``engine.py:set_bulk_size``; returns the previous value."""
+    prev = _bulk_size[0]
+    _bulk_size[0] = int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Reference ``engine.py:bulk`` scope."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

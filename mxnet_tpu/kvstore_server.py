@@ -1,0 +1,32 @@
+"""Parameter-server role (reference ``python/mxnet/kvstore_server.py``).
+
+There is no server process on TPU (SURVEY.md §5.8): ``dist_*`` reduction is
+XLA collectives among equal workers, so ``_init_kvstore_server_module`` is a
+no-op that simply returns — scripts that branch on ``DMLC_ROLE == 'server'``
+fall through to the worker path, which is correct here.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """Kept for API parity; ``run`` explains instead of blocking forever."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+
+    def run(self):
+        logging.info("kvstore server role is vestigial on TPU: dist_* types "
+                     "reduce via collectives among workers; returning "
+                     "immediately")
+
+
+def _init_kvstore_server_module():
+    role = os.environ.get("DMLC_ROLE")
+    if role == "server":
+        logging.info("DMLC_ROLE=server ignored: no parameter-server role in "
+                     "the TPU-native distribution design")

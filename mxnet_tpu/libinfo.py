@@ -1,0 +1,19 @@
+"""Version/library info (reference ``python/mxnet/libinfo.py``)."""
+from __future__ import annotations
+
+import os
+
+__version__ = "1.5.0"  # API-compatibility level with the reference
+
+
+def find_lib_path():
+    """The reference locates libmxnet.so; here the native component is the
+    IO library (built on demand)."""
+    from . import _native
+    lib = _native.load()
+    return [_native._LIB_PATH] if lib is not None else []
+
+
+def find_include_path():
+    return [os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                         "src")]

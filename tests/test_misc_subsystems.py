@@ -186,3 +186,19 @@ def test_config_knobs(monkeypatch):
     assert config.get("MXNET_CPU_WORKER_NTHREADS") == 8  # accepted, no-op
     assert "MXNET_ENGINE_TYPE" in config.describe()
     assert config.get("SOME_UNKNOWN", "fallback") == "fallback"
+
+
+def test_small_compat_modules():
+    # engine bulk scope
+    prev = mx.engine.set_bulk_size(16)
+    with mx.engine.bulk(32):
+        pass
+    mx.engine.set_bulk_size(prev)
+    # libinfo
+    assert mx.libinfo.__version__ == "1.5.0"
+    assert isinstance(mx.libinfo.find_lib_path(), list)
+    # log
+    lg = mx.log.get_logger("mxtest", level=mx.log.INFO)
+    lg.info("hello")
+    # kvstore server no-op
+    mx.kvstore_server._init_kvstore_server_module()
