@@ -1,0 +1,6 @@
+"""Gluon contrib recurrent cells (reference
+``python/mxnet/gluon/contrib/rnn/``)."""
+from .conv_rnn_cell import (  # noqa: F401
+    Conv2DRNNCell, Conv2DLSTMCell, Conv2DGRUCell,
+)
+from .rnn_cell import VariationalDropoutCell, LSTMPCell  # noqa: F401

@@ -1,0 +1,135 @@
+"""Convolutional recurrent cells (reference
+``python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py``): i2h/h2h are
+convolutions over spatial feature maps, states are (N, C_h, H, W)."""
+from __future__ import annotations
+
+from .... import ndarray as nd
+from ....base import parse_tuple
+from ...rnn.rnn_cell import HybridRecurrentCell
+
+__all__ = ["Conv2DRNNCell", "Conv2DLSTMCell", "Conv2DGRUCell"]
+
+
+class _BaseConvRNNCell(HybridRecurrentCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 gates, i2h_pad=(0, 0), activation="tanh",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_shape = tuple(input_shape)   # (C_in, H, W)
+        self._hidden_channels = hidden_channels
+        self._i2h_kernel = parse_tuple(i2h_kernel, 2)
+        self._h2h_kernel = parse_tuple(h2h_kernel, 2)
+        assert all(k % 2 == 1 for k in self._h2h_kernel), \
+            "h2h kernel dims must be odd to preserve the state shape; got " \
+            f"{self._h2h_kernel}"
+        self._i2h_pad = parse_tuple(i2h_pad, 2)
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+        self._activation = activation
+        self._gates = gates
+        cin = self._input_shape[0]
+        gh = gates * hidden_channels
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(gh, cin) + self._i2h_kernel,
+            allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(gh, hidden_channels) + self._h2h_kernel,
+            allow_deferred_init=True)
+        self.i2h_bias = self.params.get("i2h_bias", shape=(gh,),
+                                        init="zeros",
+                                        allow_deferred_init=True)
+        self.h2h_bias = self.params.get("h2h_bias", shape=(gh,),
+                                        init="zeros",
+                                        allow_deferred_init=True)
+        # spatial state dims from the i2h conv geometry
+        h_out = (self._input_shape[1] + 2 * self._i2h_pad[0]
+                 - self._i2h_kernel[0]) + 1
+        w_out = (self._input_shape[2] + 2 * self._i2h_pad[1]
+                 - self._i2h_kernel[1]) + 1
+        self._state_shape = (hidden_channels, h_out, w_out)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size,) + self._state_shape,
+                 "__layout__": "NCHW"}]
+
+    def _conv_pair(self, inputs, states):
+        gh = self._gates * self._hidden_channels
+        i2h = nd.Convolution(inputs, self.i2h_weight.data(inputs.context),
+                             self.i2h_bias.data(inputs.context),
+                             kernel=self._i2h_kernel, pad=self._i2h_pad,
+                             num_filter=gh)
+        h2h = nd.Convolution(states[0], self.h2h_weight.data(inputs.context),
+                             self.h2h_bias.data(inputs.context),
+                             kernel=self._h2h_kernel, pad=self._h2h_pad,
+                             num_filter=gh)
+        return i2h, h2h
+
+
+class Conv2DRNNCell(_BaseConvRNNCell):
+    """Elman conv cell (reference ``conv_rnn_cell.py:Conv2DRNNCell``)."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=(0, 0), activation="tanh", prefix=None, params=None):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, 1, i2h_pad, activation, prefix, params)
+
+    def _alias(self):
+        return "conv_rnn"
+
+    def _forward_step(self, inputs, states):
+        i2h, h2h = self._conv_pair(inputs, states)
+        out = nd.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class Conv2DLSTMCell(_BaseConvRNNCell):
+    """ConvLSTM (Shi et al. 2015; reference
+    ``conv_rnn_cell.py:Conv2DLSTMCell``)."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=(0, 0), activation="tanh", prefix=None, params=None):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, 4, i2h_pad, activation, prefix, params)
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size,) + self._state_shape
+        return [{"shape": shape, "__layout__": "NCHW"},
+                {"shape": shape, "__layout__": "NCHW"}]
+
+    def _forward_step(self, inputs, states):
+        i2h, h2h = self._conv_pair(inputs, states)
+        gates = i2h + h2h
+        i, f, g, o = [x for x in nd.split(gates, num_outputs=4, axis=1)]
+        i = nd.sigmoid(i)
+        f = nd.sigmoid(f)
+        g = nd.Activation(g, act_type=self._activation)
+        o = nd.sigmoid(o)
+        c = f * states[1] + i * g
+        h = o * nd.Activation(c, act_type=self._activation)
+        return h, [h, c]
+
+
+class Conv2DGRUCell(_BaseConvRNNCell):
+    """ConvGRU (reference ``conv_rnn_cell.py:Conv2DGRUCell``)."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=(0, 0), activation="tanh", prefix=None, params=None):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, 3, i2h_pad, activation, prefix, params)
+
+    def _alias(self):
+        return "conv_gru"
+
+    def _forward_step(self, inputs, states):
+        i2h, h2h = self._conv_pair(inputs, states)
+        i2h_r, i2h_z, i2h_n = [x for x in nd.split(i2h, num_outputs=3,
+                                                   axis=1)]
+        h2h_r, h2h_z, h2h_n = [x for x in nd.split(h2h, num_outputs=3,
+                                                   axis=1)]
+        r = nd.sigmoid(i2h_r + h2h_r)
+        z = nd.sigmoid(i2h_z + h2h_z)
+        n = nd.Activation(i2h_n + r * h2h_n, act_type=self._activation)
+        out = (1 - z) * n + z * states[0]
+        return out, [out]

@@ -1,0 +1,114 @@
+"""Contrib recurrent cells (reference
+``python/mxnet/gluon/contrib/rnn/rnn_cell.py``)."""
+from __future__ import annotations
+
+from .... import ndarray as nd
+from ...rnn.rnn_cell import HybridRecurrentCell, ModifierCell
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational (same-mask-every-step) dropout around a cell (reference
+    ``rnn_cell.py:VariationalDropoutCell``)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def _alias(self):
+        return "vardrop"
+
+    def reset(self):
+        super().reset()
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def _initialize_mask(self, like, p):
+        return nd.Dropout(nd.ones_like(like), p=p)
+
+    def _forward_step(self, inputs, states):
+        cell = self.base_cell
+        if self.drop_states:
+            if self.drop_states_mask is None:
+                self.drop_states_mask = self._initialize_mask(
+                    states[0], self.drop_states)
+            states = [states[0] * self.drop_states_mask] + list(states[1:])
+        if self.drop_inputs:
+            if self.drop_inputs_mask is None:
+                self.drop_inputs_mask = self._initialize_mask(
+                    inputs, self.drop_inputs)
+            inputs = inputs * self.drop_inputs_mask
+        output, states = cell(inputs, states)
+        if self.drop_outputs:
+            if self.drop_outputs_mask is None:
+                self.drop_outputs_mask = self._initialize_mask(
+                    output, self.drop_outputs)
+            output = output * self.drop_outputs_mask
+        return output, states
+
+
+class LSTMPCell(HybridRecurrentCell):
+    """LSTM with a projection layer on the hidden state (reference
+    ``rnn_cell.py:LSTMPCell``; Sak et al. 2014)."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, projection_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.h2r_weight = self.params.get(
+            "h2r_weight", shape=(projection_size, hidden_size),
+            init=h2r_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstmp"
+
+    def _forward_step(self, inputs, states):
+        if self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (4 * self._hidden_size, inputs.shape[-1])
+        h = self._hidden_size
+        ctx = inputs.context
+        i2h = nd.FullyConnected(inputs, self.i2h_weight.data(ctx),
+                                self.i2h_bias.data(ctx), num_hidden=4 * h,
+                                flatten=False)
+        h2h = nd.FullyConnected(states[0], self.h2h_weight.data(ctx),
+                                self.h2h_bias.data(ctx), num_hidden=4 * h,
+                                flatten=False)
+        gates = i2h + h2h
+        i, f, g, o = [x for x in nd.split(gates, num_outputs=4, axis=-1)]
+        c = nd.sigmoid(f) * states[1] + nd.sigmoid(i) * nd.tanh(g)
+        hidden = nd.sigmoid(o) * nd.tanh(c)
+        proj = nd.FullyConnected(hidden, self.h2r_weight.data(ctx),
+                                 no_bias=True,
+                                 num_hidden=self._projection_size,
+                                 flatten=False)
+        return proj, [proj, c]

@@ -1,0 +1,80 @@
+"""Gluon contrib layer/cell tests (reference
+``tests/python/unittest/test_gluon_contrib.py``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def test_conv_lstm_cell():
+    cell = gluon.contrib.rnn.Conv2DLSTMCell(
+        input_shape=(3, 12, 12), hidden_channels=8, i2h_kernel=(3, 3),
+        h2h_kernel=(3, 3), i2h_pad=(1, 1), prefix="clstm_")
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3, 12, 12))
+    out, states = cell(x, cell.begin_state(2))
+    assert out.shape == (2, 8, 12, 12)
+    assert states[1].shape == (2, 8, 12, 12)
+    outs, _ = cell.unroll(3, [x, x, x])
+    assert outs[-1].shape == (2, 8, 12, 12)
+
+
+@pytest.mark.parametrize("cls", ["Conv2DRNNCell", "Conv2DGRUCell"])
+def test_conv_rnn_gru_cells(cls):
+    cell = getattr(gluon.contrib.rnn, cls)(
+        input_shape=(3, 8, 8), hidden_channels=4, i2h_kernel=(3, 3),
+        h2h_kernel=(3, 3), i2h_pad=(1, 1))
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3, 8, 8))
+    out, states = cell(x, cell.begin_state(2))
+    assert out.shape == (2, 4, 8, 8)
+
+
+def test_conv_cell_odd_kernel_check():
+    with pytest.raises(AssertionError):
+        gluon.contrib.rnn.Conv2DRNNCell(
+            input_shape=(3, 8, 8), hidden_channels=4, i2h_kernel=(3, 3),
+            h2h_kernel=(2, 2))
+
+
+def test_variational_dropout_cell():
+    base = gluon.rnn.GRUCell(16, input_size=8, prefix="vd_")
+    cell = gluon.contrib.rnn.VariationalDropoutCell(base, drop_inputs=0.3,
+                                                    drop_outputs=0.3)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(4, 5, 8))
+    with mx.autograd.record():
+        outs, _ = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (4, 5, 16)
+    # same mask across steps: zeroed input dims are zero at every step
+    mask = cell.drop_inputs_mask.asnumpy()
+    assert mask.shape == (4, 8)
+
+
+def test_lstmp_cell():
+    cell = gluon.contrib.rnn.LSTMPCell(hidden_size=16, projection_size=6,
+                                       input_size=4, prefix="lp_")
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(3, 4))
+    out, states = cell(x, cell.begin_state(3))
+    assert out.shape == (3, 6)        # projected
+    assert states[0].shape == (3, 6)  # projected hidden
+    assert states[1].shape == (3, 16)  # full cell state
+    outs, _ = cell.unroll(4, [x] * 4)
+    assert outs[-1].shape == (3, 6)
+
+
+def test_pixel_shuffle():
+    ps = gluon.contrib.nn.PixelShuffle2D(2)
+    x = mx.nd.array(np.arange(16, dtype="float32").reshape(1, 4, 2, 2))
+    out = ps(x)
+    assert out.shape == (1, 1, 4, 4)
+
+
+def test_sync_batchnorm_alias():
+    bn = gluon.contrib.nn.SyncBatchNorm(in_channels=4, num_devices=8)
+    bn.initialize()
+    x = mx.nd.random.uniform(shape=(2, 4, 5, 5))
+    out = bn(x)
+    assert out.shape == x.shape
