@@ -397,3 +397,22 @@ def test_split_and_load():
     splits = gluon.utils.split_and_load(data, ctx)
     assert len(splits) == 1
     assert splits[0].shape == (4, 3)
+
+
+def test_export_symbolblock_roundtrip(tmp_path):
+    """hybridize → export → SymbolBlock.imports serves identically
+    (reference block.py:876 export + block.py:960 SymbolBlock)."""
+    net = nn.HybridSequential(prefix="exp_")
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(4, in_units=16))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.random.uniform(shape=(2, 8))
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / "served")
+    net.export(prefix)
+    served = mx.gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                          prefix + "-0000.params")
+    out = served(x).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
