@@ -99,6 +99,50 @@ def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
     return _f(data)
 
 
+@register("IdentityAttachKLSparseReg")
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001,
+                                  momentum=0.9):
+    """Reference ``identity_attach_KL_sparse_reg.cc``: identity forward; the
+    KL sparseness penalty adds to the backward signal."""
+    st = parse_float(sparseness_target, 0.1)
+    pen = parse_float(penalty, 0.001)
+
+    @jax.custom_vjp
+    def _f(x):
+        return x
+
+    def _fwd(x):
+        return x, x
+
+    def _bwd(x, g):
+        rho_hat = jnp.clip(jnp.mean(jax.nn.sigmoid(x), axis=0), 1e-6,
+                           1 - 1e-6)
+        kl_grad = -st / rho_hat + (1 - st) / (1 - rho_hat)
+        return (g + pen * kl_grad * jax.nn.sigmoid(x) *
+                (1 - jax.nn.sigmoid(x)),)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(data)
+
+
+@register("_contrib_getnnz", aliases=("getnnz",))
+def getnnz(data, axis=None):
+    """Reference ``getnnz`` (sparse introspection; dense-backed here)."""
+    if axis is None:
+        return jnp.sum(data != 0).astype(jnp.int64)
+    return jnp.sum(data != 0, axis=parse_int(axis)).astype(jnp.int64)
+
+
+@register("_contrib_edge_id", aliases=("edge_id",))
+def edge_id(data, u, v):
+    """Reference ``dgl_graph.cc edge_id``: adjacency lookup — value at
+    (u_i, v_i) of the (dense-backed) adjacency, -1 where absent."""
+    uu = u.astype(jnp.int32)
+    vv = v.astype(jnp.int32)
+    vals = data[uu, vv]
+    return jnp.where(vals != 0, vals, -1.0)
+
+
 # ------------------------------------------------------- spatial sampling
 def _bilinear_sample(data, gx, gy):
     """Sample NCHW ``data`` at pixel coords (gx, gy) with zero padding

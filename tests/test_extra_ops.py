@@ -119,3 +119,26 @@ def test_sparse_retain_op():
     out = mx.nd.sparse_retain(data, mx.nd.array([0, 2]))
     assert out.asnumpy()[1].sum() == 0
     np.testing.assert_array_equal(out.asnumpy()[2], [4, 5])
+
+
+def test_getnnz_and_edge_id():
+    m = mx.nd.array([[0, 2, 0], [1, 0, 3]])
+    assert int(mx.nd.contrib.getnnz(m).asscalar()) == 3
+    np.testing.assert_array_equal(
+        mx.nd.contrib.getnnz(m, axis=0).asnumpy(), [1, 1, 1])
+    eid = mx.nd.contrib.edge_id(m, mx.nd.array([0, 1, 0]),
+                                mx.nd.array([1, 2, 0]))
+    np.testing.assert_array_equal(eid.asnumpy(), [2, 3, -1])
+
+
+def test_identity_attach_kl_sparse_reg():
+    x = mx.nd.array(np.random.RandomState(0).randn(8, 4).astype("float32"))
+    x.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.IdentityAttachKLSparseReg(x, sparseness_target=0.2,
+                                              penalty=0.01)
+        np.testing.assert_array_equal(out.asnumpy(), x.asnumpy())
+        out.sum().backward()
+    g = x.grad.asnumpy()
+    assert np.isfinite(g).all()
+    assert np.abs(g - 1.0).max() > 1e-6  # penalty actually contributed
