@@ -49,12 +49,29 @@ class MultiHeadAttention(HybridBlock):
                                                        self._num_heads))
         k = self._split_heads(F, k)
         v = self._split_heads(F, v)
-        if mask is None and self._use_flash and not self.dropout._rate:
-            # unmasked path: the Pallas blockwise kernel — no T×T scores
-            ctx = F.contrib.flash_attention(q, k, v, scale=1.0)
-            ctx = F.transpose(ctx, axes=(0, 2, 1, 3))
-            ctx = F.reshape(ctx, shape=(0, 0, -3))
-            return self.proj(ctx)
+        if mask is None and not self.dropout._rate:
+            from ..parallel.sp_context import current_sequence_parallel
+            sp = current_sequence_parallel()
+            if sp is not None:
+                # sequence-parallel path: T stays sharded over the sp axis;
+                # K/V ring around it (parallel/ring_attention.py)
+                from ..ndarray import invoke_fn
+                from ..parallel.ring_attention import ring_self_attention
+                mesh, sp_axis, dp_axis = sp
+                ctx = invoke_fn(
+                    lambda qq, kk, vv: ring_self_attention(
+                        qq, kk, vv, mesh, sp_axis=sp_axis, dp_axis=dp_axis,
+                        scale=1.0),
+                    [q, k, v])
+                ctx = F.transpose(ctx, axes=(0, 2, 1, 3))
+                ctx = F.reshape(ctx, shape=(0, 0, -3))
+                return self.proj(ctx)
+            if self._use_flash:
+                # unmasked single-shard path: Pallas blockwise kernel
+                ctx = F.contrib.flash_attention(q, k, v, scale=1.0)
+                ctx = F.transpose(ctx, axes=(0, 2, 1, 3))
+                ctx = F.reshape(ctx, shape=(0, 0, -3))
+                return self.proj(ctx)
         # scores: (B, H, T, T) — one MXU batch_dot
         scores = F.batch_dot(F.reshape(q, shape=(-3, 0, 0)),
                              F.reshape(k, shape=(-3, 0, 0)),

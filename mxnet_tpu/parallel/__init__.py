@@ -28,3 +28,6 @@ from .checkpoint import (  # noqa: F401
 )
 from .pipeline import gpipe, pipeline_stage_loop  # noqa: F401
 from .moe import moe_layer, switch_moe_local  # noqa: F401
+from .sp_context import (  # noqa: F401
+    sequence_parallel_scope, current_sequence_parallel,
+)

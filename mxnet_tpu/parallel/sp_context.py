@@ -1,0 +1,35 @@
+"""Sequence-parallel scope: lets model code (attention layers) discover the
+active ``sp`` mesh so long-context models run sharded *inside* the fused
+SPMD train step (SURVEY.md §5.7 — "exposed as a ``sequence`` mesh axis in
+the same sharding API as DP/TP").
+
+Usage: ``SPMDTrainer(..., sp=2)`` activates the scope around tracing; an
+attention layer calls :func:`current_sequence_parallel` and, when set,
+routes through :func:`ring_self_attention` instead of local attention.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["sequence_parallel_scope", "current_sequence_parallel"]
+
+_SCOPE = []
+
+
+@contextlib.contextmanager
+def sequence_parallel_scope(mesh, sp_axis="sp", dp_axis="dp"):
+    _SCOPE.append((mesh, sp_axis, dp_axis))
+    try:
+        yield
+    finally:
+        _SCOPE.pop()
+
+
+def current_sequence_parallel():
+    """(mesh, sp_axis, dp_axis) when inside a scope with sp size > 1."""
+    if not _SCOPE:
+        return None
+    mesh, sp_axis, dp_axis = _SCOPE[-1]
+    if mesh.shape.get(sp_axis, 1) <= 1:
+        return None
+    return mesh, sp_axis, dp_axis

@@ -57,6 +57,7 @@ def _functional_apply(net, trainable, aux, n_in):
 
 
 def make_train_step(net, loss_fn, optimizer, mesh, data_spec=None,
+                    label_spec=None,
                     param_rules=None, tp_axis="tp", dp_axis="dp",
                     donate=True):
     """Build ``(step_fn, init_args)`` for SPMD training of ``net``.
@@ -88,6 +89,8 @@ def make_train_step(net, loss_fn, optimizer, mesh, data_spec=None,
         tp_axis=tp_axis)
     if data_spec is None:
         data_spec = P(dp_axis)
+    if label_spec is None:
+        label_spec = P(dp_axis)
 
     params = {p.name: jax.device_put(p.data()._data,
                                      named_sharding(mesh, specs[p.name]))
@@ -123,8 +126,9 @@ def make_train_step(net, loss_fn, optimizer, mesh, data_spec=None,
         [named_sharding(mesh, P()) for _ in aux_arrays],
     )
     data_sh = named_sharding(mesh, data_spec)
+    label_sh = named_sharding(mesh, label_spec)
     step_jit = jax.jit(step,
-                       in_shardings=(state_sh, data_sh, data_sh, None, None),
+                       in_shardings=(state_sh, data_sh, label_sh, None, None),
                        out_shardings=(state_sh, None),
                        donate_argnums=(0,) if donate else ())
     return step_jit, (params, opt_state, aux_arrays)
@@ -139,22 +143,35 @@ class SPMDTrainer:
     Parameters for eager inference / ``save_parameters``.
     """
 
-    def __init__(self, net, loss_fn, optimizer, mesh, **kw):
+    def __init__(self, net, loss_fn, optimizer, mesh,
+                 sequence_parallel=False, sp_axis="sp", dp_axis="dp", **kw):
         self._net = net
         self._mesh = mesh
-        self._step_fn, self._state = make_train_step(
-            net, loss_fn, optimizer, mesh, **kw)
+        self._sp = (mesh, sp_axis, dp_axis) if sequence_parallel else None
+        with self._sp_scope():
+            self._step_fn, self._state = make_train_step(
+                net, loss_fn, optimizer, mesh, dp_axis=dp_axis, **kw)
         self._t = 0
         items = sorted(net.collect_params().items())
         self._trainable = [p for _, p in items if p.grad_req != "null"]
         self._aux = [p for _, p in items if p.grad_req == "null"]
 
+    def _sp_scope(self):
+        import contextlib
+        if self._sp is None:
+            return contextlib.nullcontext()
+        from .sp_context import sequence_parallel_scope
+        return sequence_parallel_scope(*self._sp)
+
     def step(self, data, label):
         data = data._data if isinstance(data, NDArray) else jnp.asarray(data)
         label = label._data if isinstance(label, NDArray) else jnp.asarray(label)
         key = _rnd.next_key()
-        self._state, loss = self._step_fn(self._state, data, label, key,
-                                          jnp.uint32(self._t))
+        # the scope matters while jax traces the step (first call / retrace):
+        # attention layers consult it to route through ring attention
+        with self._sp_scope():
+            self._state, loss = self._step_fn(self._state, data, label, key,
+                                              jnp.uint32(self._t))
         self._t += 1
         return NDArray(loss)
 
