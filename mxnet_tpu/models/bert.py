@@ -49,29 +49,35 @@ class MultiHeadAttention(HybridBlock):
                                                        self._num_heads))
         k = self._split_heads(F, k)
         v = self._split_heads(F, v)
-        if mask is None and not self.dropout._rate:
-            from ..parallel.sp_context import current_sequence_parallel
-            sp = current_sequence_parallel()
-            if sp is not None:
-                # sequence-parallel path: T stays sharded over the sp axis;
-                # K/V ring around it (parallel/ring_attention.py)
-                from ..ndarray import invoke_fn
-                from ..parallel.ring_attention import ring_self_attention
-                mesh, sp_axis, dp_axis = sp
-                ctx = invoke_fn(
-                    lambda qq, kk, vv: ring_self_attention(
-                        qq, kk, vv, mesh, sp_axis=sp_axis, dp_axis=dp_axis,
-                        scale=1.0),
-                    [q, k, v])
-                ctx = F.transpose(ctx, axes=(0, 2, 1, 3))
-                ctx = F.reshape(ctx, shape=(0, 0, -3))
-                return self.proj(ctx)
-            if self._use_flash:
-                # unmasked single-shard path: Pallas blockwise kernel
-                ctx = F.contrib.flash_attention(q, k, v, scale=1.0)
-                ctx = F.transpose(ctx, axes=(0, 2, 1, 3))
-                ctx = F.reshape(ctx, shape=(0, 0, -3))
-                return self.proj(ctx)
+        from ..parallel.sp_context import current_sequence_parallel
+        sp = current_sequence_parallel()
+        blockwise_ok = mask is None and not self.dropout._rate
+        if sp is not None and not blockwise_ok:
+            import warnings
+            warnings.warn(
+                "sequence-parallel scope active but attention falls back to "
+                "the dense T×T path: ring attention supports neither a "
+                "valid-length mask nor attention-prob dropout yet. Long "
+                "sequences will materialize full score matrices.")
+        ctx = None
+        if blockwise_ok and sp is not None:
+            # sequence-parallel path: T stays sharded over the sp axis;
+            # K/V ring around it (parallel/ring_attention.py)
+            from ..ndarray import invoke_fn
+            from ..parallel.ring_attention import ring_self_attention
+            mesh, sp_axis, dp_axis = sp
+            ctx = invoke_fn(
+                lambda qq, kk, vv: ring_self_attention(
+                    qq, kk, vv, mesh, sp_axis=sp_axis, dp_axis=dp_axis,
+                    scale=1.0),
+                [q, k, v])
+        elif blockwise_ok and self._use_flash:
+            # unmasked single-shard path: Pallas blockwise kernel
+            ctx = F.contrib.flash_attention(q, k, v, scale=1.0)
+        if ctx is not None:
+            ctx = F.transpose(ctx, axes=(0, 2, 1, 3))
+            ctx = F.reshape(ctx, shape=(0, 0, -3))
+            return self.proj(ctx)
         # scores: (B, H, T, T) — one MXU batch_dot
         scores = F.batch_dot(F.reshape(q, shape=(-3, 0, 0)),
                              F.reshape(k, shape=(-3, 0, 0)),

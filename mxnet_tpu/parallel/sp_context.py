@@ -3,9 +3,10 @@ active ``sp`` mesh so long-context models run sharded *inside* the fused
 SPMD train step (SURVEY.md §5.7 — "exposed as a ``sequence`` mesh axis in
 the same sharding API as DP/TP").
 
-Usage: ``SPMDTrainer(..., sp=2)`` activates the scope around tracing; an
-attention layer calls :func:`current_sequence_parallel` and, when set,
-routes through :func:`ring_self_attention` instead of local attention.
+Usage: ``SPMDTrainer(..., sequence_parallel=True)`` with a mesh whose
+``sp`` axis size > 1 activates the scope around tracing; an attention layer
+calls :func:`current_sequence_parallel` and, when set, routes through
+:func:`ring_self_attention` instead of local attention.
 """
 from __future__ import annotations
 
