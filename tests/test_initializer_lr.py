@@ -1,0 +1,114 @@
+"""Initializer + lr_scheduler tests (reference ``test_init.py`` and the
+lr_scheduler unit tests inside ``test_optimizer.py``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _materialize(init, shape, name="fc1_weight"):
+    arr = mx.nd.zeros(shape)
+    init(mx.init.InitDesc(name), arr)
+    return arr.asnumpy()
+
+
+def test_constant_zero_one():
+    assert _materialize(mx.init.Zero(), (3, 3)).sum() == 0
+    assert (_materialize(mx.init.One(), (3, 3)) == 1).all()
+    assert (_materialize(mx.init.Constant(2.5), (2, 2)) == 2.5).all()
+
+
+def test_uniform_normal_ranges():
+    u = _materialize(mx.init.Uniform(0.3), (200, 50))
+    assert np.abs(u).max() <= 0.3 + 1e-6
+    n = _materialize(mx.init.Normal(0.1), (200, 50))
+    assert 0.05 < n.std() < 0.15
+
+
+def test_xavier_magnitude():
+    w = _materialize(mx.init.Xavier(factor_type="avg", magnitude=3), (64, 32))
+    bound = np.sqrt(3.0 * 2 / (64 + 32))
+    assert np.abs(w).max() <= bound + 1e-6
+    assert np.abs(w).std() > bound / 4
+
+
+def test_orthogonal_is_orthogonal():
+    w = _materialize(mx.init.Orthogonal(scale=1.0), (32, 32))
+    eye = w @ w.T
+    np.testing.assert_allclose(eye, np.eye(32), atol=1e-4)
+
+
+def test_msra_prelu():
+    w = _materialize(mx.init.MSRAPrelu(), (64, 32))
+    assert np.isfinite(w).all() and w.std() > 0
+
+
+def test_name_based_dispatch():
+    """Initializer.__call__ dispatches by name suffix (gamma→1, bias→0...)"""
+    init = mx.init.Uniform(0.1)
+    gamma = mx.nd.zeros((8,))
+    init(mx.init.InitDesc("bn0_gamma"), gamma)
+    assert (gamma.asnumpy() == 1).all()
+    bias = mx.nd.ones((8,))
+    init(mx.init.InitDesc("fc0_bias"), bias)
+    assert (bias.asnumpy() == 0).all()
+
+
+def test_mixed_initializer():
+    init = mx.init.Mixed([".*bias", ".*"], [mx.init.Zero(),
+                                            mx.init.Constant(3)])
+    b = mx.nd.ones((4,))
+    init(mx.init.InitDesc("fc_bias_custom"), b)
+    # Mixed patterns apply in order; plain weight gets the constant
+    w = mx.nd.zeros((4,))
+    init(mx.init.InitDesc("fc_weight_custom"), w)
+    assert (w.asnumpy() == 3).all()
+
+
+# ------------------------------------------------------------ lr schedulers
+def test_factor_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5,
+                                            base_lr=1.0, stop_factor_lr=0.1)
+    assert sched(1) == 1.0
+    assert sched(11) == 0.5
+    assert sched(21) == 0.25
+    assert sched(100) >= 0.1 / 2  # clamped near stop_factor_lr
+
+
+def test_multifactor_scheduler():
+    sched = mx.lr_scheduler.MultiFactorScheduler(step=[10, 20], factor=0.1,
+                                                 base_lr=1.0)
+    assert sched(5) == 1.0
+    assert abs(sched(15) - 0.1) < 1e-9
+    assert abs(sched(25) - 0.01) < 1e-9
+
+
+def test_poly_and_cosine_schedulers():
+    poly = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0,
+                                         final_lr=0.0)
+    assert poly(0) == 1.0
+    assert poly(100) == 0.0
+    cos = mx.lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0,
+                                          final_lr=0.0)
+    assert abs(cos(0) - 1.0) < 1e-6
+    assert abs(cos(100)) < 1e-6
+    assert 0.4 < cos(50) < 0.6
+
+
+def test_warmup():
+    sched = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0,
+                                          warmup_steps=10,
+                                          warmup_begin_lr=0.0)
+    assert sched(0) < sched(5) < sched(10)
+    assert abs(sched(10) - 1.0) < 0.15
+
+
+def test_optimizer_uses_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=1, factor=0.5, base_lr=0.8)
+    opt = mx.optimizer.SGD(lr_scheduler=sched)
+    w = mx.nd.ones((2,))
+    g = mx.nd.ones((2,))
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)
+    lr1 = float(1 - w.asnumpy()[0])  # effective lr of first step
+    assert lr1 > 0
