@@ -125,3 +125,16 @@ def test_reset():
     assert acc.num_inst == 0
     name, val = acc.get()
     assert np.isnan(val)
+
+
+def test_local_global_split():
+    """reset_local keeps epoch totals in the global view (reference 1.5
+    local/global metric split)."""
+    m = mx.metric.Accuracy()
+    m.update([mx.nd.array([0, 1])], [mx.nd.array([[0, 1], [0, 1]])])  # 1/2
+    m.reset_local()
+    m.update([mx.nd.array([1, 1])], [mx.nd.array([[0, 1], [0, 1]])])  # 2/2
+    assert m.get()[1] == 1.0                 # local window: last interval
+    assert m.get_global()[1] == 0.75         # epoch total: 3/4
+    m.reset()
+    assert np.isnan(m.get_global()[1])
