@@ -63,14 +63,14 @@ class CSRNDArray(BaseSparseNDArray):
     @property
     def indices(self):
         arr = self.asnumpy()
-        return _as_nd(_np.nonzero(arr)[1].astype(_np.int64))
+        return _as_nd(_np.nonzero(arr)[1].astype(_np.int32))
 
     @property
     def indptr(self):
         arr = self.asnumpy()
         counts = (arr != 0).sum(axis=1)
         return _as_nd(_np.concatenate([[0], _np.cumsum(counts)])
-                      .astype(_np.int64))
+                      .astype(_np.int32))
 
 
 class RowSparseNDArray(BaseSparseNDArray):
@@ -88,7 +88,7 @@ class RowSparseNDArray(BaseSparseNDArray):
     def indices(self):
         arr = self.asnumpy()
         rows = _np.nonzero((arr != 0).reshape(arr.shape[0], -1).any(axis=1))[0]
-        return _as_nd(rows.astype(_np.int64))
+        return _as_nd(rows.astype(_np.int32))
 
     def retain(self, rows):
         """Keep only the requested rows (reference ``sparse.retain``)."""

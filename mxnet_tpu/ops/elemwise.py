@@ -71,8 +71,8 @@ _unary("logical_not", lambda x: (x == 0).astype(x.dtype))
 _unary("isnan", jnp.isnan)
 _unary("isinf", jnp.isinf)
 _unary("isfinite", jnp.isfinite)
-_unary("size_array", lambda x: jnp.asarray([x.size], dtype=jnp.int64))
-_unary("shape_array", lambda x: jnp.asarray(x.shape, dtype=jnp.int64))
+_unary("size_array", lambda x: jnp.asarray([x.size], dtype=jnp.int32))  # int64 truncates on 32-bit jax anyway
+_unary("shape_array", lambda x: jnp.asarray(x.shape, dtype=jnp.int32))
 
 
 @register("_copy", aliases=("identity",))

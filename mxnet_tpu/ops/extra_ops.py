@@ -129,8 +129,8 @@ def identity_attach_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001,
 def getnnz(data, axis=None):
     """Reference ``getnnz`` (sparse introspection; dense-backed here)."""
     if axis is None:
-        return jnp.sum(data != 0).astype(jnp.int64)
-    return jnp.sum(data != 0, axis=parse_int(axis)).astype(jnp.int64)
+        return jnp.sum(data != 0).astype(jnp.int32)
+    return jnp.sum(data != 0, axis=parse_int(axis)).astype(jnp.int32)
 
 
 @register("_contrib_edge_id", aliases=("edge_id",))
@@ -276,7 +276,7 @@ def index_array(data, axes=None):
     shape = data.shape
     axes_t = parse_tuple(axes) if axes is not None else tuple(
         range(len(shape)))
-    comps = [jax.lax.broadcasted_iota(jnp.int64, shape, ax) for ax in axes_t]
+    comps = [jax.lax.broadcasted_iota(jnp.int32, shape, ax) for ax in axes_t]
     return jnp.stack(comps, axis=-1)
 
 
