@@ -453,11 +453,64 @@ def _quantized_wrapper(float_op_name, n_tensors):
     return fn
 
 
-register("_contrib_quantized_fully_connected",
-         aliases=("quantized_fully_connected",))(
-    _quantized_wrapper("FullyConnected", 3))
-register("_contrib_quantized_conv", aliases=("quantized_conv",))(
-    _quantized_wrapper("Convolution", 3))
+def _scale_of(mn, mx, dtype):
+    """De-quantization scale implied by a calibration range."""
+    if dtype == jnp.uint8:
+        return (mx.reshape(()) - mn.reshape(())) / 255.0
+    amax = jnp.maximum(jnp.abs(mn.reshape(())), jnp.abs(mx.reshape(())))
+    return amax / 127.0
+
+
+@register("_contrib_quantized_fully_connected",
+          aliases=("quantized_fully_connected",))
+def quantized_fully_connected(data, weight, bias, dmin, dmax, wmin, wmax,
+                              bmin, bmax, num_hidden=None, no_bias=False,
+                              flatten=True):
+    """TRUE int8 kernel (reference ``quantized_fully_connected.cc``):
+    int8×int8 → int32 accumulate on ``dot_general``, then rescale —
+    symmetric-int8 path; uint8 data falls back to the dequantize route."""
+    if data.dtype != jnp.int8 or weight.dtype != jnp.int8:
+        return _quantized_wrapper("FullyConnected", 3)(
+            data, weight, bias, dmin, dmax, wmin, wmax, bmin, bmax,
+            num_hidden=num_hidden, no_bias=no_bias, flatten=flatten)
+    x = data.reshape(data.shape[0], -1) if parse_bool(flatten, True) else data
+    acc = jax.lax.dot_general(
+        x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (_scale_of(dmin, dmax, jnp.int8) *
+                                     _scale_of(wmin, wmax, jnp.int8))
+    if bias is not None and not parse_bool(no_bias):
+        out = out + Q.dequantize(bias, bmin, bmax)
+    return _requant_out(out)
+
+
+@register("_contrib_quantized_conv", aliases=("quantized_conv",))
+def quantized_conv(data, weight, bias, dmin, dmax, wmin, wmax, bmin, bmax,
+                   kernel=None, stride="(1, 1)", pad="(0, 0)",
+                   dilate="(1, 1)", num_filter=None, num_group=1,
+                   no_bias=False, layout=None, workspace=None,
+                   cudnn_tune=None, cudnn_off=None):
+    """TRUE int8 convolution: int8 taps, int32 accumulators
+    (``conv_general_dilated`` with preferred int32), then rescale."""
+    if data.dtype != jnp.int8 or weight.dtype != jnp.int8:
+        return _quantized_wrapper("Convolution", 3)(
+            data, weight, bias, dmin, dmax, wmin, wmax, bmin, bmax,
+            kernel=kernel, stride=stride, pad=pad, dilate=dilate,
+            num_filter=num_filter, num_group=num_group, no_bias=no_bias)
+    sh, sw = parse_tuple(stride, 2, (1, 1))
+    ph, pw = parse_tuple(pad, 2, (0, 0))
+    dh, dw = parse_tuple(dilate, 2, (1, 1))
+    acc = jax.lax.conv_general_dilated(
+        data, weight, window_strides=(sh, sw),
+        padding=((ph, ph), (pw, pw)), rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=parse_int(num_group, 1),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (_scale_of(dmin, dmax, jnp.int8) *
+                                     _scale_of(wmin, wmax, jnp.int8))
+    if bias is not None and not parse_bool(no_bias):
+        out = out + Q.dequantize(bias, bmin, bmax).reshape(1, -1, 1, 1)
+    return _requant_out(out)
 register("_contrib_quantized_pooling", aliases=("quantized_pooling",))(
     _quantized_wrapper("Pooling", 1))
 register("_contrib_quantized_act", aliases=("quantized_act",))(

@@ -63,3 +63,38 @@ def test_quantize_model_excluded_layers():
     qsym = qz.quantize_graph(sym, {}, {}, excluded_sym_names=["fc1", "fc2"])
     names = [n.op.name for n in qsym._topo() if n.op is not None]
     assert "_contrib_quantize_v2" not in names  # everything excluded
+
+
+def test_true_int8_fc_matches_fp32():
+    """int8×int8→int32 kernel path (not fake-quant) tracks fp32."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 8).astype("float32")
+    w = rng.randn(16, 8).astype("float32")
+    qx, xmn, xmx = mx.nd.contrib.quantize_v2(mx.nd.array(x), out_type="int8")
+    qw, wmn, wmx = mx.nd.contrib.quantize_v2(mx.nd.array(w), out_type="int8")
+    qb, bmn, bmx = mx.nd.contrib.quantize_v2(mx.nd.zeros((16,)),
+                                             out_type="int8")
+    qo, omn, omx = mx.nd.contrib.quantized_fully_connected(
+        qx, qw, qb, xmn, xmx, wmn, wmx, bmn, bmx, num_hidden=16,
+        no_bias=True)
+    out = mx.nd.contrib.dequantize(qo, omn, omx).asnumpy()
+    ref = x @ w.T
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 0.05
+
+
+def test_true_int8_conv_matches_fp32():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    w = rng.randn(4, 3, 3, 3).astype("float32")
+    qx, xmn, xmx = mx.nd.contrib.quantize_v2(mx.nd.array(x), out_type="int8")
+    qw, wmn, wmx = mx.nd.contrib.quantize_v2(mx.nd.array(w), out_type="int8")
+    qb, bmn, bmx = mx.nd.contrib.quantize_v2(mx.nd.zeros((4,)),
+                                             out_type="int8")
+    qo, omn, omx = mx.nd.contrib.quantized_conv(
+        qx, qw, qb, xmn, xmx, wmn, wmx, bmn, bmx, kernel=(3, 3),
+        num_filter=4, pad=(1, 1), no_bias=True)
+    out = mx.nd.contrib.dequantize(qo, omn, omx).asnumpy()
+    ref = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w),
+                            mx.nd.zeros((4,)), kernel=(3, 3), num_filter=4,
+                            pad=(1, 1)).asnumpy()
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 0.08
