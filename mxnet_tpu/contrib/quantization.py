@@ -62,10 +62,53 @@ def _invoke_sym_by_name(op_name, sym_inputs, attrs):
     return _invoke_sym(registry.require(op_name), sym_inputs, attrs)
 
 
+def _optimal_threshold(hist, hist_edges, num_quantized_bins=255):
+    """KL-optimal clipping threshold over an activation histogram (reference
+    ``quantization.py:_get_optimal_threshold`` — the TensorRT entropy
+    calibration algorithm): for each candidate threshold, compare the clipped
+    distribution P against its ``num_quantized_bins``-level quantization Q
+    and keep the threshold minimizing KL(P||Q)."""
+    hist = hist.astype(np.float64)
+    num_bins = hist.size
+    zero_bin = num_bins // 2
+    best_kl, best_t = np.inf, hist_edges[-1]
+    # symmetric histogram around 0; candidate half-widths in bins
+    for width in range(num_quantized_bins // 2 + 1, zero_bin + 1):
+        lo, hi = zero_bin - width, zero_bin + width
+        p = hist[lo:hi].copy()
+        # outliers fold into the edge bins (clipping)
+        p[0] += hist[:lo].sum()
+        p[-1] += hist[hi:].sum()
+        if p.sum() == 0:
+            continue
+        # quantize p into num_quantized_bins levels
+        factor = p.size / num_quantized_bins
+        q = np.zeros_like(p)
+        for j in range(num_quantized_bins):
+            start = int(np.floor(j * factor))
+            stop = int(np.floor((j + 1) * factor)) or start + 1
+            chunk = p[start:stop]
+            nz = (chunk != 0).sum()
+            if nz:
+                q[start:stop] = np.where(chunk != 0, chunk.sum() / nz, 0)
+        pn = p / p.sum()
+        qn = q / max(q.sum(), 1e-20)
+        mask = pn > 0
+        kl = float(np.sum(pn[mask] * np.log(pn[mask] /
+                                            np.maximum(qn[mask], 1e-20))))
+        if kl < best_kl:
+            best_kl = kl
+            best_t = hist_edges[hi] if hi < hist_edges.size else hist_edges[-1]
+    return best_t
+
+
 def _collect_thresholds(sym, arg_params, aux_params, calib_data,
-                        data_names, num_calib_examples, logger):
-    """Naive calibration: run calibration batches, record min/max of every
-    quantizable node's data input (reference ``_LayerOutputMinMaxCollector``)."""
+                        data_names, num_calib_examples, logger,
+                        mode="naive"):
+    """Calibration: run batches, record per-layer-input statistics —
+    min/max ('naive', reference ``_LayerOutputMinMaxCollector``) or
+    histograms + KL threshold search ('entropy',
+    ``_LayerHistogramCollector``)."""
     # identify the parent outputs feeding quantizable nodes
     want = {}
     for node in sym._topo():
@@ -98,6 +141,7 @@ def _collect_thresholds(sym, arg_params, aux_params, calib_data,
             v.copyto(exe.aux_dict[k])
     mins = {n: np.inf for n in names}
     maxs = {n: -np.inf for n in names}
+    samples = {n: [] for n in names} if mode == "entropy" else None
     calib_data.reset()
     seen = 0
     for batch in calib_data:
@@ -107,12 +151,23 @@ def _collect_thresholds(sym, arg_params, aux_params, calib_data,
             a = o.asnumpy()
             mins[name] = min(mins[name], float(a.min()))
             maxs[name] = max(maxs[name], float(a.max()))
+            if samples is not None:
+                samples[name].append(a.ravel())
         seen += batch.data[0].shape[0]
         if num_calib_examples is not None and seen >= num_calib_examples:
             break
     if logger:
-        logger.info("calibrated %d layer inputs over %d examples",
-                    len(names), seen)
+        logger.info("calibrated %d layer inputs over %d examples (%s)",
+                    len(names), seen, mode)
+    if mode == "entropy":
+        out = {}
+        for n in names:
+            vals = np.concatenate(samples[n])
+            amax = max(abs(mins[n]), abs(maxs[n])) or 1e-8
+            hist, edges = np.histogram(vals, bins=2048, range=(-amax, amax))
+            t = _optimal_threshold(hist, edges)
+            out[n] = (-t, t)
+        return out
     return {n: (mins[n], maxs[n]) for n in names}
 
 
@@ -152,20 +207,17 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
     """Reference ``quantization.py:quantize_model``.
 
     ``calib_mode``: 'none' (dynamic ranges at run time), 'naive' (min/max
-    over calibration batches).  'entropy' (KL) maps to 'naive' with a
-    warning — KL threshold search is a later refinement.
+    over calibration batches), 'entropy' (KL-optimal clipping thresholds —
+    the reference's ``_get_optimal_threshold``).
     """
-    if calib_mode == "entropy":
-        logger.warning("entropy calibration not implemented; using naive "
-                       "min/max")
-        calib_mode = "naive"
     thresholds = {}
-    if calib_mode == "naive":
+    if calib_mode in ("naive", "entropy"):
         assert calib_data is not None, \
-            "calib_data is required for calib_mode='naive'"
+            f"calib_data is required for calib_mode={calib_mode!r}"
         thresholds = _collect_thresholds(sym, arg_params, aux_params,
                                          calib_data, list(data_names),
-                                         num_calib_examples, logger)
+                                         num_calib_examples, logger,
+                                         mode=calib_mode)
     qsym = quantize_graph(sym, arg_params, thresholds,
                           excluded_sym_names or (), quantized_dtype)
     return qsym, dict(arg_params), dict(aux_params)

@@ -98,3 +98,35 @@ def test_true_int8_conv_matches_fp32():
                             mx.nd.zeros((4,)), kernel=(3, 3), num_filter=4,
                             pad=(1, 1)).asnumpy()
     assert np.abs(out - ref).max() / np.abs(ref).max() < 0.08
+
+
+def test_entropy_calibration():
+    """KL-threshold calibration clips outliers and stays accurate."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(128, 8).astype("float32")
+    x[0, 0] = 50.0  # a gross outlier naive calibration would absorb
+    sym = _mlp()
+    it = mx.io.NDArrayIter(x, np.zeros(128, "float32"), batch_size=32)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    arg_params, aux_params = mod.get_params()
+    fp32_out = mod.predict(it).asnumpy()
+
+    qsym, qargs, qauxs = qz.quantize_model(
+        sym, arg_params, aux_params, calib_mode="entropy", calib_data=it,
+        num_calib_examples=128)
+    qmod = mx.mod.Module(qsym, context=mx.cpu())
+    qmod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+              for_training=False)
+    qmod.set_params(qargs, qauxs)
+    int8_out = qmod.predict(it).asnumpy()
+    assert (int8_out.argmax(1) == fp32_out.argmax(1)).mean() > 0.9
+
+
+def test_optimal_threshold_clips_outliers():
+    rng = np.random.RandomState(0)
+    vals = np.concatenate([rng.randn(100000), [60.0]])
+    hist, edges = np.histogram(vals, bins=2048, range=(-60, 60))
+    t = qz._optimal_threshold(hist, edges)
+    assert t < 30  # the single outlier must not set the range
