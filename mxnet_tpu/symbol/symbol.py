@@ -497,7 +497,8 @@ class Symbol:
 def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
              dtype=None, init=None, stype=None, **kwargs):
     """Reference ``mx.sym.Variable``."""
-    ad = dict(attr or {})
+    from ..attribute import current as _attr_current
+    ad = dict(_attr_current().get(dict(attr or {})))
     if shape is not None:
         ad["__shape__"] = str(tuple(shape))
     if dtype is not None:
@@ -666,6 +667,14 @@ def make_sym_func(op):
             else:
                 attrs[k] = v
         auto = name if name is not None else _auto_name(op.name)
+        from ..attribute import current as _attr_current
+        node_attr = _attr_current().get(dict(attr or {}))
+
+        def _finish(res):
+            if node_attr:
+                res._outputs[0][0].attr_dict.update(node_attr)
+            return res
+
         if op.name in LAYER_INPUTS:
             # layer-like op: fixed input list; auto-create missing weight/aux
             # variables named `<opname>_<slot>` (the reference's ListArguments
@@ -681,12 +690,13 @@ def make_sym_func(op):
                         v._outputs[0][0].attr_dict["__aux__"] = True
                     supplied[k] = v
                 ins.append(supplied[k])
-            return _invoke_sym(op, ins, attrs, name=auto)
+            return _finish(_invoke_sym(op, ins, attrs, name=auto))
         if named_inputs:
             order = _input_order(op, named_inputs)
-            return _invoke_sym(op, sym_inputs + [named_inputs[k] for k in order],
-                               attrs, name=auto)
-        return _invoke_sym(op, sym_inputs, attrs, name=auto)
+            return _finish(_invoke_sym(
+                op, sym_inputs + [named_inputs[k] for k in order],
+                attrs, name=auto))
+        return _finish(_invoke_sym(op, sym_inputs, attrs, name=auto))
 
     fn.__name__ = op.name
     fn.__doc__ = op.doc

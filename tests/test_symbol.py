@@ -1,0 +1,134 @@
+"""Symbol API tests (reference ``tests/python/unittest/test_symbol.py``)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_symbol_compose():
+    data = mx.sym.Variable("data")
+    net1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=10)
+    net1 = mx.sym.FullyConnected(net1, name="fc2", num_hidden=100)
+    assert net1.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                     "fc2_weight", "fc2_bias"]
+    assert net1.list_outputs() == ["fc2_output"]
+
+
+def test_symbol_internals():
+    data = mx.sym.Variable("data")
+    oldfc = mx.sym.FullyConnected(data, name="fc1", num_hidden=10)
+    net1 = mx.sym.FullyConnected(oldfc, name="fc2", num_hidden=100)
+    internals = net1.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == oldfc.list_arguments()
+
+
+def test_symbol_children():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=10)
+    children = net.get_children()
+    assert children.list_outputs() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_symbol_infer_shape():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=10)
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(100, 50))
+    assert dict(zip(net.list_arguments(), arg_shapes)) == {
+        "data": (100, 50), "fc1_weight": (10, 50), "fc1_bias": (10,)}
+    assert out_shapes == [(100, 10)]
+
+
+def test_symbol_json_roundtrip():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    net = mx.sym.Activation(net, name="act", act_type="relu")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    js = net.tojson()
+    parsed = json.loads(js)
+    assert "nodes" in parsed and "heads" in parsed
+    back = mx.sym.load_json(js)
+    assert back.list_arguments() == net.list_arguments()
+    assert back.list_outputs() == net.list_outputs()
+    # numerics survive the round trip
+    rng = np.random.RandomState(0)
+    feed = {"data": rng.rand(3, 8).astype("float32"),
+            "fc1_weight": rng.rand(16, 8).astype("float32"),
+            "fc1_bias": np.zeros(16, "float32"),
+            "softmax_label": np.zeros(3, "float32")}
+    def run(sym):
+        exe = sym.simple_bind(ctx=mx.cpu(), grad_req="null",
+                              data=(3, 8), softmax_label=(3,))
+        for k, v in feed.items():
+            exe.arg_dict[k][:] = v
+        return exe.forward()[0].asnumpy()
+    np.testing.assert_allclose(run(net), run(back), rtol=1e-6)
+
+
+def test_symbol_group():
+    data = mx.sym.Variable("data")
+    a = mx.sym.FullyConnected(data, name="fca", num_hidden=4)
+    b = mx.sym.Activation(data, name="actb", act_type="tanh")
+    grouped = mx.sym.Group([a, b])
+    assert grouped.list_outputs() == ["fca_output", "actb_output"]
+    exe = grouped.simple_bind(ctx=mx.cpu(), grad_req="null", data=(2, 3))
+    outs = exe.forward()
+    assert outs[0].shape == (2, 4) and outs[1].shape == (2, 3)
+
+
+def test_symbol_attr():
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    op = mx.sym.Convolution(data=data, name="conv", kernel=(1, 1),
+                            num_filter=1, attr={"__mood__": "so so"})
+    assert data.attr("mood") == "angry"
+    assert op.attr("__mood__") == "so so"
+
+
+def test_symbol_attr_scope():
+    with mx.AttrScope(__group__="4", __data__="great"):
+        data = mx.sym.Variable("data", attr={"specific": "data"})
+    assert data.attr("specific") == "data"
+    assert data.attr("__group__") == "4"
+
+
+def test_symbol_eval():
+    a = mx.sym.Variable("a")
+    b = a + 2
+    outs = b.eval(ctx=mx.cpu(), a=mx.nd.ones((2, 2)))
+    np.testing.assert_array_equal(outs[0].asnumpy(), np.full((2, 2), 3.0))
+
+
+def test_symbol_arith_and_pow():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = (a * 2 + b ** 2 - 3) / 2
+    exe = c.simple_bind(ctx=mx.cpu(), grad_req="null", a=(2,), b=(2,))
+    exe.arg_dict["a"][:] = np.array([1.0, 2.0])
+    exe.arg_dict["b"][:] = np.array([3.0, 4.0])
+    np.testing.assert_allclose(exe.forward()[0].asnumpy(),
+                               ((np.array([1, 2]) * 2 +
+                                 np.array([3, 4]) ** 2) - 3) / 2)
+
+
+def test_symbol_save_load(tmp_path):
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc",
+                                num_hidden=4)
+    path = str(tmp_path / "sym.json")
+    net.save(path)
+    assert os.path.exists(path)
+    back = mx.sym.load(path)
+    assert back.list_arguments() == net.list_arguments()
+
+
+def test_symbol_grad_via_bind():
+    x = mx.sym.Variable("x")
+    y = mx.sym.sum(x * x)
+    exe = y.simple_bind(ctx=mx.cpu(), grad_req="write", x=(3,))
+    exe.arg_dict["x"][:] = np.array([1.0, 2.0, 3.0])
+    exe.forward(is_train=True)
+    exe.backward()
+    np.testing.assert_allclose(exe.grad_dict["x"].asnumpy(), [2, 4, 6])
