@@ -8,3 +8,7 @@ from .image import (  # noqa: F401
     SaturationJitterAug, HueJitterAug, ColorJitterAug, LightingAug,
     ColorNormalizeAug, CreateAugmenter, ImageIter,
 )
+from .detection import (  # noqa: F401
+    DetHorizontalFlipAug, DetRandomCropAug, DetBorrowAug,
+    CreateDetAugmenter, ImageDetIter,
+)

@@ -202,3 +202,39 @@ def test_small_compat_modules():
     lg.info("hello")
     # kvstore server no-op
     mx.kvstore_server._init_kvstore_server_module()
+
+
+def test_image_det_iter(tmp_path):
+    """Detection iterator: boxes survive augmentation with images."""
+    from mxnet_tpu import recordio
+    fidx, frec = str(tmp_path / "det.idx"), str(tmp_path / "det.rec")
+    w = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(6):
+        img = (rng.rand(48, 48, 3) * 255).astype(np.uint8)
+        # packed label: header_width=2, obj_width=5, one object
+        label = np.array([2, 5, i % 3, 0.2, 0.2, 0.6, 0.7], dtype="float32")
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, label, i, 0), img, img_fmt=".png"))
+    w.close()
+    it = mx.image.ImageDetIter(3, (3, 32, 32), path_imgrec=frec,
+                               path_imgidx=fidx, max_objects=4)
+    batch = it.next()
+    assert batch.data[0].shape == (3, 3, 32, 32)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape == (3, 4, 5)
+    assert (lab[:, 0, 0] >= 0).all()       # first object valid
+    assert (lab[:, 1:, 0] == -1).all()     # padding rows
+    np.testing.assert_allclose(lab[0, 0, 1:], [0.2, 0.2, 0.6, 0.7],
+                               atol=1e-5)
+
+
+def test_det_horizontal_flip_boxes():
+    aug = mx.image.DetHorizontalFlipAug(p=1.0)
+    img = mx.nd.ones((8, 8, 3))
+    label = np.array([[1, 0.1, 0.2, 0.4, 0.6], [-1, -1, -1, -1, -1]],
+                     dtype="float32")
+    out_img, out_label = aug(img, label)
+    np.testing.assert_allclose(out_label[0], [1, 0.6, 0.2, 0.9, 0.6],
+                               atol=1e-6)
+    assert (out_label[1] == -1).all()
