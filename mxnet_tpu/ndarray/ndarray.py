@@ -238,10 +238,21 @@ class NDArray:
     def __radd__(self, other):
         return add(self, other)
 
-    def __iadd__(self, other):
-        res = add(self, other)
-        self._data, self._ag_node = res._data, res._ag_node
+    def _inplace_write(self, res):
+        # In-place write: adopt the new value.  A variable marker set by
+        # ``attach_grad``/``mark_variables`` survives unrecorded updates
+        # (reference: in-place ops on a marked var keep its AGInfo, so the
+        # ``w -= lr * w.grad`` idiom works across record blocks); a recorded
+        # result node always takes precedence.
+        new_node = res._ag_node
+        if new_node is None and self._ag_node is not None \
+                and self._ag_node[0].is_var:
+            new_node = self._ag_node
+        self._data, self._ag_node = res._data, new_node
         return self
+
+    def __iadd__(self, other):
+        return self._inplace_write(add(self, other))
 
     def __sub__(self, other):
         return subtract(self, other)
@@ -250,9 +261,7 @@ class NDArray:
         return subtract(other, self)
 
     def __isub__(self, other):
-        res = subtract(self, other)
-        self._data, self._ag_node = res._data, res._ag_node
-        return self
+        return self._inplace_write(subtract(self, other))
 
     def __mul__(self, other):
         return multiply(self, other)
@@ -261,9 +270,7 @@ class NDArray:
         return multiply(self, other)
 
     def __imul__(self, other):
-        res = multiply(self, other)
-        self._data, self._ag_node = res._data, res._ag_node
-        return self
+        return self._inplace_write(multiply(self, other))
 
     def __truediv__(self, other):
         return divide(self, other)
@@ -272,9 +279,7 @@ class NDArray:
         return divide(other, self)
 
     def __itruediv__(self, other):
-        res = divide(self, other)
-        self._data, self._ag_node = res._data, res._ag_node
-        return self
+        return self._inplace_write(divide(self, other))
 
     def __div__(self, other):
         return divide(self, other)

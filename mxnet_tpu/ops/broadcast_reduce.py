@@ -112,6 +112,14 @@ def sum_axis(data, axis=None, keepdims=False, exclude=False):
                    keepdims=parse_bool(keepdims))
 
 
+@register("_square_sum")
+def square_sum(data, axis=None, keepdims=False, exclude=False):
+    """Reference ``_square_sum`` (square_sum.cc): sum of squares — the
+    row-sparse fast path there is just the dense reduction here."""
+    return jnp.sum(jnp.square(data), axis=_axes(axis, data.ndim, exclude),
+                   keepdims=parse_bool(keepdims))
+
+
 @register("norm")
 def norm(data, ord=2, axis=None, keepdims=False, out_dtype=None):
     """Reference ``norm`` (broadcast_reduce_op_value.cc): L1/L2 only."""

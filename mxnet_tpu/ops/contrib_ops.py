@@ -478,3 +478,93 @@ def count_sketch(data, h, s, out_dim=None, processing_batch_size=32):
     ss = s
     out = jnp.zeros(data.shape[:-1] + (d,), data.dtype)
     return out.at[..., hh].add(data * ss)
+
+
+# ---------------------------------------------------------------------------
+# Hawkes process log-likelihood / gradient multiplier
+# ---------------------------------------------------------------------------
+@register("_contrib_hawkesll", aliases=("hawkesll",))
+def hawkesll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Log likelihood of a marked self-exciting Hawkes process.
+
+    Reference: ``src/operator/contrib/hawkes_ll-inl.h`` (hawkesll_forward /
+    hawkesll_forward_compensator kernels).  The reference walks each sequence
+    with a per-sample CPU/GPU thread; here the walk is one ``lax.scan`` over
+    the time axis with the whole batch vectorised per step, and the backward
+    op (``_contrib_backward_hawkesll``) is JAX autodiff through the scan.
+
+    Shapes: mu (N,K), alpha (K,), beta (K,), state (N,K), lags (N,T),
+    marks (N,T) int, valid_length (N,), max_time (N,).
+    Returns (loglike (N,), out_state (N,K)).
+    """
+    marks = marks.astype(jnp.int32)
+    N, K = mu.shape
+    T = lags.shape[1]
+    dt = mu.dtype
+
+    def step(carry, inp):
+        ll, t, last, st = carry
+        lag_j, mark_j, j = inp
+        valid = (j < valid_length.astype(jnp.float32))
+        t_new = t + lag_j
+        oh = jax.nn.one_hot(mark_j, K, dtype=dt)              # (N, K)
+        mu_c = jnp.take_along_axis(mu, mark_j[:, None], 1)[:, 0]
+        st_c = jnp.take_along_axis(st, mark_j[:, None], 1)[:, 0]
+        last_c = jnp.take_along_axis(last, mark_j[:, None], 1)[:, 0]
+        a_c = alpha[mark_j]
+        b_c = beta[mark_j]
+        # Sanitize the masked branch BEFORE the nonlinearities: with raw
+        # padded values, log(lda) can be -inf / ed inf on invalid steps, and
+        # the zero cotangent of jnp.where times that inf grad is NaN — which
+        # the scan carry then spreads to every parameter (where-grad pitfall).
+        d = jnp.where(valid, t_new - last_c, 0.0)
+        ed = jnp.exp(-b_c * d)
+        lda = jnp.where(valid, mu_c + a_c * b_c * st_c * ed, 1.0)
+        comp = jnp.where(valid, mu_c * d + a_c * st_c * (1.0 - ed), 0.0)
+        ll = ll + (jnp.log(lda) - comp).astype(dt)
+        vm = (valid.astype(dt) * oh.T).T                      # (N, K) update mask
+        st = st * (1.0 - vm) + vm * (1.0 + st_c * ed)[:, None]
+        last = last * (1.0 - vm) + vm * t_new[:, None]
+        t = jnp.where(valid, t_new, t)
+        return (ll, t, last, st), None
+
+    init = (jnp.zeros((N,), dt), jnp.zeros((N,), dt),
+            jnp.zeros((N, K), dt), state.astype(dt))
+    xs = (lags.T.astype(dt), marks.T,
+          jnp.arange(T, dtype=jnp.float32))
+    (ll, _, last, st), _ = lax.scan(step, init, xs)
+
+    # remaining compensators up to max_time + state decay
+    d = max_time[:, None].astype(dt) - last
+    ed = jnp.exp(-beta[None, :] * d)
+    rem = mu * d + alpha[None, :] * st * (1.0 - ed)
+    ll = ll - jnp.sum(rem, axis=1)
+    return ll, st * ed
+
+
+def _gm_fwd(s, x):
+    return x, None
+
+
+def _gm_bwd(s, _res, g):
+    return (g * jnp.asarray(s, g.dtype),)
+
+
+_gm_core = jax.custom_vjp(lambda s, x: x, nondiff_argnums=(0,))
+_gm_core.defvjp(_gm_fwd, _gm_bwd)
+
+
+@register("_contrib_gradientmultiplier", aliases=("gradientmultiplier",))
+def gradientmultiplier(data, scalar=1.0):
+    """Bit-exact identity forward; backward scales the incoming gradient by
+    ``scalar`` (reference ``src/operator/contrib/gradient_multiplier_op.cc``
+    — used for gradient-reversal domain adaptation)."""
+    return _gm_core(parse_float(scalar, 1.0), data)
+
+
+@register("_contrib_backward_gradientmultiplier",
+          aliases=("backward_gradientmultiplier",))
+def backward_gradientmultiplier(grad, scalar=1.0):
+    """The reference registers the backward as its own callable op; kept for
+    op-table parity (it is just scalar multiplication)."""
+    return grad * jnp.asarray(parse_float(scalar, 1.0), grad.dtype)

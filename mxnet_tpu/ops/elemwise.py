@@ -81,6 +81,16 @@ def _copy(x):
     return jnp.asarray(x)
 
 
+@register("_copyto")
+def _copyto(x):
+    """Reference ``_copyto`` (ndarray.cc CopyFromTo): cross-device copy.
+
+    Device placement is handled by the NDArray frontend / XLA runtime; the op
+    itself is an identity at the array level.
+    """
+    return jnp.asarray(x)
+
+
 @register("BlockGrad", aliases=("stop_gradient",))
 def block_grad(x):
     """Stops gradient flow (reference ``BlockGrad``,
@@ -186,6 +196,26 @@ _binary("_maximum", jnp.maximum)
 _binary("_minimum", jnp.minimum)
 _binary("_hypot", jnp.hypot)
 _binary("_power", jnp.power, aliases=("_Power",))
+_binary("_mod", jnp.mod)
+# Same-shape comparison/logic ops (reference elemwise_binary_op_logic.cc:
+# `_equal` etc. are the non-broadcast tensor-tensor variants behind
+# `nd.equal(a, b)`); outputs are 0/1 in the input dtype.
+_binary("_equal", lambda a, b: (a == b).astype(a.dtype))
+_binary("_not_equal", lambda a, b: (a != b).astype(a.dtype))
+_binary("_greater", lambda a, b: (a > b).astype(a.dtype))
+_binary("_greater_equal", lambda a, b: (a >= b).astype(a.dtype))
+_binary("_lesser", lambda a, b: (a < b).astype(a.dtype))
+_binary("_lesser_equal", lambda a, b: (a <= b).astype(a.dtype))
+_binary("_logical_and", lambda a, b: ((a != 0) & (b != 0)).astype(a.dtype))
+_binary("_logical_or", lambda a, b: ((a != 0) | (b != 0)).astype(a.dtype))
+_binary("_logical_xor", lambda a, b: ((a != 0) ^ (b != 0)).astype(a.dtype))
+# `_grad_add` (elemwise_binary_op_basic.cc): plain add used by the reference's
+# gradient-aggregation pass; here autodiff aggregates for us but the op name
+# stays callable.
+_binary("_grad_add", jnp.add)
+# `_scatter_elemwise_div` (elemwise_scatter_op.cc): divide, writing only the
+# lhs' stored values — identical to division on the dense compat layer.
+_binary("_scatter_elemwise_div", jnp.divide)
 
 
 @register("add_n", wrap_list=True, aliases=("ElementWiseSum", "_sum"))
@@ -235,6 +265,11 @@ _scalar("_lesser_equal_scalar", lambda x, s: (x <= s).astype(x.dtype))
 _scalar("_logical_and_scalar", lambda x, s: ((x != 0) & (s != 0)).astype(x.dtype))
 _scalar("_logical_or_scalar", lambda x, s: ((x != 0) | (s != 0)).astype(x.dtype))
 _scalar("_logical_xor_scalar", lambda x, s: ((x != 0) ^ (s != 0)).astype(x.dtype))
+# `_scatter_*` scalar ops (elemwise_scatter_op.cc) touch only stored values on
+# sparse inputs; on the dense-backed sparse compat layer they coincide with the
+# plain scalar ops.
+_scalar("_scatter_plus_scalar", lambda x, s: x + jnp.asarray(s, x.dtype))
+_scalar("_scatter_minus_scalar", lambda x, s: x - jnp.asarray(s, x.dtype))
 _scalar("smooth_l1", lambda x, s: jnp.where(jnp.abs(x) < 1.0 / (s * s),
                                             0.5 * s * s * x * x,
                                             jnp.abs(x) - 0.5 / (s * s)))

@@ -13,7 +13,7 @@ import ast
 import jax
 import jax.numpy as jnp
 
-from ..base import np_dtype, parse_bool, parse_int, parse_tuple
+from ..base import np_dtype, parse_bool, parse_float, parse_int, parse_tuple
 from .registry import register
 
 
@@ -140,11 +140,28 @@ def parse_tuple_allow_none(v):
 @register("slice", aliases=("crop",))
 def slice_op(data, begin=None, end=None, step=None):
     """Reference ``slice`` (matrix_op.cc)."""
+    return data[_slice_index(data, begin, end, step)]
+
+
+def _slice_index(data, begin, end, step):
     b = _norm_slice(begin, data.ndim)
     e = _norm_slice(end, data.ndim)
     s = _norm_slice(step, data.ndim)
-    idx = tuple(slice(bb, ee, ss if ss else None) for bb, ee, ss in zip(b, e, s))
-    return data[idx]
+    return tuple(slice(bb, ee, ss if ss else None) for bb, ee, ss in zip(b, e, s))
+
+
+@register("_slice_assign", aliases=("_crop_assign",))
+def slice_assign(lhs, rhs, begin=None, end=None, step=None):
+    """Reference ``_slice_assign`` (matrix_op.cc): ``lhs[begin:end:step] = rhs``
+    as a pure op — returns the updated copy (backs ``x[...] = y``)."""
+    return lhs.at[_slice_index(lhs, begin, end, step)].set(rhs.astype(lhs.dtype))
+
+
+@register("_slice_assign_scalar", aliases=("_crop_assign_scalar",))
+def slice_assign_scalar(data, scalar=0.0, begin=None, end=None, step=None):
+    """Reference ``_slice_assign_scalar``: fill a strided slice with a scalar."""
+    return data.at[_slice_index(data, begin, end, step)].set(
+        jnp.asarray(parse_float(scalar, 0.0), data.dtype))
 
 
 @register("slice_axis")

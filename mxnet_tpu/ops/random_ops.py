@@ -96,33 +96,78 @@ def random_randint(key, low=0, high=1, shape=None, dtype=None, ctx=None):
     return jax.random.randint(key, shape, parse_int(low, 0), parse_int(high, 1), dt)
 
 
+def _param_broadcast(p, shape):
+    return jnp.broadcast_to(jnp.reshape(p, p.shape + (1,) * len(shape)),
+                            p.shape + shape)
+
+
 @_register_random("_sample_uniform", aliases=("sample_uniform",))
 def sample_uniform(key, low, high, shape=None, dtype=None):
     shape = parse_tuple(shape) if shape else ()
-    out_shape = low.shape + shape
-    u = jax.random.uniform(key, out_shape, np_dtype(dtype or "float32"))
-    low_b = jnp.reshape(low, low.shape + (1,) * len(shape))
-    high_b = jnp.reshape(high, high.shape + (1,) * len(shape))
+    low_b = _param_broadcast(low, shape)
+    high_b = _param_broadcast(high, shape)
+    u = jax.random.uniform(key, low_b.shape, np_dtype(dtype or "float32"))
     return low_b + u * (high_b - low_b)
 
 
 @_register_random("_sample_normal", aliases=("sample_normal",))
 def sample_normal(key, mu, sigma, shape=None, dtype=None):
     shape = parse_tuple(shape) if shape else ()
-    out_shape = mu.shape + shape
-    n = jax.random.normal(key, out_shape, np_dtype(dtype or "float32"))
-    mu_b = jnp.reshape(mu, mu.shape + (1,) * len(shape))
-    s_b = jnp.reshape(sigma, sigma.shape + (1,) * len(shape))
+    mu_b = _param_broadcast(mu, shape)
+    s_b = _param_broadcast(sigma, shape)
+    n = jax.random.normal(key, mu_b.shape, np_dtype(dtype or "float32"))
     return mu_b + n * s_b
 
 
 @_register_random("_sample_gamma", aliases=("sample_gamma",))
 def sample_gamma(key, alpha, beta, shape=None, dtype=None):
     shape = parse_tuple(shape) if shape else ()
-    out_shape = alpha.shape + shape
-    a_b = jnp.broadcast_to(jnp.reshape(alpha, alpha.shape + (1,) * len(shape)), out_shape)
-    b_b = jnp.broadcast_to(jnp.reshape(beta, beta.shape + (1,) * len(shape)), out_shape)
+    a_b = _param_broadcast(alpha, shape)
+    b_b = _param_broadcast(beta, shape)
     return jax.random.gamma(key, a_b) * b_b
+
+
+@_register_random("_sample_poisson", aliases=("sample_poisson",))
+def sample_poisson(key, lam, shape=None, dtype=None):
+    """Reference ``_sample_poisson`` (sample_op.cc): per-element rate tensor."""
+    shape = parse_tuple(shape) if shape else ()
+    lam_b = _param_broadcast(lam, shape)
+    return jax.random.poisson(key, lam_b).astype(np_dtype(dtype or "float32"))
+
+
+@_register_random("_sample_exponential", aliases=("sample_exponential",))
+def sample_exponential(key, lam, shape=None, dtype=None):
+    """Reference ``_sample_exponential``: rate-parameterised exponential."""
+    shape = parse_tuple(shape) if shape else ()
+    lam_b = _param_broadcast(lam, shape)
+    e = jax.random.exponential(key, lam_b.shape, np_dtype(dtype or "float32"))
+    return (e / lam_b).astype(np_dtype(dtype or "float32"))
+
+
+@_register_random("_sample_negative_binomial",
+                  aliases=("sample_negative_binomial",))
+def sample_negative_binomial(key, k, p, shape=None, dtype=None):
+    """Reference ``_sample_negative_binomial``: gamma–Poisson mixture
+    (``sampler.h`` NegativeBinomialSampler uses the same construction)."""
+    shape = parse_tuple(shape) if shape else ()
+    k_b = _param_broadcast(k, shape)
+    p_b = _param_broadcast(p, shape)
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, k_b) * (1.0 - p_b) / p_b
+    return jax.random.poisson(kp, lam).astype(np_dtype(dtype or "float32"))
+
+
+@_register_random("_sample_generalized_negative_binomial",
+                  aliases=("sample_generalized_negative_binomial",))
+def sample_generalized_negative_binomial(key, mu, alpha, shape=None, dtype=None):
+    """Reference ``_sample_generalized_negative_binomial``: mean/dispersion
+    parameterisation — gamma(1/alpha, alpha*mu) mixed Poisson."""
+    shape = parse_tuple(shape) if shape else ()
+    mu_b = _param_broadcast(mu, shape)
+    a_b = _param_broadcast(alpha, shape)
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, 1.0 / jnp.maximum(a_b, 1e-12)) * a_b * mu_b
+    return jax.random.poisson(kp, lam).astype(np_dtype(dtype or "float32"))
 
 
 @_register_random("_sample_multinomial", aliases=("sample_multinomial",))

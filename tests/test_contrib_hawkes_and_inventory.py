@@ -1,0 +1,169 @@
+"""Hawkes-process log likelihood, gradient multiplier, and the internal
+op-name inventory (same-shape logic ops, slice-assign, scatter, samplers).
+
+Expected values for hawkesll come from the reference's own test
+(`tests/python/unittest/test_contrib_hawkesll.py`), evaluated against its
+C++ kernels — exact-parity fixtures.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_hawkesll_univariate_output():
+    T, N, K = 4, 4, 3
+    mu = nd.array(np.tile(np.array([1.5, 2.0, 3.0], np.float32), (N, 1)))
+    alpha = nd.array(np.array([0.2, 0.3, 0.4], np.float32))
+    beta = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    lags = nd.array(np.array([[6, 7, 8, 9], [1, 2, 3, 4],
+                              [3, 4, 5, 6], [8, 9, 10, 11]], np.float32))
+    marks = nd.zeros((N, T)).astype(np.int32)
+    states = nd.zeros((N, K))
+    valid_length = nd.array(np.array([1, 2, 3, 4], np.float32))
+    max_time = nd.ones((N,)) * 100.0
+    ll, out_state = nd.contrib.hawkesll(
+        mu, alpha, beta, states, lags, marks, valid_length, max_time)
+    np.testing.assert_allclose(
+        ll.asnumpy(),
+        [-649.79453489, -649.57118596, -649.38025115, -649.17811484],
+        rtol=1e-5)
+    assert out_state.shape == (N, K)
+
+
+def test_hawkesll_multivariate_output():
+    N, K = 2, 3
+    mu = np.array([1.5, 2.0, 3.0], np.float32)
+    alpha = nd.array(np.array([0.2, 0.3, 0.4], np.float32))
+    beta = nd.array(np.array([2.0, 2.0, 2.0], np.float32))
+    lags = nd.array(np.array([[6, 7, 8, 9, 3, 2, 5, 1, 7],
+                              [1, 2, 3, 4, 2, 1, 2, 1, 4]], np.float32))
+    marks = nd.array(np.array([[0, 1, 2, 1, 0, 2, 1, 0, 2],
+                               [1, 2, 0, 0, 0, 2, 2, 1, 0]])).astype(np.int32)
+    valid_length = nd.array(np.array([7, 9], np.float32))
+    max_time = nd.ones((N,)) * 100.0
+    ll, _ = nd.contrib.hawkesll(nd.array(np.tile(mu, (N, 1))), alpha, beta,
+                                nd.zeros((N, K)), lags, marks,
+                                valid_length, max_time)
+    np.testing.assert_allclose(ll.asnumpy(), [-647.01240372, -646.28617272],
+                               rtol=1e-5)
+
+
+def test_hawkesll_backward():
+    N, K = 2, 3
+    mu = nd.array(np.array([1.5, 2.0, 3.0], np.float32))
+    alpha = nd.array(np.array([0.2, 0.3, 0.4], np.float32))
+    beta = nd.array(np.array([2.0, 2.0, 2.0], np.float32))
+    lags = nd.array(np.array([[6, 7, 8, 9, 3, 2, 5, 1, 7],
+                              [1, 2, 3, 4, 2, 1, 2, 1, 4]], np.float32))
+    marks = nd.array(np.array([[0, 0, 0, 1, 0, 0, 1, 2, 0],
+                               [1, 2, 0, 0, 0, 2, 2, 1, 0]])).astype(np.int32)
+    valid_length = nd.array(np.array([9, 9], np.float32))
+    max_time = nd.ones((N,)) * 100.0
+    mu.attach_grad(); alpha.attach_grad(); beta.attach_grad()
+    with mx.autograd.record():
+        ll, _ = nd.contrib.hawkesll(mu.tile((N, 1)), alpha, beta,
+                                    nd.zeros((N, K)), lags, marks,
+                                    valid_length, max_time)
+    ll.backward()
+    np.testing.assert_allclose(
+        mu.grad.asnumpy(), [-193.33987481, -198.0, -198.66828681], rtol=1e-5)
+    np.testing.assert_allclose(
+        alpha.grad.asnumpy(), [-9.95093892, -4.0, -3.98784892], rtol=1e-5)
+    np.testing.assert_allclose(
+        beta.grad.asnumpy(),
+        [-1.49052169e-02, -5.87469511e-09, -7.29065224e-03],
+        rtol=1e-4, atol=1e-10)
+
+
+def test_hawkesll_padded_steps_do_not_poison_gradients():
+    # Regression: a padded (invalid) step whose mark has zero baseline used to
+    # produce log(0) in the masked where-branch, whose inf cotangent NaN'd
+    # every parameter's gradient through the scan carry.
+    N, K = 1, 3
+    mu = nd.array(np.array([1.5, 2.0, 0.0], np.float32))
+    alpha = nd.array(np.array([0.2, 0.3, 0.4], np.float32))
+    beta = nd.array(np.array([1.0, 1.0, 1.0], np.float32))
+    lags = nd.array(np.array([[1, 2, 1, 1]], np.float32))
+    marks = nd.array(np.array([[0, 1, 2, 2]])).astype(np.int32)  # padding = mark 2
+    valid_length = nd.array(np.array([2], np.float32))
+    max_time = nd.array(np.array([10.0], np.float32))
+    mu.attach_grad(); alpha.attach_grad(); beta.attach_grad()
+    with mx.autograd.record():
+        ll, _ = nd.contrib.hawkesll(mu.reshape((1, K)), alpha, beta,
+                                    nd.zeros((N, K)), lags, marks,
+                                    valid_length, max_time)
+    ll.backward()
+    assert np.isfinite(ll.asnumpy()).all()
+    for p in (mu, alpha, beta):
+        assert np.isfinite(p.grad.asnumpy()).all(), p.grad.asnumpy()
+
+
+def test_gradientmultiplier_identity_forward_scaled_backward():
+    x = nd.array(np.array([1., 2., 3.], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.contrib.gradientmultiplier(x, scalar=-0.5)
+    y.backward()
+    np.testing.assert_array_equal(y.asnumpy(), [1., 2., 3.])
+    np.testing.assert_array_equal(x.grad.asnumpy(), [-0.5, -0.5, -0.5])
+    np.testing.assert_array_equal(
+        nd.contrib.backward_gradientmultiplier(x, scalar=2.0).asnumpy(),
+        [2., 4., 6.])
+
+
+def test_internal_logic_and_mod_ops():
+    a = nd.array(np.array([[1., 2.], [3., 4.]]))
+    b = nd.array(np.array([[1., 0.], [3., 5.]]))
+    np.testing.assert_array_equal(nd._equal(a, b).asnumpy(), [[1, 0], [1, 0]])
+    np.testing.assert_array_equal(nd._not_equal(a, b).asnumpy(), [[0, 1], [0, 1]])
+    np.testing.assert_array_equal(nd._greater(a, b).asnumpy(), [[0, 1], [0, 0]])
+    np.testing.assert_array_equal(nd._lesser_equal(a, b).asnumpy(), [[1, 0], [1, 1]])
+    np.testing.assert_array_equal(nd._logical_and(a, b).asnumpy(), [[1, 0], [1, 1]])
+    np.testing.assert_array_equal(nd._logical_xor(a, b).asnumpy(), [[0, 1], [0, 0]])
+    np.testing.assert_array_equal(nd._mod(a, nd.array(np.array([[2., 2.], [2., 3.]]))).asnumpy(),
+                                  [[1, 0], [1, 1]])
+    np.testing.assert_array_equal(nd._grad_add(a, b).asnumpy(), [[2, 2], [6, 9]])
+    np.testing.assert_array_equal(nd._copyto(a).asnumpy(), a.asnumpy())
+
+
+def test_slice_assign_ops():
+    x = nd.zeros((4, 4))
+    y = nd._slice_assign(x, nd.ones((2, 2)), begin=(1, 1), end=(3, 3))
+    want = np.zeros((4, 4)); want[1:3, 1:3] = 1
+    np.testing.assert_array_equal(y.asnumpy(), want)
+    z = nd._slice_assign_scalar(x, scalar=7, begin=(0,), end=(2,))
+    want = np.zeros((4, 4)); want[0:2] = 7
+    np.testing.assert_array_equal(z.asnumpy(), want)
+    np.testing.assert_array_equal(
+        nd._scatter_plus_scalar(nd.ones((2, 2)), scalar=2).asnumpy(),
+        np.full((2, 2), 3.0))
+    np.testing.assert_array_equal(
+        nd._scatter_elemwise_div(nd.ones((2,)) * 6, nd.ones((2,)) * 3).asnumpy(),
+        [2., 2.])
+
+
+def test_square_sum():
+    a = nd.array(np.array([[1., 2.], [3., 4.]]))
+    np.testing.assert_array_equal(nd._square_sum(a, axis=1).asnumpy(), [5., 25.])
+    np.testing.assert_array_equal(nd._square_sum(a).asnumpy(), 30.)
+
+
+def test_array_parameter_samplers():
+    mx.random.seed(7)
+    lam = nd.array(np.array([1.0, 50.0], np.float32))
+    p = nd._sample_poisson(lam, shape=(3000,))
+    assert p.shape == (2, 3000)
+    m = p.asnumpy().mean(axis=1)
+    assert abs(m[0] - 1.0) < 0.2 and abs(m[1] - 50.0) < 2.0
+    e = nd._sample_exponential(lam, shape=(3000,))
+    me = e.asnumpy().mean(axis=1)
+    assert abs(me[0] - 1.0) < 0.1 and abs(me[1] - 0.02) < 0.01
+    nb = nd._sample_negative_binomial(nd.array(np.array([5.0], np.float32)),
+                                      nd.array(np.array([0.5], np.float32)),
+                                      shape=(4000,))
+    assert abs(nb.asnumpy().mean() - 5.0) < 0.5  # mean = k(1-p)/p = 5
+    gnb = nd._sample_generalized_negative_binomial(
+        nd.array(np.array([4.0], np.float32)),
+        nd.array(np.array([0.25], np.float32)), shape=(4000,))
+    assert abs(gnb.asnumpy().mean() - 4.0) < 0.5
