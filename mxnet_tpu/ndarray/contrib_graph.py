@@ -1,0 +1,266 @@
+"""DGL graph-sampling contrib ops, host-side (reference
+``src/operator/contrib/dgl_graph.cc``).
+
+The reference registers these as CPU-only ``FComputeEx`` kernels operating on
+CSR storage with dynamic output sizes (hash tables, queues, reservoir
+sampling) — shapes depend on the random walk, so there is nothing for XLA to
+compile.  TPU-native policy: they stay host ops on the CSR compat layer
+(``ndarray/sparse.py``), exactly like ``nd.contrib.foreach`` & co live at the
+frontend (``contrib_ctrl.py``); the sampled minibatch subgraphs are what get
+shipped to the chip.
+
+Deviation (documented): sampled neighbor edges whose endpoint did not make it
+into the sampled vertex set (possible only when the ``max_num_vertices``
+budget truncates the walk, which the reference warns about) are dropped from
+the sub-CSR.  The reference keeps them, producing column ids that its own
+``check_format(full_check=True)`` rejects and that ``_contrib_dgl_graph_compact``
+CHECK-crashes on (dgl_graph.cc:1467 ``CHECK(it != id_map.end())``); dropping
+them keeps every emitted subgraph well-formed and compactable.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .ndarray import _as_nd
+from .sparse import CSRNDArray
+
+
+def _csr_parts(csr):
+    return (csr.data.asnumpy(), csr.indices.asnumpy().astype(_np.int64),
+            csr.indptr.asnumpy().astype(_np.int64))
+
+
+def _make_sub_csr(rows, max_num_vertices, data_dtype):
+    """Build an (M, M) CSRNDArray from {local_row: (cols, vals)} with explicit
+    compressed buffers (keeps stored zeros / duplicate columns)."""
+    import jax.numpy as jnp
+
+    data, indices, indptr = [], [], [0]
+    dense = _np.zeros((max_num_vertices, max_num_vertices), dtype=data_dtype)
+    for r in range(max_num_vertices):
+        cols, vals = rows.get(r, ((), ()))
+        for c, v in zip(cols, vals):
+            indices.append(c)
+            data.append(v)
+            dense[r, c] = v
+        indptr.append(len(indices))
+    out = CSRNDArray(jnp.asarray(dense))
+    return out._set_csr_cache(_np.asarray(data, dtype=data_dtype),
+                              _np.asarray(indices, dtype=_np.int64),
+                              _np.asarray(indptr, dtype=_np.int64))
+
+
+def _neighbor_sample_one(csr, seed, probability, num_hops, num_neighbor,
+                         max_num_vertices, rng):
+    """The core BFS sampler (reference ``SampleSubgraph``,
+    dgl_graph.cc:533): walk out to ``num_hops`` from the seeds, keeping at
+    most ``num_neighbor`` (weighted) samples per visited vertex."""
+    val, col, indptr = _csr_parts(csr)
+    seeds = seed.asnumpy().astype(_np.int64).ravel()
+    sub_ver = {}                    # vertex id -> layer
+    queue = []
+    for s in seeds:
+        if s not in sub_ver:
+            sub_ver[int(s)] = 0
+            queue.append(int(s))
+    sampled = {}                    # vertex id -> (cols, edge vals)
+    idx = 0
+    while idx < len(queue) and len(sub_ver) < max_num_vertices:
+        dst = queue[idx]
+        level = sub_ver[dst]
+        idx += 1
+        if level >= num_hops:
+            continue
+        lo, hi = indptr[dst], indptr[dst + 1]
+        neigh, eids = col[lo:hi], val[lo:hi]
+        if len(neigh) == 0:
+            sampled[dst] = ((), ())
+            continue
+        if len(neigh) <= num_neighbor:
+            pick = _np.arange(len(neigh))
+        elif probability is None:
+            pick = rng.choice(len(neigh), size=num_neighbor, replace=False)
+        else:
+            p = probability[neigh]
+            p = p / p.sum()
+            pick = rng.choice(len(neigh), size=num_neighbor, replace=False,
+                              p=p)
+        sampled[dst] = (tuple(int(c) for c in neigh[pick]),
+                        tuple(eids[pick]))
+        for v in neigh[pick]:
+            if len(sub_ver) >= max_num_vertices:
+                break
+            v = int(v)
+            if v not in sub_ver:
+                sub_ver[v] = level + 1
+                queue.append(v)
+
+    order = sorted(sub_ver)                    # reference sorts by vertex id
+    n = len(order)
+    sample_id = _np.full(max_num_vertices + 1, 0, dtype=_np.int64)
+    layer = _np.full(max_num_vertices, 0, dtype=_np.int64)
+    sample_id[:n] = order
+    sample_id[max_num_vertices] = n
+    for i, v in enumerate(order):
+        layer[i] = sub_ver[v]
+    local = {v: i for i, v in enumerate(order)}
+    rows = {}
+    for v in order:
+        if v not in sampled:
+            continue
+        cols, vals = sampled[v]
+        # keep only edges whose endpoint made it into the sampled set (and
+        # therefore fits the (M, M) sub-matrix) — see module docstring
+        kept = [(c, e) for c, e in zip(cols, vals)
+                if c in local and c < max_num_vertices]
+        rows[local[v]] = (tuple(c for c, _ in kept),
+                          tuple(e for _, e in kept))
+    sub_csr = _make_sub_csr(rows, max_num_vertices, val.dtype)
+    outs = [_as_nd(sample_id), sub_csr]
+    if probability is not None:
+        sub_prob = _np.zeros(max_num_vertices, dtype=_np.float32)
+        sub_prob[:n] = probability[order]
+        outs.append(_as_nd(sub_prob))
+    outs.append(_as_nd(layer))
+    return outs
+
+
+def dgl_csr_neighbor_uniform_sample(csr, *seeds, num_args=None, num_hops=1,
+                                    num_neighbor=2, max_num_vertices=100,
+                                    **_ignored):
+    """Reference ``_contrib_dgl_csr_neighbor_uniform_sample``: per seed array
+    returns [sampled vertex ids (+count), sub-CSR of sampled edges, layers]."""
+    rng = _np.random
+    per_seed = [_neighbor_sample_one(csr, seed, None, int(num_hops),
+                                     int(num_neighbor),
+                                     int(max_num_vertices), rng)
+                for seed in seeds]
+    # reference output layout groups by kind: all sample_ids, then all
+    # sub-CSRs, then all layers (dgl_graph.cc:733 outputs[i + k*num_subgraphs])
+    return [o[k] for k in range(3) for o in per_seed]
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr, prob, *seeds, num_args=None,
+                                        num_hops=1, num_neighbor=2,
+                                        max_num_vertices=100, **_ignored):
+    """Reference ``_contrib_dgl_csr_neighbor_non_uniform_sample``: like the
+    uniform sampler but neighbors are drawn ∝ ``prob``; also returns the
+    sampled vertices' probabilities."""
+    rng = _np.random
+    p = prob.asnumpy().astype(_np.float64).ravel()
+    per_seed = [_neighbor_sample_one(csr, seed, p, int(num_hops),
+                                     int(num_neighbor),
+                                     int(max_num_vertices), rng)
+                for seed in seeds]
+    # grouped by kind like the reference: ids, sub-CSRs, probs, layers
+    return [o[k] for k in range(4) for o in per_seed]
+
+
+def dgl_subgraph(graph, *vertex_lists, return_mapping=False, num_args=None,
+                 **_ignored):
+    """Reference ``_contrib_dgl_subgraph`` (GetSubgraph, dgl_graph.cc:1039):
+    induced subgraph on a sorted vertex list.  Output data are NEW edge ids
+    (0..nnz-1); with ``return_mapping`` a second CSR carries the original
+    edge ids."""
+    import jax.numpy as jnp
+
+    val, col, indptr = _csr_parts(graph)
+    subs, maps = [], []
+    for varr in vertex_lists:
+        vids = varr.asnumpy().astype(_np.int64).ravel()
+        if not (_np.diff(vids) >= 0).all():
+            raise ValueError("The input vertex list has to be sorted")
+        local = {int(v): i for i, v in enumerate(vids)}
+        n = len(vids)
+        new_data, old_data, indices, new_indptr = [], [], [], [0]
+        for v in vids:
+            for k in range(indptr[v], indptr[v + 1]):
+                c = int(col[k])
+                if c in local:
+                    indices.append(local[c])
+                    old_data.append(val[k])
+                    new_data.append(len(new_data))
+            new_indptr.append(len(indices))
+        dense_new = _np.zeros((n, n), dtype=_np.int64)
+        dense_old = _np.zeros((n, n), dtype=val.dtype)
+        for r in range(n):
+            for k in range(new_indptr[r], new_indptr[r + 1]):
+                dense_new[r, indices[k]] = new_data[k]
+                dense_old[r, indices[k]] = old_data[k]
+        sub = CSRNDArray(jnp.asarray(dense_new))._set_csr_cache(
+            _np.asarray(new_data, dtype=_np.int64),
+            _np.asarray(indices, dtype=_np.int64),
+            _np.asarray(new_indptr, dtype=_np.int64))
+        subs.append(sub)
+        if return_mapping:
+            m = CSRNDArray(jnp.asarray(dense_old))._set_csr_cache(
+                _np.asarray(old_data, dtype=val.dtype),
+                _np.asarray(indices, dtype=_np.int64),
+                _np.asarray(new_indptr, dtype=_np.int64))
+            maps.append(m)
+    outs = subs + maps
+    return outs[0] if len(outs) == 1 else outs
+
+
+def dgl_graph_compact(*args, graph_sizes=None, return_mapping=False,
+                      num_args=None, **_ignored):
+    """Reference ``_contrib_dgl_graph_compact`` (CompactSubgraph,
+    dgl_graph.cc:1429): relabel a sampled sub-CSR's global column ids to
+    local positions in its vertex-id array, truncating to ``graph_sizes``
+    vertices.  Output data are new edge ids 0..nnz-1 (``sub_eids[i] = i``).
+
+    ``return_mapping=True`` additionally returns, per graph, a CSR of the
+    same structure whose data are the input sub-CSR's edge values.  (The
+    reference declares the doubled output count but its compute kernel never
+    writes the mapping outputs — dgl_graph.cc:1482 — so this is the
+    documented useful interpretation, mirroring ``dgl_subgraph``'s mapping.)
+    """
+    import jax.numpy as jnp
+
+    k = len(args) // 2
+    csrs, id_arrs = args[:k], args[k:]
+    sizes = graph_sizes
+    if not isinstance(sizes, (tuple, list)):
+        sizes = [sizes] * k
+    outs, maps = [], []
+    for csr, id_arr, size in zip(csrs, id_arrs, sizes):
+        n = int(size)
+        val, col, indptr = _csr_parts(csr)
+        ids = id_arr.asnumpy().astype(_np.int64).ravel()[:n]
+        local = {int(v): i for i, v in enumerate(ids)}
+        data, old_data, indices, new_indptr = [], [], [], [0]
+        dense = _np.zeros((n, n), dtype=_np.int64)
+        dense_old = _np.zeros((n, n), dtype=val.dtype)
+        for r in range(n):
+            for kk in range(indptr[r], indptr[r + 1]):
+                c = local[int(col[kk])]
+                indices.append(c)
+                data.append(len(data))
+                old_data.append(val[kk])
+                dense[r, c] = data[-1]
+                dense_old[r, c] = val[kk]
+            new_indptr.append(len(indices))
+        indices_np = _np.asarray(indices, dtype=_np.int64)
+        indptr_np = _np.asarray(new_indptr, dtype=_np.int64)
+        outs.append(CSRNDArray(jnp.asarray(dense))._set_csr_cache(
+            _np.asarray(data, dtype=_np.int64), indices_np, indptr_np))
+        if return_mapping:
+            maps.append(CSRNDArray(jnp.asarray(dense_old))._set_csr_cache(
+                _np.asarray(old_data, dtype=val.dtype), indices_np,
+                indptr_np))
+    outs = outs + maps
+    return outs[0] if len(outs) == 1 else outs
+
+
+def dgl_adjacency(graph, **_ignored):
+    """Reference ``_contrib_dgl_adjacency``: same structure, float32 data of
+    ones."""
+    import jax.numpy as jnp
+
+    val, col, indptr = _csr_parts(graph)
+    dense = _np.zeros(graph.shape, dtype=_np.float32)
+    for r in range(graph.shape[0]):
+        dense[r, col[indptr[r]:indptr[r + 1]]] = 1.0
+    out = CSRNDArray(jnp.asarray(dense))
+    return out._set_csr_cache(_np.ones(len(val), dtype=_np.float32), col,
+                              indptr)

@@ -161,6 +161,7 @@ class NDArray:
             if dat.dtype != other._data.dtype:
                 dat = dat.astype(other._data.dtype)
             other._data = jax.device_put(dat, list(other._data.devices())[0])
+            other._invalidate_views()
             return other
         raise TypeError(f"copyto does not support type {type(other)}")
 
@@ -217,10 +218,12 @@ class NDArray:
             else:
                 res = invoke_fn(lambda x: x.at[key].set(value), [self])
             self._data, self._ag_node = res._data, res._ag_node
+            self._invalidate_views()
             return
         if isinstance(value, NDArray):
             value = value._data
         self._data = self._data.at[key].set(value)
+        self._invalidate_views()
 
     def slice(self, begin, end, step=None):
         return invoke_op("slice", [self], {"begin": begin, "end": end, "step": step})
@@ -238,6 +241,12 @@ class NDArray:
     def __radd__(self, other):
         return add(self, other)
 
+    def _invalidate_views(self):
+        # Derived-view caches (CSRNDArray._csr_cache) describe the payload
+        # they were built from; any in-place write must drop them.
+        if getattr(self, "_csr_cache", None) is not None:
+            self._csr_cache = None
+
     def _inplace_write(self, res):
         # In-place write: adopt the new value.  A variable marker set by
         # ``attach_grad``/``mark_variables`` survives unrecorded updates
@@ -249,6 +258,7 @@ class NDArray:
                 and self._ag_node[0].is_var:
             new_node = self._ag_node
         self._data, self._ag_node = res._data, new_node
+        self._invalidate_views()
         return self
 
     def __iadd__(self, other):

@@ -39,6 +39,15 @@ image = _facade("image", ("_image_",))
 
 from . import contrib_ctrl as _ctrl  # noqa: E402
 
+from . import contrib_graph as _graph  # noqa: E402
+
+contrib.dgl_csr_neighbor_uniform_sample = _graph.dgl_csr_neighbor_uniform_sample
+contrib.dgl_csr_neighbor_non_uniform_sample = \
+    _graph.dgl_csr_neighbor_non_uniform_sample
+contrib.dgl_subgraph = _graph.dgl_subgraph
+contrib.dgl_graph_compact = _graph.dgl_graph_compact
+contrib.dgl_adjacency = _graph.dgl_adjacency
+
 contrib.foreach = _ctrl.foreach
 contrib.while_loop = _ctrl.while_loop
 contrib.cond = _ctrl.cond

@@ -24,7 +24,7 @@ from .ndarray import NDArray, _as_nd, _wrap, invoke
 
 # Ops whose behavior depends on autograd train/test mode (reference: ops read
 # ``ctx.is_train`` from the OpContext, include/mxnet/op_attr_types.h).
-MODE_DEPENDENT = {"Dropout", "BatchNorm", "RNN"}
+MODE_DEPENDENT = {"Dropout", "BatchNorm", "RNN", "_contrib_SyncBatchNorm"}
 
 _MOMENTUM_DEFAULT = 0.9
 
@@ -87,7 +87,7 @@ def make_op_func(op):
     stochastic = name in STOCHASTIC_OPS
     mode_dep = name in MODE_DEPENDENT
     writeback = INPLACE_UPDATES.get(name)
-    is_bn = name == "BatchNorm"
+    is_bn = name in ("BatchNorm", "_contrib_SyncBatchNorm")
     attr_names = _attr_param_names(op, stochastic)
     input_names = _input_param_names(op, stochastic)
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import numpy as _np
 
+from ..base import np_dtype
 from .ndarray import NDArray, _as_nd, _to_jax_device, zeros as _dense_zeros
 
 __all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
@@ -42,6 +43,9 @@ class BaseSparseNDArray(NDArray):
     def asscipy(self):
         import scipy.sparse as sp
         if self._storage_type == "csr":
+            cache = getattr(self, "_csr_cache", None)
+            if cache is not None:
+                return sp.csr_matrix(cache, shape=self.shape)
             return sp.csr_matrix(self.asnumpy())
         raise ValueError("asscipy is only supported for csr")
 
@@ -51,26 +55,70 @@ class BaseSparseNDArray(NDArray):
 
 
 class CSRNDArray(BaseSparseNDArray):
-    """CSR matrix view over a dense payload (reference ``sparse.py:86``)."""
+    """CSR matrix view over a dense payload (reference ``sparse.py:86``).
+
+    When constructed from compressed buffers (``csr_matrix((data, indices,
+    indptr))`` or the DGL graph ops), the exact buffers are kept so stored
+    zeros / duplicate columns round-trip like the reference's genuinely
+    compressed storage; otherwise the views are derived from the dense
+    payload.  The cache describes the payload at construction time — ops that
+    produce new arrays return new views, so it does not go stale.
+    """
 
     _storage_type = "csr"
 
+    def _set_csr_cache(self, data, indices, indptr):
+        self._csr_cache = (_np.asarray(data), _np.asarray(indices),
+                           _np.asarray(indptr))
+        return self
+
     @property
     def data(self):
+        cache = getattr(self, "_csr_cache", None)
+        if cache is not None:
+            return _as_nd(cache[0])
         arr = self.asnumpy()
         return _as_nd(arr[arr != 0])
 
     @property
     def indices(self):
+        cache = getattr(self, "_csr_cache", None)
+        if cache is not None:
+            return _as_nd(cache[1])
         arr = self.asnumpy()
         return _as_nd(_np.nonzero(arr)[1].astype(_np.int32))
 
     @property
     def indptr(self):
+        cache = getattr(self, "_csr_cache", None)
+        if cache is not None:
+            return _as_nd(cache[2])
         arr = self.asnumpy()
         counts = (arr != 0).sum(axis=1)
         return _as_nd(_np.concatenate([[0], _np.cumsum(counts)])
                       .astype(_np.int32))
+
+    def check_format(self, full_check=True):
+        """Validate the CSR structure (reference ``sparse.py check_format`` →
+        ``CheckFormatCSRImpl``)."""
+        indptr = self.indptr.asnumpy().astype(_np.int64)
+        indices = self.indices.asnumpy().astype(_np.int64)
+        nnz = len(self.data)
+        if indptr[0] != 0 or indptr[-1] != nnz:
+            raise ValueError("indptr head/tail malformed")
+        if (_np.diff(indptr) < 0).any():
+            raise ValueError("indptr must be non-decreasing")
+        if full_check and len(indices) and (
+                (indices < 0).any() or (indices >= self.shape[1]).any()):
+            raise ValueError("column indices out of range")
+
+    def astype(self, dtype, copy=True):
+        out = CSRNDArray(super().astype(dtype, copy=copy)._data)
+        cache = getattr(self, "_csr_cache", None)
+        if cache is not None:
+            out._set_csr_cache(cache[0].astype(np_dtype(dtype)), cache[1],
+                               cache[2])
+        return out
 
 
 class RowSparseNDArray(BaseSparseNDArray):
@@ -122,8 +170,18 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
         for row in range(shape[0]):
             for k in range(indptr[row], indptr[row + 1]):
                 dense[row, indices[k]] = data[k]
+        out = CSRNDArray(jax.device_put(jnp.asarray(dense),
+                                        _to_jax_device(ctx)))
+        return out._set_csr_cache(data, indices, indptr)
     elif hasattr(arg1, "tocsr"):  # scipy sparse
-        dense = _np.asarray(arg1.todense(), dtype=dtype or _np.float32)
+        sp = arg1.tocsr()
+        dense = _np.asarray(sp.todense(), dtype=dtype or _np.float32)
+        out = CSRNDArray(jax.device_put(jnp.asarray(dense),
+                                        _to_jax_device(ctx)))
+        return out._set_csr_cache(
+            _np.asarray(sp.data, dtype=dtype or _np.float32),
+            _np.asarray(sp.indices, dtype=_np.int64),
+            _np.asarray(sp.indptr, dtype=_np.int64))
     else:
         dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
                             else arg1, dtype=dtype or _np.float32)

@@ -214,6 +214,105 @@ def psroi_pooling(data, rois, spatial_scale=0.0625, output_dim=None,
     return jax.vmap(one_roi)(rois)
 
 
+@register("_contrib_DeformablePSROIPooling",
+          aliases=("DeformablePSROIPooling",))
+def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                             output_dim=None, group_size=None,
+                             pooled_size=None, part_size=0,
+                             sample_per_part=1, trans_std=0.0,
+                             no_trans=False):
+    """Deformable position-sensitive ROI pooling (reference
+    ``src/operator/contrib/deformable_psroi_pooling.cc``
+    DeformablePSROIPoolForwardCPU): each output bin averages
+    ``sample_per_part²`` bilinear taps whose window is shifted by a learned
+    per-part offset ``trans * trans_std`` scaled by the ROI extent.
+
+    data (N, output_dim*group², H, W); rois (R, 5) as [batch, x1, y1, x2, y2];
+    trans (R, num_classes*2, part_size, part_size) → (R, output_dim, p, p).
+    The reference's dynamic per-sample loops become static (p, p, spp, spp)
+    tensor math under vmap over ROIs — fully differentiable, so the separate
+    backward op is autodiff.
+    """
+    scale = parse_float(spatial_scale, 1.0)
+    od = parse_int(output_dim)
+    p = parse_int(pooled_size)
+    g = parse_int(group_size, 0) or p
+    spp = parse_int(sample_per_part, 1)
+    tstd = parse_float(trans_std, 0.0)
+    notrans = parse_bool(no_trans, False) or trans is None
+    ps = parse_int(part_size, 0) or p
+    n, c, h, w = data.shape
+    num_classes = 1 if notrans else trans.shape[1] // 2
+    ch_per_class = max(od // num_classes, 1)
+
+    ph = jnp.arange(p, dtype=jnp.float32)[:, None]            # (p, 1)
+    pw = jnp.arange(p, dtype=jnp.float32)[None, :]            # (1, p)
+    gh = jnp.clip(jnp.floor(ph * g / p).astype(jnp.int32), 0, g - 1)
+    gw = jnp.clip(jnp.floor(pw * g / p).astype(jnp.int32), 0, g - 1)
+    ctop = jnp.arange(od, dtype=jnp.int32)[:, None, None]     # (od, 1, 1)
+    chan = (ctop * g + gh[None]) * g + gw[None]               # (od, p, p)
+    part_h = jnp.floor(ph * ps / p).astype(jnp.int32)         # (p, 1)
+    part_w = jnp.floor(pw * ps / p).astype(jnp.int32)         # (1, p)
+    class_id = ctop // ch_per_class                           # (od, 1, 1)
+
+    def one_roi(roi, tr):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * scale - 0.5
+        y1 = jnp.round(roi[2]) * scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / p, rh / p
+        sub_w, sub_h = bin_w / spp, bin_h / spp
+
+        if notrans:
+            tx = jnp.zeros((od, p, p), jnp.float32)
+            ty = jnp.zeros((od, p, p), jnp.float32)
+        else:
+            # tr (num_classes*2, ps, ps): even planes = x offsets, odd = y
+            ph_b = jnp.broadcast_to(part_h, (p, p))
+            pw_b = jnp.broadcast_to(part_w, (p, p))
+            cls = jnp.broadcast_to(class_id, (od, p, p))
+            tx = tr[cls * 2, ph_b[None], pw_b[None]] * tstd
+            ty = tr[cls * 2 + 1, ph_b[None], pw_b[None]] * tstd
+
+        wstart = pw * bin_w + x1 + tx * rw                    # (od, p, p)
+        hstart = ph * bin_h + y1 + ty * rh
+        iw = jnp.arange(spp, dtype=jnp.float32)
+        sw = wstart[..., None, None] + iw[None, :] * sub_w    # (od,p,p,1,spp)
+        sh = hstart[..., None, None] + iw[:, None] * sub_h    # (od,p,p,spp,1)
+        sw = jnp.broadcast_to(sw, sw.shape[:-2] + (spp, spp))
+        sh = jnp.broadcast_to(sh, sh.shape[:-2] + (spp, spp))
+        valid = (sw >= -0.5) & (sw <= w - 0.5) & (sh >= -0.5) & (sh <= h - 0.5)
+        swc = jnp.clip(sw, 0.0, w - 1.0)
+        shc = jnp.clip(sh, 0.0, h - 1.0)
+
+        img = data[bidx]                                      # (C, H, W)
+        x_lo = jnp.floor(swc).astype(jnp.int32)
+        x_hi = jnp.ceil(swc).astype(jnp.int32)
+        y_lo = jnp.floor(shc).astype(jnp.int32)
+        y_hi = jnp.ceil(shc).astype(jnp.int32)
+        dx = swc - x_lo
+        dy = shc - y_lo
+        cb = jnp.broadcast_to(chan[..., None, None], sw.shape)
+        v11 = img[cb, y_lo, x_lo]
+        v12 = img[cb, y_hi, x_lo]
+        v21 = img[cb, y_lo, x_hi]
+        v22 = img[cb, y_hi, x_hi]
+        val = (1 - dx) * (1 - dy) * v11 + (1 - dx) * dy * v12 + \
+            dx * (1 - dy) * v21 + dx * dy * v22
+        cnt = valid.sum(axis=(-1, -2))
+        s = jnp.sum(val * valid, axis=(-1, -2))
+        return jnp.where(cnt > 0, s / jnp.maximum(cnt, 1), 0.0)
+
+    if notrans:
+        trans_in = jnp.zeros((rois.shape[0], 2, ps, ps), jnp.float32)
+    else:
+        trans_in = trans
+    return jax.vmap(one_roi)(rois, trans_in)
+
+
 # -------------------------------------------------------------- correlation
 @register("Correlation")
 def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,

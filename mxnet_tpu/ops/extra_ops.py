@@ -33,7 +33,6 @@ _alias("BatchNorm_v1", "BatchNorm")        # legacy pre-NNVM registrations
 _alias("Convolution_v1", "Convolution")
 _alias("Pooling_v1", "Pooling")
 _alias("_rnn_param_concat", "concat")
-_alias("_contrib_SyncBatchNorm", "BatchNorm")  # stats are global under SPMD
 _alias("_contrib_SparseEmbedding", "Embedding")
 
 

@@ -245,6 +245,52 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     return out, lax.stop_gradient(mean), lax.stop_gradient(var)
 
 
+def _cross_replica_mean(x, axis_name):
+    """pmean over a live mesh axis; identity when the axis is not bound
+    (eager, plain jit, or a mesh without that axis)."""
+    try:
+        return lax.pmean(x, axis_name)
+    except NameError:
+        return x
+
+
+@register("_contrib_SyncBatchNorm", aliases=("SyncBatchNorm",))
+def sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                    momentum=0.9, fix_gamma=False, use_global_stats=False,
+                    output_mean_var=False, ndev=1, key=None, axis_name="dp",
+                    __training__=False):
+    """Cross-device synchronized BatchNorm (reference
+    ``src/operator/contrib/sync_batch_norm.cc`` — channel axis fixed at 1).
+
+    The reference syncs per-device moments through a host-side shared-memory
+    barrier keyed by ``key``/``ndev``.  TPU-native: inside ``shard_map`` the
+    moments are ``lax.pmean``'d over the data mesh axis (``axis_name``); under
+    the fused pjit SPMD step — or on one chip — the plain batch moments are
+    already global, so the op degrades to exactly ``BatchNorm``.
+    """
+    eps_ = parse_float(eps, 1e-3)
+    red_axes = tuple(i for i in range(data.ndim) if i != 1)
+    training = parse_bool(__training__) and not parse_bool(use_global_stats)
+    if training:
+        x32 = data.astype(jnp.float32)
+        mean = _cross_replica_mean(jnp.mean(x32, axis=red_axes), axis_name)
+        mean_sq = _cross_replica_mean(jnp.mean(x32 * x32, axis=red_axes),
+                                      axis_name)
+        var = jnp.maximum(mean_sq - mean * mean, 0.0)
+        mean = mean.astype(data.dtype)
+        var = var.astype(data.dtype)
+    else:
+        mean, var = moving_mean, moving_var
+    shape = [1] * data.ndim
+    shape[1] = data.shape[1]
+    g = jnp.ones_like(gamma) if parse_bool(fix_gamma, False) else gamma
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps_).astype(data.dtype)
+    out = (data - jnp.reshape(mean, shape).astype(data.dtype)) * \
+        jnp.reshape(inv * g.astype(data.dtype), shape) + \
+        jnp.reshape(beta, shape).astype(data.dtype)
+    return out, lax.stop_gradient(mean), lax.stop_gradient(var)
+
+
 @register("LayerNorm")
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
     """Reference ``LayerNorm`` (src/operator/nn/layer_norm.cc)."""

@@ -25,10 +25,11 @@ from ..ops.random_ops import STOCHASTIC_OPS
 
 # Ops with auxiliary-state inputs (position -> aux name suffix); mirrors the
 # reference's mutable aux inputs (NDArray aux_states in executor bind).
-AUX_INPUTS = {"BatchNorm": {3: "moving_mean", 4: "moving_var"}}
+AUX_INPUTS = {"BatchNorm": {3: "moving_mean", 4: "moving_var"},
+              "_contrib_SyncBatchNorm": {3: "moving_mean", 4: "moving_var"}}
 
 # Ops whose behavior depends on is_train (OpContext ctx.is_train in reference)
-MODE_DEPENDENT = {"Dropout", "BatchNorm", "RNN"}
+MODE_DEPENDENT = {"Dropout", "BatchNorm", "RNN", "_contrib_SyncBatchNorm"}
 
 _SIG_CACHE = {}
 
@@ -321,11 +322,11 @@ class Symbol:
                 out = node.op.fn(*ins, **attrs)
                 if not isinstance(out, (tuple, list)):
                     out = (out,)
-                if node.op.name == "BatchNorm" and is_train and with_aux_updates:
+                if node.op.name in AUX_INPUTS and is_train and with_aux_updates:
                     from ..base import parse_bool, parse_float
                     if not parse_bool(node.attrs.get("use_global_stats", False)):
                         mom = parse_float(node.attrs.get("momentum", 0.9), 0.9)
-                        for pos, suffix in AUX_INPUTS["BatchNorm"].items():
+                        for pos, suffix in AUX_INPUTS[node.op.name].items():
                             pnode, pidx = node.inputs[pos]
                             new_stat = out[1] if suffix == "moving_mean" else out[2]
                             old = vals[id(pnode)][pidx]
@@ -549,7 +550,7 @@ def load_json(json_str):
             inputs = [(nodes[i], oi) for (i, oi) in map(entry, spec["inputs"])]
             node = _Node(op, spec["name"], inputs, attrs, 1)
             # fix num_outputs for known multi-output ops
-            if op.name == "BatchNorm":
+            if op.name in AUX_INPUTS:
                 if len(inputs) == 3:
                     # legacy graphs omit aux-state inputs; the reference
                     # appends them on load (legacy_json_util.cc).  NOTE:
@@ -589,8 +590,8 @@ def _auto_name(opname):
 def _num_outputs_of(op, attrs, n_inputs):
     from ..base import parse_bool, parse_int
 
-    if op.name == "BatchNorm":
-        # The op computes (out, mean, var) but only `out` is composable —
+    if op.name in AUX_INPUTS:
+        # These ops compute (out, mean, var) but only `out` is composable —
         # matching the reference's num_visible_outputs=1 for BatchNorm.
         return 1
     if op.name in ("split", "SliceChannel"):
@@ -728,6 +729,8 @@ LAYER_INPUTS = {
     "Convolution": _conv_inputs,
     "Deconvolution": _deconv_inputs,
     "BatchNorm": lambda a: ["data", "gamma", "beta", "moving_mean", "moving_var"],
+    "_contrib_SyncBatchNorm": lambda a: ["data", "gamma", "beta",
+                                         "moving_mean", "moving_var"],
     "LayerNorm": lambda a: ["data", "gamma", "beta"],
     "InstanceNorm": lambda a: ["data", "gamma", "beta"],
     "Embedding": lambda a: ["data", "weight"],
@@ -743,7 +746,8 @@ LAYER_INPUTS = {
     "SVMOutput": lambda a: ["data", "label"],
 }
 
-AUX_INPUTS_BY_NAME = {"BatchNorm": {"moving_mean", "moving_var"}}
+AUX_INPUTS_BY_NAME = {"BatchNorm": {"moving_mean", "moving_var"},
+                      "_contrib_SyncBatchNorm": {"moving_mean", "moving_var"}}
 
 
 def _infer_layer_param_shapes(node, out_specs, var_spec):
@@ -797,7 +801,7 @@ def _infer_layer_param_shapes(node, out_specs, var_spec):
         fill(roles.index("weight"), wshape)
         if "bias" in roles:
             fill(roles.index("bias"), (nf,))
-    elif op_name == "BatchNorm":
+    elif op_name in ("BatchNorm", "_contrib_SyncBatchNorm"):
         axis = parse_int(a.get("axis", 1), 1)
         c = int(dshape[axis])
         for r in ("gamma", "beta", "moving_mean", "moving_var"):

@@ -1,0 +1,212 @@
+"""DGL graph-sampling contrib ops — mirrors the reference's
+``tests/python/unittest/test_dgl_graph.py`` assertions on the host-side
+CSR implementations (``mxnet_tpu/ndarray/contrib_graph.py``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+K5 = dict(
+    data=np.arange(1, 21, dtype=np.int64),
+    indices=np.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                      0, 1, 2, 4, 0, 1, 2, 3], dtype=np.int64),
+    indptr=np.array([0, 4, 8, 12, 16, 20], dtype=np.int64),
+)
+
+
+def _k5():
+    return mx.nd.sparse.csr_matrix(
+        (K5["data"], K5["indices"], K5["indptr"]), shape=(5, 5))
+
+
+def _check_uniform(out, num_hops, max_num_vertices):
+    sample_id, sub_csr, layer = out
+    assert len(sample_id) == max_num_vertices + 1
+    num_vertices = int(sample_id[-1].asnumpy()[()])
+    sub_csr.check_format(full_check=True)
+    indptr = sub_csr.indptr.asnumpy()
+    assert (indptr[num_vertices:] == indptr[num_vertices]).all()
+    for d in layer.asnumpy()[:num_vertices]:
+        assert d <= num_hops
+    return num_vertices
+
+
+def _check_compact(csr, id_arr, num_nodes):
+    compact = mx.nd.contrib.dgl_graph_compact(
+        csr, id_arr, graph_sizes=num_nodes, return_mapping=False)
+    assert compact.shape == (num_nodes, num_nodes)
+    assert (compact.indptr.asnumpy() ==
+            csr.indptr.asnumpy()[:num_nodes + 1]).all()
+    sub_indices = compact.indices.asnumpy()
+    indices = csr.indices.asnumpy()
+    ids = id_arr.asnumpy()
+    for i in range(len(sub_indices)):
+        assert ids[sub_indices[i]] == indices[i]
+
+
+@pytest.mark.parametrize("seed,num_hops,num_neighbor,maxv", [
+    ([0, 1, 2, 3, 4], 1, 2, 5),
+    ([0], 1, 1, 4),
+    ([0], 2, 1, 3),
+    ([0, 2, 4], 1, 2, 5),
+    ([0, 4], 2, 2, 5),
+])
+def test_uniform_sample(seed, num_hops, num_neighbor, maxv):
+    a = _k5()
+    np.random.seed(42)
+    out = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, mx.nd.array(np.array(seed, dtype=np.int64)), num_args=2,
+        num_hops=num_hops, num_neighbor=num_neighbor, max_num_vertices=maxv)
+    assert len(out) == 3
+    n = _check_uniform(out, num_hops, maxv)
+    assert 0 < n < len(out[0])
+    _check_compact(out[1], out[0], n)
+
+
+def test_non_uniform_sample():
+    a = _k5()
+    prob = mx.nd.array(np.array([0.9, 0.8, 0.2, 0.4, 0.1], np.float32))
+    np.random.seed(42)
+    out = mx.nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        a, prob, mx.nd.array(np.array([0, 1, 2, 3, 4], dtype=np.int64)),
+        num_args=3, num_hops=1, num_neighbor=2, max_num_vertices=5)
+    assert len(out) == 4
+    sample_id, sub_csr, sub_prob, layer = out
+    n = _check_uniform([sample_id, sub_csr, layer], 1, 5)
+    assert len(sub_prob) == 5
+    np.testing.assert_allclose(
+        sub_prob.asnumpy()[:n],
+        prob.asnumpy()[sample_id.asnumpy()[:n]])
+
+
+def test_sampled_edges_come_from_graph():
+    # NOTE: max_num_vertices must exceed the seed count for any expansion to
+    # happen — the reference's BFS loop (dgl_graph.cc SampleSubgraph) stops
+    # once the vertex budget is reached, so num_seeds == max_num_vertices
+    # yields an empty sub-CSR (its doc example predates that check).
+    a = _k5()
+    np.random.seed(0)
+    out = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, mx.nd.array(np.array([0, 1], dtype=np.int64)),
+        num_args=2, num_hops=1, num_neighbor=2, max_num_vertices=5)
+    sub = out[1]
+    dense = sub.asnumpy()
+    full = np.zeros((5, 5), np.int64)
+    for r in range(5):
+        s, e = K5["indptr"][r], K5["indptr"][r + 1]
+        full[r, K5["indices"][s:e]] = K5["data"][s:e]
+    nz = dense != 0
+    assert nz.sum() > 0
+    assert (dense[nz] == full[nz]).all()
+
+
+def _random_graph(n, density=0.2):
+    import scipy.sparse as sp
+    rng = np.random.RandomState(3)
+    arr = sp.random(n, n, density=density, format="coo", random_state=rng)
+    arr.data = np.arange(0, len(arr.row), dtype=np.float32)
+    return arr.tocsr(), mx.nd.sparse.csr_matrix(arr.tocsr()).astype(np.int64)
+
+
+def test_subgraph():
+    sp_g, g = _random_graph(100)
+    rng = np.random.RandomState(1)
+    vertices = np.unique(rng.randint(0, 100, size=(20,)))
+    subgs = mx.nd.contrib.dgl_subgraph(
+        g, mx.nd.array(vertices, dtype=np.int64), return_mapping=True)
+    subgs[0].check_format()
+    subgs[1].check_format()
+    np.testing.assert_array_equal(subgs[0].indptr.asnumpy(),
+                                  subgs[1].indptr.asnumpy())
+    np.testing.assert_array_equal(subgs[0].indices.asnumpy(),
+                                  subgs[1].indices.asnumpy())
+    # new edge ids are 0..nnz-1
+    np.testing.assert_array_equal(subgs[0].data.asnumpy(),
+                                  np.arange(len(subgs[0].data)))
+    sp_subg = subgs[1].asscipy()
+    indptr = subgs[0].indptr.asnumpy()
+    indices = subgs[0].indices.asnumpy()
+    for subv1 in range(len(indptr) - 1):
+        v1 = vertices[subv1]
+        for subv2 in indices[indptr[subv1]:indptr[subv1 + 1]]:
+            v2 = vertices[subv2]
+            assert sp_g[v1, v2] == sp_subg[subv1, subv2]
+
+
+def test_adjacency():
+    _sp_g, g = _random_graph(100)
+    adj = mx.nd.contrib.dgl_adjacency(g)
+    assert adj.dtype == np.float32
+    assert adj.shape == g.shape
+    np.testing.assert_array_equal(adj.indptr.asnumpy(), g.indptr.asnumpy())
+    np.testing.assert_array_equal(adj.indices.asnumpy(), g.indices.asnumpy())
+    np.testing.assert_array_equal(adj.data.asnumpy(),
+                                  np.ones(g.indices.shape))
+
+
+def test_truncated_sample_is_always_compactable():
+    # Budget-truncated walks used to emit edges to vertices outside the
+    # sampled set, which graph_compact then crashed on.
+    a = _k5()
+    for s in range(10):
+        np.random.seed(s)
+        out = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+            a, mx.nd.array(np.array([2], dtype=np.int64)), num_args=2,
+            num_hops=1, num_neighbor=2, max_num_vertices=2)
+        n = int(out[0][-1].asnumpy()[()])
+        out[1].check_format(full_check=True)
+        _check_compact(out[1], out[0], n)
+
+
+def test_multi_seed_outputs_grouped_by_kind():
+    a = _k5()
+    np.random.seed(0)
+    s1 = mx.nd.array(np.array([0], dtype=np.int64))
+    s2 = mx.nd.array(np.array([3], dtype=np.int64))
+    out = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, s1, s2, num_args=3, num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    # reference layout: [ids0, ids1, csr0, csr1, layer0, layer1]
+    assert len(out) == 6
+    assert out[0].shape == (6,) and out[1].shape == (6,)
+    assert out[2].shape == (5, 5) and out[3].shape == (5, 5)
+    assert out[4].shape == (5,) and out[5].shape == (5,)
+    assert int(out[0].asnumpy()[0]) == 0    # first sampled id of seed 0
+    assert 3 in out[1].asnumpy()[:int(out[1][-1].asnumpy()[()])]
+
+
+def test_graph_compact_new_edge_ids_and_mapping():
+    a = _k5()
+    np.random.seed(0)
+    out = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, mx.nd.array(np.array([0, 1], dtype=np.int64)), num_args=2,
+        num_hops=1, num_neighbor=2, max_num_vertices=5)
+    n = int(out[0][-1].asnumpy()[()])
+    compact, mapping = mx.nd.contrib.dgl_graph_compact(
+        out[1], out[0], graph_sizes=n, return_mapping=True)
+    nnz = len(compact.data)
+    # compacted data are new edge ids 0..nnz-1 (dgl_graph.cc sub_eids[i]=i)
+    np.testing.assert_array_equal(compact.data.asnumpy(), np.arange(nnz))
+    np.testing.assert_array_equal(compact.indptr.asnumpy(),
+                                  mapping.indptr.asnumpy())
+    np.testing.assert_array_equal(compact.indices.asnumpy(),
+                                  mapping.indices.asnumpy())
+    # mapping data are the sub-CSR's edge values (original graph edge ids)
+    np.testing.assert_array_equal(mapping.data.asnumpy(),
+                                  out[1].data.asnumpy())
+
+
+def test_csr_cache_invalidated_on_inplace_write():
+    a = mx.nd.sparse.csr_matrix(
+        (np.array([5., 7.]), np.array([1, 2]), np.array([0, 1, 2])),
+        shape=(2, 3))
+    np.testing.assert_array_equal(a.data.asnumpy(), [5., 7.])
+    a += 1.0
+    np.testing.assert_array_equal(a.asnumpy(), [[1., 6., 1.], [1., 1., 8.]])
+    np.testing.assert_array_equal(a.data.asnumpy(),
+                                  [1., 6., 1., 1., 1., 8.])   # derived anew
+    b = mx.nd.sparse.csr_matrix(
+        (np.array([5., 7.]), np.array([1, 2]), np.array([0, 1, 2])),
+        shape=(2, 3))
+    b[0, 0] = 9.0
+    assert b.data.asnumpy()[0] == 9.0

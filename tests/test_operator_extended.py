@@ -168,3 +168,48 @@ def test_scatter_and_gather_nd():
     np.testing.assert_array_equal(g.asnumpy(), [1, 2])
     s = mx.nd.scatter_nd(mx.nd.array([9.0, 8.0]), idx, shape=(2, 2))
     np.testing.assert_array_equal(s.asnumpy(), [[0, 9], [8, 0]])
+
+
+def test_sync_batch_norm_matches_batch_norm_single_device():
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(4, 3, 5, 5).astype(np.float32))
+    gamma = mx.nd.array(rng.rand(3).astype(np.float32) + 0.5)
+    beta = mx.nd.array(rng.randn(3).astype(np.float32))
+    mm, mv = mx.nd.zeros((3,)), mx.nd.ones((3,))
+    with mx.autograd.record():
+        a = mx.nd.BatchNorm(x, gamma, beta, mm.copy(), mv.copy(),
+                            fix_gamma=False, eps=1e-5)
+        b = mx.nd.contrib.SyncBatchNorm(x, gamma, beta, mm.copy(), mv.copy(),
+                                        eps=1e-5, ndev=1)
+    np.testing.assert_allclose(a.asnumpy(), b.asnumpy(), rtol=1e-5, atol=1e-6)
+    # eval mode normalizes with the moving stats
+    c = mx.nd.contrib.SyncBatchNorm(x, gamma, beta, mm, mv, eps=1e-5)
+    assert np.isfinite(c.asnumpy()).all()
+
+
+def test_sync_batch_norm_shard_map_moments_are_global():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from mxnet_tpu.ops.nn import batch_norm, sync_batch_norm
+    from mxnet_tpu.parallel.mesh import shard_map_fn
+    shard_map = shard_map_fn()
+
+    rng = np.random.RandomState(0)
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("dp",))
+    X = rng.randn(8, 3, 4, 4).astype(np.float32)
+    G, B = np.ones(3, np.float32), np.zeros(3, np.float32)
+
+    def local_bn(xs):
+        out, _m, _v = sync_batch_norm(xs, jnp.asarray(G), jnp.asarray(B),
+                                      jnp.zeros(3), jnp.ones(3), eps=1e-5,
+                                      __training__=True)
+        return out
+
+    f = shard_map(local_bn, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    got = np.asarray(f(jnp.asarray(X)))
+    want, _, _ = batch_norm(jnp.asarray(X), jnp.asarray(G), jnp.asarray(B),
+                            jnp.zeros(3), jnp.ones(3), eps=1e-5,
+                            fix_gamma=False, __training__=True)
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5, atol=1e-5)
