@@ -26,7 +26,7 @@ from .ring_attention import (  # noqa: F401
 from .checkpoint import (  # noqa: F401
     save_spmd_checkpoint, load_spmd_checkpoint, SPMDCheckpointManager,
 )
-from .pipeline import gpipe, pipeline_stage_loop  # noqa: F401
+from .pipeline import gpipe, pipeline_stage_loop, pipeline_train_1f1b  # noqa: F401
 from .moe import moe_layer, switch_moe_local  # noqa: F401
 from .sp_context import (  # noqa: F401
     sequence_parallel_scope, current_sequence_parallel,

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["gpipe", "pipeline_stage_loop"]
+__all__ = ["gpipe", "pipeline_stage_loop", "pipeline_train_1f1b"]
 
 
 def pipeline_stage_loop(stage_fn, stage_params, x_micro, axis_name):
@@ -97,3 +97,145 @@ def gpipe(stage_fn, stacked_params, x, mesh, n_microbatches, pp_axis="pp"):
         out_specs=P(),
     )(stacked_params, x_micro)
     return out.reshape((b,) + out.shape[2:])
+
+
+def _f1b1_device_loop(stage_fn, loss_fn, n_stages, n_micro, stage_params,
+                      x_micro, y_micro, axis_name):
+    """Per-device 1F1B training loop (runs inside ``shard_map``).
+
+    Unlike ``gpipe`` + ``jax.grad`` — which materialises the full forward
+    schedule and then replays it reversed — this is ONE fused loop in which
+    every tick performs a forward microbatch-stage compute and a backward one
+    (the classic one-forward-one-backward steady state).  Backward for
+    microbatch m begins on the last stage one tick after its forward leaves
+    it, so a stage input is live for at most ``2*S - 1`` ticks and the
+    activation stash is a circular buffer of ``min(n_micro, 2S)`` slots —
+    the 1F1B memory bound — rather than growing with ``n_micro`` (the only
+    O(n_micro) buffer is the returned input-gradient, a result).
+
+    Schedule (device d of S, tick t):
+      forward  slot: microbatch ``m_f = t - d``          → F(m) at t = m + d
+      backward slot: microbatch ``m_b = t + d - 2S + 1`` → B(m) at
+                     t = m + 2S - 1 - d (on the last stage: one tick after
+                     its forward).
+
+    ``loss_fn(y_pred, y_true) -> scalar`` is applied per microbatch on the
+    last stage; total loss is their mean.  Returns
+    ``(loss_contrib, param_grads, input_grads)`` where ``loss_contrib``
+    psums to the loss and ``input_grads`` psums to dL/dx_micro.
+    """
+    S, N = n_stages, n_micro
+    d = lax.axis_index(axis_name)
+    params = jax.tree.map(lambda p: p[0], stage_params)
+    B = min(N, 2 * S)                       # circular stash slots (static)
+
+    probe = stage_fn(params, x_micro[0])
+    zero_act = jnp.zeros_like(probe)
+    zero_act = zero_act + lax.psum(jnp.zeros([], probe.dtype), axis_name) * 0
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    state = dict(
+        fwd_carry=zero_act,
+        bwd_carry=zero_act,
+        stash=jnp.zeros((B,) + probe.shape, probe.dtype) + zero_act,
+        # one-slot carry of the previous tick's forward output: on the last
+        # stage, B(m) runs exactly one tick after F(m), so this is y_pred —
+        # no O(n_micro) outputs buffer needed
+        prev_out=zero_act,
+        dparams=jax.tree.map(lambda p: jnp.zeros_like(p) +
+                             zero_act.ravel()[0] * 0, params),
+        dx=jnp.zeros((N,) + x_micro.shape[1:], x_micro.dtype) +
+        zero_act.ravel()[0] * 0,
+        loss=jnp.zeros([], jnp.float32) + zero_act.ravel()[0] * 0,
+    )
+
+    def tick(t, st):
+        # ---- forward slot -------------------------------------------------
+        m_f = t - d
+        f_active = (m_f >= 0) & (m_f < N)
+        m_fc = jnp.clip(m_f, 0, N - 1)
+        inp = jnp.where(d == 0, x_micro[m_fc].astype(probe.dtype),
+                        st["fwd_carry"])
+        out = stage_fn(params, inp)
+        stash = st["stash"].at[m_fc % B].set(
+            jnp.where(f_active, inp, st["stash"][m_fc % B]))
+        fwd_carry = lax.ppermute(out, axis_name, fwd_perm)
+
+        # ---- backward slot ------------------------------------------------
+        m_b = t + d - 2 * S + 1
+        b_active = (m_b >= 0) & (m_b < N)
+        m_bc = jnp.clip(m_b, 0, N - 1)
+        stage_in = stash[m_bc % B]
+        y_pred = st["prev_out"]             # last stage: F(m_b) ran last tick
+        loss_m, loss_vjp = jax.vjp(
+            lambda yp: loss_fn(yp, y_micro[m_bc]), y_pred)
+        # cotangent must carry loss_m's varying-axes type under shard_map
+        ct = jnp.ones([], loss_m.dtype) / N + loss_m * 0
+        g_seed = loss_vjp(ct)[0].astype(probe.dtype)
+        g_in = jnp.where(d == S - 1, g_seed, st["bwd_carry"])
+        _, stage_vjp = jax.vjp(stage_fn, params, stage_in)
+        dp, dx_stage = stage_vjp(g_in)
+        # NaN-safe masking: warmup ticks evaluate the loss VJP on garbage
+        # activations, which may be non-finite — jnp.where, never `* mask`
+        # (NaN * 0 = NaN would poison the accumulators and the ring)
+        dparams = jax.tree.map(
+            lambda a, g: a + jnp.where(b_active, g, jnp.zeros_like(g)),
+            st["dparams"], dp)
+        loss = st["loss"] + jnp.where(b_active & (d == S - 1),
+                                      loss_m.astype(jnp.float32) / N, 0.0)
+        dx = st["dx"].at[m_bc].set(
+            jnp.where(b_active & (d == 0),
+                      dx_stage.astype(x_micro.dtype), st["dx"][m_bc]))
+        bwd_carry = lax.ppermute(
+            jnp.where(b_active, dx_stage, jnp.zeros_like(dx_stage)),
+            axis_name, bwd_perm)
+
+        return dict(fwd_carry=fwd_carry, bwd_carry=bwd_carry, stash=stash,
+                    prev_out=out, dparams=dparams, dx=dx, loss=loss)
+
+    steps = N + 2 * S - 1                   # B(N-1) on device 0 at tick N-1+2S-1
+    st = lax.fori_loop(0, steps, tick, state)
+
+    # every device holds only its own stage's grads; re-stack on the pp axis
+    dparams_stacked = jax.tree.map(lambda g: g[None], st["dparams"])
+    mask0 = (d == 0).astype(st["dx"].dtype)
+    loss = lax.psum(st["loss"], axis_name)          # lives on the last stage
+    dx = lax.psum(st["dx"] * mask0, axis_name)      # lives on stage 0
+    return loss, dparams_stacked, dx
+
+
+def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, y, mesh,
+                        n_microbatches, pp_axis="pp"):
+    """1F1B pipelined training step: returns ``(loss, param_grads, dx)``.
+
+    Same contract as ``gpipe`` (homogeneous S→S stages, params stacked on a
+    leading stage axis sharded over ``pp_axis``) but computes loss AND
+    gradients in one fused 1F1B schedule instead of ``jax.grad``-ing the
+    GPipe forward; ``param_grads`` has the same stacked layout as
+    ``stacked_params``, ``dx`` has ``x``'s shape.
+
+    ``loss_fn(y_pred_mb, y_true_mb) -> scalar`` is applied per microbatch;
+    the returned loss is the mean over microbatches.
+    """
+    from jax.sharding import PartitionSpec as P
+    from .mesh import shard_map_fn
+    shard_map = shard_map_fn()
+
+    S = mesh.shape[pp_axis]
+    b = x.shape[0]
+    assert b % n_microbatches == 0, \
+        f"batch {b} not divisible by n_microbatches {n_microbatches}"
+    mb = b // n_microbatches
+    x_micro = x.reshape((n_microbatches, mb) + x.shape[1:])
+    y_micro = y.reshape((n_microbatches, mb) + y.shape[1:])
+
+    fn = functools.partial(_f1b1_device_loop, stage_fn, loss_fn, S,
+                           n_microbatches, axis_name=pp_axis)
+    param_specs = jax.tree.map(lambda _: P(pp_axis), stacked_params)
+    loss, grads, dx = shard_map(
+        fn, mesh=mesh,
+        in_specs=(param_specs, P(), P()),
+        out_specs=(P(), param_specs, P()),
+    )(stacked_params, x_micro, y_micro)
+    return loss, grads, dx.reshape((b,) + dx.shape[2:])
