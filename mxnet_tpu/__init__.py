@@ -55,6 +55,11 @@ from . import engine  # noqa: F401
 from . import libinfo  # noqa: F401
 from . import log  # noqa: F401
 from . import kvstore_server  # noqa: F401
+from . import registry  # noqa: F401
+from . import misc  # noqa: F401
+from . import executor_manager  # noqa: F401
+from . import ndarray_doc  # noqa: F401
+from . import symbol_doc  # noqa: F401
 from . import contrib  # noqa: F401
 from . import models  # noqa: F401
 

@@ -1,0 +1,148 @@
+"""Legacy executor-manager layer (reference
+``python/mxnet/executor_manager.py`` — the pre-Module machinery under
+``FeedForward``).
+
+TPU-native note: the reference splits each batch across GPU executors and
+reduces gradients host-side.  Here a single jitted executor serves all
+requested contexts — XLA owns device placement, and multi-chip data
+parallelism lives in ``parallel/`` (SPMD) — so the manager keeps the
+reference's API (slices, ``load_data``, ``forward/backward``,
+``update_metric``) as a thin adapter.
+"""
+from __future__ import annotations
+
+import logging
+
+from . import ndarray as nd
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Slice a batch proportionally to ``work_load_list`` (reference
+    ``executor_manager.py:31`` — same rounding/clamping: per-slice rounded
+    counts, remainder folded into the last slice, ends clamped to
+    ``batch_size``, empty slices rejected)."""
+    total = sum(work_load_list)
+    counts = [round(w * batch_size / total) for w in work_load_list]
+    shortfall = batch_size - sum(counts)
+    if shortfall > 0:
+        counts[-1] += shortfall
+    slices = []
+    end = 0
+    for n in counts:
+        begin = min(end, batch_size)
+        end = min(begin + n, batch_size)
+        if begin >= end:
+            raise ValueError("Too many slices. Some splits are empty.")
+        slices.append(slice(begin, end))
+    return slices
+
+
+def _check_arguments(symbol):
+    """Reject duplicated argument/aux names (reference
+    ``executor_manager.py:68``)."""
+    arg_names = symbol.list_arguments()
+    if len(set(arg_names)) != len(arg_names):
+        raise ValueError(
+            "Find duplicated argument name, please make the weight name "
+            f"non-duplicated, arg_names={arg_names}")
+    aux_names = symbol.list_auxiliary_states()
+    if len(set(aux_names)) != len(aux_names):
+        raise ValueError(
+            "Find duplicated auxiliary param name, "
+            f"aux_names={aux_names}")
+
+
+def _load_general(data, targets):
+    """Copy a list of NDArrays onto (possibly sliced) targets."""
+    for d_src, d_targets in zip(data, targets):
+        if isinstance(d_targets, nd.NDArray):
+            d_src.copyto(d_targets)
+        else:
+            for sl, d_dst in d_targets:
+                d_src[sl].copyto(d_dst)
+
+
+def _load_data(batch, targets):
+    _load_general(batch.data, targets)
+
+
+def _load_label(batch, targets):
+    _load_general(batch.label, targets)
+
+
+class DataParallelExecutorManager:
+    """Reference ``executor_manager.py:298`` — drives train executors.
+
+    One jitted executor underneath (see module docstring); ``ctx`` /
+    ``work_load_list`` are accepted for API compatibility.
+    """
+
+    def __init__(self, symbol, ctx, train_data, param_names, arg_names,
+                 aux_names, work_load_list=None, logger=None, sym_gen=None):
+        self.symbol = symbol
+        self.ctx = ctx if isinstance(ctx, (list, tuple)) else [ctx]
+        self.param_names = list(param_names)
+        self.arg_names = list(arg_names)
+        self.aux_names = list(aux_names)
+        self.logger = logger or logging
+        _check_arguments(symbol)
+        if work_load_list is None:
+            work_load_list = [1] * len(self.ctx)
+        self.work_load_list = work_load_list
+        shapes = dict(train_data.provide_data + train_data.provide_label)
+        self.data_shapes = shapes
+        self._exec = self.symbol.simple_bind(
+            ctx=self.ctx[0], grad_req="write",
+            **{k: v for k, v in shapes.items()})
+        self._data_names = [k for k, _ in train_data.provide_data]
+        self._label_names = [k for k, _ in train_data.provide_label]
+        self._monitor = None
+
+    def install_monitor(self, monitor):
+        """Attach a ``mx.monitor.Monitor`` (reference
+        ``executor_manager.py:install_monitor``)."""
+        monitor.install(self._exec)
+        self._monitor = monitor
+
+    # -- reference API ------------------------------------------------------
+    def set_params(self, arg_params, aux_params):
+        self._exec.copy_params_from(arg_params, aux_params)
+
+    def copy_to(self, arg_params, aux_params):
+        for name in self.param_names:
+            if name in arg_params:
+                arg_params[name][:] = self._exec.arg_dict[name]
+        for name in self.aux_names:
+            if name in aux_params:
+                aux_params[name][:] = self._exec.aux_dict[name]
+
+    @property
+    def param_arrays(self):
+        return [[self._exec.arg_dict[n]] for n in self.param_names]
+
+    @property
+    def grad_arrays(self):
+        return [[self._exec.grad_dict[n]] for n in self.param_names]
+
+    @property
+    def aux_arrays(self):
+        return [[self._exec.aux_dict[n]] for n in self.aux_names]
+
+    def load_data_batch(self, data_batch):
+        for name, arr in zip(self._data_names, data_batch.data):
+            arr.copyto(self._exec.arg_dict[name])
+        for name, arr in zip(self._label_names, data_batch.label):
+            arr.copyto(self._exec.arg_dict[name])
+
+    def forward(self, is_train=False):
+        self._exec.forward(is_train=is_train)
+
+    def backward(self):
+        self._exec.backward()
+
+    def update_metric(self, metric, labels, pre_sliced=False):
+        if pre_sliced:
+            # reference semantics: labels come as one list per executor; with
+            # the single jitted executor that is labels[0]
+            labels = labels[0]
+        metric.update(labels, self._exec.outputs)

@@ -325,7 +325,9 @@ class Constant(Parameter):
                 arr[:] = value.asnumpy()
 
         init_name = f"Constant_{name}_{id(self)}"
-        initializer._INIT_REGISTRY[init_name.lower()] = Init
+        from .. import registry as _registry
+        _registry.get_register_func(initializer.Initializer, "initializer")(
+            Init, init_name)
         super().__init__(name, grad_req="null", shape=value.shape,
                          dtype=value.dtype, init=init_name)
 

@@ -16,22 +16,21 @@ import math
 
 import numpy as _np
 
-_INIT_REGISTRY = {}
-
-
 def register(klass):
     """Register an initializer under its lowercased class name (reference
-    ``initializer.py register`` / ``mx.init.registry``)."""
-    _INIT_REGISTRY[klass.__name__.lower()] = klass
-    return klass
+    ``initializer.py:270`` — delegates to the generic ``mx.registry``
+    factory, as the reference does)."""
+    from . import registry as _registry
+    return _registry.get_register_func(Initializer, "initializer")(klass)
 
 
 def alias(*names):
     """Extra registry names (reference ``@mx.init.register @alias('zeros')``)."""
 
     def deco(klass):
+        from . import registry as _registry
         for n in names:
-            _INIT_REGISTRY[n.lower()] = klass
+            _registry.get_register_func(Initializer, "initializer")(klass, n)
         return register(klass)
 
     return deco
@@ -151,17 +150,16 @@ class Initializer:
 
 def create(init, **kwargs):
     """Initializer factory accepting an instance, name string, or JSON dump
-    (reference ``registry.py`` create path)."""
+    (delegates to ``mx.registry`` like the reference; bare callables pass
+    through for function-style initializers)."""
+    from . import registry as _registry
     if isinstance(init, Initializer):
         return init
     if callable(init):
         return init
-    if isinstance(init, str):
-        s = init.strip()
-        if s.startswith("["):
-            name, kw = json.loads(s)
-            return _INIT_REGISTRY[name.lower()](**kw)
-        return _INIT_REGISTRY[s.lower()](**kwargs)
+    if isinstance(init, (str, dict)):
+        return _registry.get_create_func(Initializer, "initializer")(
+            init, **kwargs)
     raise TypeError(f"cannot create initializer from {init!r}")
 
 

@@ -1,0 +1,31 @@
+"""Extra NDArray operator documents (reference
+``python/mxnet/ndarray_doc.py``).
+
+The reference attaches hand-written example docstrings to generated op
+functions by looking up ``<OpName>Doc`` classes here.  Our op functions carry
+their docstrings directly on the kernel definitions (``mxnet_tpu/ops/*``);
+this module keeps the lookup surface for tooling that extends it.
+"""
+from __future__ import annotations
+
+
+class NDArrayDoc:
+    """Base class for extra operator documentation."""
+
+
+def _build_doc(func_name, desc, arg_names, arg_types, arg_desc,
+               key_var_num_args=None, ret_type=None):
+    """Assemble a numpydoc-style op docstring (reference
+    ``ndarray_doc.py:_build_doc``)."""
+    lines = [desc, "", "Parameters", "----------"]
+    for name, typ, d in zip(arg_names, arg_types, arg_desc):
+        lines.append(f"{name} : {typ}")
+        if d:
+            lines.append(f"    {d}")
+    if key_var_num_args:
+        lines.append(f"{key_var_num_args} : int")
+        lines.append("    Number of variadic positional inputs.")
+    lines += ["", "Returns", "-------",
+              f"out : {ret_type or 'NDArray or list of NDArrays'}",
+              "    The output of this function."]
+    return "\n".join(lines)

@@ -1,0 +1,95 @@
+"""Generic class registry/factory (reference ``python/mxnet/registry.py``).
+
+Backs the ``@mx.init.register``-style factories and lets user code build its
+own string/JSON-configurable factories.  The create function accepts an
+instance (passthrough), a registered name, a ``'["name", {kwargs}]'`` JSON
+pair, or a ``'{"nickname": ..., ...}'`` JSON dict — the formats
+``Optimizer``/``Initializer`` configs are serialized in when shipped to
+kvstore servers (reference ``kvstore.py set_optimizer``).
+"""
+from __future__ import annotations
+
+import json
+import warnings
+
+_REGISTRY = {}
+
+
+def get_registry(base_class):
+    """A copy of the name → class mapping registered under ``base_class``."""
+    return dict(_REGISTRY.setdefault(base_class, {}))
+
+
+def get_register_func(base_class, nickname):
+    """Build a ``register(klass, name=None)`` decorator for ``base_class``."""
+    registry = _REGISTRY.setdefault(base_class, {})
+
+    def register(klass, name=None):
+        if not (isinstance(klass, type) and issubclass(klass, base_class)):
+            raise AssertionError(
+                f"Can only register subclass of {base_class.__name__}")
+        key = (name or klass.__name__).lower()
+        if key in registry:
+            warnings.warn(
+                f"New {nickname} {klass.__module__}.{klass.__name__} "
+                f"registered with name {key} is overriding existing "
+                f"{nickname} {registry[key].__module__}."
+                f"{registry[key].__name__}", UserWarning, stacklevel=2)
+        registry[key] = klass
+        return klass
+
+    register.__doc__ = f"Register {nickname} to the {nickname} factory"
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """Build an ``@alias('a', 'b')`` decorator registering extra names."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+        return reg
+
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Build a ``create(name_or_instance_or_json, **kwargs)`` factory."""
+    registry = _REGISTRY.setdefault(base_class, {})
+
+    def create(*args, **kwargs):
+        if args:
+            name, args = args[0], args[1:]
+        else:
+            name = kwargs.pop(nickname)
+        if isinstance(name, base_class):
+            if args or kwargs:
+                raise AssertionError(
+                    f"{nickname} is already an instance. Additional "
+                    "arguments are invalid")
+            return name
+        if isinstance(name, dict):
+            return create(**name)
+        if not isinstance(name, str):
+            raise AssertionError(f"{nickname} must be of string type")
+        if name.startswith("["):
+            assert not args and not kwargs
+            name, kwargs = json.loads(name)
+            return create(name, **kwargs)
+        if name.startswith("{"):
+            assert not args and not kwargs
+            return create(**json.loads(name))
+        key = name.lower()
+        if key not in registry:
+            raise AssertionError(
+                f"{name} is not registered. Please register with "
+                f"{nickname}.register first")
+        return registry[key](*args, **kwargs)
+
+    create.__doc__ = (
+        f"Create a {nickname} instance from config (name string, JSON "
+        f"config, or {base_class.__name__} instance).")
+    return create

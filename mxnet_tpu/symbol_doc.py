@@ -1,0 +1,21 @@
+"""Extra Symbol operator documents (reference
+``python/mxnet/symbol_doc.py``) — see :mod:`mxnet_tpu.ndarray_doc`; the
+symbolic namespace shares the same op docstrings.
+"""
+from __future__ import annotations
+
+from .ndarray_doc import _build_doc  # noqa: F401
+
+
+class SymbolDoc:
+    """Base class for extra symbol documentation.
+
+    The reference also hangs doc-test helpers off this class (e.g.
+    ``get_output_shape``); kept as the API anchor.
+    """
+
+    @staticmethod
+    def get_output_shape(sym, **input_shapes):
+        """Infer and return ``{output_name: shape}`` for ``sym``."""
+        _, s_outputs, _ = sym.infer_shape(**input_shapes)
+        return dict(zip(sym.list_outputs(), s_outputs))

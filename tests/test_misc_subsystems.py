@@ -238,3 +238,87 @@ def test_det_horizontal_flip_boxes():
     np.testing.assert_allclose(out_label[0], [1, 0.6, 0.2, 0.9, 0.6],
                                atol=1e-6)
     assert (out_label[1] == -1).all()
+
+
+def test_registry_factory_roundtrip():
+    import json
+    from mxnet_tpu import registry
+
+    class Base:
+        def __init__(self, x=1):
+            self.x = x
+
+    register = registry.get_register_func(Base, "thing")
+    alias = registry.get_alias_func(Base, "thing")
+    create = registry.get_create_func(Base, "thing")
+
+    @alias("widget")
+    class MyThing(Base):
+        pass
+
+    register(MyThing)
+    assert set(registry.get_registry(Base)) >= {"mything", "widget"}
+    assert isinstance(create("MyThing"), MyThing)
+    assert create("widget", x=5).x == 5
+    # JSON pair and dict configs (the kvstore set_optimizer wire format)
+    assert create(json.dumps(["mything", {"x": 3}])).x == 3
+    assert create(json.dumps({"thing": "mything", "x": 4})).x == 4
+    inst = MyThing()
+    assert create(inst) is inst
+    import pytest
+    with pytest.raises(AssertionError):
+        create("unregistered_name")
+
+
+def test_misc_deprecated_factor_scheduler():
+    from mxnet_tpu.misc import FactorScheduler
+    s = FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    assert s(0) == 1.0
+    assert s(10) == 0.5
+    assert s(25) == 0.25
+
+
+def test_executor_manager_train_loop():
+    import numpy as np
+    from mxnet_tpu.executor_manager import (
+        DataParallelExecutorManager, _split_input_slice, _check_arguments)
+
+    assert _split_input_slice(10, [1, 1]) == [slice(0, 5), slice(5, 10)]
+    assert _split_input_slice(9, [2, 1]) == [slice(0, 6), slice(6, 9)]
+    # over-subscribed splits raise, and ends are clamped to batch_size —
+    # same as the reference (rounded counts can overshoot: 9 over 6 workers)
+    import pytest
+    with pytest.raises(ValueError):
+        _split_input_slice(9, [1] * 6)
+    with pytest.raises(ValueError):
+        _split_input_slice(2, [1, 1, 1])
+    sl = _split_input_slice(10, [1, 1, 1])
+    assert sl[-1].stop == 10 and all(s.start < s.stop <= 10 for s in sl)
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    fc = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, label, name="softmax")
+    _check_arguments(out)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 4).astype("float32")
+    Y = (X.sum(axis=1) > 0).astype("float32")
+    it = mx.io.NDArrayIter(X, Y, batch_size=8, label_name="softmax_label")
+    mgr = DataParallelExecutorManager(
+        symbol=out, ctx=[mx.cpu()], train_data=it,
+        param_names=["fc_weight", "fc_bias"],
+        arg_names=out.list_arguments(), aux_names=[])
+    arg_params = {"fc_weight": mx.nd.array(rng.randn(2, 4).astype("float32") * 0.1),
+                  "fc_bias": mx.nd.zeros((2,))}
+    mgr.set_params(arg_params, {})
+    batch = next(iter(it))
+    mgr.load_data_batch(batch)
+    mgr.forward(is_train=True)
+    mgr.backward()
+    grads = mgr.grad_arrays
+    assert all(np.isfinite(g[0].asnumpy()).all() for g in grads)
+    metric = mx.metric.Accuracy()
+    mgr.update_metric(metric, batch.label)
+    assert metric.get()[1] >= 0.0
