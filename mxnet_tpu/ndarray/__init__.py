@@ -29,3 +29,12 @@ from . import sparse  # noqa: E402, F401
 from .sparse import (  # noqa: F401
     BaseSparseNDArray, CSRNDArray, RowSparseNDArray,
 )
+
+# cast_storage must return the stype-tagged frontend class (reference returns
+# genuinely different storage); the generated op only converts the payload.
+_cast_storage_op = cast_storage  # noqa: F821  (installed by populate above)
+
+
+def cast_storage(data, stype="default"):  # noqa: F811
+    out = _cast_storage_op(data)
+    return out.tostype(stype)

@@ -452,6 +452,18 @@ def _quantized_wrapper(float_op_name, n_tensors):
     return fn
 
 
+def _dequant_fallback(float_op_name, data, weight, bias, dmin, dmax,
+                      wmin, wmax, bmin, bmax, **attrs):
+    """Shared non-int8 path for quantized FC/conv: substitute a zero bias
+    when the caller used the reference's 6-input no-bias arity."""
+    if bias is None:
+        bias = jnp.zeros((weight.shape[0],), jnp.float32)
+        bmin = bmax = jnp.zeros(1)
+    return _quantized_wrapper(float_op_name, 3)(
+        data, weight, bias, dmin, dmax, wmin, wmax, bmin, bmax,
+        no_bias=False, **attrs)
+
+
 def _scale_of(mn, mx, dtype):
     """De-quantization scale implied by a calibration range."""
     if dtype == jnp.uint8:
@@ -462,16 +474,25 @@ def _scale_of(mn, mx, dtype):
 
 @register("_contrib_quantized_fully_connected",
           aliases=("quantized_fully_connected",))
-def quantized_fully_connected(data, weight, bias, dmin, dmax, wmin, wmax,
-                              bmin, bmax, num_hidden=None, no_bias=False,
-                              flatten=True):
+def quantized_fully_connected(data, weight, *rest, num_hidden=None,
+                              no_bias=False, flatten=True):
     """TRUE int8 kernel (reference ``quantized_fully_connected.cc``):
     int8×int8 → int32 accumulate on ``dot_general``, then rescale —
-    symmetric-int8 path; uint8 data falls back to the dequantize route."""
+    symmetric-int8 path; uint8 data falls back to the dequantize route.
+
+    Input arity follows the reference's dynamic num_inputs: 6 tensors with
+    ``no_bias=True`` (data, weight, 2×2 ranges), 9 with a bias triple."""
+    if len(rest) == 4:         # reference no_bias arity (6 inputs total)
+        bias, (dmin, dmax, wmin, wmax) = None, rest
+        bmin = bmax = None
+    else:
+        bias, dmin, dmax, wmin, wmax, bmin, bmax = rest
+        if parse_bool(no_bias):
+            bias = None
     if data.dtype != jnp.int8 or weight.dtype != jnp.int8:
-        return _quantized_wrapper("FullyConnected", 3)(
-            data, weight, bias, dmin, dmax, wmin, wmax, bmin, bmax,
-            num_hidden=num_hidden, no_bias=no_bias, flatten=flatten)
+        return _dequant_fallback(
+            "FullyConnected", data, weight, bias, dmin, dmax, wmin, wmax,
+            bmin, bmax, num_hidden=num_hidden, flatten=flatten)
     x = data.reshape(data.shape[0], -1) if parse_bool(flatten, True) else data
     acc = jax.lax.dot_general(
         x, weight, (((x.ndim - 1,), (1,)), ((), ())),
@@ -484,18 +505,26 @@ def quantized_fully_connected(data, weight, bias, dmin, dmax, wmin, wmax,
 
 
 @register("_contrib_quantized_conv", aliases=("quantized_conv",))
-def quantized_conv(data, weight, bias, dmin, dmax, wmin, wmax, bmin, bmax,
+def quantized_conv(data, weight, *rest,
                    kernel=None, stride="(1, 1)", pad="(0, 0)",
                    dilate="(1, 1)", num_filter=None, num_group=1,
                    no_bias=False, layout=None, workspace=None,
                    cudnn_tune=None, cudnn_off=None):
     """TRUE int8 convolution: int8 taps, int32 accumulators
-    (``conv_general_dilated`` with preferred int32), then rescale."""
+    (``conv_general_dilated`` with preferred int32), then rescale.
+    Arity follows the reference: 6 inputs with ``no_bias=True``, else 9."""
+    if len(rest) == 4:         # reference no_bias arity (6 inputs total)
+        bias, (dmin, dmax, wmin, wmax) = None, rest
+        bmin = bmax = None
+    else:
+        bias, dmin, dmax, wmin, wmax, bmin, bmax = rest
+        if parse_bool(no_bias):
+            bias = None
     if data.dtype != jnp.int8 or weight.dtype != jnp.int8:
-        return _quantized_wrapper("Convolution", 3)(
-            data, weight, bias, dmin, dmax, wmin, wmax, bmin, bmax,
-            kernel=kernel, stride=stride, pad=pad, dilate=dilate,
-            num_filter=num_filter, num_group=num_group, no_bias=no_bias)
+        return _dequant_fallback(
+            "Convolution", data, weight, bias, dmin, dmax, wmin, wmax,
+            bmin, bmax, kernel=kernel, stride=stride, pad=pad,
+            dilate=dilate, num_filter=num_filter, num_group=num_group)
     sh, sw = parse_tuple(stride, 2, (1, 1))
     ph, pw = parse_tuple(pad, 2, (0, 0))
     dh, dw = parse_tuple(dilate, 2, (1, 1))

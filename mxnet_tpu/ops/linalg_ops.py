@@ -70,9 +70,11 @@ def linalg_syrk(A, transpose=False, alpha=1.0):
 
 @register("_linalg_gelqf", aliases=("linalg_gelqf",))
 def linalg_gelqf(A):
-    """LQ factorization (A = L Q with Q orthonormal rows)."""
+    """LQ factorization: ``Q, L = gelqf(A)`` with ``A = L Q``, Q orthonormal
+    rows, L lower triangular (reference la_op.cc:780 — Q is the FIRST
+    output)."""
     q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
-    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+    return jnp.swapaxes(q, -1, -2), jnp.swapaxes(r, -1, -2)
 
 
 @register("_linalg_syevd", aliases=("linalg_syevd",))

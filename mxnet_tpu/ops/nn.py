@@ -437,11 +437,20 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
     return _f(data, label)
 
 
+def _regression_scale(grad_scale, label):
+    # reference regression_output-inl.h:200 — gradient scaled by
+    # grad_scale / num_output, num_output = label.Size()/label.shape[0]
+    num_output = 1
+    for d in label.shape[1:]:
+        num_output *= d
+    return parse_float(grad_scale, 1.0) / max(num_output, 1)
+
+
 @register("LinearRegressionOutput")
 def linear_regression_output(data, label, grad_scale=1.0):
     """Reference ``LinearRegressionOutput`` (src/operator/regression_output.cc):
-    identity forward, (pred - label) * scale / batch backward."""
-    gs = parse_float(grad_scale, 1.0)
+    identity forward, (pred - label) * grad_scale/num_output backward."""
+    gs = _regression_scale(grad_scale, label)
 
     @jax.custom_vjp
     def _f(x, lab):
@@ -460,7 +469,7 @@ def linear_regression_output(data, label, grad_scale=1.0):
 
 @register("LogisticRegressionOutput")
 def logistic_regression_output(data, label, grad_scale=1.0):
-    gs = parse_float(grad_scale, 1.0)
+    gs = _regression_scale(grad_scale, label)
 
     @jax.custom_vjp
     def _f(x, lab):
@@ -480,7 +489,7 @@ def logistic_regression_output(data, label, grad_scale=1.0):
 
 @register("MAERegressionOutput")
 def mae_regression_output(data, label, grad_scale=1.0):
-    gs = parse_float(grad_scale, 1.0)
+    gs = _regression_scale(grad_scale, label)
 
     @jax.custom_vjp
     def _f(x, lab):

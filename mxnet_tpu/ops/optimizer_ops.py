@@ -222,9 +222,12 @@ def adamw_update(weight, grad, mean, var, rescale_grad=None, lr=None, eta=1.0,
         g = jnp.clip(g, -cg, cg)
     new_mean = b1 * mean + (1 - b1) * g
     new_var = b2 * var + (1 - b2) * jnp.square(g)
-    upd = new_mean / (jnp.sqrt(new_var) + parse_float(epsilon, 1e-8)) + \
+    # reference adamw-inl.h:137: w -= eta * (lr * m/(sqrt(v)+eps) + wd*w)
+    # — the decoupled decay is scaled by eta only, NOT by lr
+    upd = parse_float(lr) * new_mean / \
+        (jnp.sqrt(new_var) + parse_float(epsilon, 1e-8)) + \
         parse_float(wd, 0.0) * weight
-    new_w = weight - parse_float(eta, 1.0) * parse_float(lr) * upd
+    new_w = weight - parse_float(eta, 1.0) * upd
     return new_w, new_mean, new_var
 
 

@@ -28,15 +28,21 @@ def _range(min_r, max_r, out_type):
 
 @register("_contrib_quantize", aliases=("quantize",))
 def quantize(data, min_range, max_range, out_type="uint8"):
-    """Reference ``quantize.cc``: affine-quantize fp32 → int8/uint8 given
-    calibration range. Returns (q, min, max)."""
-    qmin, qmax = _range(min_range, max_range, out_type)
-    mn = jnp.minimum(min_range.reshape(()), 0.0)
-    mx = jnp.maximum(max_range.reshape(()), 0.0)
-    scale = (qmax - qmin) / jnp.maximum(mx - mn, 1e-20)
-    q = jnp.clip(jnp.round((data - mn) * scale + qmin), qmin, qmax)
-    dt = jnp.uint8 if str(out_type) == "uint8" else jnp.int8
-    return q.astype(dt), mn, mx
+    """Reference ``quantize.cc``: fp32 → int8/uint8 given a calibration
+    range.  uint8 is the affine map (quantize-inl.h:59); int8 is SYMMETRIC —
+    ``scale = 127/MaxAbs(min,max)``, returned range ±real_range
+    (quantize-inl.h:73-80).  Returns (q, out_min, out_max)."""
+    if str(out_type) == "uint8":
+        mn = jnp.minimum(min_range.reshape(()), 0.0)
+        mx = jnp.maximum(max_range.reshape(()), 0.0)
+        scale = UINT8_MAX / jnp.maximum(mx - mn, 1e-20)
+        q = jnp.clip(jnp.round((data - mn) * scale), 0.0, UINT8_MAX)
+        return q.astype(jnp.uint8), mn, mx
+    real_range = jnp.maximum(jnp.abs(min_range.reshape(())),
+                             jnp.abs(max_range.reshape(())))
+    scale = INT8_MAX / jnp.maximum(real_range, 1e-20)
+    q = jnp.sign(data) * jnp.minimum(jnp.abs(data) * scale + 0.5, INT8_MAX)
+    return jnp.trunc(q).astype(jnp.int8), -real_range, real_range
 
 
 @register("_contrib_quantize_v2", aliases=("quantize_v2",))

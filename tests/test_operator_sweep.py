@@ -1,0 +1,709 @@
+"""Broad per-op numeric contracts vs NumPy/SciPy — the families not yet
+covered by the focused operator test files (mirrors reference
+``tests/python/unittest/test_operator.py``'s breadth)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+RNG = np.random.RandomState(7)
+
+
+def A(*shape, scale=1.0, offset=0.0):
+    return (RNG.randn(*shape) * scale + offset).astype("float32")
+
+
+def close(got, want, rtol=1e-5, atol=1e-5):
+    np.testing.assert_allclose(
+        got.asnumpy() if hasattr(got, "asnumpy") else got,
+        want, rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# unary math zoo
+# ---------------------------------------------------------------------------
+UNARY = [
+    ("arcsin", np.arcsin, A(3, 4, scale=0.4)),
+    ("arccos", np.arccos, A(3, 4, scale=0.4)),
+    ("arctan", np.arctan, A(3, 4)),
+    ("arcsinh", np.arcsinh, A(3, 4)),
+    ("arccosh", np.arccosh, A(3, 4, scale=0.3, offset=2.0)),
+    ("arctanh", np.arctanh, A(3, 4, scale=0.4)),
+    ("sinh", np.sinh, A(3, 4)),
+    ("cosh", np.cosh, A(3, 4)),
+    ("log2", np.log2, np.abs(A(3, 4)) + 0.1),
+    ("log10", np.log10, np.abs(A(3, 4)) + 0.1),
+    ("cbrt", np.cbrt, A(3, 4)),
+    ("rcbrt", lambda x: 1.0 / np.cbrt(x), np.abs(A(3, 4)) + 0.2),
+    ("degrees", np.degrees, A(3, 4)),
+    ("radians", np.radians, A(3, 4)),
+    ("logical_not", lambda x: (x == 0).astype(np.float32),
+     np.array([[0., 1., 2.], [-1., 0., 3.]], np.float32)),
+    ("softsign", lambda x: x / (1 + np.abs(x)), A(3, 4)),
+    ("ones_like", np.ones_like, A(3, 4)),
+]
+
+
+@pytest.mark.parametrize("name,ref,x", UNARY, ids=[u[0] for u in UNARY])
+def test_unary_math(name, ref, x):
+    close(getattr(nd, name)(nd.array(x)), ref(x), rtol=1e-4, atol=1e-5)
+
+
+def test_erf_erfinv_gammaln():
+    from scipy import special
+    x = A(3, 4, scale=0.8)
+    close(nd.erf(nd.array(x)), special.erf(x), rtol=1e-4)
+    y = A(3, 4, scale=0.4)
+    close(nd.erfinv(nd.array(y)), special.erfinv(y), rtol=1e-3, atol=1e-4)
+    z = np.abs(A(3, 4)) + 0.5
+    close(nd.gammaln(nd.array(z)), special.gammaln(z), rtol=1e-4, atol=1e-4)
+
+
+def test_softplus_softmin_hard_sigmoid():
+    x = A(3, 4)
+    close(nd.softplus(nd.array(x)), np.log1p(np.exp(x)), rtol=1e-4)
+    e = np.exp(-x - (-x).max(axis=-1, keepdims=True))
+    close(nd.softmin(nd.array(x), axis=-1), e / e.sum(-1, keepdims=True),
+          rtol=1e-4)
+    close(nd.hard_sigmoid(nd.array(x)),
+          np.clip(0.2 * x + 0.5, 0, 1), rtol=1e-5)
+
+
+def test_smooth_l1():
+    x = np.array([-2.0, -0.4, 0.0, 0.4, 2.0], np.float32)
+    s = 1.0
+    want = np.where(np.abs(x) < 1, 0.5 * x * x, np.abs(x) - 0.5)
+    close(nd.smooth_l1(nd.array(x), scalar=s), want)
+
+
+# ---------------------------------------------------------------------------
+# broadcast binary family
+# ---------------------------------------------------------------------------
+BCAST = [
+    ("broadcast_plus", np.add), ("broadcast_minus", np.subtract),
+    ("broadcast_sub", np.subtract), ("broadcast_div", np.divide),
+    ("broadcast_mod", np.mod), ("broadcast_minimum", np.minimum),
+    ("broadcast_hypot", np.hypot),
+    ("broadcast_equal", lambda a, b: (a == b).astype(np.float32)),
+    ("broadcast_not_equal", lambda a, b: (a != b).astype(np.float32)),
+    ("broadcast_greater", lambda a, b: (a > b).astype(np.float32)),
+    ("broadcast_greater_equal", lambda a, b: (a >= b).astype(np.float32)),
+    ("broadcast_lesser", lambda a, b: (a < b).astype(np.float32)),
+    ("broadcast_lesser_equal", lambda a, b: (a <= b).astype(np.float32)),
+    ("broadcast_logical_and",
+     lambda a, b: ((a != 0) & (b != 0)).astype(np.float32)),
+    ("broadcast_logical_or",
+     lambda a, b: ((a != 0) | (b != 0)).astype(np.float32)),
+    ("broadcast_logical_xor",
+     lambda a, b: ((a != 0) ^ (b != 0)).astype(np.float32)),
+]
+
+
+@pytest.mark.parametrize("name,ref", BCAST, ids=[b[0] for b in BCAST])
+def test_broadcast_binary(name, ref):
+    a = np.round(A(2, 3, 4) * 2) + 3.0
+    b = np.round(A(1, 3, 1) * 2) + 2.0
+    close(getattr(nd, name)(nd.array(a), nd.array(b)), ref(a, b), rtol=1e-5)
+
+
+def test_elemwise_family_and_minimum():
+    a, b = A(3, 4), A(3, 4)
+    close(nd.elemwise_add(nd.array(a), nd.array(b)), a + b)
+    close(nd.elemwise_sub(nd.array(a), nd.array(b)), a - b)
+    close(nd.elemwise_mul(nd.array(a), nd.array(b)), a * b)
+    close(nd._minimum(nd.array(a), nd.array(b)), np.minimum(a, b))
+    close(nd._hypot(nd.array(a), nd.array(b)), np.hypot(a, b))
+    close(nd._logical_or(nd.array(a), nd.array(b)),
+          ((a != 0) | (b != 0)).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# scalar-op family (incl. reversed variants)
+# ---------------------------------------------------------------------------
+SCALAR = [
+    ("_minus_scalar", lambda x, s: x - s),
+    ("_rminus_scalar", lambda x, s: s - x),
+    ("_mul_scalar", lambda x, s: x * s),
+    ("_div_scalar", lambda x, s: x / s),
+    ("_rdiv_scalar", lambda x, s: s / x),
+    ("_mod_scalar", lambda x, s: np.mod(x, s)),
+    ("_rmod_scalar", lambda x, s: np.mod(s, x)),
+    ("_power_scalar", lambda x, s: np.power(x, s)),
+    ("_rpower_scalar", lambda x, s: np.power(s, x)),
+    ("_maximum_scalar", lambda x, s: np.maximum(x, s)),
+    ("_minimum_scalar", lambda x, s: np.minimum(x, s)),
+    ("_hypot_scalar", lambda x, s: np.hypot(x, s)),
+    ("_equal_scalar", lambda x, s: (x == s).astype(np.float32)),
+    ("_not_equal_scalar", lambda x, s: (x != s).astype(np.float32)),
+    ("_greater_scalar", lambda x, s: (x > s).astype(np.float32)),
+    ("_greater_equal_scalar", lambda x, s: (x >= s).astype(np.float32)),
+    ("_lesser_scalar", lambda x, s: (x < s).astype(np.float32)),
+    ("_lesser_equal_scalar", lambda x, s: (x <= s).astype(np.float32)),
+    ("_logical_and_scalar", lambda x, s: ((x != 0) & (s != 0)).astype(np.float32)),
+    ("_logical_or_scalar", lambda x, s: ((x != 0) | (s != 0)).astype(np.float32)),
+    ("_logical_xor_scalar", lambda x, s: ((x != 0) ^ (s != 0)).astype(np.float32)),
+]
+
+
+@pytest.mark.parametrize("name,ref", SCALAR, ids=[s[0] for s in SCALAR])
+def test_scalar_ops(name, ref):
+    x = np.round(A(3, 4) * 2) + 2.5
+    close(getattr(nd, name)(nd.array(x), scalar=2.0), ref(x, 2.0), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# linalg suite (reference src/operator/tensor/la_op.cc)
+# ---------------------------------------------------------------------------
+def _spd(n):
+    m = A(n, n) * 0.5
+    return (m @ m.T + n * np.eye(n)).astype("float32")
+
+
+def test_linalg_gemm_gemm2():
+    a, b, c = A(2, 3, 4), A(2, 4, 5), A(2, 3, 5)
+    close(nd.linalg.gemm(nd.array(a), nd.array(b), nd.array(c),
+                         alpha=2.0, beta=3.0),
+          2.0 * a @ b + 3.0 * c, rtol=1e-4)
+    close(nd.linalg.gemm2(nd.array(a), nd.array(b), alpha=0.5),
+          0.5 * a @ b, rtol=1e-4)
+    # transpose flags
+    bt = A(2, 5, 4)
+    close(nd.linalg.gemm2(nd.array(a), nd.array(bt), transpose_b=True),
+          np.matmul(a, np.swapaxes(bt, 1, 2)), rtol=1e-4)
+    at = A(2, 4, 3)
+    close(nd.linalg.gemm2(nd.array(at), nd.array(b), transpose_a=True),
+          np.matmul(np.swapaxes(at, 1, 2), b), rtol=1e-4)
+
+
+def test_linalg_potrf_potri_sumlogdiag():
+    s = _spd(4)
+    L = np.linalg.cholesky(s)
+    close(nd.linalg.potrf(nd.array(s)), L, rtol=1e-4, atol=1e-4)
+    close(nd.linalg.potri(nd.array(L)), np.linalg.inv(s), rtol=1e-3, atol=1e-3)
+    close(nd.linalg.sumlogdiag(nd.array(L)),
+          np.log(np.diag(L)).sum(), rtol=1e-4)
+
+
+def test_linalg_trmm_trsm():
+    Lw = np.tril(A(4, 4)) + 4 * np.eye(4, dtype=np.float32)
+    b = A(4, 3)
+    close(nd.linalg.trmm(nd.array(Lw), nd.array(b), alpha=1.0),
+          Lw @ b, rtol=1e-4, atol=1e-4)
+    close(nd.linalg.trsm(nd.array(Lw), nd.array(Lw @ b), alpha=1.0),
+          b, rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_syrk_det_slogdet_inverse():
+    a = A(3, 4)
+    close(nd.linalg.syrk(nd.array(a), alpha=1.0), a @ a.T, rtol=1e-4)
+    s = _spd(3)
+    close(nd.linalg.det(nd.array(s)), np.linalg.det(s), rtol=1e-3)
+    sign, logdet = np.linalg.slogdet(s)
+    got = nd.linalg.slogdet(nd.array(s))
+    close(got[0], sign, rtol=1e-4)
+    close(got[1], logdet, rtol=1e-4)
+    close(nd.linalg.inverse(nd.array(s)), np.linalg.inv(s), rtol=1e-3,
+          atol=1e-4)
+
+
+def test_linalg_gelqf_syevd():
+    a = A(3, 5)
+    q, l = nd.linalg.gelqf(nd.array(a))     # reference order: Q first
+    qn, ln = q.asnumpy(), l.asnumpy()
+    assert qn.shape == (3, 5) and ln.shape == (3, 3)
+    close(ln @ qn, a, rtol=1e-3, atol=1e-4)             # A = L Q
+    close(qn @ qn.T, np.eye(3), rtol=1e-3, atol=1e-4)   # Q orthonormal rows
+    assert np.all(np.triu(ln, 1) == 0)                  # L lower-triangular
+    s = _spd(4)
+    u, lam = nd.linalg.syevd(nd.array(s))
+    un, lamn = u.asnumpy(), lam.asnumpy()
+    # rows of U are eigenvectors: U^T diag(lam) U == S  (reference layout)
+    close(un.T @ np.diag(lamn) @ un, s, rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_diag_trian_helpers():
+    d = np.array([1.0, 2.0, 3.0], np.float32)
+    close(nd.linalg.makediag(nd.array(d)), np.diag(d))
+    m = A(4, 4)
+    close(nd.linalg.extractdiag(nd.array(m)), np.diag(m))
+    # maketrian/extracttrian round-trip on the lower triangle
+    tri = nd.linalg.extracttrian(nd.array(m))
+    back = nd.linalg.maketrian(tri)
+    close(back, np.tril(m), rtol=1e-5)
+
+
+def test_khatri_rao():
+    a, b = A(2, 3), A(4, 3)
+    want = np.stack([np.kron(a[:, i], b[:, i]) for i in range(3)], axis=1)
+    close(nd.khatri_rao(nd.array(a), nd.array(b)), want, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# matrix utilities
+# ---------------------------------------------------------------------------
+def test_reverse_depth_space_reshape_like():
+    x = A(2, 3, 4)
+    close(nd.reverse(nd.array(x), axis=1), x[:, ::-1, :])
+    d = A(1, 8, 2, 3)
+    got = nd.depth_to_space(nd.array(d), block_size=2)
+    assert got.shape == (1, 2, 4, 6)
+    back = nd.space_to_depth(got, block_size=2)
+    close(back, d, rtol=1e-6)
+    r = A(2, 6)
+    close(nd.reshape_like(nd.array(r), nd.array(A(3, 4))), r.reshape(3, 4))
+
+
+def test_ravel_unravel_roundtrip():
+    shape = (3, 4, 5)
+    flat = np.array([0, 7, 23, 59], np.float32)
+    multi = nd.unravel_index(nd.array(flat), shape=shape)
+    want = np.stack(np.unravel_index(flat.astype(int), shape)).astype(np.float32)
+    close(multi, want)
+    back = nd.ravel_multi_index(multi, shape=shape)
+    close(back, flat)
+
+
+def test_nansum_nanprod_sum_axis_broadcast_axis():
+    x = A(3, 4)
+    x[0, 0] = np.nan
+    close(nd.nansum(nd.array(x), axis=1), np.nansum(x, axis=1), rtol=1e-5)
+    close(nd.nanprod(nd.array(x), axis=1), np.nanprod(x, axis=1), rtol=1e-4)
+    y = A(2, 5)
+    close(nd.sum_axis(nd.array(y), axis=0), y.sum(0), rtol=1e-5)
+    z = A(1, 3, 1)
+    close(nd.broadcast_axis(nd.array(z), axis=(0, 2), size=(2, 4)),
+          np.broadcast_to(z, (2, 3, 4)))
+
+
+def test_argmax_channel_cast_storage_im2col():
+    x = A(4, 6)
+    close(nd.argmax_channel(nd.array(x)), x.argmax(1).astype(np.float32))
+    c = nd.cast_storage(nd.array(x), stype="csr")
+    assert c.stype == "csr"
+    close(nd.cast_storage(c, stype="default"), x)
+    # im2col: 1x1 kernel is an identity reshape
+    img = A(2, 3, 4, 4)
+    col = nd.im2col(nd.array(img), kernel=(1, 1))
+    close(col, img.reshape(2, 3, 16), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# output heads / losses
+# ---------------------------------------------------------------------------
+def test_regression_outputs_forward_and_grad():
+    x, lbl = A(4, 3), A(4, 3)
+    close(nd.LinearRegressionOutput(nd.array(x), nd.array(lbl)), x)
+    close(nd.MAERegressionOutput(nd.array(x), nd.array(lbl)), x)
+    close(nd.LogisticRegressionOutput(nd.array(x), nd.array(lbl)),
+          1 / (1 + np.exp(-x)), rtol=1e-5)
+    # symbolic grad semantics: d(loss)/dx = (pred - label) / batch-ish scale
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    out = mx.sym.LinearRegressionOutput(data, label)
+    ex = out.simple_bind(ctx=mx.cpu(), data=x.shape, label=lbl.shape,
+                         grad_req="write")
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["label"][:] = lbl
+    ex.forward(is_train=True)
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    # reference scale: grad_scale / num_output (features per sample)
+    np.testing.assert_allclose(g, (x - lbl) / x.shape[1], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_svm_output_and_softmax_activation():
+    x = A(4, 5)
+    close(nd.SVMOutput(nd.array(x), nd.array(np.zeros(4, np.float32))), x)
+    sa = nd.SoftmaxActivation(nd.array(x))
+    e = np.exp(x - x.max(1, keepdims=True))
+    close(sa, e / e.sum(1, keepdims=True), rtol=1e-5)
+
+
+def test_pad_constant_and_edge():
+    x = A(1, 1, 3, 3)
+    got = nd.Pad(nd.array(x), mode="constant", constant_value=9.0,
+                 pad_width=(0, 0, 0, 0, 1, 1, 2, 2))
+    want = np.pad(x, ((0, 0), (0, 0), (1, 1), (2, 2)), mode="constant",
+                  constant_values=9.0)
+    close(got, want)
+    got_e = nd.Pad(nd.array(x), mode="edge",
+                   pad_width=(0, 0, 0, 0, 1, 1, 1, 1))
+    close(got_e, np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)), mode="edge"))
+
+
+def test_instance_norm_matches_numpy():
+    x = A(2, 3, 4, 5)
+    g, b = A(3, scale=0.5, offset=1.0), A(3, scale=0.2)
+    got = nd.InstanceNorm(nd.array(x), nd.array(g), nd.array(b), eps=1e-5)
+    mean = x.mean(axis=(2, 3), keepdims=True)
+    var = x.var(axis=(2, 3), keepdims=True)
+    want = (x - mean) / np.sqrt(var + 1e-5) * g.reshape(1, 3, 1, 1) + \
+        b.reshape(1, 3, 1, 1)
+    close(got, want, rtol=1e-4, atol=1e-5)
+
+
+def _ctc_ref_single(logp, labels, blank):
+    """Log-domain CTC forward algorithm for one sequence (T, C)."""
+    ext = [blank]
+    for l in labels:
+        ext += [l, blank]
+    S = len(ext)
+    NEG = -1e30
+    alpha = np.full(S, NEG)
+    alpha[0] = logp[0, ext[0]]
+    if S > 1:
+        alpha[1] = logp[0, ext[1]]
+    for t in range(1, logp.shape[0]):
+        new = np.full(S, NEG)
+        for s in range(S):
+            best = alpha[s]
+            if s >= 1:
+                best = np.logaddexp(best, alpha[s - 1])
+            if s >= 2 and ext[s] != blank and ext[s] != ext[s - 2]:
+                best = np.logaddexp(best, alpha[s - 2])
+            new[s] = best + logp[t, ext[s]]
+        alpha = new
+    tail = alpha[-1]
+    if S > 1:
+        tail = np.logaddexp(alpha[-1], alpha[-2])
+    return -tail
+
+
+def test_ctc_loss_matches_forward_algorithm():
+    T, B, C = 6, 2, 5
+    x = A(T, B, C)
+    labels = np.array([[1, 2, 0, 0], [3, 3, 4, 0]], np.float32)  # 0 padding
+    got = nd.CTCLoss(nd.array(x), nd.array(labels)).asnumpy()
+    logp = x - np.log(np.exp(x - x.max(-1, keepdims=True))
+                      .sum(-1, keepdims=True)) - x.max(-1, keepdims=True)
+    for b in range(B):
+        lab = [int(v) for v in labels[b] if v != 0]
+        want = _ctc_ref_single(logp[:, b], lab, blank=0)
+        np.testing.assert_allclose(got[b], want, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# optimizer update kernels (one-step numeric checks)
+# ---------------------------------------------------------------------------
+def test_signsgd_signum_updates():
+    w, g = A(5), A(5)
+    out = nd.signsgd_update(nd.array(w), nd.array(g), lr=0.1)
+    close(out, w - 0.1 * np.sign(g), rtol=1e-6)
+    mom = np.zeros(5, np.float32)
+    m_nd = nd.array(mom)
+    out2 = nd.signum_update(nd.array(w), nd.array(g), m_nd, lr=0.1,
+                            momentum=0.9)
+    new_mom = 0.9 * mom - (1 - 0.9) * g
+    close(out2, w + 0.1 * np.sign(new_mom), rtol=1e-5)
+
+
+def test_rmsprop_updates():
+    w, g = A(5), A(5)
+    n = np.zeros(5, np.float32)
+    n_nd = nd.array(n)
+    out = nd.rmsprop_update(nd.array(w), nd.array(g), n_nd, lr=0.1,
+                            gamma1=0.9, epsilon=1e-8)
+    n2 = 0.9 * n + 0.1 * g * g
+    close(out, w - 0.1 * g / (np.sqrt(n2) + 1e-8), rtol=1e-4)
+
+
+def test_nag_and_ftrl_and_ftml_run_and_move_weights():
+    w, g = A(5), A(5)
+    mom = nd.array(np.zeros(5, np.float32))
+    out = nd.nag_mom_update(nd.array(w), nd.array(g), mom, lr=0.1,
+                            momentum=0.9)
+    assert np.abs(out.asnumpy() - w).sum() > 0
+    z = nd.array(np.zeros(5, np.float32))
+    n = nd.array(np.zeros(5, np.float32))
+    out2 = nd.ftrl_update(nd.array(w), nd.array(g), z, n, lr=0.1)
+    assert np.isfinite(out2.asnumpy()).all()
+    d = nd.array(np.zeros(5, np.float32))
+    v = nd.array(np.zeros(5, np.float32))
+    zf = nd.array(np.zeros(5, np.float32))
+    out3 = nd.ftml_update(nd.array(w), nd.array(g), d, v, zf, lr=0.1, t=1)
+    assert np.isfinite(out3.asnumpy()).all()
+
+
+def test_multi_and_mp_sgd_updates():
+    w1, g1 = A(4), A(4)
+    w2, g2 = A(3), A(3)
+    outs = nd.multi_sgd_update(nd.array(w1), nd.array(g1),
+                               nd.array(w2), nd.array(g2),
+                               lrs=(0.1, 0.2), wds=(0.0, 0.0),
+                               num_weights=2)
+    close(outs[0], w1 - 0.1 * g1, rtol=1e-5)
+    close(outs[1], w2 - 0.2 * g2, rtol=1e-5)
+    w32 = nd.array(w1)  # fp32 master copy
+    out_mp = nd.mp_sgd_update(nd.array(w1.astype(np.float16)),
+                              nd.array(g1.astype(np.float16)), w32, lr=0.1)
+    assert out_mp.dtype == np.float16
+    close(out_mp.asnumpy().astype(np.float32), w1 - 0.1 * g1,
+          rtol=1e-2, atol=1e-2)
+
+
+def test_all_finite_ops():
+    ok = nd.all_finite(nd.array(A(4)))
+    assert ok.asnumpy().item() == 1
+    bad = nd.array(np.array([1.0, np.inf], np.float32))
+    assert nd.all_finite(bad).asnumpy().item() == 0
+    outs = nd.multi_all_finite(nd.array(A(3)), bad, num_arrays=2)
+    assert outs.asnumpy().item() == 0
+
+
+def test_adamw_updates():
+    w, g = A(5), A(5)
+    m = nd.array(np.zeros(5, np.float32))
+    v = nd.array(np.zeros(5, np.float32))
+    out = nd.adamw_update(nd.array(w), nd.array(g), m, v, lr=0.1, eta=1.0,
+                          wd=0.01)
+    m2 = 0.1 * g
+    v2 = 0.001 * g * g
+    # reference adamw-inl.h:137: w -= eta*(lr*m/(sqrt(v)+eps) + wd*w)
+    want = w - 1.0 * (0.1 * m2 / (np.sqrt(v2) + 1e-8) + 0.01 * w)
+    close(out, want, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# random distribution moments
+# ---------------------------------------------------------------------------
+def test_random_distribution_moments():
+    mx.random.seed(11)
+    n = 20000
+    u = nd.random.uniform(low=2.0, high=4.0, shape=(n,)).asnumpy()
+    assert abs(u.mean() - 3.0) < 0.03 and u.min() >= 2.0 and u.max() <= 4.0
+    g = nd.random.normal(loc=1.0, scale=2.0, shape=(n,)).asnumpy()
+    assert abs(g.mean() - 1.0) < 0.06 and abs(g.std() - 2.0) < 0.06
+    e = nd.random.exponential(lam=4.0, shape=(n,)).asnumpy()
+    assert abs(e.mean() - 0.25) < 0.02
+    p = nd.random.poisson(lam=3.0, shape=(n,)).asnumpy()
+    assert abs(p.mean() - 3.0) < 0.08
+    ga = nd.random.gamma(alpha=2.0, beta=3.0, shape=(n,)).asnumpy()
+    assert abs(ga.mean() - 6.0) < 0.2
+    nb = nd.random.negative_binomial(k=4, p=0.5, shape=(n,)).asnumpy()
+    assert abs(nb.mean() - 4.0) < 0.2            # k(1-p)/p
+    gnb = nd.random.generalized_negative_binomial(
+        mu=2.0, alpha=0.5, shape=(n,)).asnumpy()
+    assert abs(gnb.mean() - 2.0) < 0.15
+    ri = nd.random.randint(low=0, high=10, shape=(n,)).asnumpy()
+    assert ri.min() >= 0 and ri.max() <= 9 and abs(ri.mean() - 4.5) < 0.15
+
+
+def test_random_seed_determinism():
+    mx.random.seed(5)
+    a = nd.random.uniform(shape=(8,)).asnumpy()
+    mx.random.seed(5)
+    b = nd.random.uniform(shape=(8,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# image op family (CHW-aware, deterministic subset exact; random subset smoke)
+# ---------------------------------------------------------------------------
+def _img(h=8, w=10, c=3):
+    return (RNG.rand(h, w, c) * 255).astype(np.uint8)
+
+
+def test_image_to_tensor_normalize():
+    im = _img()
+    t = nd.image.to_tensor(nd.array(im))
+    close(t, im.transpose(2, 0, 1).astype(np.float32) / 255.0, rtol=1e-6)
+    normed = nd.image.normalize(t, mean=(0.5, 0.5, 0.5), std=(0.2, 0.2, 0.2))
+    close(normed, (im.transpose(2, 0, 1) / 255.0 - 0.5) / 0.2, rtol=1e-4,
+          atol=1e-5)
+
+
+def test_image_flips_and_crop():
+    im = _img().astype(np.float32)
+    close(nd.image.flip_left_right(nd.array(im)), im[:, ::-1, :])
+    close(nd.image.flip_top_bottom(nd.array(im)), im[::-1, :, :])
+    got = nd.image.crop(nd.array(im), x=2, y=1, width=4, height=3)
+    close(got, im[1:4, 2:6, :])
+
+
+def test_image_resize_shape_and_range():
+    im = _img(8, 8)
+    out = nd.image.resize(nd.array(im), size=(4, 4))
+    assert out.shape[:2] == (4, 4)
+    out2 = nd.image.resize(nd.array(im), size=(16, 12))  # (w, h) convention
+    assert out2.shape[:2] == (12, 16)
+
+
+def test_image_random_ops_smoke_and_deterministic_seed():
+    im = nd.array(_img().astype(np.float32))
+    mx.random.seed(3)
+    a = nd.image.random_brightness(im, 0.3).asnumpy()
+    mx.random.seed(3)
+    b = nd.image.random_brightness(im, 0.3).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    for fn, args in [(nd.image.random_contrast, (0.3,)),
+                     (nd.image.random_saturation, (0.3,)),
+                     (nd.image.random_hue, (0.2,)),
+                     (nd.image.random_lighting, (0.1,)),
+                     (nd.image.random_color_jitter, (0.2, 0.2, 0.2, 0.1)),
+                     (nd.image.random_flip_left_right, ()),
+                     (nd.image.random_flip_top_bottom, ())]:
+        out = fn(im, *args)
+        assert out.shape == im.shape
+        assert np.isfinite(out.asnumpy()).all()
+
+
+# ---------------------------------------------------------------------------
+# contrib utilities
+# ---------------------------------------------------------------------------
+def test_box_iou_and_nms():
+    boxes = np.array([[0, 0, 2, 2], [1, 1, 3, 3], [10, 10, 12, 12]],
+                     np.float32)
+    iou = nd.contrib.box_iou(nd.array(boxes), nd.array(boxes)).asnumpy()
+    np.testing.assert_allclose(np.diag(iou), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(iou[0, 1], 1.0 / 7.0, rtol=1e-4)
+    assert iou[0, 2] == 0
+    dets = np.array([[0, 0.9, 0, 0, 2, 2],
+                     [0, 0.8, 1, 1, 3, 3],
+                     [1, 0.7, 10, 10, 12, 12]], np.float32)
+    out = nd.contrib.box_nms(nd.array(dets), overlap_thresh=0.1).asnumpy()
+    kept = out[out[:, 0] >= 0]
+    assert len(kept) == 2  # second box suppressed by first
+
+def test_bipartite_matching():
+    score = np.array([[0.9, 0.1], [0.8, 0.7]], np.float32)
+    rows, cols = nd.contrib.bipartite_matching(nd.array(score),
+                                               threshold=0.05)
+    r = rows.asnumpy()
+    # greedy: (0,0) first (0.9), then (1,1) (0.7)
+    assert r[0] == 0 and r[1] == 1
+
+
+def test_boolean_mask_index_ops():
+    x = A(5, 3)
+    m = np.array([1, 0, 1, 0, 1], np.float32)
+    got = nd.contrib.boolean_mask(nd.array(x), nd.array(m))
+    close(got, x[m.astype(bool)])
+    idx = nd.contrib.index_array(nd.array(A(2, 3)))
+    want = np.stack(np.meshgrid(np.arange(2), np.arange(3),
+                                indexing="ij"), -1)
+    np.testing.assert_array_equal(idx.asnumpy(), want)
+    old = A(4, 3)
+    new = A(2, 3)
+    out = nd.contrib.index_copy(nd.array(old),
+                                nd.array(np.array([1, 3], np.float32)),
+                                nd.array(new))
+    want = old.copy(); want[[1, 3]] = new
+    close(out, want)
+
+
+def test_arange_like_and_div_sqrt_dim():
+    x = A(3, 4)
+    al = nd.contrib.arange_like(nd.array(x), axis=1)
+    np.testing.assert_array_equal(al.asnumpy(), np.arange(4, dtype=np.float32))
+    close(nd.contrib.div_sqrt_dim(nd.array(x)), x / 2.0, rtol=1e-5)
+
+
+def test_getnnz_quadratic_grad():
+    from mxnet_tpu.ndarray.sparse import csr_matrix
+    c = csr_matrix(np.array([[0, 1.0, 0], [2.0, 0, 3.0]], np.float32))
+    assert int(nd.contrib.getnnz(c).asnumpy()[()]) == 3
+    x = nd.array(A(4)); x.attach_grad()
+    with mx.autograd.record():
+        y = nd.contrib.quadratic(x, a=2.0, b=3.0, c=1.0)
+    y.backward()
+    close(y, 2 * x.asnumpy() ** 2 + 3 * x.asnumpy() + 1, rtol=1e-5)
+    close(x.grad, 4 * x.asnumpy() + 3, rtol=1e-5)
+
+
+def test_adaptive_avg_pool_and_bilinear_resize():
+    x = A(1, 2, 4, 4)
+    out = nd.contrib.AdaptiveAvgPooling2D(nd.array(x), output_size=2)
+    want = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+    close(out, want, rtol=1e-5)
+    rz = nd.contrib.BilinearResize2D(nd.array(x), height=8, width=8)
+    assert rz.shape == (1, 2, 8, 8)
+    # corners preserved under align_corners-style bilinear
+    close(rz.asnumpy()[..., 0, 0], x[..., 0, 0], rtol=1e-5)
+
+
+def test_roi_align_simple():
+    # constant feature map -> pooled output equals the constant
+    x = np.full((1, 1, 8, 8), 3.0, np.float32)
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = nd.contrib.ROIAlign(nd.array(x), nd.array(rois),
+                              pooled_size=(2, 2), spatial_scale=1.0)
+    close(out, np.full((1, 1, 2, 2), 3.0), rtol=1e-5)
+
+
+def test_multibox_prior_properties():
+    x = nd.array(A(1, 3, 4, 4))
+    anchors = nd.contrib.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2))
+    a = anchors.asnumpy()[0]
+    assert a.shape == (4 * 4 * 3, 4)
+    # centers lie on the pixel grid (i+0.5)/4
+    cx = (a[:, 0] + a[:, 2]) / 2
+    assert np.allclose(sorted(set(np.round(cx, 4))),
+                       [0.125, 0.375, 0.625, 0.875], atol=1e-3)
+
+
+def test_fft_ifft_roundtrip_and_count_sketch():
+    x = A(2, 8)
+    f = nd.contrib.fft(nd.array(x))
+    assert f.shape == (2, 16)
+    back = nd.contrib.ifft(f) / 8.0
+    close(back, x, rtol=1e-4, atol=1e-4)
+    h = nd.array(np.array([0, 1, 0, 1], np.float32))
+    s = nd.array(np.array([1, -1, 1, 1], np.float32))
+    cs = nd.contrib.count_sketch(nd.array(A(2, 4)), h, s, out_dim=2)
+    assert cs.shape == (2, 2)
+
+
+def test_sparse_embedding_matches_embedding():
+    w = A(10, 4)
+    idx = np.array([1, 3, 7], np.float32)
+    a = nd.contrib.SparseEmbedding(nd.array(idx), nd.array(w), input_dim=10,
+                                   output_dim=4)
+    close(a, w[idx.astype(int)])
+
+
+# ---------------------------------------------------------------------------
+# quantization round-trip
+# ---------------------------------------------------------------------------
+def test_quantize_dequantize_roundtrip():
+    x = A(4, 5)
+    lo = nd.array(np.array([float(x.min())], np.float32))
+    hi = nd.array(np.array([float(x.max())], np.float32))
+    q, qmin, qmax = nd.contrib.quantize(nd.array(x), lo, hi, out_type="int8")
+    assert q.dtype == np.int8
+    back = nd.contrib.dequantize(q, qmin, qmax, out_type="float32")
+    close(back, x, rtol=0.1, atol=0.1)
+
+
+def test_quantized_fully_connected_close_to_float():
+    x = np.clip(A(3, 6), -2, 2)
+    w = np.clip(A(4, 6), -2, 2)
+    ref = x @ w.T
+    lo = lambda a: nd.array(np.array([float(a.min())], np.float32))
+    hi = lambda a: nd.array(np.array([float(a.max())], np.float32))
+    qx, xmin, xmax = nd.contrib.quantize_v2(nd.array(x), min_calib_range=float(x.min()),
+                                            max_calib_range=float(x.max()))
+    qw, wmin, wmax = nd.contrib.quantize_v2(nd.array(w), min_calib_range=float(w.min()),
+                                            max_calib_range=float(w.max()))
+    out, omin, omax = nd.contrib.quantized_fully_connected(
+        qx, qw, xmin, xmax, wmin, wmax, num_hidden=4, no_bias=True)
+    deq = nd.contrib.dequantize(out.astype(np.int8) * 0 + out, omin, omax,
+                                out_type="float32") \
+        if out.dtype == np.int8 else out
+    got = nd.contrib.dequantize(out, omin, omax, out_type="float32").asnumpy() \
+        if out.dtype == np.int8 else out.asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=0.15, atol=0.25)
+
+
+def test_group_and_sparse_adagrad_updates():
+    w, g = A(4, 3), A(4, 3)
+    hist = nd.array(np.zeros((4,), np.float32))
+    out = nd.contrib.group_adagrad_update(nd.array(w), nd.array(g), hist,
+                                          lr=0.1)
+    h2 = (g * g).mean(axis=1)
+    want = w - 0.1 * g / np.sqrt(h2 + 1e-5)[:, None]
+    close(out, want, rtol=1e-3, atol=1e-4)
+    hist2 = nd.array(np.zeros((4, 3), np.float32))
+    out2 = nd._sparse_adagrad_update(nd.array(w), nd.array(g), hist2, lr=0.1)
+    assert np.isfinite(out2.asnumpy()).all()
