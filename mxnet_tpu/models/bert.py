@@ -56,18 +56,23 @@ class MultiHeadAttention(HybridBlock):
             import warnings
             warnings.warn(
                 "sequence-parallel scope active but attention falls back to "
-                "the dense T×T path: ring attention supports neither a "
-                "valid-length mask nor attention-prob dropout yet. Long "
-                "sequences will materialize full score matrices.")
+                "the dense T×T path: the sharded attention impls (ring/"
+                "ulysses) support neither a valid-length mask nor "
+                "attention-prob dropout yet. Long sequences will "
+                "materialize full score matrices.")
         ctx = None
         if blockwise_ok and sp is not None:
             # sequence-parallel path: T stays sharded over the sp axis;
-            # K/V ring around it (parallel/ring_attention.py)
+            # K/V ring around it (parallel/ring_attention.py) or heads are
+            # all_to_all-sharded (parallel/ulysses.py), per the scope's impl
             from ..ndarray import invoke_fn
             from ..parallel.ring_attention import ring_self_attention
-            mesh, sp_axis, dp_axis = sp
+            from ..parallel.ulysses import ulysses_self_attention
+            mesh, sp_axis, dp_axis, impl = sp
+            attn = ulysses_self_attention if impl == "ulysses" \
+                else ring_self_attention
             ctx = invoke_fn(
-                lambda qq, kk, vv: ring_self_attention(
+                lambda qq, kk, vv: attn(
                     qq, kk, vv, mesh, sp_axis=sp_axis, dp_axis=dp_axis,
                     scale=1.0),
                 [q, k, v])

@@ -20,6 +20,7 @@ from .sharding import (  # noqa: F401
 )
 from .optim import FunctionalOptimizer  # noqa: F401
 from .trainer import SPMDTrainer, make_train_step  # noqa: F401
+from .ulysses import ulysses_attention, ulysses_self_attention  # noqa: F401
 from .ring_attention import (  # noqa: F401
     ring_attention, ring_self_attention, blockwise_attention_reference,
 )

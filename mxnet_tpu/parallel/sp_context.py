@@ -18,8 +18,12 @@ _SCOPE = []
 
 
 @contextlib.contextmanager
-def sequence_parallel_scope(mesh, sp_axis="sp", dp_axis="dp"):
-    _SCOPE.append((mesh, sp_axis, dp_axis))
+def sequence_parallel_scope(mesh, sp_axis="sp", dp_axis="dp", impl="ring"):
+    """``impl``: "ring" (K/V rotate over ICI, any head count) or "ulysses"
+    (all_to_all head sharding — needs heads divisible by the sp size)."""
+    if impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sequence-parallel impl {impl!r}")
+    _SCOPE.append((mesh, sp_axis, dp_axis, impl))
     try:
         yield
     finally:
@@ -27,10 +31,10 @@ def sequence_parallel_scope(mesh, sp_axis="sp", dp_axis="dp"):
 
 
 def current_sequence_parallel():
-    """(mesh, sp_axis, dp_axis) when inside a scope with sp size > 1."""
+    """(mesh, sp_axis, dp_axis, impl) when inside a scope with sp size > 1."""
     if not _SCOPE:
         return None
-    mesh, sp_axis, dp_axis = _SCOPE[-1]
+    mesh, sp_axis, dp_axis, impl = _SCOPE[-1]
     if mesh.shape.get(sp_axis, 1) <= 1:
         return None
-    return mesh, sp_axis, dp_axis
+    return mesh, sp_axis, dp_axis, impl

@@ -144,14 +144,16 @@ class SPMDTrainer:
     """
 
     def __init__(self, net, loss_fn, optimizer, mesh,
-                 sequence_parallel=False, sp_axis="sp", dp_axis="dp", **kw):
+                 sequence_parallel=False, sp_axis="sp", dp_axis="dp",
+                 sp_impl="ring", **kw):
         self._net = net
         self._mesh = mesh
         if sequence_parallel and mesh.shape.get(sp_axis, 1) <= 1:
             raise ValueError(
                 f"sequence_parallel=True requires mesh axis {sp_axis!r} with "
                 f"size > 1; mesh has {dict(mesh.shape)}")
-        self._sp = (mesh, sp_axis, dp_axis) if sequence_parallel else None
+        self._sp = (mesh, sp_axis, dp_axis, sp_impl) \
+            if sequence_parallel else None
         with self._sp_scope():
             self._step_fn, self._state = make_train_step(
                 net, loss_fn, optimizer, mesh, dp_axis=dp_axis, **kw)

@@ -169,3 +169,11 @@ def test_bert_sequence_parallel_matches_dp():
     l1 = [float(dp_tr.step(x, y).asnumpy()) for _ in range(3)]
     l2 = [float(sp_tr.step(x, y).asnumpy()) for _ in range(3)]
     np.testing.assert_allclose(l2, l1, rtol=2e-4, atol=2e-5)
+    # Ulysses (all_to_all head-sharded) SP must match too — bert_tiny has 2
+    # heads, so sp=2 divides them exactly
+    m3 = build()
+    ul_tr = SPMDTrainer(m3, loss_fn, FunctionalOptimizer("sgd", 0.1),
+                        make_mesh(dp=4, sp=2), sequence_parallel=True,
+                        sp_impl="ulysses", data_spec=P("dp", "sp"))
+    l3 = [float(ul_tr.step(x, y).asnumpy()) for _ in range(3)]
+    np.testing.assert_allclose(l3, l1, rtol=2e-4, atol=2e-5)
