@@ -1,0 +1,65 @@
+"""Ops-layer tooling: parse_log, flakiness_checker, bandwidth
+(reference ``tools/`` — SURVEY.md §2 layer 12 / §6 benchmark-harness row)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+TOOLS = os.path.join(REPO, "tools")
+
+
+def test_parse_log_markdown_table(tmp_path):
+    sys.path.insert(0, TOOLS)
+    try:
+        import parse_log
+    finally:
+        sys.path.pop(0)
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO:root:Epoch[0] Train-accuracy=0.5\n"
+        "INFO:root:Epoch[0] Validation-accuracy=0.45\n"
+        "INFO:root:Epoch[0] Time cost=12.5\n"
+        "INFO:root:Epoch[1] Train-accuracy=0.75\n"
+        "INFO:root:Epoch[1] Time cost=11.0\n")
+    data = parse_log.parse(log.read_text().splitlines(), ["accuracy"])
+    table = parse_log.render(data, ["accuracy"])
+    assert "| epoch |" in table and "0.750000" in table and "12.5" in table
+    assert "0.450000" in table
+
+
+def test_flakiness_checker_runs_target(tmp_path):
+    test_file = tmp_path / "test_tiny_flake.py"
+    test_file.write_text(
+        "def test_always_passes():\n    assert 1 + 1 == 2\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "flakiness_checker.py"),
+         str(test_file) + "::test_always_passes", "-n", "2"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0/2 trials failed" in out.stdout
+
+
+def test_bandwidth_measure_reduces_correctly():
+    sys.path.insert(0, os.path.join(TOOLS, "bandwidth"))
+    try:
+        import measure
+    finally:
+        sys.path.pop(0)
+    res = measure.run(network="squeezenet1.0", kv_store="device",
+                      num_batches=2, num_classes=10, log=False)
+    assert len(res) == 2
+    assert all(bw > 0 and np.isfinite(t) for _b, t, bw in res)
+
+
+def test_word_lm_example_learns():
+    out = subprocess.run(
+        [sys.executable, "example/rnn/word_lm.py", "--epochs", "3",
+         "--sentences", "200"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    assert "final train perplexity" in out.stderr or \
+        "final train perplexity" in out.stdout

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""KVStore communication-cost benchmark (reference
+``tools/bandwidth/measure.py`` — same CLI shape and methodology: push/pull a
+real model's parameter set through the kvstore repeatedly, report effective
+algorithm bandwidth, optionally verify reduction correctness).
+
+TPU-native: devices are the visible JAX devices; ``local``/``device``
+kvstores reduce via XLA sum (ICI collectives on a real slice, host shuffles
+on the virtual CPU mesh).  Bandwidth is reported with the reference's 2(n-1)/n
+allreduce traffic model.
+"""
+import argparse
+import logging
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description="benchmark kvstore communication")
+    parser.add_argument("--network", type=str, default="resnet50_v1",
+                        help="model-zoo network whose parameter shapes to use")
+    parser.add_argument("--devices", type=int, default=0,
+                        help="number of devices (0 = all visible)")
+    parser.add_argument("--kv-store", type=str, default="device")
+    parser.add_argument("--num-batches", type=int, default=5)
+    parser.add_argument("--disp-batches", type=int, default=1)
+    parser.add_argument("--test-results", type=int, default=1)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--optimizer", type=str, default="None")
+    args = parser.parse_args(argv)
+    logging.info(args)
+    return args
+
+
+def get_shapes(network, num_classes):
+    net = mx.gluon.model_zoo.vision.get_model(network, classes=num_classes)
+    net.initialize()
+    net(mx.nd.zeros((1, 3, 224, 224)))
+    return [p.data().shape for p in net.collect_params().values()
+            if p.grad_req != "null"]
+
+
+def run(network="resnet50_v1", devices=0, kv_store="device", num_batches=5,
+        disp_batches=1, test_results=1, num_classes=1000, optimizer="None",
+        log=True):
+    import jax
+    n_dev = devices or len(jax.devices())
+    shapes = get_shapes(network, num_classes)
+    size = sum(np.prod(s) for s in shapes) * 4
+    logging.info("num of arrays = %d, total size = %f MB",
+                 len(shapes), size / 1e6)
+
+    kv = mx.kv.create(kv_store)
+    if optimizer != "None":
+        kv.set_optimizer(mx.optimizer.create(optimizer))
+    rng = np.random.RandomState(0)
+    grads_per_dev = [[mx.nd.array(rng.randn(*s).astype("float32"))
+                      for s in shapes] for _ in range(n_dev)]
+    for i, s in enumerate(shapes):
+        kv.init(i, mx.nd.zeros(s))
+
+    results = []
+    toc = 0.0
+    for b in range(num_batches):
+        # allocate receive buffers outside the timed region — only the
+        # push/pull (communication) should be measured
+        outs = [[mx.nd.zeros(s) for _ in range(n_dev)] for s in shapes]
+        tic = time.time()
+        for i in range(len(shapes)):
+            kv.push(i, [g[i] for g in grads_per_dev])
+            kv.pull(i, outs[i])
+        for o in outs:
+            for a in o:
+                a.wait_to_read()
+        toc += time.time() - tic
+        if test_results and optimizer == "None":
+            for i, s in enumerate(shapes):
+                want = sum(g[i].asnumpy() for g in grads_per_dev)
+                err = np.abs(outs[i][0].asnumpy() - want).max() / \
+                    max(np.abs(want).max(), 1e-20)
+                assert err < 1e-4, (i, err)
+        if (b + 1) % disp_batches == 0:
+            # allreduce traffic model: each byte crosses 2(n-1)/n links
+            ratio = 2 * (n_dev - 1) / n_dev if n_dev > 1 else 1.0
+            bw = size * ratio * disp_batches / toc / 1e9
+            results.append((b, toc / disp_batches, bw))
+            if log:
+                logging.info("iter %d, %f sec, %f GB/sec per device",
+                             b, toc / disp_batches, bw)
+            toc = 0.0
+    return results
+
+
+if __name__ == "__main__":
+    logging.getLogger().setLevel(logging.INFO)
+    run(**vars(parse_args()))

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Re-run a test many times under different seeds to expose flakiness
+(reference ``tools/flakiness_checker.py`` — same CLI shape, pytest-based:
+the reference drives nosetests with ``MXNET_TEST_SEED`` per trial; here each
+trial runs ``pytest <path>::<test>`` with a fresh ``MXNET_TEST_SEED``)."""
+import argparse
+import os
+import random
+import subprocess
+import sys
+
+DEFAULT_NUM_TRIALS = 10
+
+
+def run_test_trials(args):
+    test_path = args.test
+    if "::" not in test_path and ".py/" in test_path:
+        test_path = test_path.replace(".py/", ".py::")
+    file_part = test_path.split("::")[0]
+    if not os.path.isabs(file_part) and not os.path.exists(file_part):
+        candidate = os.path.join("tests", test_path)
+        if os.path.exists(candidate.split("::")[0]):
+            test_path = candidate
+    new_env = os.environ.copy()
+    failures = 0
+    for i in range(args.num_trials):
+        seed = args.seed if args.seed is not None else \
+            random.randint(0, 2 ** 31 - 1)
+        new_env["MXNET_TEST_SEED"] = str(seed)
+        code = subprocess.call(
+            [sys.executable, "-m", "pytest", "-q", test_path],
+            env=new_env)
+        status = "PASS" if code == 0 else "FAIL"
+        print(f"trial {i + 1}/{args.num_trials} seed={seed}: {status}")
+        if code != 0:
+            failures += 1
+    print(f"{failures}/{args.num_trials} trials failed")
+    return 1 if failures else 0
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(description="Check test for flakiness")
+    parser.add_argument(
+        "test",
+        help="file name and test name, e.g. tests/test_operator.py::test_abs "
+             "(reference spelling test_operator.test_abs also accepted)")
+    parser.add_argument("-n", "--num-trials", metavar="N", type=int,
+                        default=DEFAULT_NUM_TRIALS,
+                        help="number of test trials")
+    parser.add_argument("-s", "--seed", type=int, default=None,
+                        help="fixed seed instead of a fresh one per trial")
+    args = parser.parse_args(argv)
+    # reference dotted spelling (test_module.test_name) — only when the
+    # argument is not already a path / pytest id
+    if "::" not in args.test and "/" not in args.test \
+            and ".py" not in args.test and "." in args.test:
+        mod, _, name = args.test.rpartition(".")
+        args.test = f"{mod.replace('.', '/')}.py::{name}"
+    return args
+
+
+if __name__ == "__main__":
+    sys.exit(run_test_trials(parse_args()))

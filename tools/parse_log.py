@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Parse training output logs into a markdown table (reference
+``tools/parse_log.py`` — same regex contract on the ``Epoch[N]
+Train-<metric>=V`` / ``Validation-<metric>=V`` / ``Time cost=V`` lines
+emitted by ``BaseModule.fit`` and the callbacks)."""
+import argparse
+import re
+import sys
+
+
+def parse(lines, metric_names):
+    res = ([re.compile(r".*Epoch\[(\d+)\] Train-" + s + r".*=([.\d]+)")
+            for s in metric_names] +
+           [re.compile(r".*Epoch\[(\d+)\] Validation-" + s + r".*=([.\d]+)")
+            for s in metric_names] +
+           [re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")])
+    data = {}
+    for line in lines:
+        for i, r in enumerate(res):
+            m = r.match(line)
+            if m is not None:
+                epoch = int(m.groups()[0])
+                val = float(m.groups()[1])
+                slot = data.setdefault(epoch, [[0.0, 0] for _ in res])
+                slot[i][0] += val
+                slot[i][1] += 1
+                break
+    return data
+
+
+def render(data, metric_names, fmt="markdown"):
+    heads = (["train-" + s for s in metric_names] +
+             ["valid-" + s for s in metric_names] + ["time"])
+    out = []
+    if fmt == "markdown":
+        out.append("| epoch | " + " | ".join(heads) + " |")
+        out.append("| --- " * (len(heads) + 1) + "|")
+    for epoch in sorted(data):
+        vals = []
+        for tot, cnt in data[epoch]:
+            vals.append("%f" % (tot / cnt) if cnt else "-")
+        if fmt == "markdown":
+            out.append("| %d | " % epoch + " | ".join(vals) + " |")
+        else:
+            out.append("%d\t" % epoch + "\t".join(vals))
+    return "\n".join(out)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Parse training output log")
+    parser.add_argument("logfile", nargs=1, type=str)
+    parser.add_argument("--format", type=str, default="markdown",
+                        choices=["markdown", "none"])
+    parser.add_argument("--metric-names", type=str, nargs="+",
+                        default=["accuracy"])
+    args = parser.parse_args(argv)
+    with open(args.logfile[0]) as f:
+        lines = f.readlines()
+    data = parse(lines, args.metric_names)
+    print(render(data, args.metric_names, args.format))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
