@@ -60,9 +60,11 @@ def main():
     _pyrandom.seed(42)
     rng = np.random.RandomState(0)
     buckets = [10, 20, 30]
+    # token 0 is reserved as padding; the metric ignores it (invalid_label
+    # must match Perplexity's ignore_label or pads train the model on garbage)
     train = mx.rnn.BucketSentenceIter(
         synthetic_corpus(args.vocab, args.sentences, rng),
-        args.batch_size, buckets=buckets)
+        args.batch_size, buckets=buckets, invalid_label=0)
 
     mod = mx.mod.BucketingModule(
         sym_gen_factory(args.num_hidden, args.num_embed, args.vocab),

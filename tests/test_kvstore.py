@@ -135,3 +135,58 @@ def test_trainer_with_kvstore_multidevice():
     loss.backward()
     trainer.step(4)
     assert net.weight.data().shape == (2, 3)
+
+
+def test_gradient_compression_2bit_with_error_feedback():
+    # Mirrors the reference's compressed dist_sync checks
+    # (tests/nightly/dist_sync_kvstore.py): thresholding to {-t, 0, +t} and
+    # residual carry-over across pushes.
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 2.0})
+    kv.init(0, mx.nd.zeros((4,)))
+    out = [mx.nd.zeros((4,))]
+
+    g = mx.nd.array(np.array([0.7, -3.0, 2.5, 0.0], np.float32))
+    kv.push(0, [g])
+    kv.pull(0, out)
+    # quantized: 0.7->0 (below t), -3.0->-2, 2.5->+2, 0->0
+    np.testing.assert_allclose(out[0].asnumpy(), [0.0, -2.0, 2.0, 0.0])
+
+    # residuals now [0.7, -1.0, 0.5, 0]; same grad again:
+    # acc = [1.4, -4.0, 3.0, 0] -> q = [0, -2, 2, 0] (store replaces, no
+    # updater — reference KVStoreLocal CopyFromTo semantics)
+    kv.push(0, [g])
+    kv.pull(0, out)
+    np.testing.assert_allclose(out[0].asnumpy(), [0.0, -2.0, 2.0, 0.0])
+
+    # third push: acc = [2.1, -5.0, 3.5, 0] -> q = [2, -2, 2, 0] — the
+    # residual finally pushes the small 0.7 gradients over the threshold
+    kv.push(0, [g])
+    kv.pull(0, out)
+    np.testing.assert_allclose(out[0].asnumpy(), [2.0, -2.0, 2.0, 0.0])
+
+
+def test_gradient_compression_quantizes_after_local_reduce():
+    # reference worker-side order (kvstore_dist.h): dense local reduce
+    # first, THEN one quantization of the merged gradient per key
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    kv.init(7, mx.nd.zeros((2,)))
+    g1 = mx.nd.array(np.array([0.6, 1.2], np.float32))
+    g2 = mx.nd.array(np.array([0.6, -0.4], np.float32))
+    out = [mx.nd.zeros((2,))]
+    kv.push(7, [g1, g2])           # merged [1.2, 0.8] -> q [1, 0], r [.2, .8]
+    kv.pull(7, out)
+    np.testing.assert_allclose(out[0].asnumpy(), [1.0, 0.0])
+    kv.push(7, [g1, g2])           # acc [1.4, 1.6] -> q [1, 1]
+    kv.pull(7, out)
+    np.testing.assert_allclose(out[0].asnumpy(), [1.0, 1.0])
+    assert set(kv._residuals) == {7}   # one residual per key, not per device
+
+
+def test_gradient_compression_rejects_bad_params():
+    kv = mx.kv.create("local")
+    with pytest.raises(ValueError):
+        kv.set_gradient_compression({"type": "1bit"})
+    with pytest.raises(ValueError):
+        kv.set_gradient_compression({"type": "2bit", "threshold": -1})

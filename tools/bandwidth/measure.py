@@ -48,7 +48,8 @@ def run(network="resnet50_v1", devices=0, kv_store="device", num_batches=5,
         disp_batches=1, test_results=1, num_classes=1000, optimizer="None",
         log=True):
     import jax
-    n_dev = devices or len(jax.devices())
+    real = jax.devices()
+    n_dev = devices or len(real)
     shapes = get_shapes(network, num_classes)
     size = sum(np.prod(s) for s in shapes) * 4
     logging.info("num of arrays = %d, total size = %f MB",
@@ -58,10 +59,18 @@ def run(network="resnet50_v1", devices=0, kv_store="device", num_batches=5,
     if optimizer != "None":
         kv.set_optimizer(mx.optimizer.create(optimizer))
     rng = np.random.RandomState(0)
-    grads_per_dev = [[mx.nd.array(rng.randn(*s).astype("float32"))
-                      for s in shapes] for _ in range(n_dev)]
+    # one replica set per device, PLACED on that device — otherwise the
+    # reduce never crosses a device boundary and measures nothing
+    ctxs = [mx.Context("gpu" if real[d % len(real)].platform != "cpu"
+                       else "cpu", d % len(real)) for d in range(n_dev)]
+    grads_per_dev = [[mx.nd.array(rng.randn(*s).astype("float32"), ctx=c)
+                      for s in shapes] for c in ctxs]
     for i, s in enumerate(shapes):
         kv.init(i, mx.nd.zeros(s))
+    wants = None
+    if test_results and optimizer == "None":
+        wants = [sum(g[i].asnumpy() for g in grads_per_dev)
+                 for i in range(len(shapes))]
 
     results = []
     toc = 0.0
@@ -77,9 +86,8 @@ def run(network="resnet50_v1", devices=0, kv_store="device", num_batches=5,
             for a in o:
                 a.wait_to_read()
         toc += time.time() - tic
-        if test_results and optimizer == "None":
-            for i, s in enumerate(shapes):
-                want = sum(g[i].asnumpy() for g in grads_per_dev)
+        if wants is not None:
+            for i, want in enumerate(wants):
                 err = np.abs(outs[i][0].asnumpy() - want).max() / \
                     max(np.abs(want).max(), 1e-20)
                 assert err < 1e-4, (i, err)

@@ -9,10 +9,10 @@ import sys
 
 
 def parse(lines, metric_names):
-    res = ([re.compile(r".*Epoch\[(\d+)\] Train-" + s + r".*=([.\d]+)")
-            for s in metric_names] +
-           [re.compile(r".*Epoch\[(\d+)\] Validation-" + s + r".*=([.\d]+)")
-            for s in metric_names] +
+    res = ([re.compile(r".*Epoch\[(\d+)\] Train-" + re.escape(s) +
+                       r"=([.\d]+)") for s in metric_names] +
+           [re.compile(r".*Epoch\[(\d+)\] Validation-" + re.escape(s) +
+                       r"=([.\d]+)") for s in metric_names] +
            [re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")])
     data = {}
     for line in lines:
