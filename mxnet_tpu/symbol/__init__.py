@@ -34,6 +34,12 @@ linalg = _facade("linalg", ("_linalg_",))
 contrib = _facade("contrib", ("_contrib_",))
 image = _facade("image", ("_image_",))
 
+from . import contrib_ctrl as _ctrl  # noqa: E402
+
+contrib.foreach = _ctrl.foreach
+contrib.while_loop = _ctrl.while_loop
+contrib.cond = _ctrl.cond
+
 
 def zeros(shape, dtype=None, **kwargs):
     return getattr(_CURRENT, "_zeros")(shape=shape, dtype=dtype or "float32")

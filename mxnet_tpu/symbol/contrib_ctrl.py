@@ -1,0 +1,290 @@
+"""Symbolic control flow — ``mx.sym.contrib.{foreach, while_loop, cond}``
+(reference ``python/mxnet/symbol/contrib.py:212,375,598`` over the
+``_foreach``/``_while_loop``/``_cond`` graph ops, control_flow.cc:1089-1255).
+
+TPU-native design: each construct becomes ONE graph node whose kernel runs
+the traced sub-symbol under the matching ``lax`` primitive (``scan`` /
+masked ``fori_loop`` / ``cond``).  Like the reference's graph-cutting
+(``symbol/contrib.py _cut_subgraph``), symbols captured from the enclosing
+scope become extra node inputs — the subgraph itself is evaluated with those
+entries pre-seeded, so outer computation is never re-executed inside the
+loop.
+
+Limitations (documented): control-flow nodes hold Python closures, so graphs
+containing them do not round-trip through ``tojson`` — matching SURVEY.md
+hard-part 2's bucketing/padding guidance, use them inside bound executors.
+Stochastic ops inside a body draw from a fixed key (the reference gives each
+loop op its own resource seed).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.registry import OpDef
+from . import symbol as _sym
+from .symbol import MODE_DEPENDENT, STOCHASTIC_OPS, Symbol, _Node, _filter_attrs
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _cut_subgraph(out_entries, inner_var_ids, all_ops_inner=False):
+    """Classify the joint DAG: a node is *inner* if it is one of the loop's
+    own variables or (transitively) consumes one.  Returns the inner nodes in
+    topo order plus the ordered outer ``(node, out_idx)`` entries referenced
+    by inner nodes or the outputs — the implicit captures.
+
+    ``all_ops_inner``: treat EVERY op node as inner and every variable as a
+    capture — used by ``cond``, whose branches have no loop variables but
+    must still execute INSIDE the node (only the taken branch may run)."""
+    seen, order = set(), []
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for (p, _i) in node.inputs:
+            visit(p)
+        order.append(node)
+
+    for (n, _i) in out_entries:
+        visit(n)
+
+    if all_ops_inner:
+        inner = {id(n) for n in order if n.op is not None}
+    else:
+        inner = set(inner_var_ids)
+        for node in order:
+            if id(node) in inner:
+                continue
+            if any(id(p) in inner for (p, _i) in node.inputs):
+                inner.add(id(node))
+
+    captures = []
+
+    def capture(entry):
+        if entry not in captures:
+            captures.append(entry)
+
+    for node in order:
+        if id(node) not in inner:
+            continue
+        for (p, i) in node.inputs:
+            if id(p) not in inner:
+                capture((p, i))
+    for (n, i) in out_entries:
+        if id(n) not in inner:
+            capture((n, i))
+
+    inner_order = [n for n in order if id(n) in inner]
+    return inner_order, captures
+
+
+def _make_eval(inner_order, out_entries, captures, var_binding):
+    """Build ``eval(var_vals, capture_vals, is_train) -> [outputs]`` for the
+    cut subgraph.  ``var_binding``: ordered list of the loop's own variable
+    nodes; ``captures``: ordered outer entries seeded from node inputs."""
+    cap_index = {(id(p), i): k for k, (p, i) in enumerate(captures)}
+
+    def run(var_vals, capture_vals, is_train):
+        import jax
+
+        vals = {}
+        for node, v in zip(var_binding, var_vals):
+            vals[id(node)] = (v,)
+
+        def get(entry):
+            p, i = entry
+            k = cap_index.get((id(p), i))
+            if k is not None:
+                return capture_vals[k]
+            return vals[id(p)][i]
+
+        for node in inner_order:
+            if node.op is None:
+                continue  # loop variables pre-seeded; captures come via get()
+            ins = [get((p, i)) for (p, i) in node.inputs]
+            attrs = _filter_attrs(node.op, dict(node.attrs))
+            if node.op.name in MODE_DEPENDENT:
+                attrs["__training__"] = is_train
+            if node.op.name in STOCHASTIC_OPS or node.op.name == "Dropout":
+                ins = [jax.random.PRNGKey(0)] + ins
+            out = node.op.fn(*ins, **attrs)
+            vals[id(node)] = tuple(out) if isinstance(out, (tuple, list)) \
+                else (out,)
+        return [get(e) for e in out_entries]
+
+    return run
+
+
+def _ctrl_node(opname, node_fn, input_syms, num_outputs, name):
+    op = OpDef(opname, node_fn)
+    inputs = [s._outputs[0] for s in input_syms]
+    node = _Node(op, name, inputs, {}, num_outputs=num_outputs)
+    return [Symbol([(node, i)]) for i in range(num_outputs)]
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """Scan ``body(data_t, states) -> (outputs_t, new_states)`` over the
+    leading axis of ``data`` — the symbolic twin of
+    ``nd.contrib.foreach`` (one ``lax.scan`` node in the graph)."""
+    states_are_list = isinstance(init_states, (list, tuple))
+    state_syms = _as_list(init_states)
+
+    dvar = _sym.Variable(f"__{name}_data")
+    svars = [_sym.Variable(f"__{name}_state{i}")
+             for i in range(len(state_syms))]
+    out, new_states = body(dvar, svars if states_are_list else svars[0])
+    out_is_list = isinstance(out, (list, tuple))
+    out_syms = _as_list(out)
+    ns_syms = _as_list(new_states)
+    n_out, n_state = len(out_syms), len(ns_syms)
+
+    entries = [s._outputs[0] for s in out_syms + ns_syms]
+    inner_vars = [s._outputs[0][0] for s in [dvar] + svars]
+    inner_order, captures = _cut_subgraph(entries,
+                                          [id(n) for n in inner_vars])
+    run = _make_eval(inner_order, entries, captures, inner_vars)
+
+    def node_fn(data_v, *rest, __training__=False):
+        states = rest[:n_state]
+        caps = rest[n_state:]
+
+        def step(carry, x):
+            outs = run([x] + list(carry), caps, __training__)
+            return tuple(outs[n_out:]), tuple(outs[:n_out])
+
+        carry, ys = lax.scan(step, tuple(states), data_v)
+        return tuple(ys) + tuple(carry)
+
+    cap_syms = [Symbol([e]) for e in captures]
+    outs = _ctrl_node("_foreach", node_fn,
+                      [data] + state_syms + cap_syms,
+                      n_out + n_state, name)
+    out_res = outs[:n_out] if out_is_list else outs[0]
+    state_res = outs[n_out:] if states_are_list else outs[n_out]
+    return out_res, state_res
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None, name="while_loop"):
+    """``func(loop_vars) -> (step_output, new_loop_vars)`` while
+    ``cond(loop_vars)`` holds, up to ``max_iterations`` (required for the
+    symbolic form — static shapes).  Step outputs are stacked into
+    ``(max_iterations, ...)`` buffers; rows past the final step stay zero,
+    exactly like the reference's padded symbolic while_loop."""
+    if max_iterations is None:
+        raise ValueError("max_iterations is required for the symbolic "
+                         "while_loop (static shapes)")
+    vars_are_list = isinstance(loop_vars, (list, tuple))
+    lv_syms = _as_list(loop_vars)
+    lvars = [_sym.Variable(f"__{name}_var{i}") for i in range(len(lv_syms))]
+    lvars_arg = lvars if vars_are_list else lvars[0]
+
+    pred = cond(lvars_arg)
+    step_out, new_vars = func(lvars_arg)
+    out_is_list = isinstance(step_out, (list, tuple))
+    out_syms = _as_list(step_out)
+    nv_syms = _as_list(new_vars)
+    n_out, n_var = len(out_syms), len(nv_syms)
+    assert n_var == len(lv_syms), \
+        "func must return as many loop_vars as it receives"
+
+    inner_vars = [s._outputs[0][0] for s in lvars]
+    inner_ids = [id(n) for n in inner_vars]
+    cond_entries = [pred._outputs[0]]
+    func_entries = [s._outputs[0] for s in out_syms + nv_syms]
+    cond_order, cond_caps = _cut_subgraph(cond_entries, inner_ids)
+    func_order, func_caps = _cut_subgraph(func_entries, inner_ids)
+    run_cond = _make_eval(cond_order, cond_entries, cond_caps, inner_vars)
+    run_func = _make_eval(func_order, func_entries, func_caps, inner_vars)
+    n_ccap = len(cond_caps)
+
+    def node_fn(*rest, __training__=False):
+        vars0 = rest[:n_var]
+        ccaps = rest[n_var:n_var + n_ccap]
+        fcaps = rest[n_var + n_ccap:]
+        import jax
+        probe = jax.eval_shape(
+            lambda vs: run_func(list(vs), fcaps, __training__), vars0)
+        out_bufs = tuple(jnp.zeros((max_iterations,) + o.shape, o.dtype)
+                         for o in probe[:n_out])
+
+        # cond is checked FIRST each tick; the body only executes under
+        # lax.cond when it holds — inactive iterations never run `func`, so
+        # singular values past termination cannot NaN the gradients (the
+        # reference stops stepping once cond fails, same contract).
+        def body_fn(i, st):
+            vars_, bufs, active = st
+
+            def take(ops):
+                vars_, bufs = ops
+                p = jnp.reshape(
+                    jnp.asarray(run_cond(list(vars_), ccaps,
+                                         __training__)[0]), ()) != 0
+
+                def do(ops2):
+                    vars_, bufs = ops2
+                    res = run_func(list(vars_), fcaps, __training__)
+                    bufs = tuple(b.at[i].set(o)
+                                 for b, o in zip(bufs, res[:n_out]))
+                    return tuple(res[n_out:]), bufs
+
+                vars_, bufs = lax.cond(p, do, lambda o: o, (vars_, bufs))
+                return vars_, bufs, p
+
+            vars_, bufs, cont = lax.cond(
+                active, take, lambda o: (o[0], o[1], jnp.asarray(False)),
+                (vars_, bufs))
+            return vars_, bufs, active & cont
+
+        vars_f, bufs, _ = lax.fori_loop(
+            0, max_iterations, body_fn,
+            (tuple(vars0), out_bufs, jnp.asarray(True)))
+        return tuple(bufs) + tuple(vars_f)
+
+    cap_syms = [Symbol([e]) for e in cond_caps + func_caps]
+    outs = _ctrl_node("_while_loop", node_fn, lv_syms + cap_syms,
+                      n_out + n_var, name)
+    out_res = outs[:n_out] if out_is_list else outs[0]
+    var_res = outs[n_out:] if vars_are_list else outs[n_out]
+    return out_res, var_res
+
+
+def cond(pred, then_func, else_func, name="cond"):
+    """If-then-else on a scalar symbol (reference ``symbol/contrib.py:598``):
+    nullary branch functions closing over outer symbols; both branches must
+    produce matching shapes — compiled to ``lax.cond``."""
+    then_out = then_func()
+    else_out = else_func()
+    then_is_list = isinstance(then_out, (list, tuple))
+    t_syms, e_syms = _as_list(then_out), _as_list(else_out)
+    assert len(t_syms) == len(e_syms), \
+        "then_func and else_func must produce the same number of outputs"
+    n_out = len(t_syms)
+
+    # branches execute INSIDE lax.cond (all their op nodes are inner; the
+    # leaf variables become captures), so only the taken branch runs — its
+    # twin cannot poison gradients with domain errors (log(0) etc.)
+    t_entries = [s._outputs[0] for s in t_syms]
+    e_entries = [s._outputs[0] for s in e_syms]
+    t_order, t_caps = _cut_subgraph(t_entries, [], all_ops_inner=True)
+    e_order, e_caps = _cut_subgraph(e_entries, [], all_ops_inner=True)
+    run_t = _make_eval(t_order, t_entries, t_caps, [])
+    run_e = _make_eval(e_order, e_entries, e_caps, [])
+    n_tcap = len(t_caps)
+
+    def node_fn(pred_v, *caps, __training__=False):
+        tc = caps[:n_tcap]
+        ec = caps[n_tcap:]
+        p = jnp.reshape(jnp.asarray(pred_v), ()) != 0
+        return lax.cond(p,
+                        lambda: tuple(run_t([], tc, __training__)),
+                        lambda: tuple(run_e([], ec, __training__)))
+
+    cap_syms = [Symbol([e]) for e in t_caps + e_caps]
+    outs = _ctrl_node("_cond", node_fn, [pred] + cap_syms, n_out, name)
+    return outs if then_is_list else outs[0]

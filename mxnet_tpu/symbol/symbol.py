@@ -29,7 +29,8 @@ AUX_INPUTS = {"BatchNorm": {3: "moving_mean", 4: "moving_var"},
               "_contrib_SyncBatchNorm": {3: "moving_mean", 4: "moving_var"}}
 
 # Ops whose behavior depends on is_train (OpContext ctx.is_train in reference)
-MODE_DEPENDENT = {"Dropout", "BatchNorm", "RNN", "_contrib_SyncBatchNorm"}
+MODE_DEPENDENT = {"Dropout", "BatchNorm", "RNN", "_contrib_SyncBatchNorm",
+                  "_foreach", "_while_loop", "_cond"}
 
 _SIG_CACHE = {}
 
@@ -468,6 +469,44 @@ class Symbol:
 
     def __neg__(self):
         return _scalar_sym("_mul_scalar", self, -1.0)
+
+    # comparisons (reference symbol.py __gt__/__ge__/... → broadcast ops;
+    # outputs are 0/1 symbols)
+    def __gt__(self, other):
+        return _binary_sym("broadcast_greater", "_greater_scalar", self, other)
+
+    def __ge__(self, other):
+        return _binary_sym("broadcast_greater_equal", "_greater_equal_scalar",
+                           self, other)
+
+    def __lt__(self, other):
+        return _binary_sym("broadcast_lesser", "_lesser_scalar", self, other)
+
+    def __le__(self, other):
+        return _binary_sym("broadcast_lesser_equal", "_lesser_equal_scalar",
+                           self, other)
+
+    def __eq__(self, other):
+        if isinstance(other, (Symbol, int, float)):
+            return _binary_sym("broadcast_equal", "_equal_scalar", self, other)
+        return NotImplemented
+
+    def __ne__(self, other):
+        if isinstance(other, (Symbol, int, float)):
+            return _binary_sym("broadcast_not_equal", "_not_equal_scalar",
+                               self, other)
+        return NotImplemented
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        # __eq__ returns a graph node, so Python truthiness (membership
+        # tests, `if sym:`) would silently misbehave — fail loudly instead
+        # (same guard numpy/jax arrays use for ambiguous truth values)
+        raise TypeError(
+            "The truth value of a Symbol is ambiguous (comparisons build "
+            "graph nodes); use explicit ops or identity checks instead")
 
     def __repr__(self):
         name = self.name

@@ -89,3 +89,138 @@ def test_isfinite_isnan():
     np.testing.assert_allclose(mx.nd.contrib.isfinite(x).asnumpy(), [1, 0, 0])
     np.testing.assert_allclose(mx.nd.contrib.isnan(x).asnumpy(), [0, 0, 1])
     np.testing.assert_allclose(mx.nd.contrib.isinf(x).asnumpy(), [0, 1, 0])
+
+
+# ---------------------------------------------------------------------------
+# symbolic control flow (mx.sym.contrib) — reference symbol/contrib.py
+# ---------------------------------------------------------------------------
+def test_sym_foreach_with_capture_and_grad():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+
+    def body(x, states):
+        new_s = states[0] + x * w        # w captured from enclosing scope
+        return new_s, [new_s]
+
+    out, states = mx.sym.contrib.foreach(body, data,
+                                         [mx.sym.Variable("s0")])
+    g = mx.sym.Group([out, states[0]])
+    ex = g.simple_bind(ctx=mx.cpu(), data=(5, 3), w=(3,), s0=(3,))
+    x = np.arange(15).reshape(5, 3).astype("float32")
+    wv = np.array([1.0, 2.0, 0.5], np.float32)
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["w"][:] = wv
+    ex.arg_dict["s0"][:] = 0
+    ex.forward()
+    ys, final = [o.asnumpy() for o in ex.outputs]
+    want = np.cumsum(x * wv, axis=0)
+    np.testing.assert_allclose(ys, want, rtol=1e-5)
+    np.testing.assert_allclose(final, want[-1], rtol=1e-5)
+
+    # gradient w.r.t. the captured symbol flows through the scan
+    loss = mx.sym.sum(out)
+    ex2 = loss.simple_bind(ctx=mx.cpu(), data=(5, 3), w=(3,), s0=(3,),
+                           grad_req="write")
+    ex2.arg_dict["data"][:] = np.ones((5, 3), np.float32)
+    ex2.arg_dict["w"][:] = 1.0
+    ex2.arg_dict["s0"][:] = 0
+    ex2.forward(is_train=True)
+    ex2.backward()
+    np.testing.assert_allclose(ex2.grad_dict["w"].asnumpy(), [15, 15, 15],
+                               rtol=1e-5)
+
+
+def test_sym_while_loop_padded_outputs():
+    i_v = mx.sym.Variable("i")
+    tot = mx.sym.Variable("tot")
+    outs, fvars = mx.sym.contrib.while_loop(
+        cond=lambda vs: vs[1] < 10,
+        func=lambda vs: (vs[0], [vs[0] + 1, vs[1] + vs[0]]),
+        loop_vars=[i_v, tot], max_iterations=8)
+    g = mx.sym.Group([outs, fvars[0], fvars[1]])
+    ex = g.simple_bind(ctx=mx.cpu(), i=(1,), tot=(1,))
+    ex.arg_dict["i"][:] = 1
+    ex.arg_dict["tot"][:] = 0
+    ex.forward()
+    step_out, fi, ftot = [o.asnumpy() for o in ex.outputs]
+    np.testing.assert_allclose(step_out.ravel()[:4], [1, 2, 3, 4])
+    assert (step_out.ravel()[4:] == 0).all()   # padded rows stay zero
+    np.testing.assert_allclose(fi, [5])
+    np.testing.assert_allclose(ftot, [10])
+
+
+def test_sym_while_loop_requires_max_iterations():
+    v = mx.sym.Variable("v")
+    with pytest.raises(ValueError):
+        mx.sym.contrib.while_loop(lambda vs: vs[0] < 1,
+                                  lambda vs: (vs[0], [vs[0]]),
+                                  [v], max_iterations=None)
+
+
+def test_sym_cond_branches():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    res = mx.sym.contrib.cond(a > b, lambda: a * 2, lambda: b * 3)
+    ex = res.simple_bind(ctx=mx.cpu(), a=(1,), b=(1,))
+    ex.arg_dict["a"][:] = 5
+    ex.arg_dict["b"][:] = 2
+    ex.forward()
+    assert ex.outputs[0].asnumpy()[0] == 10
+    ex.arg_dict["a"][:] = 1
+    ex.forward()
+    assert ex.outputs[0].asnumpy()[0] == 6
+
+
+def test_symbol_comparison_operators():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    g = mx.sym.Group([a > b, a >= b, a < b, a <= b, a == b, a != b,
+                      a > 1.0, a == 2.0])
+    ex = g.simple_bind(ctx=mx.cpu(), a=(3,), b=(3,))
+    ex.arg_dict["a"][:] = np.array([1.0, 2.0, 3.0], np.float32)
+    ex.arg_dict["b"][:] = np.array([2.0, 2.0, 2.0], np.float32)
+    ex.forward()
+    got = [o.asnumpy().tolist() for o in ex.outputs]
+    assert got == [[0, 0, 1], [0, 1, 1], [1, 0, 0], [1, 1, 0],
+                   [0, 1, 0], [1, 0, 1], [0, 1, 1], [0, 1, 0]]
+
+
+def test_sym_cond_untaken_branch_cannot_poison_gradients():
+    # Regression: both branches used to be evaluated unconditionally, so the
+    # untaken branch's log(0) leaked NaN into the gradient.
+    a = mx.sym.Variable("a")
+    res = mx.sym.contrib.cond(a > 0, lambda: mx.sym.log(a), lambda: a * 0)
+    loss = mx.sym.sum(res)
+    ex = loss.simple_bind(ctx=mx.cpu(), a=(1,), grad_req="write")
+    ex.arg_dict["a"][:] = 0.0          # else branch taken; log(0) untaken
+    ex.forward(is_train=True)
+    assert float(ex.outputs[0].asnumpy()) == 0.0
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), [0.0])
+
+
+def test_sym_while_loop_inactive_iterations_cannot_poison_gradients():
+    # Regression: iterations past termination used to still execute func, so
+    # 1/0 at an inactive step NaN'd the gradient through the where-mask.
+    v = mx.sym.Variable("v")
+    outs, fvars = mx.sym.contrib.while_loop(
+        cond=lambda vs: vs[0] > 0,
+        func=lambda vs: (1.0 / vs[0], [vs[0] - 1]),
+        loop_vars=[v], max_iterations=4)
+    loss = mx.sym.sum(outs)
+    ex = loss.simple_bind(ctx=mx.cpu(), v=(1,), grad_req="write")
+    ex.arg_dict["v"][:] = 2.0          # runs 2 steps: 1/2 + 1/1 = 1.5
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(float(ex.outputs[0].asnumpy()), 1.5)
+    ex.backward()
+    # d/dv [1/v + 1/(v-1)] at v=2: -1/4 - 1 = -1.25
+    np.testing.assert_allclose(ex.grad_dict["v"].asnumpy(), [-1.25],
+                               rtol=1e-5)
+
+
+def test_symbol_bool_raises():
+    a = mx.sym.Variable("a")
+    with pytest.raises(TypeError):
+        bool(a == a)
+    with pytest.raises(TypeError):
+        a in [mx.sym.Variable("b")]   # membership uses __eq__ + __bool__
