@@ -80,5 +80,4 @@ def main():
 
 
 if __name__ == "__main__":
-    import sys
     sys.exit(main())

@@ -82,9 +82,13 @@ def _neighbor_sample_one(csr, seed, probability, num_hops, num_neighbor,
             pick = rng.choice(len(neigh), size=num_neighbor, replace=False)
         else:
             p = probability[neigh]
-            p = p / p.sum()
-            pick = rng.choice(len(neigh), size=num_neighbor, replace=False,
-                              p=p)
+            total = p.sum()
+            if total <= 0:   # all-zero weights: fall back to uniform
+                pick = rng.choice(len(neigh), size=num_neighbor,
+                                  replace=False)
+            else:
+                pick = rng.choice(len(neigh), size=num_neighbor,
+                                  replace=False, p=p / total)
         sampled[dst] = (tuple(int(c) for c in neigh[pick]),
                         tuple(eids[pick]))
         for v in neigh[pick]:

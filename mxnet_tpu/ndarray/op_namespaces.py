@@ -14,8 +14,10 @@ from .register import make_op_func
 
 def _facade(name, prefixes, extra=()):
     mod = types.ModuleType(f"mxnet_tpu.ndarray.{name}")
-    for opname in _reg.all_names():
-        for p in prefixes:
+    # earlier prefixes win (e.g. _random_ over _sample_ for nd.random.*),
+    # independent of op registration order
+    for p in prefixes:
+        for opname in _reg.all_names():
             if opname.startswith(p):
                 short = opname[len(p):]
                 if short and not hasattr(mod, short):

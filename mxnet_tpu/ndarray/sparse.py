@@ -82,21 +82,23 @@ class CSRNDArray(BaseSparseNDArray):
 
     @property
     def indices(self):
+        # one aux dtype on both construction paths (int64 pre-wrap; the
+        # runtime's default int width applies uniformly after wrapping)
         cache = getattr(self, "_csr_cache", None)
         if cache is not None:
-            return _as_nd(cache[1])
+            return _as_nd(cache[1].astype(_np.int64, copy=False))
         arr = self.asnumpy()
-        return _as_nd(_np.nonzero(arr)[1].astype(_np.int32))
+        return _as_nd(_np.nonzero(arr)[1].astype(_np.int64))
 
     @property
     def indptr(self):
         cache = getattr(self, "_csr_cache", None)
         if cache is not None:
-            return _as_nd(cache[2])
+            return _as_nd(cache[2].astype(_np.int64, copy=False))
         arr = self.asnumpy()
         counts = (arr != 0).sum(axis=1)
         return _as_nd(_np.concatenate([[0], _np.cumsum(counts)])
-                      .astype(_np.int32))
+                      .astype(_np.int64))
 
     def check_format(self, full_check=True):
         """Validate the CSR structure (reference ``sparse.py check_format`` →
