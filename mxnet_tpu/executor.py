@@ -38,6 +38,36 @@ class Executor:
         self._fwd_cache = {}
         self._fwdbwd_cache = {}
         self._saved_fwd = None
+        self._dp = None                   # (Mesh, set of batch-sharded args)
+
+    # ------------------------------------------------- multi-device data par
+    def set_data_parallel(self, mesh, batch_arg_names):
+        """Run this executor SPMD over a ``dp`` mesh: the named args are
+        sharded on their batch (leading) axis, everything else is replicated.
+        XLA's partitioner splits the compute and inserts the gradient
+        all-reduce — the TPU-native replacement for the reference's
+        ``DataParallelExecutorGroup`` (``executor_group.py:282-304``)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        self._dp = (mesh, frozenset(batch_arg_names),
+                    NamedSharding(mesh, P("dp")), NamedSharding(mesh, P()))
+        self._fwd_cache.clear()
+        self._fwdbwd_cache.clear()
+
+    def _place(self, name, arr, batch=None):
+        """Commit ``arr`` to its dp-mesh sharding (no-op when already there
+        or when no dp mesh is set)."""
+        if self._dp is None:
+            return arr
+        mesh, batch_names, batch_sh, rep_sh = self._dp
+        from jax.sharding import NamedSharding
+        sh = getattr(arr, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.mesh == mesh:
+            return arr          # already on the mesh — hot path, no dispatch
+        if batch is None:
+            batch = name in batch_names
+        if batch and arr.ndim >= 1 and arr.shape[0] % mesh.devices.size == 0:
+            return jax.device_put(arr, batch_sh)
+        return jax.device_put(arr, rep_sh)
 
     # ------------------------------------------------------------ properties
     @property
@@ -98,7 +128,18 @@ class Executor:
             self._fwdbwd_cache[True] = run
         return self._fwdbwd_cache[True]
 
+    def commit_to_mesh(self):
+        """Commit every buffer to the dp mesh (and keep it there), so the
+        eager update paths (updater / kvstore optimizer state) also run
+        SPMD.  No-op without a dp mesh."""
+        if self._dp is None:
+            return
+        for d in (self.arg_dict, self.aux_dict, self.grad_dict):
+            for n, a in d.items():
+                a._data = self._place(n, a._data)
+
     def _env(self):
+        self.commit_to_mesh()
         env = {n: a._data for n, a in self.arg_dict.items()}
         env.update({n: a._data for n, a in self.aux_dict.items()})
         return env
@@ -112,11 +153,13 @@ class Executor:
                 v = array(v)
             dat = v._data.astype(self.arg_dict[k].dtype) \
                 if v.dtype != self.arg_dict[k].dtype else v._data
-            # stage the batch onto the executor's device (host→HBM transfer;
-            # the reference's _load_data scatter, executor_group.py:437)
-            buf_dev = list(self.arg_dict[k]._data.devices())[0]
-            if list(dat.devices())[0] != buf_dev:
-                dat = jax.device_put(dat, buf_dev)
+            # stage the batch onto the executor's device(s) (host→HBM
+            # transfer; the reference's _load_data scatter,
+            # executor_group.py:437).  Under dp, _env() commits to the mesh.
+            if self._dp is None:
+                buf_dev = list(self.arg_dict[k]._data.devices())[0]
+                if list(dat.devices())[0] != buf_dev:
+                    dat = jax.device_put(dat, buf_dev)
             self.arg_dict[k]._data = dat
         run = self._compiled_fwd(is_train)
         outs, aux_updates = run(self._env(), _rnd.next_key())
@@ -146,6 +189,9 @@ class Executor:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             out_grads = [g._data if isinstance(g, NDArray) else g for g in out_grads]
+        if self._dp is not None:
+            # output cotangents carry the batch axis: shard them like data
+            out_grads = [self._place("", g, batch=True) for g in out_grads]
         run = self._compiled_fwdbwd()
         outs, aux_updates, grads = run(self._env(), _rnd.current_key(), out_grads)
         for name, g in grads.items():

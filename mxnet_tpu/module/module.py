@@ -158,12 +158,39 @@ class Module(BaseModule):
         self._grad_req = reqs
         self._exec = self._symbol.simple_bind(
             ctx=self._context[0], grad_req=reqs, **shapes)
+        if len(self._context) > 1:
+            self._set_data_parallel(self._exec)
         self.binded = True
         if shared_module is not None and shared_module.params_initialized:
             self.set_params(*shared_module.get_params())
         elif self.params_initialized:
             # bound after load: push loaded params into the executor
             self._exec.copy_params_from(self._arg_params, self._aux_params)
+
+    def _set_data_parallel(self, executor):
+        """Multi-context data parallelism, TPU-native: one SPMD program over
+        a ``dp`` mesh of the bound contexts — batch args sharded on axis 0,
+        params replicated, gradient all-reduce inserted by the XLA
+        partitioner (reference ``DataParallelExecutorGroup``,
+        ``executor_group.py:144,282-304``)."""
+        import numpy as _np
+        from jax.sharding import Mesh
+
+        devs = [c.jax_device() for c in self._context]
+        if len(set(devs)) != len(devs):
+            raise ValueError(
+                f"context={self._context} resolves to duplicate devices "
+                f"{devs}; multi-context data parallelism needs one distinct "
+                f"device per context")
+        n = len(devs)
+        for desc in list(self._data_shapes) + list(self._label_shapes or []):
+            if not desc.shape or desc.shape[0] % n != 0:
+                raise ValueError(
+                    f"batch axis of {desc.name} {desc.shape} must be "
+                    f"divisible by the {n} contexts")
+        mesh = Mesh(_np.asarray(devs), ("dp",))
+        executor.set_data_parallel(
+            mesh, set(self._data_names) | set(self._label_names))
 
     def _reset_bind(self):
         self.binded = False
@@ -251,6 +278,9 @@ class Module(BaseModule):
         self._update_on_kvstore = bool(kv) and "dist" not in (kv.type if kv else "")
         self._updater = opt.get_updater(optimizer)
         if kv:
+            # under multi-context dp the kvstore's weight/state copies must
+            # live on the mesh like the gradients that will be pushed
+            self._exec.commit_to_mesh()
             for i, name in enumerate(self._param_names):
                 kv.init(i, self._exec.arg_dict[name])
             if self._update_on_kvstore:

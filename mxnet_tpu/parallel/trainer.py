@@ -59,7 +59,7 @@ def _functional_apply(net, trainable, aux, n_in):
 def make_train_step(net, loss_fn, optimizer, mesh, data_spec=None,
                     label_spec=None,
                     param_rules=None, tp_axis="tp", dp_axis="dp",
-                    donate=True):
+                    donate=True, n_in=1):
     """Build ``(step_fn, init_args)`` for SPMD training of ``net``.
 
     - ``net``: an initialized (non-hybridized) Gluon block.
@@ -87,7 +87,16 @@ def make_train_step(net, loss_fn, optimizer, mesh, data_spec=None,
     specs = infer_param_specs(
         {p.name: p.shape for p in trainable}, mesh, rules=param_rules,
         tp_axis=tp_axis)
-    if data_spec is None:
+    if n_in > 1:
+        if data_spec is None:
+            data_spec = tuple(P(dp_axis) for _ in range(n_in))
+        elif isinstance(data_spec, P) or len(data_spec) != n_in:
+            # P is itself a tuple subclass — iterating it would yield raw
+            # axis names, so demand an explicit sequence of n_in specs
+            raise ValueError(
+                f"with n_in={n_in}, data_spec must be a sequence of {n_in} "
+                f"PartitionSpecs, got {data_spec!r}")
+    elif data_spec is None:
         data_spec = P(dp_axis)
     if label_spec is None:
         label_spec = P(dp_axis)
@@ -101,11 +110,12 @@ def make_train_step(net, loss_fn, optimizer, mesh, data_spec=None,
                           for s in v)
                  for k, v in optimizer.init_state(params).items()}
 
-    apply_fn = _functional_apply(net, trainable, aux, n_in=1)
+    apply_fn = _functional_apply(net, trainable, aux, n_in=n_in)
 
     def loss_of(par_dict, aux_raw, data, label, key):
-        out, new_aux = apply_fn([par_dict[n] for n in names], aux_raw, data,
-                                __key__=key)
+        inputs = data if isinstance(data, tuple) else (data,)
+        out, new_aux = apply_fn([par_dict[n] for n in names], aux_raw,
+                                *inputs, __key__=key)
         with autograd.pause(train_mode=True):
             loss = loss_fn(out, nd_mod._wrap(label))
             if isinstance(loss, NDArray):
@@ -125,7 +135,8 @@ def make_train_step(net, loss_fn, optimizer, mesh, data_spec=None,
          for k, v in opt_state.items()},
         [named_sharding(mesh, P()) for _ in aux_arrays],
     )
-    data_sh = named_sharding(mesh, data_spec)
+    data_sh = tuple(named_sharding(mesh, s) for s in data_spec) \
+        if n_in > 1 else named_sharding(mesh, data_spec)
     label_sh = named_sharding(mesh, label_spec)
     step_jit = jax.jit(step,
                        in_shardings=(state_sh, data_sh, label_sh, None, None),
@@ -170,8 +181,11 @@ class SPMDTrainer:
         return sequence_parallel_scope(*self._sp)
 
     def step(self, data, label):
-        data = data._data if isinstance(data, NDArray) else jnp.asarray(data)
-        label = label._data if isinstance(label, NDArray) else jnp.asarray(label)
+        def _raw(x):
+            return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        data = tuple(_raw(d) for d in data) \
+            if isinstance(data, (tuple, list)) else _raw(data)
+        label = _raw(label)
         key = _rnd.next_key()
         # the scope matters while jax traces the step (first call / retrace):
         # attention layers consult it to route through ring attention
