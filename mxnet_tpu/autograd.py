@@ -226,6 +226,17 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
         if g is None:
             continue
         buf = node.grad_buf
+        from .ndarray.sparse import RowSparseNDArray
+        if (isinstance(g, RowSparseNDArray) and g.is_compressed()
+                and isinstance(buf, RowSparseNDArray)
+                and node.grad_req != "add"):
+            # keep the gradient compressed end-to-end (O(nnz) memory): the
+            # buffer adopts the rows/indices without densifying
+            idx, vals = g._rs
+            if vals.dtype != buf.dtype:
+                vals = vals.astype(buf.dtype)
+            buf.adopt_rows(idx, vals, g._rs_shape)
+            continue
         gd = g._data.astype(buf.dtype) if g.dtype != buf.dtype else g._data
         if node.grad_req == "add":
             buf._data = buf._data + gd
@@ -266,6 +277,15 @@ def _node_vjp(node, gout_nds, create_graph):
 
     if node.custom_vjp is not None:
         return node.custom_vjp(gout_nds)
+
+    # ops can provide a storage-type-changing backward (Embedding
+    # sparse_grad → compressed row-sparse weight cotangent, the analog of
+    # the reference's kRowSparseStorage backward dispatch)
+    sparse_vjp = getattr(node.fn, "_sparse_vjp", None)
+    if sparse_vjp is not None and not create_graph:
+        sg = node.attrs.get("sparse_grad", False)
+        if sg if isinstance(sg, bool) else str(sg).lower() in ("true", "1"):
+            return sparse_vjp(node.attrs, node.in_nds, gout_nds)
 
     fn, attrs = node.fn, dict(node.attrs)
     n_in = len(node.in_nds)

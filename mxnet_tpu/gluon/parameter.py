@@ -172,8 +172,18 @@ class Parameter:
         if self.grad_req == "null":
             self._grad = None
             return
-        self._grad = nd.zeros(self._data.shape, dtype=self._data.dtype,
-                              ctx=self._data.context)
+        if self._grad_stype == "row_sparse":
+            # compressed zero-row gradient: the Embedding sparse backward
+            # swaps in its rows without ever allocating (vocab, dim)
+            import jax.numpy as jnp
+            from ..ndarray.sparse import RowSparseNDArray
+            shape = tuple(self._data.shape)
+            self._grad = RowSparseNDArray.from_rows(
+                jnp.zeros((0,), jnp.int32),
+                jnp.zeros((0,) + shape[1:], self._data.dtype), shape)
+        else:
+            self._grad = nd.zeros(self._data.shape, dtype=self._data.dtype,
+                                  ctx=self._data.context)
         autograd.mark_variables([self._data], [self._grad],
                                 grad_reqs=self.grad_req)
 
@@ -256,6 +266,16 @@ class Parameter:
     def zero_grad(self):
         """Zero the gradient buffer in place (reference ``parameter.py:562``)."""
         if self._grad is None:
+            return
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(self._grad, RowSparseNDArray):
+            # reset to an empty compressed gradient — never allocate the
+            # dense (vocab, dim) buffer just to zero it
+            import jax.numpy as jnp
+            shape = tuple(self._grad.shape)
+            self._grad.adopt_rows(jnp.zeros((0,), jnp.int32),
+                                  jnp.zeros((0,) + shape[1:], self.dtype),
+                                  shape)
             return
         self._grad[:] = 0
 

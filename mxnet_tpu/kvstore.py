@@ -202,10 +202,25 @@ class KVStore:
         self._check_keys(keys)
         if isinstance(row_ids, NDArray):
             row_ids = [row_ids] * len(keys)
+        from .ndarray.sparse import RowSparseNDArray
         for k, os_, rid in zip(keys, outs, row_ids):
             stored = self._store[k]
             for o in os_:
-                if o.shape != stored.shape:
+                if isinstance(o, RowSparseNDArray):
+                    # O(nnz): hand back only the requested rows, compressed
+                    # (reference kvstore.h:213 RowSparsePull; indices come
+                    # back unique and sorted like the reference's)
+                    import jax.numpy as jnp
+                    rid_np = np.unique(rid.asnumpy().astype("int64"))
+                    if len(rid_np) and (rid_np[0] < 0
+                                        or rid_np[-1] >= stored.shape[0]):
+                        raise ValueError(
+                            f"row_sparse_pull row_ids out of range for "
+                            f"shape {stored.shape}: {rid_np}")
+                    rows = jnp.asarray(rid_np.astype("int32"))
+                    o.adopt_rows(rows, stored._data[rows],
+                                 tuple(stored.shape))
+                elif o.shape != stored.shape:
                     stored.take(rid.as_in_context(stored.context)).copyto(o)
                 else:
                     stored.copyto(o)

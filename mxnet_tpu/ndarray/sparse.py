@@ -124,27 +124,119 @@ class CSRNDArray(BaseSparseNDArray):
 
 
 class RowSparseNDArray(BaseSparseNDArray):
-    """Row-sparse view over a dense payload (reference ``sparse.py:560``)."""
+    """Row-sparse array (reference ``sparse.py:560``).  Two storage modes:
+
+    - **compressed** (``from_rows``): only ``(indices, values)`` live on
+      device — O(nnz) memory, the asymptotics of the reference's
+      ``RowSparseNDArray`` (``src/ndarray/ndarray.cc`` kRowSparseStorage).
+      The Embedding ``sparse_grad`` backward and ``kvstore.row_sparse_pull``
+      produce this mode; the lazy optimizer kernels consume it without ever
+      densifying.  Indices may be padded with ``shape[0]`` (out-of-range)
+      entries from fixed-size ``jnp.unique`` — all consumers drop them.
+    - **dense-backed view** (any other constructor): compressed views are
+      derived on demand; every operator works on the dense payload.  A
+      ``._data`` read on a compressed array scatters into a dense array
+      lazily and caches it.
+    """
 
     _storage_type = "row_sparse"
 
+    def __init__(self, data):
+        self._rs = None               # (indices i32 (N,), values (N, ...cols))
+        self._dense = None
+        super().__init__(data)        # routes through the _data setter
+
+    @classmethod
+    def from_rows(cls, indices, values, shape, ctx=None):
+        """Compressed construction: nothing is densified."""
+        obj = cls.__new__(cls)
+        obj._ag_node = None
+        obj._ag_grad = None
+        obj._dense = None
+        obj._rs = None
+        obj.adopt_rows(indices, values, shape, ctx=ctx)
+        return obj
+
+    def adopt_rows(self, indices, values, shape=None, ctx=None):
+        """Atomically become a compressed array holding these rows.  The
+        single producer-side entry point — computes/validates everything
+        before touching state, so a failure leaves the array intact."""
+        import jax
+        import jax.numpy as jnp
+        shape = tuple(int(s) for s in
+                      (shape if shape is not None else self.shape))
+        idx = jnp.asarray(indices).astype(jnp.int32).reshape((-1,))
+        vals = jnp.asarray(values)
+        assert vals.shape[1:] == shape[1:] and vals.shape[0] == idx.shape[0], \
+            f"rows {vals.shape} do not match shape {shape} / idx {idx.shape}"
+        if ctx is not None:
+            dev = _to_jax_device(ctx)
+            if dev is not None:
+                idx, vals = jax.device_put(idx, dev), jax.device_put(vals, dev)
+        self._rs = (idx, vals)
+        self._rs_shape = shape
+        self._dense = None
+
+    def is_compressed(self):
+        return self._dense is None and self._rs is not None
+
+    # _data is a lazy property so compressed arrays only densify when some
+    # dense op actually touches them
+    @property
+    def _data(self):
+        if self._dense is None:
+            import jax.numpy as jnp
+            idx, vals = self._rs
+            self._dense = jnp.zeros(self._rs_shape, vals.dtype).at[idx].set(
+                vals, mode="drop")
+        return self._dense
+
+    @_data.setter
+    def _data(self, value):
+        self._dense = value
+        self._rs = None
+
+    @property
+    def shape(self):
+        if self.is_compressed():
+            return self._rs_shape
+        return tuple(self._dense.shape)
+
+    @property
+    def dtype(self):
+        if self.is_compressed():
+            return _np.dtype(self._rs[1].dtype)
+        return _np.dtype(self._dense.dtype)
+
     @property
     def data(self):
+        if self.is_compressed():
+            idx, vals = self._rs
+            mask = _np.asarray(idx) < self.shape[0]   # drop unique() padding
+            return _as_nd(vals[_np.nonzero(mask)[0]])
         arr = self.asnumpy()
         rows = _np.nonzero((arr != 0).reshape(arr.shape[0], -1).any(axis=1))[0]
         return _as_nd(arr[rows])
 
     @property
     def indices(self):
+        if self.is_compressed():
+            idx = _np.asarray(self._rs[0])
+            return _as_nd(idx[idx < self.shape[0]].astype(_np.int64))
         arr = self.asnumpy()
         rows = _np.nonzero((arr != 0).reshape(arr.shape[0], -1).any(axis=1))[0]
-        return _as_nd(rows.astype(_np.int32))
+        return _as_nd(rows.astype(_np.int64))
 
     def retain(self, rows):
         """Keep only the requested rows (reference ``sparse.retain``)."""
         import jax.numpy as jnp
         rows = rows.asnumpy().astype(_np.int64) if isinstance(rows, NDArray) \
             else _np.asarray(rows, dtype=_np.int64)
+        if self.is_compressed():
+            idx = _np.asarray(self._rs[0])
+            keep = _np.nonzero(_np.isin(idx, rows))[0]
+            return RowSparseNDArray.from_rows(
+                jnp.asarray(idx[keep]), self._rs[1][keep], self.shape)
         mask = _np.zeros(self.shape[0], dtype=bool)
         mask[rows] = True
         out = jnp.where(jnp.asarray(mask).reshape((-1,) + (1,) *
@@ -206,8 +298,9 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
         indices = _np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
                               else indices, dtype=_np.int64).ravel()
         assert shape is not None, "shape is required for (data, indices)"
-        dense = _np.zeros(shape, dtype=data.dtype)
-        dense[indices] = data
+        # O(nnz): only the present rows go to device
+        return RowSparseNDArray.from_rows(indices, jnp.asarray(data), shape,
+                                          ctx=ctx)
     else:
         dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
                             else arg1, dtype=dtype or _np.float32)

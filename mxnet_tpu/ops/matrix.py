@@ -9,6 +9,7 @@ reshape/transpose/gather/scatter, which are free or fused on TPU; ``dot`` and
 from __future__ import annotations
 
 import ast
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -276,9 +277,44 @@ def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
               sparse_grad=False):
     """Reference ``Embedding`` (indexing_op.cc): row gather; on TPU this is a
     single XLA gather and its VJP is the scatter-add the reference implements
-    by hand (``AddTakeGrad``)."""
+    by hand (``AddTakeGrad``).  With ``sparse_grad=True`` the eager tape
+    produces a compressed row-sparse weight gradient instead (reference
+    ``EmbeddingOpBackward`` kRowSparseStorage dispatch) — O(batch·dim)
+    gradient memory, consumed by the lazy optimizer kernels."""
     idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
     return jnp.take(weight, idx, axis=0)
+
+
+@functools.lru_cache(maxsize=None)
+def _embedding_rs_grad_fn(vocab):
+    @jax.jit
+    def f(idx_flat, gout_2d):
+        n = idx_flat.shape[0]
+        uniq, inv = jnp.unique(idx_flat, return_inverse=True, size=n,
+                               fill_value=vocab)
+        vals = jax.ops.segment_sum(gout_2d, inv.reshape((-1,)),
+                                   num_segments=n)
+        return uniq, vals
+    return f
+
+
+def _embedding_sparse_vjp(attrs, in_nds, gout_nds):
+    """Row-sparse cotangent for the weight input: unique input tokens as
+    indices (padded with ``vocab`` by the fixed-size unique), summed output
+    gradients as rows."""
+    from ..ndarray.sparse import RowSparseNDArray
+
+    data, weight = in_nds[0], in_nds[1]
+    gout = gout_nds[0]
+    vocab, dim = weight.shape
+    idx_flat = jnp.clip(data._data.astype(jnp.int32), 0,
+                        vocab - 1).reshape((-1,))
+    gout_2d = gout._data.reshape((-1, dim))
+    uniq, vals = _embedding_rs_grad_fn(vocab)(idx_flat, gout_2d)
+    return [None, RowSparseNDArray.from_rows(uniq, vals, (vocab, dim))]
+
+
+embedding._sparse_vjp = _embedding_sparse_vjp
 
 
 @register("one_hot")

@@ -244,3 +244,107 @@ def all_finite(*arrays, init_output=True):
 @register("multi_all_finite", wrap_list=True)
 def multi_all_finite(*arrays, num_arrays=1, init_output=True):
     return all_finite(*arrays)
+
+
+# ---------------------------------------------------------------------------
+# Lazy row-sparse updates (reference optimizer_op.cc sparse kernels:
+# SGDUpdateRspImpl / SGDMomLazyUpdateRspImpl / AdagradUpdateRspImpl /
+# AdamUpdateRspImpl): with a compressed row-sparse gradient only the rows
+# present in the gradient are read, updated, and scattered back — O(nnz)
+# compute and O(nnz) transient memory.  Rows absent from the batch keep
+# stale state (momentum/mean/var), exactly the reference lazy_update
+# semantics.  Padding indices (== num_rows, from fixed-size unique) read
+# clipped and scatter with mode="drop", so they are inert.
+# ---------------------------------------------------------------------------
+import functools as _functools
+
+import jax as _jax
+
+
+@_functools.lru_cache(maxsize=None)
+def _lazy_sgd(has_mom, has_clip):
+    @_jax.jit
+    def f(w, mom, rows, vals, lr, momentum, wd, rescale, clip):
+        wr = w[rows]
+        g = vals * rescale
+        if has_clip:
+            g = jnp.clip(g, -clip, clip)
+        g = g + wd * wr
+        if has_mom:
+            new_m = momentum * mom[rows] - lr * g
+            return (w.at[rows].set(wr + new_m, mode="drop"),
+                    mom.at[rows].set(new_m, mode="drop"))
+        return w.at[rows].set(wr - lr * g, mode="drop"), mom
+    return f
+
+
+@_functools.lru_cache(maxsize=None)
+def _lazy_adagrad(has_clip):
+    @_jax.jit
+    def f(w, hist, rows, vals, lr, eps, wd, rescale, clip):
+        wr = w[rows]
+        g = vals * rescale
+        if has_clip:
+            g = jnp.clip(g, -clip, clip)
+        new_h = hist[rows] + g * g
+        upd = g / jnp.sqrt(new_h + eps) + wd * wr
+        return (w.at[rows].set(wr - lr * upd, mode="drop"),
+                hist.at[rows].set(new_h, mode="drop"))
+    return f
+
+
+@_functools.lru_cache(maxsize=None)
+def _lazy_adam(has_clip):
+    @_jax.jit
+    def f(w, mean, var, rows, vals, lr, beta1, beta2, eps, wd, rescale,
+          clip):
+        wr = w[rows]
+        g = vals * rescale
+        if has_clip:
+            g = jnp.clip(g, -clip, clip)
+        g = g + wd * wr
+        new_mean = beta1 * mean[rows] + (1 - beta1) * g
+        new_var = beta2 * var[rows] + (1 - beta2) * g * g
+        new_w = wr - lr * new_mean / (jnp.sqrt(new_var) + eps)
+        return (w.at[rows].set(new_w, mode="drop"),
+                mean.at[rows].set(new_mean, mode="drop"),
+                var.at[rows].set(new_var, mode="drop"))
+    return f
+
+
+def apply_lazy_sgd(weight, grad_rs, mom, lr, momentum, wd, rescale_grad,
+                   clip_gradient):
+    """In-place lazy SGD(-momentum) on a compressed row-sparse grad.
+    ``weight``/``mom`` are NDArrays (mom may be None)."""
+    rows, vals = grad_rs._rs
+    has_clip = clip_gradient is not None and clip_gradient > 0
+    f = _lazy_sgd(mom is not None, has_clip)
+    new_w, new_m = f(weight._data, mom._data if mom is not None else rows,
+                     rows, vals, lr, momentum, wd, rescale_grad,
+                     clip_gradient if has_clip else 0.0)
+    weight._data = new_w
+    if mom is not None:
+        mom._data = new_m
+
+
+def apply_lazy_adagrad(weight, grad_rs, history, lr, eps, wd, rescale_grad,
+                       clip_gradient):
+    rows, vals = grad_rs._rs
+    has_clip = clip_gradient is not None and clip_gradient > 0
+    new_w, new_h = _lazy_adagrad(has_clip)(
+        weight._data, history._data, rows, vals, lr, eps, wd, rescale_grad,
+        clip_gradient if has_clip else 0.0)
+    weight._data = new_w
+    history._data = new_h
+
+
+def apply_lazy_adam(weight, grad_rs, mean, var, lr, beta1, beta2, eps, wd,
+                    rescale_grad, clip_gradient):
+    rows, vals = grad_rs._rs
+    has_clip = clip_gradient is not None and clip_gradient > 0
+    new_w, new_mean, new_var = _lazy_adam(has_clip)(
+        weight._data, mean._data, var._data, rows, vals, lr, beta1, beta2,
+        eps, wd, rescale_grad, clip_gradient if has_clip else 0.0)
+    weight._data = new_w
+    mean._data = new_mean
+    var._data = new_var

@@ -1,0 +1,191 @@
+"""Row-sparse gradient path (reference ``src/operator/optimizer_op.cc``
+sparse kernels, ``python/mxnet/optimizer/optimizer.py`` lazy_update,
+``include/mxnet/kvstore.h:213`` RowSparsePull, and
+``tests/python/train/test_sparse_fm.py``-style embedding training).
+
+The capability under test is asymptotic, not just numeric: gradients for
+``Embedding(sparse_grad=True)`` must be O(batch·dim) compressed rows, the
+lazy optimizers must touch only present rows (absent rows keep stale
+momentum), and ``row_sparse_pull`` must return only the requested rows.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+VOCAB, DIM = 50000, 16
+
+
+def _embed(vocab=VOCAB, dim=DIM):
+    emb = mx.gluon.nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize()
+    emb(mx.nd.zeros((1, 1), dtype="int32"))   # materialize deferred init
+    return emb
+
+
+def test_row_sparse_ctor_is_compressed():
+    rs = mx.nd.sparse.row_sparse_array(
+        (np.ones((2, 4), "float32"), [1, 5]), shape=(10000, 4))
+    assert rs.is_compressed()
+    np.testing.assert_array_equal(rs.indices.asnumpy(), [1, 5])
+    assert rs.data.shape == (2, 4)
+    # dense materialization is lazy and correct
+    d = rs.asnumpy()
+    assert d.shape == (10000, 4) and d[1].sum() == 4 and d[2].sum() == 0
+
+
+def test_embedding_sparse_grad_memory_is_o_batch():
+    emb = _embed()
+    x = mx.nd.array([[3, 17, 3], [99, 4096, 17]], dtype="int32")
+    with mx.autograd.record():
+        emb(x).sum().backward()
+    g = emb.weight.grad()
+    assert isinstance(g, RowSparseNDArray) and g.is_compressed()
+    rows, vals = g._rs
+    # O(batch·dim): 6 token slots, never (VOCAB, DIM)
+    assert vals.shape == (6, DIM)
+    assert g._dense is None, "gradient must not densify"
+    # duplicates are summed into one row
+    got = dict(zip(np.asarray(g.indices.asnumpy()).tolist(),
+                   np.asarray(g.data.asnumpy())[:, 0].tolist()))
+    assert got[3] == pytest.approx(2.0)
+    assert got[17] == pytest.approx(2.0)
+    assert got[4096] == pytest.approx(1.0)
+    assert sorted(got) == [3, 17, 99, 4096]
+
+
+def test_lazy_sgd_momentum_absent_rows_stay_stale():
+    """Reference SGDMomLazyUpdateRspImpl: a row absent from the batch keeps
+    its momentum *unchanged* (no decay applied) and its weight frozen."""
+    emb = _embed(vocab=100, dim=4)
+    tr = mx.gluon.Trainer(emb.collect_params(), "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9})
+    # step 1 touches rows {1, 2}
+    with mx.autograd.record():
+        emb(mx.nd.array([[1, 2]], dtype="int32")).sum().backward()
+    tr.step(1)
+    state = tr._updaters[0].states
+    mom = next(iter(state.values()))
+    mom = mom[0] if isinstance(mom, (list, tuple)) else mom
+    mom1 = mom.asnumpy().copy()
+    w1 = emb.weight.data().asnumpy().copy()
+    assert np.abs(mom1[1]).sum() > 0 and np.abs(mom1[2]).sum() > 0
+    # step 2 touches only row {2}: row 1 must be completely frozen
+    with mx.autograd.record():
+        emb(mx.nd.array([[2]], dtype="int32")).sum().backward()
+    tr.step(1)
+    mom2 = mom.asnumpy()
+    w2 = emb.weight.data().asnumpy()
+    np.testing.assert_array_equal(mom2[1], mom1[1])   # stale momentum kept
+    np.testing.assert_array_equal(w2[1], w1[1])       # weight frozen
+    assert np.abs(mom2[2] - mom1[2]).sum() > 0        # present row updated
+
+
+def test_lazy_sgd_matches_rowwise_formula():
+    emb = _embed(vocab=30, dim=4)
+    lr, momentum, wd = 0.1, 0.9, 0.01
+    tr = mx.gluon.Trainer(emb.collect_params(), "sgd",
+                          {"learning_rate": lr, "momentum": momentum,
+                           "wd": wd})
+    w0 = emb.weight.data().asnumpy().copy()
+    x = mx.nd.array([[5, 9]], dtype="int32")
+    with mx.autograd.record():
+        emb(x).sum().backward()
+    tr.step(1)
+    w1 = emb.weight.data().asnumpy()
+    for r in (5, 9):
+        g = np.ones(4, "float32") + wd * w0[r]   # rescale=1 (batch 1)
+        expect = w0[r] + (momentum * 0 - lr * g)
+        np.testing.assert_allclose(w1[r], expect, rtol=1e-6)
+    untouched = [r for r in range(30) if r not in (5, 9)]
+    np.testing.assert_array_equal(w1[untouched], w0[untouched])
+
+
+@pytest.mark.parametrize("optname,kw", [
+    ("adagrad", {"learning_rate": 0.1}),
+    ("adam", {"learning_rate": 0.01}),
+])
+def test_lazy_adagrad_adam_touch_only_present_rows(optname, kw):
+    emb = _embed(vocab=64, dim=4)
+    tr = mx.gluon.Trainer(emb.collect_params(), optname, dict(kw))
+    w0 = emb.weight.data().asnumpy().copy()
+    with mx.autograd.record():
+        emb(mx.nd.array([[7, 13]], dtype="int32")).sum().backward()
+    tr.step(1)
+    w1 = emb.weight.data().asnumpy()
+    changed = np.nonzero(np.abs(w1 - w0).sum(axis=1))[0].tolist()
+    assert sorted(changed) == [7, 13]
+
+
+def test_sparse_embedding_model_trains():
+    """Sparse-FM-style workload: bag-of-tokens embedding + linear head
+    learns a separable toy problem with lazy sparse updates only."""
+    vocab, dim, nclass = 10000, 8, 3
+    rng = np.random.RandomState(0)
+    # class c ≡ tokens drawn from a distinct, far-apart vocab region
+    xs = np.stack([rng.randint(c * 3000, c * 3000 + 50, size=4)
+                   for c in rng.randint(0, nclass, 200).tolist()])
+    ys = (xs[:, 0] // 3000).astype("float32")
+
+    net = mx.gluon.nn.Sequential()
+    emb = mx.gluon.nn.Embedding(vocab, dim, sparse_grad=True)
+    net.add(emb)
+    net.add(mx.gluon.nn.Lambda(lambda x: x.mean(axis=1)))
+    net.add(mx.gluon.nn.Dense(nclass))
+    net.initialize()
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 0.05})
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    first = last = None
+    for _ in range(30):
+        with mx.autograd.record():
+            loss = loss_fn(net(mx.nd.array(xs, dtype="int32")),
+                           mx.nd.array(ys))
+        loss.backward()
+        tr.step(len(xs))
+        v = float(loss.mean().asscalar())
+        first = v if first is None else first
+        last = v
+    assert last < first * 0.3, (first, last)
+    g = emb.weight.grad()
+    assert g.is_compressed(), "training must keep gradients compressed"
+
+
+def test_hybridized_embedding_falls_back_dense_correctly():
+    """Under hybridize the fused jit produces dense grads; writing them into
+    the row-sparse buffer must densify it (correctness over sparsity)."""
+    emb = _embed(vocab=50, dim=4)
+    emb.hybridize()
+    x = mx.nd.array([[1, 2]], dtype="int32")
+    with mx.autograd.record():
+        emb(x).sum().backward()
+    g = emb.weight.grad()
+    gd = g.asnumpy()
+    assert gd[1].sum() == 4 and gd[3].sum() == 0
+
+
+def test_kvstore_row_sparse_pull_compressed():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.arange(40).reshape((10, 4)))
+    out = mx.nd.sparse.zeros("row_sparse", (10, 4))
+    kv.row_sparse_pull(3, out=out, row_ids=mx.nd.array([2, 5]))
+    assert out.is_compressed()
+    np.testing.assert_array_equal(out.indices.asnumpy(), [2, 5])
+    np.testing.assert_allclose(out.data.asnumpy(),
+                               np.arange(40).reshape(10, 4)[[2, 5]])
+
+
+def test_retain_and_zero_grad_compressed():
+    rs = mx.nd.sparse.row_sparse_array(
+        (np.arange(8, dtype="float32").reshape(2, 4), [3, 7]), shape=(20, 4))
+    kept = rs.retain(mx.nd.array([3, 11]))
+    assert kept.is_compressed()
+    np.testing.assert_array_equal(kept.indices.asnumpy(), [3])
+    emb = _embed(vocab=40, dim=4)
+    with mx.autograd.record():
+        emb(mx.nd.array([[1]], dtype="int32")).sum().backward()
+    p = emb.weight
+    assert p.grad().indices.shape[0] == 1
+    p.zero_grad()
+    assert p.grad().is_compressed() and p.grad().indices.shape[0] == 0
