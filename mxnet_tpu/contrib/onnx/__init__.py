@@ -1,18 +1,21 @@
 """ONNX import/export (reference ``python/mxnet/contrib/onnx/``).
 
-Gated: the ``onnx`` protobuf package is not present in this zero-egress
-image, so these entry points raise with instructions instead of failing at
-import time.  The graph machinery they need (Symbol topo walk + op table,
-``mxnet_tpu/symbol``) is in place; the converter tables are the remaining
-work once the dependency is available.
+The converter machinery — symbol topo-walk, per-op converter tables both
+directions, parameter/initializer extraction — is wheel-independent and
+operates on a plain-dict graph (see :mod:`.mx2onnx`).  Only protobuf
+(de)serialization needs the ``onnx`` package, which is absent in this
+zero-egress image; those two steps (``graph_to_proto``/``proto_to_graph``)
+raise with instructions, everything else runs and is tested.
 """
 from __future__ import annotations
 
-__all__ = ["import_model", "export_model", "get_model_metadata"]
+__all__ = ["import_model", "export_model", "get_model_metadata",
+           "export_graph", "graph_to_proto", "import_graph",
+           "proto_to_graph", "mx2onnx", "onnx2mx"]
 
-_MSG = ("ONNX support requires the 'onnx' package, which is not available "
-        "in this environment (no network access). Install onnx and re-run; "
-        "the converter operates on mxnet_tpu.symbol graphs.")
+_MSG = ("this step needs the 'onnx' protobuf package, which is not "
+        "available in this environment (no network access); the dict-level "
+        "converters (export_graph/import_graph) work without it")
 
 
 def _require_onnx():
@@ -22,19 +25,15 @@ def _require_onnx():
         raise ImportError(_MSG) from e
 
 
-def import_model(model_file):
-    """Reference ``onnx2mx/import_model.py``."""
-    _require_onnx()
-    raise NotImplementedError(_MSG)
-
-
-def export_model(sym, params, input_shape, input_type=None,
-                 onnx_file_path="model.onnx", verbose=False):
-    """Reference ``mx2onnx/export_model.py``."""
-    _require_onnx()
-    raise NotImplementedError(_MSG)
+from . import mx2onnx, onnx2mx  # noqa: E402
+from .mx2onnx import export_graph, export_model, graph_to_proto  # noqa: E402
+from .onnx2mx import import_graph, import_model, proto_to_graph  # noqa: E402
 
 
 def get_model_metadata(model_file):
-    _require_onnx()
-    raise NotImplementedError(_MSG)
+    """Reference ``onnx2mx/import_model.py:get_model_metadata``."""
+    graph = proto_to_graph(model_file)
+    return {"input_tensor_data": [(i["name"], i["shape"])
+                                  for i in graph["inputs"]],
+            "output_tensor_data": [(o["name"], None)
+                                   for o in graph["outputs"]]}

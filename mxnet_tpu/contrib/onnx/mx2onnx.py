@@ -1,0 +1,376 @@
+"""Symbol → ONNX graph conversion (reference
+``python/mxnet/contrib/onnx/mx2onnx/export_onnx.py`` MXNetGraph +
+``_op_translations.py`` converter table).
+
+The converter is wheel-independent: it produces a plain-dict ONNX graph
+(nodes with ``op_type``/``inputs``/``outputs``/``attrs``, initializers as
+numpy arrays) that round-trips through :mod:`.onnx2mx` and is structurally
+testable without protobuf.  Only :func:`graph_to_proto` (and therefore
+``export_model``'s file emission) needs the real ``onnx`` package.
+
+Graph dict schema::
+
+    {"nodes": [{"op_type", "name", "inputs": [names], "outputs": [names],
+                "attrs": {...python values...}}, ...],
+     "inputs": [{"name", "shape", "dtype"}],
+     "outputs": [{"name"}],
+     "initializers": {name: np.ndarray}}
+"""
+from __future__ import annotations
+
+import ast
+import json
+
+import numpy as _np
+
+_MX2ONNX = {}
+
+
+def register(op_name):
+    def deco(fn):
+        _MX2ONNX[op_name] = fn
+        return fn
+    return deco
+
+
+def _parse(v, default=None):
+    """MXNet string attr → python value ('(2, 2)' → (2, 2), 'True' → True)."""
+    if v is None:
+        return default
+    if not isinstance(v, str):
+        return v
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def _tuple2(v, default):
+    t = _parse(v, default)
+    if isinstance(t, int):
+        t = (t,) * len(default)
+    return tuple(int(x) for x in t)
+
+
+class _Ctx:
+    """Conversion state handed to each op converter."""
+
+    def __init__(self, params, input_map):
+        self.params = params          # name -> np.ndarray (initializers)
+        self.input_map = input_map    # mx node-name -> onnx tensor name
+        self.nodes = []
+        self.extra_initializers = {}
+
+    def inp(self, name):
+        return self.input_map.get(name, name)
+
+    def add(self, op_type, name, inputs, attrs=None, outputs=None):
+        self.nodes.append({
+            "op_type": op_type, "name": name, "inputs": list(inputs),
+            "outputs": list(outputs) if outputs else [name],
+            "attrs": dict(attrs or {})})
+        return self.nodes[-1]["outputs"][0]
+
+
+# --------------------------------------------------------------- converters
+@register("Convolution")
+def _conv(ctx, name, ins, attrs):
+    kernel = _tuple2(attrs.get("kernel"), (1, 1))
+    a = {"kernel_shape": kernel,
+         "strides": _tuple2(attrs.get("stride"), (1,) * len(kernel)),
+         "dilations": _tuple2(attrs.get("dilate"), (1,) * len(kernel)),
+         "group": int(_parse(attrs.get("num_group"), 1))}
+    pad = _tuple2(attrs.get("pad"), (0,) * len(kernel))
+    a["pads"] = pad + pad            # onnx wants begin+end per spatial axis
+    return ctx.add("Conv", name, ins, a)
+
+
+@register("Deconvolution")
+def _deconv(ctx, name, ins, attrs):
+    kernel = _tuple2(attrs.get("kernel"), (1, 1))
+    pad = _tuple2(attrs.get("pad"), (0,) * len(kernel))
+    a = {"kernel_shape": kernel,
+         "strides": _tuple2(attrs.get("stride"), (1,) * len(kernel)),
+         "dilations": _tuple2(attrs.get("dilate"), (1,) * len(kernel)),
+         "group": int(_parse(attrs.get("num_group"), 1)),
+         "pads": pad + pad}
+    return ctx.add("ConvTranspose", name, ins, a)
+
+
+@register("BatchNorm")
+def _batchnorm(ctx, name, ins, attrs):
+    # ins = [data, gamma, beta, moving_mean, moving_var]
+    if _parse(attrs.get("fix_gamma"), True) in (True, 1, "True"):
+        gamma_name = ins[1]
+        if gamma_name in ctx.params:
+            ctx.extra_initializers[gamma_name] = _np.ones_like(
+                ctx.params[gamma_name])
+    return ctx.add("BatchNormalization", name, ins, {
+        "epsilon": float(_parse(attrs.get("eps"), 1e-3)),
+        "momentum": float(_parse(attrs.get("momentum"), 0.9))})
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+@register("Activation")
+def _activation(ctx, name, ins, attrs):
+    return ctx.add(_ACT[attrs.get("act_type", "relu")], name, ins)
+
+
+@register("LeakyReLU")
+def _leaky(ctx, name, ins, attrs):
+    act = attrs.get("act_type", "leaky")
+    if act == "leaky":
+        return ctx.add("LeakyRelu", name, ins[:1],
+                       {"alpha": float(_parse(attrs.get("slope"), 0.25))})
+    if act == "elu":
+        return ctx.add("Elu", name, ins[:1],
+                       {"alpha": float(_parse(attrs.get("slope"), 0.25))})
+    if act == "prelu":
+        return ctx.add("PRelu", name, ins)
+    raise NotImplementedError(f"LeakyReLU act_type={act}")
+
+
+@register("Pooling")
+def _pooling(ctx, name, ins, attrs):
+    ptype = attrs.get("pool_type", "max")
+    if _parse(attrs.get("global_pool"), False) in (True, 1, "True"):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[ptype]
+        return ctx.add(op, name, ins)
+    kernel = _tuple2(attrs.get("kernel"), (1, 1))
+    pad = _tuple2(attrs.get("pad"), (0,) * len(kernel))
+    a = {"kernel_shape": kernel,
+         "strides": _tuple2(attrs.get("stride"), (1,) * len(kernel)),
+         "pads": pad + pad}
+    if ptype == "avg":
+        a["count_include_pad"] = 0 \
+            if attrs.get("count_include_pad", "True") in ("False", False) \
+            else 1
+        return ctx.add("AveragePool", name, ins, a)
+    return ctx.add("MaxPool", name, ins, a)
+
+
+@register("FullyConnected")
+def _fc(ctx, name, ins, attrs):
+    flat = ctx.add("Flatten", name + "_flatten", ins[:1], {"axis": 1})
+    no_bias = _parse(attrs.get("no_bias"), False) in (True, 1, "True")
+    if no_bias:
+        # Gemm needs C; synthesize a zero bias initializer
+        w = ctx.params.get(ins[1])
+        zname = name + "_zero_bias"
+        ctx.extra_initializers[zname] = _np.zeros(
+            (int(_parse(attrs.get("num_hidden"),
+                        w.shape[0] if w is not None else 0)),), "float32")
+        gemm_in = [flat, ins[1], zname]
+    else:
+        gemm_in = [flat, ins[1], ins[2]]
+    return ctx.add("Gemm", name, gemm_in,
+                   {"alpha": 1.0, "beta": 1.0, "transA": 0, "transB": 1})
+
+
+@register("Flatten")
+def _flatten(ctx, name, ins, attrs):
+    return ctx.add("Flatten", name, ins, {"axis": 1})
+
+
+@register("SoftmaxOutput")
+def _softmax_output(ctx, name, ins, attrs):
+    # label input is dropped; inference softmax over axis 1 (reference
+    # _op_translations softmax_output)
+    return ctx.add("Softmax", name, ins[:1], {"axis": 1})
+
+
+@register("softmax")
+def _softmax(ctx, name, ins, attrs):
+    return ctx.add("Softmax", name, ins,
+                   {"axis": int(_parse(attrs.get("axis"), -1))})
+
+
+@register("Concat")
+def _concat(ctx, name, ins, attrs):
+    return ctx.add("Concat", name, ins,
+                   {"axis": int(_parse(attrs.get("dim"), 1))})
+
+
+@register("Dropout")
+def _dropout(ctx, name, ins, attrs):
+    return ctx.add("Dropout", name, ins,
+                   {"ratio": float(_parse(attrs.get("p"), 0.5))})
+
+
+@register("Reshape")
+def _reshape(ctx, name, ins, attrs):
+    shape = _tuple2(attrs.get("shape"), ())
+    sname = name + "_shape"
+    ctx.extra_initializers[sname] = _np.asarray(shape, dtype=_np.int64)
+    return ctx.add("Reshape", name, [ins[0], sname])
+
+
+@register("transpose")
+def _transpose(ctx, name, ins, attrs):
+    axes = _parse(attrs.get("axes"), None)
+    a = {"perm": tuple(int(x) for x in axes)} if axes else {}
+    return ctx.add("Transpose", name, ins, a)
+
+
+@register("Embedding")
+def _embedding(ctx, name, ins, attrs):
+    # ONNX Gather(data=weight, indices)
+    return ctx.add("Gather", name, [ins[1], ins[0]], {"axis": 0})
+
+
+@register("mean")
+def _mean(ctx, name, ins, attrs):
+    axis = _parse(attrs.get("axis"), None)
+    a = {"keepdims": 1 if _parse(attrs.get("keepdims"), False)
+         in (True, 1, "True") else 0}
+    if axis is not None:
+        a["axes"] = tuple(axis) if isinstance(axis, (tuple, list)) \
+            else (int(axis),)
+    return ctx.add("ReduceMean", name, ins, a)
+
+
+@register("clip")
+def _clip(ctx, name, ins, attrs):
+    return ctx.add("Clip", name, ins,
+                   {"min": float(_parse(attrs.get("a_min"), 0.0)),
+                    "max": float(_parse(attrs.get("a_max"), 0.0))})
+
+
+def _binop(onnx_op):
+    def cv(ctx, name, ins, attrs):
+        return ctx.add(onnx_op, name, ins)
+    return cv
+
+
+for _mx, _ox in [("elemwise_add", "Add"), ("broadcast_add", "Add"),
+                 ("_plus", "Add"),
+                 ("elemwise_sub", "Sub"), ("broadcast_sub", "Sub"),
+                 ("elemwise_mul", "Mul"), ("broadcast_mul", "Mul"),
+                 ("elemwise_div", "Div"), ("broadcast_div", "Div"),
+                 ("dot", "MatMul")]:
+    register(_mx)(_binop(_ox))
+
+
+def _scalar_op(onnx_op):
+    def cv(ctx, name, ins, attrs):
+        sname = name + "_scalar"
+        ctx.extra_initializers[sname] = _np.asarray(
+            float(_parse(attrs.get("scalar"), 0.0)), dtype=_np.float32)
+        return ctx.add(onnx_op, name, [ins[0], sname])
+    return cv
+
+
+for _mx, _ox in [("_plus_scalar", "Add"), ("_minus_scalar", "Sub"),
+                 ("_mul_scalar", "Mul"), ("_div_scalar", "Div")]:
+    register(_mx)(_scalar_op(_ox))
+
+
+for _mx, _ox in [("relu", "Relu"), ("sigmoid", "Sigmoid"), ("tanh", "Tanh"),
+                 ("exp", "Exp"), ("log", "Log"), ("sqrt", "Sqrt"),
+                 ("abs", "Abs"), ("negative", "Neg"), ("identity", "Identity"),
+                 ("BlockGrad", "Identity")]:
+    register(_mx)(_binop(_ox))
+
+
+# ------------------------------------------------------------------ exporter
+def export_graph(sym, params, input_shapes, input_dtype="float32"):
+    """Convert a Symbol + params to the plain-dict ONNX graph.
+
+    ``params``: dict name → NDArray/np.ndarray (arg + aux, as saved by
+    ``save_checkpoint``; ``arg:``/``aux:`` prefixes accepted).
+    ``input_shapes``: dict data-name → shape (or a single shape for the
+    sole non-param input).
+    """
+    graph = json.loads(sym.tojson())
+    nodes, heads = graph["nodes"], graph["heads"]
+    np_params = {}
+    for k, v in (params or {}).items():
+        k = k.split(":", 1)[1] if k.startswith(("arg:", "aux:")) else k
+        np_params[k] = v.asnumpy() if hasattr(v, "asnumpy") else _np.asarray(v)
+
+    # one output tensor name per (node, out_idx)
+    def out_name(i, j):
+        base = nodes[i]["name"]
+        return base if j == 0 else f"{base}_out{j}"
+
+    ctx = _Ctx(np_params, {})
+    for i, n in enumerate(nodes):
+        if n["op"] == "null":
+            continue
+        conv = _MX2ONNX.get(n["op"])
+        if conv is None:
+            raise NotImplementedError(
+                f"no ONNX converter for op {n['op']!r} (node {n['name']})")
+        ins = [out_name(src, j) for (src, j, _) in n["inputs"]]
+        out = conv(ctx, n["name"], ins, n.get("attrs", {}))
+        # every converter's final node must carry the mx node's name — that
+        # is how downstream nodes reference this output
+        assert out == out_name(i, 0), \
+            f"converter for {n['op']} renamed output {out!r}"
+
+    # graph inputs = variables the emitted nodes actually reference (labels
+    # consumed only by dropped training heads vanish, like the reference
+    # exporter's forbidden/label handling)
+    used = {x for n in ctx.nodes for x in n["inputs"]}
+    data_inputs = [n["name"] for n in nodes
+                   if n["op"] == "null" and n["name"] not in np_params
+                   and n["name"] in used]
+    if not isinstance(input_shapes, dict):
+        assert len(data_inputs) == 1, \
+            f"need an input_shapes dict for inputs {data_inputs}"
+        input_shapes = {data_inputs[0]: tuple(input_shapes)}
+
+    inits = dict(np_params)
+    inits.update(ctx.extra_initializers)
+    inits = {k: v for k, v in inits.items() if k in used}
+    return {
+        "nodes": ctx.nodes,
+        "inputs": [{"name": d, "shape": tuple(input_shapes[d]),
+                    "dtype": input_dtype} for d in data_inputs],
+        "outputs": [{"name": out_name(i, j)} for (i, j, _) in heads],
+        "initializers": inits,
+    }
+
+
+def graph_to_proto(graph):
+    """Plain-dict graph → onnx.ModelProto — the ONLY wheel-gated step."""
+    from . import _require_onnx
+    _require_onnx()
+    import onnx
+    from onnx import helper, numpy_helper, TensorProto
+
+    dt = {"float32": TensorProto.FLOAT, "float64": TensorProto.DOUBLE,
+          "int32": TensorProto.INT32, "int64": TensorProto.INT64}
+    onodes = []
+    for n in graph["nodes"]:
+        attrs = {}
+        for k, v in n["attrs"].items():
+            attrs[k] = list(v) if isinstance(v, tuple) else v
+        onodes.append(helper.make_node(n["op_type"], n["inputs"],
+                                       n["outputs"], name=n["name"], **attrs))
+    inputs = [helper.make_tensor_value_info(i["name"], dt[i["dtype"]],
+                                            list(i["shape"]))
+              for i in graph["inputs"]]
+    outputs = [helper.make_tensor_value_info(o["name"], dt["float32"], None)
+               for o in graph["outputs"]]
+    inits = [numpy_helper.from_array(v, name=k)
+             for k, v in graph["initializers"].items()]
+    g = helper.make_graph(onodes, "mxnet_tpu", inputs, outputs,
+                          initializer=inits)
+    return helper.make_model(g)
+
+
+def export_model(sym, params, input_shape, input_type="float32",
+                 onnx_file_path="model.onnx", verbose=False):
+    """Reference ``mx2onnx/export_model.py:export_model``: converts and
+    writes a ``.onnx`` file (requires the onnx wheel for this last step)."""
+    graph = export_graph(sym, params, input_shape, input_dtype=input_type)
+    model = graph_to_proto(graph)
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.SerializeToString())
+    if verbose:
+        print(f"exported {onnx_file_path}")
+    return onnx_file_path

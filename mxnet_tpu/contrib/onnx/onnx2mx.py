@@ -1,0 +1,286 @@
+"""ONNX graph → Symbol conversion (reference
+``python/mxnet/contrib/onnx/onnx2mx/import_onnx.py`` GraphProto +
+``_op_translations.py``).
+
+Operates on the same plain-dict graph schema as :mod:`.mx2onnx`, so the
+whole converter (walk + op table + parameter extraction) runs and is tested
+without the onnx wheel; only :func:`proto_to_graph` (file parsing) needs it.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+_ONNX2MX = {}
+
+
+def register(op_type):
+    def deco(fn):
+        _ONNX2MX[op_type] = fn
+        return fn
+    return deco
+
+
+def _pads_to_mx(pads):
+    if pads is None:
+        return None
+    pads = tuple(int(p) for p in pads)
+    half = len(pads) // 2
+    begin, end = pads[:half], pads[half:]
+    assert begin == end, f"asymmetric pads {pads} unsupported"
+    return begin
+
+
+# --------------------------------------------------------------- converters
+@register("Conv")
+def _conv(sym, ins, attrs, name):
+    kw = {"kernel": tuple(attrs["kernel_shape"]),
+          "num_filter": 0,   # patched by importer from the weight shape
+          "stride": tuple(attrs.get("strides", ())) or None,
+          "dilate": tuple(attrs.get("dilations", ())) or None,
+          "pad": _pads_to_mx(attrs.get("pads")),
+          "num_group": int(attrs.get("group", 1)),
+          "no_bias": len(ins) < 3}
+    return ("Convolution", kw)
+
+
+@register("ConvTranspose")
+def _convt(sym, ins, attrs, name):
+    kw = {"kernel": tuple(attrs["kernel_shape"]),
+          "num_filter": 0,
+          "stride": tuple(attrs.get("strides", ())) or None,
+          "dilate": tuple(attrs.get("dilations", ())) or None,
+          "pad": _pads_to_mx(attrs.get("pads")),
+          "num_group": int(attrs.get("group", 1)),
+          "no_bias": len(ins) < 3}
+    return ("Deconvolution", kw)
+
+
+@register("BatchNormalization")
+def _bn(sym, ins, attrs, name):
+    return ("BatchNorm", {"eps": float(attrs.get("epsilon", 1e-5)),
+                          "momentum": float(attrs.get("momentum", 0.9)),
+                          "fix_gamma": False})
+
+
+@register("Gemm")
+def _gemm(sym, ins, attrs, name):
+    assert int(attrs.get("transB", 0)) == 1 and \
+        int(attrs.get("transA", 0)) == 0, "only transB=1 Gemm maps to FC"
+    return ("FullyConnected", {"num_hidden": 0, "no_bias": len(ins) < 3})
+
+
+_SIMPLE = {
+    "Relu": ("relu", {}), "Sigmoid": ("sigmoid", {}), "Tanh": ("tanh", {}),
+    "Softplus": ("Activation", {"act_type": "softrelu"}),
+    "Softsign": ("Activation", {"act_type": "softsign"}),
+    "Exp": ("exp", {}), "Log": ("log", {}), "Sqrt": ("sqrt", {}),
+    "Abs": ("abs", {}), "Neg": ("negative", {}),
+    "Identity": ("identity", {}),
+    "Add": ("broadcast_add", {}), "Sub": ("broadcast_sub", {}),
+    "Mul": ("broadcast_mul", {}), "Div": ("broadcast_div", {}),
+    "MatMul": ("dot", {}),
+}
+for _ox, (_mx, _kw) in _SIMPLE.items():
+    register(_ox)(lambda sym, ins, attrs, name, _mx=_mx, _kw=_kw:
+                  (_mx, dict(_kw)))
+
+
+@register("Flatten")
+def _flatten(sym, ins, attrs, name):
+    return ("Flatten", {})
+
+
+@register("Softmax")
+def _softmax(sym, ins, attrs, name):
+    return ("softmax", {"axis": int(attrs.get("axis", -1))})
+
+
+@register("Concat")
+def _concat(sym, ins, attrs, name):
+    return ("Concat", {"dim": int(attrs.get("axis", 1))})
+
+
+@register("Dropout")
+def _dropout(sym, ins, attrs, name):
+    return ("Dropout", {"p": float(attrs.get("ratio", 0.5))})
+
+
+@register("LeakyRelu")
+def _leaky(sym, ins, attrs, name):
+    return ("LeakyReLU", {"act_type": "leaky",
+                          "slope": float(attrs.get("alpha", 0.01))})
+
+
+@register("Elu")
+def _elu(sym, ins, attrs, name):
+    return ("LeakyReLU", {"act_type": "elu",
+                          "slope": float(attrs.get("alpha", 1.0))})
+
+
+@register("MaxPool")
+def _maxpool(sym, ins, attrs, name):
+    return ("Pooling", {"pool_type": "max",
+                        "kernel": tuple(attrs["kernel_shape"]),
+                        "stride": tuple(attrs.get("strides", ())) or None,
+                        "pad": _pads_to_mx(attrs.get("pads"))})
+
+
+@register("AveragePool")
+def _avgpool(sym, ins, attrs, name):
+    return ("Pooling", {"pool_type": "avg",
+                        "kernel": tuple(attrs["kernel_shape"]),
+                        "stride": tuple(attrs.get("strides", ())) or None,
+                        "pad": _pads_to_mx(attrs.get("pads")),
+                        "count_include_pad":
+                            bool(attrs.get("count_include_pad", 1))})
+
+
+@register("GlobalMaxPool")
+def _gmaxpool(sym, ins, attrs, name):
+    return ("Pooling", {"pool_type": "max", "global_pool": True,
+                        "kernel": (1, 1)})
+
+
+@register("GlobalAveragePool")
+def _gavgpool(sym, ins, attrs, name):
+    return ("Pooling", {"pool_type": "avg", "global_pool": True,
+                        "kernel": (1, 1)})
+
+
+@register("ReduceMean")
+def _rmean(sym, ins, attrs, name):
+    return ("mean", {"axis": tuple(attrs.get("axes", ())) or None,
+                     "keepdims": bool(attrs.get("keepdims", 1))})
+
+
+@register("Clip")
+def _clip(sym, ins, attrs, name):
+    return ("clip", {"a_min": float(attrs.get("min", -3.4e38)),
+                     "a_max": float(attrs.get("max", 3.4e38))})
+
+
+@register("Gather")
+def _gather(sym, ins, attrs, name):
+    # (weight, indices) → Embedding(indices, weight); importer fixes arity
+    assert int(attrs.get("axis", 0)) == 0, "Gather axis != 0 unsupported"
+    return ("__gather__", {})
+
+
+@register("Transpose")
+def _transpose(sym, ins, attrs, name):
+    perm = attrs.get("perm")
+    return ("transpose", {"axes": tuple(perm)} if perm else ("transpose", {}))
+
+
+@register("Reshape")
+def _reshape(sym, ins, attrs, name):
+    return ("__reshape__", {})
+
+
+# ------------------------------------------------------------------ importer
+def import_graph(graph):
+    """Plain-dict ONNX graph → ``(sym, arg_params, aux_params)`` (reference
+    ``import_onnx.py GraphProto.from_onnx``).  Wheel-free."""
+    return _import_graph_impl(graph)
+
+
+def _import_graph_impl(graph):
+    from ... import symbol as sym_mod
+    from ... import ndarray as nd_mod
+
+    inits = {k: _np.asarray(v) for k, v in graph["initializers"].items()}
+    tensors = {}
+    for i in graph["inputs"]:
+        tensors[i["name"]] = sym_mod.var(i["name"])
+    for k in inits:
+        tensors.setdefault(k, sym_mod.var(k))
+
+    aux_renames = {}   # imported aux-state name -> source tensor name
+    for n in graph["nodes"]:
+        conv = _ONNX2MX.get(n["op_type"])
+        if conv is None:
+            raise NotImplementedError(
+                f"no MXNet converter for ONNX op {n['op_type']!r} "
+                f"(node {n['name']})")
+        mx_op, kw = conv(None, n["inputs"], n["attrs"], n["name"])
+        ins = [tensors[x] for x in n["inputs"]]
+        if mx_op == "__gather__":
+            out = getattr(sym_mod, "Embedding")(
+                ins[1], ins[0],
+                input_dim=int(inits[n["inputs"][0]].shape[0]),
+                output_dim=int(inits[n["inputs"][0]].shape[1]),
+                name=n["name"])
+        elif mx_op == "__reshape__":
+            shape = tuple(int(x) for x in inits[n["inputs"][1]])
+            out = sym_mod.Reshape(ins[0], shape=shape, name=n["name"])
+        else:
+            if mx_op == "Convolution" or mx_op == "Deconvolution":
+                w = inits[n["inputs"][1]]
+                kw["num_filter"] = int(w.shape[0]) if mx_op == "Convolution" \
+                    else int(w.shape[1] * kw.get("num_group", 1))
+            if mx_op == "FullyConnected":
+                kw["num_hidden"] = int(inits[n["inputs"][1]].shape[0])
+                if kw.get("no_bias"):
+                    ins = ins[:2]
+            if mx_op == "BatchNorm":
+                # moving stats must become auxiliary states, not arguments:
+                # pass only (data, gamma, beta) and let the symbol create
+                # its aux vars, then route the ONNX mean/var tensors there
+                aux_renames[f"{n['name']}_moving_mean"] = n["inputs"][3]
+                aux_renames[f"{n['name']}_moving_var"] = n["inputs"][4]
+                ins = ins[:3]
+            kw = {k: v for k, v in kw.items() if v is not None}
+            fn = getattr(sym_mod, mx_op)
+            out = fn(*ins, name=n["name"], **kw)
+        for j, oname in enumerate(n["outputs"]):
+            tensors[oname] = out[j] if len(n["outputs"]) > 1 else out
+
+    outs = [tensors[o["name"]] for o in graph["outputs"]]
+    final = outs[0] if len(outs) == 1 else sym_mod.Group(outs)
+
+    arg_params, aux_params = {}, {}
+    for k in final.list_arguments():
+        if k in inits:
+            arg_params[k] = nd_mod.array(inits[k])
+    for k in final.list_auxiliary_states():
+        src = aux_renames.get(k, k)
+        if src in inits:
+            aux_params[k] = nd_mod.array(inits[src])
+    return final, arg_params, aux_params
+
+
+def proto_to_graph(model):
+    """onnx.ModelProto (or file path) → plain-dict graph — the ONLY
+    wheel-gated step."""
+    from . import _require_onnx
+    _require_onnx()
+    import onnx
+    from onnx import numpy_helper
+
+    if isinstance(model, (str, bytes)):
+        model = onnx.load(model)
+    g = model.graph
+    inits = {t.name: numpy_helper.to_array(t) for t in g.initializer}
+    nodes = []
+    for n in g.node:
+        attrs = {}
+        for a in n.attribute:
+            attrs[a.name] = onnx.helper.get_attribute_value(a)
+        nodes.append({"op_type": n.op_type, "name": n.name or n.output[0],
+                      "inputs": list(n.input), "outputs": list(n.output),
+                      "attrs": attrs})
+    inputs = []
+    for i in g.input:
+        if i.name in inits:
+            continue
+        shp = tuple(d.dim_value for d in i.type.tensor_type.shape.dim)
+        inputs.append({"name": i.name, "shape": shp, "dtype": "float32"})
+    return {"nodes": nodes, "inputs": inputs,
+            "outputs": [{"name": o.name} for o in g.output],
+            "initializers": inits}
+
+
+def import_model(model_file):
+    """Reference ``onnx2mx/import_model.py:import_model`` — parses the
+    protobuf (wheel-gated) then runs the wheel-free dict importer."""
+    return _import_graph_impl(proto_to_graph(model_file))
