@@ -192,11 +192,13 @@ class KVStore:
         self.pull(key, out if out is not None else value, priority=priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Dense fallback: gather requested rows (reference
-        ``kvstore.py:285``; sparse storage is layered on gather/scatter on
-        TPU — SURVEY.md hard-part #4). If ``out`` is sized for the requested
-        rows, only those rows are gathered; a full-size ``out`` (the
-        ``Trainer._row_sparse_pull`` call pattern) receives the whole array."""
+        """Pull only the requested rows (reference ``kvstore.py:285`` /
+        ``kvstore.h:213`` RowSparsePull).  A ``RowSparseNDArray`` ``out``
+        receives the rows *compressed* (unique, sorted, bounds-checked ids —
+        O(nnz) transfer).  Dense fallbacks: an ``out`` sized for the
+        requested rows is filled by gather; a full-size dense ``out`` (the
+        ``Trainer._row_sparse_pull`` call pattern) receives the whole
+        array."""
         assert out is not None and row_ids is not None
         keys, outs = _group_kv(key, out)
         self._check_keys(keys)

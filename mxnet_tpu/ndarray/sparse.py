@@ -178,7 +178,10 @@ class RowSparseNDArray(BaseSparseNDArray):
         self._dense = None
 
     def is_compressed(self):
-        return self._dense is None and self._rs is not None
+        # merely *observing* the dense view (asnumpy/print) caches it but
+        # must not change storage semantics — compressed rows stay
+        # authoritative until someone assigns a new dense payload
+        return self._rs is not None
 
     # _data is a lazy property so compressed arrays only densify when some
     # dense op actually touches them
@@ -212,7 +215,7 @@ class RowSparseNDArray(BaseSparseNDArray):
     def data(self):
         if self.is_compressed():
             idx, vals = self._rs
-            mask = _np.asarray(idx) < self.shape[0]   # drop unique() padding
+            mask = _np.asarray(idx) < self._rs_shape[0]  # drop unique() pad
             return _as_nd(vals[_np.nonzero(mask)[0]])
         arr = self.asnumpy()
         rows = _np.nonzero((arr != 0).reshape(arr.shape[0], -1).any(axis=1))[0]

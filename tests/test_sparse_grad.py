@@ -189,3 +189,21 @@ def test_retain_and_zero_grad_compressed():
     assert p.grad().indices.shape[0] == 1
     p.zero_grad()
     assert p.grad().is_compressed() and p.grad().indices.shape[0] == 0
+
+
+def test_observing_grad_does_not_change_semantics():
+    """asnumpy() on a compressed gradient caches a dense view but must NOT
+    flip it to dense storage — lazy updates stay lazy after logging."""
+    emb = _embed(vocab=100, dim=4)
+    tr = mx.gluon.Trainer(emb.collect_params(), "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9})
+    with mx.autograd.record():
+        emb(mx.nd.array([[1, 2]], dtype="int32")).sum().backward()
+    g = emb.weight.grad()
+    _ = g.asnumpy()                      # a logging read
+    assert g.is_compressed()
+    w0 = emb.weight.data().asnumpy().copy()
+    tr.step(1)
+    changed = np.nonzero(np.abs(emb.weight.data().asnumpy() - w0)
+                         .sum(axis=1))[0].tolist()
+    assert sorted(changed) == [1, 2], "lazy update must survive observation"
