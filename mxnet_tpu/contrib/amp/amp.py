@@ -122,17 +122,104 @@ def scale_loss(loss, optimizer_or_trainer):
     scaler.update_scale(overflow)
 
 
+def convert_symbol(sym, target_dtype="bfloat16", target_dtype_ops=None,
+                   fp32_ops=None, conditional_fp32_ops=None,
+                   excluded_sym_names=None, data_names=None,
+                   cast_optional_params=False):
+    """Graph rewrite inserting ``amp_cast``/``amp_multicast`` (reference
+    ``amp.py:convert_symbol`` → ``src/nnvm/low_precision_pass.cc:257``).
+
+    Inputs of ops on the target list are cast to ``target_dtype``; inputs
+    of fp32-list ops are cast back to float32; multi-input widest-type ops
+    get one ``amp_multicast``.  Casts are deduplicated per (tensor, dtype)
+    so a weight feeding two lp16 ops is cast once.  ``conditional_fp32_ops``
+    is ``[(op_name, attr_name, [values])...]`` — matching nodes are forced
+    fp32."""
+    from ...base import np_dtype
+    from ...ops import registry as _reg
+    from ...symbol.symbol import Symbol, _Node
+
+    lp16 = set(target_dtype_ops if target_dtype_ops is not None
+               else lists.TARGET_DTYPE_OPS)
+    fp32 = set(fp32_ops if fp32_ops is not None else lists.FP32_OPS)
+    widest = set(lists.WIDEST_TYPE_OPS)
+    excluded = set(excluded_sym_names or ())
+    cond = {}
+    for (opname, attr, values) in (conditional_fp32_ops or ()):
+        cond.setdefault(opname, []).append((attr, set(values)))
+    tgt_str = str(np_dtype(target_dtype))
+    if target_dtype == "bfloat16":
+        tgt_str = "bfloat16"
+    cast_op = _reg.require("amp_cast")
+    multi_op = _reg.require("amp_multicast")
+
+    new_out = {}          # (id(old_node), out_idx) -> (new_node, out_idx)
+    cast_cache = {}       # (id(new_node), out_idx, dtype) -> (node, idx)
+    counter = [0]
+
+    def cast_to(pair, dtype_str):
+        key = (id(pair[0]), pair[1], dtype_str)
+        if key not in cast_cache:
+            counter[0] += 1
+            cnode = _Node(cast_op, f"amp_cast_{counter[0]}", [pair],
+                          {"dtype": dtype_str}, 1)
+            cast_cache[key] = (cnode, 0)
+        return cast_cache[key]
+
+    for node in sym._topo():
+        if node.op is None:
+            nn = _Node(None, node.name, [], dict(node.attrs or {}), 1,
+                       dict(node.attr_dict))
+        else:
+            from ...symbol.symbol import AUX_INPUTS
+            ins = [new_out[(id(p), i)] for (p, i) in node.inputs]
+            opname = node.op.name
+            # aux-state inputs (BatchNorm moving stats) are runtime-updated
+            # buffers keyed by their var — never interpose a cast on them
+            skip = set(AUX_INPUTS.get(opname, ()))
+            force_fp32 = opname in fp32
+            for (attr, values) in cond.get(opname, ()):
+                if str(node.attrs.get(attr)) in values:
+                    force_fp32 = True
+            if node.name in excluded:
+                pass
+            elif force_fp32:
+                ins = [p if i in skip else cast_to(p, "float32")
+                       for i, p in enumerate(ins)]
+            elif opname in lp16:
+                ins = [p if i in skip else cast_to(p, tgt_str)
+                       for i, p in enumerate(ins)]
+            elif opname in widest and len(ins) > 1:
+                counter[0] += 1
+                mnode = _Node(multi_op, f"amp_multicast_{counter[0]}", ins,
+                              {"num_outputs": str(len(ins))}, len(ins))
+                ins = [(mnode, i) for i in range(len(ins))]
+            nn = _Node(node.op, node.name, ins, dict(node.attrs),
+                       node.num_outputs, dict(node.attr_dict))
+        for i in range(node.num_outputs):
+            new_out[(id(node), i)] = (nn, i)
+    return Symbol([new_out[(id(n), i)] for (n, i) in sym._outputs])
+
+
 def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
                   target_dtype_ops=None, fp32_ops=None,
                   conditional_fp32_ops=None, excluded_sym_names=None,
                   cast_optional_params=False):
     """Convert a symbolic checkpoint for low-precision inference (reference
-    ``amp.py:convert_model`` → ``low_precision_pass.cc``).  With the dispatch
-    hook applying casts at run time, the graph itself needs no rewrite; the
-    parameters of LP16 layers are cast so weights live in bf16 HBM."""
-    import jax.numpy as jnp
+    ``amp.py:convert_model`` → ``low_precision_pass.cc``): rewrite the
+    graph via :func:`convert_symbol` and store LP16 layers' weights in
+    ``target_dtype`` HBM (their inserted ``amp_cast`` then becomes a no-op
+    XLA folds away)."""
+    from ...base import np_dtype
+    new_sym = convert_symbol(
+        sym, target_dtype=target_dtype, target_dtype_ops=target_dtype_ops,
+        fp32_ops=fp32_ops, conditional_fp32_ops=conditional_fp32_ops,
+        excluded_sym_names=excluded_sym_names,
+        cast_optional_params=cast_optional_params)
+    tgt = np_dtype(target_dtype)
     excluded = set(excluded_sym_names or ())
-    lp16_layers = set(target_dtype_ops or lists.TARGET_DTYPE_OPS)
+    lp16_layers = set(target_dtype_ops if target_dtype_ops is not None
+                      else lists.TARGET_DTYPE_OPS)
     lp16_params = set()
     for node in sym._topo():
         if node.op is not None and node.op.name in lp16_layers \
@@ -140,10 +227,9 @@ def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
             for p, _ in node.inputs:
                 if p.op is None:
                     lp16_params.add(p.name)
-    new_args = {}
-    for k, v in arg_params.items():
-        new_args[k] = v.astype(jnp.bfloat16) if k in lp16_params else v
-    return sym, new_args, dict(aux_params)
+    new_args = {k: (v.astype(tgt) if k in lp16_params else v)
+                for k, v in arg_params.items()}
+    return new_sym, new_args, dict(aux_params)
 
 
 def convert_hybrid_block(block, target_dtype="bfloat16",

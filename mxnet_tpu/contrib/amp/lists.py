@@ -27,6 +27,14 @@ FP32_OPS = [
     "MAERegressionOutput", "SVMOutput", "Perplexity",
 ]
 
+# multi-input ops whose inputs may disagree after casting: one
+# amp_multicast promotes to the widest type (reference WIDEST_TYPE_CASTS)
+WIDEST_TYPE_OPS = [
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "add_n", "Concat", "concat", "stack", "where",
+]
+
 # conditionally fp16-safe in the reference; on TPU they follow their inputs
 FP16_FP32_OPS = [
     "Activation", "Pooling", "Dropout", "Flatten", "Reshape", "reshape",

@@ -12,7 +12,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..base import parse_bool, parse_float
+from ..base import np_dtype, parse_bool, parse_float
 from .registry import register
 
 
@@ -307,3 +307,20 @@ def zeros_like(x):
 @register("ones_like")
 def ones_like(x):
     return jnp.ones_like(x)
+
+
+@register("amp_cast")
+def amp_cast(data, dtype=None):
+    """AMP-inserted cast (reference ``src/operator/tensor/amp_cast.cc``):
+    identity up to dtype — the low-precision pass (contrib.amp
+    convert_symbol) inserts these around listed ops; XLA folds them into
+    the neighboring matmul/conv."""
+    return data.astype(np_dtype(dtype))
+
+
+@register("amp_multicast")
+def amp_multicast(*data, num_outputs=None):
+    """Cast all inputs to the widest of their dtypes (reference
+    ``amp_cast.cc AMPMultiCast``)."""
+    dt = jnp.result_type(*[d.dtype for d in data])
+    return tuple(d.astype(dt) for d in data)

@@ -205,7 +205,8 @@ def adagrad_update(weight, grad, history, lr=None, epsilon=1e-7, wd=0.0,
     return weight - parse_float(lr) * g / (jnp.sqrt(new_hist) + parse_float(epsilon, 1e-7)), new_hist
 
 
-@_register_update("adamw_update", [(0, 0), (2, 1), (3, 2)])
+@_register_update("adamw_update", [(0, 0), (2, 1), (3, 2)],
+                  aliases=("_contrib_adamw_update",))
 def adamw_update(weight, grad, mean, var, rescale_grad=None, lr=None, eta=1.0,
                  beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
                  clip_gradient=-1.0):
@@ -348,3 +349,4 @@ def apply_lazy_adam(weight, grad_rs, mean, var, lr, beta1, beta2, eps, wd,
     weight._data = new_w
     mean._data = new_mean
     var._data = new_var
+

@@ -587,7 +587,8 @@ def load_json(json_str):
             if op is None:
                 raise ValueError(f"unknown op in JSON: {spec['op']}")
             inputs = [(nodes[i], oi) for (i, oi) in map(entry, spec["inputs"])]
-            node = _Node(op, spec["name"], inputs, attrs, 1)
+            node = _Node(op, spec["name"], inputs, attrs,
+                         _num_outputs_of(op, attrs, len(inputs)))
             # fix num_outputs for known multi-output ops
             if op.name in AUX_INPUTS:
                 if len(inputs) == 3:
@@ -599,6 +600,13 @@ def load_json(json_str):
                         aux = _Node(None, f"{spec['name']}_{suffix}", [], {},
                                     1, {"__aux__": "1"})
                         inputs.append((aux, 0))
+                else:
+                    # aux-ness comes from the op schema (mutable inputs in
+                    # the reference), not the JSON — re-mark the vars at
+                    # the aux positions so list_auxiliary_states is right
+                    for pos in AUX_INPUTS[op.name]:
+                        if pos < len(inputs) and inputs[pos][0].op is None:
+                            inputs[pos][0].attr_dict["__aux__"] = "1"
                 node.num_outputs = 3
             elif op.name in ("split", "SliceChannel"):
                 from ..base import parse_int
@@ -656,6 +664,8 @@ def _num_outputs_of(op, attrs, n_inputs):
         return 3
     if op.name == "histogram":
         return 2
+    if op.name == "amp_multicast":
+        return max(parse_int(attrs.get("num_outputs", n_inputs)), 1)
     return 1
 
 
