@@ -134,7 +134,10 @@ def convert_symbol(sym, target_dtype="bfloat16", target_dtype_ops=None,
     get one ``amp_multicast``.  Casts are deduplicated per (tensor, dtype)
     so a weight feeding two lp16 ops is cast once.  ``conditional_fp32_ops``
     is ``[(op_name, attr_name, [values])...]`` — matching nodes are forced
-    fp32."""
+    fp32.  ``data_names`` and ``cast_optional_params`` are accepted for
+    reference-API parity but are no-ops here: graph inputs keep their
+    dtype (the inserted casts handle conversion), and param storage dtype
+    is decided by :func:`convert_model` from the op lists."""
     from ...base import np_dtype
     from ...ops import registry as _reg
     from ...symbol.symbol import Symbol, _Node
@@ -220,13 +223,24 @@ def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
     excluded = set(excluded_sym_names or ())
     lp16_layers = set(target_dtype_ops if target_dtype_ops is not None
                       else lists.TARGET_DTYPE_OPS)
-    lp16_params = set()
+    fp32_layers = set(fp32_ops if fp32_ops is not None else lists.FP32_OPS)
+    cond = {}
+    for (opname, attr, values) in (conditional_fp32_ops or ()):
+        cond.setdefault(opname, []).append((attr, set(values)))
+    lp16_params, fp32_params = set(), set()
     for node in sym._topo():
-        if node.op is not None and node.op.name in lp16_layers \
-                and node.name not in excluded:
+        if node.op is None or node.name in excluded:
+            continue
+        opname = node.op.name
+        force_fp32 = opname in fp32_layers or any(
+            str(node.attrs.get(attr)) in values
+            for (attr, values) in cond.get(opname, ()))
+        if force_fp32 or opname in lp16_layers:
             for p, _ in node.inputs:
                 if p.op is None:
-                    lp16_params.add(p.name)
+                    (fp32_params if force_fp32 else lp16_params).add(p.name)
+    # a param consumed by any fp32-forced op must stay full precision
+    lp16_params -= fp32_params
     new_args = {k: (v.astype(tgt) if k in lp16_params else v)
                 for k, v in arg_params.items()}
     return new_sym, new_args, dict(aux_params)

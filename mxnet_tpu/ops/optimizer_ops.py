@@ -349,4 +349,3 @@ def apply_lazy_adam(weight, grad_rs, mean, var, lr, beta1, beta2, eps, wd,
     weight._data = new_w
     mean._data = new_mean
     var._data = new_var
-

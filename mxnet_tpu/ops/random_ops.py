@@ -202,4 +202,3 @@ def shuffle(key, data):
 def random_bernoulli(key, p=0.5, shape=None, dtype=None, ctx=None):
     shape, dt = _shape_dtype(shape, dtype)
     return jax.random.bernoulli(key, parse_float(p, 0.5), shape).astype(dt)
-

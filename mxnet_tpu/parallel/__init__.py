@@ -27,7 +27,8 @@ from .ring_attention import (  # noqa: F401
 from .checkpoint import (  # noqa: F401
     save_spmd_checkpoint, load_spmd_checkpoint, SPMDCheckpointManager,
 )
-from .pipeline import gpipe, pipeline_stage_loop, pipeline_train_1f1b  # noqa: F401
+from .pipeline import (gpipe, gpipe_interleaved,  # noqa: F401
+                       pipeline_stage_loop, pipeline_train_1f1b)
 from .moe import moe_layer, switch_moe_local  # noqa: F401
 from .sp_context import (  # noqa: F401
     sequence_parallel_scope, current_sequence_parallel,

@@ -22,7 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["gpipe", "pipeline_stage_loop", "pipeline_train_1f1b"]
+__all__ = ["gpipe", "gpipe_interleaved", "pipeline_stage_loop",
+           "pipeline_train_1f1b"]
 
 
 def pipeline_stage_loop(stage_fn, stage_params, x_micro, axis_name):
@@ -239,3 +240,157 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, y, mesh,
         out_specs=(P(), param_specs, P()),
     )(stacked_params, x_micro, y_micro)
     return loss, grads, dx.reshape((b,) + dx.shape[2:])
+
+
+# ---------------------------------------------------------------------------
+# Interleaved (virtual-stage) schedule — Megatron-style: device d hosts the
+# v chunks {d, d+S, d+2S, ...} of an S·v-stage pipeline, cutting bubble time
+# from (S-1)/N to (S-1)/(N·v) of the schedule.  The schedule is STATIC, so
+# it is computed host-side as per-tick index tables (who processes which
+# microbatch/chunk, which buffer slot feeds it, where the output lands) and
+# the device program is one `lax.scan` over those tables — fully
+# compiler-visible, and reverse-differentiable so `jax.grad` provides the
+# backward schedule for free.
+# ---------------------------------------------------------------------------
+def _simulate_interleaved(n_dev, v, n_micro):
+    """Work-conserving drain-first simulation of the interleaved forward.
+
+    Returns (proc, src_slot, dst_slot, n_slots):
+      proc[t][d]    = (microbatch, logical_stage) or None (idle)
+      src_slot[t][d]= buffer slot holding the input (-1 = fresh injection)
+      dst_slot[t][d]= slot on device (d+1)%S where the output lands
+                      (-1 = final pipeline output)
+    """
+    S, K = n_dev, n_dev * v
+    queued = [[] for _ in range(S)]     # (m, k, slot) ready to process
+    free = [list(range(64)) for _ in range(S)]
+    max_used = 0
+    proc, src, dst = [], [], []
+    inject = 0
+    done = 0
+    while done < n_micro:
+        row_p, row_s, row_d = [None] * S, [-1] * S, [-1] * S
+        arrivals = []                   # (dev, m, k, slot)
+        for d in range(S):
+            if queued[d]:
+                # drain-first: highest chunk, then oldest microbatch
+                queued[d].sort(key=lambda it: (-it[1], it[0]))
+                m, k, slot = queued[d].pop(0)
+                free[d].append(slot)
+                row_s[d] = slot
+            elif d == 0 and inject < n_micro:
+                m, k = inject, 0
+                inject += 1
+            else:
+                continue
+            row_p[d] = (m, k)
+            if k + 1 < K:
+                nd = (d + 1) % S
+                nslot = free[nd].pop(0)
+                max_used = max(max_used, nslot + 1)
+                row_d[d] = nslot
+                arrivals.append((nd, m, k + 1, nslot))
+            else:
+                done += 1
+        for (nd, m, k, slot) in arrivals:
+            queued[nd].append((m, k, slot))
+        proc.append(row_p)
+        src.append(row_s)
+        dst.append(row_d)
+    return proc, src, dst, max(max_used, 1)
+
+
+def gpipe_interleaved(stage_fn, stacked_params, x, mesh, n_microbatches,
+                      n_chunks, pp_axis="pp"):
+    """Interleaved virtual-stage pipeline forward.
+
+    - ``stage_fn(params, x) -> y`` with ``y.shape == x.shape``; stages may
+      have *distinct* parameter values (the stacked leading axis), only the
+      activation shape is shared.
+    - ``stacked_params``: pytree with leading axis ``S·n_chunks`` in natural
+      stage order (stage k = k-th row); internally re-laid-out so device d
+      holds chunks ``{d, d+S, ...}``.
+    - differentiable: wrap in ``jax.grad`` for the interleaved backward.
+    """
+    import numpy as _np
+    from jax.sharding import PartitionSpec as P
+    from .mesh import shard_map_fn
+    shard_map = shard_map_fn()
+
+    S = mesh.shape[pp_axis]
+    V = n_chunks
+    K = S * V
+    b = x.shape[0]
+    assert b % n_microbatches == 0
+    N = n_microbatches
+    x_micro = x.reshape((N, b // N) + x.shape[1:])
+
+    proc, src, dst, n_slots = _simulate_interleaved(S, V, N)
+    T = len(proc)
+    # tables: m/k = -1 ⇒ idle tick on that device
+    tab_m = _np.full((T, S), -1, _np.int32)
+    tab_k = _np.full((T, S), -1, _np.int32)
+    for t in range(T):
+        for d in range(S):
+            if proc[t][d] is not None:
+                tab_m[t, d], tab_k[t, d] = proc[t][d]
+    tab_src = _np.asarray(src, _np.int32)
+    tab_dst = _np.asarray(dst, _np.int32)
+    # receiver-side view of the same static schedule: the slot where the
+    # activation arriving from device d-1 lands this tick (-1 = nothing)
+    tab_recv = _np.roll(tab_dst, 1, axis=1)
+
+    # natural stage order → device-major layout: row d*V + c = stage d + c*S
+    lay = _np.asarray([d * V + c for c in range(V) for d in range(S)])
+    inv = _np.empty_like(lay)
+    inv[lay] = _np.arange(K)            # inv[k] = storage row of stage k
+    params_dev = jax.tree.map(lambda p: jnp.take(p, jnp.asarray(inv), axis=0),
+                              stacked_params)
+
+    def device_loop(params, xm):
+        d = lax.axis_index(pp_axis)
+        my_params = params                     # (V, ...) chunks of device d
+        probe = stage_fn(jax.tree.map(lambda p: p[0], my_params), xm[0])
+        zero = jnp.zeros_like(probe)
+        zero = zero + lax.psum(jnp.zeros([], probe.dtype), pp_axis) * 0
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        bufs0 = jnp.zeros((n_slots,) + probe.shape, probe.dtype) + zero
+        outs0 = jnp.zeros((N,) + probe.shape, probe.dtype) + zero
+
+        def tick(carry, row):
+            bufs, outs = carry
+            m, k, s_src, s_recv = (row[0][d], row[1][d], row[2][d],
+                                   row[3][d])
+            active = m >= 0
+            mc = jnp.clip(m, 0, N - 1)
+            inp = jnp.where(s_src < 0, xm[mc].astype(probe.dtype),
+                            bufs[jnp.clip(s_src, 0, n_slots - 1)])
+            chunk = jnp.clip(k // S, 0, V - 1)
+            out = stage_fn(jax.tree.map(lambda p: p[chunk], my_params), inp)
+            out = jnp.where(active, out, zero)
+            # last logical stage writes the pipeline output
+            is_final = active & (k == K - 1)
+            outs = outs.at[mc].set(jnp.where(is_final, out, outs[mc]))
+            # ship to the next device; the receiving slot comes from the
+            # static schedule (tab_recv), no index needs to travel
+            sent = lax.ppermute(out, pp_axis, perm)
+            write = s_recv >= 0
+            wslot = jnp.clip(s_recv, 0, n_slots - 1)
+            bufs = bufs.at[wslot].set(jnp.where(write, sent, bufs[wslot]))
+            return (bufs, outs), 0.0
+
+        rows = (jnp.asarray(tab_m), jnp.asarray(tab_k),
+                jnp.asarray(tab_src), jnp.asarray(tab_recv))
+        (bufs, outs), _ = lax.scan(tick, (bufs0, outs0), rows)
+        # outputs live on the device that ran the final stage of each
+        # microbatch; idle devices contributed zeros
+        return lax.psum(outs, pp_axis)
+
+    param_specs = jax.tree.map(lambda _: P(pp_axis), params_dev)
+    out = shard_map(
+        device_loop, mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+    )(params_dev, x_micro)
+    return out.reshape((b,) + out.shape[2:])

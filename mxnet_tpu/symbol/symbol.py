@@ -608,9 +608,6 @@ def load_json(json_str):
                         if pos < len(inputs) and inputs[pos][0].op is None:
                             inputs[pos][0].attr_dict["__aux__"] = "1"
                 node.num_outputs = 3
-            elif op.name in ("split", "SliceChannel"):
-                from ..base import parse_int
-                node.num_outputs = parse_int(node.attrs.get("num_outputs", 1), 1)
         nodes.append(node)
     heads = [(nodes[i], oi) for (i, oi) in map(entry, g["heads"])]
     return Symbol(heads)
