@@ -276,7 +276,9 @@ def _simulate_interleaved(n_dev, v, n_micro):
                 # drain-first: highest chunk, then oldest microbatch
                 queued[d].sort(key=lambda it: (-it[1], it[0]))
                 m, k, slot = queued[d].pop(0)
-                free[d].append(slot)
+                # LIFO reuse keeps n_slots equal to true peak concurrency
+                # (2-3) instead of cycling through fresh slot numbers
+                free[d].insert(0, slot)
                 row_s[d] = slot
             elif d == 0 and inject < n_micro:
                 m, k = inject, 0

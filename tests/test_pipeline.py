@@ -209,22 +209,17 @@ def test_interleaved_v1_equals_gpipe():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
 
 
-def test_interleaved_schedule_reduces_bubble():
-    """The whole point of virtual stages: fewer idle ticks per device than
-    one-chunk scheduling of the same 8-stage model on 4 devices."""
+def test_interleaved_schedule_is_near_ideal():
+    """Work conservation + tick bound: every stage-visit happens exactly
+    once, and the schedule finishes within one chunk-round of the perfect
+    pipelining bound of N*v + (S-1) ticks."""
     S, N = 4, 8
-    # 8 logical stages on 4 devices interleaved (v=2)
-    proc_i, _, _, _ = _simulate_interleaved(S, 2, N)
-    # same 8 logical stages as a flat 8-device pipeline folded 2-per-device
-    # = each microbatch visits each device twice back-to-back (v=2 chunks,
-    # sequential placement) — emulate by v=2 simulation with chunk-major
-    # order... compare instead against the naive lower bound:
+    proc_i, _, _, n_slots = _simulate_interleaved(S, 2, N)
     total_slots_i = sum(1 for row in proc_i for e in row if e is not None)
     assert total_slots_i == S * 2 * N        # every stage-visit happens once
-    ticks_i = len(proc_i)
-    # perfect pipelining would take N*v + (S-1) ticks; interleaving must be
-    # within one chunk-round of that, far below the flat-schedule bound
-    assert ticks_i <= N * 2 + 2 * S
+    assert len(proc_i) <= N * 2 + 2 * S
+    # LIFO slot reuse keeps the activation buffer at true peak concurrency
+    assert n_slots <= 3
 
 
 def test_interleaved_odd_batches_and_slots():
