@@ -616,26 +616,38 @@ def array(source_array, ctx=None, dtype=None):
             arr = arr.astype(_np.float32)
     else:
         arr = _np.asarray(source_array, dtype=_np.float32)
-    return NDArray(jax.device_put(jnp.asarray(arr), _to_jax_device(ctx)))
+    # single hop: device_put straight from host numpy to the target device
+    # (jnp.asarray would first commit to the DEFAULT device — on an
+    # accelerator-default process that turns every cpu-ctx creation into an
+    # upload + download round-trip)
+    return NDArray(jax.device_put(arr, _to_jax_device(ctx)))
 
 
 def empty(shape, ctx=None, dtype=None):
     return zeros(shape, ctx=ctx, dtype=dtype)
 
 
-def zeros(shape, ctx=None, dtype=None, **kwargs):
+def _filled(np_fn, jnp_fn, shape, ctx, dtype, *args):
+    """Constant-filled array on the target device, built host-side for cpu
+    targets (a jnp build would land on the DEFAULT device first and force a
+    device→host fetch on accelerator-default processes)."""
     shape = (shape,) if isinstance(shape, int) else tuple(shape)
-    return NDArray(jax.device_put(jnp.zeros(shape, np_dtype(dtype)), _to_jax_device(ctx)))
+    dev = _to_jax_device(ctx)
+    fn = np_fn if dev is not None and dev.platform == "cpu" else jnp_fn
+    return NDArray(jax.device_put(fn(shape, *args, dtype=np_dtype(dtype)),
+                                  dev))
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    return _filled(_np.zeros, jnp.zeros, shape, ctx, dtype)
 
 
 def ones(shape, ctx=None, dtype=None, **kwargs):
-    shape = (shape,) if isinstance(shape, int) else tuple(shape)
-    return NDArray(jax.device_put(jnp.ones(shape, np_dtype(dtype)), _to_jax_device(ctx)))
+    return _filled(_np.ones, jnp.ones, shape, ctx, dtype)
 
 
 def full(shape, val, ctx=None, dtype=None):
-    shape = (shape,) if isinstance(shape, int) else tuple(shape)
-    return NDArray(jax.device_put(jnp.full(shape, val, np_dtype(dtype)), _to_jax_device(ctx)))
+    return _filled(_np.full, jnp.full, shape, ctx, dtype, val)
 
 
 def zeros_like(other, **kwargs):
