@@ -47,3 +47,52 @@ def zeros(shape, dtype=None, **kwargs):
 
 def ones(shape, dtype=None, **kwargs):
     return getattr(_CURRENT, "_ones")(shape=shape, dtype=dtype or "float32")
+
+
+def maximum(left, right):
+    """Element-wise max of Symbols/scalars (reference ``symbol.py
+    maximum``)."""
+    if isinstance(left, Symbol) and isinstance(right, Symbol):
+        return _maximum(left, right)
+    if isinstance(left, Symbol):
+        return _maximum_scalar(left, scalar=float(right))
+    if isinstance(right, Symbol):
+        return _maximum_scalar(right, scalar=float(left))
+    return max(left, right)
+
+
+def minimum(left, right):
+    """Element-wise min of Symbols/scalars (reference ``symbol.py
+    minimum``)."""
+    if isinstance(left, Symbol) and isinstance(right, Symbol):
+        return _minimum(left, right)
+    if isinstance(left, Symbol):
+        return _minimum_scalar(left, scalar=float(right))
+    if isinstance(right, Symbol):
+        return _minimum_scalar(right, scalar=float(left))
+    return min(left, right)
+
+
+def pow(base, exp):
+    """Element-wise power of Symbols/scalars (reference ``symbol.py
+    pow``)."""
+    if isinstance(base, Symbol) and isinstance(exp, Symbol):
+        return _power(base, exp)
+    if isinstance(base, Symbol):
+        return _power_scalar(base, scalar=float(exp))
+    if isinstance(exp, Symbol):
+        return _rpower_scalar(exp, scalar=float(base))
+    return base ** exp
+
+
+def hypot(left, right):
+    """sqrt(left² + right²) of Symbols/scalars (reference ``symbol.py
+    hypot``)."""
+    if isinstance(left, Symbol) and isinstance(right, Symbol):
+        return _hypot(left, right)
+    if isinstance(left, Symbol):
+        return _hypot_scalar(left, scalar=float(right))
+    if isinstance(right, Symbol):
+        return _hypot_scalar(right, scalar=float(left))
+    import math
+    return math.hypot(left, right)

@@ -1,0 +1,536 @@
+"""Finite-difference gradient contracts across operator families
+(reference ``tests/python/unittest/test_operator.py`` strategy:
+``check_numeric_gradient`` per op config, plus forward dtype sweeps).
+
+Shapes are deliberately tiny — the FD check runs 2·size forwards per
+tensor — but every config exercises a distinct attribute path of the op.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient)
+
+
+def _rand(*shape, seed=0, scale=1.0, shift=0.0):
+    return (np.random.RandomState(seed).uniform(-1, 1, shape) * scale
+            + shift).astype("float32")
+
+
+def _grad_check(sym, location, aux=None, rtol=5e-2, atol=1e-2, **kw):
+    check_numeric_gradient(sym, location, aux_states=aux, rtol=rtol,
+                           atol=atol, **kw)
+
+
+# ------------------------------------------------------------- Convolution
+@pytest.mark.parametrize("kernel,stride,pad,dilate,groups", [
+    ((3, 3), (1, 1), (0, 0), (1, 1), 1),
+    ((3, 3), (1, 1), (1, 1), (1, 1), 1),
+    ((3, 3), (2, 2), (1, 1), (1, 1), 1),
+    ((2, 2), (1, 1), (0, 0), (2, 2), 1),
+    ((3, 3), (1, 1), (1, 1), (1, 1), 2),
+    ((1, 1), (1, 1), (0, 0), (1, 1), 1),
+])
+def test_convolution_grad(kernel, stride, pad, dilate, groups):
+    data = mx.sym.Variable("data")
+    sym = mx.sym.Convolution(data, name="c", kernel=kernel, stride=stride,
+                             pad=pad, dilate=dilate, num_group=groups,
+                             num_filter=4)
+    loc = {"data": _rand(1, 2 * groups, 6, 6, seed=1),
+           "c_weight": _rand(4, 2, *kernel, seed=2, scale=0.5),
+           "c_bias": _rand(4, seed=3)}
+    _grad_check(sym, loc)
+
+
+@pytest.mark.parametrize("kernel,stride", [((3, 3), (1, 1)),
+                                           ((2, 2), (2, 2))])
+def test_convolution_no_bias_grad(kernel, stride):
+    data = mx.sym.Variable("data")
+    sym = mx.sym.Convolution(data, name="c", kernel=kernel, stride=stride,
+                             num_filter=3, no_bias=True)
+    _grad_check(sym, {"data": _rand(1, 2, 5, 5, seed=1),
+                      "c_weight": _rand(3, 2, *kernel, seed=2, scale=0.5)})
+
+
+def test_convolution_1d_grad():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.Convolution(data, name="c", kernel=(3,), num_filter=3)
+    _grad_check(sym, {"data": _rand(2, 2, 7, seed=1),
+                      "c_weight": _rand(3, 2, 3, seed=2, scale=0.5),
+                      "c_bias": _rand(3, seed=3)})
+
+
+@pytest.mark.parametrize("kernel,stride,pad", [
+    ((3, 3), (1, 1), (0, 0)), ((2, 2), (2, 2), (0, 0)),
+    ((3, 3), (2, 2), (1, 1)),
+])
+def test_deconvolution_grad(kernel, stride, pad):
+    data = mx.sym.Variable("data")
+    sym = mx.sym.Deconvolution(data, name="d", kernel=kernel, stride=stride,
+                               pad=pad, num_filter=2, no_bias=True)
+    _grad_check(sym, {"data": _rand(1, 3, 4, 4, seed=1),
+                      "d_weight": _rand(3, 2, *kernel, seed=2, scale=0.5)})
+
+
+# ----------------------------------------------------------------- Pooling
+@pytest.mark.parametrize("ptype,kernel,stride,pad", [
+    ("max", (2, 2), (2, 2), (0, 0)),
+    ("max", (3, 3), (1, 1), (1, 1)),
+    ("avg", (2, 2), (2, 2), (0, 0)),
+    ("avg", (3, 3), (2, 2), (1, 1)),
+    ("sum", (2, 2), (1, 1), (0, 0)),
+])
+def test_pooling_grad(ptype, kernel, stride, pad):
+    data = mx.sym.Variable("data")
+    sym = mx.sym.Pooling(data, pool_type=ptype, kernel=kernel,
+                         stride=stride, pad=pad)
+    if ptype == "max":
+        # distinct, well-separated values so FD picks stable argmaxes
+        x = np.arange(1 * 2 * 6 * 6, dtype="float32").reshape(1, 2, 6, 6)
+        x += _rand(1, 2, 6, 6, seed=4, scale=0.2)
+    else:
+        # small centered values: FD on sums of large numbers drowns in
+        # fp32 cancellation noise
+        x = _rand(1, 2, 6, 6, seed=4)
+    _grad_check(sym, {"data": x})
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_global_pooling_grad(ptype):
+    data = mx.sym.Variable("data")
+    sym = mx.sym.Pooling(data, pool_type=ptype, global_pool=True,
+                         kernel=(1, 1))
+    x = np.arange(2 * 2 * 4 * 4, dtype="float32").reshape(2, 2, 4, 4)
+    _grad_check(sym, {"data": x})
+
+
+def test_avg_pool_count_include_pad_forward():
+    data = mx.sym.Variable("data")
+    x = np.ones((1, 1, 2, 2), "float32")
+    inc = mx.sym.Pooling(data, pool_type="avg", kernel=(2, 2), pad=(1, 1),
+                         count_include_pad=True)
+    exc = mx.sym.Pooling(data, pool_type="avg", kernel=(2, 2), pad=(1, 1),
+                         count_include_pad=False)
+    oi = inc.eval(data=mx.nd.array(x))[0].asnumpy()
+    oe = exc.eval(data=mx.nd.array(x))[0].asnumpy()
+    assert oi[0, 0, 0, 0] == pytest.approx(0.25)   # 1 of 4 cells real
+    assert oe[0, 0, 0, 0] == pytest.approx(1.0)    # padding not counted
+
+
+# --------------------------------------------------------------- BatchNorm
+@pytest.mark.parametrize("fix_gamma", [True, False])
+def test_batchnorm_grad(fix_gamma):
+    data = mx.sym.Variable("data")
+    sym = mx.sym.BatchNorm(data, name="bn", fix_gamma=fix_gamma, eps=1e-3)
+    loc = {"data": _rand(4, 3, 2, 2, seed=5, scale=2.0),
+           "bn_gamma": _rand(3, seed=6, shift=1.5),
+           "bn_beta": _rand(3, seed=7)}
+    aux = {"bn_moving_mean": np.zeros(3, "float32"),
+           "bn_moving_var": np.ones(3, "float32")}
+    nodes = ["data", "bn_beta"] + ([] if fix_gamma else ["bn_gamma"])
+    _grad_check(sym, loc, aux=aux, grad_nodes=nodes)
+
+
+def test_batchnorm_use_global_stats_forward():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.BatchNorm(data, name="bn", use_global_stats=True,
+                           fix_gamma=False, eps=0.0)
+    x = _rand(2, 2, 3, 3, seed=8, scale=3.0)
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null", data=x.shape)
+    ex.copy_params_from(
+        {"bn_gamma": mx.nd.array([2.0, 1.0]),
+         "bn_beta": mx.nd.array([0.0, 1.0])},
+        {"bn_moving_mean": mx.nd.array([1.0, -1.0]),
+         "bn_moving_var": mx.nd.array([4.0, 1.0])})
+    out = ex.forward(is_train=True, data=mx.nd.array(x))[0].asnumpy()
+    want = np.stack([(x[:, 0] - 1.0) / 2.0 * 2.0,
+                     (x[:, 1] + 1.0) + 1.0], axis=1)
+    assert_almost_equal(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_layernorm_grad():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.LayerNorm(data, name="ln", eps=1e-3)
+    _grad_check(sym, {"data": _rand(3, 5, seed=9, scale=2.0),
+                      "ln_gamma": _rand(5, seed=10, shift=1.0),
+                      "ln_beta": _rand(5, seed=11)})
+
+
+def test_instancenorm_grad():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.InstanceNorm(data, name="in", eps=1e-3)
+    _grad_check(sym, {"data": _rand(2, 2, 3, 3, seed=12, scale=2.0),
+                      "in_gamma": _rand(2, seed=13, shift=1.0),
+                      "in_beta": _rand(2, seed=14)})
+
+
+def test_l2normalization_grad():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.L2Normalization(data, eps=1e-4)
+    _grad_check(sym, {"data": _rand(3, 4, seed=15, shift=0.5)})
+
+
+# ----------------------------------------------------------------- softmax
+@pytest.mark.parametrize("axis", [-1, 0, 1])
+def test_softmax_grad(axis):
+    data = mx.sym.Variable("data")
+    sym = mx.sym.softmax(data, axis=axis) * mx.sym.Variable("w")
+    _grad_check(sym, {"data": _rand(3, 4, seed=16, scale=2.0),
+                      "w": _rand(3, 4, seed=17)}, grad_nodes=["data"])
+
+
+def test_softmax_temperature_forward():
+    data = mx.sym.Variable("data")
+    x = _rand(2, 5, seed=18, scale=3.0)
+    out = mx.sym.softmax(data, temperature=2.0).eval(
+        data=mx.nd.array(x))[0].asnumpy()
+    e = np.exp(x / 2.0 - (x / 2.0).max(-1, keepdims=True))
+    assert_almost_equal(out, e / e.sum(-1, keepdims=True), rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_log_softmax_grad():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.log_softmax(data, axis=-1) * mx.sym.Variable("w")
+    _grad_check(sym, {"data": _rand(3, 4, seed=19, scale=2.0),
+                      "w": _rand(3, 4, seed=20)}, grad_nodes=["data"])
+
+
+def test_softmax_output_backward_is_p_minus_label():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.SoftmaxOutput(data, name="softmax")
+    x = _rand(3, 4, seed=21, scale=2.0)
+    y = np.array([0, 2, 3], "float32")
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="write", data=x.shape,
+                         softmax_label=y.shape)
+    out = ex.forward(is_train=True, data=mx.nd.array(x),
+                     softmax_label=mx.nd.array(y))[0].asnumpy()
+    ex.backward()
+    onehot = np.eye(4, dtype="float32")[y.astype(int)]
+    assert_almost_equal(ex.grad_dict["data"].asnumpy(), out - onehot,
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_cross_entropy_matches_manual():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    sym = mx.sym.softmax_cross_entropy(data, label)
+    x = _rand(4, 5, seed=22, scale=2.0)
+    y = np.array([1, 0, 4, 2], "float32")
+    out = float(sym.eval(data=mx.nd.array(x),
+                         label=mx.nd.array(y))[0].asnumpy())
+    p = np.exp(x - x.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = -np.log(p[np.arange(4), y.astype(int)]).sum()
+    assert out == pytest.approx(want, rel=1e-4)
+
+
+# ------------------------------------------------------- FullyConnected/dot
+@pytest.mark.parametrize("no_bias,flatten", [(False, True), (True, True),
+                                             (False, False)])
+def test_fully_connected_grad(no_bias, flatten):
+    data = mx.sym.Variable("data")
+    sym = mx.sym.FullyConnected(data, name="fc", num_hidden=3,
+                                no_bias=no_bias, flatten=flatten)
+    loc = {"data": _rand(2, 2, 3, seed=23),
+           "fc_weight": _rand(3, 6 if flatten else 3, seed=24, scale=0.5)}
+    if not no_bias:
+        loc["fc_bias"] = _rand(3, seed=25)
+    _grad_check(sym, loc)
+
+
+@pytest.mark.parametrize("ta,tb", [(False, False), (True, False),
+                                   (False, True), (True, True)])
+def test_dot_grad(ta, tb):
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    sym = mx.sym.dot(a, b, transpose_a=ta, transpose_b=tb)
+    sa = (4, 3) if ta else (3, 4)
+    sb = (5, 4) if tb else (4, 5)
+    _grad_check(sym, {"a": _rand(*sa, seed=26), "b": _rand(*sb, seed=27)})
+
+
+@pytest.mark.parametrize("ta,tb", [(False, False), (True, True)])
+def test_batch_dot_grad(ta, tb):
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    sym = mx.sym.batch_dot(a, b, transpose_a=ta, transpose_b=tb)
+    sa = (2, 4, 3) if ta else (2, 3, 4)
+    sb = (2, 5, 4) if tb else (2, 4, 5)
+    _grad_check(sym, {"a": _rand(*sa, seed=28), "b": _rand(*sb, seed=29)})
+
+
+# -------------------------------------------------------------- activations
+@pytest.mark.parametrize("act", ["sigmoid", "tanh", "softrelu", "softsign"])
+def test_activation_grad(act):
+    data = mx.sym.Variable("data")
+    sym = mx.sym.Activation(data, act_type=act)
+    _grad_check(sym, {"data": _rand(3, 4, seed=30, scale=2.0)})
+
+
+def test_relu_grad_away_from_kink():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.Activation(data, act_type="relu")
+    x = _rand(3, 4, seed=31, scale=2.0)
+    x[np.abs(x) < 0.1] = 0.5            # keep FD off the kink
+    _grad_check(sym, {"data": x})
+
+
+@pytest.mark.parametrize("act,slope", [("leaky", 0.3), ("elu", 0.5)])
+def test_leakyrelu_grad(act, slope):
+    data = mx.sym.Variable("data")
+    sym = mx.sym.LeakyReLU(data, act_type=act, slope=slope)
+    x = _rand(3, 4, seed=32, scale=2.0)
+    x[np.abs(x) < 0.1] = 0.5
+    _grad_check(sym, {"data": x})
+
+
+def test_prelu_grad():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.LeakyReLU(data, name="pr", act_type="prelu")
+    x = _rand(3, 4, seed=33, scale=2.0)
+    x[np.abs(x) < 0.1] = -0.5
+    _grad_check(sym, {"data": x, "pr_gamma": np.full(4, 0.3, "float32")})
+
+
+@pytest.mark.parametrize("op,scale,shift", [
+    ("exp", 1.0, 0.0), ("log", 0.4, 1.5), ("sqrt", 0.4, 1.5),
+    ("rsqrt", 0.4, 1.5), ("cbrt", 0.4, 1.5), ("square", 1.0, 0.0),
+    ("sin", 1.0, 0.0), ("cos", 1.0, 0.0), ("arctan", 1.0, 0.0),
+    ("arcsinh", 1.0, 0.0), ("expm1", 1.0, 0.0), ("log1p", 0.4, 0.5),
+    ("erf", 1.0, 0.0),
+])
+def test_unary_grad(op, scale, shift):
+    data = mx.sym.Variable("data")
+    sym = getattr(mx.sym, op)(data)
+    _grad_check(sym, {"data": _rand(3, 4, seed=34, scale=scale,
+                                    shift=shift)})
+
+
+def test_clip_grad_interior():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.clip(data, a_min=-0.8, a_max=0.8)
+    x = _rand(3, 4, seed=35, scale=0.5)   # interior: gradient is identity
+    _grad_check(sym, {"data": x})
+
+
+# -------------------------------------------------- broadcast binary + pow
+@pytest.mark.parametrize("op", ["broadcast_add", "broadcast_sub",
+                                "broadcast_mul", "broadcast_div",
+                                "broadcast_maximum", "broadcast_minimum",
+                                "broadcast_hypot"])
+def test_broadcast_binary_grad(op):
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    sym = getattr(mx.sym, op)(a, b)
+    _grad_check(sym, {"a": _rand(3, 4, seed=36, shift=2.0),
+                      "b": _rand(1, 4, seed=37, shift=0.7)})
+
+
+def test_broadcast_power_grad():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    sym = mx.sym.broadcast_power(a, b)
+    _grad_check(sym, {"a": _rand(3, 4, seed=38, scale=0.3, shift=1.2),
+                      "b": _rand(1, 4, seed=39, scale=0.5, shift=1.0)})
+
+
+@pytest.mark.parametrize("op", ["elemwise_add", "elemwise_sub",
+                                "elemwise_mul", "elemwise_div"])
+def test_elemwise_binary_grad(op):
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    sym = getattr(mx.sym, op)(a, b)
+    _grad_check(sym, {"a": _rand(3, 4, seed=40, shift=2.0),
+                      "b": _rand(3, 4, seed=41, shift=0.8)})
+
+
+# -------------------------------------------------------------- reductions
+@pytest.mark.parametrize("op,axis,keepdims", [
+    ("sum", None, False), ("sum", 1, True), ("sum", (0, 2), False),
+    ("mean", None, False), ("mean", 1, False),
+    ("prod", 1, False), ("nansum", 1, False),
+])
+def test_reduce_grad(op, axis, keepdims):
+    data = mx.sym.Variable("data")
+    kw = {"keepdims": keepdims}
+    if axis is not None:
+        kw["axis"] = axis
+    sym = getattr(mx.sym, op)(data, **kw)
+    _grad_check(sym, {"data": _rand(2, 3, 4, seed=42, shift=1.5)})
+
+
+@pytest.mark.parametrize("ord", [1, 2])
+def test_norm_grad(ord):
+    data = mx.sym.Variable("data")
+    sym = mx.sym.norm(data, ord=ord, axis=1)
+    _grad_check(sym, {"data": _rand(3, 4, seed=43, shift=2.0)})
+
+
+# -------------------------------------------------------- shape-manipulation
+@pytest.mark.parametrize("build", [
+    lambda d: mx.sym.Reshape(d, shape=(4, 6)),
+    lambda d: mx.sym.transpose(d, axes=(1, 0, 2)),
+    lambda d: mx.sym.Flatten(d),
+    lambda d: mx.sym.expand_dims(d, axis=1),
+    lambda d: mx.sym.flip(d, axis=1),
+    lambda d: mx.sym.tile(d, reps=(2, 1, 1)),
+    lambda d: mx.sym.repeat(d, repeats=2, axis=0),
+    lambda d: mx.sym.slice(d, begin=(0, 1, 0), end=(2, 3, 2)),
+    lambda d: mx.sym.slice_axis(d, axis=2, begin=1, end=3),
+    lambda d: mx.sym.reverse(d, axis=0),
+])
+def test_shape_op_grad(build):
+    data = mx.sym.Variable("data")
+    sym = build(data)
+    _grad_check(sym, {"data": _rand(2, 3, 4, seed=44)})
+
+
+def test_concat_grad():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    sym = mx.sym.Concat(a, b, dim=1)
+    _grad_check(sym, {"a": _rand(2, 3, seed=45), "b": _rand(2, 2, seed=46)})
+
+
+def test_stack_where_grad():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    sym = mx.sym.stack(a, b, axis=1).mean() + \
+        mx.sym.sum(mx.sym.where(a > 0, a * 2, b))
+    _grad_check(sym, {"a": _rand(3, 4, seed=47, shift=0.6),
+                      "b": _rand(3, 4, seed=48)})
+
+
+# ------------------------------------------------------------- indexing ops
+def test_embedding_weight_grad():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.Embedding(data, name="e", input_dim=6, output_dim=3)
+    idx = np.array([[0, 2], [5, 2]], "float32")
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="write", data=idx.shape,
+                         e_weight=(6, 3))
+    w = _rand(6, 3, seed=49)
+    ex.arg_dict["e_weight"][:] = w
+    ex.forward(is_train=True, data=mx.nd.array(idx))
+    ex.backward()
+    g = ex.grad_dict["e_weight"].asnumpy()
+    want = np.zeros((6, 3), "float32")
+    for t in idx.ravel().astype(int):
+        want[t] += 1
+    assert_almost_equal(g, want, rtol=1e-5, atol=1e-6)
+
+
+def test_take_grad():
+    a = mx.sym.Variable("a")
+    sym = mx.sym.take(a, mx.sym.Variable("idx"))
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="write", a=(4, 3),
+                         idx=(2,))
+    ex.arg_dict["a"][:] = _rand(4, 3, seed=50)
+    ex.forward(is_train=True, a=ex.arg_dict["a"],
+               idx=mx.nd.array([1.0, 1.0]))
+    ex.backward()
+    g = ex.grad_dict["a"].asnumpy()
+    assert g[1].sum() == pytest.approx(6.0)   # row taken twice, dim 3
+    assert g[0].sum() == 0
+
+
+# -------------------------------------------------------- dtype consistency
+_DTYPE_TOL = {"float16": (2e-2, 2e-2), "float32": (1e-5, 1e-6),
+              "float64": (1e-5, 1e-6)}
+
+# float64 requests run in float32 (documented deviation: no f64 units on
+# TPU and the runtime keeps 32-bit defaults) — storage dtype reflects that
+_EFFECTIVE = {"float16": "float16", "float32": "float32",
+              "float64": "float32"}
+
+
+@pytest.mark.parametrize("dtype", ["float16", "float32", "float64"])
+@pytest.mark.parametrize("family", ["conv", "pool", "softmax", "fc", "bn"])
+def test_forward_dtype_consistency(family, dtype):
+    """Each family computes in the requested dtype and matches the fp32
+    result within per-dtype tolerance (reference test_operator.py dtype
+    sweeps)."""
+    data = mx.sym.Variable("data")
+    if family == "conv":
+        sym = mx.sym.Convolution(data, name="c", kernel=(3, 3),
+                                 num_filter=2, no_bias=True)
+        shapes = {"data": (1, 2, 5, 5), "c_weight": (2, 2, 3, 3)}
+    elif family == "pool":
+        sym = mx.sym.Pooling(data, pool_type="avg", kernel=(2, 2),
+                             stride=(2, 2))
+        shapes = {"data": (1, 2, 4, 4)}
+    elif family == "softmax":
+        sym = mx.sym.softmax(data)
+        shapes = {"data": (3, 4)}
+    elif family == "fc":
+        sym = mx.sym.FullyConnected(data, name="f", num_hidden=3,
+                                    no_bias=True)
+        shapes = {"data": (2, 4), "f_weight": (3, 4)}
+    else:
+        sym = mx.sym.BatchNorm(data, name="b", fix_gamma=False)
+        shapes = {"data": (2, 2, 3, 3), "b_gamma": (2,), "b_beta": (2,)}
+    vals = {k: _rand(*v, seed=51, shift=0.5) for k, v in shapes.items()}
+
+    def run(dt):
+        ex = sym.simple_bind(
+            ctx=mx.cpu(), grad_req="null",
+            type_dict={k: np.dtype(dt) for k in shapes}, **shapes)
+        feeds = {k: mx.nd.array(v.astype(dt)) for k, v in vals.items()}
+        for k, v in feeds.items():
+            ex.arg_dict[k][:] = v
+        out = ex.forward(is_train=False)[0]
+        return out
+
+    out = run(dtype)
+    assert out.dtype == np.dtype(_EFFECTIVE[dtype])
+    rtol, atol = _DTYPE_TOL[dtype]
+    assert_almost_equal(out.asnumpy().astype("float32"),
+                        run("float32").asnumpy(), rtol=rtol, atol=atol)
+
+
+# ------------------------------------------------------------ special forms
+def test_dropout_p0_and_eval_identity():
+    data = mx.sym.Variable("data")
+    x = _rand(3, 4, seed=52)
+    out = mx.sym.Dropout(data, p=0.0).eval(
+        data=mx.nd.array(x))[0].asnumpy()
+    assert_almost_equal(out, x, rtol=1e-6, atol=1e-7)
+    ex = mx.sym.Dropout(data, p=0.7).simple_bind(ctx=mx.cpu(),
+                                                 grad_req="null",
+                                                 data=x.shape)
+    out = ex.forward(is_train=False, data=mx.nd.array(x))[0].asnumpy()
+    assert_almost_equal(out, x, rtol=1e-6, atol=1e-7)  # eval mode: identity
+
+
+def test_dropout_train_scales_survivors():
+    data = mx.sym.Variable("data")
+    p = 0.5
+    ex = mx.sym.Dropout(data, p=p).simple_bind(ctx=mx.cpu(),
+                                               grad_req="null",
+                                               data=(64, 64))
+    mx.random.seed(3)
+    x = np.ones((64, 64), "float32")
+    out = ex.forward(is_train=True, data=mx.nd.array(x))[0].asnumpy()
+    kept = out[out != 0]
+    assert kept.size > 0
+    assert_almost_equal(kept, np.full_like(kept, 1 / (1 - p)), rtol=1e-5,
+                        atol=1e-6)
+    frac = kept.size / out.size
+    assert 0.4 < frac < 0.6
+
+
+def test_where_and_maximum_grad_routing():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    sym = mx.sym.maximum(a, b)
+    av = np.array([[1.0, -2.0], [3.0, 0.5]], "float32")
+    bv = np.array([[0.0, 4.0], [1.0, 2.0]], "float32")
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="write", a=av.shape,
+                         b=bv.shape)
+    ex.forward(is_train=True, a=mx.nd.array(av), b=mx.nd.array(bv))
+    ex.backward()
+    assert_almost_equal(ex.grad_dict["a"].asnumpy(),
+                        (av > bv).astype("float32"), rtol=1e-6, atol=0)
+    assert_almost_equal(ex.grad_dict["b"].asnumpy(),
+                        (bv >= av).astype("float32"), rtol=1e-6, atol=0)
