@@ -26,6 +26,28 @@ __all__ = ["gpipe", "gpipe_interleaved", "pipeline_stage_loop",
            "pipeline_train_1f1b"]
 
 
+def _stage_caller(stage_fn):
+    """Heterogeneous-architecture support: a ``stage_fn(params, x,
+    stage_idx)`` receives the logical stage index (a traced scalar — switch
+    on it with ``lax.switch`` for per-stage distinct computations); the
+    common 2-arg form ignores it."""
+    import inspect
+    try:
+        params = inspect.signature(stage_fn).parameters.values()
+        n_required = sum(1 for p in params
+                         if p.kind in (p.POSITIONAL_ONLY,
+                                       p.POSITIONAL_OR_KEYWORD)
+                         and p.default is p.empty)
+    except (TypeError, ValueError):
+        n_required = 2
+    # only an explicitly 3-required-positional signature opts in — a
+    # defaulted/variadic third parameter (train=False, **kw) must NOT
+    # silently receive the traced stage index
+    if n_required >= 3:
+        return stage_fn
+    return lambda p, x, _k: stage_fn(p, x)
+
+
 def pipeline_stage_loop(stage_fn, stage_params, x_micro, axis_name):
     """Per-device body (call inside shard_map).
 
@@ -37,12 +59,13 @@ def pipeline_stage_loop(stage_fn, stage_params, x_micro, axis_name):
     """
     n_stage = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
+    call = _stage_caller(stage_fn)
     params = jax.tree.map(lambda p: p[0], stage_params)
     n_micro = x_micro.shape[0]
     steps = n_micro + n_stage - 1
     perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
 
-    probe = stage_fn(params, x_micro[0])
+    probe = call(params, x_micro[0], idx)
     carry0 = jnp.zeros_like(probe)
     outputs0 = jnp.zeros((n_micro,) + probe.shape, probe.dtype)
     # accumulators must carry the same varying-axes type as the loop values
@@ -55,7 +78,7 @@ def pipeline_stage_loop(stage_fn, stage_params, x_micro, axis_name):
         inp = jnp.where(idx == 0, inject, carry)
         # fill/drain ticks run with garbage on idle devices; their results
         # are never written (masked below) — branch-free schedule
-        out = stage_fn(params, inp)
+        out = call(params, inp, idx)
         widx = t - (n_stage - 1)
         is_last = idx == n_stage - 1
         write = is_last & (widx >= 0)
@@ -127,10 +150,11 @@ def _f1b1_device_loop(stage_fn, loss_fn, n_stages, n_micro, stage_params,
     """
     S, N = n_stages, n_micro
     d = lax.axis_index(axis_name)
+    call = _stage_caller(stage_fn)
     params = jax.tree.map(lambda p: p[0], stage_params)
     B = min(N, 2 * S)                       # circular stash slots (static)
 
-    probe = stage_fn(params, x_micro[0])
+    probe = call(params, x_micro[0], d)
     zero_act = jnp.zeros_like(probe)
     zero_act = zero_act + lax.psum(jnp.zeros([], probe.dtype), axis_name) * 0
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
@@ -158,7 +182,7 @@ def _f1b1_device_loop(stage_fn, loss_fn, n_stages, n_micro, stage_params,
         m_fc = jnp.clip(m_f, 0, N - 1)
         inp = jnp.where(d == 0, x_micro[m_fc].astype(probe.dtype),
                         st["fwd_carry"])
-        out = stage_fn(params, inp)
+        out = call(params, inp, d)
         stash = st["stash"].at[m_fc % B].set(
             jnp.where(f_active, inp, st["stash"][m_fc % B]))
         fwd_carry = lax.ppermute(out, axis_name, fwd_perm)
@@ -175,7 +199,8 @@ def _f1b1_device_loop(stage_fn, loss_fn, n_stages, n_micro, stage_params,
         ct = jnp.ones([], loss_m.dtype) / N + loss_m * 0
         g_seed = loss_vjp(ct)[0].astype(probe.dtype)
         g_in = jnp.where(d == S - 1, g_seed, st["bwd_carry"])
-        _, stage_vjp = jax.vjp(stage_fn, params, stage_in)
+        _, stage_vjp = jax.vjp(lambda p, xx: call(p, xx, d), params,
+                               stage_in)
         dp, dx_stage = stage_vjp(g_in)
         # NaN-safe masking: warmup ticks evaluate the loss VJP on garbage
         # activations, which may be non-finite — jnp.where, never `* mask`
@@ -351,8 +376,9 @@ def gpipe_interleaved(stage_fn, stacked_params, x, mesh, n_microbatches,
 
     def device_loop(params, xm):
         d = lax.axis_index(pp_axis)
+        call = _stage_caller(stage_fn)
         my_params = params                     # (V, ...) chunks of device d
-        probe = stage_fn(jax.tree.map(lambda p: p[0], my_params), xm[0])
+        probe = call(jax.tree.map(lambda p: p[0], my_params), xm[0], d)
         zero = jnp.zeros_like(probe)
         zero = zero + lax.psum(jnp.zeros([], probe.dtype), pp_axis) * 0
         perm = [(i, (i + 1) % S) for i in range(S)]
@@ -369,7 +395,8 @@ def gpipe_interleaved(stage_fn, stacked_params, x, mesh, n_microbatches,
             inp = jnp.where(s_src < 0, xm[mc].astype(probe.dtype),
                             bufs[jnp.clip(s_src, 0, n_slots - 1)])
             chunk = jnp.clip(k // S, 0, V - 1)
-            out = stage_fn(jax.tree.map(lambda p: p[chunk], my_params), inp)
+            out = call(jax.tree.map(lambda p: p[chunk], my_params), inp,
+                       jnp.clip(k, 0, K - 1))
             out = jnp.where(active, out, zero)
             # last logical stage writes the pipeline output
             is_final = active & (k == K - 1)

@@ -232,3 +232,88 @@ def test_interleaved_odd_batches_and_slots():
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(_sequential(params, x)),
                                rtol=2e-5, atol=2e-6)
+
+
+# ------------------------------------------- heterogeneous stage functions
+def _hetero_stage(p, x, k):
+    """Per-stage distinct ARCHITECTURE: even stages tanh, odd stages
+    leaky-relu — selected by the traced logical stage index."""
+    h = x @ p["w"] + p["b"]
+    return jax.lax.switch(k % 2, [jnp.tanh,
+                                  lambda z: jnp.where(z > 0, z, 0.2 * z)], h)
+
+
+def _hetero_sequential(params, x):
+    for i in range(params["w"].shape[0]):
+        h = x @ params["w"][i] + params["b"][i]
+        x = np.tanh(h) if i % 2 == 0 else np.where(h > 0, h, 0.2 * h)
+    return x
+
+
+def test_heterogeneous_stages_gpipe():
+    S, d = 4, 5
+    mesh = _mesh(S)
+    params = _stacked(S, d, seed=21)
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(8, d), jnp.float32)
+    out = gpipe(_hetero_stage, params, x, mesh, 4)
+    np.testing.assert_allclose(np.asarray(out),
+                               _hetero_sequential(params, np.asarray(x)),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_heterogeneous_stages_interleaved():
+    S, v, d = 2, 2, 5
+    mesh = _mesh(S)
+    params = _stacked(S * v, d, seed=22)
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(6, d), jnp.float32)
+    out = gpipe_interleaved(_hetero_stage, params, x, mesh, 3, v)
+    np.testing.assert_allclose(np.asarray(out),
+                               _hetero_sequential(params, np.asarray(x)),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_heterogeneous_stages_1f1b_grads():
+    from mxnet_tpu.parallel import pipeline_train_1f1b
+    S, d = 4, 4
+    mesh = _mesh(S)
+    params = _stacked(S, d, seed=23)
+    rng = np.random.RandomState(8)
+    x = jnp.asarray(rng.randn(4, d), jnp.float32)
+    y = jnp.asarray(rng.randn(4, d), jnp.float32)
+    mse = lambda yp, yt: jnp.mean((yp - yt) ** 2)  # noqa: E731
+    loss, grads, dx = pipeline_train_1f1b(_hetero_stage, mse, params, x, y,
+                                          mesh, n_microbatches=2)
+
+    def ref_of(p, xx):
+        out = xx
+        for i in range(S):
+            h = out @ p["w"][i] + p["b"][i]
+            out = jnp.tanh(h) if i % 2 == 0 else jnp.where(h > 0, h, 0.2 * h)
+        return mse(out, y)
+
+    want_loss, want_grads = jax.value_and_grad(ref_of)(params, x)
+    want_dx = jax.grad(lambda xx: ref_of(params, xx))(x)
+    np.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-5)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(grads[k]),
+                                   np.asarray(want_grads[k]),
+                                   rtol=1e-4, atol=1e-6, err_msg=k)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(want_dx),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_defaulted_third_param_is_not_stage_idx():
+    """A homogeneous stage_fn with a defaulted third parameter must keep
+    its default — only 3 required positionals opt into the stage index."""
+    from mxnet_tpu.parallel.pipeline import _stage_caller
+    seen = {}
+
+    def stage(p, x, train=False):
+        seen["train"] = train
+        return x
+
+    call = _stage_caller(stage)
+    call({}, jnp.ones(2), 5)
+    assert seen["train"] is False
