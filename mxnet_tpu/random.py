@@ -18,12 +18,20 @@ import jax
 
 _lock = threading.Lock()
 _key = [jax.random.PRNGKey(0)]
+# pre-split pool: one eager split per POOL draws instead of one per draw —
+# an eager jax.random.split costs ~1.5 ms of dispatch, which would otherwise
+# dominate every stochastic op and every CachedOp call
+_POOL = 128
+_pool = {"keys": None, "i": 0, "last": None}
 
 
 def seed(seed_state, ctx="all"):
     """Reset the global key (reference ``mx.random.seed``)."""
     with _lock:
         _key[0] = jax.random.PRNGKey(int(seed_state))
+        _pool["keys"] = None
+        _pool["i"] = 0
+        _pool["last"] = None
 
 
 _tls = threading.local()
@@ -56,14 +64,31 @@ def next_key():
     each stochastic op invocation)."""
     stack = getattr(_tls, "stack", None)
     if stack:
+        # traced scope: splits are recorded into the trace, not dispatched
         stack[-1], sub = jax.random.split(stack[-1])
         return sub
     with _lock:
-        _key[0], sub = jax.random.split(_key[0])
+        if _pool["keys"] is None or _pool["i"] >= _POOL:
+            import numpy as _np
+            ks = jax.random.split(_key[0], _POOL + 1)
+            _key[0] = ks[0]
+            # host copy: a numpy row IS a valid key and slices for free —
+            # a device-array __getitem__ costs a full eager dispatch
+            _pool["keys"] = _np.asarray(ks[1:])
+            _pool["i"] = 0
+        sub = _pool["keys"][_pool["i"]]
+        _pool["i"] += 1
+        _pool["last"] = sub
         return sub
 
 
 def current_key():
+    """The most recently issued key — consumers that *re-run* the last
+    stochastic computation (executor.backward's fused fwd+bwd recompute)
+    must see the same stream the forward drew, and it must differ draw to
+    draw (the pool no longer advances ``_key[0]`` per draw)."""
+    if _pool["last"] is not None:
+        return _pool["last"]
     return _key[0]
 
 

@@ -345,3 +345,20 @@ def test_profiler_xplane_per_op_table(tmp_path):
     # sort_by=count works and the parse is repeatable
     t2 = profiler.dumps(sort_by="count")
     assert "Device ops" in t2
+
+
+def test_current_key_varies_per_draw():
+    """Regression: with the pre-split key pool, current_key() must track
+    the draw stream (executor.backward seeds its fwd+bwd recompute from it
+    — a frozen key would repeat dropout masks across steps)."""
+    from mxnet_tpu import random as r
+    mx.random.seed(11)
+    seen = []
+    for _ in range(5):
+        r.next_key()
+        seen.append(tuple(np.asarray(r.current_key()).tolist()))
+    assert len(set(seen)) == 5, seen
+    # and it equals the key the draw returned
+    k = r.next_key()
+    assert tuple(np.asarray(k).tolist()) == \
+        tuple(np.asarray(r.current_key()).tolist())
