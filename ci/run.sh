@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# CI harness (reference ``ci/`` runtime functions, adapted: no docker — one
+# box, two backends).  Stages:
+#   unit      - full pytest suite on the virtual 8-device CPU mesh
+#   gate      - multichip SPMD dry-run (dp/tp/sp/pp/ep) via __graft_entry__
+#   examples  - fast example-script smoke runs (synthetic data)
+#   bench     - quick headline benchmark sanity (img/s > 0)
+# Usage: ci/run.sh [stage ...]   (default: unit gate)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+stage_unit() {
+  python -m pytest tests/ -q
+}
+
+stage_gate() {
+  python - <<'PY'
+import __graft_entry__
+__graft_entry__.dryrun_multichip(8)
+PY
+}
+
+stage_examples() {
+  python example/gluon/mnist.py --epochs 1
+  python example/rnn/word_lm.py --epochs 3 --sentences 200
+  python example/sparse/factorization_machine.py --epochs 3 --samples 512
+  python example/quantization/quantize_model.py --epochs 4
+  python example/profiler/profile_model.py --iters 4
+  python example/distributed_training/train_dist.py --iters 5
+}
+
+stage_bench() {
+  local out
+  out=$(BENCH_CONFIGS=headline python bench.py | tail -1)
+  python - "$out" <<'PY'
+import json, sys
+d = json.loads(sys.argv[1])
+assert d["value"] and d["value"] > 0, d
+print("bench ok:", d["value"], d["unit"])
+PY
+}
+
+stages=("$@")
+[ $# -eq 0 ] && stages=(unit gate)
+for s in "${stages[@]}"; do
+  echo "=== ci stage: $s ==="
+  "stage_$s"
+done
+echo "=== ci: all stages green ==="

@@ -130,6 +130,8 @@ def _xplane_aggregate(logdir):
             for line in plane.lines:
                 lname = (line.name or line.display_name).lower()
                 if plane_is_device:
+                    if "step" in lname:
+                        continue        # step-number markers, not ops
                     target = agg        # TPU/GPU: lines are XLA ops/modules
                 elif lname.startswith("tf_xlapjrt"):
                     target = rt_agg     # host runtime executing XLA thunks
