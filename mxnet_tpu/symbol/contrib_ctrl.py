@@ -10,13 +10,16 @@ scope become extra node inputs — the subgraph itself is evaluated with those
 entries pre-seeded, so outer computation is never re-executed inside the
 loop.
 
-Limitations (documented): control-flow nodes hold Python closures, so graphs
-containing them do not round-trip through ``tojson`` — matching SURVEY.md
-hard-part 2's bucketing/padding guidance, use them inside bound executors.
-Stochastic ops inside a body draw from a fixed key (the reference gives each
-loop op its own resource seed).
+Serialization: each control-flow node carries its traced body as a
+standalone sub-Symbol (captures replaced by placeholder variables), emitted
+under the node's ``subgraphs`` JSON key — the reference's mechanism
+(``symbol.cc`` subgraph serialization) — and the closure is rebuilt on
+``load``.  Stochastic ops inside a body draw from a fixed key (the
+reference gives each loop op its own resource seed).
 """
 from __future__ import annotations
+
+import json as _json
 
 import jax.numpy as jnp
 from jax import lax
@@ -121,35 +124,65 @@ def _make_eval(inner_order, out_entries, captures, var_binding):
     return run
 
 
-def _ctrl_node(opname, node_fn, input_syms, num_outputs, name):
+def _ctrl_node(opname, node_fn, input_syms, num_outputs, name,
+               attrs=None, subgraphs=None):
     op = OpDef(opname, node_fn)
     inputs = [s._outputs[0] for s in input_syms]
-    node = _Node(op, name, inputs, {}, num_outputs=num_outputs)
+    node = _Node(op, name, inputs, dict(attrs or {}),
+                 num_outputs=num_outputs)
+    if subgraphs:
+        node.subgraphs = subgraphs
     return [Symbol([(node, i)]) for i in range(num_outputs)]
 
 
-def foreach(body, data, init_states, name="foreach"):
-    """Scan ``body(data_t, states) -> (outputs_t, new_states)`` over the
-    leading axis of ``data`` — the symbolic twin of
-    ``nd.contrib.foreach`` (one ``lax.scan`` node in the graph)."""
-    states_are_list = isinstance(init_states, (list, tuple))
-    state_syms = _as_list(init_states)
+def _subgraph_copy(inner_order, out_entries, captures, var_binding,
+                   cap_prefix):
+    """Standalone, serializable copy of a cut subgraph: loop variables keep
+    their names, captured outer entries become placeholder variables
+    ``{cap_prefix}{k}``.  Returns the copy as a Symbol."""
+    remap = {}
+    for vn in var_binding:
+        remap[id(vn)] = _Node(None, vn.name, [], {}, 1, dict(vn.attr_dict))
+    cap_map = {}
+    for k, (p, i) in enumerate(captures):
+        cap_map[(id(p), i)] = _Node(None, f"{cap_prefix}{k}", [], {}, 1, {})
 
-    dvar = _sym.Variable(f"__{name}_data")
-    svars = [_sym.Variable(f"__{name}_state{i}")
-             for i in range(len(state_syms))]
-    out, new_states = body(dvar, svars if states_are_list else svars[0])
-    out_is_list = isinstance(out, (list, tuple))
-    out_syms = _as_list(out)
-    ns_syms = _as_list(new_states)
-    n_out, n_state = len(out_syms), len(ns_syms)
+    def map_entry(p, i):
+        if (id(p), i) in cap_map:
+            return (cap_map[(id(p), i)], 0)
+        return (remap[id(p)], i)
 
-    entries = [s._outputs[0] for s in out_syms + ns_syms]
-    inner_vars = [s._outputs[0][0] for s in [dvar] + svars]
-    inner_order, captures = _cut_subgraph(entries,
-                                          [id(n) for n in inner_vars])
-    run = _make_eval(inner_order, entries, captures, inner_vars)
+    for node in inner_order:
+        if node.op is None:
+            continue            # loop vars pre-created; others are captures
+        nn = _Node(
+            node.op, node.name,
+            [map_entry(p, i) for (p, i) in node.inputs],
+            dict(node.attrs), node.num_outputs, dict(node.attr_dict))
+        # nested control flow: the body symbols are already standalone
+        nn.subgraphs = node.subgraphs
+        remap[id(node)] = nn
+    return Symbol([map_entry(p, i) for (p, i) in out_entries])
 
+
+def _subgraph_parts(sub, var_names, cap_names):
+    """Inverse of :func:`_subgraph_copy` on a loaded subgraph Symbol:
+    returns (inner_order, out_entries, captures, var_binding) for
+    :func:`_make_eval`."""
+    by_name = {}
+    order = sub._topo()
+    for n in order:
+        if n.op is None:
+            by_name[n.name] = n
+    # a loop var the body never reads is absent from the serialized graph —
+    # bind a placeholder (its slot value is simply never consumed)
+    var_binding = [by_name.get(v) or _Node(None, v, [], {}, 1)
+                   for v in var_names]
+    captures = [(by_name[c], 0) for c in cap_names]
+    return order, list(sub._outputs), captures, var_binding
+
+
+def _foreach_node_fn(run, n_out, n_state):
     def node_fn(data_v, *rest, __training__=False):
         states = rest[:n_state]
         caps = rest[n_state:]
@@ -160,49 +193,11 @@ def foreach(body, data, init_states, name="foreach"):
 
         carry, ys = lax.scan(step, tuple(states), data_v)
         return tuple(ys) + tuple(carry)
-
-    cap_syms = [Symbol([e]) for e in captures]
-    outs = _ctrl_node("_foreach", node_fn,
-                      [data] + state_syms + cap_syms,
-                      n_out + n_state, name)
-    out_res = outs[:n_out] if out_is_list else outs[0]
-    state_res = outs[n_out:] if states_are_list else outs[n_out]
-    return out_res, state_res
+    return node_fn
 
 
-def while_loop(cond, func, loop_vars, max_iterations=None, name="while_loop"):
-    """``func(loop_vars) -> (step_output, new_loop_vars)`` while
-    ``cond(loop_vars)`` holds, up to ``max_iterations`` (required for the
-    symbolic form — static shapes).  Step outputs are stacked into
-    ``(max_iterations, ...)`` buffers; rows past the final step stay zero,
-    exactly like the reference's padded symbolic while_loop."""
-    if max_iterations is None:
-        raise ValueError("max_iterations is required for the symbolic "
-                         "while_loop (static shapes)")
-    vars_are_list = isinstance(loop_vars, (list, tuple))
-    lv_syms = _as_list(loop_vars)
-    lvars = [_sym.Variable(f"__{name}_var{i}") for i in range(len(lv_syms))]
-    lvars_arg = lvars if vars_are_list else lvars[0]
-
-    pred = cond(lvars_arg)
-    step_out, new_vars = func(lvars_arg)
-    out_is_list = isinstance(step_out, (list, tuple))
-    out_syms = _as_list(step_out)
-    nv_syms = _as_list(new_vars)
-    n_out, n_var = len(out_syms), len(nv_syms)
-    assert n_var == len(lv_syms), \
-        "func must return as many loop_vars as it receives"
-
-    inner_vars = [s._outputs[0][0] for s in lvars]
-    inner_ids = [id(n) for n in inner_vars]
-    cond_entries = [pred._outputs[0]]
-    func_entries = [s._outputs[0] for s in out_syms + nv_syms]
-    cond_order, cond_caps = _cut_subgraph(cond_entries, inner_ids)
-    func_order, func_caps = _cut_subgraph(func_entries, inner_ids)
-    run_cond = _make_eval(cond_order, cond_entries, cond_caps, inner_vars)
-    run_func = _make_eval(func_order, func_entries, func_caps, inner_vars)
-    n_ccap = len(cond_caps)
-
+def _while_node_fn(run_cond, run_func, n_out, n_var, n_ccap,
+                   max_iterations):
     def node_fn(*rest, __training__=False):
         vars0 = rest[:n_var]
         ccaps = rest[n_var:n_var + n_ccap]
@@ -245,10 +240,163 @@ def while_loop(cond, func, loop_vars, max_iterations=None, name="while_loop"):
             0, max_iterations, body_fn,
             (tuple(vars0), out_bufs, jnp.asarray(True)))
         return tuple(bufs) + tuple(vars_f)
+    return node_fn
 
+
+def _cond_node_fn(run_t, run_e, n_tcap):
+    def node_fn(pred_v, *caps, __training__=False):
+        tc = caps[:n_tcap]
+        ec = caps[n_tcap:]
+        p = jnp.reshape(jnp.asarray(pred_v), ()) != 0
+        return lax.cond(p,
+                        lambda: tuple(run_t([], tc, __training__)),
+                        lambda: tuple(run_e([], ec, __training__)))
+    return node_fn
+
+
+def rebuild_ctrl_node(opname, name, attrs, inputs, subgraph_syms):
+    """Reconstruct a control-flow node (+ its Python kernel) from loaded
+    JSON: ``subgraph_syms`` are the deserialized body graphs, ``attrs``
+    the serialized metadata."""
+    meta = dict(attrs)
+    if "subgraph_vars" not in meta and opname in ("_foreach", "_while_loop"):
+        raise NotImplementedError(
+            f"{opname} node uses the reference's control-flow checkpoint "
+            "schema (num_args/in_data_locs/in_state_locs), which is not "
+            "supported — re-export the graph with this framework")
+    if opname == "_cond" and "then_caps" not in meta:
+        raise NotImplementedError(
+            "_cond node uses the reference's control-flow checkpoint "
+            "schema, which is not supported — re-export the graph")
+    if opname == "_foreach":
+        n_out = int(meta["num_out_data"])
+        n_state = int(meta["num_states"])
+        var_names = _json.loads(meta["subgraph_vars"])
+        cap_names = _json.loads(meta["subgraph_caps"])
+        run = _make_eval(*_subgraph_parts(subgraph_syms[0], var_names,
+                                          cap_names))
+        fn = _foreach_node_fn(run, n_out, n_state)
+        num_outputs = n_out + n_state
+    elif opname == "_while_loop":
+        n_out = int(meta["num_out_data"])
+        n_var = int(meta["num_vars"])
+        var_names = _json.loads(meta["subgraph_vars"])
+        ccaps = _json.loads(meta["cond_caps"])
+        fcaps = _json.loads(meta["func_caps"])
+        run_cond = _make_eval(*_subgraph_parts(subgraph_syms[0], var_names,
+                                               ccaps))
+        run_func = _make_eval(*_subgraph_parts(subgraph_syms[1], var_names,
+                                               fcaps))
+        fn = _while_node_fn(run_cond, run_func, n_out, n_var, len(ccaps),
+                            int(meta["max_iterations"]))
+        num_outputs = n_out + n_var
+    elif opname == "_cond":
+        n_out = int(meta["num_out_data"])
+        tcaps = _json.loads(meta["then_caps"])
+        ecaps = _json.loads(meta["else_caps"])
+        run_t = _make_eval(*_subgraph_parts(subgraph_syms[0], [], tcaps))
+        run_e = _make_eval(*_subgraph_parts(subgraph_syms[1], [], ecaps))
+        fn = _cond_node_fn(run_t, run_e, len(tcaps))
+        num_outputs = n_out
+    else:
+        raise ValueError(f"unknown control-flow op {opname!r}")
+    node = _Node(OpDef(opname, fn), name, inputs, dict(meta),
+                 num_outputs=num_outputs)
+    node.subgraphs = subgraph_syms
+    return node
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """Scan ``body(data_t, states) -> (outputs_t, new_states)`` over the
+    leading axis of ``data`` — the symbolic twin of
+    ``nd.contrib.foreach`` (one ``lax.scan`` node in the graph)."""
+    states_are_list = isinstance(init_states, (list, tuple))
+    state_syms = _as_list(init_states)
+
+    dvar = _sym.Variable(f"__{name}_data")
+    svars = [_sym.Variable(f"__{name}_state{i}")
+             for i in range(len(state_syms))]
+    out, new_states = body(dvar, svars if states_are_list else svars[0])
+    out_is_list = isinstance(out, (list, tuple))
+    out_syms = _as_list(out)
+    ns_syms = _as_list(new_states)
+    n_out, n_state = len(out_syms), len(ns_syms)
+
+    entries = [s._outputs[0] for s in out_syms + ns_syms]
+    inner_vars = [s._outputs[0][0] for s in [dvar] + svars]
+    inner_order, captures = _cut_subgraph(entries,
+                                          [id(n) for n in inner_vars])
+    run = _make_eval(inner_order, entries, captures, inner_vars)
+    node_fn = _foreach_node_fn(run, n_out, n_state)
+
+    cap_prefix = f"__{name}_cap"
+    sub = _subgraph_copy(inner_order, entries, captures, inner_vars,
+                         cap_prefix)
+    attrs = {"num_out_data": str(n_out), "num_states": str(n_state),
+             "subgraph_vars": _json.dumps([v.name for v in inner_vars]),
+             "subgraph_caps": _json.dumps(
+                 [f"{cap_prefix}{k}" for k in range(len(captures))])}
+    cap_syms = [Symbol([e]) for e in captures]
+    outs = _ctrl_node("_foreach", node_fn,
+                      [data] + state_syms + cap_syms,
+                      n_out + n_state, name, attrs=attrs, subgraphs=[sub])
+    out_res = outs[:n_out] if out_is_list else outs[0]
+    state_res = outs[n_out:] if states_are_list else outs[n_out]
+    return out_res, state_res
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None, name="while_loop"):
+    """``func(loop_vars) -> (step_output, new_loop_vars)`` while
+    ``cond(loop_vars)`` holds, up to ``max_iterations`` (required for the
+    symbolic form — static shapes).  Step outputs are stacked into
+    ``(max_iterations, ...)`` buffers; rows past the final step stay zero,
+    exactly like the reference's padded symbolic while_loop."""
+    if max_iterations is None:
+        raise ValueError("max_iterations is required for the symbolic "
+                         "while_loop (static shapes)")
+    vars_are_list = isinstance(loop_vars, (list, tuple))
+    lv_syms = _as_list(loop_vars)
+    lvars = [_sym.Variable(f"__{name}_var{i}") for i in range(len(lv_syms))]
+    lvars_arg = lvars if vars_are_list else lvars[0]
+
+    pred = cond(lvars_arg)
+    step_out, new_vars = func(lvars_arg)
+    out_is_list = isinstance(step_out, (list, tuple))
+    out_syms = _as_list(step_out)
+    nv_syms = _as_list(new_vars)
+    n_out, n_var = len(out_syms), len(nv_syms)
+    assert n_var == len(lv_syms), \
+        "func must return as many loop_vars as it receives"
+
+    inner_vars = [s._outputs[0][0] for s in lvars]
+    inner_ids = [id(n) for n in inner_vars]
+    cond_entries = [pred._outputs[0]]
+    func_entries = [s._outputs[0] for s in out_syms + nv_syms]
+    cond_order, cond_caps = _cut_subgraph(cond_entries, inner_ids)
+    func_order, func_caps = _cut_subgraph(func_entries, inner_ids)
+    run_cond = _make_eval(cond_order, cond_entries, cond_caps, inner_vars)
+    run_func = _make_eval(func_order, func_entries, func_caps, inner_vars)
+    n_ccap = len(cond_caps)
+
+    node_fn = _while_node_fn(run_cond, run_func, n_out, n_var, n_ccap,
+                             max_iterations)
+    ccap_prefix = f"__{name}_ccap"
+    fcap_prefix = f"__{name}_fcap"
+    sub_c = _subgraph_copy(cond_order, cond_entries, cond_caps, inner_vars,
+                           ccap_prefix)
+    sub_f = _subgraph_copy(func_order, func_entries, func_caps, inner_vars,
+                           fcap_prefix)
+    attrs = {"num_out_data": str(n_out), "num_vars": str(n_var),
+             "max_iterations": str(int(max_iterations)),
+             "subgraph_vars": _json.dumps([v.name for v in inner_vars]),
+             "cond_caps": _json.dumps(
+                 [f"{ccap_prefix}{k}" for k in range(n_ccap)]),
+             "func_caps": _json.dumps(
+                 [f"{fcap_prefix}{k}" for k in range(len(func_caps))])}
     cap_syms = [Symbol([e]) for e in cond_caps + func_caps]
     outs = _ctrl_node("_while_loop", node_fn, lv_syms + cap_syms,
-                      n_out + n_var, name)
+                      n_out + n_var, name, attrs=attrs,
+                      subgraphs=[sub_c, sub_f])
     out_res = outs[:n_out] if out_is_list else outs[0]
     var_res = outs[n_out:] if vars_are_list else outs[n_out]
     return out_res, var_res
@@ -277,14 +425,16 @@ def cond(pred, then_func, else_func, name="cond"):
     run_e = _make_eval(e_order, e_entries, e_caps, [])
     n_tcap = len(t_caps)
 
-    def node_fn(pred_v, *caps, __training__=False):
-        tc = caps[:n_tcap]
-        ec = caps[n_tcap:]
-        p = jnp.reshape(jnp.asarray(pred_v), ()) != 0
-        return lax.cond(p,
-                        lambda: tuple(run_t([], tc, __training__)),
-                        lambda: tuple(run_e([], ec, __training__)))
-
+    node_fn = _cond_node_fn(run_t, run_e, n_tcap)
+    tprefix, eprefix = f"__{name}_tcap", f"__{name}_ecap"
+    sub_t = _subgraph_copy(t_order, t_entries, t_caps, [], tprefix)
+    sub_e = _subgraph_copy(e_order, e_entries, e_caps, [], eprefix)
+    attrs = {"num_out_data": str(n_out),
+             "then_caps": _json.dumps(
+                 [f"{tprefix}{k}" for k in range(n_tcap)]),
+             "else_caps": _json.dumps(
+                 [f"{eprefix}{k}" for k in range(len(e_caps))])}
     cap_syms = [Symbol([e]) for e in t_caps + e_caps]
-    outs = _ctrl_node("_cond", node_fn, [pred] + cap_syms, n_out, name)
+    outs = _ctrl_node("_cond", node_fn, [pred] + cap_syms, n_out, name,
+                      attrs=attrs, subgraphs=[sub_t, sub_e])
     return outs if then_is_list else outs[0]

@@ -59,7 +59,8 @@ def _filter_attrs(op, attrs):
 class _Node:
     """One op instantiation in the graph (or a variable if ``op is None``)."""
 
-    __slots__ = ("op", "name", "inputs", "attrs", "num_outputs", "attr_dict")
+    __slots__ = ("op", "name", "inputs", "attrs", "num_outputs", "attr_dict",
+                 "subgraphs")
 
     def __init__(self, op, name, inputs, attrs, num_outputs=1, attr_dict=None):
         self.op = op            # OpDef or None for variables
@@ -68,6 +69,7 @@ class _Node:
         self.attrs = attrs
         self.num_outputs = num_outputs
         self.attr_dict = attr_dict or {}
+        self.subgraphs = None   # control-flow bodies (list[Symbol]) or None
 
 
 class Symbol:
@@ -419,12 +421,18 @@ class Symbol:
                                         if not k.startswith("__")},
                               "inputs": []})
             else:
-                nodes.append({
+                spec = {
                     "op": node.op.name,
                     "name": node.name,
                     "attrs": {k: str(v) for k, v in node.attrs.items()},
                     "inputs": [[node_index[id(p)], idx, 0] for (p, idx) in node.inputs],
-                })
+                }
+                if node.subgraphs:
+                    # control-flow bodies serialize as nested graphs (the
+                    # reference's node-level subgraph mechanism)
+                    spec["subgraphs"] = [json.loads(sg.tojson())
+                                         for sg in node.subgraphs]
+                nodes.append(spec)
         heads = [[node_index[id(n)], i, 0] for (n, i) in self._outputs]
         return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
                            "node_row_ptr": list(range(len(nodes) + 1)),
@@ -582,6 +590,13 @@ def load_json(json_str):
         attrs.update(spec.get("attrs") or {})
         if spec["op"] == "null":
             node = _Node(None, spec["name"], [], {}, 1, attrs)
+        elif spec.get("subgraphs"):
+            # control-flow node: rebuild body symbols and the lax kernel
+            from . import contrib_ctrl
+            inputs = [(nodes[i], oi) for (i, oi) in map(entry, spec["inputs"])]
+            subs = [load_json(json.dumps(sg)) for sg in spec["subgraphs"]]
+            node = contrib_ctrl.rebuild_ctrl_node(
+                spec["op"], spec["name"], attrs, inputs, subs)
         else:
             op = _reg.get(spec["op"])
             if op is None:

@@ -224,3 +224,111 @@ def test_symbol_bool_raises():
         bool(a == a)
     with pytest.raises(TypeError):
         a in [mx.sym.Variable("b")]   # membership uses __eq__ + __bool__
+
+
+# ------------------------------------------------- JSON subgraph round-trip
+# (reference node-level subgraph serialization, symbol.cc — control-flow
+# graphs must survive save/load like any other checkpointed symbol)
+
+def test_foreach_json_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    s0 = mx.sym.Variable("s0")
+    w = mx.sym.Variable("w")                 # capture from the outer scope
+    out, _st = mx.sym.contrib.foreach(
+        lambda d, s: (d * w + s[0], [s[0] + d.sum()]), data, [s0])
+    f = str(tmp_path / "fe-symbol.json")
+    out.save(f)
+    loaded = mx.sym.load(f)
+    x = np.random.RandomState(0).randn(4, 3).astype("float32")
+    feed = dict(data=mx.nd.array(x),
+                s0=mx.nd.array(np.zeros(3, "float32")),
+                w=mx.nd.array([2.0, 3.0, 4.0]))
+    np.testing.assert_allclose(out.eval(**feed)[0].asnumpy(),
+                               loaded.eval(**feed)[0].asnumpy(), rtol=1e-6)
+    # structure survives a SECOND round-trip (save of a loaded graph)
+    f2 = str(tmp_path / "fe2-symbol.json")
+    loaded.save(f2)
+    again = mx.sym.load(f2)
+    np.testing.assert_allclose(out.eval(**feed)[0].asnumpy(),
+                               again.eval(**feed)[0].asnumpy(), rtol=1e-6)
+
+
+def test_while_loop_json_roundtrip(tmp_path):
+    i0 = mx.sym.Variable("i0")
+    acc0 = mx.sym.Variable("acc0")
+    outs, vars_ = mx.sym.contrib.while_loop(
+        cond=lambda vs: vs[0] < 5,
+        func=lambda vs: ([vs[1]], [vs[0] + 1, vs[1] * 2]),
+        loop_vars=[i0, acc0], max_iterations=8)
+    g = mx.sym.Group([outs[0], vars_[1]])
+    f = str(tmp_path / "wl-symbol.json")
+    g.save(f)
+    loaded = mx.sym.load(f)
+    feed = dict(i0=mx.nd.array([0.0]), acc0=mx.nd.array([1.0]))
+    for a, b in zip(g.eval(**feed), loaded.eval(**feed)):
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy(), rtol=1e-6)
+
+
+def test_cond_json_roundtrip(tmp_path):
+    p = mx.sym.Variable("p")
+    u = mx.sym.Variable("u")
+    c = mx.sym.contrib.cond(p, lambda: u * 2, lambda: u - 1)
+    f = str(tmp_path / "cd-symbol.json")
+    c.save(f)
+    loaded = mx.sym.load(f)
+    for pv in (1.0, 0.0):
+        fd = dict(p=mx.nd.array([pv]), u=mx.nd.array([10.0]))
+        np.testing.assert_allclose(c.eval(**fd)[0].asnumpy(),
+                                   loaded.eval(**fd)[0].asnumpy())
+
+
+def test_loaded_foreach_trains_in_module(tmp_path):
+    """A checkpointed control-flow model must keep training after load
+    (the real point of serialization)."""
+    data = mx.sym.Variable("data")          # (T, batch, feat)
+    s0 = mx.sym.Variable("s0")              # (batch, feat)
+    out, _ = mx.sym.contrib.foreach(
+        lambda d, s: (d + s[0], [s[0] * 0.5 + d]), data, [s0])
+    head = mx.sym.FullyConnected(
+        mx.sym.Flatten(mx.sym.transpose(out, axes=(1, 0, 2))),
+        name="fc", num_hidden=2)
+    sym = mx.sym.SoftmaxOutput(head, name="softmax")
+    f = str(tmp_path / "cf-symbol.json")
+    sym.save(f)
+    loaded = mx.sym.load(f)
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 8, 4).astype("float32")
+    y = rng.randint(0, 2, 8).astype("float32")
+    mod = mx.mod.Module(loaded, data_names=("data", "s0"),
+                        label_names=("softmax_label",), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (3, 8, 4)), ("s0", (8, 4))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd")
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(x), mx.nd.zeros((8, 4))],
+        label=[mx.nd.array(y)])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    assert np.isfinite(mod.get_outputs()[0].asnumpy()).all()
+
+
+def test_nested_control_flow_json_roundtrip(tmp_path):
+    """foreach whose body contains a cond — nested bodies must serialize."""
+    data = mx.sym.Variable("data")
+    s0 = mx.sym.Variable("s0")
+
+    def body(d, s):
+        gated = mx.sym.contrib.cond(d.sum() > 0, lambda: d * 2,
+                                    lambda: d * 0.5)
+        return gated + s[0], [s[0] + 1]
+
+    out, _ = mx.sym.contrib.foreach(body, data, [s0])
+    f = str(tmp_path / "nested-symbol.json")
+    out.save(f)
+    loaded = mx.sym.load(f)
+    x = np.random.RandomState(3).randn(5, 4).astype("float32")
+    feed = dict(data=mx.nd.array(x), s0=mx.nd.array(np.zeros(4, "float32")))
+    np.testing.assert_allclose(out.eval(**feed)[0].asnumpy(),
+                               loaded.eval(**feed)[0].asnumpy(), rtol=1e-6)
