@@ -30,8 +30,9 @@ import time
 
 import numpy as np
 
-BASELINE_TRAIN = 298.51    # ResNet-50 train bs32 fp32, 1x V100
-BASELINE_INFER = 1076.81   # ResNet-50 infer bs32 fp32, 1x V100
+BASELINE_TRAIN = 298.51        # ResNet-50 train bs32 fp32, 1x V100
+BASELINE_INFER = 1076.81       # ResNet-50 infer bs32 fp32, 1x V100
+BASELINE_INFER_FP16 = 2085.51  # ResNet-50 infer bs32 fp16, 1x V100
 
 # bf16 matmul peak TFLOP/s per chip, by device kind substring
 _PEAKS = (("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
@@ -131,7 +132,7 @@ def _stats(block_times, steps_per_block, items_per_step, flops_per_step,
 
 def _trainer_bench(net, loss_fn, data, label, *, n_in=1, warm=3,
                    n_blocks=5, steps_per_block=20, flops_fallback=None,
-                   peak=None, lr=1e-4):
+                   peak=None, lr=1e-4, amp_bf16=False):
     """AOT-compile one SPMD train step, time it, return stats."""
     import jax
     import jax.numpy as jnp
@@ -145,7 +146,7 @@ def _trainer_bench(net, loss_fn, data, label, *, n_in=1, warm=3,
     mesh = make_mesh(n_devices=1, dp=1)
     step_jit, state = make_train_step(
         net, loss_fn, FunctionalOptimizer("sgd", lr, momentum=0.9), mesh,
-        n_in=n_in, donate=True)
+        n_in=n_in, donate=True, amp_bf16=amp_bf16)
     # stage batch data onto the mesh with the executable's expected sharding
     # (an AOT-compiled step refuses to re-place host-resident arrays)
     batch_sh = NamedSharding(mesh, P("dp"))
@@ -185,7 +186,8 @@ def _trainer_bench(net, loss_fn, data, label, *, n_in=1, warm=3,
 
 
 def bench_resnet_train(precision):
-    """precision: 'default' (bf16 compute on TPU) or 'highest' (fp32)."""
+    """precision: 'default' (bf16 compute on TPU), 'highest' (fp32), or
+    'amp' (bf16 compute AND activations, fp32 master weights)."""
     import contextlib
     import jax
     import mxnet_tpu as mx
@@ -204,17 +206,24 @@ def bench_resnet_train(precision):
         net = _resnet(classes=1000, ctx=ctx)
         times, flops, spb = _trainer_bench(
             net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), x, y,
-            n_blocks=5 if precision == "default" else 3,
-            flops_fallback=_RESNET50_TRAIN_FLOPS * batch, peak=peak)
+            n_blocks=5 if precision != "highest" else 3,
+            flops_fallback=_RESNET50_TRAIN_FLOPS * batch, peak=peak,
+            amp_bf16=(precision == "amp"))
     st = _stats(times, spb, batch, flops, peak)
-    st["precision"] = ("bf16_compute_fp32_params" if precision == "default"
-                      else "fp32_highest")
+    st["precision"] = {"default": "bf16_compute_fp32_params",
+                       "highest": "fp32_highest",
+                       "amp": "bf16_activations_fp32_master"}[precision]
     st["batch"] = batch
     return st
 
 
-def bench_resnet_infer():
+def bench_resnet_infer(bf16_weights=False):
+    """Inference throughput; with ``bf16_weights`` the model is converted
+    the way ``amp.convert_hybrid_block`` stores it — bf16 params and
+    activations (the analog of the reference's fp16 V100 inference rows,
+    ``docs/faq/perf.md:195``)."""
     import jax
+    import jax.numpy as jnp
     from __graft_entry__ import entry
 
     batch = 32
@@ -223,16 +232,20 @@ def bench_resnet_infer():
     rng = np.random.RandomState(0)
     x0 = jax.device_put(rng.randn(batch, 3, 224, 224).astype("float32"))
     arrays = example_args[1:]
+    if bf16_weights:
+        arrays = tuple(a.astype(jnp.bfloat16) if a.dtype == jnp.float32
+                       else a for a in arrays)
+        x0 = x0.astype(jnp.bfloat16)
 
     # chain the input through each step (x' = x + eps·Σlogits) so successive
     # dispatches carry a real data dependency — without it the async pipeline
     # overlaps identical executions and the wall-clock is fiction.  The
     # scalar mean is the value-fetch sync barrier.
-    import jax.numpy as jnp
-
     def chained(x, *par):
         out = fn(x, *par)
-        return jnp.mean(out), x + 1e-30 * jnp.sum(out).astype(x.dtype)
+        return (jnp.mean(out.astype(jnp.float32)),
+                x + jnp.asarray(1e-8 if bf16_weights else 1e-30,
+                                x.dtype) * jnp.sum(out).astype(x.dtype))
 
     compiled = jax.jit(chained).lower(x0, *arrays).compile()
     flops = _cost_flops(compiled) or _RESNET50_FWD_FLOPS * batch
@@ -249,9 +262,11 @@ def bench_resnet_infer():
     times = _time_blocks(one_block, 5,
                          lambda: float(np.asarray(holder["m"])))
     st = _stats(times, 30, batch, flops, peak)
-    st["precision"] = "bf16_compute_fp32_params"
+    st["precision"] = ("bf16_weights_and_activations" if bf16_weights
+                       else "bf16_compute_fp32_params")
     st["batch"] = batch
-    st["vs_baseline"] = round(st["items_per_sec"] / BASELINE_INFER, 3)
+    base = BASELINE_INFER_FP16 if bf16_weights else BASELINE_INFER
+    st["vs_baseline"] = round(st["items_per_sec"] / base, 3)
     return st
 
 
@@ -405,7 +420,7 @@ def _bench_input_pipeline_impl(_os, jax, mx, recordio, tmpdir, n_img, hw,
 def main():
     sel = [s.strip() for s in
            os.environ.get("BENCH_CONFIGS",
-                          "headline,infer,fp32,bert,ssd,io").split(",")]
+                          "headline,infer,fp32,amp,bert,ssd,io").split(",")]
     extra = {}
 
     headline = None
@@ -425,6 +440,16 @@ def main():
                 bench_resnet_train("highest")
         except Exception as e:           # pragma: no cover
             extra["resnet50_train_bs32_fp32_highest"] = {"error": repr(e)}
+    if "amp" in sel:
+        try:
+            extra["resnet50_train_bs32_amp_bf16"] = bench_resnet_train("amp")
+        except Exception as e:           # pragma: no cover
+            extra["resnet50_train_bs32_amp_bf16"] = {"error": repr(e)}
+        try:
+            extra["resnet50_infer_bs32_bf16"] = \
+                bench_resnet_infer(bf16_weights=True)
+        except Exception as e:           # pragma: no cover
+            extra["resnet50_infer_bs32_bf16"] = {"error": repr(e)}
     if "bert" in sel:
         try:
             extra["bert_base_train_b32_s128"] = bench_bert_train()

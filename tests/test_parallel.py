@@ -197,3 +197,47 @@ def test_ulysses_differentiable():
     g_ref = jax.grad(f_ref)(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=2e-3,
                                atol=2e-4)
+
+
+def test_amp_bf16_train_step_matches_fp32_direction():
+    """make_train_step(amp_bf16=True): fp32 master weights, bf16 compute —
+    loss trajectory tracks the fp32 run within bf16 tolerance."""
+    import jax
+    import numpy as np
+    from mxnet_tpu.parallel import (FunctionalOptimizer, make_mesh,
+                                    make_train_step)
+    import mxnet_tpu as mx
+
+    def make(amp):
+        mx.random.seed(3)
+        net = mx.gluon.nn.Sequential()
+        net.add(mx.gluon.nn.Dense(32, activation="relu"),
+                mx.gluon.nn.Dense(4))
+        net.initialize()
+        net(mx.nd.zeros((1, 8)))
+        mesh = make_mesh(n_devices=1, dp=1)
+        return make_train_step(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                               FunctionalOptimizer("sgd", 0.1), mesh,
+                               donate=False, amp_bf16=amp)
+
+    rng = np.random.RandomState(0)
+    centers = rng.randn(4, 8) * 3
+    yv = rng.randint(0, 4, 64)
+    xv = (centers[yv] + rng.randn(64, 8) * 0.5).astype("float32")
+    import jax.numpy as jnp
+    key = jnp.zeros((2,), jnp.uint32)
+    losses = {}
+    for amp in (False, True):
+        step, state = make(amp)
+        ls = []
+        for t in range(12):
+            state, loss = step(state, jnp.asarray(xv),
+                               jnp.asarray(yv.astype("float32")), key,
+                               jnp.uint32(t))
+            ls.append(float(loss))
+        # master weights stay fp32 under amp
+        assert all(p.dtype == jnp.float32 for p in state[0].values())
+        losses[amp] = ls
+    assert losses[True][-1] < losses[True][0] * 0.5, losses[True]
+    np.testing.assert_allclose(losses[True][-1], losses[False][-1],
+                               rtol=0.15)
