@@ -426,9 +426,17 @@ def main():
     headline = None
     if "headline" in sel:
         try:
-            headline = bench_resnet_train("default")
+            # headline = the fastest honestly-labeled config: AMP mixed
+            # precision (bf16 activations/compute, fp32 master weights)
+            headline = bench_resnet_train("amp")
         except Exception as e:           # pragma: no cover
-            extra["resnet50_train_bs32_bf16"] = {"error": repr(e)}
+            extra["resnet50_train_bs32_amp_bf16"] = {"error": repr(e)}
+        try:
+            extra["resnet50_train_bs32_bf16_fp32_storage"] = \
+                bench_resnet_train("default")
+        except Exception as e:           # pragma: no cover
+            extra["resnet50_train_bs32_bf16_fp32_storage"] = {
+                "error": repr(e)}
     if "infer" in sel:
         try:
             extra["resnet50_infer_bs32"] = bench_resnet_infer()
@@ -441,10 +449,6 @@ def main():
         except Exception as e:           # pragma: no cover
             extra["resnet50_train_bs32_fp32_highest"] = {"error": repr(e)}
     if "amp" in sel:
-        try:
-            extra["resnet50_train_bs32_amp_bf16"] = bench_resnet_train("amp")
-        except Exception as e:           # pragma: no cover
-            extra["resnet50_train_bs32_amp_bf16"] = {"error": repr(e)}
         try:
             extra["resnet50_infer_bs32_bf16"] = \
                 bench_resnet_infer(bf16_weights=True)
@@ -467,7 +471,7 @@ def main():
             extra["imagerecorditer_pipeline"] = {"error": repr(e)}
 
     print(json.dumps({
-        "metric": "resnet50_train_imgs_per_sec_bs32_bf16",
+        "metric": "resnet50_train_imgs_per_sec_bs32_amp_bf16",
         "value": headline["items_per_sec"] if headline else None,
         "unit": "images/sec/chip",
         "vs_baseline": round(headline["items_per_sec"] / BASELINE_TRAIN, 3)

@@ -60,16 +60,15 @@ def main():
     ctx = mx.gpu(0) if mx.num_gpus() else mx.cpu(0)
     net.initialize(ctx=ctx)
     net(mx.nd.zeros((1,) + shape, ctx=ctx))  # materialize deferred shapes
-    if args.dtype == "bfloat16":
-        from mxnet_tpu.contrib import amp
-        amp.init()
-
     import jax
     mesh = make_mesh(dp=len(jax.devices()))
     trainer = SPMDTrainer(
         net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
         FunctionalOptimizer("sgd", args.lr, momentum=args.mom, wd=args.wd),
-        mesh)
+        mesh,
+        # --dtype bfloat16 → AMP mixed precision inside the fused step
+        # (bf16 activations/compute, fp32 master weights)
+        amp_bf16=(args.dtype in ("bfloat16", "float16")))
 
     if args.benchmark:
         import time

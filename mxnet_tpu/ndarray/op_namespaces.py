@@ -57,3 +57,15 @@ contrib.isfinite = _ctrl.isfinite
 contrib.isnan = _ctrl.isnan
 contrib.isinf = _ctrl.isinf
 
+
+def _reset_arrays(*arrays, num_arrays=None):
+    """Reference ``reset_arrays`` (src/operator/contrib/reset_arrays.cc):
+    zero a list of arrays in place (LARS helper) — an eager frontend
+    utility here (in-place writes are frontend semantics on TPU)."""
+    import jax.numpy as jnp
+    for a in arrays:
+        a._data = jnp.zeros_like(a._data)
+
+
+contrib.reset_arrays = _reset_arrays
+contrib.multi_sum_sq = make_op_func(_reg.get("multi_sum_sq"))

@@ -324,3 +324,24 @@ def amp_multicast(*data, num_outputs=None):
     ``amp_cast.cc AMPMultiCast``)."""
     dt = jnp.result_type(*[d.dtype for d in data])
     return tuple(d.astype(dt) for d in data)
+
+
+@register("_contrib_bitwise_and", aliases=("bitwise_and",))
+def bitwise_and(a, b):
+    return jnp.bitwise_and(a.astype(jnp.int32), b.astype(jnp.int32))
+
+
+@register("_contrib_bitwise_or", aliases=("bitwise_or",))
+def bitwise_or(a, b):
+    return jnp.bitwise_or(a.astype(jnp.int32), b.astype(jnp.int32))
+
+
+@register("_contrib_bitwise_xor", aliases=("bitwise_xor",))
+def bitwise_xor(a, b):
+    return jnp.bitwise_xor(a.astype(jnp.int32), b.astype(jnp.int32))
+
+
+@register("digamma")
+def digamma(a):
+    import jax.scipy.special as jsp
+    return jsp.digamma(a)

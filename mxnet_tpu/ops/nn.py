@@ -765,3 +765,38 @@ def im2col(data, kernel=None, stride=None, dilate=None, pad=None):
     for s in out_spatial:
         flat *= s
     return patches.reshape(n, patches.shape[1], flat)
+
+
+@register("col2im")
+def col2im(data, output_size=None, kernel=None, stride=None, dilate=None,
+           pad=None):
+    """Reference ``col2im`` (src/operator/nn/im2col.h): scatter-add column
+    patches back into an image — exactly the transpose of ``im2col``, so it
+    is derived from it with ``jax.linear_transpose`` (XLA emits the native
+    scatter)."""
+    import jax
+    out_sp = parse_tuple(output_size)
+    nd_ = len(out_sp)
+    kern = parse_tuple(kernel, nd_)
+    n = data.shape[0]
+    prod_k = 1
+    for k in kern:
+        prod_k *= k
+    c = data.shape[1] // prod_k
+    img_shape = (n, c) + tuple(out_sp)
+
+    def fwd(img):
+        return im2col(img, kernel=kernel, stride=stride, dilate=dilate,
+                      pad=pad)
+
+    transpose = jax.linear_transpose(
+        fwd, jax.ShapeDtypeStruct(img_shape, data.dtype))
+    return transpose(data)[0]
+
+
+@register("multi_sum_sq")
+def multi_sum_sq(*arrays, num_arrays=None):
+    """Reference ``multi_sum_sq`` (src/operator/contrib/multi_sum_sq.cc):
+    per-array sum of squares in one fused op (LARS/global-norm clipping)."""
+    return jnp.stack([jnp.sum(jnp.square(a.astype(jnp.float32)))
+                      for a in arrays])

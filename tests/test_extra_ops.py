@@ -142,3 +142,37 @@ def test_identity_attach_kl_sparse_reg():
     g = x.grad.asnumpy()
     assert np.isfinite(g).all()
     assert np.abs(g - 1.0).max() > 1e-6  # penalty actually contributed
+
+
+def test_col2im_is_transpose_of_im2col():
+    x = mx.nd.array(np.arange(32, dtype="float32").reshape(1, 2, 4, 4))
+    cols = mx.nd.im2col(x, kernel=(2, 2), stride=(2, 2))
+    back = mx.nd.col2im(cols, output_size=(4, 4), kernel=(2, 2),
+                        stride=(2, 2))
+    # non-overlapping patches: exact reconstruction
+    np.testing.assert_array_equal(back.asnumpy(), x.asnumpy())
+    # overlapping: each pixel accumulated once per covering patch
+    cols2 = mx.nd.im2col(mx.nd.ones((1, 1, 3, 3)), kernel=(2, 2),
+                         stride=(1, 1))
+    acc = mx.nd.col2im(cols2, output_size=(3, 3), kernel=(2, 2),
+                       stride=(1, 1)).asnumpy()[0, 0]
+    np.testing.assert_array_equal(acc, [[1, 2, 1], [2, 4, 2], [1, 2, 1]])
+
+
+def test_multi_sum_sq_and_reset_arrays():
+    a = mx.nd.ones((2, 2)) * 2
+    b = mx.nd.ones((3,))
+    out = mx.nd.multi_sum_sq(a, b, num_arrays=2).asnumpy()
+    np.testing.assert_allclose(out, [16.0, 3.0])
+    mx.nd.contrib.reset_arrays(a, b, num_arrays=2)
+    assert a.asnumpy().sum() == 0 and b.asnumpy().sum() == 0
+
+
+def test_bitwise_and_digamma():
+    np.testing.assert_array_equal(
+        mx.nd.bitwise_and(mx.nd.array([6, 5]), mx.nd.array([3, 4]))
+        .asnumpy(), [2, 4])
+    np.testing.assert_array_equal(
+        mx.nd.bitwise_xor(mx.nd.array([6]), mx.nd.array([3])).asnumpy(), [5])
+    assert abs(float(mx.nd.digamma(mx.nd.array([1.0])).asscalar())
+               + 0.5772157) < 1e-5
