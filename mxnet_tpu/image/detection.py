@@ -124,8 +124,10 @@ class ImageDetIter(ImageIter):
 
     def __init__(self, batch_size, data_shape, path_imgrec=None,
                  path_imgidx=None, shuffle=False, aug_list=None,
-                 label_width=-1, max_objects=8, **kwargs):
+                 label_width=-1, max_objects=8, label_pad_value=-1.0,
+                 **kwargs):
         self._max_objects = max_objects
+        self._label_pad_value = float(label_pad_value)
         super().__init__(batch_size, data_shape, path_imgrec=path_imgrec,
                          path_imgidx=path_imgidx, shuffle=shuffle,
                          aug_list=aug_list if aug_list is not None else [],
@@ -160,7 +162,8 @@ class ImageDetIter(ImageIter):
             objs = arr.reshape(-1, 5)
         else:
             objs = np.zeros((0, 5), np.float32)
-        out = np.full((self._max_objects, 5), -1.0, dtype=np.float32)
+        out = np.full((self._max_objects, 5), self._label_pad_value,
+                      dtype=np.float32)
         n = min(len(objs), self._max_objects)
         out[:n] = objs[:n]
         return out

@@ -11,4 +11,5 @@ per batch (the host→HBM staging role of the reference's pinned-memory path).
 from .io import (  # noqa: F401
     DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter, PrefetchingIter,
 )
-from .iterators import CSVIter, MNISTIter, ImageRecordIter, LibSVMIter  # noqa: F401
+from .iterators import (CSVIter, ImageDetRecordIter,  # noqa: F401
+                        ImageRecordIter, LibSVMIter, MNISTIter)

@@ -376,3 +376,43 @@ class ImageRecordIter(DataIter):
 
     def getpad(self):
         return max(0, self._cursor + self.batch_size - self.num_data)
+
+
+def ImageDetRecordIter(path_imgrec=None, data_shape=None, batch_size=1,
+                       path_imgidx=None, shuffle=False, label_pad_width=None,
+                       label_pad_value=-1.0, max_objects=None, **kwargs):
+    """Detection record iterator (reference ``ImageDetRecordIter``,
+    src/io/iter_image_det_recordio.cc): `.rec` packs whose headers carry
+    ``[header_width, obj_width, cls, x1, y1, x2, y2, ...]`` labels.
+
+    Thin io-namespace front for :class:`mxnet_tpu.image.ImageDetIter` with
+    the record-iter argument convention; ``label_pad_width`` (total padded
+    label length, 2 + max_objects*obj_width in the reference) maps onto
+    ``max_objects``.
+    """
+    from ..image import ImageDetIter
+    if max_objects is None:
+        max_objects = max((int(label_pad_width) - 2) // 5, 1) \
+            if label_pad_width else 8
+    shape = _maybe_parse_shape(data_shape)
+    aug_kwargs = {k: v for k, v in kwargs.items()
+                  if k in ("resize", "rand_crop", "rand_mirror",
+                           "mean", "std")}
+    # record-iter-convention per-channel normalization args
+    mean_rgb = [kwargs.pop(k, 0.0) for k in ("mean_r", "mean_g", "mean_b")]
+    std_rgb = [kwargs.pop(k, 1.0) for k in ("std_r", "std_g", "std_b")]
+    if any(v != 0.0 for v in mean_rgb):
+        aug_kwargs["mean"] = np.asarray(mean_rgb, np.float32)
+    if any(v != 1.0 for v in std_rgb):
+        aug_kwargs["std"] = np.asarray(std_rgb, np.float32)
+    known = {"round_batch", "preprocess_threads", "seed", "verbose",
+             "part_index", "num_parts"}
+    unknown = set(kwargs) - known - set(aug_kwargs)
+    if unknown:
+        raise TypeError(f"ImageDetRecordIter: unsupported arguments "
+                        f"{sorted(unknown)}")
+    return ImageDetIter(batch_size=int(batch_size), data_shape=shape,
+                        path_imgrec=path_imgrec, path_imgidx=path_imgidx,
+                        shuffle=shuffle, max_objects=max_objects,
+                        label_pad_value=float(label_pad_value),
+                        **aug_kwargs)

@@ -1,0 +1,191 @@
+"""SequentialModule (reference ``python/mxnet/module/sequential_module.py``):
+chain modules so each one's outputs feed the next one's data — the legacy
+way to mix symbolic stages with Python stages (see
+:class:`~mxnet_tpu.module.python_module.PythonModule`).
+"""
+from __future__ import annotations
+
+import logging
+
+from ..io import DataDesc
+from .base_module import BaseModule
+
+
+class SequentialModule(BaseModule):
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=logging):
+        super().__init__(logger=logger)
+        self._modules = []
+        self._metas = []
+        self._label_shapes = None
+        self._data_shapes = None
+
+    def add(self, module, **kwargs):
+        """Append a module (reference ``sequential_module.py:60``).
+        ``take_labels=True`` marks the stage that consumes the labels;
+        ``auto_wiring=True`` renames the previous stage's outputs to this
+        stage's data names."""
+        self._modules.append(module)
+        for key in kwargs:
+            assert key in (self.META_TAKE_LABELS, self.META_AUTO_WIRING), \
+                f"unknown meta {key}"
+        self._metas.append(kwargs)
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        return self
+
+    # ------------------------------------------------------------ properties
+    @property
+    def data_names(self):
+        return self._modules[0].data_names if self._modules else []
+
+    @property
+    def output_names(self):
+        return self._modules[-1].output_names if self._modules else []
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._modules[0].data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._modules[-1].output_shapes
+
+    # ------------------------------------------------------------ parameters
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg_params, aux_params = {}, {}
+        for m in self._modules:
+            a, x = m.get_params()
+            arg_params.update(a)
+            aux_params.update(x)
+        return arg_params, aux_params
+
+    def init_params(self, initializer="default", arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded
+        for m in self._modules:
+            m.init_params(initializer=initializer, arg_params=arg_params,
+                          aux_params=aux_params, allow_missing=True,
+                          force_init=force_init, allow_extra=True)
+        self.params_initialized = True
+
+    # ------------------------------------------------------------------ bind
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        assert shared_module is None, \
+            "shared_module is not supported for SequentialModule"
+        assert len(self._modules) > 0
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._label_shapes = label_shapes
+
+        my_shapes = data_shapes
+        anybody_ever_needs_label = False
+        for i, (module, meta) in enumerate(zip(self._modules, self._metas)):
+            take_labels = meta.get(self.META_TAKE_LABELS, False)
+            last = i == len(self._modules) - 1
+            mod_inputs_need_grad = inputs_need_grad if i == 0 \
+                else for_training
+            if take_labels:
+                anybody_ever_needs_label = True
+            module.bind(data_shapes=my_shapes,
+                        label_shapes=label_shapes if take_labels else None,
+                        for_training=for_training,
+                        inputs_need_grad=mod_inputs_need_grad,
+                        force_rebind=force_rebind, grad_req=grad_req)
+            if not last:
+                outs = module.output_shapes
+                # auto_wiring is declared on the CONSUMING stage's add()
+                if self._metas[i + 1].get(self.META_AUTO_WIRING, False):
+                    # rename this stage's outputs to the next stage's data
+                    # names positionally (reference auto_wiring)
+                    data_names = self._modules[i + 1].data_names
+                    assert len(data_names) == len(outs), \
+                        (data_names, outs)
+                    my_shapes = [DataDesc(n, s) for n, (_o, s)
+                                 in zip(data_names, outs)]
+                else:
+                    # reference default: bind with the actual output names —
+                    # a name mismatch surfaces in the next stage's bind
+                    my_shapes = [DataDesc(o, s) for (o, s) in outs]
+        if not anybody_ever_needs_label:
+            self._label_shapes = None
+        self.binded = True
+
+    # ------------------------------------------------------------- optimizer
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        for m in self._modules:
+            m.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                             optimizer_params=optimizer_params,
+                             force_init=force_init)
+        self.optimizer_initialized = True
+
+    # -------------------------------------------------------------- compute
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        from ..io import DataBatch
+        batch = data_batch
+        for i, (module, meta) in enumerate(zip(self._modules, self._metas)):
+            module.forward(batch, is_train=is_train)
+            if i == len(self._modules) - 1:
+                break
+            label = data_batch.label \
+                if self._metas[i + 1].get(self.META_TAKE_LABELS) else None
+            batch = DataBatch(data=module.get_outputs(), label=label,
+                              pad=getattr(data_batch, "pad", 0))
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        grads = out_grads
+        for i, module in reversed(list(enumerate(self._modules))):
+            module.backward(out_grads=grads)
+            if i == 0:
+                break
+            grads = module.get_input_grads()
+
+    def update(self):
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        for m in self._modules:
+            m.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._modules[-1].get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized \
+            and self.inputs_need_grad
+        return self._modules[0].get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        assert self.binded and self.params_initialized
+        for module, meta in zip(self._modules, self._metas):
+            if meta.get(self.META_TAKE_LABELS, False):
+                module.update_metric(eval_metric, labels, pre_sliced)
+
+    def install_monitor(self, mon):
+        assert self.binded
+        for m in self._modules:
+            m.install_monitor(mon)

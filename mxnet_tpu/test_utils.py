@@ -5,6 +5,7 @@ the numerical contract toolkit every reference test file imports:
 from __future__ import annotations
 
 import numbers
+import os
 
 import numpy as np
 
@@ -285,3 +286,28 @@ class DummyIter:
 
     def reset(self):
         pass
+
+
+def download(url, fname=None, dirname=None, overwrite=False, retries=5):
+    """Reference ``test_utils.py:download``.  This environment has no
+    network egress, so only ``file://`` URLs and existing local paths are
+    fetchable; anything else raises with a clear message (tests that need
+    real downloads gate on it)."""
+    import shutil
+    from urllib.parse import urlparse
+
+    parsed = urlparse(url)
+    if fname is None:
+        fname = parsed.path.split("/")[-1] or "download"
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+        fname = os.path.join(dirname, fname)
+    if os.path.exists(fname) and not overwrite:
+        return fname
+    src = parsed.path if parsed.scheme in ("", "file") else None
+    if src and os.path.exists(src):
+        shutil.copyfile(src, fname)
+        return fname
+    raise RuntimeError(
+        f"download({url!r}): no network egress in this environment; "
+        "use a file:// URL or a pre-staged local path")

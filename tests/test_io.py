@@ -259,3 +259,46 @@ def test_dataloader_multiworker():
     assert len(batches) == 4
     got = np.concatenate([b[1].asnumpy() for b in batches])
     np.testing.assert_allclose(np.sort(got), y)
+
+
+def test_image_det_record_iter(tmp_path):
+    """io.ImageDetRecordIter parses packed detection labels from .rec
+    (reference iter_image_det_recordio.cc format)."""
+    import cv2
+    from mxnet_tpu import recordio
+    rec_path = str(tmp_path / "det.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = (rng.rand(32, 32, 3) * 255).astype(np.uint8)
+        # packed label: [header_width=2, obj_width=5, cls,x1,y1,x2,y2]*2
+        label = [2, 5,
+                 float(i % 3), 0.1, 0.1, 0.5, 0.5,
+                 float((i + 1) % 3), 0.4, 0.4, 0.9, 0.9]
+        header = recordio.IRHeader(len(label), label, i, 0)
+        rec.write(recordio.pack_img(header, img, quality=90))
+    rec.close()
+    it = mx.io.ImageDetRecordIter(path_imgrec=rec_path,
+                                  data_shape=(3, 32, 32), batch_size=4,
+                                  label_pad_width=12)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape[0] == 4 and lab.shape[2] == 5
+    # first object of record 0: class 0 at (.1,.1,.5,.5)
+    np.testing.assert_allclose(lab[0, 0], [0, 0.1, 0.1, 0.5, 0.5],
+                               atol=1e-6)
+    # padding rows are -1
+    assert (lab[0, 2:] == -1).all()
+
+
+def test_test_utils_download_local(tmp_path):
+    src = tmp_path / "weights.bin"
+    src.write_bytes(b"abc123")
+    out = mx.test_utils.download("file://" + str(src),
+                                 dirname=str(tmp_path / "dl"))
+    assert open(out, "rb").read() == b"abc123"
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="egress"):
+        mx.test_utils.download("http://example.com/x.bin",
+                               fname=str(tmp_path / "nope.bin"))

@@ -240,3 +240,61 @@ def test_module_duplicate_device_raises():
     with pytest.raises(ValueError, match="duplicate"):
         mod.bind(data_shapes=[("data", (8, 8))],
                  label_shapes=[("softmax_label", (8,))])
+
+
+# ------------------------------------------------ Sequential / Python modules
+def test_sequential_module_trains():
+    """Two chained symbolic stages (reference sequential_module.py):
+    features → classifier, labels consumed by the last stage."""
+    x, y = _toy_data(240)
+    d1 = mx.sym.Variable("data")
+    feat = mx.sym.Activation(mx.sym.FullyConnected(d1, name="fc1",
+                                                   num_hidden=32),
+                             act_type="relu")
+    d2 = mx.sym.Variable("data")
+    head = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(d2, name="fc2",
+                                                      num_hidden=4),
+                                name="softmax")
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(feat, label_names=None, context=mx.cpu()))
+    seq.add(mx.mod.Module(head, context=mx.cpu()), take_labels=True,
+            auto_wiring=True)
+    it = mx.io.NDArrayIter(x, y, batch_size=40, shuffle=True)
+    seq.fit(it, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3})
+    score = seq.score(mx.io.NDArrayIter(x, y, batch_size=40), "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_python_loss_module_chain():
+    """Symbolic features + a Python loss head (reference
+    python_module.py PythonLossModule)."""
+    x, y = _toy_data(120, nclass=2)
+    onehot = np.eye(2, dtype="float32")[y.astype(int)]
+    d = mx.sym.Variable("data")
+    net = mx.sym.softmax(mx.sym.FullyConnected(d, name="fc",
+                                               num_hidden=2), axis=-1)
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net, label_names=None, context=mx.cpu()))
+    seq.add(mx.mod.PythonLossModule(data_names=("data",),
+                                    label_names=("softmax_label",)),
+            take_labels=True, auto_wiring=True)
+    seq.bind(data_shapes=[("data", (30, 8))],
+             label_shapes=[("softmax_label", (30, 2))],
+             inputs_need_grad=False)
+    seq.init_params()
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    for _ in range(40):
+        for start in range(0, 120, 30):
+            b = mx.io.DataBatch(
+                data=[mx.nd.array(x[start:start + 30])],
+                label=[mx.nd.array(onehot[start:start + 30])])
+            seq.forward(b, is_train=True)
+            seq.backward()
+            seq.update()
+    seq.forward(mx.io.DataBatch(data=[mx.nd.array(x)],
+                                label=[mx.nd.array(onehot)]),
+                is_train=False)
+    pred = seq.get_outputs()[0].asnumpy().argmax(axis=1)
+    assert (pred == y).mean() > 0.9
