@@ -16,6 +16,8 @@ import math
 
 import numpy as _np
 
+from .random import np_rng as _np_rng
+
 def register(klass):
     """Register an initializer under its lowercased class name (reference
     ``initializer.py:270`` — delegates to the generic ``mx.registry``
@@ -194,7 +196,7 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, name, arr):
-        self._set(arr, _np.random.uniform(-self.scale, self.scale,
+        self._set(arr, _np_rng.uniform(-self.scale, self.scale,
                                           arr.shape).astype(_np.float32))
 
 
@@ -207,7 +209,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, name, arr):
-        self._set(arr, _np.random.normal(0, self.sigma,
+        self._set(arr, _np_rng.normal(0, self.sigma,
                                          arr.shape).astype(_np.float32))
 
 
@@ -224,9 +226,9 @@ class Orthogonal(Initializer):
         nout = arr.shape[0]
         nin = int(_np.prod(arr.shape[1:]))
         if self.rand_type == "uniform":
-            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+            tmp = _np_rng.uniform(-1.0, 1.0, (nout, nin))
         else:
-            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+            tmp = _np_rng.normal(0.0, 1.0, (nout, nin))
         u, _, v = _np.linalg.svd(tmp, full_matrices=False)
         q = u if u.shape == tmp.shape else v
         self._set(arr, (self.scale * q).reshape(arr.shape).astype(_np.float32))
@@ -263,9 +265,9 @@ class Xavier(Initializer):
             raise ValueError("Incorrect factor type")
         scale = _np.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            w = _np.random.uniform(-scale, scale, shape)
+            w = _np_rng.uniform(-scale, scale, shape)
         elif self.rnd_type == "gaussian":
-            w = _np.random.normal(0, scale, shape)
+            w = _np_rng.normal(0, scale, shape)
         else:
             raise ValueError("Unknown random type")
         self._set(arr, w.astype(_np.float32))

@@ -18,6 +18,10 @@ import jax
 
 _lock = threading.Lock()
 _key = [jax.random.PRNGKey(0)]
+# host-side stream for initializers (reference initializers run on mxnet's
+# seeded RNG ops, so mx.random.seed must determinize them here too)
+import numpy as _np
+np_rng = _np.random.RandomState(0)
 # pre-split pool: one eager split per POOL draws instead of one per draw —
 # an eager jax.random.split costs ~1.5 ms of dispatch, which would otherwise
 # dominate every stochastic op and every CachedOp call
@@ -32,6 +36,7 @@ def seed(seed_state, ctx="all"):
         _pool["keys"] = None
         _pool["i"] = 0
         _pool["last"] = None
+        np_rng.seed(int(seed_state))
 
 
 _tls = threading.local()

@@ -19,6 +19,7 @@ def test_dist_sync_kvstore_two_workers():
          "-n", "2", "--port", "29731",
          sys.executable, os.path.join(root, "tests",
                                       "dist_sync_kvstore_worker.py")],
-        capture_output=True, text=True, timeout=280, env=env)
+        capture_output=True, text=True, timeout=420, env=env)
     assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
     assert out.stdout.count("WORKER_OK") == 2, out.stdout
+    assert out.stdout.count("MODULE_DIST_OK") == 2, out.stdout

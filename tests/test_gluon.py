@@ -291,7 +291,7 @@ def test_hybrid_grad_matches_eager():
     np.random.seed(7)
     x = nd.array(np.random.randn(2, 8).astype(np.float32))
 
-    np.random.seed(42)
+    mx.random.seed(42)
     net_a = build()
     with autograd.record():
         loss = net_a(x).sum()
@@ -299,7 +299,7 @@ def test_hybrid_grad_matches_eager():
     g_a = [p.grad().asnumpy() for p in net_a.collect_params().values()
            if p.grad_req != "null"]
 
-    np.random.seed(42)
+    mx.random.seed(42)
     net_b = build()
     net_b.hybridize()
     with autograd.record():
