@@ -74,7 +74,6 @@ def next_key():
         return sub
     with _lock:
         if _pool["keys"] is None or _pool["i"] >= _POOL:
-            import numpy as _np
             ks = jax.random.split(_key[0], _POOL + 1)
             _key[0] = ks[0]
             # host copy: a numpy row IS a valid key and slices for free —
