@@ -291,9 +291,28 @@ def export_graph(sym, params, input_shapes, input_dtype="float32"):
         k = k.split(":", 1)[1] if k.startswith(("arg:", "aux:")) else k
         np_params[k] = v.asnumpy() if hasattr(v, "asnumpy") else _np.asarray(v)
 
-    # one output tensor name per (node, out_idx)
+    # one output tensor name per (node, out_idx).  MXNet JSON wires inputs
+    # by index, so duplicate node names are legal there (Gluon-traced
+    # graphs name every op "fwd") — ONNX wires by NAME, so duplicates must
+    # be uniquified here
+    taken = set()
+    uniq = []
+    for n in nodes:
+        name = n["name"]
+        if n["op"] == "null":
+            # duplicate variable names intentionally alias one tensor
+            uniq.append(name)
+            taken.add(name)
+            continue
+        cand, k = name, 0
+        while cand in taken:
+            k += 1
+            cand = f"{name}_n{k}"
+        uniq.append(cand)
+        taken.add(cand)
+
     def out_name(i, j):
-        base = nodes[i]["name"]
+        base = uniq[i]
         return base if j == 0 else f"{base}_out{j}"
 
     ctx = _Ctx(np_params, {})
@@ -305,7 +324,7 @@ def export_graph(sym, params, input_shapes, input_dtype="float32"):
             raise NotImplementedError(
                 f"no ONNX converter for op {n['op']!r} (node {n['name']})")
         ins = [out_name(src, j) for (src, j, _) in n["inputs"]]
-        out = conv(ctx, n["name"], ins, n.get("attrs", {}))
+        out = conv(ctx, uniq[i], ins, n.get("attrs", {}))
         # every converter's final node must carry the mx node's name — that
         # is how downstream nodes reference this output
         assert out == out_name(i, 0), \

@@ -134,10 +134,25 @@ class Symbol:
         return order
 
     # ---------------------------------------------------------------- listing
+    def _schema_aux_ids(self):
+        """Variables that sit at an op's mutable-input positions IN THIS
+        GRAPH (reference NNVM mutable-inputs semantics: aux-ness is the op
+        schema's call, computed per graph — never stored on shared nodes)."""
+        aux = set()
+        for node in self._topo():
+            if node.op is None:
+                continue
+            for pos in AUX_INPUTS.get(node.op.name, ()):
+                if pos < len(node.inputs) and node.inputs[pos][0].op is None:
+                    aux.add(id(node.inputs[pos][0]))
+        return aux
+
     def list_arguments(self):
+        aux_ids = self._schema_aux_ids()
         args = []
         for node in self._topo():
-            if node.op is None and not node.attr_dict.get("__aux__"):
+            if node.op is None and not node.attr_dict.get("__aux__") \
+                    and id(node) not in aux_ids:
                 args.append(node.name)
         return args
 
@@ -153,9 +168,11 @@ class Symbol:
         return names
 
     def list_auxiliary_states(self):
+        aux_ids = self._schema_aux_ids()
         auxs = []
         for node in self._topo():
-            if node.op is None and node.attr_dict.get("__aux__"):
+            if node.op is None and (node.attr_dict.get("__aux__")
+                                    or id(node) in aux_ids):
                 auxs.append(node.name)
         return auxs
 

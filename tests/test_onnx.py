@@ -177,3 +177,37 @@ def test_bn_moving_stats_import_as_aux():
     # ch0: (3-1)/sqrt(4+eps)+0.5 ≈ 1.5 ; ch1: (3-2)/3 - 0.5 ≈ -0.1667
     np.testing.assert_allclose(out[0, 0, 0, 0], 1.5, atol=1e-3)
     np.testing.assert_allclose(out[0, 1, 0, 0], -1 / 6, atol=1e-3)
+
+
+def test_model_zoo_resnet18_export_roundtrip(tmp_path):
+    """Flagship chain (reference mx2onnx's real use): Gluon model-zoo net →
+    hybridize → export (dual-file checkpoint) → load → ONNX dict →
+    import → identical inference outputs."""
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.vision.get_model("resnet18_v1", classes=10)
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(1, 3, 32, 32))
+    net.hybridize()
+    net(x)
+    prefix = str(tmp_path / "r18")
+    net.export(prefix)
+    sym, args, auxs = (mx.sym.load(prefix + "-symbol.json"),
+                       *_load_checkpoint_params(prefix))
+    params = dict(args)
+    params.update(auxs)
+    graph = mxonnx.export_graph(sym, params, (1, 3, 32, 32))
+    sym2, args2, auxs2 = mxonnx.import_graph(graph)
+    xv = x.asnumpy()
+    o1 = _outputs(sym, params, xv)[0]
+    p2 = dict(args2)
+    p2.update(auxs2)
+    o2 = _outputs(sym2, p2, xv)[0]
+    np.testing.assert_allclose(o1, o2, rtol=2e-4, atol=2e-5)
+
+
+def _load_checkpoint_params(prefix):
+    loaded = mx.nd.load(prefix + "-0000.params")
+    args, auxs = {}, {}
+    for k, v in loaded.items():
+        (args if k.startswith("arg:") else auxs)[k.split(":", 1)[1]] = v
+    return args, auxs
