@@ -10,18 +10,20 @@ precision (JAX's default on TPU is bf16 compute over fp32 params; the
 ``fp32`` variant forces ``jax.default_matmul_precision('highest')``).
 
 Headline metric (ONE JSON line on the last stdout line): ResNet-50 training
-throughput, batch 32, default (bf16-compute) precision, vs the reference's
-published 298.51 img/s — ResNet-50 train bs32 fp32 1×V100
-(``docs/faq/perf.md:239``; see BASELINE.md).  All other configs are nested
-under ``"extra"`` in the same JSON object:
+throughput, batch 32, AMP mixed precision (bf16 activations/compute, fp32
+master weights — clearly labeled), vs the reference's published 298.51
+img/s — ResNet-50 train bs32 fp32 1×V100 (``docs/faq/perf.md:239``; see
+BASELINE.md).  All other configs are nested under ``"extra"``:
 
-- ResNet-50 inference bs32 (vs 1,076.81 img/s V100 fp32, ``docs/faq/perf.md:181``)
+- ResNet-50 train bs32 default precision (bf16 compute, fp32 storage)
+- ResNet-50 inference bs32 (vs 1,076.81 img/s V100 fp32) and bf16-weights
+  inference (vs the 2,085.51 img/s V100 fp16 row)
 - ResNet-50 train bs32, fp32-HIGHEST matmul precision
 - BERT-base pretraining step (b32 × s128, BASELINE config 3; no published number)
 - SSD-300 VGG16 train step (b8, BASELINE config 4; no published number)
 - ImageRecordIter input pipeline (host decode img/s + device round-trip MB/s)
 
-Select a subset with BENCH_CONFIGS=headline,infer,fp32,bert,ssd,io.
+Select a subset with BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,io.
 """
 import json
 import os
