@@ -15,13 +15,14 @@ master weights — clearly labeled), vs the reference's published 298.51
 img/s — ResNet-50 train bs32 fp32 1×V100 (``docs/faq/perf.md:239``; see
 BASELINE.md).  All other configs are nested under ``"extra"``:
 
-- ResNet-50 train bs32 default precision (bf16 compute, fp32 storage)
-- ResNet-50 inference bs32 (vs 1,076.81 img/s V100 fp32) and bf16-weights
-  inference (vs the 2,085.51 img/s V100 fp16 row)
-- ResNet-50 train bs32, fp32-HIGHEST matmul precision
-- BERT-base pretraining step (b32 × s128, BASELINE config 3; no published number)
-- SSD-300 VGG16 train step (b8, BASELINE config 4; no published number)
-- ImageRecordIter input pipeline (host decode img/s + device round-trip MB/s)
+- ``headline``: AMP train (above) + train at default precision (bf16
+  compute, fp32 storage)
+- ``infer``: ResNet-50 inference bs32 (vs 1,076.81 img/s V100 fp32)
+- ``amp``: bf16-weights inference (vs the 2,085.51 img/s V100 fp16 row)
+- ``fp32``: train at fp32-HIGHEST matmul precision
+- ``bert``: BERT-base pretraining step (b32 × s128, BASELINE config 3)
+- ``ssd``: SSD-300 VGG16 train step (b8, BASELINE config 4)
+- ``io``: ImageRecordIter pipeline (host decode img/s + round-trip MB/s)
 
 Select a subset with BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,io.
 """

@@ -9,18 +9,10 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu.test_utils import (assert_almost_equal,
-                                  check_numeric_gradient)
+from mxnet_tpu.test_utils import assert_almost_equal  # noqa: F401
 
 
-def _rand(*shape, seed=0, scale=1.0, shift=0.0):
-    return (np.random.RandomState(seed).uniform(-1, 1, shape) * scale
-            + shift).astype("float32")
-
-
-def _grad_check(sym, location, aux=None, rtol=5e-2, atol=1e-2, **kw):
-    check_numeric_gradient(sym, location, aux_states=aux, rtol=rtol,
-                           atol=atol, **kw)
+from conftest import fd_grad_check as _grad_check, fd_rand as _rand  # noqa: E402
 
 
 # ------------------------------------------------------------- Convolution

@@ -1,0 +1,269 @@
+"""Second finite-difference/semantics tranche (reference
+``tests/python/unittest/test_operator.py`` families not covered by
+``test_operator_grad_contracts.py``): pad, LRN, sequence ops, pick/take
+variants, ordering, spatial ops, and shape-polymorphic helpers.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal  # noqa: F401
+
+
+from conftest import fd_grad_check as _grad_check, fd_rand as _rand  # noqa: E402
+
+
+# ---------------------------------------------------------------------- pad
+@pytest.mark.parametrize("mode", ["constant", "edge", "reflect"])
+def test_pad_grad(mode):
+    data = mx.sym.Variable("data")
+    sym = mx.sym.pad(data, mode=mode,
+                     pad_width=(0, 0, 0, 0, 1, 1, 1, 1))
+    _grad_check(sym, {"data": _rand(1, 2, 3, 3, seed=1)})
+
+
+def test_pad_constant_value_forward():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.pad(data, mode="constant", constant_value=7.0,
+                     pad_width=(0, 0, 0, 0, 1, 0, 0, 0))
+    out = sym.eval(data=mx.nd.ones((1, 1, 2, 2)))[0].asnumpy()
+    assert out[0, 0, 0, 0] == 7.0 and out[0, 0, 1, 0] == 1.0
+
+
+# ---------------------------------------------------------------------- LRN
+def test_lrn_grad():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.LRN(data, nsize=3, alpha=1e-2, beta=0.5)
+    _grad_check(sym, {"data": _rand(1, 4, 3, 3, seed=2, shift=1.0)})
+
+
+# -------------------------------------------------------------- sequence ops
+def test_sequence_mask_semantics():
+    data = mx.sym.Variable("data")
+    slen = mx.sym.Variable("len")
+    sym = mx.sym.SequenceMask(data, slen, use_sequence_length=True,
+                              value=-9.0)
+    x = _rand(4, 2, 3, seed=3)                  # (T, batch, feat)
+    ln = np.array([2.0, 4.0], "float32")
+    out = sym.eval(data=mx.nd.array(x), len=mx.nd.array(ln))[0].asnumpy()
+    np.testing.assert_allclose(out[:2, 0], x[:2, 0])
+    assert (out[2:, 0] == -9.0).all()
+    np.testing.assert_allclose(out[:, 1], x[:, 1])
+
+
+def test_sequence_last_and_reverse():
+    data = mx.sym.Variable("data")
+    slen = mx.sym.Variable("len")
+    x = _rand(4, 2, 3, seed=4)
+    ln = np.array([2.0, 4.0], "float32")
+    last = mx.sym.SequenceLast(data, slen, use_sequence_length=True)
+    out = last.eval(data=mx.nd.array(x), len=mx.nd.array(ln))[0].asnumpy()
+    np.testing.assert_allclose(out[0], x[1, 0])
+    np.testing.assert_allclose(out[1], x[3, 1])
+    rev = mx.sym.SequenceReverse(data, slen, use_sequence_length=True)
+    out = rev.eval(data=mx.nd.array(x), len=mx.nd.array(ln))[0].asnumpy()
+    np.testing.assert_allclose(out[0, 0], x[1, 0])   # first 2 reversed
+    np.testing.assert_allclose(out[2, 0], x[2, 0])   # tail untouched
+    np.testing.assert_allclose(out[0, 1], x[3, 1])   # full reverse
+
+
+def test_sequence_mask_grad():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.SequenceMask(data, mx.sym.Variable("len"),
+                              use_sequence_length=True)
+    _grad_check(sym, {"data": _rand(3, 2, 2, seed=5),
+                      "len": np.array([2.0, 3.0], "float32")},
+                grad_nodes=["data"])
+
+
+# ------------------------------------------------------------- pick and take
+def test_pick_grad_and_modes():
+    data = mx.sym.Variable("data")
+    idx = mx.sym.Variable("idx")
+    sym = mx.sym.pick(data, idx, axis=1)
+    x = _rand(3, 4, seed=6)
+    iv = np.array([0.0, 3.0, 1.0], "float32")
+    out = sym.eval(data=mx.nd.array(x), idx=mx.nd.array(iv))[0].asnumpy()
+    np.testing.assert_allclose(out, x[np.arange(3), iv.astype(int)])
+    _grad_check(sym, {"data": x, "idx": iv}, grad_nodes=["data"])
+
+
+@pytest.mark.parametrize("mode", ["clip", "wrap"])
+def test_take_modes(mode):
+    data = mx.sym.Variable("data")
+    idx = mx.sym.Variable("idx")
+    sym = mx.sym.take(data, idx, mode=mode)
+    x = _rand(4, 2, seed=7)
+    iv = np.array([-1.0, 5.0], "float32")
+    out = sym.eval(data=mx.nd.array(x), idx=mx.nd.array(iv))[0].asnumpy()
+    if mode == "clip":
+        np.testing.assert_allclose(out, x[[0, 3]])
+    else:
+        np.testing.assert_allclose(out, x[[-1 % 4, 5 % 4]])
+
+
+def test_batch_take_forward():
+    a = mx.sym.Variable("a")
+    idx = mx.sym.Variable("idx")
+    sym = mx.sym.batch_take(a, idx)
+    x = _rand(3, 4, seed=8)
+    iv = np.array([1.0, 0.0, 3.0], "float32")
+    out = sym.eval(a=mx.nd.array(x), idx=mx.nd.array(iv))[0].asnumpy()
+    np.testing.assert_allclose(out, x[np.arange(3), iv.astype(int)])
+
+
+def test_gather_nd_grad():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.gather_nd(data, mx.sym.Variable("idx"))
+    x = _rand(3, 4, seed=9)
+    iv = np.array([[0, 2, 1], [1, 3, 0]], "float32")
+    _grad_check(sym, {"data": x, "idx": iv}, grad_nodes=["data"])
+
+
+# ------------------------------------------------------------------ ordering
+def test_sort_argsort_topk():
+    data = mx.sym.Variable("data")
+    x = np.array([[3.0, 1.0, 2.0], [0.0, -1.0, 5.0]], "float32")
+    out = mx.sym.sort(data, axis=-1).eval(data=mx.nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(out, np.sort(x, -1))
+    out = mx.sym.argsort(data, axis=-1, is_ascend=False).eval(
+        data=mx.nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(out, np.argsort(-x, -1))
+    val, ind = mx.sym.topk(data, k=2, ret_typ="both", axis=-1).eval(
+        data=mx.nd.array(x))
+    np.testing.assert_allclose(val.asnumpy()[0], [3.0, 2.0])
+    np.testing.assert_allclose(ind.asnumpy()[0], [0.0, 2.0])
+
+
+def test_argmax_argmin_keepdims():
+    data = mx.sym.Variable("data")
+    x = _rand(3, 5, seed=10)
+    out = mx.sym.argmax(data, axis=1, keepdims=True).eval(
+        data=mx.nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(out[:, 0], np.argmax(x, 1))
+    out = mx.sym.argmin(data, axis=0).eval(data=mx.nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(out, np.argmin(x, 0))
+
+
+# ------------------------------------------------------------- spatial/misc
+def test_swapaxes_grad():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.SwapAxis(data, dim1=0, dim2=2)
+    _grad_check(sym, {"data": _rand(2, 3, 4, seed=11)})
+
+
+def test_depth_space_roundtrip():
+    data = mx.sym.Variable("data")
+    x = _rand(1, 8, 2, 2, seed=12)
+    d2s = mx.sym.depth_to_space(data, block_size=2)
+    s2d = mx.sym.space_to_depth(d2s, block_size=2)
+    out = s2d.eval(data=mx.nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-6)
+
+
+def test_upsampling_nearest_grad():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.UpSampling(data, scale=2, sample_type="nearest")
+    x = _rand(1, 2, 3, 3, seed=13)
+    out = sym.eval(data=mx.nd.array(x))[0].asnumpy()
+    assert out.shape == (1, 2, 6, 6)
+    np.testing.assert_allclose(out[0, 0, :2, :2], np.full((2, 2),
+                                                          x[0, 0, 0, 0]))
+    _grad_check(sym, {"data": x})
+
+
+def test_slice_like_and_broadcast_like():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = mx.sym.slice_like(a, b).eval(
+        a=mx.nd.ones((4, 5)), b=mx.nd.zeros((2, 3)))[0]
+    assert out.shape == (2, 3)
+    out = mx.sym.broadcast_like(a, b).eval(
+        a=mx.nd.ones((1, 3)), b=mx.nd.zeros((4, 3)))[0]
+    assert out.shape == (4, 3)
+
+
+def test_shape_array_and_size_array():
+    data = mx.sym.Variable("data")
+    out = mx.sym.shape_array(data).eval(
+        data=mx.nd.ones((2, 3, 5)))[0].asnumpy()
+    np.testing.assert_array_equal(out, [2, 3, 5])
+    out = mx.sym.size_array(data).eval(data=mx.nd.ones((2, 3)))[0].asnumpy()
+    np.testing.assert_array_equal(out.ravel(), [6])
+
+
+def test_one_hot_and_diag():
+    idx = mx.sym.Variable("idx")
+    out = mx.sym.one_hot(idx, depth=4, on_value=2.0, off_value=-1.0).eval(
+        idx=mx.nd.array([1.0, 3.0]))[0].asnumpy()
+    want = np.full((2, 4), -1.0, "float32")
+    want[0, 1] = want[1, 3] = 2.0
+    np.testing.assert_allclose(out, want)
+    data = mx.sym.Variable("data")
+    out = mx.sym.diag(data).eval(
+        data=mx.nd.array(np.arange(9).reshape(3, 3)))[0].asnumpy()
+    np.testing.assert_allclose(out, [0, 4, 8])
+
+
+# --------------------------------------------------------------- RNN fused op
+@pytest.mark.parametrize("mode", ["rnn_tanh", "gru", "lstm"])
+def test_fused_rnn_matches_cell_math(mode):
+    """Fused RNN op forward is finite, shape-correct, and differentiable
+    (reference rnn.cc; exact cell math is covered in test_gluon_rnn)."""
+    T, B, I, H = 3, 2, 4, 5
+    data = mx.sym.Variable("data")
+    params = mx.sym.Variable("params")
+    state = mx.sym.Variable("state")
+    ngates = {"rnn_tanh": 1, "gru": 3, "lstm": 4}[mode]
+    psize = ngates * H * (I + H + 2)
+    inputs = {"data": _rand(T, B, I, seed=14),
+              "params": _rand(psize, seed=15, scale=0.2),
+              "state": np.zeros((1, B, H), "float32")}
+    if mode == "lstm":
+        cell = mx.sym.Variable("cell")
+        sym = mx.sym.RNN(data, params, state, cell, state_size=H,
+                         num_layers=1, mode=mode)
+        inputs["cell"] = np.zeros((1, B, H), "float32")
+    else:
+        sym = mx.sym.RNN(data, params, state, state_size=H, num_layers=1,
+                         mode=mode)
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="write",
+                         **{k: v.shape for k, v in inputs.items()})
+    for k, v in inputs.items():
+        ex.arg_dict[k][:] = v
+    out = ex.forward(is_train=True)[0]
+    assert out.shape == (T, B, H)
+    assert np.isfinite(out.asnumpy()).all()
+    ex.backward()
+    g = ex.grad_dict["params"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+# ------------------------------------------------------------ CTC loss shape
+def test_ctc_loss_positive_and_differentiable():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    sym = mx.sym.CTCLoss(data, label)
+    T, B, C = 6, 2, 5
+    x = _rand(T, B, C, seed=16, scale=2.0)
+    y = np.array([[1, 2, 0, 0], [3, 1, 2, 0]], "float32")
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="write", data=x.shape,
+                         label=y.shape)
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["label"][:] = y
+    out = ex.forward(is_train=True)[0].asnumpy()
+    assert out.shape == (B,) and (out > 0).all()
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+# ----------------------------------------------------- dot with sparse lhs
+def test_sparse_dot_csr_dense():
+    lhs = mx.nd.sparse.csr_matrix(
+        (np.array([1.0, 2.0, 3.0], "float32"), np.array([0, 2, 1]),
+         np.array([0, 2, 3])), shape=(2, 3))
+    rhs = mx.nd.array(_rand(3, 4, seed=17))
+    out = mx.nd.sparse.dot(lhs, rhs).asnumpy()
+    np.testing.assert_allclose(out, lhs.asnumpy() @ rhs.asnumpy(),
+                               rtol=1e-5)
