@@ -20,6 +20,9 @@ __all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
            "BERTEncoder", "BERTModel", "BERTClassifier", "get_bert_model"]
 
 
+from ..symbol.symbol import Symbol as _Symbol
+
+
 class MultiHeadAttention(HybridBlock):
     """Self-attention: fused QKV projection, (B,H,T,D) batch_dot scores."""
 
@@ -181,6 +184,7 @@ class BERTModel(HybridBlock):
                                            prefix="word_embed_")
             self.token_type_embed = nn.Embedding(token_type_vocab_size, units,
                                                  prefix="type_embed_")
+            self._max_length = max_length
             self.position_embed = nn.Embedding(max_length, units,
                                                prefix="pos_embed_")
             self.embed_norm = nn.LayerNorm()
@@ -204,10 +208,13 @@ class BERTModel(HybridBlock):
 
     def hybrid_forward(self, F, inputs, token_types=None, valid_mask=None,
                        masked_positions=None, decoder_bias=None):
-        seq_len = inputs.shape[1]
-        positions = F.arange(seq_len).astype("int32")
+        # position embeddings over max_length, sliced to the input's length
+        # with slice_like — shape-polymorphic, so the model traces in BOTH
+        # frontends (symbol export has no concrete input shape)
+        positions = F.arange(self._max_length).astype("int32")
         x = self.word_embed(inputs)
-        x = x + F.expand_dims(self.position_embed(positions), axis=0)
+        pos_emb = F.expand_dims(self.position_embed(positions), axis=0)
+        x = x + F.slice_like(pos_emb, x, axes=(1,))
         if token_types is not None:
             x = x + self.token_type_embed(token_types)
         x = self.embed_dropout(self.embed_norm(x))
@@ -219,27 +226,16 @@ class BERTModel(HybridBlock):
             outputs.append(pooled)
         if self.use_decoder and masked_positions is not None:
             # gather masked positions: (B, M, C)
-            picked = _batched_gather(F, seq_out, masked_positions)
+            picked = F._batched_gather(seq_out, masked_positions)
             h = self.decoder_norm(self.decoder_act(
                 self.decoder_transform(picked)))
-            w = self.word_embed.weight.data(h.context)
+            w = self.word_embed.weight.var() if isinstance(h, _Symbol) \
+                else self.word_embed.weight.data(h.context)
             scores = F.dot(h, w, transpose_b=True) + decoder_bias
             outputs.append(scores)
         if self.use_classifier and self.use_pooler:
             outputs.append(self.nsp_classifier(outputs[1]))
         return tuple(outputs) if len(outputs) > 1 else outputs[0]
-
-
-def _batched_gather(F, seq, positions):
-    """(B, T, C) gathered at (B, M) → (B, M, C)."""
-    import jax.numpy as jnp
-    from ..ndarray import NDArray, invoke_fn
-    if isinstance(seq, NDArray):
-        return invoke_fn(
-            lambda s, p: jnp.take_along_axis(
-                s, p.astype(jnp.int32)[:, :, None], axis=1),
-            [seq, positions])
-    raise TypeError("batched gather requires NDArray inputs")
 
 
 class BERTClassifier(HybridBlock):

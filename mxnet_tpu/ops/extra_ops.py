@@ -562,3 +562,12 @@ def quantized_concat(*args, num_args=1, dim=1):
     deq = [_dequant(t, ranges[2 * i], ranges[2 * i + 1])
            for i, t in enumerate(tensors)]
     return _requant_out(jnp.concatenate(deq, axis=parse_int(dim, 1)))
+
+
+@register("_batched_gather")
+def _batched_gather_op(seq, positions):
+    """(B, T, C) gathered at (B, M) → (B, M, C) — the BERT masked-position
+    select (one XLA gather; internal helper op so the model traces in both
+    the imperative and symbolic frontends)."""
+    return jnp.take_along_axis(seq, positions.astype(jnp.int32)[:, :, None],
+                               axis=1)

@@ -96,3 +96,18 @@ def hypot(left, right):
         return _hypot_scalar(right, scalar=float(left))
     import math
     return math.hypot(left, right)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, infer_range=False,
+           name=None, dtype="float32"):
+    """Range symbol (reference ``symbol.py arange`` over ``_arange``)."""
+    return _arange(start=float(start),
+                   stop=float(stop) if stop is not None else None,
+                   step=float(step), repeat=int(repeat),
+                   infer_range=infer_range, dtype=dtype, name=name)
+
+
+def linspace(start, stop, num, endpoint=True, name=None, dtype="float32"):
+    """Evenly spaced values (reference ``symbol.py linspace``)."""
+    return _linspace(start=float(start), stop=float(stop), num=int(num),
+                     endpoint=endpoint, dtype=dtype, name=name)

@@ -287,6 +287,15 @@ class Symbol:
                 if node.name in shapes:
                     out_specs[(id(node), 0)] = var_spec(node.name,
                                                         shapes[node.name])
+                elif node.attr_dict.get("__shape__"):
+                    # a Variable declared with a fully-known shape (gluon
+                    # param vars carry theirs through export); partial
+                    # shapes (None/0 dims) stay with consumer inference
+                    import ast
+                    shp = ast.literal_eval(node.attr_dict["__shape__"])
+                    if shp and all(isinstance(x, int) and x > 0
+                                   for x in shp):
+                        out_specs[(id(node), 0)] = var_spec(node.name, shp)
                 # else: leave unknown — may be inferable at a consumer
                 continue
             pending.append(node)
@@ -433,9 +442,12 @@ class Symbol:
         for i, node in enumerate(order):
             if node.op is None:
                 arg_nodes.append(i)
+                # dunder attrs (__shape__/__dtype__/__init__) are part of
+                # the reference JSON contract; only the internal aux marker
+                # stays out (aux-ness is recomputed from the op schema)
                 nodes.append({"op": "null", "name": node.name,
                               "attrs": {k: str(v) for k, v in node.attr_dict.items()
-                                        if not k.startswith("__")},
+                                        if k != "__aux__"},
                               "inputs": []})
             else:
                 spec = {

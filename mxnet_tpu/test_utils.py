@@ -311,3 +311,15 @@ def download(url, fname=None, dirname=None, overwrite=False, retries=5):
     raise RuntimeError(
         f"download({url!r}): no network egress in this environment; "
         "use a file:// URL or a pre-staged local path")
+
+
+def fd_rand(*shape, seed=0, scale=1.0, shift=0.0):
+    """Deterministic uniform tensor for the FD contract tranches."""
+    return (np.random.RandomState(seed).uniform(-1, 1, shape) * scale
+            + shift).astype("float32")
+
+
+def fd_grad_check(sym, location, aux=None, rtol=5e-2, atol=1e-2, **kw):
+    """check_numeric_gradient with the contract tranches' tolerances."""
+    check_numeric_gradient(sym, location, aux_states=aux, rtol=rtol,
+                           atol=atol, **kw)

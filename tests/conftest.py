@@ -39,14 +39,3 @@ def _seed():
     _pyrandom.seed(seed)
     yield
 
-
-# shared helpers for the finite-difference contract tranches
-def fd_rand(*shape, seed=0, scale=1.0, shift=0.0):
-    return (np.random.RandomState(seed).uniform(-1, 1, shape) * scale
-            + shift).astype("float32")
-
-
-def fd_grad_check(sym, location, aux=None, rtol=5e-2, atol=1e-2, **kw):
-    from mxnet_tpu.test_utils import check_numeric_gradient
-    check_numeric_gradient(sym, location, aux_states=aux, rtol=rtol,
-                           atol=atol, **kw)

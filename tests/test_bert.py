@@ -177,3 +177,32 @@ def test_bert_sequence_parallel_matches_dp():
                         sp_impl="ulysses", data_spec=P("dp", "sp"))
     l3 = [float(ul_tr.step(x, y).asnumpy()) for _ in range(3)]
     np.testing.assert_allclose(l3, l1, rtol=2e-4, atol=2e-5)
+
+
+def test_bert_symbol_export_roundtrip(tmp_path):
+    """BERT is shape-polymorphic enough to trace symbolically: hybridize →
+    export (dual-file checkpoint) → load → bind → identical outputs
+    (the deployment path reference users take through gluon export)."""
+    mx.random.seed(0)
+    net = get_bert_model("bert_tiny", vocab_size=50, max_length=32,
+                         dropout=0.0)
+    net.initialize()
+    tokens, segments, mask, positions = _inputs(vocab=50)
+    net.hybridize()
+    ref = [o.asnumpy() for o in net(tokens, segments, mask, positions)]
+    prefix = str(tmp_path / "bt")
+    net.export(prefix)
+    sym = mx.sym.load(prefix + "-symbol.json")
+    loaded = mx.nd.load(prefix + "-0000.params")
+    args = {k.split(":", 1)[1]: v for k, v in loaded.items()
+            if k.startswith("arg:")}
+    auxs = {k.split(":", 1)[1]: v for k, v in loaded.items()
+            if k.startswith("aux:")}
+    ins = [a for a in sym.list_arguments() if a not in args]
+    feeds = dict(zip(ins, [tokens, segments, mask, positions]))
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null",
+                         **{k: v.shape for k, v in feeds.items()})
+    ex.copy_params_from(args, auxs, allow_extra_params=True)
+    outs = [o.asnumpy() for o in ex.forward(is_train=False, **feeds)]
+    for a, b in zip(ref, outs):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)

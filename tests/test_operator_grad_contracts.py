@@ -12,7 +12,8 @@ import mxnet_tpu as mx
 from mxnet_tpu.test_utils import assert_almost_equal  # noqa: F401
 
 
-from conftest import fd_grad_check as _grad_check, fd_rand as _rand  # noqa: E402
+from mxnet_tpu.test_utils import (fd_grad_check as _grad_check,  # noqa: E402
+                                  fd_rand as _rand)
 
 
 # ------------------------------------------------------------- Convolution
