@@ -64,12 +64,15 @@ class _Ctx:
     def inp(self, name):
         return self.input_map.get(name, name)
 
-    def add(self, op_type, name, inputs, attrs=None, outputs=None):
-        self.nodes.append({
-            "op_type": op_type, "name": name, "inputs": list(inputs),
-            "outputs": list(outputs) if outputs else [name],
-            "attrs": dict(attrs or {})})
-        return self.nodes[-1]["outputs"][0]
+    def add(self, op_type, name, inputs, attrs=None, outputs=None,
+            domain=None):
+        node = {"op_type": op_type, "name": name, "inputs": list(inputs),
+                "outputs": list(outputs) if outputs else [name],
+                "attrs": dict(attrs or {})}
+        if domain:
+            node["domain"] = domain
+        self.nodes.append(node)
+        return node["outputs"][0]
 
 
 # --------------------------------------------------------------- converters
@@ -154,6 +157,15 @@ def _pooling(ctx, name, ins, attrs):
 
 @register("FullyConnected")
 def _fc(ctx, name, ins, attrs):
+    if _parse(attrs.get("flatten"), True) in (False, 0, "False"):
+        # flatten=False: y = x @ W.T (+ b) over the last axis, batched —
+        # Gemm is 2-D-only, so emit Transpose(W) + MatMul (+ Add)
+        wt = ctx.add("Transpose", name + "_wT", [ins[1]], {"perm": (1, 0)})
+        no_bias = _parse(attrs.get("no_bias"), False) in (True, 1, "True")
+        if no_bias:
+            return ctx.add("MatMul", name, [ins[0], wt])
+        mm = ctx.add("MatMul", name + "_mm", [ins[0], wt])
+        return ctx.add("Add", name, [mm, ins[2]])
     flat = ctx.add("Flatten", name + "_flatten", ins[:1], {"axis": 1})
     no_bias = _parse(attrs.get("no_bias"), False) in (True, 1, "True")
     if no_bias:
@@ -200,12 +212,7 @@ def _dropout(ctx, name, ins, attrs):
                    {"ratio": float(_parse(attrs.get("p"), 0.5))})
 
 
-@register("Reshape")
-def _reshape(ctx, name, ins, attrs):
-    shape = _tuple2(attrs.get("shape"), ())
-    sname = name + "_shape"
-    ctx.extra_initializers[sname] = _np.asarray(shape, dtype=_np.int64)
-    return ctx.add("Reshape", name, [ins[0], sname])
+
 
 
 @register("transpose")
@@ -249,9 +256,21 @@ for _mx, _ox in [("elemwise_add", "Add"), ("broadcast_add", "Add"),
                  ("_plus", "Add"),
                  ("elemwise_sub", "Sub"), ("broadcast_sub", "Sub"),
                  ("elemwise_mul", "Mul"), ("broadcast_mul", "Mul"),
-                 ("elemwise_div", "Div"), ("broadcast_div", "Div"),
-                 ("dot", "MatMul")]:
+                 ("elemwise_div", "Div"), ("broadcast_div", "Div")]:
     register(_mx)(_binop(_ox))
+
+
+@register("dot")
+def _dot(ctx, name, ins, attrs):
+    # NOTE: assumes matrix (2-D) semantics — mx N-D dot is tensordot(axes=1)
+    # with full-reverse transposes, which MatMul does not express; an N-D
+    # transpose import fails loudly on the 2-D perm rather than silently
+    a, b = ins
+    if _parse(attrs.get("transpose_a"), False) in (True, 1, "True"):
+        a = ctx.add("Transpose", name + "_ta", [a], {"perm": (1, 0)})
+    if _parse(attrs.get("transpose_b"), False) in (True, 1, "True"):
+        b = ctx.add("Transpose", name + "_tb", [b], {"perm": (1, 0)})
+    return ctx.add("MatMul", name, [a, b])
 
 
 def _scalar_op(onnx_op):
@@ -266,6 +285,19 @@ def _scalar_op(onnx_op):
 for _mx, _ox in [("_plus_scalar", "Add"), ("_minus_scalar", "Sub"),
                  ("_mul_scalar", "Mul"), ("_div_scalar", "Div")]:
     register(_mx)(_scalar_op(_ox))
+
+
+def _rscalar_op(onnx_op):
+    def cv(ctx, name, ins, attrs):
+        sname = name + "_scalar"
+        ctx.extra_initializers[sname] = _np.asarray(
+            float(_parse(attrs.get("scalar"), 0.0)), dtype=_np.float32)
+        return ctx.add(onnx_op, name, [sname, ins[0]])
+    return cv
+
+
+for _mx, _ox in [("_rminus_scalar", "Sub"), ("_rdiv_scalar", "Div")]:
+    register(_mx)(_rscalar_op(_ox))
 
 
 for _mx, _ox in [("relu", "Relu"), ("sigmoid", "Sigmoid"), ("tanh", "Tanh"),
@@ -362,14 +394,19 @@ def graph_to_proto(graph):
     from onnx import helper, numpy_helper, TensorProto
 
     dt = {"float32": TensorProto.FLOAT, "float64": TensorProto.DOUBLE,
+          "float16": TensorProto.FLOAT16,
           "int32": TensorProto.INT32, "int64": TensorProto.INT64}
     onodes = []
     for n in graph["nodes"]:
         attrs = {}
         for k, v in n["attrs"].items():
             attrs[k] = list(v) if isinstance(v, tuple) else v
+        if n["op_type"] == "Cast":
+            # the dict carries dtype names; the proto wants the enum
+            attrs["to"] = dt[str(attrs.get("to", "float32"))]
         onodes.append(helper.make_node(n["op_type"], n["inputs"],
-                                       n["outputs"], name=n["name"], **attrs))
+                                       n["outputs"], name=n["name"],
+                                       domain=n.get("domain", ""), **attrs))
     inputs = [helper.make_tensor_value_info(i["name"], dt[i["dtype"]],
                                             list(i["shape"]))
               for i in graph["inputs"]]
@@ -379,7 +416,10 @@ def graph_to_proto(graph):
              for k, v in graph["initializers"].items()]
     g = helper.make_graph(onodes, "mxnet_tpu", inputs, outputs,
                           initializer=inits)
-    return helper.make_model(g)
+    # opset 11: the attr forms of Unsqueeze/Slice/Split emitted here are
+    # only legal pre-13/pre-10-input-form opsets
+    return helper.make_model(g, opset_imports=[
+        helper.make_opsetid("", 11), helper.make_opsetid("mxnet", 1)])
 
 
 def export_model(sym, params, input_shape, input_type="float32",
@@ -393,3 +433,114 @@ def export_model(sym, params, input_shape, input_type="float32",
     if verbose:
         print(f"exported {onnx_file_path}")
     return onnx_file_path
+
+
+# ------------------------------------------------- transformer-family ops
+@register("LayerNorm")
+def _layernorm(ctx, name, ins, attrs):
+    return ctx.add("LayerNormalization", name, ins, {
+        "axis": int(_parse(attrs.get("axis"), -1)),
+        "epsilon": float(_parse(attrs.get("eps"), 1e-5))})
+
+
+@register("erf")
+def _erf(ctx, name, ins, attrs):
+    return ctx.add("Erf", name, ins)
+
+
+@register("_copy")
+def _copy_cv(ctx, name, ins, attrs):
+    return ctx.add("Identity", name, ins)
+
+
+@register("cast")
+def _cast_cv(ctx, name, ins, attrs):
+    return ctx.add("Cast", name, ins,
+                   {"to": str(_parse(attrs.get("dtype"), "float32"))})
+
+
+@register("expand_dims")
+def _expand_dims(ctx, name, ins, attrs):
+    return ctx.add("Unsqueeze", name, ins,
+                   {"axes": (int(_parse(attrs.get("axis"), 0)),)})
+
+
+@register("reshape")
+def _reshape(ctx, name, ins, attrs):
+    # mx reshape 0/-1 specials share ONNX Reshape semantics (allowzero=0);
+    # the MXNet-only -2/-3/-4 specials are NOT ONNX — emit those under the
+    # mxnet domain so a foreign runtime fails loudly instead of silently
+    # misreshaping (the dict round-trip maps them back to mx reshape)
+    shape = tuple(int(x) for x in _parse(attrs.get("shape"), ()))
+    sname = name + "_shape"
+    ctx.extra_initializers[sname] = _np.asarray(shape, dtype=_np.int64)
+    domain = "mxnet" if any(x < -1 for x in shape) else None
+    return ctx.add("Reshape", name, [ins[0], sname], domain=domain)
+
+
+@register("slice_axis")
+def _slice_axis(ctx, name, ins, attrs):
+    ax = int(_parse(attrs.get("axis"), 0))
+    begin = int(_parse(attrs.get("begin"), 0))
+    end = _parse(attrs.get("end"), None)
+    return ctx.add("Slice", name, ins, {
+        "axes": (ax,), "starts": (begin,),
+        "ends": (int(end) if end is not None else 2**31 - 1,)})
+
+
+@register("slice_like")
+def _slice_like(ctx, name, ins, attrs):
+    # no ONNX builtin: emitted under the custom mxnet domain (the dict
+    # round-trip and graph_to_proto keep it; foreign runtimes would need
+    # the Shape→Gather→Slice expansion)
+    axes = _parse(attrs.get("axes"), None)
+    a = {"axes": tuple(int(x) for x in axes) if axes else ()}
+    return ctx.add("SliceLike", name, ins, a, domain="mxnet")
+
+
+@register("split")
+def _split(ctx, name, ins, attrs):
+    if _parse(attrs.get("squeeze_axis"), False) in (True, 1, "True"):
+        raise NotImplementedError(
+            "split(squeeze_axis=True) has no ONNX equivalent — the Split "
+            "outputs would keep the split axis and silently change rank")
+    n = int(_parse(attrs.get("num_outputs"), 1))
+    ax = int(_parse(attrs.get("axis"), 1))
+    outs = [name] + [f"{name}_out{j}" for j in range(1, n)]
+    ctx.add("Split", name, ins, {"axis": ax}, outputs=outs)
+    return outs[0]
+
+
+@register("_arange")
+def _arange_cv(ctx, name, ins, attrs):
+    # static attrs: constant-fold to an initializer + Identity
+    start = float(_parse(attrs.get("start"), 0.0))
+    stop = _parse(attrs.get("stop"), None)
+    step = float(_parse(attrs.get("step"), 1.0))
+    dt = str(_parse(attrs.get("dtype"), "float32"))
+    arr = _np.arange(start, float(stop) if stop is not None else None,
+                     step).astype(dt if dt != "bfloat16" else "float32")
+    rep = int(_parse(attrs.get("repeat"), 1))
+    if rep > 1:
+        arr = _np.repeat(arr, rep)
+    cname = name + "_const"
+    ctx.extra_initializers[cname] = arr
+    return ctx.add("Identity", name, [cname])
+
+
+@register("_batched_gather")
+def _batched_gather_cv(ctx, name, ins, attrs):
+    # (B,T,C) @ (B,M) → GatherND(batch_dims=1) over (B,M,1) int64 indices
+    c = ctx.add("Cast", name + "_idx64", [ins[1]], {"to": "int64"})
+    u = ctx.add("Unsqueeze", name + "_idx3", [c], {"axes": (2,)})
+    return ctx.add("GatherND", name, [ins[0], u], {"batch_dims": 1})
+
+
+@register("batch_dot")
+def _batch_dot(ctx, name, ins, attrs):
+    a, b = ins
+    if _parse(attrs.get("transpose_a"), False) in (True, 1, "True"):
+        a = ctx.add("Transpose", name + "_ta", [a], {"perm": (0, 2, 1)})
+    if _parse(attrs.get("transpose_b"), False) in (True, 1, "True"):
+        b = ctx.add("Transpose", name + "_tb", [b], {"perm": (0, 2, 1)})
+    return ctx.add("MatMul", name, [a, b])

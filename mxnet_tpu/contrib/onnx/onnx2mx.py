@@ -78,7 +78,7 @@ _SIMPLE = {
     "Identity": ("identity", {}),
     "Add": ("broadcast_add", {}), "Sub": ("broadcast_sub", {}),
     "Mul": ("broadcast_mul", {}), "Div": ("broadcast_div", {}),
-    "MatMul": ("dot", {}),
+    "MatMul": ("_onnx_matmul", {}),
 }
 for _ox, (_mx, _kw) in _SIMPLE.items():
     register(_ox)(lambda sym, ins, attrs, name, _mx=_mx, _kw=_kw:
@@ -166,10 +166,71 @@ def _gather(sym, ins, attrs, name):
     return ("__gather__", {})
 
 
+@register("LayerNormalization")
+def _layernorm(sym, ins, attrs, name):
+    return ("LayerNorm", {"axis": int(attrs.get("axis", -1)),
+                          "eps": float(attrs.get("epsilon", 1e-5))})
+
+
+@register("Erf")
+def _erf(sym, ins, attrs, name):
+    return ("erf", {})
+
+
+@register("Cast")
+def _cast(sym, ins, attrs, name):
+    return ("cast", {"dtype": str(attrs.get("to", "float32"))})
+
+
+@register("Unsqueeze")
+def _unsqueeze(sym, ins, attrs, name):
+    axes = tuple(attrs.get("axes", (0,)))
+    assert len(axes) == 1, \
+        f"multi-axes Unsqueeze {axes} does not map to one expand_dims"
+    return ("expand_dims", {"axis": int(axes[0])})
+
+
+@register("Squeeze")
+def _squeeze(sym, ins, attrs, name):
+    axes = attrs.get("axes", None)
+    return ("squeeze",
+            {"axis": tuple(int(x) for x in axes)} if axes else {})
+
+
+@register("Slice")
+def _slice(sym, ins, attrs, name):
+    axes = tuple(attrs.get("axes", ()))
+    starts = tuple(attrs.get("starts", ()))
+    ends = tuple(attrs.get("ends", ()))
+    assert len(axes) == 1, "only single-axis attr-form Slice imports"
+    end = int(ends[0])
+    return ("slice_axis", {"axis": int(axes[0]), "begin": int(starts[0]),
+                           "end": None if end >= 2**31 - 1 else end})
+
+
+@register("SliceLike")
+def _slice_like(sym, ins, attrs, name):
+    axes = tuple(attrs.get("axes", ()))
+    return ("slice_like", {"axes": axes} if axes else {})
+
+
+@register("Split")
+def _split(sym, ins, attrs, name):
+    return ("split", {"axis": int(attrs.get("axis", 0)),
+                      "num_outputs": None})   # patched from node arity
+
+
+@register("GatherND")
+def _gather_nd(sym, ins, attrs, name):
+    assert int(attrs.get("batch_dims", 0)) == 1, \
+        "only batch_dims=1 GatherND imports (the _batched_gather pattern)"
+    return ("__batched_gather__", {})
+
+
 @register("Transpose")
 def _transpose(sym, ins, attrs, name):
     perm = attrs.get("perm")
-    return ("transpose", {"axes": tuple(perm)} if perm else ("transpose", {}))
+    return ("transpose", {"axes": tuple(perm)} if perm else {})
 
 
 @register("Reshape")
@@ -191,9 +252,11 @@ def _import_graph_impl(graph):
     inits = {k: _np.asarray(v) for k, v in graph["initializers"].items()}
     tensors = {}
     for i in graph["inputs"]:
-        tensors[i["name"]] = sym_mod.var(i["name"])
+        tensors[i["name"]] = sym_mod.var(i["name"], shape=i.get("shape"))
     for k in inits:
-        tensors.setdefault(k, sym_mod.var(k))
+        # initializer shapes are known — declare them so the bound graph
+        # infers every parameter without caller-provided shapes
+        tensors.setdefault(k, sym_mod.var(k, shape=inits[k].shape))
 
     aux_renames = {}   # imported aux-state name -> source tensor name
     for n in graph["nodes"]:
@@ -204,7 +267,12 @@ def _import_graph_impl(graph):
                 f"(node {n['name']})")
         mx_op, kw = conv(None, n["inputs"], n["attrs"], n["name"])
         ins = [tensors[x] for x in n["inputs"]]
-        if mx_op == "__gather__":
+        if mx_op == "__batched_gather__":
+            # GatherND carried (B,M,1) indices; the op wants (B,M)
+            idx = sym_mod.squeeze(ins[1], axis=2)
+            out = getattr(sym_mod, "_batched_gather")(ins[0], idx,
+                                                      name=n["name"])
+        elif mx_op == "__gather__":
             out = getattr(sym_mod, "Embedding")(
                 ins[1], ins[0],
                 input_dim=int(inits[n["inputs"][0]].shape[0]),
@@ -222,6 +290,8 @@ def _import_graph_impl(graph):
                 kw["num_hidden"] = int(inits[n["inputs"][1]].shape[0])
                 if kw.get("no_bias"):
                     ins = ins[:2]
+            if mx_op == "split":
+                kw["num_outputs"] = len(n["outputs"])
             if mx_op == "BatchNorm":
                 # moving stats must become auxiliary states, not arguments:
                 # pass only (data, gamma, beta) and let the symbol create
@@ -259,6 +329,8 @@ def proto_to_graph(model):
 
     if isinstance(model, (str, bytes)):
         model = onnx.load(model)
+    enum2name = {1: "float32", 10: "float16", 11: "float64",
+                 6: "int32", 7: "int64"}
     g = model.graph
     inits = {t.name: numpy_helper.to_array(t) for t in g.initializer}
     nodes = []
@@ -266,6 +338,8 @@ def proto_to_graph(model):
         attrs = {}
         for a in n.attribute:
             attrs[a.name] = onnx.helper.get_attribute_value(a)
+        if n.op_type == "Cast" and isinstance(attrs.get("to"), int):
+            attrs["to"] = enum2name.get(attrs["to"], "float32")
         nodes.append({"op_type": n.op_type, "name": n.name or n.output[0],
                       "inputs": list(n.input), "outputs": list(n.output),
                       "attrs": attrs})

@@ -571,3 +571,11 @@ def _batched_gather_op(seq, positions):
     the imperative and symbolic frontends)."""
     return jnp.take_along_axis(seq, positions.astype(jnp.int32)[:, :, None],
                                axis=1)
+
+
+@register("_onnx_matmul")
+def _onnx_matmul(a, b):
+    """numpy-matmul semantics (rank-polymorphic, batched) — the exact
+    contract of ONNX MatMul; the onnx importer maps MatMul here since mx
+    ``dot``/``batch_dot`` split that contract by rank."""
+    return jnp.matmul(a, b)

@@ -293,8 +293,9 @@ class Symbol:
                     # shapes (None/0 dims) stay with consumer inference
                     import ast
                     shp = ast.literal_eval(node.attr_dict["__shape__"])
-                    if shp and all(isinstance(x, int) and x > 0
-                                   for x in shp):
+                    if shp is not None and all(isinstance(x, int) and x > 0
+                                               for x in shp):
+                        # () is a valid scalar declaration
                         out_specs[(id(node), 0)] = var_spec(node.name, shp)
                 # else: leave unknown — may be inferable at a consumer
                 continue

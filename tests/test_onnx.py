@@ -211,3 +211,45 @@ def _load_checkpoint_params(prefix):
     for k, v in loaded.items():
         (args if k.startswith("arg:") else auxs)[k.split(":", 1)[1]] = v
     return args, auxs
+
+
+def test_bert_tiny_onnx_roundtrip(tmp_path):
+    """Transformer coverage: BERT-tiny exports symbolically, converts to
+    the ONNX dict (LayerNormalization/MatMul/Erf/GatherND/Split/...), and
+    imports back with identical outputs on all four heads."""
+    from mxnet_tpu.models import get_bert_model
+    mx.random.seed(0)
+    net = get_bert_model("bert_tiny", vocab_size=50, max_length=32,
+                         dropout=0.0)
+    net.initialize()
+    rng = np.random.RandomState(0)
+    tok = mx.nd.array(rng.randint(0, 50, (2, 8)), dtype="int32")
+    seg = mx.nd.array(rng.randint(0, 2, (2, 8)), dtype="int32")
+    msk = mx.nd.ones((2, 8))
+    pos = mx.nd.array(rng.randint(0, 8, (2, 3)), dtype="int32")
+    net.hybridize()
+    ref = [o.asnumpy() for o in net(tok, seg, msk, pos)]
+    prefix = str(tmp_path / "bt")
+    net.export(prefix)
+    sym = mx.sym.load(prefix + "-symbol.json")
+    args, auxs = _load_checkpoint_params(prefix)
+    params = dict(args)
+    params.update(auxs)
+    ins = [a for a in sym.list_arguments() if a not in params]
+    feeds = dict(zip(ins, [tok, seg, msk, pos]))
+    graph = mxonnx.export_graph(sym, params,
+                                {k: v.shape for k, v in feeds.items()})
+    ops = {n["op_type"] for n in graph["nodes"]}
+    assert {"LayerNormalization", "MatMul", "Erf",
+            "GatherND", "Split"} <= ops
+    sym2, args2, auxs2 = mxonnx.import_graph(graph)
+
+    def run(s, a, x):
+        ex = s.simple_bind(ctx=mx.cpu(), grad_req="null",
+                           **{k: v.shape for k, v in feeds.items()})
+        ex.copy_params_from(a, x, allow_extra_params=True)
+        return [o.asnumpy() for o in ex.forward(is_train=False, **feeds)]
+    o1 = run(sym, args, auxs)
+    o2 = run(sym2, args2, auxs2)
+    for a, b in zip(o1, o2):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
