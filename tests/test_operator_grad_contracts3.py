@@ -1,0 +1,78 @@
+"""Third contract tranche: spatial/detection legacy ops (reference
+``tests/python/unittest/test_operator.py`` ROIPooling/BilinearSampler/
+SpatialTransformer/GridGenerator families).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (fd_grad_check as _grad_check,  # noqa: E402
+                                  fd_rand as _rand)
+
+
+def test_roi_pooling_forward_semantics():
+    data = mx.sym.Variable("data")
+    rois = mx.sym.Variable("rois")
+    sym = mx.sym.ROIPooling(data, rois, pooled_size=(2, 2),
+                            spatial_scale=1.0)
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    r = np.array([[0, 0, 0, 3, 3]], "float32")   # whole image
+    out = sym.eval(data=mx.nd.array(x),
+                   rois=mx.nd.array(r))[0].asnumpy()
+    # 2x2 max pool over the 4x4 region
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_bilinear_sampler_identity_grid():
+    data = mx.sym.Variable("data")
+    grid = mx.sym.Variable("grid")
+    sym = mx.sym.BilinearSampler(data, grid)
+    x = _rand(1, 1, 4, 4, seed=1)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    g = np.stack([xs, ys])[None].astype("float32")   # identity sampling
+    out = sym.eval(data=mx.nd.array(x), grid=mx.nd.array(g))[0].asnumpy()
+    np.testing.assert_allclose(out, x, atol=1e-5)
+
+
+def test_bilinear_sampler_grad():
+    data = mx.sym.Variable("data")
+    grid = mx.sym.Variable("grid")
+    sym = mx.sym.BilinearSampler(data, grid)
+    ys, xs = np.meshgrid(np.linspace(-0.8, 0.8, 3),
+                         np.linspace(-0.8, 0.8, 3), indexing="ij")
+    g = np.stack([xs, ys])[None].astype("float32")
+    _grad_check(sym, {"data": _rand(1, 1, 4, 4, seed=2), "grid": g},
+                grad_nodes=["data"])
+
+
+def test_spatial_transformer_identity():
+    data = mx.sym.Variable("data")
+    loc = mx.sym.Variable("loc")
+    sym = mx.sym.SpatialTransformer(data, loc, target_shape=(4, 4),
+                                    transform_type="affine",
+                                    sampler_type="bilinear")
+    x = _rand(1, 1, 4, 4, seed=3)
+    theta = np.array([[1, 0, 0, 0, 1, 0]], "float32")   # identity affine
+    out = sym.eval(data=mx.nd.array(x), loc=mx.nd.array(theta))[0].asnumpy()
+    np.testing.assert_allclose(out, x, atol=1e-4)
+
+
+def test_grid_generator_affine():
+    loc = mx.sym.Variable("loc")
+    sym = mx.sym.GridGenerator(loc, transform_type="affine",
+                               target_shape=(3, 3))
+    theta = np.array([[1, 0, 0, 0, 1, 0]], "float32")
+    out = sym.eval(loc=mx.nd.array(theta))[0].asnumpy()
+    assert out.shape == (1, 2, 3, 3)
+    np.testing.assert_allclose(out[0, 0, 0], [-1, 0, 1], atol=1e-5)
+    np.testing.assert_allclose(out[0, 1, :, 0], [-1, 0, 1], atol=1e-5)
+
+
+def test_multibox_prior_layout():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.contrib.MultiBoxPrior(data, sizes=(0.5,), ratios=(1.0,))
+    out = sym.eval(data=mx.nd.zeros((1, 3, 2, 2)))[0].asnumpy()
+    assert out.shape == (1, 4, 4)
+    # center of the first cell is (0.25, 0.25) with extent 0.5
+    np.testing.assert_allclose(out[0, 0], [0.0, 0.0, 0.5, 0.5], atol=1e-5)
