@@ -227,6 +227,43 @@ def _gather_nd(sym, ins, attrs, name):
     return ("__batched_gather__", {})
 
 
+@register("Pow")
+def _pow(sym, ins, attrs, name):
+    return ("broadcast_power", {})
+
+
+@register("ReduceSum")
+def _rsum(sym, ins, attrs, name):
+    return ("sum", {"axis": tuple(attrs.get("axes", ())) or None,
+                    "keepdims": bool(attrs.get("keepdims", 1))})
+
+
+@register("ReduceMax")
+def _rmax(sym, ins, attrs, name):
+    return ("max", {"axis": tuple(attrs.get("axes", ())) or None,
+                    "keepdims": bool(attrs.get("keepdims", 1))})
+
+
+@register("ReduceMin")
+def _rmin(sym, ins, attrs, name):
+    return ("min", {"axis": tuple(attrs.get("axes", ())) or None,
+                    "keepdims": bool(attrs.get("keepdims", 1))})
+
+
+@register("Pad")
+def _pad(sym, ins, attrs, name):
+    # attr-form (opset<11): pads = [b0..bN, e0..eN] → mx pad_width pairs
+    pads = tuple(int(p) for p in attrs.get("pads", ()))
+    half = len(pads) // 2
+    width = []
+    for b, e in zip(pads[:half], pads[half:]):
+        width += [b, e]
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect"}[str(attrs.get("mode", "constant"))]
+    return ("pad", {"mode": mode, "pad_width": tuple(width),
+                    "constant_value": float(attrs.get("value", 0.0))})
+
+
 @register("Transpose")
 def _transpose(sym, ins, attrs, name):
     perm = attrs.get("perm")

@@ -253,3 +253,29 @@ def test_bert_tiny_onnx_roundtrip(tmp_path):
     o2 = run(sym2, args2, auxs2)
     for a, b in zip(o1, o2):
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+def test_foreign_graph_import():
+    """Import a hand-written ONNX dict (as a foreign exporter would emit):
+    Pow/ReduceSum/Pad have no mx-export source here, only importers."""
+    graph = {
+        "nodes": [
+            {"op_type": "Pad", "name": "p", "inputs": ["data"],
+             "outputs": ["p"], "attrs": {"pads": (0, 1, 0, 1),
+                                         "mode": "constant", "value": 2.0}},
+            {"op_type": "Pow", "name": "q", "inputs": ["p", "e"],
+             "outputs": ["q"], "attrs": {}},
+            {"op_type": "ReduceSum", "name": "r", "inputs": ["q"],
+             "outputs": ["r"], "attrs": {"axes": (1,), "keepdims": 0}},
+        ],
+        "inputs": [{"name": "data", "shape": (2, 3), "dtype": "float32"}],
+        "outputs": [{"name": "r"}],
+        "initializers": {"e": np.asarray(2.0, "float32")},
+    }
+    sym, args, _ = mxonnx.import_graph(graph)
+    x = np.abs(np.random.RandomState(0).randn(2, 3)).astype("float32")
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null", data=(2, 3))
+    ex.copy_params_from(args)
+    out = ex.forward(is_train=False, data=mx.nd.array(x))[0].asnumpy()
+    padded = np.pad(x, ((0, 0), (1, 1)), constant_values=2.0)
+    np.testing.assert_allclose(out, (padded ** 2).sum(axis=1), rtol=1e-5)
