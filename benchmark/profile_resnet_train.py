@@ -1,0 +1,65 @@
+"""Per-op device profile of the AMP ResNet-50 train step (bench headline).
+
+Traces a few compiled steps on the real chip and prints the XPlane per-op
+aggregate sorted by total device time — the tool for finding where the
+conv-training MFU goes (VERDICT r2 weak #1).
+"""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu import random as _rnd
+from mxnet_tpu.parallel import FunctionalOptimizer, make_mesh, make_train_step
+from __graft_entry__ import _resnet
+
+
+def main():
+    batch = 32
+    layout = os.environ.get("PROF_LAYOUT", "NCHW")
+    accel = [d for d in jax.devices() if d.platform != "cpu"]
+    ctx = mx.gpu(0) if accel else mx.cpu(0)
+    rng = np.random.RandomState(0)
+    if layout == "NHWC":
+        net = _resnet(classes=1000, ctx=ctx, layout="NHWC")
+        x = jax.device_put(rng.randn(batch, 224, 224, 3).astype("float32"))
+    else:
+        net = _resnet(classes=1000, ctx=ctx)
+        x = jax.device_put(rng.randn(batch, 3, 224, 224).astype("float32"))
+    y = jax.device_put(rng.randint(0, 1000, size=(batch,)).astype("float32"))
+
+    mesh = make_mesh(n_devices=1, dp=1)
+    step_jit, state = make_train_step(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+        FunctionalOptimizer("sgd", 1e-4, momentum=0.9), mesh,
+        donate=True, amp_bf16=True)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    x = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    y = jax.device_put(y, NamedSharding(mesh, P("dp")))
+    key = _rnd.next_key()
+    t = jnp.uint32(0)
+    compiled = step_jit.lower(state, x, y, key, t).compile()
+    for _ in range(3):
+        state, loss = compiled(state, x, y, key, t)
+    print("warm loss:", float(np.asarray(loss)))
+
+    base = tempfile.mkdtemp(prefix="rprof_")
+    profiler.set_config(filename=os.path.join(base, "profile.json"))
+    profiler.start()
+    for _ in range(10):
+        state, loss = compiled(state, x, y, key, t)
+    print("traced loss:", float(np.asarray(loss)))
+    profiler.stop()
+    print(profiler.dumps(sort_by="total"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

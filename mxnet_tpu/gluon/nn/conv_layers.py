@@ -66,13 +66,24 @@ class _Conv(HybridBlock):
             else:
                 self.act = None
 
+    def _channels_last(self):
+        return self._layout.find("C") == len(self._layout) - 1
+
     def _weight_shape_fwd(self, in_channels, kernel_size):
-        return (self._channels, in_channels // self._groups if in_channels
-                else 0) + tuple(kernel_size)
+        ic = in_channels // self._groups if in_channels else 0
+        if self._channels_last():
+            # weight follows the data layout (reference convolution-inl.h:
+            # NHWC weight is (num_filter, *kernel, C/g))
+            return (self._channels,) + tuple(kernel_size) + (ic,)
+        return (self._channels, ic) + tuple(kernel_size)
 
     def _weight_shape_trans(self, in_channels, kernel_size):
-        # Deconvolution weight: (in_channels, channels//groups, *kernel)
-        return (in_channels, self._channels // self._groups) + tuple(kernel_size)
+        # Deconvolution weight: (in_channels, channels//groups, *kernel);
+        # channel-last: (in_channels, *kernel, channels//groups)
+        oc = self._channels // self._groups
+        if self._channels_last():
+            return (in_channels,) + tuple(kernel_size) + (oc,)
+        return (in_channels, oc) + tuple(kernel_size)
 
     def _channel_axis(self):
         return self._layout.find("C")
@@ -80,11 +91,11 @@ class _Conv(HybridBlock):
     def infer_shape(self, x, *args):
         in_channels = x.shape[self._channel_axis()]
         if self._op_name == "Convolution":
-            self.weight.shape = (self._channels, in_channels // self._groups) + \
-                tuple(self._kwargs["kernel"])
+            self.weight.shape = self._weight_shape_fwd(
+                in_channels, self._kwargs["kernel"])
         else:
-            self.weight.shape = (in_channels, self._channels // self._groups) + \
-                tuple(self._kwargs["kernel"])
+            self.weight.shape = self._weight_shape_trans(
+                in_channels, self._kwargs["kernel"])
 
     def hybrid_forward(self, F, x, weight, bias=None):
         op = getattr(F, self._op_name)
@@ -249,6 +260,8 @@ class _Pooling(HybridBlock):
             "kernel": pool_size, "stride": strides, "pad": padding,
             "global_pool": global_pool, "pool_type": pool_type,
             "pooling_convention": "full" if ceil_mode else "valid"}
+        if layout is not None:
+            self._kwargs["layout"] = layout
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 
@@ -272,11 +285,11 @@ class MaxPool1D(_Pooling):
 
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, **kwargs):
-        assert layout == "NCW", "Only supports 'NCW' layout for now"
+        assert layout in ("NCW", "NWC"), "layout must be NCW or NWC"
         if isinstance(pool_size, int):
             pool_size = (pool_size,)
         assert len(pool_size) == 1, "pool_size must be a number or a list of 1 ints"
-        super().__init__(pool_size, strides, padding, ceil_mode, False, "max",
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "max", layout=layout,
                          **kwargs)
 
 
@@ -285,11 +298,11 @@ class MaxPool2D(_Pooling):
 
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  layout="NCHW", ceil_mode=False, **kwargs):
-        assert layout == "NCHW", "Only supports 'NCHW' layout for now"
+        assert layout in ("NCHW", "NHWC"), "layout must be NCHW or NHWC"
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 2
         assert len(pool_size) == 2, "pool_size must be a number or a list of 2 ints"
-        super().__init__(pool_size, strides, padding, ceil_mode, False, "max",
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "max", layout=layout,
                          **kwargs)
 
 
@@ -298,11 +311,11 @@ class MaxPool3D(_Pooling):
 
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  ceil_mode=False, layout="NCDHW", **kwargs):
-        assert layout == "NCDHW", "Only supports 'NCDHW' layout for now"
+        assert layout in ("NCDHW", "NDHWC"), "layout must be NCDHW or NDHWC"
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 3
         assert len(pool_size) == 3, "pool_size must be a number or a list of 3 ints"
-        super().__init__(pool_size, strides, padding, ceil_mode, False, "max",
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "max", layout=layout,
                          **kwargs)
 
 
@@ -311,11 +324,11 @@ class AvgPool1D(_Pooling):
 
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, count_include_pad=True, **kwargs):
-        assert layout == "NCW", "Only supports 'NCW' layout for now"
+        assert layout in ("NCW", "NWC"), "layout must be NCW or NWC"
         if isinstance(pool_size, int):
             pool_size = (pool_size,)
         assert len(pool_size) == 1, "pool_size must be a number or a list of 1 ints"
-        super().__init__(pool_size, strides, padding, ceil_mode, False, "avg",
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "avg", layout=layout,
                          count_include_pad=count_include_pad, **kwargs)
 
 
@@ -325,11 +338,11 @@ class AvgPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  ceil_mode=False, layout="NCHW", count_include_pad=True,
                  **kwargs):
-        assert layout == "NCHW", "Only supports 'NCHW' layout for now"
+        assert layout in ("NCHW", "NHWC"), "layout must be NCHW or NHWC"
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 2
         assert len(pool_size) == 2, "pool_size must be a number or a list of 2 ints"
-        super().__init__(pool_size, strides, padding, ceil_mode, False, "avg",
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "avg", layout=layout,
                          count_include_pad=count_include_pad, **kwargs)
 
 
@@ -339,11 +352,11 @@ class AvgPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  ceil_mode=False, layout="NCDHW", count_include_pad=True,
                  **kwargs):
-        assert layout == "NCDHW", "Only supports 'NCDHW' layout for now"
+        assert layout in ("NCDHW", "NDHWC"), "layout must be NCDHW or NDHWC"
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 3
         assert len(pool_size) == 3, "pool_size must be a number or a list of 3 ints"
-        super().__init__(pool_size, strides, padding, ceil_mode, False, "avg",
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "avg", layout=layout,
                          count_include_pad=count_include_pad, **kwargs)
 
 
@@ -351,48 +364,48 @@ class GlobalMaxPool1D(_Pooling):
     """Global 1-D max pooling (reference ``conv_layers.py:1028``)."""
 
     def __init__(self, layout="NCW", **kwargs):
-        assert layout == "NCW", "Only supports 'NCW' layout for now"
-        super().__init__((1,), None, 0, True, True, "max", **kwargs)
+        assert layout in ("NCW", "NWC"), "layout must be NCW or NWC"
+        super().__init__((1,), None, 0, True, True, "max", layout=layout, **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
     """Global 2-D max pooling (reference ``conv_layers.py:1051``)."""
 
     def __init__(self, layout="NCHW", **kwargs):
-        assert layout == "NCHW", "Only supports 'NCHW' layout for now"
-        super().__init__((1, 1), None, 0, True, True, "max", **kwargs)
+        assert layout in ("NCHW", "NHWC"), "layout must be NCHW or NHWC"
+        super().__init__((1, 1), None, 0, True, True, "max", layout=layout, **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
     """Global 3-D max pooling (reference ``conv_layers.py:1075``)."""
 
     def __init__(self, layout="NCDHW", **kwargs):
-        assert layout == "NCDHW", "Only supports 'NCDHW' layout for now"
-        super().__init__((1, 1, 1), None, 0, True, True, "max", **kwargs)
+        assert layout in ("NCDHW", "NDHWC"), "layout must be NCDHW or NDHWC"
+        super().__init__((1, 1, 1), None, 0, True, True, "max", layout=layout, **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
     """Global 1-D average pooling (reference ``conv_layers.py:1100``)."""
 
     def __init__(self, layout="NCW", **kwargs):
-        assert layout == "NCW", "Only supports 'NCW' layout for now"
-        super().__init__((1,), None, 0, True, True, "avg", **kwargs)
+        assert layout in ("NCW", "NWC"), "layout must be NCW or NWC"
+        super().__init__((1,), None, 0, True, True, "avg", layout=layout, **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
     """Global 2-D average pooling (reference ``conv_layers.py:1120``)."""
 
     def __init__(self, layout="NCHW", **kwargs):
-        assert layout == "NCHW", "Only supports 'NCHW' layout for now"
-        super().__init__((1, 1), None, 0, True, True, "avg", **kwargs)
+        assert layout in ("NCHW", "NHWC"), "layout must be NCHW or NHWC"
+        super().__init__((1, 1), None, 0, True, True, "avg", layout=layout, **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
     """Global 3-D average pooling (reference ``conv_layers.py:1140``)."""
 
     def __init__(self, layout="NCDHW", **kwargs):
-        assert layout == "NCDHW", "Only supports 'NCDHW' layout for now"
-        super().__init__((1, 1, 1), None, 0, True, True, "avg", **kwargs)
+        assert layout in ("NCDHW", "NDHWC"), "layout must be NCDHW or NDHWC"
+        super().__init__((1, 1, 1), None, 0, True, True, "avg", layout=layout, **kwargs)
 
 
 class ReflectionPad2D(HybridBlock):

@@ -1,0 +1,173 @@
+"""Channel-last (NHWC/NWC) layout contracts.
+
+The reference supports a ``layout`` parameter on Convolution / Pooling
+(``src/operator/nn/convolution.cc`` param layout, NHWC weight layout
+(num_filter, *kernel, C/g)) and ``axis`` on BatchNorm.  On TPU channel-last
+is the MXU/VPU-native choice, so these are first-class here: every op must
+produce exactly the channel-first result under a transpose.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+RTOL, ATOL = 2e-5, 2e-5
+
+
+def _rng():
+    return np.random.RandomState(7)
+
+
+def test_conv_nhwc_matches_nchw():
+    rng = _rng()
+    x = rng.randn(2, 5, 9, 9).astype("float32")
+    w = rng.randn(7, 5, 3, 3).astype("float32")
+    b = rng.randn(7).astype("float32")
+    y1 = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+                           kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                           num_filter=7).asnumpy()
+    y2 = mx.nd.Convolution(mx.nd.array(x.transpose(0, 2, 3, 1)),
+                           mx.nd.array(w.transpose(0, 2, 3, 1)),
+                           mx.nd.array(b), kernel=(3, 3), stride=(2, 2),
+                           pad=(1, 1), num_filter=7,
+                           layout="NHWC").asnumpy()
+    np.testing.assert_allclose(y1, y2.transpose(0, 3, 1, 2), RTOL, ATOL)
+
+
+def test_conv_nhwc_grouped():
+    rng = _rng()
+    x = rng.randn(2, 10, 8, 8).astype("float32")
+    w = rng.randn(6, 5, 3, 3).astype("float32")
+    y1 = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                           num_filter=6, num_group=2, no_bias=True).asnumpy()
+    y2 = mx.nd.Convolution(mx.nd.array(x.transpose(0, 2, 3, 1)),
+                           mx.nd.array(w.transpose(0, 2, 3, 1)),
+                           kernel=(3, 3), num_filter=6, num_group=2,
+                           no_bias=True, layout="NHWC").asnumpy()
+    np.testing.assert_allclose(y1, y2.transpose(0, 3, 1, 2), RTOL, ATOL)
+
+
+def test_conv_nwc_1d():
+    rng = _rng()
+    x = rng.randn(2, 5, 11).astype("float32")
+    w = rng.randn(4, 5, 3).astype("float32")
+    y1 = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), kernel=(3,),
+                           num_filter=4, no_bias=True, pad=(1,)).asnumpy()
+    y2 = mx.nd.Convolution(mx.nd.array(x.transpose(0, 2, 1)),
+                           mx.nd.array(w.transpose(0, 2, 1)), kernel=(3,),
+                           num_filter=4, no_bias=True, pad=(1,),
+                           layout="NWC").asnumpy()
+    np.testing.assert_allclose(y1, y2.transpose(0, 2, 1), RTOL, ATOL)
+
+
+@pytest.mark.parametrize("pool_type,conv", [("max", "valid"),
+                                            ("avg", "valid"),
+                                            ("max", "full"),
+                                            ("avg", "full")])
+def test_pooling_nhwc(pool_type, conv):
+    rng = _rng()
+    x = rng.randn(2, 5, 9, 9).astype("float32")
+    kw = dict(kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type=pool_type,
+              pooling_convention=conv)
+    y1 = mx.nd.Pooling(mx.nd.array(x), **kw).asnumpy()
+    y2 = mx.nd.Pooling(mx.nd.array(x.transpose(0, 2, 3, 1)),
+                       layout="NHWC", **kw).asnumpy()
+    np.testing.assert_allclose(y1, y2.transpose(0, 3, 1, 2), RTOL, ATOL)
+
+
+def test_pooling_nhwc_global_and_exclude_pad():
+    rng = _rng()
+    x = rng.randn(2, 5, 6, 6).astype("float32")
+    y1 = mx.nd.Pooling(mx.nd.array(x), pool_type="avg",
+                       global_pool=True).asnumpy()
+    y2 = mx.nd.Pooling(mx.nd.array(x.transpose(0, 2, 3, 1)), pool_type="avg",
+                       global_pool=True, layout="NHWC").asnumpy()
+    np.testing.assert_allclose(y1, y2.transpose(0, 3, 1, 2), RTOL, ATOL)
+    kw = dict(kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type="avg",
+              count_include_pad=False)
+    y1 = mx.nd.Pooling(mx.nd.array(x), **kw).asnumpy()
+    y2 = mx.nd.Pooling(mx.nd.array(x.transpose(0, 2, 3, 1)), layout="NHWC",
+                       **kw).asnumpy()
+    np.testing.assert_allclose(y1, y2.transpose(0, 3, 1, 2), RTOL, ATOL)
+
+
+def test_deconv_nhwc_matches_nchw():
+    rng = _rng()
+    x = rng.randn(2, 4, 6, 6).astype("float32")
+    w = rng.randn(4, 3, 3, 3).astype("float32")
+    y1 = mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                             stride=(2, 2), pad=(1, 1),
+                             num_filter=3).asnumpy()
+    y2 = mx.nd.Deconvolution(mx.nd.array(x.transpose(0, 2, 3, 1)),
+                             mx.nd.array(w.transpose(0, 2, 3, 1)),
+                             kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                             num_filter=3, layout="NHWC").asnumpy()
+    np.testing.assert_allclose(y1, y2.transpose(0, 3, 1, 2), RTOL, ATOL)
+
+
+def test_gluon_conv2d_nhwc_weight_shape_and_forward():
+    rng = _rng()
+    net = mx.gluon.nn.Conv2D(8, 3, padding=1, layout="NHWC", use_bias=True)
+    net.initialize()
+    x = mx.nd.array(rng.randn(2, 6, 6, 5).astype("float32"))
+    y = net(x)
+    assert y.shape == (2, 6, 6, 8)
+    assert net.weight.shape == (8, 3, 3, 5)   # (O, kh, kw, I)
+
+
+def test_gluon_conv_nhwc_gradient():
+    rng = _rng()
+    net = mx.gluon.nn.Conv2D(4, 3, padding=1, layout="NHWC", use_bias=False)
+    net.initialize()
+    x = mx.nd.array(rng.randn(2, 5, 5, 3).astype("float32"))
+    with mx.autograd.record():
+        y = net(x)
+        loss = (y * y).sum()
+    loss.backward()
+    g = net.weight.grad()
+    assert g.shape == net.weight.shape
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_resnet_nhwc_matches_nchw_model():
+    rng = _rng()
+    mx.random.seed(0)
+    n1 = mx.gluon.model_zoo.vision.resnet18_v1(classes=10)
+    n1.initialize()
+    n1(mx.nd.zeros((1, 3, 32, 32)))
+    mx.random.seed(0)
+    n2 = mx.gluon.model_zoo.vision.resnet18_v1(classes=10, layout="NHWC")
+    n2.initialize()
+    n2(mx.nd.zeros((1, 32, 32, 3)))
+    p1 = {k.split("_", 1)[1]: v for k, v in n1.collect_params().items()}
+    p2 = {k.split("_", 1)[1]: v for k, v in n2.collect_params().items()}
+    assert set(p1) == set(p2)
+    for k in p2:
+        a = p1[k].data().asnumpy()
+        if a.ndim == 4:
+            a = a.transpose(0, 2, 3, 1)
+        p2[k].set_data(mx.nd.array(a))
+    x = rng.randn(2, 3, 32, 32).astype("float32")
+    o1 = n1(mx.nd.array(x)).asnumpy()
+    o2 = n2(mx.nd.array(x.transpose(0, 2, 3, 1))).asnumpy()
+    np.testing.assert_allclose(o1, o2, rtol=2e-4, atol=2e-4)
+
+
+def test_batchnorm_training_stats_onepass_numerics():
+    """The fused one-pass E[x²]−E[x]² batch statistics must match numpy's
+    two-pass moments (reference batch_norm.cc semantics) to fp32 accuracy."""
+    rng = _rng()
+    x = (rng.randn(8, 4, 5, 5) * 3 + 50).astype("float32")   # offset mean
+    gamma = rng.rand(4).astype("float32") + 0.5
+    beta = rng.randn(4).astype("float32")
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    want = (x - mean[None, :, None, None]) / \
+        np.sqrt(var[None, :, None, None] + 1e-3) * \
+        gamma[None, :, None, None] + beta[None, :, None, None]
+    with mx.autograd.record(train_mode=True):
+        got = mx.nd.BatchNorm(
+            mx.nd.array(x), mx.nd.array(gamma), mx.nd.array(beta),
+            mx.nd.zeros((4,)), mx.nd.ones((4,)), fix_gamma=False)
+    np.testing.assert_allclose(got.asnumpy(), want, rtol=2e-4, atol=2e-4)
