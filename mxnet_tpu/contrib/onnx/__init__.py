@@ -1,17 +1,19 @@
 """ONNX import/export (reference ``python/mxnet/contrib/onnx/``).
 
 The converter machinery — symbol topo-walk, per-op converter tables both
-directions, parameter/initializer extraction — is wheel-independent and
-operates on a plain-dict graph (see :mod:`.mx2onnx`).  Only protobuf
-(de)serialization needs the ``onnx`` package, which is absent in this
-zero-egress image; those two steps (``graph_to_proto``/``proto_to_graph``)
-raise with instructions, everything else runs and is tested.
+directions, parameter/initializer extraction — operates on a plain-dict
+graph (see :mod:`.mx2onnx`), and protobuf (de)serialization is
+hand-written (:mod:`.protobuf`), so real ``.onnx`` bytes are produced and
+parsed with NO wheel: ``export_model``/``import_model`` are fully
+functional.  ``graph_to_proto``/``proto_to_graph`` additionally expose
+``onnx.ModelProto`` objects when the wheel is present.
 """
 from __future__ import annotations
 
 __all__ = ["import_model", "export_model", "get_model_metadata",
-           "export_graph", "graph_to_proto", "import_graph",
-           "proto_to_graph", "mx2onnx", "onnx2mx"]
+           "export_graph", "graph_to_proto", "graph_to_bytes",
+           "import_graph", "proto_to_graph", "graph_from_bytes",
+           "mx2onnx", "onnx2mx", "protobuf"]
 
 _MSG = ("this step needs the 'onnx' protobuf package, which is not "
         "available in this environment (no network access); the dict-level "
@@ -25,14 +27,17 @@ def _require_onnx():
         raise ImportError(_MSG) from e
 
 
-from . import mx2onnx, onnx2mx  # noqa: E402
-from .mx2onnx import export_graph, export_model, graph_to_proto  # noqa: E402
-from .onnx2mx import import_graph, import_model, proto_to_graph  # noqa: E402
+from . import mx2onnx, onnx2mx, protobuf  # noqa: E402
+from .mx2onnx import (export_graph, export_model, graph_to_proto,  # noqa: E402
+                      graph_to_bytes)
+from .onnx2mx import (import_graph, import_model, proto_to_graph,  # noqa: E402
+                      graph_from_bytes)
 
 
 def get_model_metadata(model_file):
-    """Reference ``onnx2mx/import_model.py:get_model_metadata``."""
-    graph = proto_to_graph(model_file)
+    """Reference ``onnx2mx/import_model.py:get_model_metadata`` —
+    wheel-free via the wire-format parser."""
+    graph = graph_from_bytes(model_file)
     return {"input_tensor_data": [(i["name"], i["shape"])
                                   for i in graph["inputs"]],
             "output_tensor_data": [(o["name"], None)

@@ -147,6 +147,8 @@ def _pooling(ctx, name, ins, attrs):
     a = {"kernel_shape": kernel,
          "strides": _tuple2(attrs.get("stride"), (1,) * len(kernel)),
          "pads": pad + pad}
+    if str(attrs.get("pooling_convention", "valid")) == "full":
+        a["ceil_mode"] = 1           # ONNX MaxPool/AveragePool opset>=10
     if ptype == "avg":
         a["count_include_pad"] = 0 \
             if attrs.get("count_include_pad", "True") in ("False", False) \
@@ -241,9 +243,13 @@ def _mean(ctx, name, ins, attrs):
 
 @register("clip")
 def _clip(ctx, name, ins, attrs):
-    return ctx.add("Clip", name, ins,
-                   {"min": float(_parse(attrs.get("a_min"), 0.0)),
-                    "max": float(_parse(attrs.get("a_max"), 0.0))})
+    # opset>=11 Clip: min/max are INPUTS (the attr form is only legal <=6)
+    mn, mx = name + "_min", name + "_max"
+    ctx.extra_initializers[mn] = _np.asarray(
+        float(_parse(attrs.get("a_min"), 0.0)), dtype=_np.float32)
+    ctx.extra_initializers[mx] = _np.asarray(
+        float(_parse(attrs.get("a_max"), 0.0)), dtype=_np.float32)
+    return ctx.add("Clip", name, [ins[0], mn, mx])
 
 
 def _binop(onnx_op):
@@ -386,16 +392,20 @@ def export_graph(sym, params, input_shapes, input_dtype="float32"):
     }
 
 
+# all emitted ops use their opset-17 forms: Slice (input-form since 10),
+# Clip (11), Pad (11), Unsqueeze/Split (13), LayerNormalization (17)
+OPSET = 17
+
+
 def graph_to_proto(graph):
-    """Plain-dict graph → onnx.ModelProto — the ONLY wheel-gated step."""
+    """Plain-dict graph → onnx.ModelProto (wheel path; the wheel-free
+    serializer is :func:`graph_to_bytes`)."""
     from . import _require_onnx
     _require_onnx()
     import onnx
     from onnx import helper, numpy_helper, TensorProto
 
-    dt = {"float32": TensorProto.FLOAT, "float64": TensorProto.DOUBLE,
-          "float16": TensorProto.FLOAT16,
-          "int32": TensorProto.INT32, "int64": TensorProto.INT64}
+    from .protobuf import DTYPE_TO_ONNX as dt   # one shared dtype table
     onodes = []
     for n in graph["nodes"]:
         attrs = {}
@@ -416,20 +426,43 @@ def graph_to_proto(graph):
              for k, v in graph["initializers"].items()]
     g = helper.make_graph(onodes, "mxnet_tpu", inputs, outputs,
                           initializer=inits)
-    # opset 11: the attr forms of Unsqueeze/Slice/Split emitted here are
-    # only legal pre-13/pre-10-input-form opsets
+    # opset 17: Slice/Clip/Unsqueeze are emitted in input form (legal
+    # since 10/11/13) and LayerNormalization is a default-domain op (17)
     return helper.make_model(g, opset_imports=[
-        helper.make_opsetid("", 11), helper.make_opsetid("mxnet", 1)])
+        helper.make_opsetid("", OPSET), helper.make_opsetid("mxnet", 1)])
+
+
+def graph_to_bytes(graph):
+    """Plain-dict graph → real ONNX ModelProto bytes via the hand-written
+    wire-format serializer (:mod:`.protobuf`) — no wheel needed.  The
+    bytes parse back through ``protobuf.bytes_to_model``, through
+    ``protoc --decode_raw``, and through the onnx wheel where present."""
+    from .protobuf import model_to_bytes
+    import copy
+
+    g = {"nodes": [], "inputs": graph["inputs"],
+         "outputs": graph["outputs"],
+         "initializers": graph["initializers"]}
+    for n in graph["nodes"]:
+        n = copy.copy(n)
+        if n["op_type"] == "Cast":
+            # the dict carries numpy dtype names; the proto wants the enum
+            from .protobuf import DTYPE_TO_ONNX
+            attrs = dict(n["attrs"])
+            attrs["to"] = DTYPE_TO_ONNX[str(attrs.get("to", "float32"))]
+            n["attrs"] = attrs
+        g["nodes"].append(n)
+    return model_to_bytes(g, opset=OPSET)
 
 
 def export_model(sym, params, input_shape, input_type="float32",
                  onnx_file_path="model.onnx", verbose=False):
     """Reference ``mx2onnx/export_model.py:export_model``: converts and
-    writes a ``.onnx`` file (requires the onnx wheel for this last step)."""
+    writes a real ``.onnx`` protobuf file (wheel-free — see
+    :func:`graph_to_bytes`)."""
     graph = export_graph(sym, params, input_shape, input_dtype=input_type)
-    model = graph_to_proto(graph)
     with open(onnx_file_path, "wb") as f:
-        f.write(model.SerializeToString())
+        f.write(graph_to_bytes(graph))
     if verbose:
         print(f"exported {onnx_file_path}")
     return onnx_file_path
@@ -461,8 +494,10 @@ def _cast_cv(ctx, name, ins, attrs):
 
 @register("expand_dims")
 def _expand_dims(ctx, name, ins, attrs):
-    return ctx.add("Unsqueeze", name, ins,
-                   {"axes": (int(_parse(attrs.get("axis"), 0)),)})
+    # opset>=13 Unsqueeze: axes is an INPUT tensor, not an attribute
+    aname = _int64_init(ctx, name + "_axes",
+                        [int(_parse(attrs.get("axis"), 0))])
+    return ctx.add("Unsqueeze", name, [ins[0], aname])
 
 
 @register("reshape")
@@ -480,12 +515,16 @@ def _reshape(ctx, name, ins, attrs):
 
 @register("slice_axis")
 def _slice_axis(ctx, name, ins, attrs):
+    # opset>=10 Slice: starts/ends/axes are INPUTS (attr form legal <=9)
     ax = int(_parse(attrs.get("axis"), 0))
     begin = int(_parse(attrs.get("begin"), 0))
     end = _parse(attrs.get("end"), None)
-    return ctx.add("Slice", name, ins, {
-        "axes": (ax,), "starts": (begin,),
-        "ends": (int(end) if end is not None else 2**31 - 1,)})
+    names = [_int64_init(ctx, name + suffix, [int(val)])
+             for suffix, val in (("_starts", begin),
+                                 ("_ends", int(end) if end is not None
+                                  else 2**31 - 1),
+                                 ("_axes", ax))]
+    return ctx.add("Slice", name, [ins[0]] + names)
 
 
 @register("slice_like")
@@ -500,13 +539,19 @@ def _slice_like(ctx, name, ins, attrs):
 
 @register("split")
 def _split(ctx, name, ins, attrs):
-    if _parse(attrs.get("squeeze_axis"), False) in (True, 1, "True"):
-        raise NotImplementedError(
-            "split(squeeze_axis=True) has no ONNX equivalent — the Split "
-            "outputs would keep the split axis and silently change rank")
     n = int(_parse(attrs.get("num_outputs"), 1))
     ax = int(_parse(attrs.get("axis"), 1))
     outs = [name] + [f"{name}_out{j}" for j in range(1, n)]
+    if _parse(attrs.get("squeeze_axis"), False) in (True, 1, "True"):
+        # SliceChannel(squeeze_axis=True): Split keeps the split axis, so
+        # each output gets a Squeeze(axes=[ax]) (input form, opset 13)
+        pres = [f"{name}_pre{j}" for j in range(n)]
+        ctx.add("Split", name + "_split", ins, {"axis": ax}, outputs=pres)
+        aname = _int64_init(ctx, name + "_sq_axes", [ax])
+        for j in range(n):
+            ctx.add("Squeeze", outs[j], [pres[j], aname],
+                    outputs=[outs[j]])
+        return outs[0]
     ctx.add("Split", name, ins, {"axis": ax}, outputs=outs)
     return outs[0]
 
@@ -544,3 +589,312 @@ def _batch_dot(ctx, name, ins, attrs):
     if _parse(attrs.get("transpose_b"), False) in (True, 1, "True"):
         b = ctx.add("Transpose", name + "_tb", [b], {"perm": (0, 2, 1)})
     return ctx.add("MatMul", name, [a, b])
+
+
+# ---------------------------------------------------- breadth tranche (r3)
+# Reference table: mx2onnx/_op_translations.py (98 @mx_op.register entries).
+# Everything below emits opset-17-legal forms (axes/shape/repeats as inputs
+# where the opset moved them there).
+def _int64_init(ctx, name, values):
+    ctx.extra_initializers[name] = _np.asarray(values, dtype=_np.int64)
+    return name
+
+
+for _mx, _ox in [("reciprocal", "Reciprocal"), ("ceil", "Ceil"),
+                 ("floor", "Floor"), ("sin", "Sin"), ("cos", "Cos"),
+                 ("tan", "Tan"), ("arcsin", "Asin"), ("arccos", "Acos"),
+                 ("arctan", "Atan"), ("sinh", "Sinh"), ("cosh", "Cosh"),
+                 ("tanh", "Tanh"), ("round", "Round"), ("sign", "Sign"),
+                 ("softsign", "Softsign"),
+                 ("_maximum", "Max"), ("_minimum", "Min"),
+                 ("broadcast_maximum", "Max"), ("broadcast_minimum", "Min"),
+                 ("broadcast_power", "Pow"), ("_power", "Pow"),
+                 ("add_n", "Sum"), ("ElementWiseSum", "Sum"),
+                 ("shape_array", "Shape"), ("size_array", "Size")]:
+    register(_mx)(_binop(_ox))
+
+
+def _not_equal_cv(ctx, name, ins, attrs):
+    # no ONNX NotEqual op: Equal → Not, with the bool↔float casts the mx
+    # dtype contract needs
+    eq = ctx.add("Equal", name + "_eq", ins)
+    ne = ctx.add("Not", name + "_not", [eq])
+    return ctx.add("Cast", name, [ne], {"to": "float32"})
+
+
+register("broadcast_not_equal")(_not_equal_cv)
+register("_not_equal")(_not_equal_cv)
+
+register("_power_scalar")(_scalar_op("Pow"))
+register("_maximum_scalar")(_scalar_op("Max"))
+register("_minimum_scalar")(_scalar_op("Min"))
+
+
+@register("square")
+def _square(ctx, name, ins, attrs):
+    # no ONNX Square: x*x keeps it a single fused Mul everywhere
+    return ctx.add("Mul", name, [ins[0], ins[0]])
+
+
+@register("logical_not")
+def _logical_not(ctx, name, ins, attrs):
+    b = ctx.add("Cast", name + "_b", ins, {"to": "bool"})
+    n = ctx.add("Not", name + "_not", [b])
+    return ctx.add("Cast", name, [n], {"to": "float32"})
+
+
+def _cmp_op(onnx_op):
+    # mx comparisons return float 0/1; ONNX comparators return bool
+    def cv(ctx, name, ins, attrs):
+        c = ctx.add(onnx_op, name + "_cmp", ins)
+        return ctx.add("Cast", name, [c], {"to": "float32"})
+    return cv
+
+
+for _mx, _ox in [("broadcast_equal", "Equal"),
+                 ("broadcast_greater", "Greater"),
+                 ("broadcast_lesser", "Less"),
+                 ("broadcast_greater_equal", "GreaterOrEqual"),
+                 ("broadcast_lesser_equal", "LessOrEqual")]:
+    register(_mx)(_cmp_op(_ox))
+
+
+def _logical_op(onnx_op):
+    def cv(ctx, name, ins, attrs):
+        bs = [ctx.add("Cast", f"{name}_b{i}", [x], {"to": "bool"})
+              for i, x in enumerate(ins)]
+        o = ctx.add(onnx_op, name + "_op", bs)
+        return ctx.add("Cast", name, [o], {"to": "float32"})
+    return cv
+
+
+for _mx, _ox in [("broadcast_logical_and", "And"),
+                 ("broadcast_logical_or", "Or"),
+                 ("broadcast_logical_xor", "Xor")]:
+    register(_mx)(_logical_op(_ox))
+
+
+def _reduce_op(onnx_op, axes_as_input=False):
+    def cv(ctx, name, ins, attrs):
+        axes = _parse(attrs.get("axis"), None)
+        if axes is not None and not isinstance(axes, (tuple, list)):
+            axes = (axes,)
+        a = {"keepdims": 1 if _parse(attrs.get("keepdims"), False)
+             in (True, 1, "True") else 0}
+        if axes_as_input:
+            # ReduceSum moved axes to an input at opset 13
+            extra = [_int64_init(ctx, name + "_axes",
+                                 [int(x) for x in axes])] if axes else []
+            return ctx.add(onnx_op, name, [ins[0]] + extra, a)
+        if axes:
+            a["axes"] = tuple(int(x) for x in axes)
+        return ctx.add(onnx_op, name, ins, a)
+    return cv
+
+
+register("sum")(_reduce_op("ReduceSum", axes_as_input=True))
+register("max")(_reduce_op("ReduceMax"))
+register("min")(_reduce_op("ReduceMin"))
+register("prod")(_reduce_op("ReduceProd"))
+
+
+@register("norm")
+def _norm(ctx, name, ins, attrs):
+    ordv = int(_parse(attrs.get("ord"), 2))
+    axes = _parse(attrs.get("axis"), None)
+    if axes is not None and not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    a = {"keepdims": 1 if _parse(attrs.get("keepdims"), False)
+         in (True, 1, "True") else 0}
+    if axes:
+        a["axes"] = tuple(int(x) for x in axes)
+    return ctx.add({1: "ReduceL1", 2: "ReduceL2"}[ordv], name, ins, a)
+
+
+def _arg_op(onnx_op):
+    def cv(ctx, name, ins, attrs):
+        ax = _parse(attrs.get("axis"), None)
+        a = {"axis": int(ax) if ax is not None else 0,
+             "keepdims": 1 if _parse(attrs.get("keepdims"), False)
+             in (True, 1, "True") else 0}
+        o = ctx.add(onnx_op, name + "_i64", ins, a)
+        # mx argmax/argmin return float32 — keep that dtype contract
+        return ctx.add("Cast", name, [o], {"to": "float32"})
+    return cv
+
+
+register("argmax")(_arg_op("ArgMax"))
+register("argmin")(_arg_op("ArgMin"))
+
+
+@register("log_softmax")
+def _log_softmax(ctx, name, ins, attrs):
+    return ctx.add("LogSoftmax", name, ins,
+                   {"axis": int(_parse(attrs.get("axis"), -1))})
+
+
+@register("hard_sigmoid")
+def _hard_sigmoid(ctx, name, ins, attrs):
+    return ctx.add("HardSigmoid", name, ins,
+                   {"alpha": float(_parse(attrs.get("alpha"), 0.2)),
+                    "beta": float(_parse(attrs.get("beta"), 0.5))})
+
+
+@register("squeeze")
+def _squeeze_cv(ctx, name, ins, attrs):
+    axes = _parse(attrs.get("axis"), None)
+    if axes is None:
+        return ctx.add("Squeeze", name, ins)
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    aname = _int64_init(ctx, name + "_axes", [int(x) for x in axes])
+    return ctx.add("Squeeze", name, [ins[0], aname])
+
+
+@register("broadcast_to")
+def _broadcast_to(ctx, name, ins, attrs):
+    shape = tuple(int(x) for x in _parse(attrs.get("shape"), ()))
+    sname = _int64_init(ctx, name + "_shape", shape)
+    return ctx.add("Expand", name, [ins[0], sname])
+
+
+@register("tile")
+def _tile(ctx, name, ins, attrs):
+    reps = tuple(int(x) for x in _parse(attrs.get("reps"), ()))
+    rname = _int64_init(ctx, name + "_reps", reps)
+    return ctx.add("Tile", name, [ins[0], rname])
+
+
+@register("depth_to_space")
+def _d2s(ctx, name, ins, attrs):
+    return ctx.add("DepthToSpace", name, ins,
+                   {"blocksize": int(_parse(attrs.get("block_size"), 1)),
+                    "mode": "DCR"})
+
+
+@register("space_to_depth")
+def _s2d(ctx, name, ins, attrs):
+    return ctx.add("SpaceToDepth", name, ins,
+                   {"blocksize": int(_parse(attrs.get("block_size"), 1))})
+
+
+@register("Pad")
+def _pad_cv(ctx, name, ins, attrs):
+    # mx pad_width pairs (b0,e0,b1,e1,…) → ONNX [b…, e…]; input form (11+)
+    pw = tuple(int(x) for x in _parse(attrs.get("pad_width"), ()))
+    begins, ends = pw[0::2], pw[1::2]
+    mode = str(_parse(attrs.get("mode"), "constant"))
+    pname = _int64_init(ctx, name + "_pads", list(begins) + list(ends))
+    inputs = [ins[0], pname]
+    if mode == "constant":
+        vname = name + "_value"
+        ctx.extra_initializers[vname] = _np.asarray(
+            float(_parse(attrs.get("constant_value"), 0.0)),
+            dtype=_np.float32)
+        inputs.append(vname)
+    return ctx.add("Pad", name, inputs, {"mode": mode})
+
+
+register("pad")(_pad_cv)
+
+
+@register("LRN")
+def _lrn(ctx, name, ins, attrs):
+    return ctx.add("LRN", name, ins, {
+        "alpha": float(_parse(attrs.get("alpha"), 1e-4)),
+        "beta": float(_parse(attrs.get("beta"), 0.75)),
+        "bias": float(_parse(attrs.get("knorm"), 2.0)),
+        "size": int(_parse(attrs.get("nsize"), 5))})
+
+
+@register("InstanceNorm")
+def _instance_norm(ctx, name, ins, attrs):
+    return ctx.add("InstanceNormalization", name, ins,
+                   {"epsilon": float(_parse(attrs.get("eps"), 1e-3))})
+
+
+@register("L2Normalization")
+def _l2norm(ctx, name, ins, attrs):
+    mode = str(_parse(attrs.get("mode"), "instance"))
+    if mode != "channel":
+        raise NotImplementedError(
+            f"L2Normalization mode={mode!r}: only 'channel' maps to "
+            "LpNormalization (reference _op_translations.py raises the "
+            "same way)")
+    return ctx.add("LpNormalization", name, ins, {"axis": 1, "p": 2})
+
+
+@register("ROIPooling")
+def _roipool(ctx, name, ins, attrs):
+    hw = _tuple2(_parse(attrs.get("pooled_size"), (1, 1)), (1, 1))
+    return ctx.add("MaxRoiPool", name, ins, {
+        "pooled_shape": tuple(int(x) for x in hw),
+        "spatial_scale": float(_parse(attrs.get("spatial_scale"), 1.0))})
+
+
+@register("LogisticRegressionOutput")
+def _logistic_out(ctx, name, ins, attrs):
+    return ctx.add("Sigmoid", name, ins[:1])
+
+
+@register("MakeLoss")
+def _make_loss(ctx, name, ins, attrs):
+    return ctx.add("Identity", name, ins[:1])
+
+
+@register("_random_uniform")
+def _random_uniform_cv(ctx, name, ins, attrs):
+    return ctx.add("RandomUniform", name, [], {
+        "low": float(_parse(attrs.get("low"), 0.0)),
+        "high": float(_parse(attrs.get("high"), 1.0)),
+        "shape": tuple(int(x) for x in _parse(attrs.get("shape"), ()))})
+
+
+@register("_random_normal")
+def _random_normal_cv(ctx, name, ins, attrs):
+    return ctx.add("RandomNormal", name, [], {
+        "mean": float(_parse(attrs.get("loc"), 0.0)),
+        "scale": float(_parse(attrs.get("scale"), 1.0)),
+        "shape": tuple(int(x) for x in _parse(attrs.get("shape"), ()))})
+
+
+@register("_sample_multinomial")
+def _sample_multinomial_cv(ctx, name, ins, attrs):
+    shape = _parse(attrs.get("shape"), 1)
+    n = int(shape[0]) if isinstance(shape, (tuple, list)) else int(shape)
+    lg = ctx.add("Log", name + "_log", ins)   # mx takes probs, ONNX logits
+    return ctx.add("Multinomial", name, [lg], {"sample_size": n})
+
+
+@register("_linalg_gemm2")
+def _linalg_gemm2_cv(ctx, name, ins, attrs):
+    a, b = ins
+    if _parse(attrs.get("transpose_a"), False) in (True, 1, "True"):
+        a = ctx.add("Transpose", name + "_ta", [a], {"perm": (1, 0)})
+    if _parse(attrs.get("transpose_b"), False) in (True, 1, "True"):
+        b = ctx.add("Transpose", name + "_tb", [b], {"perm": (1, 0)})
+    alpha = float(_parse(attrs.get("alpha"), 1.0))
+    if alpha == 1.0:
+        return ctx.add("MatMul", name, [a, b])
+    m = ctx.add("MatMul", name + "_mm", [a, b])
+    sname = name + "_alpha"
+    ctx.extra_initializers[sname] = _np.asarray(alpha, dtype=_np.float32)
+    return ctx.add("Mul", name, [m, sname])
+
+
+@register("Crop")
+def _crop(ctx, name, ins, attrs):
+    # attr-form center/offset crop on H/W (reference Crop → Slice); the
+    # 2-input crop-like form needs shapes, which the dict walk doesn't carry
+    hw = _parse(attrs.get("h_w"), None)
+    if hw is None or len(ins) > 1:
+        raise NotImplementedError(
+            "Crop: only the attr-form (h_w [+ offset], center_crop=False) "
+            "exports")
+    h, w = (int(x) for x in hw)
+    off = _tuple2(_parse(attrs.get("offset"), (0, 0)), (0, 0))
+    oy, ox = (int(x) for x in off)
+    starts = _int64_init(ctx, name + "_starts", [oy, ox])
+    ends = _int64_init(ctx, name + "_ends", [oy + h, ox + w])
+    axes = _int64_init(ctx, name + "_axes", [2, 3])
+    return ctx.add("Slice", name, [ins[0], starts, ends, axes])

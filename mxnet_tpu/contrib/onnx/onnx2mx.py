@@ -122,7 +122,9 @@ def _maxpool(sym, ins, attrs, name):
     return ("Pooling", {"pool_type": "max",
                         "kernel": tuple(attrs["kernel_shape"]),
                         "stride": tuple(attrs.get("strides", ())) or None,
-                        "pad": _pads_to_mx(attrs.get("pads"))})
+                        "pad": _pads_to_mx(attrs.get("pads")),
+                        "pooling_convention":
+                            "full" if attrs.get("ceil_mode") else "valid"})
 
 
 @register("AveragePool")
@@ -131,6 +133,8 @@ def _avgpool(sym, ins, attrs, name):
                         "kernel": tuple(attrs["kernel_shape"]),
                         "stride": tuple(attrs.get("strides", ())) or None,
                         "pad": _pads_to_mx(attrs.get("pads")),
+                        "pooling_convention":
+                            "full" if attrs.get("ceil_mode") else "valid",
                         "count_include_pad":
                             bool(attrs.get("count_include_pad", 1))})
 
@@ -184,7 +188,13 @@ def _cast(sym, ins, attrs, name):
 
 @register("Unsqueeze")
 def _unsqueeze(sym, ins, attrs, name):
-    axes = tuple(attrs.get("axes", (0,)))
+    if "axes" not in attrs:
+        # opset>=13 carries axes as an input; _normalize_graph resolves
+        # constant axes into the attr — reaching here means they were
+        # dynamic, and defaulting would silently use the wrong axis
+        raise NotImplementedError(
+            f"Unsqueeze {name!r}: axes not statically known")
+    axes = tuple(attrs["axes"])
     assert len(axes) == 1, \
         f"multi-axes Unsqueeze {axes} does not map to one expand_dims"
     return ("expand_dims", {"axis": int(axes[0])})
@@ -199,13 +209,23 @@ def _squeeze(sym, ins, attrs, name):
 
 @register("Slice")
 def _slice(sym, ins, attrs, name):
-    axes = tuple(attrs.get("axes", ()))
-    starts = tuple(attrs.get("starts", ()))
-    ends = tuple(attrs.get("ends", ()))
-    assert len(axes) == 1, "only single-axis attr-form Slice imports"
-    end = int(ends[0])
-    return ("slice_axis", {"axis": int(axes[0]), "begin": int(starts[0]),
-                           "end": None if end >= 2**31 - 1 else end})
+    starts = tuple(int(x) for x in attrs.get("starts", ()))
+    ends = tuple(int(x) for x in attrs.get("ends", ()))
+    axes = tuple(int(x) for x in
+                 attrs.get("axes", range(len(starts))))
+    if len(axes) == 1:
+        end = ends[0]
+        return ("slice_axis", {"axis": axes[0], "begin": starts[0],
+                               "end": None if end >= 2**31 - 1 else end})
+
+    def build(s, xs, inits, nm):
+        out = xs[0]
+        for k, (ax, b, e) in enumerate(zip(axes, starts, ends)):
+            out = s.slice_axis(out, axis=ax, begin=b,
+                               end=None if e >= 2**31 - 1 else e,
+                               name=f"{nm}_ax{k}")
+        return out
+    return ("__lambda__", build)
 
 
 @register("SliceLike")
@@ -216,6 +236,11 @@ def _slice_like(sym, ins, attrs, name):
 
 @register("Split")
 def _split(sym, ins, attrs, name):
+    sections = attrs.get("split")
+    if sections is not None and len(set(sections)) > 1:
+        raise NotImplementedError(
+            f"Split {name!r}: unequal sections {tuple(sections)} do not "
+            "map to mx split")
     return ("split", {"axis": int(attrs.get("axis", 0)),
                       "num_outputs": None})   # patched from node arity
 
@@ -252,7 +277,11 @@ def _rmin(sym, ins, attrs, name):
 
 @register("Pad")
 def _pad(sym, ins, attrs, name):
-    # attr-form (opset<11): pads = [b0..bN, e0..eN] → mx pad_width pairs
+    if "pads" not in attrs:
+        # opset>=11 carries pads as an input; _normalize_graph resolves
+        # constants — dynamic pads cannot map to mx pad
+        raise NotImplementedError(f"Pad {name!r}: pads not statically known")
+    # pads = [b0..bN, e0..eN] → mx pad_width pairs
     pads = tuple(int(p) for p in attrs.get("pads", ()))
     half = len(pads) // 2
     width = []
@@ -270,16 +299,315 @@ def _transpose(sym, ins, attrs, name):
     return ("transpose", {"axes": tuple(perm)} if perm else {})
 
 
+# ---------------------------------------------------- breadth tranche (r3)
+# Reference table: onnx2mx/_import_helper.py _convert_map (92 entries).
+# ``("__lambda__", fn)`` converters get (sym_mod, ins, inits, name) and
+# build composite expressions.
+_SIMPLE2 = {
+    "Ceil": ("ceil", {}), "Floor": ("floor", {}), "Round": ("round", {}),
+    "Reciprocal": ("reciprocal", {}), "Sign": ("sign", {}),
+    "Cos": ("cos", {}), "Sin": ("sin", {}), "Tan": ("tan", {}),
+    "Acos": ("arccos", {}), "Asin": ("arcsin", {}), "Atan": ("arctan", {}),
+    "Sinh": ("sinh", {}), "Cosh": ("cosh", {}),
+    "Shape": ("shape_array", {}), "Size": ("size_array", {}),
+    "Pow": ("broadcast_power", {}),
+}
+for _ox, (_mx, _kw) in _SIMPLE2.items():
+    register(_ox)(lambda sym, ins, attrs, name, _mx=_mx, _kw=_kw:
+                  (_mx, dict(_kw)))
+
+
+@register("Sum")
+def _sum_n(sym, ins, attrs, name):
+    return ("add_n", {})
+
+
+@register("Mean")
+def _mean_n(sym, ins, attrs, name):
+    n = len(ins)
+    return ("__lambda__", lambda s, xs, inits, nm:
+            s.add_n(*xs, name=nm + "_sum") / float(n))
+
+
+@register("Max")
+def _max_n(sym, ins, attrs, name):
+    def build(s, xs, inits, nm):
+        out = xs[0]
+        for x in xs[1:]:
+            out = getattr(s, "_maximum")(out, x)
+        return out
+    return ("__lambda__", build)
+
+
+@register("Min")
+def _min_n(sym, ins, attrs, name):
+    def build(s, xs, inits, nm):
+        out = xs[0]
+        for x in xs[1:]:
+            out = getattr(s, "_minimum")(out, x)
+        return out
+    return ("__lambda__", build)
+
+
+@register("ArgMax")
+def _argmax(sym, ins, attrs, name):
+    return ("argmax", {"axis": int(attrs.get("axis", 0)),
+                       "keepdims": bool(attrs.get("keepdims", 1))})
+
+
+@register("ArgMin")
+def _argmin(sym, ins, attrs, name):
+    return ("argmin", {"axis": int(attrs.get("axis", 0)),
+                       "keepdims": bool(attrs.get("keepdims", 1))})
+
+
+def _reduce_import(mx_name):
+    def cv(sym, ins, attrs, name):
+        return (mx_name, {"axis": tuple(attrs.get("axes", ())) or None,
+                          "keepdims": bool(attrs.get("keepdims", 1))})
+    return cv
+
+
+register("ReduceProd")(_reduce_import("prod"))
+
+
+def _reduce_lambda(body):
+    def cv(sym, ins, attrs, name):
+        axis = tuple(attrs.get("axes", ())) or None
+        keep = bool(attrs.get("keepdims", 1))
+        return ("__lambda__", lambda s, xs, inits, nm:
+                body(s, xs[0], axis, keep, nm))
+    return cv
+
+
+register("ReduceLogSum")(_reduce_lambda(
+    lambda s, x, ax, k, nm: s.log(s.sum(x, axis=ax, keepdims=k))))
+register("ReduceLogSumExp")(_reduce_lambda(
+    lambda s, x, ax, k, nm: s.log(s.sum(s.exp(x), axis=ax, keepdims=k))))
+register("ReduceSumSquare")(_reduce_lambda(
+    lambda s, x, ax, k, nm: s.sum(s.square(x), axis=ax, keepdims=k)))
+register("ReduceL1")(_reduce_lambda(
+    lambda s, x, ax, k, nm: s.norm(x, ord=1, axis=ax, keepdims=k)))
+register("ReduceL2")(_reduce_lambda(
+    lambda s, x, ax, k, nm: s.norm(x, ord=2, axis=ax, keepdims=k)))
+
+
+@register("PRelu")
+def _prelu(sym, ins, attrs, name):
+    return ("LeakyReLU", {"act_type": "prelu"})
+
+
+@register("Selu")
+def _selu(sym, ins, attrs, name):
+    return ("LeakyReLU", {"act_type": "selu"})
+
+
+@register("HardSigmoid")
+def _hard_sigmoid_in(sym, ins, attrs, name):
+    return ("hard_sigmoid", {"alpha": float(attrs.get("alpha", 0.2)),
+                             "beta": float(attrs.get("beta", 0.5))})
+
+
+@register("LogSoftmax")
+def _log_softmax_in(sym, ins, attrs, name):
+    return ("log_softmax", {"axis": int(attrs.get("axis", -1))})
+
+
+@register("LRN")
+def _lrn_in(sym, ins, attrs, name):
+    return ("LRN", {"alpha": float(attrs.get("alpha", 1e-4)),
+                    "beta": float(attrs.get("beta", 0.75)),
+                    "knorm": float(attrs.get("bias", 1.0)),
+                    "nsize": int(attrs["size"])})
+
+
+@register("InstanceNormalization")
+def _instnorm_in(sym, ins, attrs, name):
+    return ("InstanceNorm", {"eps": float(attrs.get("epsilon", 1e-5))})
+
+
+@register("LpNormalization")
+def _lpnorm_in(sym, ins, attrs, name):
+    p = int(attrs.get("p", 2))
+    ax = int(attrs.get("axis", -1))
+    if p != 2 or ax not in (1,):
+        raise NotImplementedError(
+            f"LpNormalization p={p} axis={ax}: only p=2/axis=1 maps to "
+            "L2Normalization(mode='channel')")
+    return ("L2Normalization", {"mode": "channel"})
+
+
+@register("LpPool")
+def _lppool_in(sym, ins, attrs, name):
+    return ("Pooling", {"pool_type": "lp",
+                        "p_value": int(attrs.get("p", 2)),
+                        "kernel": tuple(attrs["kernel_shape"]),
+                        "stride": tuple(attrs.get("strides", ())) or None,
+                        "pad": _pads_to_mx(attrs.get("pads"))})
+
+
+@register("GlobalLpPool")
+def _glppool_in(sym, ins, attrs, name):
+    return ("Pooling", {"pool_type": "lp", "global_pool": True,
+                        "p_value": int(attrs.get("p", 2)),
+                        "kernel": (1, 1)})
+
+
+def _cmp_import(mx_name):
+    # ONNX comparators return bool; mx returns float 0/1 — keep mx dtype
+    def cv(sym, ins, attrs, name):
+        return ("__lambda__", lambda s, xs, inits, nm:
+                s.cast(getattr(s, mx_name)(xs[0], xs[1]), dtype="float32"))
+    return cv
+
+
+register("Less")(_cmp_import("broadcast_lesser"))
+register("Greater")(_cmp_import("broadcast_greater"))
+register("Equal")(_cmp_import("broadcast_equal"))
+register("LessOrEqual")(_cmp_import("broadcast_lesser_equal"))
+register("GreaterOrEqual")(_cmp_import("broadcast_greater_equal"))
+register("And")(_cmp_import("broadcast_logical_and"))
+register("Or")(_cmp_import("broadcast_logical_or"))
+register("Xor")(_cmp_import("broadcast_logical_xor"))
+
+
+@register("Not")
+def _not_in(sym, ins, attrs, name):
+    return ("__lambda__", lambda s, xs, inits, nm:
+            s.cast(s.logical_not(xs[0]), dtype="float32"))
+
+
+@register("Expand")
+def _expand_in(sym, ins, attrs, name):
+    shape = attrs.get("shape")
+    if shape is None:
+        raise NotImplementedError(
+            f"Expand {name!r}: shape not statically known")
+    return ("broadcast_to", {"shape": tuple(int(x) for x in shape)})
+
+
+@register("Tile")
+def _tile_in(sym, ins, attrs, name):
+    reps = attrs.get("repeats")
+    if reps is None:
+        raise NotImplementedError(
+            f"Tile {name!r}: repeats not statically known")
+    return ("tile", {"reps": tuple(int(x) for x in reps)})
+
+
+@register("DepthToSpace")
+def _d2s_in(sym, ins, attrs, name):
+    if str(attrs.get("mode", "DCR")) != "DCR":
+        raise NotImplementedError("DepthToSpace mode CRD")
+    return ("depth_to_space", {"block_size": int(attrs["blocksize"])})
+
+
+@register("SpaceToDepth")
+def _s2d_in(sym, ins, attrs, name):
+    return ("space_to_depth", {"block_size": int(attrs["blocksize"])})
+
+
+@register("RandomUniform")
+def _random_uniform_in(sym, ins, attrs, name):
+    return ("_random_uniform", {"low": float(attrs.get("low", 0.0)),
+                                "high": float(attrs.get("high", 1.0)),
+                                "shape": tuple(attrs.get("shape", ()))})
+
+
+@register("RandomNormal")
+def _random_normal_in(sym, ins, attrs, name):
+    return ("_random_normal", {"loc": float(attrs.get("mean", 0.0)),
+                               "scale": float(attrs.get("scale", 1.0)),
+                               "shape": tuple(attrs.get("shape", ()))})
+
+
+@register("Multinomial")
+def _multinomial_in(sym, ins, attrs, name):
+    n = int(attrs.get("sample_size", 1))
+    # ONNX takes log-probs, mx takes probs
+    return ("__lambda__", lambda s, xs, inits, nm:
+            getattr(s, "_sample_multinomial")(s.exp(xs[0]), shape=n))
+
+
+@register("MaxRoiPool")
+def _maxroipool_in(sym, ins, attrs, name):
+    return ("ROIPooling",
+            {"pooled_size": tuple(int(x) for x in attrs["pooled_shape"]),
+             "spatial_scale": float(attrs.get("spatial_scale", 1.0))})
+
+
+
+
 @register("Reshape")
 def _reshape(sym, ins, attrs, name):
     return ("__reshape__", {})
 
 
 # ------------------------------------------------------------------ importer
+# ops whose opset>=10/11/13 forms carry what used to be attributes as
+# constant inputs: {op_type: [(input_idx, attr_name), ...]}
+_INPUT_FORM = {
+    "Slice": [(1, "starts"), (2, "ends"), (3, "axes"), (4, "steps")],
+    "Unsqueeze": [(1, "axes")],
+    "Squeeze": [(1, "axes")],
+    "Clip": [(1, "min"), (2, "max")],
+    "Pad": [(1, "pads"), (2, "value")],
+    "ReduceSum": [(1, "axes")],
+    "Split": [(1, "split")],
+    "Expand": [(1, "shape")],
+    "Tile": [(1, "repeats")],
+}
+
+
+def _normalize_graph(graph):
+    """Fold foreign-graph conveniences into the canonical attr form:
+
+    - ``Constant`` nodes become initializers;
+    - input-form parameters (opset>=10/11/13 Slice/Clip/Unsqueeze/Squeeze/
+      Pad/ReduceSum/Split) are resolved from initializers into attributes —
+      or raise :class:`NotImplementedError` when dynamic, instead of the
+      silent wrong-default the attr-only converters would have used.
+    """
+    inits = dict(graph["initializers"])
+    nodes = []
+    for n in graph["nodes"]:
+        if n["op_type"] == "Constant":
+            val = n["attrs"].get("value")
+            if val is None:
+                raise NotImplementedError(
+                    f"Constant {n['name']!r} without a tensor value")
+            inits[n["outputs"][0]] = _np.asarray(val)
+            continue
+        spec = _INPUT_FORM.get(n["op_type"])
+        if spec and len(n["inputs"]) > 1:
+            n = dict(n, attrs=dict(n["attrs"]),
+                     inputs=list(n["inputs"]))
+            for idx, attr in spec:
+                if idx >= len(n["inputs"]) or not n["inputs"][idx]:
+                    continue
+                src = n["inputs"][idx]
+                if src not in inits:
+                    raise NotImplementedError(
+                        f"{n['op_type']} {n['name']!r}: input {attr!r} is "
+                        f"dynamic (tensor {src!r}); only constant "
+                        f"{attr} imports")
+                arr = _np.asarray(inits[src])
+                n["attrs"][attr] = float(arr) if arr.ndim == 0 \
+                    else tuple(arr.reshape(-1).tolist())
+            n["inputs"] = n["inputs"][:1]
+            if n["op_type"] == "Slice" and "steps" in n["attrs"]:
+                steps = tuple(int(s) for s in n["attrs"].pop("steps"))
+                if any(s != 1 for s in steps):
+                    raise NotImplementedError(
+                        f"Slice {n['name']!r}: steps {steps} != 1")
+        nodes.append(n)
+    return dict(graph, nodes=nodes, initializers=inits)
+
+
 def import_graph(graph):
     """Plain-dict ONNX graph → ``(sym, arg_params, aux_params)`` (reference
     ``import_onnx.py GraphProto.from_onnx``).  Wheel-free."""
-    return _import_graph_impl(graph)
+    return _import_graph_impl(_normalize_graph(graph))
 
 
 def _import_graph_impl(graph):
@@ -304,7 +632,9 @@ def _import_graph_impl(graph):
                 f"(node {n['name']})")
         mx_op, kw = conv(None, n["inputs"], n["attrs"], n["name"])
         ins = [tensors[x] for x in n["inputs"]]
-        if mx_op == "__batched_gather__":
+        if mx_op == "__lambda__":
+            out = kw(sym_mod, ins, inits, n["name"])
+        elif mx_op == "__batched_gather__":
             # GatherND carried (B,M,1) indices; the op wants (B,M)
             idx = sym_mod.squeeze(ins[1], axis=2)
             out = getattr(sym_mod, "_batched_gather")(ins[0], idx,
@@ -391,7 +721,43 @@ def proto_to_graph(model):
             "initializers": inits}
 
 
+def graph_from_bytes(data):
+    """Real ONNX ModelProto bytes (or a file path) → the importer's
+    plain-dict graph, via the hand-written wire-format parser
+    (:mod:`.protobuf`) — no wheel needed."""
+    from .protobuf import bytes_to_model, ONNX_TO_DTYPE
+
+    if isinstance(data, str):
+        with open(data, "rb") as f:
+            data = f.read()
+    model = bytes_to_model(data)
+    g = model["graph"]
+    inits = g["initializers"]
+    nodes = []
+    for n in g["nodes"]:
+        attrs = dict(n["attrs"])
+        if n["op_type"] == "Cast" and isinstance(attrs.get("to"), int):
+            attrs["to"] = ONNX_TO_DTYPE.get(attrs["to"], "float32")
+        nodes.append({"op_type": n["op_type"],
+                      "name": n["name"] or n["outputs"][0],
+                      "inputs": list(n["inputs"]),
+                      "outputs": list(n["outputs"]), "attrs": attrs,
+                      "domain": n.get("domain", "")})
+    inputs = []
+    for i in g["inputs"]:
+        if i["name"] in inits:
+            continue        # pre-IR4 models list initializers as inputs
+        shp = tuple(d if isinstance(d, int) else 0
+                    for d in (i["shape"] or ()))
+        inputs.append({"name": i["name"], "shape": shp,
+                       "dtype": i["dtype"] or "float32"})
+    return {"nodes": nodes, "inputs": inputs,
+            "outputs": [{"name": o["name"]} for o in g["outputs"]],
+            "initializers": inits}
+
+
 def import_model(model_file):
     """Reference ``onnx2mx/import_model.py:import_model`` — parses the
-    protobuf (wheel-gated) then runs the wheel-free dict importer."""
-    return _import_graph_impl(proto_to_graph(model_file))
+    ``.onnx`` protobuf with the wheel-free wire-format parser and runs the
+    dict importer."""
+    return import_graph(graph_from_bytes(model_file))

@@ -1,0 +1,69 @@
+"""Model-zoo family ONNX round-trips through REAL protobuf bytes.
+
+VERDICT r2 acceptance: every model_zoo family (mobilenet, densenet,
+squeezenet, inception, vgg — plus alexnet and resnet v2) must export to
+real ``.onnx`` bytes and import back with identical forward outputs.
+Reference flow: ``python/mxnet/contrib/onnx/mx2onnx/export_model.py`` on
+the zoo models.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import onnx as onnx_mod
+
+
+_CASES = [
+    ("squeezenet1.0", (1, 3, 224, 224)),
+    ("mobilenet0.25", (1, 3, 224, 224)),
+    ("mobilenetv2_0.25", (1, 3, 224, 224)),
+    ("densenet121", (1, 3, 224, 224)),
+    ("inceptionv3", (1, 3, 299, 299)),
+    ("vgg11", (1, 3, 224, 224)),
+    ("alexnet", (1, 3, 224, 224)),
+    ("resnet18_v2", (1, 3, 224, 224)),
+]
+
+
+def _load_checkpoint_params(prefix):
+    loaded = mx.nd.load(prefix + "-0000.params")
+    args, auxs = {}, {}
+    for k, v in loaded.items():
+        (args if k.startswith("arg:") else auxs)[k.split(":", 1)[1]] = v
+    return args, auxs
+
+
+def _outputs(sym, params, xv):
+    binds = dict(params)
+    binds["data"] = mx.nd.array(xv)
+    aux = {k: binds.pop(k) for k in list(binds)
+           if k in sym.list_auxiliary_states()}
+    args = {k: v for k, v in binds.items() if k in sym.list_arguments()}
+    ex = sym.bind(mx.cpu(), args, aux_states=aux)
+    return [o.asnumpy() for o in ex.forward()]
+
+
+@pytest.mark.parametrize("name,shape", _CASES, ids=[c[0] for c in _CASES])
+def test_model_zoo_roundtrip_real_bytes(name, shape, tmp_path):
+    rng = np.random.RandomState(0)
+    mx.random.seed(0)
+    net = mx.gluon.model_zoo.vision.get_model(name, classes=10)
+    net.initialize()
+    x = mx.nd.array(rng.rand(*shape).astype("float32"))
+    net.hybridize()
+    net(x)
+    prefix = str(tmp_path / name.replace(".", "_"))
+    net.export(prefix)
+    sym = mx.sym.load(prefix + "-symbol.json")
+    args, auxs = _load_checkpoint_params(prefix)
+    params = dict(args)
+    params.update(auxs)
+    want = _outputs(sym, params, x.asnumpy())[0]
+
+    path = str(tmp_path / (name.replace(".", "_") + ".onnx"))
+    onnx_mod.export_model(sym, params, shape, onnx_file_path=path)
+    import os
+    assert os.path.getsize(path) > 10000
+    sym2, arg2, aux2 = onnx_mod.import_model(path)
+    got = _outputs(sym2, {**arg2, **aux2}, x.asnumpy())[0]
+    np.testing.assert_allclose(want, got, rtol=1e-4, atol=1e-4)

@@ -1,0 +1,270 @@
+"""Real ONNX protobuf bytes — wire format, external validation, foreign
+input-form graphs.
+
+The reference's exporter writes ModelProto via the onnx wheel
+(``mx2onnx/export_model.py``); here the wire format is hand-written
+(``contrib/onnx/protobuf.py``) so ``export_model``/``import_model``
+produce/consume real ``.onnx`` bytes with no wheel.  External validation:
+``protoc --decode_raw`` (libprotoc) must parse the emitted bytes.
+"""
+import os
+import shutil
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import onnx as onnx_mod
+from mxnet_tpu.contrib.onnx import protobuf as pb
+
+
+def _tiny_convnet():
+    data = mx.sym.var("data")
+    w = mx.sym.var("conv_weight")
+    b = mx.sym.var("conv_bias")
+    c = mx.sym.Convolution(data, w, b, kernel=(3, 3), pad=(1, 1),
+                           num_filter=4, name="conv0")
+    a = mx.sym.relu(c, name="relu0")
+    p = mx.sym.Pooling(a, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       name="pool0")
+    f = mx.sym.Flatten(p, name="flat0")
+    fcw = mx.sym.var("fc_weight")
+    fcb = mx.sym.var("fc_bias")
+    return mx.sym.FullyConnected(f, fcw, fcb, num_hidden=10, name="fc0")
+
+
+def _tiny_params(rng):
+    return {
+        "conv_weight": mx.nd.array(rng.randn(4, 3, 3, 3).astype("float32")),
+        "conv_bias": mx.nd.array(rng.randn(4).astype("float32")),
+        "fc_weight": mx.nd.array(rng.randn(10, 4 * 4 * 4).astype("float32")),
+        "fc_bias": mx.nd.array(rng.randn(10).astype("float32")),
+    }
+
+
+def _forward(sym, params, x):
+    binds = dict(params)
+    free = [a for a in sym.list_arguments() if a not in binds]
+    assert len(free) == 1, free
+    binds[free[0]] = mx.nd.array(x)
+    ex = sym.bind(mx.cpu(), binds)
+    return ex.forward()[0].asnumpy()
+
+
+def test_export_import_through_real_bytes(tmp_path=None):
+    rng = np.random.RandomState(3)
+    sym = _tiny_convnet()
+    params = _tiny_params(rng)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    want = _forward(sym, params, x)
+
+    d = tempfile.mkdtemp(prefix="onnxbytes_")
+    try:
+        path = os.path.join(d, "tiny.onnx")
+        onnx_mod.export_model(sym, params, (2, 3, 8, 8),
+                              onnx_file_path=path)
+        assert os.path.getsize(path) > 500
+        sym2, arg2, aux2 = onnx_mod.import_model(path)
+        got = _forward(sym2, {**arg2, **aux2}, x)
+        np.testing.assert_allclose(want, got, rtol=1e-5, atol=1e-5)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def test_model_metadata_from_bytes():
+    rng = np.random.RandomState(3)
+    d = tempfile.mkdtemp(prefix="onnxmeta_")
+    try:
+        path = os.path.join(d, "tiny.onnx")
+        onnx_mod.export_model(_tiny_convnet(), _tiny_params(rng),
+                              (2, 3, 8, 8), onnx_file_path=path)
+        meta = onnx_mod.get_model_metadata(path)
+        assert meta["input_tensor_data"] == [("data", (2, 3, 8, 8))]
+        assert len(meta["output_tensor_data"]) == 1
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+@pytest.mark.skipif(shutil.which("protoc") is None,
+                    reason="protoc not in image")
+def test_emitted_bytes_parse_with_protoc():
+    """libprotoc is an independent wire-format implementation: it must
+    parse our bytes, and the raw field tree must carry the expected ONNX
+    schema positions (7=graph, 8=opset_import; in graph 1=node)."""
+    rng = np.random.RandomState(3)
+    d = tempfile.mkdtemp(prefix="onnxpc_")
+    try:
+        path = os.path.join(d, "tiny.onnx")
+        onnx_mod.export_model(_tiny_convnet(), _tiny_params(rng),
+                              (2, 3, 8, 8), onnx_file_path=path)
+        with open(path, "rb") as f:
+            out = subprocess.run(["protoc", "--decode_raw"], stdin=f,
+                                 capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        assert '4: "Conv"' in out.stdout        # NodeProto.op_type field 4
+        assert '4: "MaxPool"' in out.stdout
+        assert '4: "Gemm"' in out.stdout or '4: "MatMul"' in out.stdout
+        assert "7 {" in out.stdout              # ModelProto.graph field 7
+        assert "8 {" in out.stdout              # ModelProto.opset_import
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def test_opset_declared_17_and_inputform_slice_clip_unsqueeze():
+    """ADVICE r2 (medium): the emitted forms must be legal at the declared
+    opset.  Slice/Clip/Unsqueeze must be input-form, opset 17."""
+    data = mx.sym.var("data")
+    s = mx.sym.slice_axis(data, axis=1, begin=1, end=3, name="sl")
+    c = mx.sym.clip(s, a_min=-1.0, a_max=1.0, name="cl")
+    e = mx.sym.expand_dims(c, axis=0, name="ex")
+    g = onnx_mod.export_graph(e, {}, (2, 4))
+    ops = {n["op_type"]: n for n in g["nodes"]}
+    assert len(ops["Slice"]["inputs"]) == 4          # data+starts+ends+axes
+    assert "starts" not in ops["Slice"]["attrs"]
+    assert len(ops["Clip"]["inputs"]) == 3           # data+min+max
+    assert "min" not in ops["Clip"]["attrs"]
+    assert len(ops["Unsqueeze"]["inputs"]) == 2      # data+axes
+    assert "axes" not in ops["Unsqueeze"]["attrs"]
+    m = pb.bytes_to_model(onnx_mod.graph_to_bytes(g))
+    assert m["opset"] == 17
+
+    # and the round-trip back through real bytes stays exact
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4).astype("float32")
+    want = _forward(e, {}, x)
+    sym2, arg2, aux2 = onnx_mod.import_graph(
+        onnx_mod.graph_from_bytes(onnx_mod.graph_to_bytes(g)))
+    got = _forward(sym2, {**arg2, **aux2}, x)
+    np.testing.assert_allclose(want, got, rtol=1e-6)
+
+
+def _foreign_model(nodes, inputs, outputs, initializers):
+    """Build .onnx bytes the way a foreign exporter would (input-form)."""
+    return pb.model_to_bytes({"nodes": nodes, "inputs": inputs,
+                              "outputs": outputs,
+                              "initializers": initializers})
+
+
+def test_foreign_inputform_unsqueeze_pad_reducesum():
+    """Foreign opset>=13 graphs carry axes/pads as constant inputs — the
+    importer must resolve them (ADVICE r2: no silent axis-0 default)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3).astype("float32")
+    data = _foreign_model(
+        nodes=[
+            {"op_type": "Unsqueeze", "name": "u", "inputs": ["x", "u_ax"],
+             "outputs": ["ux"], "attrs": {}},
+            {"op_type": "Pad", "name": "p", "inputs": ["ux", "p_pads"],
+             "outputs": ["px"], "attrs": {"mode": "constant"}},
+            {"op_type": "ReduceSum", "name": "r",
+             "inputs": ["px", "r_ax"], "outputs": ["y"],
+             "attrs": {"keepdims": 0}},
+        ],
+        inputs=[{"name": "x", "dtype": "float32", "shape": (2, 3)}],
+        outputs=[{"name": "y"}],
+        initializers={
+            "u_ax": np.asarray([1], dtype=np.int64),
+            "p_pads": np.asarray([0, 1, 0, 0, 0, 1], dtype=np.int64),
+            "r_ax": np.asarray([2], dtype=np.int64),
+        })
+    sym, arg, aux = onnx_mod.import_graph(onnx_mod.graph_from_bytes(data))
+    got = _forward(sym, {**arg, **aux}, x)
+    want = np.pad(x[:, None, :], ((0, 0), (1, 0), (0, 1))).sum(axis=2)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_foreign_inputform_slice_clip():
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 6).astype("float32")
+    data = _foreign_model(
+        nodes=[
+            {"op_type": "Slice", "name": "s",
+             "inputs": ["x", "st", "en", "ax"], "outputs": ["sx"],
+             "attrs": {}},
+            {"op_type": "Clip", "name": "c",
+             "inputs": ["sx", "mn", "mx"], "outputs": ["y"], "attrs": {}},
+        ],
+        inputs=[{"name": "x", "dtype": "float32", "shape": (3, 6)}],
+        outputs=[{"name": "y"}],
+        initializers={
+            "st": np.asarray([1], dtype=np.int64),
+            "en": np.asarray([5], dtype=np.int64),
+            "ax": np.asarray([1], dtype=np.int64),
+            "mn": np.asarray(-0.5, dtype=np.float32),
+            "mx": np.asarray(0.5, dtype=np.float32),
+        })
+    sym, arg, aux = onnx_mod.import_graph(onnx_mod.graph_from_bytes(data))
+    got = _forward(sym, {**arg, **aux}, x)
+    np.testing.assert_allclose(got, np.clip(x[:, 1:5], -0.5, 0.5), rtol=1e-6)
+
+
+def test_foreign_constant_node_becomes_initializer():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3).astype("float32")
+    cval = rng.randn(2, 3).astype("float32")
+    data = _foreign_model(
+        nodes=[
+            {"op_type": "Constant", "name": "k", "inputs": [],
+             "outputs": ["kc"], "attrs": {"value": cval}},
+            {"op_type": "Add", "name": "a", "inputs": ["x", "kc"],
+             "outputs": ["y"], "attrs": {}},
+        ],
+        inputs=[{"name": "x", "dtype": "float32", "shape": (2, 3)}],
+        outputs=[{"name": "y"}], initializers={})
+    sym, arg, aux = onnx_mod.import_graph(onnx_mod.graph_from_bytes(data))
+    got = _forward(sym, {**arg, **aux}, x)
+    np.testing.assert_allclose(got, x + cval, rtol=1e-6)
+
+
+def test_dynamic_inputform_fails_loudly():
+    """Axes coming from a computed tensor (not an initializer) must raise,
+    never default."""
+    data = _foreign_model(
+        nodes=[
+            {"op_type": "Shape", "name": "sh", "inputs": ["x"],
+             "outputs": ["dyn"], "attrs": {}},
+            {"op_type": "Unsqueeze", "name": "u", "inputs": ["x", "dyn"],
+             "outputs": ["y"], "attrs": {}},
+        ],
+        inputs=[{"name": "x", "dtype": "float32", "shape": (2, 3)}],
+        outputs=[{"name": "y"}], initializers={})
+    with pytest.raises(NotImplementedError, match="dynamic"):
+        onnx_mod.import_graph(onnx_mod.graph_from_bytes(data))
+
+
+def test_wire_format_all_dtypes_roundtrip():
+    rng = np.random.RandomState(4)
+    inits = {}
+    for dt in ("float32", "float64", "float16", "int32", "int64", "uint8",
+               "int8", "bool"):
+        a = (rng.rand(3, 2) * 4).astype(dt)
+        inits[f"t_{dt}"] = a
+    data = pb.model_to_bytes({"nodes": [], "inputs": [], "outputs": [],
+                              "initializers": inits})
+    g = pb.bytes_to_model(data)["graph"]
+    for k, v in inits.items():
+        np.testing.assert_array_equal(g["initializers"][k], v)
+        assert g["initializers"][k].dtype == v.dtype
+
+
+def test_golden_bytes_fixture_stable():
+    """Schema pin: the serialized form of a fixed graph must stay
+    byte-identical (field numbers / ordering / varint encoding)."""
+    g = {"nodes": [{"op_type": "Relu", "name": "r", "inputs": ["x"],
+                    "outputs": ["y"], "attrs": {}}],
+         "inputs": [{"name": "x", "dtype": "float32", "shape": (1, 2)}],
+         "outputs": [{"name": "y"}],
+         "initializers": {"w": np.asarray([1.0, 2.0], dtype=np.float32)}}
+    data = pb.model_to_bytes(g)
+    fixture = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "golden_tiny.onnx")
+    if not os.path.exists(fixture):
+        os.makedirs(os.path.dirname(fixture), exist_ok=True)
+        with open(fixture, "wb") as f:
+            f.write(data)
+    with open(fixture, "rb") as f:
+        assert f.read() == data, (
+            "ONNX wire emission changed for an identical graph — if "
+            "intentional, regenerate tests/fixtures/golden_tiny.onnx")
