@@ -118,12 +118,20 @@ class KVStore:
         """Initialize key(s) with value(s) (reference ``kvstore.py:116``)."""
         keys, vals = _group_kv(key, value)
         self._check_keys(keys)
+        from .ndarray.sparse import RowSparseNDArray
         for k, vs in zip(keys, vals):
             if k in self._store:
                 raise ValueError(f"duplicate init of key {k}")
-            self._store[k] = vs[0].copy()
+            v = vs[0]
+            if "dist" in self._type and isinstance(v, RowSparseNDArray):
+                # the reference's servers store row-sparse keys dense
+                # (kvstore_dist_server.h): cross-worker pushes carry
+                # different row sets, so the replicated store is dense and
+                # row_sparse_pull gathers rows from it
+                v = v.tostype("default")
+            self._store[k] = v.copy()
 
-    def _reduce(self, vs):
+    def _local_reduce(self, vs):
         """Sum per-device values into one array on the first value's device —
         the ``CommDevice::Reduce`` role (``src/kvstore/comm.h:451``)."""
         merged = vs[0]
@@ -133,15 +141,19 @@ class KVStore:
             for v in vs[1:]:
                 acc += v.as_in_context(dev)
             merged = acc
-        if "dist" in self._type and self.num_workers > 1:
-            merged = self._global_allreduce(merged)
         return merged
 
     def _global_allreduce(self, arr):
         """Cross-process sum over all workers (replaces ps-lite ZPush/ZPull +
-        server aggregation, ``kvstore_dist_server.h:346-358``)."""
+        server aggregation, ``kvstore_dist_server.h:346-358``).  Row-sparse
+        gradients densify for the collective: workers hold different nnz so
+        a ragged allgather does not exist; the reference ships row subsets
+        to the sharded servers instead — same aggregate, different wire."""
         import jax.numpy as jnp
         from jax.experimental import multihost_utils
+        from .ndarray.sparse import RowSparseNDArray
+        if isinstance(arr, RowSparseNDArray):
+            arr = arr.tostype("default")
         summed = multihost_utils.process_allgather(arr._data)
         return NDArray(jnp.asarray(summed).sum(axis=0))
 
@@ -158,10 +170,16 @@ class KVStore:
         for k, vs in zip(keys, vals):
             if k not in self._store:
                 raise ValueError(f"key {k} has not been initialized")
-            merged = self._reduce(vs)
+            # reference order (kvstore_dist.h): local devices reduce densely
+            # FIRST, the worker's aggregated gradient is quantized with its
+            # own residual, and only the quantized values cross workers —
+            # the server sums already-compressed gradients.
+            merged = self._local_reduce(vs)
             if self._compression_params is not None and \
                     self._compression_params.get("type") == "2bit":
                 merged = self._compress(k, merged)
+            if "dist" in self._type and self.num_workers > 1:
+                merged = self._global_allreduce(merged)
             stored = self._store[k]
             if self._updater is not None:
                 self._updater(k, merged, stored)

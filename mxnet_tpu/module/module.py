@@ -275,7 +275,15 @@ class Module(BaseModule):
         if kvstore:
             kv = kvstore if isinstance(kvstore, KVStore) else kv_create(kvstore)
         self._kvstore = kv
-        self._update_on_kvstore = bool(kv) and "dist" not in (kv.type if kv else "")
+        # reference module.py:480 _create_kvstore: update_on_kvstore defaults
+        # True (server-side update) for local AND dist stores; here the
+        # "server" state is each worker's replica of the store, which stays
+        # identical because push() applies the updater to the globally
+        # allreduced gradient on every worker.  MXNET_UPDATE_ON_KVSTORE=0
+        # opts out like the reference env knob.
+        import os as _os
+        self._update_on_kvstore = bool(kv) and \
+            _os.environ.get("MXNET_UPDATE_ON_KVSTORE", "1") != "0"
         self._updater = opt.get_updater(optimizer)
         if kv:
             # under multi-context dp the kvstore's weight/state copies must

@@ -1,0 +1,242 @@
+"""4-worker dist_sync exact-value matrix (port of the reference nightly
+``tests/nightly/dist_sync_kvstore.py:16-55`` semantics): dense + row_sparse
+push/pull, fp16 keys, server-side optimizer, 2-bit gradient compression with
+error feedback — all over real multi-process ``jax.distributed``, launched
+via tools/launch.py like the reference's own launcher flow.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+    num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+    process_id=int(os.environ["JAX_PROCESS_ID"]))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+SHAPE = (2, 3)
+IRREGULAR = (121, 121)
+BIG = (120, 120)
+RATE = 2.0
+
+
+class TestOptimizer(opt.Optimizer):
+    """The reference nightly's 'test' optimizer: w += rescale_grad * grad
+    (``mxnet/test_utils.py`` via ``mx.optimizer.create('test', ...)``)."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad
+
+
+def check_diff(arr, expect, rank, msg=""):
+    a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
+    e = expect.asnumpy() if hasattr(expect, "asnumpy") else np.asarray(expect)
+    assert np.sum(np.abs(a - e)) == 0, (rank, msg, a, e)
+
+
+def test_dense(kv, rank, nw, nrepeat=3):
+    for dtype in ("float32", "float16"):
+        keys = ["3", "5"] if dtype == "float32" else ["4", "6"]
+        shapes = [SHAPE, BIG]
+        for k, s in zip(keys, shapes):
+            kv.init(k, mx.nd.ones(s, dtype=dtype))
+            for i in range(nrepeat):
+                kv.push(k, mx.nd.ones(s, dtype=dtype) * (rank + 1))
+                # server optimizer: w += rate * sum_r (r+1) each repeat
+                num = (nw + 1) * nw * RATE / 2 * (i + 1) + 1
+                val = mx.nd.zeros(s, dtype=dtype)
+                kv.pull(k, out=val)
+                check_diff(val, np.full(s, num, dtype), rank,
+                           f"dense {dtype} {k}")
+    print(f"DENSE_OK rank={rank}")
+
+
+def test_row_sparse(kv, rank, nw, nrepeat=3):
+    for dtype in ("float32", "float16"):
+        k = "9" if dtype == "float32" else "10"
+        kv.init(k, mx.nd.ones(SHAPE, dtype=dtype).tostype("row_sparse"))
+        v = np.zeros(SHAPE, dtype)
+        my_row = rank % SHAPE[0]
+        v[my_row] = rank + 1
+        for i in range(nrepeat):
+            kv.push(k, mx.nd.array(v).tostype("row_sparse"))
+            rng = np.random.RandomState(42 + rank + i)
+            row_ids_np = rng.randint(SHAPE[0], size=SHAPE[0])
+            val = mx.nd.sparse.zeros("row_sparse", SHAPE, dtype=dtype)
+            kv.row_sparse_pull(k, out=val,
+                               row_ids=mx.nd.array(row_ids_np))
+            updated = np.ones(SHAPE, dtype)
+            for r in range(nw):
+                updated[r % SHAPE[0]] += (r + 1) * RATE * (i + 1)
+            expected = np.zeros(SHAPE, dtype)
+            for row in row_ids_np:
+                expected[row] = updated[row]
+            check_diff(val.tostype("default"), expected, rank,
+                       f"rsp {dtype}")
+    print(f"RSP_OK rank={rank}")
+
+
+def test_row_sparse_zeros(kv, rank, nw):
+    for dtype in ("float32", "float16"):
+        k = "11" if dtype == "float32" else "12"
+        kv.init(k, mx.nd.ones(BIG, dtype=dtype).tostype("row_sparse"))
+        v = mx.nd.sparse.zeros("row_sparse", BIG, dtype=dtype)
+        kv.push(k, v)
+        val = mx.nd.sparse.zeros("row_sparse", BIG, dtype=dtype)
+        kv.row_sparse_pull(k, out=val,
+                           row_ids=mx.nd.array(np.arange(BIG[0])))
+        check_diff(val.tostype("default"), np.ones(BIG, dtype), rank,
+                   "rsp zeros full")
+        kv.row_sparse_pull(k, out=val, row_ids=mx.nd.array([]))
+        check_diff(val.tostype("default"), np.zeros(BIG, dtype), rank,
+                   "rsp zeros empty")
+    print(f"RSP_ZEROS_OK rank={rank}")
+
+
+def test_big_row_sparse(kv, rank, nw, nrepeat=2):
+    k = "97"
+    kv.init(k, mx.nd.ones(IRREGULAR).tostype("row_sparse"))
+    rng = np.random.RandomState(123)
+    density = 0.3
+    indices = np.argwhere(rng.rand(IRREGULAR[0]) < density).flatten()
+    update_rows = []
+    for r in range(nw):
+        step = (r + 1) * 2
+        update_rows.append(np.asarray(indices[::step]))
+    v = np.zeros(IRREGULAR, "float32")
+    for row in update_rows[rank]:
+        v[row] = rank + 1
+    for i in range(nrepeat):
+        kv.push(k, mx.nd.array(v).tostype("row_sparse"))
+        rng2 = np.random.RandomState(rank + 7 * i)
+        row_ids_np = rng2.randint(IRREGULAR[0], size=IRREGULAR[0])
+        val = mx.nd.sparse.zeros("row_sparse", IRREGULAR)
+        kv.row_sparse_pull(k, out=val, row_ids=mx.nd.array(row_ids_np))
+        updated = np.ones(IRREGULAR, "float32")
+        for r in range(nw):
+            for row in update_rows[r]:
+                updated[row] += (r + 1) * RATE * (i + 1)
+        expected = np.zeros(IRREGULAR, "float32")
+        for row in row_ids_np:
+            expected[row] = updated[row]
+        check_diff(val.tostype("default"), expected, rank, "big rsp")
+    print(f"BIG_RSP_OK rank={rank}")
+
+
+def test_2bit_compression(kv, rank, nw):
+    threshold = 0.5
+    kv.set_gradient_compression({"type": "2bit", "threshold": threshold})
+    for k, s in [("1000", SHAPE), ("1200", IRREGULAR), ("1300", BIG)]:
+        kv.init(k, mx.nd.zeros(s))
+        # below threshold: residual only, no update
+        kv.push(k, mx.nd.ones(s) * 0.4)
+        val = mx.nd.zeros(s)
+        kv.pull(k, out=val)
+        check_diff(val, np.zeros(s, "float32"), rank, "compr below")
+        # residual tops it over the threshold on every worker
+        kv.push(k, mx.nd.ones(s) * (threshold - 0.4))
+        kv.pull(k, out=val)
+        curval = threshold * RATE * nw
+        check_diff(val, np.full(s, curval, "float32"), rank, "compr meet")
+        # below again
+        kv.push(k, mx.nd.ones(s) * 0.2)
+        kv.pull(k, out=val)
+        check_diff(val, np.full(s, curval, "float32"), rank, "compr below2")
+        # exceeds with residual
+        kv.push(k, mx.nd.ones(s) * (threshold - 0.2))
+        kv.pull(k, out=val)
+        curval += threshold * RATE * nw
+        check_diff(val, np.full(s, curval, "float32"), rank, "compr meet2")
+    # inactive keys: init after compression, never pushed — stay at init
+    for k, s in [("1001", SHAPE), ("1301", BIG)]:
+        kv.init(k, mx.nd.ones(s))
+        val = mx.nd.zeros(s)
+        kv.pull(k, out=val)
+        check_diff(val, np.ones(s, "float32"), rank, "compr inactive")
+    # random gradients, same on every worker: expected = quantize chain
+    rng = np.random.RandomState(9)
+    g1 = rng.uniform(-1, 1, SHAPE).astype("float32")
+    g2 = rng.uniform(-1, 1, SHAPE).astype("float32")
+    kv.init("1002", mx.nd.zeros(SHAPE))
+    w_expect = np.zeros(SHAPE, "float32")
+    residual = np.zeros(SHAPE, "float32")
+    for g in (g1, g2):
+        kv.push("1002", mx.nd.array(g))
+        acc = residual + g
+        q = np.where(acc >= threshold, threshold,
+                     np.where(acc <= -threshold, -threshold, 0.0)
+                     ).astype("float32")
+        residual = acc - q
+        w_expect += RATE * nw * q
+        val = mx.nd.zeros(SHAPE)
+        kv.pull("1002", out=val)
+        check_diff(val, w_expect, rank, "compr random")
+    print(f"COMPR_OK rank={rank}")
+
+
+def test_dist_lenet(rank, nw):
+    """dist_lenet-style convergence (reference
+    ``tests/nightly/dist_lenet.py``): a small conv net via Module.fit over
+    dist_sync; weights must stay identical across workers and learn."""
+    kv = mx.kv.create("dist_sync")
+    mx.random.seed(7)
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, name="c1")
+    a1 = mx.sym.Activation(c1, act_type="relu")
+    p1 = mx.sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f1 = mx.sym.Flatten(p1)
+    fc = mx.sym.FullyConnected(f1, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+
+    rng = np.random.RandomState(1000 + rank)
+    n = 64
+    y = rng.randint(0, 4, n).astype("float32")
+    x = np.zeros((n, 1, 12, 12), "float32")
+    for j in range(n):
+        q = int(y[j])
+        x[j, 0, (q // 2) * 6:(q // 2) * 6 + 6,
+          (q % 2) * 6:(q % 2) * 6 + 6] = 1.0
+    x += rng.randn(*x.shape).astype("float32") * 0.05
+    it = mx.io.NDArrayIter(x, y, batch_size=16)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=4, kvstore=kv, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3})
+    acc = mod.score(mx.io.NDArrayIter(x, y, batch_size=16), "acc")[0][1]
+    w = mod.get_params()[0]["fc_weight"].asnumpy()
+    from jax.experimental import multihost_utils
+    allw = np.asarray(multihost_utils.process_allgather(w))
+    for r in range(nw):
+        assert np.allclose(allw[r], w, atol=1e-5), \
+            f"rank {rank}: lenet weights diverged from rank {r}"
+    assert acc > 0.9, acc
+    print(f"LENET_OK rank={rank} acc={acc:.3f}")
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == int(os.environ["JAX_NUM_PROCESSES"])
+    kv.set_optimizer(TestOptimizer(rescale_grad=RATE))
+    test_dense(kv, rank, nw)
+    test_row_sparse(kv, rank, nw)
+    test_row_sparse_zeros(kv, rank, nw)
+    test_big_row_sparse(kv, rank, nw)
+    test_2bit_compression(kv, rank, nw)
+    kv.barrier()
+    test_dist_lenet(rank, nw)
+    print(f"MATRIX_OK rank={rank}")
+
+
+if __name__ == "__main__":
+    main()

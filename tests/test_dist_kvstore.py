@@ -23,3 +23,23 @@ def test_dist_sync_kvstore_two_workers():
     assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
     assert out.stdout.count("WORKER_OK") == 2, out.stdout
     assert out.stdout.count("MODULE_DIST_OK") == 2, out.stdout
+
+
+def test_dist_sync_matrix_four_workers():
+    """The reference nightly matrix (dist_sync_kvstore.py): dense+row_sparse
+    push/pull, fp16 keys, server-side optimizer, 2-bit compression with
+    error feedback, and a dist_lenet-style convergence run — 4 workers."""
+    root = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # one device per worker process
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "launch.py"),
+         "-n", "4", "--port", "29741",
+         sys.executable, os.path.join(root, "tests",
+                                      "dist_matrix_worker.py")],
+        capture_output=True, text=True, timeout=560, env=env)
+    assert out.returncode == 0, (out.stdout[-3000:], out.stderr[-2000:])
+    for marker in ("DENSE_OK", "RSP_OK", "RSP_ZEROS_OK", "BIG_RSP_OK",
+                   "COMPR_OK", "LENET_OK", "MATRIX_OK"):
+        assert out.stdout.count(marker) >= 4, (marker, out.stdout[-3000:])
