@@ -162,7 +162,11 @@ class Executor:
                     dat = jax.device_put(dat, buf_dev)
             self.arg_dict[k]._data = dat
         run = self._compiled_fwd(is_train)
-        outs, aux_updates = run(self._env(), _rnd.next_key())
+        # capture the key: backward's fused fwd+bwd recompute must replay
+        # EXACTLY this forward's stream even if other eager stochastic ops
+        # run in between (ADVICE r2: current_key() re-query could desync)
+        self._fwd_key = _rnd.next_key()
+        outs, aux_updates = run(self._env(), self._fwd_key)
         if is_train:
             for k, v in aux_updates.items():
                 self.aux_dict[k]._data = v
@@ -193,7 +197,10 @@ class Executor:
             # output cotangents carry the batch axis: shard them like data
             out_grads = [self._place("", g, batch=True) for g in out_grads]
         run = self._compiled_fwdbwd()
-        outs, aux_updates, grads = run(self._env(), _rnd.current_key(), out_grads)
+        key = getattr(self, "_fwd_key", None)
+        if key is None:
+            key = _rnd.current_key()
+        outs, aux_updates, grads = run(self._env(), key, out_grads)
         for name, g in grads.items():
             buf = self.grad_dict.get(name)
             if buf is None:

@@ -57,7 +57,9 @@ class key_scope:
         stack = getattr(_tls, "stack", None)
         if stack is None:
             stack = _tls.stack = []
-        stack.append(self._key)
+        # frame-local "last" so current_key() inside a traced scope sees the
+        # traced stream — and the tracer can never leak past __exit__
+        stack.append({"key": self._key, "last": None})
         return self
 
     def __exit__(self, *a):
@@ -70,7 +72,9 @@ def next_key():
     stack = getattr(_tls, "stack", None)
     if stack:
         # traced scope: splits are recorded into the trace, not dispatched
-        stack[-1], sub = jax.random.split(stack[-1])
+        frame = stack[-1]
+        frame["key"], sub = jax.random.split(frame["key"])
+        frame["last"] = sub
         return sub
     with _lock:
         if _pool["keys"] is None or _pool["i"] >= _POOL:
@@ -88,12 +92,22 @@ def next_key():
 
 def current_key():
     """The most recently issued key — consumers that *re-run* the last
-    stochastic computation (executor.backward's fused fwd+bwd recompute)
-    must see the same stream the forward drew, and it must differ draw to
-    draw (the pool no longer advances ``_key[0]`` per draw)."""
-    if _pool["last"] is not None:
-        return _pool["last"]
-    return _key[0]
+    stochastic computation must see the same stream the forward drew, and
+    it must differ draw to draw (the pool no longer advances ``_key[0]``
+    per draw).  Inside a traced ``key_scope`` the scope's own last split is
+    returned (a tracer — valid only within that trace); eager state is
+    read under the pool lock.  NOTE: the executor captures its forward key
+    explicitly (``executor.py``) rather than re-querying here, so an eager
+    stochastic op between its forward and backward cannot desync the
+    fwd/bwd pairing."""
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        frame = stack[-1]
+        return frame["last"] if frame["last"] is not None else frame["key"]
+    with _lock:
+        if _pool["last"] is not None:
+            return _pool["last"]
+        return _key[0]
 
 
 # The user-facing sampling functions (mx.random.uniform etc.) are installed by

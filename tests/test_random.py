@@ -1,0 +1,54 @@
+"""Random-stream contracts (reference ``tests/python/unittest/test_random.py``
+seed/determinism family; the statistical tranche lives in
+``test_random_statistics.py``)."""
+import jax
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import random as _rnd
+
+
+def test_backward_key_pairing_survives_interleaved_eager_draw():
+    """ADVICE r2: an eager stochastic op between an executor forward and
+    its backward must not change the backward's recompute stream — the
+    executor captures its forward key instead of re-querying."""
+    mx.random.seed(77)
+    data = mx.sym.var("data")
+    d = mx.sym.Dropout(data, p=0.5, name="do")
+    loss = mx.sym.MakeLoss(mx.sym.sum(d))
+    x = mx.nd.ones((64,))
+    ex = loss.bind(mx.cpu(), {"data": x},
+                   args_grad={"data": mx.nd.zeros((64,))})
+    out1 = ex.forward(is_train=True)[0].asnumpy()
+    # interleaved eager draw advances the global stream
+    _ = mx.nd.random.uniform(shape=(4,))
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    scale = 1.0 / 0.5
+    kept = np.isclose(g, scale)
+    dropped = np.isclose(g, 0.0)
+    assert (kept | dropped).all()
+    # backward replayed the SAME dropout mask the forward drew: the kept
+    # count (scaled) reproduces the forward's sum exactly
+    assert kept.sum() * scale == pytest.approx(float(out1), rel=1e-6)
+
+
+def test_current_key_inside_traced_scope_is_scope_local():
+    """current_key() inside a key_scope returns the scope's stream (and
+    never leaks a tracer into the global eager state)."""
+    mx.random.seed(3)
+    k_eager_before = _rnd.current_key()
+    seen = {}
+
+    def f(key):
+        with _rnd.key_scope(key):
+            a = _rnd.next_key()
+            seen["in_scope_last"] = _rnd.current_key() is a
+        return jax.random.uniform(a)
+
+    jax.jit(f)(jax.random.PRNGKey(0))
+    assert seen["in_scope_last"]
+    # global eager "last" untouched by the traced scope
+    after = _rnd.current_key()
+    assert np.array_equal(np.asarray(after), np.asarray(k_eager_before))
