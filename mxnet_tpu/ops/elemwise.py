@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 
 from ..base import np_dtype, parse_bool, parse_float
 from .registry import register
@@ -278,9 +279,19 @@ _scalar("smooth_l1", lambda x, s: jnp.where(jnp.abs(x) < 1.0 / (s * s),
 @register("cast", aliases=("Cast", "amp_cast"))
 def cast(x, dtype="float32"):
     """Reference ``Cast`` (elemwise_unary_op_basic.cc) and ``amp_cast``
-    (src/operator/tensor/amp_cast.cc)."""
+    (src/operator/tensor/amp_cast.cc).
+
+    int64/uint64 casts run as int32/uint32 — the documented PARITY scope
+    decision for this x64-disabled TPU build (the mapping is explicit here
+    so it is policy, not a silent jax truncation warning).
+    """
     from ..base import np_dtype
-    return x.astype(np_dtype(dtype))
+    dt = _np.dtype(np_dtype(dtype))
+    if dt == _np.int64:
+        dt = _np.dtype(_np.int32)
+    elif dt == _np.uint64:
+        dt = _np.dtype(_np.uint32)
+    return x.astype(dt)
 
 
 @register("amp_multicast", wrap_list=True)

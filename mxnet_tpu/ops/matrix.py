@@ -475,11 +475,28 @@ def histogram(data, bins=None, bin_cnt=None, range=None):
     return hist, edges
 
 
+def _check_flat_size_fits_int32(shp, op):
+    """int64 index contract (PARITY scope decision): this build runs with
+    x64 disabled — flat indices are int32.  Where the reference's int64
+    build would be REQUIRED for correctness (>2^31-1 flat elements,
+    tests/nightly/test_large_array.py), fail loudly instead of silently
+    wrapping."""
+    n = 1
+    for s in shp:
+        n *= int(s)
+    if n > 2**31 - 1:
+        raise NotImplementedError(
+            f"{op}: flat size {n} exceeds int32; the int64 large-tensor "
+            "build is a documented scope-out on this TPU build "
+            "(PARITY.md 'Scope decisions')")
+
+
 @register("ravel_multi_index", aliases=("_ravel_multi_index",))
 def ravel_multi_index(data, shape=None):
     shp = parse_tuple(shape)
-    idx = data.astype(jnp.int64)
-    out = jnp.zeros(idx.shape[1:], jnp.int64)
+    _check_flat_size_fits_int32(shp, "ravel_multi_index")
+    idx = data.astype(jnp.int32)
+    out = jnp.zeros(idx.shape[1:], jnp.int32)
     for i, s in enumerate(shp):
         out = out * s + idx[i]
     return out.astype(data.dtype)
@@ -488,7 +505,8 @@ def ravel_multi_index(data, shape=None):
 @register("unravel_index", aliases=("_unravel_index",))
 def unravel_index(data, shape=None):
     shp = parse_tuple(shape)
-    idx = data.astype(jnp.int64)
+    _check_flat_size_fits_int32(shp, "unravel_index")
+    idx = data.astype(jnp.int32)
     outs = []
     rem = idx
     for s in reversed(shp):
