@@ -407,21 +407,41 @@ def _bench_input_pipeline_impl(_os, jax, mx, recordio, tmpdir, n_img, hw,
 
     batch = 32
     threads = _os.cpu_count() or 8
-    it = mx.io.ImageRecordIter(
-        path_imgrec=rec_path, data_shape=(3, hw, hw), batch_size=batch,
-        rand_mirror=True, preprocess_threads=threads)
-    # warm epoch (thread pool spin-up, file cache)
-    for b in it:
-        pass
-    # host pipeline: record read → JPEG decode → augment → batch
-    it.reset()
-    t0 = time.perf_counter()
-    n = 0
-    last = None
-    for b in it:
-        last = b.data[0]
-        n += batch
-    host_dt = time.perf_counter() - t0
+
+    def epoch_rate(n_threads):
+        it = mx.io.ImageRecordIter(
+            path_imgrec=rec_path, data_shape=(3, hw, hw), batch_size=batch,
+            rand_mirror=True, preprocess_threads=n_threads)
+        for b in it:           # warm epoch (thread spin-up, file cache)
+            pass
+        it.reset()
+        t0 = time.perf_counter()
+        n, last = 0, None
+        for b in it:
+            last = b.data[0]
+            n += batch
+        return n / (time.perf_counter() - t0), n, last
+
+    from mxnet_tpu import _native
+    # measured thread-scaling curve (native libjpeg path when available)
+    sweep = {}
+    rate = n = last = None
+    for t in sorted({1, 2, max(1, threads)}):
+        sweep[t], tn, tlast = epoch_rate(t)
+        if t == max(1, threads):
+            rate, n, last = sweep[t], tn, tlast
+    # the cv2 Python reference path, for the native-vs-fallback ratio
+    cv2_rate = None
+    if _native.decode_available():
+        orig = _native.decode_available
+        _native.decode_available = lambda: False
+        try:
+            cv2_rate, _, _ = epoch_rate(threads)
+        except ImportError:
+            cv2_rate = None         # no opencv: native is the only decoder
+        finally:
+            _native.decode_available = orig
+    host_dt = n / rate
     # device transfer, reported separately: a full upload+readback loop
     # (the readback is the only sync a remoted transport cannot fake), so
     # the figure counts the batch's bytes ONCE over a round trip — a lower
@@ -432,13 +452,20 @@ def _bench_input_pipeline_impl(_os, jax, mx, recordio, tmpdir, n_img, hw,
     np.asarray(dev)
     stage_dt = time.perf_counter() - t0
     mb = arr.nbytes / 1e6
-    return {"items_per_sec": round(n / host_dt, 2), "images": n,
+    return {"items_per_sec": round(rate, 2), "images": n,
+            "decoder": "native_libjpeg" if _native.decode_available()
+            else "cv2_python",
             "decode_threads": threads,
             "per_image_ms": round(host_dt / n * 1e3, 3),
             "includes": "read+jpeg_decode+augment+batch (host)",
+            "thread_sweep_img_per_sec": {str(k): round(v, 1)
+                                         for k, v in sweep.items()},
+            "cv2_fallback_img_per_sec": round(cv2_rate, 2)
+            if cv2_rate else None,
+            "native_vs_cv2": round(rate / cv2_rate, 2) if cv2_rate
+            else None,
             "device_roundtrip_mb_per_sec": round(mb / stage_dt, 1),
-            "note": "host pipeline scales ~linearly with cores; this "
-                    f"machine has {threads}"}
+            "cores": threads}
 
 
 def main():

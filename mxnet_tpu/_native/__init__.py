@@ -20,6 +20,7 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "..", "..", "src", "io", "recordio_reader.cc")
+_SRC_JPEG = os.path.join(_DIR, "..", "..", "src", "io", "jpeg_decode.cc")
 _LIB_PATH = os.path.join(_DIR, "libmxnet_tpu_io.so")
 _lock = threading.Lock()
 _lib = None
@@ -27,6 +28,18 @@ _tried = False
 
 
 def _build():
+    # jpeg_decode.cc needs libjpeg; try with it first, fall back to the
+    # reader-only library when the dev package is absent (decode then uses
+    # the cv2 Python path)
+    if os.path.exists(_SRC_JPEG):
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               os.path.abspath(_SRC), os.path.abspath(_SRC_JPEG),
+               "-o", _LIB_PATH, "-ljpeg"]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True)
+            return
+        except subprocess.CalledProcessError:
+            pass
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
            os.path.abspath(_SRC), "-o", _LIB_PATH]
     subprocess.run(cmd, check=True, capture_output=True)
@@ -40,8 +53,11 @@ def load():
             return _lib
         _tried = True
         try:
+            srcs = [_SRC] + ([_SRC_JPEG] if os.path.exists(_SRC_JPEG)
+                             else [])
+            newest_src = max(os.path.getmtime(p) for p in srcs)
             if not os.path.exists(_LIB_PATH) or \
-                    os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
+                    os.path.getmtime(_LIB_PATH) < newest_src:
                 _build()
             lib = ctypes.CDLL(_LIB_PATH)
             lib.rio_build_index.restype = ctypes.c_int64
@@ -58,6 +74,18 @@ def load():
                 ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
                 ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8),
                 ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)]
+            if hasattr(lib, "jpg_decode_batch"):
+                lib.jpg_decode_batch.restype = ctypes.c_int64
+                lib.jpg_decode_batch.argtypes = [
+                    ctypes.POINTER(ctypes.c_uint8),
+                    ctypes.POINTER(ctypes.c_uint64),
+                    ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+                    ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                    ctypes.POINTER(ctypes.c_float),
+                    ctypes.POINTER(ctypes.c_uint8),
+                    ctypes.POINTER(ctypes.c_float),
+                    ctypes.POINTER(ctypes.c_float), ctypes.c_float,
+                    ctypes.c_int, ctypes.POINTER(ctypes.c_float)]
             _lib = lib
         except Exception:
             _lib = None
@@ -131,3 +159,56 @@ def read_batch(path, offsets, lengths):
         recs.append(out[pos:pos + ln].tobytes())
         pos += ln
     return recs
+
+
+def decode_available():
+    """True when the native library carries the libjpeg decode path."""
+    lib = load()
+    return lib is not None and hasattr(lib, "jpg_decode_batch")
+
+
+def decode_batch(payloads, out_hw, resize=-1, crop_xy=None, mirror=None,
+                 mean=(0.0, 0.0, 0.0), std=(1.0, 1.0, 1.0), scale=1.0,
+                 n_threads=4):
+    """Decode+augment a batch of JPEG byte strings into float32 CHW RGB
+    (the reference's in-iterator OMP decode, iter_image_recordio_2.cc).
+
+    ``crop_xy``: (n, 2) fractions in [0, 1) for random crops, or None for
+    center crop.  Returns (n, 3, H, W) float32, or None when the native
+    decode path is unavailable.
+    """
+    lib = load()
+    if lib is None or not hasattr(lib, "jpg_decode_batch"):
+        return None
+    n = len(payloads)
+    h, w = int(out_hw[0]), int(out_hw[1])
+    lengths = np.asarray([len(p) for p in payloads], dtype=np.uint64)
+    offsets = np.zeros(n, dtype=np.uint64)
+    np.cumsum(lengths[:-1], out=offsets[1:])
+    blob = np.empty(int(lengths.sum()), dtype=np.uint8)
+    for i, p in enumerate(payloads):
+        blob[int(offsets[i]):int(offsets[i]) + len(p)] = \
+            np.frombuffer(p, dtype=np.uint8)
+    if crop_xy is None:
+        crops = np.full((n, 2), -1.0, dtype=np.float32)
+    else:
+        crops = np.ascontiguousarray(crop_xy, dtype=np.float32)
+    flips = np.zeros(n, dtype=np.uint8) if mirror is None else \
+        np.ascontiguousarray(mirror, dtype=np.uint8)
+    mean = np.ascontiguousarray(mean, dtype=np.float32)
+    std = np.ascontiguousarray(std, dtype=np.float32)
+    out = np.empty((n, 3, h, w), dtype=np.float32)
+    rc = lib.jpg_decode_batch(
+        blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        n, int(resize), h, w,
+        crops.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        flips.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        float(scale), int(n_threads),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    if rc < 0:
+        raise IOError(f"native jpeg decode failed on image {-rc - 1}")
+    return out

@@ -354,6 +354,41 @@ class ImageRecordIter(DataIter):
             label = np.float32(label)
         return np.transpose(img, (2, 0, 1)), label
 
+    def _decode_batch_native(self, raws, flips, crops):
+        """Whole-batch decode+augment in one native call (the reference's
+        in-iterator OMP pipeline, ``iter_image_recordio_2.cc:142-154``):
+        libjpeg decode → shorter-edge resize → crop → mirror → normalize on
+        a C++ thread pool, float32 CHW out.  Returns None when the payload
+        set is not all-JPEG (native path handles only JPEG, like the
+        reference's libjpeg-turbo fast path)."""
+        from .. import _native, recordio
+        headers, payloads = [], []
+        for raw in raws:
+            header, payload = recordio.unpack(raw)
+            if not payload[:3] == b"\xff\xd8\xff":
+                return None
+            headers.append(header)
+            payloads.append(payload)
+        c, h, w = self._data_shape
+        try:
+            data = _native.decode_batch(
+                payloads, (h, w), resize=self._resize,
+                crop_xy=crops if self._rand_crop else None,
+                mirror=flips.astype(np.uint8),
+                mean=self._mean, std=self._std, scale=self._scale,
+                n_threads=self._threads)
+        except IOError:
+            # e.g. CMYK/YCCK JPEGs libjpeg won't convert — cv2 handles them
+            return None
+        labels = []
+        for header in headers:
+            label = header.label
+            if not np.isscalar(label) and getattr(label, "size", 1) > 1:
+                labels.append(np.asarray(label, dtype=np.float32))
+            else:
+                labels.append(np.float32(label))
+        return data, np.stack(labels)
+
     def next(self):
         if not self.iter_next():
             raise StopIteration
@@ -368,9 +403,18 @@ class ImageRecordIter(DataIter):
         flips = self._rng.rand(len(sel)) < 0.5 if self._rand_mirror \
             else np.zeros(len(sel), dtype=bool)
         crops = self._rng.rand(len(sel), 2)
-        decoded = list(self._pool.map(self._decode_one, raws, flips, crops))
-        data = np.stack([d for d, _ in decoded]).astype(self._dtype)
-        labels = np.stack([l for _, l in decoded])
+        from .. import _native
+        native = None
+        if _native.decode_available():
+            native = self._decode_batch_native(raws, flips, crops)
+        if native is not None:
+            data, labels = native
+            data = data.astype(self._dtype, copy=False)
+        else:
+            decoded = list(self._pool.map(self._decode_one, raws, flips,
+                                          crops))
+            data = np.stack([d for d, _ in decoded]).astype(self._dtype)
+            labels = np.stack([l for _, l in decoded])
         return DataBatch(data=[nd.array(data)], label=[nd.array(labels)],
                          pad=pad, index=sel.copy())
 
