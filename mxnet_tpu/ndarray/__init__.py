@@ -38,3 +38,15 @@ _cast_storage_op = cast_storage  # noqa: F821  (installed by populate above)
 def cast_storage(data, stype="default"):  # noqa: F811
     out = _cast_storage_op(data)
     return out.tostype(stype)
+
+
+# sparse_retain preserves the row-sparse stype (reference sparse_retain
+# outputs kRowSparseStorage); the generated op masks the dense payload.
+_sparse_retain_op = sparse_retain  # noqa: F821
+
+
+def sparse_retain(data, indices):  # noqa: F811
+    out = _sparse_retain_op(data, indices)
+    if isinstance(data, RowSparseNDArray):
+        return out.tostype("row_sparse")
+    return out

@@ -1,0 +1,161 @@
+"""Distribution-level statistical contracts for the samplers (port of the
+reference ``tests/python/unittest/test_random.py`` check_with_device
+moment/density checks, upgraded to scipy KS / chi-square gates).
+
+Seeded draws → deterministic; tolerances sized for n=60k samples.
+"""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import mxnet_tpu as mx
+
+N = 60_000
+
+
+def _draw(op, n=N, **kw):
+    mx.random.seed(1234)
+    return getattr(mx.nd.random, op)(shape=(n,), **kw).asnumpy()
+
+
+def _ks(sample, cdf, *args):
+    # Kolmogorov–Smirnov against the analytic CDF; n=60k → reject only on
+    # gross mismatch (p < 1e-3 would be a real distribution bug)
+    stat, p = st.kstest(sample, cdf, args=args)
+    assert p > 1e-3, (stat, p)
+
+
+def test_uniform_moments_and_ks():
+    s = _draw("uniform", low=-2.0, high=3.0)
+    assert abs(s.mean() - 0.5) < 0.02
+    assert abs(s.var() - 25 / 12) < 0.05
+    assert s.min() >= -2.0 and s.max() < 3.0
+    _ks((s + 2.0) / 5.0, "uniform")
+
+
+def test_normal_moments_and_ks():
+    s = _draw("normal", loc=1.5, scale=2.0)
+    assert abs(s.mean() - 1.5) < 0.03
+    assert abs(s.std() - 2.0) < 0.03
+    _ks(s, "norm", 1.5, 2.0)
+
+
+def test_gamma_moments_and_ks():
+    alpha, beta = 2.5, 1.5     # mx: shape alpha, scale beta
+    s = _draw("gamma", alpha=alpha, beta=beta)
+    assert abs(s.mean() - alpha * beta) < 0.05
+    assert abs(s.var() - alpha * beta * beta) < 0.3
+    _ks(s, "gamma", alpha, 0, beta)
+
+
+def test_exponential_moments_and_ks():
+    lam = 2.0
+    s = _draw("exponential", lam=lam)
+    assert abs(s.mean() - 1 / lam) < 0.01
+    _ks(s, "expon", 0, 1 / lam)
+
+
+def test_poisson_moments_and_chisquare():
+    lam = 3.7
+    s = _draw("poisson", lam=lam)
+    assert abs(s.mean() - lam) < 0.05
+    assert abs(s.var() - lam) < 0.15
+    kmax = int(st.poisson.ppf(0.9999, lam))
+    obs = np.bincount(np.clip(s.astype(int), 0, kmax),
+                      minlength=kmax + 1)
+    probs = st.poisson.pmf(np.arange(kmax + 1), lam)
+    probs[-1] += 1 - probs.sum()
+    chi, p = st.chisquare(obs, probs * len(s))
+    assert p > 1e-3, (chi, p)
+
+
+def test_negative_binomial_moments():
+    k, prob = 4, 0.4
+    s = _draw("negative_binomial", k=k, p=prob)
+    want_mean = k * (1 - prob) / prob
+    want_var = k * (1 - prob) / prob ** 2
+    assert abs(s.mean() - want_mean) < 0.1
+    assert abs(s.var() - want_var) < 1.0
+    kmax = int(st.nbinom.ppf(0.9999, k, prob))
+    obs = np.bincount(np.clip(s.astype(int), 0, kmax),
+                      minlength=kmax + 1)
+    probs = st.nbinom.pmf(np.arange(kmax + 1), k, prob)
+    probs[-1] += 1 - probs.sum()
+    chi, p = st.chisquare(obs, probs * len(s))
+    assert p > 1e-3, (chi, p)
+
+
+def test_generalized_negative_binomial_moments():
+    mu, alpha = 2.0, 0.3
+    s = _draw("generalized_negative_binomial", mu=mu, alpha=alpha)
+    assert abs(s.mean() - mu) < 0.05
+    assert abs(s.var() - (mu + alpha * mu * mu)) < 0.25
+
+
+def test_randint_uniformity():
+    mx.random.seed(99)
+    s = mx.nd.random.randint(low=2, high=12, shape=(N,)).asnumpy()
+    assert s.min() >= 2 and s.max() <= 11
+    obs = np.bincount(s.astype(int) - 2, minlength=10)
+    chi, p = st.chisquare(obs)
+    assert p > 1e-3, (chi, p)
+
+
+def test_multinomial_frequencies():
+    mx.random.seed(7)
+    probs = mx.nd.array([[0.1, 0.2, 0.3, 0.4]])
+    s = mx.nd.sample_multinomial(probs, shape=N).asnumpy().ravel()
+    freq = np.bincount(s.astype(int), minlength=4) / len(s)
+    np.testing.assert_allclose(freq, [0.1, 0.2, 0.3, 0.4], atol=0.01)
+
+
+def test_bernoulli_frequency():
+    mx.random.seed(5)
+    s = mx.nd.sample_bernoulli(mx.nd.array([0.3]), shape=N).asnumpy()
+    assert set(np.unique(s)) <= {0.0, 1.0}
+    assert abs(s.mean() - 0.3) < 0.01
+
+
+def test_sample_family_per_parameter_rows():
+    """sample_* take a parameter tensor: each row follows its own
+    distribution (reference test_random.py sample_* checks)."""
+    mx.random.seed(11)
+    mu = mx.nd.array([-3.0, 0.0, 4.0])
+    sig = mx.nd.array([0.5, 1.0, 2.0])
+    s = mx.nd.sample_normal(mu, sig, shape=20_000).asnumpy()
+    assert s.shape == (3, 20_000)
+    for i, (m, sd) in enumerate([(-3, 0.5), (0, 1.0), (4, 2.0)]):
+        assert abs(s[i].mean() - m) < 0.05 * max(1, abs(m))
+        assert abs(s[i].std() - sd) < 0.05
+
+    lam = mx.nd.array([1.0, 6.0])
+    sp = mx.nd.sample_poisson(lam, shape=20_000).asnumpy()
+    assert abs(sp[0].mean() - 1.0) < 0.05
+    assert abs(sp[1].mean() - 6.0) < 0.12
+
+
+def test_gamma_sample_gradient_free_and_positive():
+    s = _draw("gamma", alpha=0.3, beta=2.0, n=10_000)
+    assert (s >= 0).all()
+
+
+def test_shuffle_is_permutation():
+    mx.random.seed(21)
+    x = mx.nd.arange(1000)
+    y = mx.nd.shuffle(x).asnumpy()
+    assert not np.array_equal(y, np.arange(1000))
+    np.testing.assert_array_equal(np.sort(y), np.arange(1000))
+
+
+def test_seed_reproducibility_across_all_samplers():
+    outs = {}
+    for trial in range(2):
+        mx.random.seed(31415)
+        for op, kw in [("uniform", {}), ("normal", {}),
+                       ("gamma", {"alpha": 2.0}),
+                       ("exponential", {}), ("poisson", {"lam": 2.0})]:
+            v = getattr(mx.nd.random, op)(shape=(64,), **kw).asnumpy()
+            key = (trial, op)
+            outs[key] = v
+    for op in ("uniform", "normal", "gamma", "exponential", "poisson"):
+        np.testing.assert_array_equal(outs[(0, op)], outs[(1, op)])

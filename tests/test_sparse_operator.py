@@ -1,0 +1,251 @@
+"""Sparse-operator contracts (port of the reference
+``tests/python/unittest/test_sparse_operator.py`` semantics onto the
+compressed-RowSparse / dense-backed CSR layer).
+
+Covered families: cast_storage round trips, sparse_retain fwd+bwd, dot
+with csr lhs (+transposes), elemwise add/mul across stype combinations,
+CSR slicing, storage-type preservation, where/abs/sign on sparse inputs,
+and scipy cross-checks.
+"""
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray.sparse import CSRNDArray, RowSparseNDArray
+
+
+def _rand_sparse(rng, shape, density=0.3):
+    dense = rng.randn(*shape).astype("float32")
+    mask = rng.rand(*shape) < density
+    return dense * mask
+
+
+def _rand_rsp(rng, shape, density=0.4):
+    dense = rng.randn(*shape).astype("float32")
+    keep = rng.rand(shape[0]) < density
+    return dense * keep[:, None]
+
+
+# ------------------------------------------------------------ cast_storage
+@pytest.mark.parametrize("stype", ["csr", "row_sparse"])
+def test_cast_storage_roundtrip(stype):
+    rng = np.random.RandomState(0)
+    d = _rand_sparse(rng, (7, 5))
+    x = mx.nd.array(d)
+    s = mx.nd.cast_storage(x, stype=stype)
+    assert s.stype == stype
+    np.testing.assert_array_equal(s.asnumpy(), d)
+    back = mx.nd.cast_storage(s, stype="default")
+    assert back.stype == "default"
+    np.testing.assert_array_equal(back.asnumpy(), d)
+
+
+def test_cast_storage_csr_matches_scipy():
+    rng = np.random.RandomState(1)
+    d = _rand_sparse(rng, (6, 9))
+    c = mx.nd.array(d).tostype("csr")
+    ref = sps.csr_matrix(d)
+    np.testing.assert_array_equal(c.indptr.asnumpy(), ref.indptr)
+    np.testing.assert_array_equal(c.indices.asnumpy(), ref.indices)
+    np.testing.assert_allclose(c.data.asnumpy(), ref.data, rtol=1e-6)
+
+
+# ---------------------------------------------------------- sparse_retain
+def test_sparse_retain_forward():
+    rng = np.random.RandomState(2)
+    d = _rand_rsp(rng, (8, 4))
+    x = mx.nd.array(d).tostype("row_sparse")
+    rows = mx.nd.array([1, 3, 6])
+    out = mx.nd.sparse_retain(x, rows)
+    want = np.zeros_like(d)
+    for r in (1, 3, 6):
+        want[r] = d[r]
+    np.testing.assert_array_equal(out.asnumpy(), want)
+    assert out.stype == "row_sparse"
+
+
+def test_sparse_retain_gradient():
+    """Reference contract: d(retain)/d(data) keeps only retained rows."""
+    rng = np.random.RandomState(3)
+    d = rng.randn(6, 3).astype("float32")
+    x = mx.nd.array(d)
+    x.attach_grad()
+    rows = mx.nd.array([0, 4])
+    with mx.autograd.record():
+        y = mx.nd.sparse_retain(x, rows)
+        loss = (y * y).sum()
+    loss.backward()
+    g = x.grad.asnumpy()
+    want = np.zeros_like(d)
+    for r in (0, 4):
+        want[r] = 2 * d[r]
+    np.testing.assert_allclose(g, want, rtol=1e-5)
+
+
+# ------------------------------------------------------------------- dot
+@pytest.mark.parametrize("ta", [False, True])
+def test_dot_csr_dense(ta):
+    rng = np.random.RandomState(4)
+    a = _rand_sparse(rng, (5, 7))
+    b = rng.randn(*((5, 3) if ta else (7, 3))).astype("float32")
+    lhs = mx.nd.array(a).tostype("csr")
+    out = mx.nd.sparse.dot(lhs, mx.nd.array(b), transpose_a=ta)
+    want = (a.T if ta else a) @ b
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5, atol=1e-6)
+
+
+def test_dot_dense_rsp():
+    rng = np.random.RandomState(5)
+    a = rng.randn(4, 6).astype("float32")
+    b = _rand_rsp(rng, (6, 3))
+    rhs = mx.nd.array(b).tostype("row_sparse")
+    out = mx.nd.sparse.dot(mx.nd.array(a), rhs)
+    np.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5, atol=1e-6)
+
+
+def test_dot_csr_dense_gradient():
+    rng = np.random.RandomState(6)
+    a = _rand_sparse(rng, (5, 7))
+    b = rng.randn(7, 3).astype("float32")
+    bnd = mx.nd.array(b)
+    bnd.attach_grad()
+    lhs = mx.nd.array(a).tostype("csr")
+    with mx.autograd.record():
+        out = mx.nd.sparse.dot(lhs, bnd)
+        loss = out.sum()
+    loss.backward()
+    np.testing.assert_allclose(bnd.grad.asnumpy(),
+                               a.T @ np.ones((5, 3), "float32"),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ----------------------------------------------------- elemwise mixtures
+@pytest.mark.parametrize("op,np_op", [("elemwise_add", np.add),
+                                      ("elemwise_mul", np.multiply)])
+@pytest.mark.parametrize("lt,rt", [("row_sparse", "row_sparse"),
+                                   ("csr", "csr"),
+                                   ("row_sparse", "default"),
+                                   ("default", "csr")])
+def test_elemwise_mixed_stypes(op, np_op, lt, rt):
+    rng = np.random.RandomState(7)
+    a = _rand_sparse(rng, (6, 5))
+    b = _rand_sparse(rng, (6, 5))
+    an = mx.nd.array(a)
+    bn = mx.nd.array(b)
+    if lt != "default":
+        an = an.tostype(lt)
+    if rt != "default":
+        bn = bn.tostype(rt)
+    out = getattr(mx.nd, op)(an, bn)
+    np.testing.assert_allclose(out.asnumpy(), np_op(a, b), rtol=1e-6)
+
+
+def test_add_n_sparse():
+    rng = np.random.RandomState(8)
+    arrs = [_rand_rsp(rng, (5, 4)) for _ in range(3)]
+    nds = [mx.nd.array(a).tostype("row_sparse") for a in arrs]
+    out = mx.nd.add_n(*nds)
+    np.testing.assert_allclose(out.asnumpy(), sum(arrs), rtol=1e-6)
+
+
+# --------------------------------------------------------------- slicing
+def test_csr_slice():
+    rng = np.random.RandomState(9)
+    d = _rand_sparse(rng, (8, 6))
+    c = mx.nd.array(d).tostype("csr")
+    s = c[2:6]
+    np.testing.assert_array_equal(s.asnumpy(), d[2:6])
+    s2 = mx.nd.slice(c, begin=(1,), end=(5,))
+    np.testing.assert_array_equal(s2.asnumpy(), d[1:5])
+
+
+def test_rsp_retain_method():
+    rng = np.random.RandomState(10)
+    d = _rand_rsp(rng, (7, 3))
+    r = mx.nd.array(d).tostype("row_sparse")
+    kept = r.retain(mx.nd.array([0, 2, 5]))
+    want = np.zeros_like(d)
+    for row in (0, 2, 5):
+        want[row] = d[row]
+    np.testing.assert_array_equal(kept.asnumpy(), want)
+
+
+# -------------------------------------------------- unary stype-preserving
+@pytest.mark.parametrize("op,np_op", [("abs", np.abs), ("sign", np.sign),
+                                      ("square", np.square)])
+def test_unary_on_sparse(op, np_op):
+    rng = np.random.RandomState(11)
+    d = _rand_rsp(rng, (6, 4))
+    r = mx.nd.array(d).tostype("row_sparse")
+    out = getattr(mx.nd, op)(r)
+    np.testing.assert_allclose(out.asnumpy(), np_op(d), rtol=1e-6)
+
+
+def test_scalar_ops_on_csr():
+    rng = np.random.RandomState(12)
+    d = _rand_sparse(rng, (5, 5))
+    c = mx.nd.array(d).tostype("csr")
+    np.testing.assert_allclose((c * 3.0).asnumpy(), d * 3.0, rtol=1e-6)
+    np.testing.assert_allclose((c / 2.0).asnumpy(), d / 2.0, rtol=1e-6)
+
+
+# --------------------------------------------------------- where / misc
+def test_where_with_sparse_condition():
+    rng = np.random.RandomState(13)
+    d = _rand_sparse(rng, (4, 4))
+    cond = (d != 0).astype("float32")
+    x = rng.randn(4, 4).astype("float32")
+    y = rng.randn(4, 4).astype("float32")
+    out = mx.nd.where(mx.nd.array(cond), mx.nd.array(x), mx.nd.array(y))
+    np.testing.assert_array_equal(out.asnumpy(), np.where(cond != 0, x, y))
+
+
+def test_norm_on_sparse():
+    rng = np.random.RandomState(14)
+    d = _rand_sparse(rng, (6, 6))
+    c = mx.nd.array(d).tostype("csr")
+    got = float(mx.nd.norm(c).asnumpy())
+    assert got == pytest.approx(np.linalg.norm(d), rel=1e-5)
+
+
+def test_sum_mean_on_rsp():
+    rng = np.random.RandomState(15)
+    d = _rand_rsp(rng, (6, 4))
+    r = mx.nd.array(d).tostype("row_sparse")
+    assert float(mx.nd.sum(r).asnumpy()) == pytest.approx(d.sum(), rel=1e-5)
+    np.testing.assert_allclose(mx.nd.sum(r, axis=0).asnumpy(), d.sum(0),
+                               rtol=1e-5)
+
+
+def test_csr_scipy_dot_crosscheck():
+    """dot(csr, dense) against scipy's own csr @ dense."""
+    rng = np.random.RandomState(16)
+    d = _rand_sparse(rng, (10, 8), density=0.2)
+    b = rng.randn(8, 5).astype("float32")
+    ref = sps.csr_matrix(d) @ b
+    out = mx.nd.sparse.dot(mx.nd.array(d).tostype("csr"), mx.nd.array(b))
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_embedding_sparse_grad_rows_match_batch():
+    """Embedding(sparse_grad=True) gradient holds exactly the batch's
+    unique rows (reference test_sparse_operator embedding checks)."""
+    vocab, dim = 20, 6
+    emb = mx.gluon.nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize()
+    idx = mx.nd.array([3, 7, 3, 11])
+    with mx.autograd.record():
+        out = emb(idx)
+        loss = out.sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert isinstance(g, RowSparseNDArray)
+    rows = np.unique(np.asarray(g.indices.asnumpy()))
+    np.testing.assert_array_equal(rows, [3, 7, 11])
+    dense = g.asnumpy() if not hasattr(g, "tostype") else \
+        g.tostype("default").asnumpy()
+    want = np.zeros((vocab, dim), "float32")
+    for i in (3, 7, 11):
+        want[i] = 2.0 if i == 3 else 1.0
+    np.testing.assert_allclose(dense, want, rtol=1e-6)
