@@ -62,43 +62,71 @@ def _invoke_sym_by_name(op_name, sym_inputs, attrs):
     return _invoke_sym(registry.require(op_name), sym_inputs, attrs)
 
 
+def _smooth_distribution(p, eps=1e-4):
+    """Replace zeros with eps, rebalanced off the non-zero entries
+    (reference ``quantization.py:_smooth_distribution`` — KL needs full
+    support on both sides or zero bins dominate the divergence)."""
+    is_zeros = (p == 0).astype(np.float64)
+    is_nonzeros = (p != 0).astype(np.float64)
+    n_zeros = is_zeros.sum()
+    n_nonzeros = p.size - n_zeros
+    if not n_nonzeros:
+        return None
+    eps1 = eps * n_zeros / n_nonzeros
+    if eps1 >= 1.0:
+        return None
+    return p.astype(np.float64) + eps * is_zeros - eps1 * is_nonzeros
+
+
 def _optimal_threshold(hist, hist_edges, num_quantized_bins=255):
-    """KL-optimal clipping threshold over an activation histogram (reference
-    ``quantization.py:_get_optimal_threshold`` — the TensorRT entropy
-    calibration algorithm): for each candidate threshold, compare the clipped
-    distribution P against its ``num_quantized_bins``-level quantization Q
-    and keep the threshold minimizing KL(P||Q)."""
+    """KL-optimal clipping threshold over an activation histogram (the
+    reference's ``_get_optimal_threshold`` — TensorRT entropy calibration):
+    for each candidate threshold, compare the clipped distribution P
+    against its ``num_quantized_bins``-level quantization Q (both
+    eps-smoothed) and keep the threshold minimizing KL(P||Q)."""
     hist = hist.astype(np.float64)
     num_bins = hist.size
     zero_bin = num_bins // 2
     best_kl, best_t = np.inf, hist_edges[-1]
-    # symmetric histogram around 0; candidate half-widths in bins
-    for width in range(num_quantized_bins // 2 + 1, zero_bin + 1):
-        lo, hi = zero_bin - width, zero_bin + width
-        p = hist[lo:hi].copy()
+    # symmetric histogram around 0; candidate half-widths in bins (the
+    # reference iterates i = nqb//2 .. num_bins//2 with slice width 2i+1)
+    for width in range(num_quantized_bins // 2, zero_bin + 1):
+        lo, hi = zero_bin - width, zero_bin + width + 1
+        sliced = hist[lo:hi]
+        p = sliced.copy()
         # outliers fold into the edge bins (clipping)
         p[0] += hist[:lo].sum()
         p[-1] += hist[hi:].sum()
         if p.sum() == 0:
             continue
-        # quantize p into num_quantized_bins levels
-        factor = p.size / num_quantized_bins
-        q = np.zeros_like(p)
+        is_nonzeros = (p != 0)
+        # merge the UNCLIPPED slice into num_quantized_bins bins, then
+        # expand back across p's nonzero support (reference lines: q is
+        # built from sliced_nd_hist, not from the outlier-folded p)
+        num_merged = sliced.size // num_quantized_bins
+        if num_merged == 0:
+            continue
+        q = np.zeros(sliced.size, dtype=np.float64)
         for j in range(num_quantized_bins):
-            start = int(np.floor(j * factor))
-            stop = int(np.floor((j + 1) * factor)) or start + 1
-            chunk = p[start:stop]
-            nz = (chunk != 0).sum()
-            if nz:
-                q[start:stop] = np.where(chunk != 0, chunk.sum() / nz, 0)
-        pn = p / p.sum()
-        qn = q / max(q.sum(), 1e-20)
-        mask = pn > 0
-        kl = float(np.sum(pn[mask] * np.log(pn[mask] /
-                                            np.maximum(qn[mask], 1e-20))))
+            start = j * num_merged
+            stop = sliced.size if j == num_quantized_bins - 1 \
+                else start + num_merged
+            total = sliced[start:stop].sum()
+            norm = is_nonzeros[start:stop].sum()
+            if norm:
+                q[start:stop] = np.where(is_nonzeros[start:stop],
+                                         total / norm, 0.0)
+        q[p == 0] = 0.0
+        ps = _smooth_distribution(p)
+        qs = _smooth_distribution(q)
+        if ps is None or qs is None:
+            continue
+        pn = ps / ps.sum()
+        qn = qs / qs.sum()
+        kl = float(np.sum(pn * np.log(pn / qn)))
         if kl < best_kl:
             best_kl = kl
-            best_t = hist_edges[hi] if hi < hist_edges.size else hist_edges[-1]
+            best_t = hist_edges[min(hi, hist_edges.size - 1)]
     return best_t
 
 
@@ -109,7 +137,10 @@ def _collect_thresholds(sym, arg_params, aux_params, calib_data,
     min/max ('naive', reference ``_LayerOutputMinMaxCollector``) or
     histograms + KL threshold search ('entropy',
     ``_LayerHistogramCollector``)."""
-    # identify the parent outputs feeding quantizable nodes
+    # identify the parent outputs feeding quantizable nodes.  Keys are
+    # (id(parent), out_idx) — NOT names: Gluon-traced graphs name every op
+    # "fwd", so name keys would merge different layers' statistics into
+    # one threshold (and did, before r3)
     want = {}
     for node in sym._topo():
         if node.op is not None and node.op.name in _QUANTIZABLE:
@@ -124,7 +155,7 @@ def _collect_thresholds(sym, arg_params, aux_params, calib_data,
         for key, pname in want.items():
             if key[0] == id(node):
                 nodes_syms.append((node, key[1]))
-                names.append(pname)
+                names.append(key)
     from ..symbol.symbol import Group
     probe = Group([Symbol([(n, i)]) for (n, i) in nodes_syms])
     shapes = {}
@@ -159,14 +190,23 @@ def _collect_thresholds(sym, arg_params, aux_params, calib_data,
     if logger:
         logger.info("calibrated %d layer inputs over %d examples (%s)",
                     len(names), seen, mode)
+
     if mode == "entropy":
         out = {}
         for n in names:
             vals = np.concatenate(samples[n])
             amax = max(abs(mins[n]), abs(maxs[n])) or 1e-8
-            hist, edges = np.histogram(vals, bins=2048, range=(-amax, amax))
-            t = _optimal_threshold(hist, edges)
-            out[n] = (-t, t)
+            hist, edges = np.histogram(vals, bins=8001, range=(-amax, amax))
+            # reference _get_optimal_threshold: a non-negative layer (post
+            # relu / input pixels) quantizes to uint8 over [0, t] — the KL
+            # search must model 2*255+1 levels across the symmetric
+            # histogram, else it prices clipping against half the real
+            # resolution and picks thresholds ~2x too small
+            nonneg = mins[n] >= 0
+            t = _optimal_threshold(
+                hist, edges,
+                num_quantized_bins=(255 * 2 + 1) if nonneg else 255)
+            out[n] = (0.0, t) if nonneg else (-t, t)
         return out
     return {n: (mins[n], maxs[n]) for n in names}
 
@@ -181,11 +221,16 @@ def quantize_graph(sym, arg_params, thresholds, excluded_sym_names=(),
                 node.name in excluded:
             return None
         new_ins = list(ins)
-        # data input: calibrated range (skip when uncalibrated)
-        pname = node.inputs[0][0].name
-        if pname in thresholds:
-            mn, mx = thresholds[pname]
-            new_ins[0] = _fake_quant(ins[0], mn, mx, quantized_dtype)
+        # data input: calibrated range (skip when uncalibrated).  Like the
+        # reference's 'auto' dtype, a non-negative range quantizes to uint8
+        # (full 256 levels on [0, t]); signed ranges use symmetric int8.
+        pkey = (id(node.inputs[0][0]), node.inputs[0][1])
+        if pkey in thresholds:
+            mn, mx = thresholds[pkey]
+            ddtype = "uint8" if (mn >= 0 and quantized_dtype
+                                 in ("int8", "auto", "uint8")) \
+                else quantized_dtype
+            new_ins[0] = _fake_quant(ins[0], mn, mx, ddtype)
         # weight input: its own range (static)
         if len(node.inputs) > 1:
             wnode = node.inputs[1][0]
