@@ -24,6 +24,7 @@ BASELINE.md).  All other configs are nested under ``"extra"``:
 - ``fp32``: train at fp32-HIGHEST matmul precision
 - ``bert``: BERT-base pretraining step (b32 × s128, BASELINE config 3)
 - ``ssd``: SSD-300 VGG16 train step (b8, BASELINE config 4)
+- ``int8``: naive-calibrated int8 ResNet-50 inference (quantization flow)
 - ``io``: ImageRecordIter pipeline (host decode img/s + round-trip MB/s)
 
 Select a subset with BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,io.
@@ -369,6 +370,78 @@ def bench_ssd_train():
     return st
 
 
+def bench_int8_infer():
+    """Quantized ResNet-50 inference (reference
+    ``example/quantization/README.md`` int8 rows): naive-calibrated int8
+    graph from the model-zoo net, measured like the other infer configs."""
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib.quantization import quantize_model
+    from __graft_entry__ import _resnet
+
+    batch = 32
+    peak = _bf16_peak()
+    rng = np.random.RandomState(0)
+    accel = [d for d in jax.devices() if d.platform != "cpu"]
+    ctx = mx.gpu(0) if accel else mx.cpu(0)
+    net = _resnet(classes=1000, ctx=ctx)
+    x = rng.rand(batch, 3, 224, 224).astype("float32")
+    import tempfile, os as _os
+    d = tempfile.mkdtemp(prefix="q8bench_")
+    prefix = _os.path.join(d, "r50")
+    net.hybridize()
+    net(mx.nd.array(x, ctx=ctx))
+    net.export(prefix)
+    sym = mx.sym.load(prefix + "-symbol.json")
+    loaded = mx.nd.load(prefix + "-0000.params")
+    arg_params = {k.split(":", 1)[1]: v for k, v in loaded.items()
+                  if k.startswith("arg:")}
+    aux_params = {k.split(":", 1)[1]: v for k, v in loaded.items()
+                  if k.startswith("aux:")}
+    calib = mx.io.NDArrayIter(x, np.zeros(batch, "float32"),
+                              batch_size=batch)
+    qsym, qarg, qaux = quantize_model(
+        sym, arg_params, aux_params, calib_mode="naive", calib_data=calib,
+        num_calib_examples=batch)
+    ex = qsym.bind(ctx, {**{k: v.as_in_context(ctx) for k, v in qarg.items()},
+                         "data": mx.nd.array(x, ctx=ctx)},
+                   aux_states={k: v.as_in_context(ctx)
+                               for k, v in qaux.items()})
+
+    # jit the bound executor's forward with a data dependency chain
+    xj = jax.device_put(x)
+
+    def fwd(xv):
+        ex.arg_dict["data"]._data = xv
+        out = ex.forward()[0]
+        return out._data
+
+    def chained(xv):
+        out = fwd(xv)                       # trace the graph exactly once
+        return (jnp.mean(out.astype(jnp.float32)),
+                xv + 1e-30 * jnp.sum(out))
+
+    compiled = jax.jit(chained).lower(xj).compile()
+    flops = _cost_flops(compiled) or _RESNET50_FWD_FLOPS * batch
+
+    holder = {"x": xj}
+
+    def one_block():
+        for _ in range(30):
+            holder["m"], holder["x"] = compiled(holder["x"])
+
+    for _ in range(3):
+        holder["m"], holder["x"] = compiled(holder["x"])
+    float(np.asarray(holder["m"]))
+    times = _time_blocks(one_block, 5,
+                         lambda: float(np.asarray(holder["m"])))
+    st = _stats(times, 30, batch, flops, peak)
+    st["precision"] = "int8_weights_activations_int32_accum"
+    st["batch"] = batch
+    return st
+
+
 def bench_input_pipeline():
     """End-to-end ImageRecordIter throughput on a synthetic ``.rec``:
     record read → JPEG decode (thread pool) → augment → batch → device.
@@ -471,7 +544,7 @@ def _bench_input_pipeline_impl(_os, jax, mx, recordio, tmpdir, n_img, hw,
 def main():
     sel = [s.strip() for s in
            os.environ.get("BENCH_CONFIGS",
-                          "headline,infer,fp32,amp,bert,ssd,io").split(",")]
+                          "headline,infer,fp32,amp,bert,ssd,int8,io").split(",")]
     extra = {}
 
     headline = None
@@ -515,6 +588,11 @@ def main():
             extra["ssd300_vgg16_train_b8"] = bench_ssd_train()
         except Exception as e:           # pragma: no cover
             extra["ssd300_vgg16_train_b8"] = {"error": repr(e)}
+    if "int8" in sel:
+        try:
+            extra["resnet50_infer_bs32_int8"] = bench_int8_infer()
+        except Exception as e:           # pragma: no cover
+            extra["resnet50_infer_bs32_int8"] = {"error": repr(e)}
     if "io" in sel:
         try:
             extra["imagerecorditer_pipeline"] = bench_input_pipeline()

@@ -1,16 +1,49 @@
 #!/usr/bin/env bash
 # CI harness (reference ``ci/`` runtime functions, adapted: no docker — one
 # box, two backends).  Stages:
-#   unit      - full pytest suite on the virtual 8-device CPU mesh
-#   gate      - multichip SPMD dry-run (dp/tp/sp/pp/ep) via __graft_entry__
-#   examples  - fast example-script smoke runs (synthetic data)
-#   bench     - quick headline benchmark sanity (img/s > 0)
+#   unit       - full pytest suite on the virtual 8-device CPU mesh
+#   unit_fast  - the suite minus the heavy files (per-commit loop; ~7 min)
+#   unit_heavy - only the heavy files (unit == unit_fast + unit_heavy)
+#   gate       - multichip SPMD dry-run (dp/tp/sp/pp/ep) via __graft_entry__
+#   examples   - fast example-script smoke runs (synthetic data)
+#   bench      - quick headline benchmark sanity (img/s > 0)
 # Usage: ci/run.sh [stage ...]   (default: unit gate)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# files dominating wall time (measured with --durations: model-zoo ONNX
+# round-trips, SSD, pipeline schedules, multi-process dist, example-driving
+# tool tests).  unit_fast excludes exactly these; unit_heavy runs them.
+HEAVY_TESTS=(
+  tests/test_onnx_model_zoo.py
+  tests/test_onnx.py
+  tests/test_ssd.py
+  tests/test_pipeline.py
+  tests/test_tools.py
+  tests/test_gluon_model_zoo.py
+  tests/test_dist_kvstore.py
+  tests/test_moe.py
+  tests/test_bert.py
+  tests/test_rnn_legacy.py
+  tests/test_gluon_rnn.py
+  tests/test_parallel.py
+  tests/test_spmd_checkpoint.py
+  tests/test_quantization_accuracy.py
+  tests/test_layout_nhwc.py
+)
+
 stage_unit() {
   python -m pytest tests/ -q
+}
+
+stage_unit_fast() {
+  local ignores=()
+  for f in "${HEAVY_TESTS[@]}"; do ignores+=("--ignore=$f"); done
+  python -m pytest tests/ -q "${ignores[@]}"
+}
+
+stage_unit_heavy() {
+  python -m pytest "${HEAVY_TESTS[@]}" -q
 }
 
 stage_gate() {

@@ -1,0 +1,111 @@
+"""Model-parallel matrix factorization (reference
+``example/model-parallel/matrix_factorization/`` — the reference splits the
+embedding tables across GPUs with ``ctx_group``/``group2ctxs``; the
+TPU-native mechanism is a declarative PartitionRule mapping the same layers
+onto a mesh axis, with XLA inserting the collectives the placement implies).
+
+Runs on a virtual 8-device CPU mesh (dp=2 × mp=4): user/item embedding
+tables are sharded over ``mp`` along the embedding dimension, the batch
+over ``dp``.  Asserts the tables really land sharded and the loss drops.
+"""
+import argparse
+import logging
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+         if "host_platform_device_count" not in f]
+flags.append("--xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = " ".join(flags)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import (FunctionalOptimizer, PartitionRule,
+                                SPMDTrainer, device_mesh)
+
+
+class MFNet(mx.gluon.HybridBlock):
+    def __init__(self, n_users, n_items, dim, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.user = mx.gluon.nn.Embedding(n_users, dim)
+            self.item = mx.gluon.nn.Embedding(n_items, dim)
+
+    def hybrid_forward(self, F, uid, iid):
+        return F.sum(self.user(uid) * self.item(iid), axis=-1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=60)
+    ap.add_argument("--users", type=int, default=96)
+    ap.add_argument("--items", type=int, default=64)
+    ap.add_argument("--dim", type=int, default=16)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    assert len(jax.devices()) >= 8, "needs the 8-device CPU mesh"
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    mesh = device_mesh({"dp": 2, "tp": 4})
+
+    net = MFNet(args.users, args.items, args.dim)
+    net.initialize()
+    net(mx.nd.zeros((2,)), mx.nd.zeros((2,)))   # materialize params
+
+    # ground-truth low-rank ratings
+    u_true = rng.randn(args.users, 4).astype("float32")
+    i_true = rng.randn(args.items, 4).astype("float32")
+
+    def batch(n=64):
+        u = rng.randint(0, args.users, n)
+        i = rng.randint(0, args.items, n)
+        r = (u_true[u] * i_true[i]).sum(-1)
+        return (mx.nd.array(u), mx.nd.array(i)), mx.nd.array(r)
+
+    def l2(pred, label):
+        d = pred - label
+        return d * d
+
+    # the ctx_group analog: embedding tables sharded over the tp axis on
+    # their embedding dimension (rules win over the Megatron default)
+    rules = [PartitionRule(r"embedding.*weight",
+                           __import__("jax").sharding.PartitionSpec(None,
+                                                                    "tp"))]
+    trainer = SPMDTrainer(net, l2, FunctionalOptimizer("adam", 0.05), mesh,
+                          n_in=2, param_rules=rules,
+                          data_spec=(jax.sharding.PartitionSpec("dp"),
+                                     jax.sharding.PartitionSpec("dp")))
+
+    # placement proof: each table shard holds dim/4 columns per tp slice
+    params, _, _ = trainer._state
+    for name, arr in params.items():
+        if "weight" in name:
+            spec = arr.sharding.spec
+            assert tuple(spec) == (None, "tp"), (name, spec)
+
+    first = last = None
+    for it in range(args.iters):
+        (u, i), r = batch()
+        loss = float(trainer.step((u, i), r).asnumpy())
+        first = loss if first is None else first
+        last = loss
+        if it % 15 == 0:
+            logging.info("iter %3d  mse=%.4f", it, loss)
+
+    logging.info("INFO model-parallel MF: mse %.3f -> %.3f "
+                 "(tables sharded (None, 'tp') over %s)", first, last,
+                 dict(zip(mesh.axis_names, mesh.devices.shape)))
+    assert last < first * 0.2, (first, last)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

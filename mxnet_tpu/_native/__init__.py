@@ -27,6 +27,11 @@ _lib = None
 _tried = False
 
 
+# marker recording a failed -ljpeg link (so a reader-only .so is not
+# mistaken for up-to-date once libjpeg appears later)
+_NOJPEG_MARKER = _LIB_PATH + ".nojpeg"
+
+
 def _build():
     # jpeg_decode.cc needs libjpeg; try with it first, fall back to the
     # reader-only library when the dev package is absent (decode then uses
@@ -37,9 +42,13 @@ def _build():
                "-o", _LIB_PATH, "-ljpeg"]
         try:
             subprocess.run(cmd, check=True, capture_output=True)
+            if os.path.exists(_NOJPEG_MARKER):
+                os.remove(_NOJPEG_MARKER)
             return
         except subprocess.CalledProcessError:
-            pass
+            with open(_NOJPEG_MARKER, "w") as f:
+                f.write("libjpeg link failed; delete this file (or touch "
+                        "src/io/*.cc) after installing libjpeg to retry\n")
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
            os.path.abspath(_SRC), "-o", _LIB_PATH]
     subprocess.run(cmd, check=True, capture_output=True)
@@ -56,8 +65,17 @@ def load():
             srcs = [_SRC] + ([_SRC_JPEG] if os.path.exists(_SRC_JPEG)
                              else [])
             newest_src = max(os.path.getmtime(p) for p in srcs)
-            if not os.path.exists(_LIB_PATH) or \
-                    os.path.getmtime(_LIB_PATH) < newest_src:
+            stale = not os.path.exists(_LIB_PATH) or \
+                os.path.getmtime(_LIB_PATH) < newest_src
+            if not stale and os.path.exists(_SRC_JPEG):
+                # a reader-only .so from a failed -ljpeg link must retry
+                # once the marker is gone (e.g. libjpeg installed later)
+                probe = ctypes.CDLL(_LIB_PATH)
+                if not hasattr(probe, "jpg_decode_batch") and \
+                        not os.path.exists(_NOJPEG_MARKER):
+                    stale = True
+                del probe
+            if stale:
                 _build()
             lib = ctypes.CDLL(_LIB_PATH)
             lib.rio_build_index.restype = ctypes.c_int64

@@ -174,6 +174,7 @@ class SPMDTrainer:
             raise ValueError(
                 f"sequence_parallel=True requires mesh axis {sp_axis!r} with "
                 f"size > 1; mesh has {dict(mesh.shape)}")
+        self._dp_axis = dp_axis
         self._sp = (mesh, sp_axis, dp_axis, sp_impl) \
             if sequence_parallel else None
         with self._sp_scope():
@@ -192,8 +193,22 @@ class SPMDTrainer:
         return sequence_parallel_scope(*self._sp)
 
     def step(self, data, label):
+        import jax as _jax
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
         def _raw(x):
-            return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+            if isinstance(x, NDArray):
+                x = x._data
+                if getattr(x, "committed", False) and \
+                        len(x.devices()) < self._mesh.devices.size:
+                    # committed single-device arrays cannot be resharded
+                    # implicitly by the jitted step; async device_put onto
+                    # the batch sharding (uncommitted arrays pass through —
+                    # jit places those itself)
+                    return _jax.device_put(
+                        x, NamedSharding(self._mesh, _P(self._dp_axis)))
+                return x
+            return jnp.asarray(x)
         data = tuple(_raw(d) for d in data) \
             if isinstance(data, (tuple, list)) else _raw(data)
         label = _raw(label)

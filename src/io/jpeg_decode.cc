@@ -54,6 +54,15 @@ bool DecodeJpeg(const uint8_t* data, uint64_t len, std::vector<uint8_t>* rgb,
     jpeg_destroy_decompress(&cinfo);
     return false;
   }
+  // cap declared dimensions: a hostile/corrupt header can declare 65k x 65k
+  // (≈12.8 GB) — bad_alloc inside a worker thread would std::terminate the
+  // whole process, and >2^31/3 pixels would overflow the int32 pixel
+  // arithmetic below.  100 MP is far beyond any training image.
+  if (static_cast<uint64_t>(cinfo.image_width) * cinfo.image_height >
+      100ull * 1000 * 1000) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
   cinfo.out_color_space = JCS_RGB;
   jpeg_start_decompress(&cinfo);
   *w = static_cast<int>(cinfo.output_width);
@@ -198,7 +207,13 @@ int64_t jpg_decode_batch(const uint8_t* blob, const uint64_t* offsets,
     std::vector<uint8_t> rgb, tmp;
     int i;
     while ((i = next.fetch_add(1)) < n) {
-      if (!DecodeOne(args, i, &rgb, &tmp)) {
+      bool ok = false;
+      try {
+        ok = DecodeOne(args, i, &rgb, &tmp);
+      } catch (...) {
+        ok = false;   // never let an exception escape a worker thread —
+      }               // it would std::terminate the host process
+      if (!ok) {
         int64_t expected = 0;
         fail.compare_exchange_strong(expected, -(1 + int64_t(i)));
       }
