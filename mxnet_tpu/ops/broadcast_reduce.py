@@ -48,8 +48,11 @@ _binary("broadcast_mul", jnp.multiply)
 _binary("broadcast_div", jnp.divide)
 _binary("broadcast_mod", jnp.mod)
 _binary("broadcast_power", jnp.power)
-_binary("broadcast_maximum", jnp.maximum)
-_binary("broadcast_minimum", jnp.minimum)
+# tie-gradient convention: the reference's backward uses ge/le
+# (mshadow_op.h) — the FULL cotangent goes to the LHS at exact ties.
+# jnp.maximum's VJP splits ties 50/50, so select explicitly.
+_binary("broadcast_maximum", lambda a, b: jnp.where(a >= b, a, b))
+_binary("broadcast_minimum", lambda a, b: jnp.where(a <= b, a, b))
 _binary("broadcast_hypot", jnp.hypot)
 _binary("broadcast_equal", jnp.equal, cast_back=True)
 _binary("broadcast_not_equal", jnp.not_equal, cast_back=True)

@@ -193,8 +193,10 @@ _binary("elemwise_add", jnp.add, aliases=("_plus", "_add"))
 _binary("elemwise_sub", jnp.subtract, aliases=("_minus", "_sub"))
 _binary("elemwise_mul", jnp.multiply, aliases=("_mul",))
 _binary("elemwise_div", jnp.divide, aliases=("_div",))
-_binary("_maximum", jnp.maximum)
-_binary("_minimum", jnp.minimum)
+# ties: full cotangent to the LHS (reference mshadow_op ge/le backward);
+# jnp.maximum's VJP would split 50/50
+_binary("_maximum", lambda a, b: jnp.where(a >= b, a, b))
+_binary("_minimum", lambda a, b: jnp.where(a <= b, a, b))
 _binary("_hypot", jnp.hypot)
 _binary("_power", jnp.power, aliases=("_Power",))
 _binary("_mod", jnp.mod)
@@ -254,8 +256,10 @@ _scalar("_mod_scalar", lambda x, s: jnp.mod(x, jnp.asarray(s, x.dtype)))
 _scalar("_rmod_scalar", lambda x, s: jnp.mod(jnp.asarray(s, x.dtype), x))
 _scalar("_power_scalar", lambda x, s: jnp.power(x, jnp.asarray(s, x.dtype)))
 _scalar("_rpower_scalar", lambda x, s: jnp.power(jnp.asarray(s, x.dtype), x))
-_scalar("_maximum_scalar", lambda x, s: jnp.maximum(x, jnp.asarray(s, x.dtype)))
-_scalar("_minimum_scalar", lambda x, s: jnp.minimum(x, jnp.asarray(s, x.dtype)))
+# ties: full cotangent to the tensor operand (reference ge/le backward;
+# see _maximum/_minimum above)
+_scalar("_maximum_scalar", lambda x, s: jnp.where(x >= jnp.asarray(s, x.dtype), x, jnp.asarray(s, x.dtype)))
+_scalar("_minimum_scalar", lambda x, s: jnp.where(x <= jnp.asarray(s, x.dtype), x, jnp.asarray(s, x.dtype)))
 _scalar("_hypot_scalar", lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)))
 _scalar("_equal_scalar", lambda x, s: (x == s).astype(x.dtype))
 _scalar("_not_equal_scalar", lambda x, s: (x != s).astype(x.dtype))

@@ -1,0 +1,249 @@
+"""Fourth operator-contract tranche: indexing, gathering, ordering and
+layout-movement gradients (reference ``test_operator.py``:
+``test_take``/``test_pick``/``test_order``/``test_gather_nd`` etc. —
+``check_numeric_gradient`` per attribute path).
+
+These families route cotangents through index maps (take/pick/gather) or
+permutations (sort/topk/transpose-like) where a wrong axis or an
+unaccumulated duplicate index silently corrupts training.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (fd_grad_check as _grad_check,
+                                  fd_rand as _rand)
+
+
+# ------------------------------------------------------------------- take
+@pytest.mark.parametrize("axis", [0, 1, 2])
+def test_take_grad(axis):
+    data = mx.sym.Variable("data")
+    idx = mx.sym.Variable("idx")
+    sym = mx.sym.take(data, idx, axis=axis)
+    loc = {"data": _rand(3, 4, 5, seed=1),
+           "idx": np.asarray([1, 0, 2, 1], "float32")}
+    _grad_check(sym, loc, grad_nodes=["data"])
+
+
+def test_take_duplicate_indices_accumulate():
+    """Duplicate indices must SUM their cotangents (scatter-add), not
+    overwrite (reference take backward AddTakeGrad)."""
+    x = mx.nd.array(np.arange(12, dtype="float32").reshape(4, 3))
+    x.attach_grad()
+    idx = mx.nd.array([1, 1, 1, 2])
+    with mx.autograd.record():
+        y = mx.nd.take(x, idx)
+        loss = y.sum()
+    loss.backward()
+    want = np.zeros((4, 3), "float32")
+    want[1] = 3.0
+    want[2] = 1.0
+    np.testing.assert_array_equal(x.grad.asnumpy(), want)
+
+
+@pytest.mark.parametrize("mode", ["clip", "wrap"])
+def test_take_out_of_range_modes(mode):
+    x = mx.nd.array(np.arange(6, dtype="float32").reshape(3, 2))
+    idx = mx.nd.array([-1, 3, 4])
+    out = mx.nd.take(x, idx, mode=mode).asnumpy()
+    xn = x.asnumpy()
+    if mode == "clip":
+        want = xn[[0, 2, 2]]
+    else:
+        want = xn[[-1 % 3, 3 % 3, 4 % 3]]
+    np.testing.assert_array_equal(out, want)
+
+
+def test_batch_take_grad():
+    x = mx.nd.array(np.arange(12, dtype="float32").reshape(4, 3))
+    x.attach_grad()
+    idx = mx.nd.array([0, 2, 1, 0])
+    with mx.autograd.record():
+        y = mx.nd.batch_take(x, idx)
+        (y * y).sum().backward()
+    g = x.grad.asnumpy()
+    want = np.zeros((4, 3), "float32")
+    for r, c in enumerate([0, 2, 1, 0]):
+        want[r, c] = 2 * x.asnumpy()[r, c]
+    np.testing.assert_allclose(g, want, rtol=1e-5)
+
+
+# -------------------------------------------------------- gather/scatter
+def test_gather_nd_grad_accumulates():
+    x = mx.nd.array(np.arange(12, dtype="float32").reshape(3, 4))
+    x.attach_grad()
+    idx = mx.nd.array([[0, 0, 2], [1, 1, 3]])   # picks (0,1),(0,1),(2,3)
+    with mx.autograd.record():
+        y = mx.nd.gather_nd(x, idx)
+        y.sum().backward()
+    want = np.zeros((3, 4), "float32")
+    want[0, 1] = 2.0
+    want[2, 3] = 1.0
+    np.testing.assert_array_equal(x.grad.asnumpy(), want)
+
+
+def test_scatter_nd_forward_and_grad():
+    # NOTE: duplicate indices are explicitly UNDEFINED for scatter_nd
+    # (reference indexing_op.cc:889 "the gradient ... will not be
+    # correct") — contract covers distinct targets only
+    data = mx.nd.array([9.0, 8.0, 7.0])
+    data.attach_grad()
+    idx = mx.nd.array([[0, 3, 2]])
+    with mx.autograd.record():
+        y = mx.nd.scatter_nd(data, idx, shape=(4,))
+        (y * mx.nd.arange(4)).sum().backward()
+    np.testing.assert_array_equal(y.asnumpy(), [9.0, 0.0, 7.0, 8.0])
+    np.testing.assert_array_equal(data.grad.asnumpy(), [0.0, 3.0, 2.0])
+
+
+# ------------------------------------------------------------------- pick
+@pytest.mark.parametrize("keepdims", [False, True])
+def test_pick_grad(keepdims):
+    x = mx.nd.array(np.arange(12, dtype="float32").reshape(4, 3))
+    x.attach_grad()
+    idx = mx.nd.array([0, 2, 1, 1])
+    with mx.autograd.record():
+        y = mx.nd.pick(x, idx, axis=1, keepdims=keepdims)
+        (y * y).sum().backward()
+    want = np.zeros((4, 3), "float32")
+    for r, c in enumerate([0, 2, 1, 1]):
+        want[r, c] = 2 * x.asnumpy()[r, c]
+    np.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-5)
+
+
+# --------------------------------------------------------------- ordering
+def test_sort_grad_routes_through_permutation():
+    xv = np.asarray([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], "float32")
+    x = mx.nd.array(xv)
+    x.attach_grad()
+    w = np.asarray([[1.0, 10.0, 100.0], [1.0, 10.0, 100.0]], "float32")
+    with mx.autograd.record():
+        y = mx.nd.sort(x, axis=1)
+        (y * mx.nd.array(w)).sum().backward()
+    # grad lands where each sorted element CAME from
+    want = np.zeros_like(xv)
+    for r in range(2):
+        order = np.argsort(xv[r])
+        for j, src in enumerate(order):
+            want[r, src] = w[r, j]
+    np.testing.assert_array_equal(x.grad.asnumpy(), want)
+
+
+def test_topk_value_grad():
+    xv = np.asarray([[3.0, 1.0, 2.0, 5.0]], "float32")
+    x = mx.nd.array(xv)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.topk(x, k=2, ret_typ="value", axis=1)
+        y.sum().backward()
+    want = np.asarray([[1.0, 0.0, 0.0, 1.0]], "float32")
+    np.testing.assert_array_equal(x.grad.asnumpy(), want)
+
+
+def test_argsort_matches_numpy_and_topk_indices():
+    rng = np.random.RandomState(3)
+    xv = rng.randn(4, 7).astype("float32")
+    a = mx.nd.argsort(mx.nd.array(xv), axis=1).asnumpy()
+    np.testing.assert_array_equal(a, np.argsort(xv, axis=1))
+    t = mx.nd.topk(mx.nd.array(xv), k=3, axis=1).asnumpy()
+    np.testing.assert_array_equal(t, np.argsort(-xv, axis=1)[:, :3])
+
+
+# ------------------------------------------------------- layout movement
+@pytest.mark.parametrize("op,kw", [
+    ("repeat", {"repeats": 3}),
+    ("repeat", {"repeats": 2, "axis": 1}),
+    ("reverse", {"axis": 1}),
+    ("tile", {"reps": (2, 3)}),
+    ("swapaxes", {"dim1": 0, "dim2": 1}),
+    ("flip", {"axis": 0}),
+], ids=["repeat_flat", "repeat_ax1", "reverse", "tile", "swapaxes", "flip"])
+def test_movement_grads(op, kw):
+    data = mx.sym.Variable("data")
+    sym = getattr(mx.sym, op)(data, **kw)
+    _grad_check(sym, {"data": _rand(3, 4, seed=5)})
+
+
+def test_where_grad_masks_branches():
+    cond = mx.nd.array([[1.0, 0.0], [0.0, 1.0]])
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = mx.nd.array([[10.0, 20.0], [30.0, 40.0]])
+    a.attach_grad()
+    b.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.where(cond, a, b)
+        (y * y).sum().backward()
+    np.testing.assert_allclose(a.grad.asnumpy(),
+                               [[2.0, 0.0], [0.0, 8.0]], rtol=1e-6)
+    np.testing.assert_allclose(b.grad.asnumpy(),
+                               [[0.0, 40.0], [60.0, 0.0]], rtol=1e-6)
+
+
+# ------------------------------------------------------------------ dots
+@pytest.mark.parametrize("ta,tb", [(False, False), (True, False),
+                                   (False, True), (True, True)])
+def test_dot_transpose_grads(ta, tb):
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    sym = mx.sym.dot(a, b, transpose_a=ta, transpose_b=tb)
+    sa = (4, 3) if ta else (3, 4)
+    sb = (5, 4) if tb else (4, 5)
+    _grad_check(sym, {"a": _rand(*sa, seed=6), "b": _rand(*sb, seed=7)})
+
+
+@pytest.mark.parametrize("ta,tb", [(False, False), (True, False),
+                                   (False, True)])
+def test_batch_dot_transpose_grads(ta, tb):
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    sym = mx.sym.batch_dot(a, b, transpose_a=ta, transpose_b=tb)
+    sa = (2, 4, 3) if ta else (2, 3, 4)
+    sb = (2, 5, 4) if tb else (2, 4, 5)
+    _grad_check(sym, {"a": _rand(*sa, seed=8), "b": _rand(*sb, seed=9)})
+
+
+# ---------------------------------------------------------- shape-likes
+def test_broadcast_like_grad_reduces():
+    a = mx.nd.array(np.ones((1, 3), "float32"))
+    ref = mx.nd.zeros((4, 3))
+    a.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.broadcast_like(a, ref)
+        y.sum().backward()
+    np.testing.assert_array_equal(a.grad.asnumpy(), [[4.0, 4.0, 4.0]])
+
+
+@pytest.mark.parametrize("op,kw,shape", [
+    ("expand_dims", {"axis": 1}, (3, 4)),
+    ("squeeze", {"axis": 0}, (1, 3, 4)),
+    ("reshape", {"shape": (4, 3)}, (3, 4)),
+    ("reshape", {"shape": (0, -1)}, (3, 2, 2)),
+], ids=["expand", "squeeze", "reshape", "reshape_special"])
+def test_shape_op_grads(op, kw, shape):
+    data = mx.sym.Variable("data")
+    sym = getattr(mx.sym, op)(data, **kw)
+    _grad_check(sym, {"data": _rand(*shape, seed=10)})
+
+
+def test_clip_grad_zero_outside_range():
+    x = mx.nd.array([-2.0, -0.5, 0.5, 2.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.clip(x, -1.0, 1.0)
+        (y * mx.nd.array([1.0, 2.0, 3.0, 4.0])).sum().backward()
+    np.testing.assert_array_equal(x.grad.asnumpy(), [0.0, 2.0, 3.0, 0.0])
+
+
+def test_maximum_tie_gradient_split():
+    """At exact ties the reference sends the full cotangent to the LHS
+    (mshadow_op ge); pin that convention."""
+    a = mx.nd.array([1.0, 2.0])
+    b = mx.nd.array([1.0, 1.0])
+    a.attach_grad()
+    b.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.broadcast_maximum(a, b)
+        y.sum().backward()
+    np.testing.assert_array_equal(a.grad.asnumpy(), [1.0, 1.0])
+    np.testing.assert_array_equal(b.grad.asnumpy(), [0.0, 0.0])
