@@ -157,7 +157,7 @@ def _stats(block_times, steps_per_block, items_per_step, flops_per_step,
 
 def _trainer_bench(net, loss_fn, data, label, *, n_in=1, warm=3,
                    n_blocks=5, steps_per_block=20, flops_fallback=None,
-                   peak=None, lr=1e-4, amp_bf16=False):
+                   peak=None, lr=1e-4, amp_bf16=False, param_dtype=None):
     """AOT-compile one SPMD train step, time it, return stats."""
     import jax
     import jax.numpy as jnp
@@ -171,7 +171,8 @@ def _trainer_bench(net, loss_fn, data, label, *, n_in=1, warm=3,
     mesh = make_mesh(n_devices=1, dp=1)
     step_jit, state = make_train_step(
         net, loss_fn, FunctionalOptimizer("sgd", lr, momentum=0.9), mesh,
-        n_in=n_in, donate=True, amp_bf16=amp_bf16)
+        n_in=n_in, donate=True, amp_bf16=amp_bf16,
+        param_dtype=param_dtype)
     # stage batch data onto the mesh with the executable's expected sharding
     # (an AOT-compiled step refuses to re-place host-resident arrays)
     batch_sh = NamedSharding(mesh, P("dp"))
@@ -211,8 +212,10 @@ def _trainer_bench(net, loss_fn, data, label, *, n_in=1, warm=3,
 
 
 def bench_resnet_train(precision):
-    """precision: 'default' (bf16 compute on TPU), 'highest' (fp32), or
-    'amp' (bf16 compute AND activations, fp32 master weights)."""
+    """precision: 'default' (bf16 compute on TPU), 'highest' (fp32),
+    'amp' (bf16 compute AND activations, fp32 master weights), or
+    'bf16all' (bf16 storage for params and optimizer state too; update
+    math in fp32)."""
     import contextlib
     import jax
     import mxnet_tpu as mx
@@ -229,15 +232,19 @@ def bench_resnet_train(precision):
         if precision == "highest" else contextlib.nullcontext()
     with scope:
         net = _resnet(classes=1000, ctx=ctx)
+        import jax.numpy as jnp
         times, flops, spb = _trainer_bench(
             net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), x, y,
             n_blocks=5 if precision != "highest" else 3,
             flops_fallback=_RESNET50_TRAIN_FLOPS * batch, peak=peak,
-            amp_bf16=(precision == "amp"))
+            amp_bf16=(precision == "amp"),
+            param_dtype=jnp.bfloat16 if precision == "bf16all" else None)
     st = _stats(times, spb, batch, flops, peak)
     st["precision"] = {"default": "bf16_compute_fp32_params",
                        "highest": "fp32_highest",
-                       "amp": "bf16_activations_fp32_master"}[precision]
+                       "amp": "bf16_activations_fp32_master",
+                       "bf16all": "bf16_params_activations_optstate"
+                       }[precision]
     st["batch"] = batch
     return st
 
@@ -555,6 +562,11 @@ def main():
             headline = bench_resnet_train("amp")
         except Exception as e:           # pragma: no cover
             extra["resnet50_train_bs32_amp_bf16"] = {"error": repr(e)}
+        try:
+            extra["resnet50_train_bs32_bf16_all"] = \
+                bench_resnet_train("bf16all")
+        except Exception as e:           # pragma: no cover
+            extra["resnet50_train_bs32_bf16_all"] = {"error": repr(e)}
         try:
             extra["resnet50_train_bs32_bf16_fp32_storage"] = \
                 bench_resnet_train("default")

@@ -59,7 +59,8 @@ def _functional_apply(net, trainable, aux, n_in):
 def make_train_step(net, loss_fn, optimizer, mesh, data_spec=None,
                     label_spec=None,
                     param_rules=None, tp_axis="tp", dp_axis="dp",
-                    donate=True, n_in=1, amp_bf16=False):
+                    donate=True, n_in=1, amp_bf16=False,
+                    param_dtype=None):
     """Build ``(step_fn, init_args)`` for SPMD training of ``net``.
 
     - ``net``: an initialized (non-hybridized) Gluon block.
@@ -67,6 +68,14 @@ def make_train_step(net, loss_fn, optimizer, mesh, data_spec=None,
     - ``optimizer``: :class:`FunctionalOptimizer`, eager Optimizer, or name.
     - ``data_spec``: PartitionSpec for each input batch (default: first axis
       sharded over ``dp``).
+    - ``amp_bf16``: fp32 master weights, bf16 compute+activations (AMP).
+    - ``param_dtype=jnp.bfloat16``: pure-bf16 STORAGE — params and
+      optimizer state live in bf16 (half the HBM prefetch traffic of the
+      AMP master copies); the optimizer update itself computes in fp32
+      and rounds back — intra-step arithmetic is exact, but slots still
+      ROUND to bf16 between steps (per-step contributions below the
+      slot's bf16 ulp are lost).  Use amp_bf16 (fp32 master) when exact
+      long-run accumulation matters.
 
     Returns ``(step_fn, state)`` where ``state = (params, opt_state, aux)``
     holds sharded ``jax.Array``s and
@@ -101,7 +110,12 @@ def make_train_step(net, loss_fn, optimizer, mesh, data_spec=None,
     if label_spec is None:
         label_spec = P(dp_axis)
 
-    params = {p.name: jax.device_put(p.data()._data,
+    def _store(a):
+        if param_dtype is not None and a.dtype == jnp.float32:
+            a = a.astype(param_dtype)
+        return a
+
+    params = {p.name: jax.device_put(_store(p.data()._data),
                                      named_sharding(mesh, specs[p.name]))
               for p in trainable}
     aux_arrays = [jax.device_put(p.data()._data, named_sharding(mesh, P()))
@@ -115,11 +129,13 @@ def make_train_step(net, loss_fn, optimizer, mesh, data_spec=None,
     def loss_of(par_dict, aux_raw, data, label, key):
         inputs = data if isinstance(data, tuple) else (data,)
         par_vals = [par_dict[n] for n in names]
-        if amp_bf16:
+        if amp_bf16 or param_dtype is not None:
             # mixed precision, TPU style: fp32 master weights, bf16 compute
             # AND bf16 activations — the fwd/bwd HBM traffic halves, which
             # is the actual bottleneck (measured: ResNet-50 fwd 0.29 → 0.52
-            # MFU).  Gradients flow back through the casts as fp32.
+            # MFU).  Gradients flow back through the casts as fp32.  Under
+            # param_dtype=bf16 the param cast is a no-op (already stored
+            # bf16) and only inputs cast.
             par_vals = [p.astype(jnp.bfloat16) if p.dtype == jnp.float32
                         else p for p in par_vals]
             inputs = tuple(x.astype(jnp.bfloat16)
@@ -137,7 +153,20 @@ def make_train_step(net, loss_fn, optimizer, mesh, data_spec=None,
         params, opt_state, aux_raw = state
         (loss, new_aux), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params, aux_raw, data, label, key)
-        new_params, new_opt = optimizer.update(params, grads, opt_state, t)
+        if param_dtype is not None:
+            # bf16 storage: do the update arithmetic in fp32 (a fused
+            # convert on each side), round the results back to storage
+            f32 = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.float32), (params, grads, opt_state))
+            new_params, new_opt = optimizer.update(*f32[:2], f32[2], t)
+            new_params = {k: v.astype(params[k].dtype)
+                          for k, v in new_params.items()}
+            new_opt = {k: tuple(s.astype(opt_state[k][i].dtype)
+                                for i, s in enumerate(v))
+                       for k, v in new_opt.items()}
+        else:
+            new_params, new_opt = optimizer.update(params, grads,
+                                                   opt_state, t)
         return (new_params, new_opt, new_aux), loss
 
     state_sh = (
@@ -224,6 +253,12 @@ class SPMDTrainer:
     def sync_to_block(self):
         params, _, aux_arrays = self._state
         for p in self._trainable:
-            p.data()._data = params[p.name]
+            arr = params[p.name]
+            want = p.data()._data.dtype
+            if arr.dtype != want:
+                # param_dtype=bf16 storage: the block's Parameters keep
+                # their declared dtype — cast back on the way out
+                arr = arr.astype(want)
+            p.data()._data = arr
         for p, a in zip(self._aux, aux_arrays):
             p.data()._data = a

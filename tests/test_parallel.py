@@ -241,3 +241,46 @@ def test_amp_bf16_train_step_matches_fp32_direction():
     assert losses[True][-1] < losses[True][0] * 0.5, losses[True]
     np.testing.assert_allclose(losses[True][-1], losses[False][-1],
                                rtol=0.15)
+
+
+def test_make_train_step_bf16_param_storage():
+    """param_dtype=bf16: params and optimizer state live in bf16, update
+    math runs in fp32, and the step still learns."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import (FunctionalOptimizer, make_mesh,
+                                    make_train_step)
+    from mxnet_tpu import random as _rnd
+
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(16, activation="relu"), mx.gluon.nn.Dense(2))
+    net.initialize()
+    net(mx.nd.zeros((2, 4)))
+    mesh = make_mesh(n_devices=1, dp=1)
+    step, state = make_train_step(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+        FunctionalOptimizer("sgd", 0.1, momentum=0.9), mesh,
+        param_dtype=jnp.bfloat16)
+    params, opt_state, _ = state
+    for k, v in params.items():
+        assert v.dtype == jnp.bfloat16, (k, v.dtype)
+    for k, slots in opt_state.items():
+        for s in slots:
+            assert s.dtype == jnp.bfloat16, (k, s.dtype)
+
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, 2, 64).astype("float32")
+    x = (np.asarray([[2.0] * 4, [-2.0] * 4], "float32")[y.astype(int)]
+         + rng.randn(64, 4).astype("float32") * 0.3)
+    xj, yj = jax.device_put(x), jax.device_put(y)
+    losses = []
+    for i in range(30):
+        state, loss = step(state, xj, yj, _rnd.next_key(), jnp.uint32(i))
+        losses.append(float(np.asarray(loss)))
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+    # state stays bf16 through the step
+    for k, v in state[0].items():
+        assert v.dtype == jnp.bfloat16
