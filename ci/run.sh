@@ -60,6 +60,11 @@ stage_examples() {
   python example/quantization/quantize_model.py --epochs 4
   python example/profiler/profile_model.py --iters 4
   python example/distributed_training/train_dist.py --iters 5
+  python example/rcnn/train_end2end.py --iters 30
+  python example/model-parallel/matrix_factorization.py
+  python example/gan/dcgan.py --iters 120
+  python example/image-classification/fine-tune.py
+  python example/multi-task/multi_task.py
 }
 
 stage_bench() {

@@ -1,0 +1,109 @@
+"""Multi-task training (reference ``example/multi-task/example_multi_task.py``):
+one shared backbone, two output heads (digit class + parity), trained
+jointly through a ``Group`` symbol with per-head SoftmaxOutput losses and
+scored with two metrics — the reference's multi-loss Module pattern.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build():
+    data = mx.sym.Variable("data")
+    lab1 = mx.sym.Variable("softmax1_label")
+    lab2 = mx.sym.Variable("softmax2_label")
+    h = mx.sym.FullyConnected(data, name="fc1", num_hidden=32)
+    h = mx.sym.Activation(h, act_type="relu")
+    out1 = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, name="fc_cls", num_hidden=4), lab1,
+        name="softmax1")
+    out2 = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, name="fc_par", num_hidden=2), lab2,
+        name="softmax2")
+    return mx.sym.Group([out1, out2])
+
+
+class TwoLabelIter(mx.io.DataIter):
+    """NDArrayIter-alike providing two label blobs per batch."""
+
+    def __init__(self, x, y1, y2, batch_size):
+        super().__init__(batch_size)
+        self.x, self.y1, self.y2 = x, y1, y2
+        self.n = x.shape[0]
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [mx.io.DataDesc("data", (self.batch_size,) +
+                               self.x.shape[1:], np.float32)]
+
+    @property
+    def provide_label(self):
+        return [mx.io.DataDesc("softmax1_label", (self.batch_size,),
+                               np.float32),
+                mx.io.DataDesc("softmax2_label", (self.batch_size,),
+                               np.float32)]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+
+    def next(self):
+        self.cursor += self.batch_size
+        if self.cursor + self.batch_size > self.n:
+            raise StopIteration
+        s = slice(self.cursor, self.cursor + self.batch_size)
+        return mx.io.DataBatch(
+            data=[mx.nd.array(self.x[s])],
+            label=[mx.nd.array(self.y1[s]), mx.nd.array(self.y2[s])],
+            pad=0, index=None)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+
+    n = 512
+    y1 = rng.randint(0, 4, n).astype("float32")
+    y2 = (y1 % 2).astype("float32")
+    x = np.eye(4, dtype="float32")[y1.astype(int)]
+    x = np.repeat(x, 3, axis=1) + rng.randn(n, 12).astype("float32") * 0.15
+
+    it = TwoLabelIter(x, y1, y2, 32)
+    mod = mx.mod.Module(build(), context=mx.cpu(),
+                        label_names=("softmax1_label", "softmax2_label"))
+    mod.fit(it, num_epoch=args.epochs, initializer=mx.init.Xavier(),
+            optimizer="adam", optimizer_params={"learning_rate": 5e-3})
+
+    # score both heads
+    it.reset()
+    correct1 = correct2 = total = 0
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        out1, out2 = mod.get_outputs()
+        p1 = out1.asnumpy().argmax(axis=1)
+        p2 = out2.asnumpy().argmax(axis=1)
+        l1 = batch.label[0].asnumpy()
+        l2 = batch.label[1].asnumpy()
+        correct1 += (p1 == l1).sum()
+        correct2 += (p2 == l2).sum()
+        total += len(l1)
+    acc1, acc2 = correct1 / total, correct2 / total
+    logging.info("INFO multi-task: class acc %.3f, parity acc %.3f",
+                 acc1, acc2)
+    assert acc1 > 0.9 and acc2 > 0.9, (acc1, acc2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
