@@ -30,6 +30,7 @@ HEAVY_TESTS=(
   tests/test_spmd_checkpoint.py
   tests/test_quantization_accuracy.py
   tests/test_layout_nhwc.py
+  tests/test_chip_consistency.py
 )
 
 stage_unit() {
