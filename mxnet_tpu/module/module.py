@@ -162,7 +162,7 @@ class Module(BaseModule):
             self._set_data_parallel(self._exec)
         self.binded = True
         if shared_module is not None and shared_module.params_initialized:
-            self.set_params(*shared_module.get_params())
+            self.set_params(*shared_module.get_params(), allow_extra=True)
         elif self.params_initialized:
             # bound after load: push loaded params into the executor
             self._exec.copy_params_from(self._arg_params, self._aux_params)
@@ -212,6 +212,16 @@ class Module(BaseModule):
                           "init_params call ignored.", stacklevel=2)
             return
         assert self.binded, "call bind before initializing the parameters"
+        if not allow_extra:
+            # reference module.py:589 set_params: unknown keys are an error
+            # unless allow_extra — silently dropping them hides typos in
+            # loaded checkpoints
+            extra = set(arg_params or ()) - set(self._param_names)
+            extra |= set(aux_params or ()) - set(self._aux_names)
+            if extra:
+                raise ValueError(
+                    f"parameters {sorted(extra)} are not present in the "
+                    "symbol (pass allow_extra=True to ignore)")
         attrs = self._symbol.attr_dict()
         for name in self._param_names:
             desc = InitDesc(name, attrs.get(name, {}))
@@ -313,6 +323,11 @@ class Module(BaseModule):
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
+        # reference graph_executor contract: an inference bind allocates no
+        # gradient buffers — backward on it is an error, not a silent
+        # recompute (even though the fused jit COULD recompute here)
+        assert self.for_training, \
+            "backward() on a module bound with for_training=False"
         self._exec.backward(out_grads=out_grads)
 
     def update(self):

@@ -1,0 +1,235 @@
+"""Gluon behavior contracts, tranche 2 (reference
+``tests/python/unittest/test_gluon.py`` families not yet pinned:
+parameter sharing/tying, Constant params, save/load variants,
+SymbolBlock.imports, grad_req setattr, deferred-init errors, cast,
+apply/children, Sequential indexing, name uniqueness, summary).
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def test_parameter_sharing_ties_weights():
+    """reference test_gluon.py test_parameter_sharing: blocks built with
+    params=other.collect_params() train as ONE set of weights."""
+    a = gluon.nn.Dense(4, in_units=3, prefix="tied_")
+    b = gluon.nn.Dense(4, in_units=3, prefix="tied_",
+                       params=a.collect_params())
+    a.initialize()
+    x = mx.nd.ones((2, 3))
+    np.testing.assert_array_equal(a(x).asnumpy(), b(x).asnumpy())
+    # updating through a is visible through b
+    a.weight.set_data(mx.nd.ones((4, 3)) * 2)
+    np.testing.assert_array_equal(b(x).asnumpy(), a(x).asnumpy())
+    assert a.weight is b.weight or \
+        a.weight.data() is b.weight.data()
+
+
+def test_constant_parameter_receives_no_gradient():
+    class WithConst(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.const = self.params.get_constant(
+                    "const", np.asarray([[1.0, 2.0], [3.0, 4.0]],
+                                        "float32"))
+                self.dense = gluon.nn.Dense(2, in_units=2)
+
+        def hybrid_forward(self, F, x, const):
+            return self.dense(x) + F.dot(x, const)
+
+    net = WithConst()
+    net.initialize()
+    x = mx.nd.ones((3, 2))
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    # constant took part in forward but holds no/zero grad
+    g = net.const.grad() if net.const.grad_req != "null" else None
+    assert g is None or float(np.abs(g.asnumpy()).sum()) == 0.0
+    assert float(np.abs(net.dense.weight.grad().asnumpy()).sum()) > 0
+
+
+def test_save_load_parameters_roundtrip_and_flags():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(5, in_units=3), gluon.nn.Dense(2, in_units=5))
+    net.initialize()
+    x = mx.nd.ones((1, 3))
+    want = net(x).asnumpy()
+    d = tempfile.mkdtemp(prefix="gluonsl_")
+    path = os.path.join(d, "p.params")
+    net.save_parameters(path)
+
+    net2 = gluon.nn.HybridSequential()
+    net2.add(gluon.nn.Dense(5, in_units=3), gluon.nn.Dense(2, in_units=5))
+    net2.load_parameters(path)
+    np.testing.assert_allclose(net2(x).asnumpy(), want, rtol=1e-6)
+
+    # ignore_extra: loading into a net with FEWER params
+    net3 = gluon.nn.HybridSequential()
+    net3.add(gluon.nn.Dense(5, in_units=3))
+    with pytest.raises(Exception):
+        net3.load_parameters(path)        # extra keys must raise by default
+    net3.load_parameters(path, ignore_extra=True)
+
+    # allow_missing: loading into a net with MORE params
+    net4 = gluon.nn.HybridSequential()
+    net4.add(gluon.nn.Dense(5, in_units=3), gluon.nn.Dense(2, in_units=5),
+             gluon.nn.Dense(7, in_units=2))
+    with pytest.raises(Exception):
+        net4.load_parameters(path)
+    net4.collect_params().initialize()
+    net4.load_parameters(path, allow_missing=True)
+
+
+def test_symbolblock_imports_runs_exported_model():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, activation="relu", in_units=3),
+            gluon.nn.Dense(2, in_units=4))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((2, 3))
+    want = net(x).asnumpy()
+    d = tempfile.mkdtemp(prefix="symblk_")
+    prefix = os.path.join(d, "m")
+    net.export(prefix)
+    sb = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                   prefix + "-0000.params")
+    np.testing.assert_allclose(sb(x).asnumpy(), want, rtol=1e-6)
+
+
+def test_grad_req_setattr_disables_gradients():
+    net = gluon.nn.Dense(3, in_units=2)
+    net.initialize()
+    net.bias.grad_req = "null"        # freeze ONLY the bias
+    x = mx.nd.ones((2, 2))
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    assert float(np.abs(net.weight.grad().asnumpy()).sum()) > 0
+    with pytest.raises(Exception):
+        net.bias.grad()               # no gradient buffer for null req
+    # freezing everything makes backward a loud error (stricter than the
+    # reference's silent no-op — documented eager error semantics)
+    net.weight.grad_req = "null"
+    with mx.autograd.record():
+        loss = net(x).sum()
+    with pytest.raises(ValueError):
+        loss.backward()
+
+
+def test_deferred_init_access_raises():
+    net = gluon.nn.Dense(3)           # in_units unknown
+    net.initialize()
+    from mxnet_tpu.gluon.parameter import DeferredInitializationError
+    with pytest.raises(DeferredInitializationError):
+        net.weight.data()
+    net(mx.nd.ones((2, 5)))           # materializes
+    assert net.weight.shape == (3, 5)
+
+
+def test_uninitialized_forward_raises():
+    net = gluon.nn.Dense(3, in_units=2)
+    with pytest.raises(Exception):
+        net(mx.nd.ones((1, 2)))
+
+
+def test_block_cast_changes_param_dtype():
+    net = gluon.nn.Dense(3, in_units=2)
+    net.initialize()
+    net.cast("float16")
+    assert net.weight.data().dtype == np.float16
+    out = net(mx.nd.ones((2, 2), dtype="float16"))
+    assert out.dtype == np.float16
+
+
+def test_apply_and_children_iteration():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4), gluon.nn.Dense(2))
+    seen = []
+    net.apply(lambda b: seen.append(type(b).__name__))
+    assert seen.count("Dense") == 2
+    assert len(list(net)) == 2
+    assert isinstance(net[1], gluon.nn.Dense)
+
+
+def test_sequential_prefix_uniqueness():
+    a = gluon.nn.Dense(2)
+    b = gluon.nn.Dense(2)
+    assert a.prefix != b.prefix
+    names = set(a.collect_params()) & set(b.collect_params())
+    assert not names, names
+
+
+def test_summary_prints_shapes():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, activation="relu"), gluon.nn.Dense(2))
+    net.initialize()
+    import io as _io
+    import contextlib
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        net.summary(mx.nd.ones((3, 5)))
+    text = buf.getvalue()
+    assert "Dense" in text
+    # total parameter count = 5*4+4 + 4*2+2 = 34
+    assert "34" in text, text
+
+
+def test_hybridize_then_unhybridized_numerics_match():
+    mx.random.seed(3)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="tanh"), gluon.nn.Dense(3))
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 6).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize(static_alloc=True, static_shape=True)   # flags accepted
+    np.testing.assert_allclose(net(x).asnumpy(), eager, rtol=1e-6)
+
+
+def test_parameter_reset_ctx_and_list_ctx():
+    net = gluon.nn.Dense(2, in_units=2)
+    net.initialize(ctx=mx.cpu(0))
+    assert net.weight.list_ctx() == [mx.cpu(0)]
+    net.collect_params().reset_ctx(mx.cpu(0))
+    out = net(mx.nd.ones((1, 2)))
+    assert out.shape == (1, 2)
+
+
+def test_zero_grad_clears_accumulated():
+    net = gluon.nn.Dense(2, in_units=2)
+    net.initialize()
+    x = mx.nd.ones((1, 2))
+    with mx.autograd.record():
+        net(x).sum().backward()
+    assert float(np.abs(net.weight.grad().asnumpy()).sum()) > 0
+    net.collect_params().zero_grad()
+    assert float(np.abs(net.weight.grad().asnumpy()).sum()) == 0
+
+
+def test_lambda_blocks():
+    """reference test_gluon.py test_lambda: Lambda + HybridLambda."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.HybridLambda("tanh"),
+            gluon.nn.Lambda(lambda x: x * 2))
+    x = mx.nd.array([[0.5, -0.5]])
+    np.testing.assert_allclose(net(x).asnumpy(), np.tanh([[0.5, -0.5]]) * 2,
+                               rtol=1e-6)
+
+
+def test_multi_input_hybrid_block_with_none():
+    class Two(gluon.HybridBlock):
+        def hybrid_forward(self, F, a, b=None):
+            return a * 2 if b is None else a + b
+
+    net = Two()
+    net.hybridize()
+    a = mx.nd.ones((2, 2))
+    b = mx.nd.ones((2, 2)) * 2            # a+b=3 ≠ a*2=2: the two traces
+    np.testing.assert_array_equal(net(a).asnumpy(), np.full((2, 2), 2.0))
+    np.testing.assert_array_equal(net(a, b).asnumpy(), np.full((2, 2), 3.0))

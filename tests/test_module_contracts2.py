@@ -1,0 +1,151 @@
+"""Module behavior contracts, tranche 2 (reference
+``tests/python/unittest/test_module.py`` families: input grads, reshape,
+set_params validation, checkpoint resume incl. optimizer state, dtype,
+forward-shape change re-bind).
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=8)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=2)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_module_input_grads():
+    """inputs_need_grad routes dL/ddata out of the module (reference
+    test_module.py:test_module_input_grads)."""
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))],
+             inputs_need_grad=True)
+    mod.init_params(mx.init.Xavier())
+    batch = mx.io.DataBatch(data=[mx.nd.ones((4, 6))],
+                            label=[mx.nd.array([0, 1, 0, 1])])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    [dgrad] = mod.get_input_grads()
+    assert dgrad.shape == (4, 6)
+    assert float(np.abs(dgrad.asnumpy()).sum()) > 0
+
+
+def test_module_reshape_keeps_params():
+    """reshape to a new batch size without re-init (reference
+    test_module_reshape)."""
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    w_before = mod.get_params()[0]["fc1_weight"].asnumpy()
+    mod.reshape(data_shapes=[("data", (16, 6))],
+                label_shapes=[("softmax_label", (16,))])
+    batch = mx.io.DataBatch(data=[mx.nd.ones((16, 6))],
+                            label=[mx.nd.zeros((16,))])
+    mod.forward(batch, is_train=False)
+    assert mod.get_outputs()[0].shape == (16, 2)
+    np.testing.assert_array_equal(
+        mod.get_params()[0]["fc1_weight"].asnumpy(), w_before)
+
+
+def test_set_params_validates_names():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 6))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(mx.init.Xavier())
+    arg, aux = mod.get_params()
+    bad = dict(arg)
+    bad["not_a_param"] = mx.nd.ones((1,))
+    with pytest.raises(Exception):
+        mod.set_params(bad, aux, allow_extra=False)
+    mod.set_params(bad, aux, allow_extra=True)      # tolerated when asked
+    missing = dict(arg)
+    missing.pop("fc1_weight")
+    with pytest.raises(Exception):
+        mod.set_params(missing, aux, allow_missing=False)
+    mod.set_params(missing, aux, allow_missing=True)
+
+
+def test_checkpoint_resume_continues_optimizer_state():
+    """save_checkpoint + load(load_optimizer_states): momentum carries
+    across the restart — trajectories with and without a restart match
+    (reference test_module.py save/load family)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 6).astype("float32")
+    y = rng.randint(0, 2, 64).astype("float32")
+
+    def make_it():
+        return mx.io.NDArrayIter(x, y, batch_size=16)
+
+    def fit(num_epoch, resume_from=None, save_to=None):
+        mx.random.seed(7)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        it = make_it()
+        kw = {}
+        if resume_from is not None:
+            sym, arg, aux = mx.model.load_checkpoint(*resume_from)
+            mod.bind(data_shapes=it.provide_data,
+                     label_shapes=it.provide_label)
+            mod.set_params(arg, aux)
+            kw["arg_params"], kw["aux_params"] = arg, aux
+            kw["begin_epoch"] = resume_from[1]
+        mod.fit(it, num_epoch=num_epoch, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                initializer=mx.init.Xavier(), force_init=False, **kw)
+        if save_to is not None:
+            mod.save_checkpoint(save_to, num_epoch,
+                                save_optimizer_states=True)
+        return mod
+
+    d = tempfile.mkdtemp(prefix="modresume_")
+    prefix = os.path.join(d, "ck")
+    # straight run: 4 epochs
+    m_straight = fit(4)
+    w_straight = m_straight.get_params()[0]["fc1_weight"].asnumpy()
+    # split run: 2 epochs, checkpoint (incl. optimizer state), resume via
+    # Module.load(load_optimizer_states=True) for 2 more — momentum
+    # carries across the restart so the trajectory MATCHES the straight run
+    fit(2, save_to=prefix)
+    mx.random.seed(7)
+    mod2 = mx.mod.Module.load(prefix, 2, load_optimizer_states=True)
+    it = make_it()
+    mod2.fit(it, num_epoch=4, begin_epoch=2, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    w_resumed = mod2.get_params()[0]["fc1_weight"].asnumpy()
+    np.testing.assert_allclose(w_resumed, w_straight, rtol=1e-4, atol=1e-5)
+
+
+def test_module_fp16_dtype_forward():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc", num_hidden=3)
+    mod = mx.mod.Module(mx.sym.MakeLoss(mx.sym.sum(net)),
+                        label_names=None, context=mx.cpu())
+    mod.bind(data_shapes=[mx.io.DataDesc("data", (2, 4), np.float16)],
+             for_training=False)
+    mod.init_params(mx.init.One())
+    batch = mx.io.DataBatch(data=[mx.nd.ones((2, 4), dtype="float16")],
+                            label=None)
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_backward_without_training_bind_raises():
+    """for_training=False bind + backward = loud error (reference
+    executor contract: no grad arrays were allocated)."""
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 6))],
+             label_shapes=[("softmax_label", (2,))], for_training=False)
+    mod.init_params(mx.init.Xavier())
+    batch = mx.io.DataBatch(data=[mx.nd.ones((2, 6))],
+                            label=[mx.nd.zeros((2,))])
+    mod.forward(batch, is_train=False)
+    with pytest.raises(Exception):
+        mod.backward()
