@@ -121,6 +121,21 @@ class BaseModule:
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
+        # reference module.py:589: set_params (NOT init_params — fit's
+        # arg_params resume path stays permissive) rejects unknown keys
+        # unless allow_extra; silently dropping them hides checkpoint typos
+        if not allow_extra:
+            known_args = getattr(self, "_param_names", None)
+            known_aux = getattr(self, "_aux_names", None)
+            extra = set()
+            if known_args is not None:
+                extra |= set(arg_params or ()) - set(known_args)
+            if known_aux is not None:
+                extra |= set(aux_params or ()) - set(known_aux)
+            if extra:
+                raise ValueError(
+                    f"parameters {sorted(extra)} are not present in the "
+                    "symbol (pass allow_extra=True to ignore)")
         self.init_params(initializer=None, arg_params=arg_params,
                          aux_params=aux_params, allow_missing=allow_missing,
                          force_init=force_init, allow_extra=allow_extra)

@@ -212,16 +212,6 @@ class Module(BaseModule):
                           "init_params call ignored.", stacklevel=2)
             return
         assert self.binded, "call bind before initializing the parameters"
-        if not allow_extra:
-            # reference module.py:589 set_params: unknown keys are an error
-            # unless allow_extra — silently dropping them hides typos in
-            # loaded checkpoints
-            extra = set(arg_params or ()) - set(self._param_names)
-            extra |= set(aux_params or ()) - set(self._aux_names)
-            if extra:
-                raise ValueError(
-                    f"parameters {sorted(extra)} are not present in the "
-                    "symbol (pass allow_extra=True to ignore)")
         attrs = self._symbol.attr_dict()
         for name in self._param_names:
             desc = InitDesc(name, attrs.get(name, {}))

@@ -200,13 +200,13 @@ class NDArray:
 
     # --------------------------------------------------------------- indexing
     def __getitem__(self, key):
-        key = _index_key(key)
+        key = _index_key(key, self.shape)
         if _ag.is_recording() and self._ag_node is not None:
             return invoke_fn(lambda x: x[key], [self], op_name="_slice")
         return _wrap(self._data[key])
 
     def __setitem__(self, key, value):
-        key = _index_key(key)
+        key = _index_key(key, self.shape)
         if _ag.is_recording() and self._ag_node is not None:
             # Route the functional scatter through the tape so backward sees
             # the post-mutation graph (the reference forbids/handles in-place
@@ -461,11 +461,54 @@ class NDArray:
         return invoke_op("dot", [self, other], kwargs)
 
 
-def _index_key(key):
+def _index_raw(k):
+    """NDArray indexer → jax indexer.  MXNet index arrays default to
+    float32 (reference advanced indexing accepts them, ndarray.py
+    _get_nd_basic_indexing casts) — floats become int32; boolean and
+    integer indexers pass through."""
+    raw = k._data
+    if jnp.issubdtype(raw.dtype, jnp.floating):
+        raw = raw.astype(jnp.int32)
+    return raw
+
+
+def _check_int_bounds(key, shape):
+    """IndexError on out-of-range static int indices (reference NDArray
+    raises; jax would silently CLAMP them — a wrong-row read, not an
+    error)."""
+    keys = key if isinstance(key, tuple) else (key,)
+    # only pure basic indexing is checked: masks and index arrays follow
+    # advanced/take semantics (clamp like nd.take), and a bool/array
+    # element consumes a variable number of axes the walker cannot track
+    if any(isinstance(k, (bool, _np.bool_, NDArray, _np.ndarray))
+           or hasattr(k, "dtype") for k in keys):
+        return
+    dim = 0
+    for pos, k in enumerate(keys):
+        if k is None:
+            continue
+        if k is Ellipsis:
+            # dims after the ellipsis count from the right
+            rest = sum(1 for kk in keys[pos + 1:]
+                       if kk is not None and kk is not Ellipsis)
+            dim = len(shape) - rest
+            continue
+        if isinstance(k, (int, _np.integer)) and dim < len(shape):
+            if not -shape[dim] <= k < shape[dim]:
+                raise IndexError(
+                    f"index {k} is out of bounds for axis {dim} with "
+                    f"size {shape[dim]}")
+        dim += 1
+
+
+def _index_key(key, shape=None):
+    if shape is not None:
+        _check_int_bounds(key, shape)
     if isinstance(key, NDArray):
-        return key._data
+        return _index_raw(key)
     if isinstance(key, tuple):
-        return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+        return tuple(_index_raw(k) if isinstance(k, NDArray) else k
+                     for k in key)
     return key
 
 

@@ -1,0 +1,152 @@
+"""NDArray indexing contracts (reference
+``tests/python/unittest/test_ndarray.py``: test_getitem/test_setitem/
+advanced-indexing families — MXNet accepts float32 index arrays, the
+historical default dtype).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _x():
+    return mx.nd.array(np.arange(24).reshape(4, 6).astype("float32"))
+
+
+def test_basic_slicing_matches_numpy():
+    x = _x()
+    n = x.asnumpy()
+    for key in [slice(1, 3), slice(None, None, 2), slice(None, None, -1),
+                (slice(1, 3), slice(2, 5)), (slice(None), slice(1, None, 2)),
+                2, -1, (2, 3), Ellipsis, (Ellipsis, 1), None,
+                (slice(None), None)]:
+        np.testing.assert_array_equal(x[key].asnumpy(), n[key],
+                                      err_msg=str(key))
+
+
+def test_advanced_indexing_with_float_index_array():
+    """Reference accepts float32 index NDArrays (the default dtype)."""
+    x = _x()
+    idx = mx.nd.array([0.0, 2.0, 3.0])          # float32!
+    np.testing.assert_array_equal(x[idx].asnumpy(),
+                                  x.asnumpy()[[0, 2, 3]])
+    idx2 = mx.nd.array([1, 1, 0], dtype="int32")
+    np.testing.assert_array_equal(x[idx2].asnumpy(),
+                                  x.asnumpy()[[1, 1, 0]])
+
+
+def test_advanced_indexing_in_tuple():
+    x = _x()
+    rows = mx.nd.array([0.0, 3.0])
+    got = x[rows, 2].asnumpy()
+    np.testing.assert_array_equal(got, x.asnumpy()[[0, 3], 2])
+
+
+def test_setitem_scalar_slice_and_array():
+    x = _x()
+    n = x.asnumpy().copy()
+    x[1:3] = 7.0
+    n[1:3] = 7.0
+    np.testing.assert_array_equal(x.asnumpy(), n)
+    v = np.ones((2, 3), "float32") * 5
+    x[0:2, 0:3] = mx.nd.array(v)
+    n[0:2, 0:3] = v
+    np.testing.assert_array_equal(x.asnumpy(), n)
+    # broadcast setitem: row vector across the selected block
+    x[:, 0:2] = mx.nd.array([[9.0, 8.0]])
+    n[:, 0:2] = np.asarray([[9.0, 8.0]])
+    np.testing.assert_array_equal(x.asnumpy(), n)
+
+
+def test_setitem_with_float_index_array():
+    x = _x()
+    n = x.asnumpy().copy()
+    x[mx.nd.array([0.0, 2.0])] = 1.5
+    n[[0, 2]] = 1.5
+    np.testing.assert_array_equal(x.asnumpy(), n)
+
+
+def test_getitem_under_autograd_routes_gradient():
+    x = mx.nd.array(np.arange(6, dtype="float32"))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = x[1:4]
+        (y * y).sum().backward()
+    want = np.zeros(6, "float32")
+    want[1:4] = 2 * np.arange(1, 4)
+    np.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-6)
+
+
+def test_setitem_under_autograd_masks_gradient():
+    """Writing a constant into a recorded array: the overwritten region's
+    upstream gradient is cut (the write is itself a recorded op)."""
+    x = mx.nd.array(np.arange(6, dtype="float32"))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = x * 2.0
+        y[0:2] = 0.0
+        y.sum().backward()
+    want = np.full(6, 2.0, "float32")
+    want[0:2] = 0.0
+    np.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-6)
+
+
+def test_getitem_returns_value_not_alias():
+    x = _x()
+    s = x[1:3]
+    s[:] = 0.0
+    # functional arrays: mutating the slice must not corrupt the base
+    # (stricter than the reference's shared-memory views — documented)
+    assert float(np.abs(x.asnumpy()[1:3]).sum()) > 0
+
+
+def test_scalar_item_and_asscalar():
+    x = _x()
+    assert float(x[2, 3].asnumpy()) == 15.0
+    assert x[0, 0].asscalar() == 0.0
+
+
+def test_negative_and_out_of_range_int_index():
+    x = _x()
+    np.testing.assert_array_equal(x[-1].asnumpy(), x.asnumpy()[-1])
+    with pytest.raises(Exception):
+        _ = x[7]
+
+
+def test_index_chain_equivalence():
+    x = _x()
+    np.testing.assert_array_equal(x[1][2:4].asnumpy(),
+                                  x.asnumpy()[1][2:4])
+
+
+def test_bool_scalar_and_mask_indexing():
+    x = _x()
+    n = x.asnumpy()
+    # scalar bool adds an axis (numpy semantics) — must NOT be treated as
+    # an integer index by the bounds checker
+    np.testing.assert_array_equal(x[True].asnumpy(), n[True])
+    assert x[False].shape == n[False].shape
+    # explicit boolean mask array
+    mask = np.zeros(4, dtype=bool)
+    mask[1] = mask[3] = True
+    np.testing.assert_array_equal(x[mask].asnumpy(), n[mask])
+
+
+def test_fit_resume_with_extra_checkpoint_keys_stays_permissive():
+    """init_params via fit(arg_params=...) ignores extra keys (reference
+    behavior — only set_params validates)."""
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, name="fc", num_hidden=2), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 3))],
+             label_shapes=[("softmax_label", (4,))])
+    extra_args = {"fc_weight": mx.nd.ones((2, 3)),
+                  "fc_bias": mx.nd.zeros((2,)),
+                  "leftover_from_bigger_model": mx.nd.ones((5,))}
+    mod.init_params(arg_params=extra_args, aux_params={},
+                    allow_missing=False)          # extras tolerated here
+    with pytest.raises(ValueError):
+        mod.set_params(extra_args, {}, allow_extra=False)
+    mod.set_params(extra_args, {}, allow_extra=True)
