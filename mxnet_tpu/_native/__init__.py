@@ -33,15 +33,20 @@ _NOJPEG_MARKER = _LIB_PATH + ".nojpeg"
 
 
 def _build():
+    # Link to a temp path and os.replace() over _LIB_PATH: relinking in
+    # place would truncate an inode that may still be mapped in-process
+    # (the staleness probe dlopens it), risking SIGBUS / a stale mapping.
+    tmp = f"{_LIB_PATH}.tmp.{os.getpid()}"
     # jpeg_decode.cc needs libjpeg; try with it first, fall back to the
     # reader-only library when the dev package is absent (decode then uses
     # the cv2 Python path)
     if os.path.exists(_SRC_JPEG):
         cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
                os.path.abspath(_SRC), os.path.abspath(_SRC_JPEG),
-               "-o", _LIB_PATH, "-ljpeg"]
+               "-o", tmp, "-ljpeg"]
         try:
             subprocess.run(cmd, check=True, capture_output=True)
+            os.replace(tmp, _LIB_PATH)
             if os.path.exists(_NOJPEG_MARKER):
                 os.remove(_NOJPEG_MARKER)
             return
@@ -50,8 +55,9 @@ def _build():
                 f.write("libjpeg link failed; delete this file (or touch "
                         "src/io/*.cc) after installing libjpeg to retry\n")
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-           os.path.abspath(_SRC), "-o", _LIB_PATH]
+           os.path.abspath(_SRC), "-o", tmp]
     subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, _LIB_PATH)
 
 
 def load():
@@ -74,7 +80,10 @@ def load():
                 if not hasattr(probe, "jpg_decode_batch") and \
                         not os.path.exists(_NOJPEG_MARKER):
                     stale = True
+                handle = probe._handle
                 del probe
+                import _ctypes
+                _ctypes.dlclose(handle)
             if stale:
                 _build()
             lib = ctypes.CDLL(_LIB_PATH)

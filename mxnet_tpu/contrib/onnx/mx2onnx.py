@@ -75,9 +75,24 @@ class _Ctx:
         return node["outputs"][0]
 
 
+def _require_channel_first(name, attrs):
+    """ONNX Conv/Pool semantics are channel-first; exporting an NHWC-built
+    node as-is would silently emit wrong-axis kernel_shape/pads."""
+    layout = attrs.get("layout")
+    if layout in (None, "None", ""):        # default = channel-first
+        return
+    layout = str(layout)
+    if layout[1] != "C":
+        raise NotImplementedError(
+            f"ONNX export of node {name!r} with channel-last layout "
+            f"{layout!r} is not supported — rebuild the network with the "
+            f"default channel-first layout (e.g. NCHW) before exporting")
+
+
 # --------------------------------------------------------------- converters
 @register("Convolution")
 def _conv(ctx, name, ins, attrs):
+    _require_channel_first(name, attrs)
     kernel = _tuple2(attrs.get("kernel"), (1, 1))
     a = {"kernel_shape": kernel,
          "strides": _tuple2(attrs.get("stride"), (1,) * len(kernel)),
@@ -90,6 +105,7 @@ def _conv(ctx, name, ins, attrs):
 
 @register("Deconvolution")
 def _deconv(ctx, name, ins, attrs):
+    _require_channel_first(name, attrs)
     kernel = _tuple2(attrs.get("kernel"), (1, 1))
     pad = _tuple2(attrs.get("pad"), (0,) * len(kernel))
     a = {"kernel_shape": kernel,
@@ -138,6 +154,7 @@ def _leaky(ctx, name, ins, attrs):
 
 @register("Pooling")
 def _pooling(ctx, name, ins, attrs):
+    _require_channel_first(name, attrs)
     ptype = attrs.get("pool_type", "max")
     if _parse(attrs.get("global_pool"), False) in (True, 1, "True"):
         op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[ptype]

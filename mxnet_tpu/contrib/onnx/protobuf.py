@@ -108,7 +108,12 @@ def _parse_tensor(buf: bytes):
     float_data, int32_data, int64_data, double_data = [], [], [], []
     for field, wire, val in _iter_fields(buf):
         if field == 1:
-            dims.append(_signed64(val))
+            # proto3 serializers emit repeated int64 dims packed (wire 2);
+            # proto2-style emitters use one varint per dim (wire 0)
+            if wire == 2:
+                dims.extend(_signed64(v) for v in _unpack_varints(val))
+            else:
+                dims.append(_signed64(val))
         elif field == 2:
             dtype = val
         elif field == 8:

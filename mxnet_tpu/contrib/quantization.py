@@ -130,6 +130,21 @@ def _optimal_threshold(hist, hist_edges, num_quantized_bins=255):
     return best_t
 
 
+def _stable_node_keys(sym):
+    """id(node) → stable string key ``'<name>#<dup>'`` where ``<dup>``
+    disambiguates repeated names (Gluon-traced graphs name every op "fwd")
+    by topo-order occurrence.  Deterministic for a given graph structure,
+    so threshold dicts keyed this way serialize and survive graph copies —
+    unlike the id()-based keys used before r4."""
+    counts = {}
+    key_of = {}
+    for node in sym._topo():
+        k = counts.get(node.name, 0)
+        counts[node.name] = k + 1
+        key_of[id(node)] = f"{node.name}#{k}"
+    return key_of
+
+
 def _collect_thresholds(sym, arg_params, aux_params, calib_data,
                         data_names, num_calib_examples, logger,
                         mode="naive"):
@@ -138,23 +153,28 @@ def _collect_thresholds(sym, arg_params, aux_params, calib_data,
     histograms + KL threshold search ('entropy',
     ``_LayerHistogramCollector``)."""
     # identify the parent outputs feeding quantizable nodes.  Keys are
-    # (id(parent), out_idx) — NOT names: Gluon-traced graphs name every op
-    # "fwd", so name keys would merge different layers' statistics into
-    # one threshold (and did, before r3)
+    # stable strings '<name>#<dup>:<out_idx>' (see _stable_node_keys) —
+    # NOT bare names: Gluon-traced graphs name every op "fwd", so name
+    # keys would merge different layers' statistics into one threshold
+    # (and did, before r3).  Unlike the r3 id()-based keys, these survive
+    # serialization and remain valid across graph copies.
+    key_of = _stable_node_keys(sym)
     want = {}
     for node in sym._topo():
         if node.op is not None and node.op.name in _QUANTIZABLE:
             p, i = node.inputs[0]
-            want[(id(p), i)] = p.name
+            want[f"{key_of[id(p)]}:{i}"] = p.name
     if not want:
         return {}
     # bind an executor producing every wanted internal output
     nodes_syms = []
     names = []
     for node in sym._topo():
-        for key, pname in want.items():
-            if key[0] == id(node):
-                nodes_syms.append((node, key[1]))
+        base = key_of[id(node)]
+        for key in want:
+            skey, _, idx = key.rpartition(":")
+            if skey == base:
+                nodes_syms.append((node, int(idx)))
                 names.append(key)
     from ..symbol.symbol import Group
     probe = Group([Symbol([(n, i)]) for (n, i) in nodes_syms])
@@ -213,19 +233,48 @@ def _collect_thresholds(sym, arg_params, aux_params, calib_data,
 
 def quantize_graph(sym, arg_params, thresholds, excluded_sym_names=(),
                    quantized_dtype="int8"):
-    """Insert fake-quant pairs on data+weight inputs of quantizable nodes."""
+    """Insert fake-quant pairs on data+weight inputs of quantizable nodes.
+
+    ``thresholds`` keys: the stable ``'<name>#<dup>:<out_idx>'`` strings
+    produced by calibration (see ``_stable_node_keys``); bare parent names
+    are also accepted for externally computed tables on graphs with unique
+    node names.  If ``thresholds`` is non-empty but no key matches any
+    quantizable input, a ValueError is raised — a stale/mis-keyed table
+    must fail loudly, not silently skip fake-quantization.
+    """
     excluded = set(excluded_sym_names or ())
+    key_of = _stable_node_keys(sym)
+    name_counts = {}
+    for node in sym._topo():
+        name_counts[node.name] = name_counts.get(node.name, 0) + 1
+    matched = set()
+    considered = [0]     # non-excluded quantizable nodes seen
 
     def node_fn(node, ins):
         if node.op is None or node.op.name not in _QUANTIZABLE or \
                 node.name in excluded:
             return None
+        considered[0] += 1
         new_ins = list(ins)
         # data input: calibrated range (skip when uncalibrated).  Like the
         # reference's 'auto' dtype, a non-negative range quantizes to uint8
         # (full 256 levels on [0, t]); signed ranges use symmetric int8.
-        pkey = (id(node.inputs[0][0]), node.inputs[0][1])
+        p, i = node.inputs[0]
+        pkey = f"{key_of[id(p)]}:{i}"
+        if pkey not in thresholds and p.name in thresholds:
+            # legacy name-keyed tables — only safe when the name is unique
+            # in this graph (Gluon-traced graphs name every op "fwd"; one
+            # shared threshold silently merging every layer's range is the
+            # pre-r3 bug, so duplicates must fail the lookup loudly below)
+            if name_counts.get(p.name, 0) > 1:
+                raise ValueError(
+                    f"quantize_graph: legacy name-keyed threshold "
+                    f"{p.name!r} is ambiguous — {name_counts[p.name]} "
+                    f"nodes share that name; recalibrate to get stable "
+                    f"'<name>#<dup>:<out_idx>' keys")
+            pkey = p.name
         if pkey in thresholds:
+            matched.add(pkey)
             mn, mx = thresholds[pkey]
             ddtype = "uint8" if (mn >= 0 and quantized_dtype
                                  in ("int8", "auto", "uint8")) \
@@ -241,7 +290,15 @@ def quantize_graph(sym, arg_params, thresholds, excluded_sym_names=(),
         return _invoke_sym(node.op, new_ins, dict(node.attrs),
                            name=node.name)
 
-    return _rebuild(sym, node_fn)
+    out = _rebuild(sym, node_fn)
+    if thresholds and considered[0] and not matched:
+        raise ValueError(
+            "quantize_graph: none of the %d threshold keys matched any "
+            "quantizable node input — the table is stale or keyed under a "
+            "different scheme (expected '<name>#<dup>:<out_idx>' stable "
+            "keys from calibration, or bare parent names); sample keys: %r"
+            % (len(thresholds), list(thresholds)[:3]))
+    return out
 
 
 def quantize_model(sym, arg_params, aux_params, data_names=("data",),

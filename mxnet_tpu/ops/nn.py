@@ -281,16 +281,23 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     red_axes = tuple(i for i in range(data.ndim) if i != ax)
     training = parse_bool(__training__) and not parse_bool(use_global_stats)
     if training:
-        # one fused pass over the activation: E[x] and E[x²] together
+        # one fused pass over the activation: E[x-p] and E[(x-p)²] together
         # (jnp.var would re-read the tensor a second time for Σ(x-μ)² —
         # at ResNet-50 scale that second HBM pass is ~2ms/step on a v5e).
-        # Accumulate in f32 regardless of compute dtype; var via
-        # E[x²]−E[x]² clamped at 0, the standard fused-BN formulation.
-        x32 = data.astype(jnp.float32)
-        mean32 = jnp.mean(x32, axis=red_axes)
-        meansq32 = jnp.mean(x32 * x32, axis=red_axes)
-        var32 = jnp.maximum(meansq32 - mean32 * mean32, 0.0)
-        mean = mean32.astype(data.dtype)
+        # The per-channel pivot p (first element along the reduce axes)
+        # keeps the f32 E[x²]−E[x]² subtraction from cancelling when
+        # |mean| ≫ std; variance is shift-invariant so any pivot near the
+        # data restores full precision. The subtract fuses into the same
+        # HBM pass.
+        idx = tuple(slice(None) if i == ax else 0 for i in range(data.ndim))
+        pshape = [1] * data.ndim
+        pshape[ax] = data.shape[ax]
+        pivot32 = lax.stop_gradient(data[idx]).astype(jnp.float32)
+        d32 = data.astype(jnp.float32) - jnp.reshape(pivot32, pshape)
+        dmean32 = jnp.mean(d32, axis=red_axes)
+        dmeansq32 = jnp.mean(d32 * d32, axis=red_axes)
+        var32 = jnp.maximum(dmeansq32 - dmean32 * dmean32, 0.0)
+        mean = (pivot32 + dmean32).astype(data.dtype)
         var = var32.astype(data.dtype)
     else:
         mean, var = moving_mean, moving_var
@@ -331,13 +338,22 @@ def sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     red_axes = tuple(i for i in range(data.ndim) if i != 1)
     training = parse_bool(__training__) and not parse_bool(use_global_stats)
     if training:
-        x32 = data.astype(jnp.float32)
-        mean = _cross_replica_mean(jnp.mean(x32, axis=red_axes), axis_name)
-        mean_sq = _cross_replica_mean(jnp.mean(x32 * x32, axis=red_axes),
+        # same shifted single-pass moments as batch_norm (E[x²]−E[x]² in
+        # f32 cancels when |mean| ≫ std); the pivot is pmean'd so every
+        # replica shifts by the identical constant before aggregation.
+        idx = tuple(slice(None) if i == 1 else 0 for i in range(data.ndim))
+        pshape = [1] * data.ndim
+        pshape[1] = data.shape[1]
+        pivot32 = _cross_replica_mean(
+            lax.stop_gradient(data[idx]).astype(jnp.float32), axis_name)
+        d32 = data.astype(jnp.float32) - jnp.reshape(pivot32, pshape)
+        dmean32 = _cross_replica_mean(jnp.mean(d32, axis=red_axes),
                                       axis_name)
-        var = jnp.maximum(mean_sq - mean * mean, 0.0)
-        mean = mean.astype(data.dtype)
-        var = var.astype(data.dtype)
+        dmeansq32 = _cross_replica_mean(jnp.mean(d32 * d32, axis=red_axes),
+                                        axis_name)
+        var = jnp.maximum(dmeansq32 - dmean32 * dmean32, 0.0) \
+            .astype(data.dtype)
+        mean = (pivot32 + dmean32).astype(data.dtype)
     else:
         mean, var = moving_mean, moving_var
     shape = [1] * data.ndim

@@ -268,3 +268,28 @@ def test_golden_bytes_fixture_stable():
         assert f.read() == data, (
             "ONNX wire emission changed for an identical graph — if "
             "intentional, regenerate tests/fixtures/golden_tiny.onnx")
+
+
+def test_parse_tensor_packed_dims():
+    """proto3 serializers emit TensorProto.dims packed (wire type 2);
+    the parser must accept both packed and unpacked forms (ADVICE r3)."""
+    import numpy as np
+    from mxnet_tpu.contrib.onnx import protobuf as pb
+    arr = np.arange(12, dtype="float32").reshape(3, 4)
+    buf = pb._tensor_proto("t", arr)
+    # re-encode dims [3, 4] as one packed field-1 entry, dropping the two
+    # unpacked varint entries the encoder emitted
+    out = bytearray()
+    packed = bytearray()
+    for f, w, v in pb._iter_fields(buf):
+        if f == 1:
+            packed += pb._varint(v)
+        elif w == 0:
+            out += pb._f_varint(f, v)
+        else:
+            out += pb._len_delim(f, v)
+    out = pb._len_delim(1, bytes(packed)) + bytes(out)
+    name, parsed = pb._parse_tensor(bytes(out))
+    assert name == "t"
+    assert parsed.shape == (3, 4)
+    assert np.array_equal(parsed, arr)

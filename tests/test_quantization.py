@@ -130,3 +130,34 @@ def test_optimal_threshold_clips_outliers():
     hist, edges = np.histogram(vals, bins=2048, range=(-60, 60))
     t = qz._optimal_threshold(hist, edges)
     assert t < 30  # the single outlier must not set the range
+
+
+def test_threshold_keys_are_serializable_strings():
+    """Calibration tables use stable '<name>#<dup>:<out_idx>' string keys
+    (r4: the r3 id()-based keys could not be persisted and silently went
+    stale across graph copies)."""
+    import json
+    rng = np.random.RandomState(2)
+    x = rng.randn(32, 8).astype("float32")
+    sym = _mlp()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    it = mx.io.NDArrayIter(x, np.zeros(32, "float32"), batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    arg_params, aux_params = mod.get_params()
+    th = qz._collect_thresholds(sym, arg_params, aux_params, it,
+                                ["data"], 32, None, mode="naive")
+    assert th and all(isinstance(k, str) for k in th)
+    # round-trips through JSON and still applies to a fresh graph copy
+    th2 = json.loads(json.dumps(th))
+    qsym = qz.quantize_graph(_mlp(), arg_params, th2)
+    names = [n.op.name for n in qsym._topo() if n.op is not None]
+    assert "_contrib_quantize_v2" in names
+
+
+def test_stale_threshold_table_fails_loudly():
+    """A threshold table whose keys match nothing raises instead of
+    silently skipping every fake-quant insertion."""
+    sym = _mlp()
+    with pytest.raises(ValueError, match="none of the .* threshold keys"):
+        qz.quantize_graph(sym, {}, {"no_such_node:0": (0.0, 1.0)})
