@@ -410,7 +410,7 @@ def bench_int8_infer():
                               batch_size=batch)
     qsym, qarg, qaux = quantize_model(
         sym, arg_params, aux_params, calib_mode="naive", calib_data=calib,
-        num_calib_examples=batch)
+        num_calib_examples=batch, lowering="fused_int8")
     ex = qsym.bind(ctx, {**{k: v.as_in_context(ctx) for k, v in qarg.items()},
                          "data": mx.nd.array(x, ctx=ctx)},
                    aux_states={k: v.as_in_context(ctx)
@@ -445,6 +445,7 @@ def bench_int8_infer():
                          lambda: float(np.asarray(holder["m"])))
     st = _stats(times, 30, batch, flops, peak)
     st["precision"] = "int8_weights_activations_int32_accum"
+    st["lowering"] = "fused_int8_mxu"
     st["batch"] = batch
     return st
 

@@ -1,0 +1,137 @@
+"""Microbenchmark: int8 vs bf16 convolution on the MXU.
+
+Establishes whether XLA lowers ``conv_general_dilated`` with int8 taps and
+``preferred_element_type=int32`` to the v5e's int8 MXU passes (nominal
+~2x bf16 peak), and what a fused int8-in/int8-out layer (conv + static
+requant epilogue) costs vs the bf16 equivalent.  This is the measurement
+the r4 int8-inference work is built on (VERDICT r3 item 1): the reference
+gets its quantization speedup from cuDNN/MKL-DNN int8 kernels
+(/root/reference/src/operator/quantization/quantized_conv.cc); the TPU
+equivalent is the MXU int8 path, reached purely through XLA dtypes.
+
+Usage: python benchmark/int8_micro.py [--layers N] [--blocks B]
+"""
+import argparse
+import json
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layers", type=int, default=12,
+                    help="conv layers chained per jit call")
+    ap.add_argument("--blocks", type=int, default=5)
+    ap.add_argument("--steps", type=int, default=10,
+                    help="chained jit calls per timed block")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from bench import _time_blocks, _bf16_peak
+
+    peak = _bf16_peak() or 197e12
+
+    # (N, C, H, W) with C->C 3x3 pad=1: shape-preserving so layers chain
+    shapes = [
+        ("b32_c64_hw56", (32, 64, 56, 56)),
+        ("b32_c128_hw28", (32, 128, 28, 28)),
+        ("b32_c256_hw14", (32, 256, 14, 14)),
+        ("b32_c512_hw7", (32, 512, 7, 7)),
+    ]
+    L = args.layers
+    results = {}
+    rng = np.random.RandomState(0)
+
+    def time_fn(fn, x, flops_per_call):
+        compiled = jax.jit(fn).lower(x).compile()
+        holder = {"x": compiled(x)}
+
+        def block():
+            for _ in range(args.steps):
+                holder["x"] = compiled(holder["x"])
+
+        block()  # warm
+        jnp.sum(holder["x"].astype(jnp.float32)).block_until_ready()
+
+        def sync():
+            return float(np.asarray(
+                jnp.sum(holder["x"][0, 0].astype(jnp.float32))))
+
+        times = _time_blocks(block, args.blocks, sync)
+        per_call = float(np.median(times)) / args.steps
+        return per_call, flops_per_call / per_call / 1e12
+
+    for name, (n, c, h, w) in shapes:
+        wk_f = rng.randn(c, c, 3, 3).astype(np.float32) * 0.05
+        x_f = rng.randn(n, c, h, w).astype(np.float32)
+        wk8 = np.clip(np.round(wk_f * 127 / np.abs(wk_f).max()),
+                      -127, 127).astype(np.int8)
+        x8 = np.clip(np.round(x_f * 31), -127, 127).astype(np.int8)
+        flops = 2.0 * n * c * c * 9 * h * w * L
+
+        dn = ("NCHW", "OIHW", "NCHW")
+
+        w_bf = jax.device_put(wk_f.astype(jnp.bfloat16))
+
+        def bf16_chain(x, w_bf=w_bf):
+            for _ in range(L):
+                x = jax.lax.conv_general_dilated(
+                    x, w_bf, (1, 1), ((1, 1), (1, 1)),
+                    dimension_numbers=dn)
+                x = jnp.maximum(x, 0)
+            return x
+
+        w_i8 = jax.device_put(wk8)
+        scale = jnp.float32(1 / (31.0 * 127.0))
+
+        def int8_chain(x, w_i8=w_i8):
+            # int8 in -> int32 acc -> static-scale requant epilogue -> int8
+            for _ in range(L):
+                acc = jax.lax.conv_general_dilated(
+                    x, w_i8, (1, 1), ((1, 1), (1, 1)),
+                    dimension_numbers=dn,
+                    preferred_element_type=jnp.int32)
+                f = acc.astype(jnp.float32) * scale
+                f = jnp.maximum(f, 0)            # relu
+                x = jnp.clip(jnp.round(f * 31.0), -127, 127) \
+                    .astype(jnp.int8)
+            return x
+
+        def int8_noepi(x, w_i8=w_i8):
+            # int8 conv, epilogue kept int32->int8 shift only (no float)
+            for _ in range(L):
+                acc = jax.lax.conv_general_dilated(
+                    x, w_i8, (1, 1), ((1, 1), (1, 1)),
+                    dimension_numbers=dn,
+                    preferred_element_type=jnp.int32)
+                x = jnp.clip(acc >> 7, -127, 127).astype(jnp.int8)
+            return x
+
+        x_bf = jax.device_put(x_f.astype(jnp.bfloat16))
+        x_i8 = jax.device_put(x8)
+
+        t_bf, tf_bf = time_fn(bf16_chain, x_bf, flops)
+        t_i8, tf_i8 = time_fn(int8_chain, x_i8, flops)
+        t_i8s, tf_i8s = time_fn(int8_noepi, x_i8, flops)
+        results[name] = {
+            "bf16_ms": round(t_bf * 1e3, 3),
+            "bf16_tflops": round(tf_bf, 1),
+            "bf16_mfu": round(tf_bf * 1e12 / peak, 3),
+            "int8_ms": round(t_i8 * 1e3, 3),
+            "int8_tflops": round(tf_i8, 1),
+            "int8_vs_bf16": round(t_bf / t_i8, 2),
+            "int8_shift_ms": round(t_i8s * 1e3, 3),
+            "int8_shift_vs_bf16": round(t_bf / t_i8s, 2),
+        }
+        print(name, json.dumps(results[name]), flush=True)
+
+    print(json.dumps({"layers": L, "results": results}))
+
+
+if __name__ == "__main__":
+    main()

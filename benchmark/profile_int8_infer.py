@@ -1,0 +1,79 @@
+"""Per-op device profile of the fused int8 ResNet-50 inference step.
+
+Answers VERDICT r4 item 1's verification demand: which ops the quantized
+step actually spends device time in (int8 MXU dots vs bf16 convs vs
+requant epilogues vs layout ops).
+"""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu.contrib.quantization import quantize_model
+from __graft_entry__ import _resnet
+
+
+def main():
+    batch = 32
+    rng = np.random.RandomState(0)
+    accel = [d for d in jax.devices() if d.platform != "cpu"]
+    ctx = mx.gpu(0) if accel else mx.cpu(0)
+    net = _resnet(classes=1000, ctx=ctx)
+    x = rng.rand(batch, 3, 224, 224).astype("float32")
+    d = tempfile.mkdtemp(prefix="q8prof_")
+    prefix = os.path.join(d, "r50")
+    net.hybridize()
+    net(mx.nd.array(x, ctx=ctx))
+    net.export(prefix)
+    sym = mx.sym.load(prefix + "-symbol.json")
+    loaded = mx.nd.load(prefix + "-0000.params")
+    arg_params = {k.split(":", 1)[1]: v for k, v in loaded.items()
+                  if k.startswith("arg:")}
+    aux_params = {k.split(":", 1)[1]: v for k, v in loaded.items()
+                  if k.startswith("aux:")}
+    calib = mx.io.NDArrayIter(x, np.zeros(batch, "float32"),
+                              batch_size=batch)
+    qsym, qarg, qaux = quantize_model(
+        sym, arg_params, aux_params, calib_mode="naive", calib_data=calib,
+        num_calib_examples=batch, lowering="fused_int8")
+    ex = qsym.bind(ctx, {**{k: v.as_in_context(ctx) for k, v in qarg.items()},
+                         "data": mx.nd.array(x, ctx=ctx)},
+                   aux_states={k: v.as_in_context(ctx)
+                               for k, v in qaux.items()})
+    xj = jax.device_put(x)
+
+    def fwd(xv):
+        ex.arg_dict["data"]._data = xv
+        out = ex.forward()[0]
+        return out._data
+
+    def chained(xv):
+        out = fwd(xv)
+        return (jnp.mean(out.astype(jnp.float32)),
+                xv + 1e-30 * jnp.sum(out))
+
+    compiled = jax.jit(chained).lower(xj).compile()
+    m, xj2 = compiled(xj)
+    for _ in range(3):
+        m, xj2 = compiled(xj2)
+    print("warm mean:", float(np.asarray(m)))
+
+    base = tempfile.mkdtemp(prefix="q8prof_tr_")
+    profiler.set_config(filename=os.path.join(base, "profile.json"))
+    profiler.start()
+    for _ in range(20):
+        m, xj2 = compiled(xj2)
+    print("traced mean:", float(np.asarray(m)))
+    profiler.stop()
+    print(profiler.dumps(sort_by="total"))
+
+
+if __name__ == "__main__":
+    main()

@@ -73,6 +73,27 @@ def main():
                  int8_acc)
     assert int8_acc > fp32_acc - 0.05, (fp32_acc, int8_acc)
     logging.info("int8 within 5%% of fp32 — quantization OK")
+
+    if args.calib_mode != "none":
+        # the FAST deployment path: fused int8 lowering (folded BN,
+        # offline per-channel int8 weights, int8 MXU matmuls, int8 NHWC
+        # activations with static requantize epilogues)
+        calib.reset()
+        fsym, farg, faux = quantize_model(
+            mod.symbol, arg_params, aux_params,
+            calib_mode=args.calib_mode, calib_data=calib,
+            num_calib_examples=32 * args.calib_batches,
+            lowering="fused_int8")
+        fmod = mx.mod.Module(fsym, context=mx.cpu())
+        fmod.bind(data_shapes=[("data", (32, 1, 8, 8))],
+                  label_shapes=[("softmax_label", (32,))],
+                  for_training=False)
+        fmod.set_params(farg, faux, allow_missing=False)
+        fused_acc = fmod.score(mx.io.NDArrayIter(x, y, batch_size=32),
+                               "acc")[0][1]
+        logging.info("int8 accuracy (fused int8 lowering): %.3f", fused_acc)
+        assert fused_acc > fp32_acc - 0.05, (fp32_acc, fused_acc)
+        logging.info("fused int8 lowering OK")
     return 0
 
 

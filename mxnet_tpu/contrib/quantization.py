@@ -18,6 +18,7 @@ import logging
 import numpy as np
 
 from .. import ndarray as nd
+from ..base import parse_tuple
 from ..symbol.symbol import Symbol, _invoke_sym, Variable
 
 __all__ = ["quantize_model", "quantize_graph"]
@@ -147,23 +148,33 @@ def _stable_node_keys(sym):
 
 def _collect_thresholds(sym, arg_params, aux_params, calib_data,
                         data_names, num_calib_examples, logger,
-                        mode="naive"):
+                        mode="naive", boundaries="inputs"):
     """Calibration: run batches, record per-layer-input statistics —
     min/max ('naive', reference ``_LayerOutputMinMaxCollector``) or
     histograms + KL threshold search ('entropy',
-    ``_LayerHistogramCollector``)."""
-    # identify the parent outputs feeding quantizable nodes.  Keys are
-    # stable strings '<name>#<dup>:<out_idx>' (see _stable_node_keys) —
-    # NOT bare names: Gluon-traced graphs name every op "fwd", so name
-    # keys would merge different layers' statistics into one threshold
-    # (and did, before r3).  Unlike the r3 id()-based keys, these survive
-    # serialization and remain valid across graph copies.
+    ``_LayerHistogramCollector``).
+
+    ``boundaries='inputs'`` (fake-quant pass) records the data inputs of
+    quantizable nodes; ``'all'`` additionally records every op-node output
+    (min/max only — these feed the fused int8 lowering's requantize
+    epilogues; KL search stays on the conv/fc inputs where it matters).
+    """
+    # Keys are stable strings '<name>#<dup>:<out_idx>' (see
+    # _stable_node_keys) — NOT bare names: Gluon-traced graphs name every
+    # op "fwd", so name keys would merge different layers' statistics into
+    # one threshold (and did, before r3).  Unlike the r3 id()-based keys,
+    # these survive serialization and remain valid across graph copies.
     key_of = _stable_node_keys(sym)
-    want = {}
+    want = {}           # stable key -> parent name (conv/fc data inputs)
     for node in sym._topo():
         if node.op is not None and node.op.name in _QUANTIZABLE:
             p, i = node.inputs[0]
             want[f"{key_of[id(p)]}:{i}"] = p.name
+    entropy_keys = set(want)
+    if boundaries == "all":
+        for node in sym._topo():
+            if node.op is not None:
+                want.setdefault(f"{key_of[id(node)]}:0", node.name)
     if not want:
         return {}
     # bind an executor producing every wanted internal output
@@ -192,7 +203,8 @@ def _collect_thresholds(sym, arg_params, aux_params, calib_data,
             v.copyto(exe.aux_dict[k])
     mins = {n: np.inf for n in names}
     maxs = {n: -np.inf for n in names}
-    samples = {n: [] for n in names} if mode == "entropy" else None
+    samples = {n: [] for n in names if n in entropy_keys} \
+        if mode == "entropy" else None
     calib_data.reset()
     seen = 0
     for batch in calib_data:
@@ -202,7 +214,7 @@ def _collect_thresholds(sym, arg_params, aux_params, calib_data,
             a = o.asnumpy()
             mins[name] = min(mins[name], float(a.min()))
             maxs[name] = max(maxs[name], float(a.max()))
-            if samples is not None:
+            if samples is not None and name in samples:
                 samples[name].append(a.ravel())
         seen += batch.data[0].shape[0]
         if num_calib_examples is not None and seen >= num_calib_examples:
@@ -214,6 +226,9 @@ def _collect_thresholds(sym, arg_params, aux_params, calib_data,
     if mode == "entropy":
         out = {}
         for n in names:
+            if samples is None or n not in samples:
+                out[n] = (mins[n], maxs[n])
+                continue
             vals = np.concatenate(samples[n])
             amax = max(abs(mins[n]), abs(maxs[n])) or 1e-8
             hist, edges = np.histogram(vals, bins=8001, range=(-amax, amax))
@@ -305,21 +320,532 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                    label_names=("softmax_label",), ctx=None,
                    excluded_sym_names=None, calib_mode="none",
                    calib_data=None, num_calib_examples=None,
-                   quantized_dtype="int8", logger=logging):
+                   quantized_dtype="int8", logger=logging,
+                   lowering="fake_quant", data_shapes=None):
     """Reference ``quantization.py:quantize_model``.
 
     ``calib_mode``: 'none' (dynamic ranges at run time), 'naive' (min/max
     over calibration batches), 'entropy' (KL-optimal clipping thresholds —
     the reference's ``_get_optimal_threshold``).
+
+    ``lowering``: ``'fake_quant'`` (default — quantize/dequantize pairs,
+    the numerics-first formulation) or ``'fused_int8'`` — the fast path:
+    conv+BN+act+add fusion, offline per-channel int8 weights, int8 MXU
+    matmuls, activations int8 NHWC end-to-end (requires calibration; see
+    ``lower_int8_inference``).  ``data_shapes`` (e.g. ``[("data", (32, 3,
+    224, 224))]``) enables shape-dependent decisions in the fused pass.
     """
     thresholds = {}
     if calib_mode in ("naive", "entropy"):
         assert calib_data is not None, \
             f"calib_data is required for calib_mode={calib_mode!r}"
-        thresholds = _collect_thresholds(sym, arg_params, aux_params,
-                                         calib_data, list(data_names),
-                                         num_calib_examples, logger,
-                                         mode=calib_mode)
+        thresholds = _collect_thresholds(
+            sym, arg_params, aux_params, calib_data, list(data_names),
+            num_calib_examples, logger, mode=calib_mode,
+            boundaries="all" if lowering == "fused_int8" else "inputs")
+    if lowering == "fused_int8":
+        assert thresholds, \
+            "lowering='fused_int8' requires calib_mode 'naive'/'entropy'"
+        if quantized_dtype not in ("int8", "auto"):
+            raise ValueError(
+                f"lowering='fused_int8' quantizes symmetric int8; "
+                f"quantized_dtype={quantized_dtype!r} is not supported "
+                f"on this path (use the fake_quant lowering)")
+        if data_shapes is None and calib_data is not None:
+            try:
+                data_shapes = [(n, tuple(s))
+                               for n, s, *_ in calib_data.provide_data]
+            except Exception:
+                data_shapes = None
+        return lower_int8_inference(sym, arg_params, aux_params,
+                                    thresholds, excluded_sym_names or (),
+                                    data_shapes=data_shapes, logger=logger)
     qsym = quantize_graph(sym, arg_params, thresholds,
                           excluded_sym_names or (), quantized_dtype)
     return qsym, dict(arg_params), dict(aux_params)
+
+
+# --------------------------------------------------------------------------
+# Fused int8 lowering (the *fast* path — reference quantized_conv.cc +
+# the MKL-DNN conv+BN+act+add subgraph fusion, re-designed for the MXU)
+# --------------------------------------------------------------------------
+
+_I8 = "i8_nhwc"          # int8, NHWC (4d) or natural (2d), with a scale
+_BF16 = "bf16_nhwc"      # real-valued bf16, NHWC (no scale)
+_F32 = "f32"             # fp32 in the ORIGINAL graph layout
+
+
+def _amax_scale(rng_pair):
+    mn, mx = rng_pair
+    amax = max(abs(float(mn)), abs(float(mx)))
+    return (amax or 1e-8) / 127.0
+
+
+def lower_int8_inference(sym, arg_params, aux_params, thresholds,
+                         excluded_sym_names=(), data_shapes=None,
+                         logger=None):
+    """Lower a calibrated fp32 graph to fused static-scale int8 ops.
+
+    Pattern-fuses Convolution→BatchNorm→Activation chains (BN folded into
+    per-channel weight scales/bias), residual ``broadcast_add``+relu, and
+    FullyConnected heads into the ``_contrib_int8_*`` ops of
+    ``ops/int8_ops.py``; activations stay int8 NHWC between layers with
+    calibrated compile-time scales, so XLA fuses each epilogue into its
+    producing matmul.  Anything unmatched (or excluded) falls back to the
+    original fp32 op behind a dequantize — accuracy-safe for arbitrary
+    graphs.
+
+    Returns ``(lowered_sym, new_arg_params, new_aux_params)``; weights are
+    offline-quantized per-output-channel (int8), BN is folded away.
+
+    Reference being matched: ``src/operator/quantization/
+    quantize_graph_pass.cc`` after ``src/operator/subgraph/mkldnn/
+    mkldnn_conv_property.h`` fusion; TPU redesign rationale in
+    ``ops/int8_ops.py`` (measured int8-MXU reality on v5e).
+    """
+    from ..ndarray import ndarray as _nd_mod
+    excluded = set(excluded_sym_names or ())
+    key_of = _stable_node_keys(sym)
+
+    def rng_of(node, idx=0):
+        return thresholds.get(f"{key_of[id(node)]}:{idx}")
+
+    # shapes of every internal output (for FC weight permutation checks)
+    shape_of = {}
+    if data_shapes:
+        try:
+            internals = sym.get_internals()
+            _, out_shapes, _ = internals.infer_shape(**dict(data_shapes))
+            for (n, i), s in zip(internals._outputs, out_shapes):
+                shape_of[(id(n), i)] = s
+        except Exception:
+            shape_of = {}
+
+    # single-consumer map over (id(node), out_idx)
+    consumers = {}
+    for node in sym._topo():
+        for (p, i) in node.inputs:
+            consumers.setdefault((id(p), i), []).append(node)
+
+    state = {}           # (id(node), out_idx) -> (Symbol, repr, scale|None)
+    new_args = {}
+    new_aux = {}
+    fused_away = set()   # id(node) of BN/Activation nodes folded into a conv
+    n_fused = [0]
+
+    def _np(x):
+        return x.asnumpy() if hasattr(x, "asnumpy") else _np_mod.asarray(x)
+
+    import numpy as _np_mod
+
+    def to_f32(key):
+        """Original-layout fp32 Symbol for a tensor state (for fallback)."""
+        s, rep, scale = state[key]
+        if rep == _F32:
+            return s
+        sh = shape_of.get(key)
+        is_4d = sh is None or len(sh) == 4
+        return _invoke_sym_by_name(
+            "_contrib_int8_dequantize_static", [s],
+            {"scale": 1.0 if rep == _BF16 else scale, "to_nchw": is_4d})
+
+    i8_cache = {}        # key -> (int8 Symbol, scale): quantize once
+    bf16_cache = {}      # key -> bf16-NHWC Symbol
+
+    def to_i8(key):
+        """int8-NHWC Symbol + scale for a tensor state (quantizing an
+        fp32/bf16 tensor at its calibrated range on demand).  The original
+        state entry is NOT replaced — fp32-fallback consumers of a shared
+        tensor must keep the unclipped original values."""
+        s, rep, scale = state[key]
+        if rep == _I8:
+            return s, scale
+        if key in i8_cache:
+            return i8_cache[key]
+        rngp = None
+        for (n2, i2) in _tensor_index[key]:
+            rngp = thresholds.get(f"{key_of[id(n2)]}:{i2}") or rngp
+        if rngp is None:
+            raise ValueError(
+                "int8 lowering: no calibrated range for tensor %r — "
+                "calibrate with boundaries='all'" % (key,))
+        sc = _amax_scale(rngp)
+        sh = shape_of.get(key)
+        # f32 tensors are in the original (NCHW) layout; bf16 ones are
+        # already NHWC from a fused producer
+        is_4d = (sh is None or len(sh) == 4) and rep != _BF16
+        q = _invoke_sym_by_name(
+            "_contrib_int8_quantize_static", [s],
+            {"scale": sc, "from_nchw": is_4d})
+        i8_cache[key] = (q, sc)
+        return q, sc
+
+    # (id(node), out_idx) -> [(node, out_idx)] for threshold lookup
+    _tensor_index = {}
+    for node in sym._topo():
+        for i in range(node.num_outputs):
+            _tensor_index[(id(node), i)] = [(node, i)]
+
+    def _conv_plan(c):
+        """Kernel choice for a Convolution node: 'dot' (int8 MXU matmul)
+        when it's a dense 1x1 with both channel dims ≥ 128 (where the
+        int8 path measured ~2x bf16 — benchmark/int8_micro.py), 'bf16'
+        otherwise; None for non-conv/excluded nodes."""
+        if c.op is None or c.op.name != "Convolution" \
+                or c.name in excluded:
+            return None
+        a = dict(c.attrs)
+        if parse_tuple(a.get("kernel"), 2, (1, 1)) != (1, 1) \
+                or parse_tuple(a.get("pad"), 2, (0, 0)) != (0, 0) \
+                or parse_tuple(a.get("dilate"), 2, (1, 1)) != (1, 1) \
+                or int(_parse_scalar(a.get("num_group"), 1)) != 1:
+            return "bf16"
+        wn = c.inputs[1][0]
+        if wn.op is not None or wn.name not in arg_params:
+            return "bf16"
+        wsh = arg_params[wn.name].shape
+        return "dot" if min(wsh[0], wsh[1]) >= 128 else "bf16"
+
+    def single_consumer(node, idx, opname):
+        use = consumers.get((id(node), idx), [])
+        if len(use) == 1 and use[0].op is not None \
+                and use[0].op.name == opname \
+                and use[0].inputs[0] == (node, idx) \
+                and use[0].name not in excluded:
+            return use[0]
+        return None
+
+    def quant_weight(w, per_channel_axis=0):
+        """Per-output-channel symmetric int8 quantization of a weight."""
+        red = tuple(i for i in range(w.ndim) if i != per_channel_axis)
+        amax = _np_mod.maximum(_np_mod.abs(w).max(axis=red), 1e-8)
+        ws = (amax / 127.0).astype("float32")
+        shape = [1] * w.ndim
+        shape[per_channel_axis] = -1
+        q = _np_mod.clip(_np_mod.round(w / ws.reshape(shape)),
+                         -127, 127).astype("int8")
+        return q, ws
+
+    def lower_conv(node):
+        """Convolution [+BatchNorm [+Activation]] → _contrib_int8_conv_fused.
+        Returns the original tensor key the fused output stands for."""
+        attrs = dict(node.attrs)
+        kernel = parse_tuple(attrs.get("kernel"), 2, (1, 1))
+        groups = int(_parse_scalar(attrs.get("num_group"), 1))
+        layout = attrs.get("layout")
+        if layout not in (None, "None", "", "NCHW") or groups != 1:
+            return None                      # fallback handles
+        wnode = node.inputs[1][0]
+        if wnode.op is not None or wnode.name not in arg_params:
+            return None
+        w = _np(arg_params[wnode.name]).astype("float32")   # (O, I, kh, kw)
+        if w.ndim != 4:
+            return None        # 1-D/3-D convolution: fp32 fallback
+        no_bias = str(attrs.get("no_bias", "False")) in ("True", "1", "true")
+        b = None
+        if not no_bias and len(node.inputs) > 2:
+            bnode = node.inputs[2][0]
+            if bnode.op is not None or bnode.name not in arg_params:
+                return None
+            b = _np(arg_params[bnode.name]).astype("float32")
+        bias = b if b is not None else _np_mod.zeros(w.shape[0], "float32")
+
+        out_node, out_idx = node, 0
+        act = ""
+        bn = single_consumer(node, 0, "BatchNorm")
+        if bn is not None:
+            battrs = dict(bn.attrs)
+            eps = float(_parse_scalar(battrs.get("eps"), 1e-3))
+            fix_gamma = str(battrs.get("fix_gamma", "True")) \
+                in ("True", "1", "true")
+            try:
+                gamma = _np(arg_params[bn.inputs[1][0].name])
+                beta = _np(arg_params[bn.inputs[2][0].name])
+                mean = _np(aux_params[bn.inputs[3][0].name])
+                var = _np(aux_params[bn.inputs[4][0].name])
+            except KeyError:
+                return None
+            g = (_np_mod.ones_like(gamma) if fix_gamma else gamma) \
+                / _np_mod.sqrt(var + eps)
+            w = w * g.reshape(-1, 1, 1, 1)
+            bias = g * (bias - mean) + beta
+            fused_away.add(id(bn))
+            out_node, out_idx = bn, 0
+        a = single_consumer(out_node, out_idx, "Activation")
+        if a is not None and str(a.attrs.get("act_type")) == "relu":
+            act = "relu"
+            fused_away.add(id(a))
+            out_node, out_idx = a, 0
+
+        rngp = rng_of(out_node, out_idx)
+        out_scale = _amax_scale(rngp) if rngp is not None else 0.0
+
+        cons = consumers.get((id(out_node), out_idx), [])
+        out_bf16 = bool(cons) and all(_conv_plan(c) == "bf16"
+                                      for c in cons)
+
+        plan = _conv_plan(node)
+        din = node.inputs[0]
+        dkey = (id(din[0]), din[1])
+        dstate = state.get(dkey)
+        skey = key_of[id(node)].replace("#", "_")
+        if plan == "dot":
+            w8, ws = quant_weight(w.reshape(w.shape[0], -1).T,
+                                  per_channel_axis=1)     # (I, O)
+            data_s, in_scale = to_i8(dkey)
+            scale_vec = (in_scale * ws).astype("float32")
+        else:
+            # bf16 MXU path (spatial kernels, or 1x1 with thin channels
+            # where the int8 dot measured ≤ bf16): weight HWIO
+            w8, ws = quant_weight(w.transpose(2, 3, 1, 0),
+                                  per_channel_axis=3)
+            if dstate is not None and dstate[1] == _BF16:
+                data_s = dstate[0]          # real-valued bf16: no in-scale
+                scale_vec = ws.astype("float32")
+            elif dstate is not None and dstate[1] == _I8:
+                data_s = dstate[0]          # op converts s8 → bf16
+                scale_vec = (dstate[2] * ws).astype("float32")
+            else:
+                # fp32 original-layout input (e.g. the image): cast to
+                # bf16 NHWC — no quantize round-trip.  Cached separately;
+                # the f32 state stays for any fallback consumer.
+                if dkey in bf16_cache:
+                    data_s = bf16_cache[dkey]
+                else:
+                    sh = shape_of.get(dkey)
+                    is_4d = sh is None or len(sh) == 4
+                    data_s = _invoke_sym_by_name(
+                        "_contrib_int8_quantize_static", [to_f32(dkey)],
+                        {"scale": 1.0, "from_nchw": is_4d,
+                         "out_dtype": "bf16"})
+                    bf16_cache[dkey] = data_s
+                scale_vec = ws.astype("float32")
+        wv = Variable(f"{skey}_qweight", shape=w8.shape, dtype="int8")
+        sv = Variable(f"{skey}_qscale", shape=scale_vec.shape,
+                      dtype="float32")
+        bv = Variable(f"{skey}_qbias", shape=bias.shape, dtype="float32")
+        new_args[f"{skey}_qweight"] = _nd_mod.array(w8)
+        new_args[f"{skey}_qscale"] = _nd_mod.array(scale_vec)
+        new_args[f"{skey}_qbias"] = _nd_mod.array(bias.astype("float32"))
+        out_dtype = "bf16" if out_bf16 else \
+            ("int8" if out_scale else "f32")
+        out = _invoke_sym_by_name(
+            "_contrib_int8_conv_fused", [data_s, wv, sv, bv],
+            {"kernel": attrs.get("kernel"), "stride": attrs.get("stride"),
+             "pad": attrs.get("pad"), "dilate": attrs.get("dilate"),
+             "num_group": groups, "act_type": act, "out_scale": out_scale,
+             "out_dtype": out_dtype, "impl": plan},
+        )
+        n_fused[0] += 1
+        okey = (id(out_node), out_idx)
+        if out_dtype == "bf16":
+            state[okey] = (out, _BF16, None)
+        elif out_dtype == "int8":
+            state[okey] = (out, _I8, out_scale)
+        else:
+            # fp32-NHWC output: restore NCHW so fallback consumers are safe
+            back = _invoke_sym_by_name(
+                "_contrib_int8_dequantize_static", [out],
+                {"scale": 1.0, "to_nchw": True})
+            state[okey] = (back, _F32, None)
+        return okey
+
+    def lower_fc(node):
+        attrs = dict(node.attrs)
+        if str(attrs.get("flatten", "True")) not in ("True", "1", "true"):
+            return None
+        wnode = node.inputs[1][0]
+        if wnode.op is not None or wnode.name not in arg_params:
+            return None
+        w = _np(arg_params[wnode.name]).astype("float32")   # (O, K)
+        no_bias = str(attrs.get("no_bias", "False")) in ("True", "1", "true")
+        bias = _np_mod.zeros(w.shape[0], "float32")
+        if not no_bias and len(node.inputs) > 2:
+            bnode = node.inputs[2][0]
+            if bnode.op is not None or bnode.name not in arg_params:
+                return None
+            bias = _np(arg_params[bnode.name]).astype("float32")
+        din = node.inputs[0]
+        dkey = (id(din[0]), din[1])
+        dshape = shape_of.get(dkey)
+        dst = state.get(dkey)
+        if dshape is None and dst is not None and dst[1] in (_I8, _BF16):
+            # input is NHWC from a fused producer but its shape is
+            # unknown (no data_shapes given): the weight-column
+            # permutation below cannot be verified — fall back to fp32
+            # rather than silently flatten against NCHW-ordered columns
+            return None
+        if dshape is not None and len(dshape) == 4 and \
+                (dshape[2] != 1 or dshape[3] != 1):
+            # NHWC flatten ≠ NCHW flatten when H*W > 1: permute weight
+            # columns (O, C, H, W) → (O, H, W, C)
+            o, (c, h, wd) = w.shape[0], dshape[1:]
+            w = w.reshape(o, c, h, wd).transpose(0, 2, 3, 1).reshape(o, -1)
+        data_s, in_scale = to_i8(dkey)
+        w8, ws = quant_weight(w.T, per_channel_axis=1)      # (K, O)
+        skey = key_of[id(node)].replace("#", "_")
+        wv = Variable(f"{skey}_qweight", shape=w8.shape, dtype="int8")
+        sv = Variable(f"{skey}_qscale", shape=ws.shape, dtype="float32")
+        bv = Variable(f"{skey}_qbias", shape=bias.shape, dtype="float32")
+        new_args[f"{skey}_qweight"] = _nd_mod.array(w8)
+        new_args[f"{skey}_qscale"] = _nd_mod.array(
+            (in_scale * ws).astype("float32"))
+        new_args[f"{skey}_qbias"] = _nd_mod.array(bias)
+        out = _invoke_sym_by_name(
+            "_contrib_int8_fc_fused", [data_s, wv, sv, bv],
+            {"act_type": "", "out_scale": 0.0})
+        n_fused[0] += 1
+        state[(id(node), 0)] = (out, _F32, None)    # logits: natural 2-D
+        return (id(node), 0)
+
+    def lower_add(node):
+        (ln, li), (rn, ri) = node.inputs[0], node.inputs[1]
+        lkey, rkey = (id(ln), li), (id(rn), ri)
+        lst = state.get(lkey, (None, None, None))
+        rst = state.get(rkey, (None, None, None))
+        if lst[1] not in (_I8, _BF16) or rst[1] not in (_I8, _BF16):
+            return None
+        lsym, lsc = lst[0], (lst[2] if lst[1] == _I8 else 1.0)
+        rsym, rsc = rst[0], (rst[2] if rst[1] == _I8 else 1.0)
+        out_node, out_idx, act = node, 0, ""
+        a = single_consumer(node, 0, "Activation")
+        if a is not None and str(a.attrs.get("act_type")) == "relu":
+            act = "relu"
+            fused_away.add(id(a))
+            out_node, out_idx = a, 0
+        cons = consumers.get((id(out_node), out_idx), [])
+        out_bf16 = bool(cons) and all(_conv_plan(c) == "bf16"
+                                      for c in cons)
+        rngp = rng_of(out_node, out_idx)
+        out_scale = _amax_scale(rngp) if rngp is not None else 0.0
+        out_dtype = "bf16" if out_bf16 else \
+            ("int8" if out_scale else "f32")
+        out = _invoke_sym_by_name(
+            "_contrib_int8_add_act", [lsym, rsym],
+            {"lhs_scale": lsc, "rhs_scale": rsc, "act_type": act,
+             "out_scale": out_scale, "out_dtype": out_dtype})
+        n_fused[0] += 1
+        okey = (id(out_node), out_idx)
+        if out_dtype == "bf16":
+            state[okey] = (out, _BF16, None)
+        elif out_dtype == "int8":
+            state[okey] = (out, _I8, out_scale)
+        else:
+            back = _invoke_sym_by_name(
+                "_contrib_int8_dequantize_static", [out],
+                {"scale": 1.0, "to_nchw": True})
+            state[okey] = (back, _F32, None)
+        return okey
+
+    def lower_pool(node):
+        din = node.inputs[0]
+        dkey = (id(din[0]), din[1])
+        if state.get(dkey, (None, None, None))[1] not in (_I8, _BF16):
+            return None
+        attrs = dict(node.attrs)
+        ptype = str(attrs.get("pool_type", "max"))
+        gpool = str(attrs.get("global_pool", "False")) \
+            in ("True", "1", "true")
+        if ptype not in ("max", "avg"):
+            return None
+        if str(attrs.get("layout", "NCHW")) not in ("NCHW", "None"):
+            return None
+        if not gpool and \
+                str(attrs.get("pooling_convention", "valid")) == "full":
+            return None
+        dst = state[dkey]
+        data_s = dst[0]
+        in_scale = dst[2] if dst[1] == _I8 else 1.0
+        out = _invoke_sym_by_name(
+            "_contrib_int8_pool", [data_s],
+            {"kernel": attrs.get("kernel"), "stride": attrs.get("stride"),
+             "pad": attrs.get("pad"), "pool_type": ptype,
+             "global_pool": gpool, "in_scale": in_scale})
+        n_fused[0] += 1
+        if ptype == "max" and not gpool:
+            state[(id(node), 0)] = (out, dst[1], dst[2])
+        else:
+            # fp32 NHWC; restore NCHW for generic consumers (free when
+            # global: H=W=1)
+            back = _invoke_sym_by_name(
+                "_contrib_int8_dequantize_static", [out],
+                {"scale": 1.0, "to_nchw": True})
+            state[(id(node), 0)] = (back, _F32, None)
+        return (id(node), 0)
+
+    def fallback(node):
+        """Reconstruct the node on fp32 inputs in the original layout."""
+        ins = []
+        for (p, i) in node.inputs:
+            ins.append(to_f32((id(p), i)))
+        res = _invoke_sym(node.op, ins, dict(node.attrs), name=node.name)
+        for i in range(node.num_outputs):
+            state[(id(node), i)] = (Symbol([res._outputs[i]]), _F32, None)
+
+    for node in sym._topo():
+        if id(node) in fused_away:
+            continue
+        if node.op is None:
+            v = Variable(node.name, attr=dict(node.attr_dict) or None)
+            state[(id(node), 0)] = (v, _F32, None)
+            continue
+        opname = node.op.name
+        handled = None
+        if node.name not in excluded:
+            if opname == "Convolution":
+                handled = lower_conv(node)
+            elif opname == "FullyConnected":
+                handled = lower_fc(node)
+            elif opname in ("broadcast_add", "elemwise_add", "_plus",
+                            "_Plus"):
+                handled = lower_add(node)
+            elif opname == "Pooling":
+                handled = lower_pool(node)
+            elif opname == "Flatten":
+                din = node.inputs[0]
+                dkey = (id(din[0]), din[1])
+                st = state.get(dkey)
+                sh = shape_of.get(dkey)
+                if st is not None and st[1] == _I8 and sh is not None \
+                        and len(sh) == 4 and sh[2] == 1 and sh[3] == 1:
+                    flat = _invoke_sym_by_name(
+                        "Flatten", [st[0]], {})
+                    state[(id(node), 0)] = (flat, _I8, st[2])
+                    handled = (id(node), 0)
+            elif opname == "Dropout":
+                din = node.inputs[0]
+                dkey = (id(din[0]), din[1])
+                if dkey in state:          # inference: identity
+                    state[(id(node), 0)] = state[dkey]
+                    handled = (id(node), 0)
+        if handled is None:
+            fallback(node)
+
+    outputs = []
+    for (n, i) in sym._outputs:
+        outputs.append(to_f32((id(n), i))._outputs[0])
+    lowered = Symbol(outputs)
+
+    # prune params to what the lowered graph references
+    referenced = {nd.name for nd in lowered._topo() if nd.op is None}
+    for k, v in arg_params.items():
+        if k in referenced:
+            new_args[k] = v
+    for k, v in aux_params.items():
+        if k in referenced:
+            new_aux[k] = v
+    if logger:
+        logger.info("int8 lowering: fused %d nodes (%d fell back to fp32)",
+                    n_fused[0],
+                    sum(1 for nd in sym._topo() if nd.op is not None)
+                    - n_fused[0])
+    return lowered, new_args, new_aux
+
+
+def _parse_scalar(v, default=None):
+    if v is None:
+        return default
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return default

@@ -26,6 +26,13 @@ def foreach(body, data, init_states):
     """Scan ``body`` over the leading axis of ``data`` (reference
     ``contrib.py:foreach``): ``body(data_t, states) -> (out_t, new_states)``.
     Compiled to ``lax.scan`` — grads flow through the whole loop as one op.
+
+    Free variables the body closes over (e.g. RNN-cell parameters) are
+    discovered by a one-step probe run that logs every operand not
+    produced inside the body, and become explicit inputs of the composite
+    op so gradients reach them — the ND-side analogue of the reference's
+    subgraph cut discovering closure symbols
+    (``python/mxnet/symbol/contrib.py:_cut_subgraph``).
     """
     import jax
     from jax import lax
@@ -34,13 +41,33 @@ def foreach(body, data, init_states):
     data_list = _as_list(data)
     state_list = _as_list(init_states)
     n_data = len(data_list)
+    n_state = len(state_list)
     data_is_list = isinstance(data, (list, tuple))
     states_are_list = isinstance(init_states, (list, tuple))
     out_struct = {}
 
+    # --- probe: one eager body step to discover free-variable captures
+    given = {id(a) for a in data_list + state_list}
+    with _ag.pause():
+        first = [d[0] for d in data_list]
+        given.update(id(a) for a in first)
+        with nd_core.capture_operands() as log:
+            body(first if data_is_list else first[0],
+                 [s for s in state_list] if states_are_list
+                 else state_list[0])
+    made = {id(a) for a in log["made"]}
+    captures, seen = [], set()
+    for a in log["used"]:
+        if isinstance(a, NDArray) and id(a) not in given \
+                and id(a) not in made and id(a) not in seen:
+            seen.add(id(a))
+            captures.append(a)
+
     def pure(*raw):
         xs = list(raw[:n_data])
-        ss = list(raw[n_data:])
+        ss = list(raw[n_data:n_data + n_state])
+        cap_raw = list(raw[n_data + n_state:])
+        saved = [c._data for c in captures]
 
         def step(carry, x_t):
             with _ag.pause():
@@ -57,11 +84,23 @@ def foreach(body, data, init_states):
             return tuple(s._data for s in ns_l), \
                 tuple(o._data for o in out_l)
 
-        carry, ys = lax.scan(step, tuple(ss), tuple(xs) if n_data > 1
-                             else xs[0])
+        try:
+            # the body closes over the capture OBJECTS — point their
+            # payloads at the traced arguments for the duration of the
+            # scan trace so they become differentiable op inputs.  Operand
+            # logging is suspended: scan-trace temporaries must not be
+            # mistaken for captures by an enclosing probe.
+            for c, r in zip(captures, cap_raw):
+                c._data = r
+            with nd_core.suspend_capture():
+                carry, ys = lax.scan(step, tuple(ss),
+                                     tuple(xs) if n_data > 1 else xs[0])
+        finally:
+            for c, s in zip(captures, saved):
+                c._data = s
         return tuple(ys) + tuple(carry)
 
-    raws = data_list + state_list
+    raws = data_list + state_list + captures
     outs = nd_core.invoke_fn(pure, raws)
     if not isinstance(outs, list):
         outs = [outs]
@@ -94,9 +133,10 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
     while steps < max_iterations and \
             bool(cond(*loop_vars).asscalar()):
         step_out, loop_vars = func(*loop_vars)
-        step_out = _as_list(step_out)
-        out_fmt = len(step_out)
-        outputs.append(step_out)
+        if step_out is not None:       # reference: func may emit no output
+            step_out = _as_list(step_out)
+            out_fmt = len(step_out)
+            outputs.append(step_out)
         loop_vars = _as_list(loop_vars)
         steps += 1
     if outputs:

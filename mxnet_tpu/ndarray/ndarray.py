@@ -336,8 +336,8 @@ class NDArray:
     # ----------------------------------------------------- op method shortcuts
     def reshape(self, *shape, **kwargs):
         if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
-            shape = tuple(shape[0])
-        if not shape:
+            shape = tuple(shape[0])   # may be () — a scalar reshape
+        elif not shape:
             shape = kwargs.get("shape")
         return invoke_op("reshape", [self], {"shape": shape})
 
@@ -602,6 +602,51 @@ def _call_op(op, raw, attrs):
     return fn(*raw)
 
 
+# when set (a dict with 'used'/'made' lists), every eager op invocation
+# logs its operands and outputs — the control-flow wrappers use this to
+# discover free-variable captures in loop bodies (reference: the subgraph
+# cut pass discovers them at symbol composition,
+# src/operator/control_flow.cc ForeachParam in_data/in_state mapping)
+_OPERAND_LOG = None
+
+
+class capture_operands:
+    """Context manager: record (operands, outputs) of every nd op call."""
+
+    def __enter__(self):
+        global _OPERAND_LOG
+        self._prev = _OPERAND_LOG
+        _OPERAND_LOG = {"used": [], "made": []}
+        return _OPERAND_LOG
+
+    def __exit__(self, *exc):
+        global _OPERAND_LOG
+        _OPERAND_LOG = self._prev
+        return False
+
+
+class suspend_capture:
+    """Temporarily disable operand logging — used while tracing a scan
+    body so trace-level temporaries can't be mistaken for free-variable
+    captures of an ENCLOSING probe (they'd leak tracers)."""
+
+    def __enter__(self):
+        global _OPERAND_LOG
+        self._prev = _OPERAND_LOG
+        _OPERAND_LOG = None
+
+    def __exit__(self, *exc):
+        global _OPERAND_LOG
+        _OPERAND_LOG = self._prev
+        return False
+
+
+def _log_operands(nd_inputs, nd_outs):
+    if _OPERAND_LOG is not None:
+        _OPERAND_LOG["used"].extend(nd_inputs)
+        _OPERAND_LOG["made"].extend(nd_outs)
+
+
 def invoke(op, nd_inputs, attrs, out=None):
     nd_inputs = [x if isinstance(x, NDArray) else _as_nd(x) for x in nd_inputs]
     raw = [x._data for x in nd_inputs]
@@ -611,6 +656,7 @@ def invoke(op, nd_inputs, attrs, out=None):
     single = not isinstance(result, (tuple, list))
     outs = [result] if single else list(result)
     nd_outs = [_wrap(r) for r in outs]
+    _log_operands(nd_inputs, nd_outs)
     if _ag.is_recording():
         _ag.record_op(op.fn, attrs, nd_inputs, raw, nd_outs, out_tuple=not single)
     if out is not None:
@@ -635,6 +681,7 @@ def invoke_fn(fn, nd_inputs, attrs=None, op_name=None):
     single = not isinstance(result, (tuple, list))
     outs = [result] if single else list(result)
     nd_outs = [_wrap(r) for r in outs]
+    _log_operands(nd_inputs, nd_outs)
     if _ag.is_recording():
         _ag.record_op(fn, attrs, nd_inputs, raw, nd_outs, out_tuple=not single)
     return nd_outs[0] if single else nd_outs

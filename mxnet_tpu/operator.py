@@ -102,16 +102,7 @@ def _invoke_custom(op_type, inputs, kwargs):
     and record a tape node whose backward calls the op's ``backward``."""
     from . import autograd as _ag
 
-    prop_cls = _REGISTRY.get(op_type)
-    if prop_cls is None:
-        raise ValueError(f"custom op type {op_type!r} is not registered")
-    import inspect
-    sig = inspect.signature(prop_cls.__init__)
-    accepted = {k: v for k, v in kwargs.items()
-                if k in sig.parameters or any(
-                    p.kind == inspect.Parameter.VAR_KEYWORD
-                    for p in sig.parameters.values())}
-    prop = prop_cls(**{k: str(v) for k, v in accepted.items()})
+    prop = _prop_for(op_type, kwargs)
     in_shapes = [list(x.shape) for x in inputs]
     out_shapes = prop.infer_shape(in_shapes)[1]
     in_types = [x.dtype for x in inputs]
@@ -159,4 +150,104 @@ def _custom_entry(*inputs, op_type=None, **kwargs):
 
 
 # surface as mx.nd.Custom
+nd.Custom = _custom_entry
+
+
+# ---------------------------------------------------------------------------
+# Symbol-level Custom: the registered graph op.  The reference's symbolic
+# Custom runs the Python operator on the engine's worker threads
+# (src/operator/custom/custom.cc); TPU-native, the host body runs under
+# ``jax.pure_callback`` inside the jitted executor, with a ``custom_vjp``
+# routing gradients through the op's ``backward`` — the documented
+# host-roundtrip cost model is the same.
+# ---------------------------------------------------------------------------
+def _prop_for(op_type, kwargs):
+    prop_cls = _REGISTRY.get(op_type)
+    if prop_cls is None:
+        raise ValueError(f"custom op type {op_type!r} is not registered")
+    import inspect
+    sig = inspect.signature(prop_cls.__init__)
+    has_var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD
+                     for p in sig.parameters.values())
+    accepted = {k: str(v) for k, v in kwargs.items()
+                if has_var_kw or k in sig.parameters}
+    return prop_cls(**accepted)
+
+
+def _custom_graph_kernel(*raw, op_type=None, **kwargs):
+    import jax
+    import numpy as _np
+
+    assert op_type is not None, "Custom requires op_type"
+    prop = _prop_for(op_type, kwargs)
+    in_shapes = [list(x.shape) for x in raw]
+    shapes = prop.infer_shape(in_shapes)
+    out_shapes, aux_shapes = shapes[1], shapes[2]
+    in_types = [_np.dtype(x.dtype) for x in raw]
+    out_types = [_np.dtype(t) for t in prop.infer_type(in_types)[1]]
+    op_inst = prop.create_operator(None, in_shapes, in_types)
+    out_avals = tuple(jax.ShapeDtypeStruct(tuple(s), t)
+                      for s, t in zip(out_shapes, out_types))
+    in_avals = tuple(jax.ShapeDtypeStruct(tuple(x.shape),
+                                          _np.dtype(x.dtype)) for x in raw)
+    n_in, n_out = len(in_avals), len(out_avals)
+
+    def _to_nd(arrs, avals):
+        return [nd.array(_np.asarray(a, dtype=av.dtype), ctx=None)
+                for a, av in zip(arrs, avals)]
+
+    def host_fwd(*args):
+        ins = _to_nd(args, in_avals)
+        outs = [nd.zeros(tuple(s), dtype=t)
+                for s, t in zip(out_shapes, out_types)]
+        aux = [nd.zeros(tuple(s)) for s in aux_shapes]
+        op_inst.forward(True, ["write"] * n_out, ins, outs, aux)
+        return tuple(_np.asarray(o.asnumpy(), dtype=t)
+                     for o, t in zip(outs, out_types))
+
+    @jax.custom_vjp
+    def run(*args):
+        return jax.pure_callback(host_fwd, out_avals, *args)
+
+    def run_fwd(*args):
+        outs = jax.pure_callback(host_fwd, out_avals, *args)
+        return outs, (args, outs)
+
+    def run_bwd(res, gouts):
+        args, outs = res
+
+        def host_bwd(*flat):
+            ins = _to_nd(flat[:n_in], in_avals)
+            outs_nd = _to_nd(flat[n_in:n_in + n_out], out_avals)
+            gout_nd = _to_nd(flat[n_in + n_out:], out_avals)
+            igrad = [nd.zeros(tuple(s.shape), dtype=s.dtype)
+                     for s in in_avals]
+            aux = [nd.zeros(tuple(s)) for s in aux_shapes]
+            op_inst.backward(["write"] * n_in, gout_nd, ins, outs_nd,
+                             igrad, aux)
+            return tuple(_np.asarray(g.asnumpy(), dtype=s.dtype)
+                         for g, s in zip(igrad, in_avals))
+
+        return jax.pure_callback(host_bwd, in_avals, *args, *outs, *gouts)
+
+    run.defvjp(run_fwd, run_bwd)
+    outs = run(*raw)
+    return list(outs) if n_out > 1 else outs[0]
+
+
+from .ops.registry import register as _register_graph_op   # noqa: E402
+
+_register_graph_op("Custom")(_custom_graph_kernel)
+
+# the symbol namespace was populated before this registration — attach
+# the generated wrapper now
+from . import symbol as _sym_mod                           # noqa: E402
+from .symbol.symbol import make_sym_func as _msf           # noqa: E402
+from .ops import registry as _reg_mod                      # noqa: E402
+
+_sym_mod.Custom = _msf(_reg_mod.get("Custom"))
+
+# the eager nd path stays the direct host implementation (no callback);
+# re-assert it AFTER the registry op exists so module population can't
+# shadow it
 nd.Custom = _custom_entry

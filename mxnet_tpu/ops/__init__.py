@@ -12,6 +12,7 @@ from . import image_ops  # noqa: F401
 from . import contrib_ops  # noqa: F401
 from . import quantization_ops  # noqa: F401
 from . import extra_ops  # noqa: F401
+from . import int8_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import pallas_kernels  # noqa: F401
 

@@ -1,0 +1,230 @@
+"""Fused static-scale int8 inference ops (TPU-native quantized kernels).
+
+The reference's quantization subsystem exists to make inference *faster*:
+its int8 kernels run on cuDNN/MKL-DNN integer paths
+(``src/operator/quantization/quantized_conv.cc``,
+``quantized_fully_connected.cc``), reached after MKL-DNN subgraph fusion
+collapses conv+BN+relu+add chains
+(``src/operator/subgraph/mkldnn/mkldnn_conv_property.h``).
+
+TPU equivalent, measured on a v5e (benchmark/int8_micro.py):
+
+- ``lax.dot_general`` with int8 operands and ``preferred_element_type=
+  jnp.int32`` DOES hit the MXU's int8 path — ~1.9–2.0x bf16 matmul
+  throughput (342 vs 180 TF/s at 4096³).
+- ``lax.conv_general_dilated`` with int8 taps does NOT (0.3–0.7x bf16) —
+  XLA has no int8 conv lowering on this target.
+
+So the fused ops here are designed around that reality:
+
+- 1x1 convolutions (≈58% of ResNet-50 FLOPs) and FullyConnected lower to
+  int8 ``dot_general`` over an NHWC activation layout, with the whole
+  epilogue (per-channel scale, folded-BN bias, relu, static requantize to
+  the next layer's int8 scale) fused by XLA into the matmul output.
+- Spatial (3x3/7x7) convolutions run the MXU in bf16 over *integer-valued*
+  operands: int8 values are exact in bf16 (8-bit mantissa covers ±256) and
+  the MXU accumulates in f32, so the arithmetic is int8-faithful at full
+  bf16 conv speed — 2x the activation bandwidth of the fp32 fake-quant
+  path and no quantize/dequantize chains in between.
+
+Activations stay int8 NHWC end-to-end; scales are compile-time attrs
+(calibrated offline), so every epilogue is a static elementwise chain XLA
+fuses into its producer.  See ``contrib/quantization.py:
+lower_int8_inference`` for the graph pass that emits these ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import parse_bool, parse_float, parse_int, parse_tuple
+from .registry import register
+
+
+def _requant_static(f, out_scale):
+    """fp32 → int8 with a calibrated static scale (amax/127)."""
+    q = jnp.round(f * (1.0 / out_scale))
+    return jnp.clip(q, -127, 127).astype(jnp.int8)
+
+
+@register("_contrib_int8_quantize_static")
+def int8_quantize_static(data, scale=1.0, from_nchw=False,
+                         out_dtype="int8"):
+    """fp32 → symmetric int8 at a static calibrated scale; optionally
+    transposes NCHW → NHWC in the same fused pass (the int8 pipeline runs
+    NHWC internally so 1x1 convs reshape straight into matmuls).
+    ``out_dtype='bf16'`` skips quantization and just casts — used to feed
+    layers whose kernels run the MXU in bf16."""
+    if parse_bool(from_nchw) and data.ndim == 4:
+        data = jnp.transpose(data, (0, 2, 3, 1))
+    if out_dtype == "bf16":
+        return data.astype(jnp.bfloat16)
+    return _requant_static(data.astype(jnp.float32),
+                           parse_float(scale, 1.0))
+
+
+@register("_contrib_int8_dequantize_static")
+def int8_dequantize_static(data, scale=1.0, to_nchw=False):
+    """int8 → fp32 at a static scale; optional NHWC → NCHW restore."""
+    out = data.astype(jnp.float32) * parse_float(scale, 1.0)
+    if parse_bool(to_nchw) and out.ndim == 4:
+        out = jnp.transpose(out, (0, 3, 1, 2))
+    return out
+
+
+def _epilogue(acc_f32, scale_vec, bias, act_type, out_scale,
+              out_dtype="int8"):
+    """Shared conv/fc epilogue: per-channel rescale + folded bias + act,
+    then static int8 requant (``out_dtype='int8'``, needs ``out_scale``),
+    real-valued bf16 (``'bf16'`` — for consumers that run the MXU in
+    bf16, skipping a pointless int8 round-trip), or fp32."""
+    out = acc_f32 * scale_vec + bias
+    if act_type == "relu":
+        out = jnp.maximum(out, 0.0)
+    elif act_type not in ("", None, "None"):
+        raise NotImplementedError(f"int8 fused act_type={act_type!r}")
+    if out_dtype == "bf16":
+        return out.astype(jnp.bfloat16)
+    if out_dtype == "int8" and out_scale and out_scale > 0:
+        return _requant_static(out, out_scale)
+    return out
+
+
+@register("_contrib_int8_conv_fused")
+def int8_conv_fused(data, weight, scale_vec, bias, kernel="(1, 1)",
+                    stride="(1, 1)", pad="(0, 0)", dilate="(1, 1)",
+                    num_group=1, act_type="relu", out_scale=0.0,
+                    out_dtype="int8", impl="auto", num_filter=None,
+                    layout="NHWC"):
+    """Quantized conv + folded BN + activation + requantize, NHWC.
+
+    ``weight`` is offline-quantized int8 — shape ``(Cin, Cout)`` for the
+    1x1 dot path, ``HWIO`` otherwise.  ``scale_vec`` is the per-output-
+    channel combined fp32 scale (``in_scale * w_scale_c`` for int8 data,
+    ``w_scale_c`` alone for real-valued bf16 data), ``bias`` the folded
+    BN bias.  ``out_dtype``: 'int8' (requantize at ``out_scale``),
+    'bf16' (real values — chosen by the lowering when every consumer is
+    a spatial conv that would immediately convert anyway), or 'f32'.
+    Reference contract: ``src/operator/quantization/quantized_conv.cc``
+    + the conv+bn+act+add fusion of ``mkldnn_conv_property.h``.
+    """
+    kh, kw = parse_tuple(kernel, 2, (1, 1))
+    sh, sw = parse_tuple(stride, 2, (1, 1))
+    ph, pw = parse_tuple(pad, 2, (0, 0))
+    dh, dw = parse_tuple(dilate, 2, (1, 1))
+    groups = parse_int(num_group, 1)
+    out_scale = parse_float(out_scale, 0.0)
+
+    dot_ok = (kh, kw) == (1, 1) and (dh, dw) == (1, 1) and groups == 1 \
+        and (ph, pw) == (0, 0) and data.dtype == jnp.int8 \
+        and weight.ndim == 2
+    if impl == "dot":
+        assert dot_ok, "impl='dot' needs int8 NHWC data + (Cin,Cout) weight"
+    elif impl == "auto":
+        # the int8 MXU only wins when both channel dims fill the 128-lane
+        # tiles (measured: 56x56 C=64 layers run 0.5-1x bf16 while paying
+        # s8 relayout copies — benchmark/int8_micro.py + the XPlane table)
+        dot_ok = dot_ok and min(weight.shape) >= 128
+    else:
+        dot_ok = False
+    if dot_ok:
+        # 1x1 conv ≡ matmul over channels — the int8 MXU path.  The dot
+        # contracts the channel axis of the 4-D NHWC tensor DIRECTLY (no
+        # 2-D reshape: reshapes forced XLA into per-layer relayout copies
+        # of the big s8 activations, see benchmark/profile_int8_infer.py).
+        # Stride subsamples rows before the dot (cheap int8 gather).
+        if (sh, sw) != (1, 1):
+            data = data[:, ::sh, ::sw, :]
+        acc = jax.lax.dot_general(
+            data, weight, (((3,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        return _epilogue(acc.astype(jnp.float32), scale_vec, bias,
+                         act_type, out_scale, out_dtype)
+
+    # spatial conv: integer-valued bf16 on the MXU (exact: |values| ≤ 127
+    # fit bf16's mantissa; accumulation is f32 on the MXU).  Data may be
+    # int8 (converted here) or already real-valued bf16.
+    acc = jax.lax.conv_general_dilated(
+        data.astype(jnp.bfloat16), weight.astype(jnp.bfloat16),
+        window_strides=(sh, sw), padding=((ph, ph), (pw, pw)),
+        rhs_dilation=(dh, dw),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32)
+    return _epilogue(acc, scale_vec, bias, act_type, out_scale, out_dtype)
+
+
+@register("_contrib_int8_fc_fused")
+def int8_fc_fused(data, weight, scale_vec, bias, act_type="",
+                  out_scale=0.0, num_hidden=None):
+    """Quantized FullyConnected: int8 dot + fused epilogue.  ``weight`` is
+    offline-quantized int8 ``(K, O)`` with columns pre-permuted to the
+    NHWC flatten order (reference ``quantized_fully_connected.cc``)."""
+    if data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    acc = jax.lax.dot_general(
+        data, weight, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return _epilogue(acc.astype(jnp.float32), scale_vec, bias,
+                     act_type, parse_float(out_scale, 0.0))
+
+
+@register("_contrib_int8_add_act")
+def int8_add_act(lhs, rhs, lhs_scale=1.0, rhs_scale=1.0, act_type="relu",
+                 out_scale=0.0, out_dtype="int8"):
+    """Residual add of two quantized-pipeline tensors (int8 with scales,
+    or real-valued bf16 with scale 1) + activation + requantize — one
+    fused elementwise pass (reference ``quantized_elemwise_add.cc`` + the
+    mkldnn conv-sum fusion)."""
+    f = lhs.astype(jnp.float32) * parse_float(lhs_scale, 1.0) + \
+        rhs.astype(jnp.float32) * parse_float(rhs_scale, 1.0)
+    if act_type == "relu":
+        f = jnp.maximum(f, 0.0)
+    if out_dtype == "bf16":
+        return f.astype(jnp.bfloat16)
+    out_scale = parse_float(out_scale, 0.0)
+    if out_dtype == "int8" and out_scale and out_scale > 0:
+        return _requant_static(f, out_scale)
+    return f
+
+
+@register("_contrib_int8_pool")
+def int8_pool(data, kernel="(1, 1)", stride=None, pad="(0, 0)",
+              pool_type="max", global_pool=False, in_scale=1.0,
+              pooling_convention="valid", out_scale=0.0):
+    """Pooling on int8 NHWC activations.  Max pooling is scale-preserving
+    (max commutes with monotone quantization) and stays int8; avg/global
+    pooling accumulates in f32 and emits fp32 (requantized only if
+    ``out_scale`` is set) — matching ``quantized_pooling.cc``."""
+    in_scale = parse_float(in_scale, 1.0)
+    if parse_bool(global_pool):
+        if pool_type == "max":
+            return jnp.max(data, axis=(1, 2), keepdims=True)
+        f = jnp.mean(data.astype(jnp.float32), axis=(1, 2), keepdims=True)
+        f = f * in_scale
+        out_scale = parse_float(out_scale, 0.0)
+        if out_scale and out_scale > 0:
+            return _requant_static(f, out_scale)
+        return f
+    kh, kw = parse_tuple(kernel, 2, (1, 1))
+    sh, sw = parse_tuple(stride, 2, (kh, kw)) if stride is not None \
+        else (kh, kw)
+    ph, pw = parse_tuple(pad, 2, (0, 0))
+    window = (1, kh, kw, 1)
+    strides = (1, sh, sw, 1)
+    pads = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+    if pool_type == "max":
+        init = jnp.int8(-128) if data.dtype == jnp.int8 \
+            else jnp.array(-jnp.inf, data.dtype)
+        return jax.lax.reduce_window(
+            data, init, jax.lax.max, window, strides, pads)
+    s = jax.lax.reduce_window(
+        data.astype(jnp.float32), 0.0, jax.lax.add, window, strides, pads)
+    cnt = jax.lax.reduce_window(
+        jnp.ones(data.shape[:3] + (1,), jnp.float32), 0.0, jax.lax.add,
+        window, strides, pads)
+    f = (s / cnt) * in_scale
+    out_scale = parse_float(out_scale, 0.0)
+    if out_scale and out_scale > 0:
+        return _requant_static(f, out_scale)
+    return f

@@ -357,12 +357,13 @@ def while_loop(cond, func, loop_vars, max_iterations=None, name="while_loop"):
     vars_are_list = isinstance(loop_vars, (list, tuple))
     lv_syms = _as_list(loop_vars)
     lvars = [_sym.Variable(f"__{name}_var{i}") for i in range(len(lv_syms))]
-    lvars_arg = lvars if vars_are_list else lvars[0]
 
-    pred = cond(lvars_arg)
-    step_out, new_vars = func(lvars_arg)
+    # reference convention (python/mxnet/symbol/contrib.py while_loop):
+    # cond/func receive the loop variables SPLATTED — cond(*loop_vars)
+    pred = cond(*lvars)
+    step_out, new_vars = func(*lvars)
     out_is_list = isinstance(step_out, (list, tuple))
-    out_syms = _as_list(step_out)
+    out_syms = [] if step_out is None else _as_list(step_out)
     nv_syms = _as_list(new_vars)
     n_out, n_var = len(out_syms), len(nv_syms)
     assert n_var == len(lv_syms), \
@@ -397,7 +398,10 @@ def while_loop(cond, func, loop_vars, max_iterations=None, name="while_loop"):
     outs = _ctrl_node("_while_loop", node_fn, lv_syms + cap_syms,
                       n_out + n_var, name, attrs=attrs,
                       subgraphs=[sub_c, sub_f])
-    out_res = outs[:n_out] if out_is_list else outs[0]
+    if n_out == 0:
+        out_res = None
+    else:
+        out_res = outs[:n_out] if out_is_list else outs[0]
     var_res = outs[n_out:] if vars_are_list else outs[n_out]
     return out_res, var_res
 

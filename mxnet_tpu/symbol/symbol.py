@@ -249,10 +249,15 @@ class Symbol:
         specs = {}          # variable name -> ShapeDtypeStruct
         out_specs = {}      # (id(node), out_idx) -> ShapeDtypeStruct
 
-        def var_spec(name, shape):
+        def var_spec(name, shape, dtype=None):
+            if dtype is not None:
+                try:
+                    dtype = _np.dtype(dtype)
+                except TypeError:
+                    dtype = None       # legacy str(dtype) class-repr forms
             s = jax.ShapeDtypeStruct(
                 tuple(int(x) for x in shape),
-                _np.dtype(dtypes.get(name, _np.float32)))
+                dtype or _np.dtype(dtypes.get(name, _np.float32)))
             specs[name] = s
             return s
 
@@ -284,9 +289,10 @@ class Symbol:
         pending = []
         for node in self._topo():
             if node.op is None:
+                vdt = node.attr_dict.get("__dtype__") or None
                 if node.name in shapes:
-                    out_specs[(id(node), 0)] = var_spec(node.name,
-                                                        shapes[node.name])
+                    out_specs[(id(node), 0)] = var_spec(
+                        node.name, shapes[node.name], vdt)
                 elif node.attr_dict.get("__shape__"):
                     # a Variable declared with a fully-known shape (gluon
                     # param vars carry theirs through export); partial
@@ -296,7 +302,8 @@ class Symbol:
                     if shp is not None and all(isinstance(x, int) and x > 0
                                                for x in shp):
                         # () is a valid scalar declaration
-                        out_specs[(id(node), 0)] = var_spec(node.name, shp)
+                        out_specs[(id(node), 0)] = var_spec(node.name, shp,
+                                                            vdt)
                 # else: leave unknown — may be inferable at a consumer
                 continue
             pending.append(node)
@@ -580,7 +587,10 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
     if shape is not None:
         ad["__shape__"] = str(tuple(shape))
     if dtype is not None:
-        ad["__dtype__"] = str(dtype)
+        try:
+            ad["__dtype__"] = _np.dtype(dtype).name
+        except TypeError:
+            ad["__dtype__"] = str(dtype)
     if lr_mult is not None:
         ad["lr_mult"] = str(lr_mult)
     if wd_mult is not None:
@@ -708,6 +718,13 @@ def _num_outputs_of(op, attrs, n_inputs):
         return 2
     if op.name == "amp_multicast":
         return max(parse_int(attrs.get("num_outputs", n_inputs)), 1)
+    if op.name == "Custom":
+        from ..operator import _REGISTRY, _prop_for
+        try:
+            prop = _prop_for(attrs.get("op_type"), attrs)
+            return max(len(prop.list_outputs()), 1)
+        except Exception:
+            return 1
     return 1
 
 

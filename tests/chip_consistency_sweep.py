@@ -1,0 +1,350 @@
+"""Registry-wide CPU↔chip consistency sweep (VERDICT r4 item 6).
+
+The reference re-runs its ENTIRE operator suite on the second backend
+(``tests/python/gpu/test_operator_gpu.py:37-45``).  TPU equivalent: walk
+``registry.list_ops()`` and synthesize a deterministic forward call for
+every op — explicit specs for ops with structured inputs (conv/rnn/
+sequence/...), signature-driven generic tensors for the long elementwise/
+reduce tail — then compare chip vs CPU outputs.  Both sides import THIS
+module so inputs are bit-identical.
+
+Ops that are stochastic, stateful, host-side, or need graph context are
+skip-listed with a reason; anything else that fails to synthesize is
+reported, and the companion test enforces a floor on coverage so the
+sweep can't silently rot.
+"""
+import inspect
+
+import numpy as np
+
+# ops that cannot be value-compared across backends
+SKIP = {
+    # stochastic (draws differ by construction; statistical gates live in
+    # test_random_statistics.py)
+    "_random_uniform", "_random_normal", "_random_gamma",
+    "_random_exponential", "_random_poisson", "_random_negative_binomial",
+    "_random_generalized_negative_binomial", "_random_randint",
+    "_sample_uniform", "_sample_normal", "_sample_gamma",
+    "_sample_exponential", "_sample_poisson", "_sample_negative_binomial",
+    "_sample_generalized_negative_binomial", "_sample_multinomial",
+    "_sample_unique_zipfian", "_shuffle", "Dropout", "uniform", "normal",
+    "random_uniform", "random_normal", "random_gamma",
+    "random_exponential", "random_poisson", "random_negative_binomial",
+    "random_generalized_negative_binomial", "random_randint",
+    "sample_multinomial", "sample_uniform", "sample_normal",
+    "sample_gamma", "sample_exponential", "sample_poisson", "shuffle",
+    "_random_pdf_uniform", "_random_pdf_normal", "_random_pdf_gamma",
+    "_random_pdf_exponential", "_random_pdf_poisson",
+    "_random_pdf_negative_binomial",
+    "_random_pdf_generalized_negative_binomial", "_random_pdf_dirichlet",
+    "GridGenerator",  # covered in the curated batch
+    # control flow / graph-context ops (exercised by their own suites)
+    "_foreach", "_while_loop", "_cond", "_CustomFunction", "Custom",
+    # host-side / debugging / IO
+    "_npi_load", "_npi_save", "load", "save", "_cvimread", "_cvimresize",
+    "_cvcopyMakeBorder", "imdecode",
+    # zero-input creation ops with required shape attrs are covered via
+    # the curated batch; generic synthesis can't guess their attrs
+    "_zeros", "_ones", "_full", "_eye", "_arange", "_linspace",
+    "zeros_like_legacy",
+}
+
+_GENERIC_4D = (2, 3, 4, 5)
+_GENERIC_2D = (4, 6)
+
+
+def _specs(mx, ctx, A, I):
+    """Explicit input specs: op name → thunk returning the output.
+    Covers the structured-input families the generic synthesizer can't."""
+    x4 = A(2, 3, 8, 8)
+    w_conv = A(4, 3, 3, 3, scale=0.5)
+    seq = A(5, 3, 6)
+
+    return {
+        "Convolution": lambda: mx.nd.Convolution(
+            x4, w_conv, A(4), kernel=(3, 3), pad=(1, 1), num_filter=4),
+        "Deconvolution": lambda: mx.nd.Deconvolution(
+            x4, A(3, 4, 3, 3, scale=0.5), kernel=(3, 3), stride=(2, 2),
+            pad=(1, 1), num_filter=4),
+        "Pooling": lambda: mx.nd.Pooling(
+            x4, kernel=(2, 2), stride=(2, 2), pool_type="max"),
+        "BatchNorm": lambda: mx.nd.BatchNorm(
+            x4, A(3, scale=0.3), A(3, scale=0.3), A(3, scale=0.1),
+            mx.nd.abs(A(3)) + 1.0, fix_gamma=False),
+        "FullyConnected": lambda: mx.nd.FullyConnected(
+            A(4, 10), A(6, 10, scale=0.5), A(6), num_hidden=6),
+        "Embedding": lambda: mx.nd.Embedding(
+            I(4, high=5), A(5, 6), input_dim=5, output_dim=6),
+        "RNN": lambda: mx.nd.RNN(
+            seq, A(2 * (6 * 4 + 4 * 4 + 8)), mx.nd.zeros((2, 3, 4),
+                                                         ctx=ctx),
+            state_size=4, num_layers=2, mode="rnn_tanh")[0],
+        "SequenceMask": lambda: mx.nd.SequenceMask(
+            seq, mx.nd.array([2, 5, 3], ctx=ctx), use_sequence_length=True,
+            value=-1.0),
+        "SequenceLast": lambda: mx.nd.SequenceLast(
+            seq, mx.nd.array([2, 5, 3], ctx=ctx),
+            use_sequence_length=True),
+        "SequenceReverse": lambda: mx.nd.SequenceReverse(
+            seq, mx.nd.array([2, 5, 3], ctx=ctx),
+            use_sequence_length=True),
+        "LRN": lambda: mx.nd.LRN(x4, nsize=3, alpha=1e-3, beta=0.7),
+        "LayerNorm": lambda: mx.nd.LayerNorm(A(4, 9), A(9), A(9)),
+        "InstanceNorm": lambda: mx.nd.InstanceNorm(x4, A(3), A(3),
+                                                   eps=1e-4),
+        "L2Normalization": lambda: mx.nd.L2Normalization(A(4, 9)),
+        "SpatialTransformer": lambda: mx.nd.SpatialTransformer(
+            x4, A(2, 6, scale=0.3), target_shape=(4, 4),
+            transform_type="affine", sampler_type="bilinear"),
+        "BilinearSampler": lambda: mx.nd.BilinearSampler(
+            x4, mx.nd.clip(A(2, 2, 4, 4), -0.9, 0.9)),
+        "ROIPooling": lambda: mx.nd.ROIPooling(
+            x4, mx.nd.array([[0, 0, 0, 7, 7], [1, 2, 2, 7, 7]], ctx=ctx),
+            pooled_size=(2, 2), spatial_scale=1.0),
+        "Correlation": lambda: mx.nd.Correlation(
+            x4, A(2, 3, 8, 8), kernel_size=1, max_displacement=2,
+            stride1=1, stride2=1),
+        "Crop": lambda: mx.nd.Crop(x4, offset=(1, 1), h_w=(5, 5)),
+        "Pad": lambda: mx.nd.Pad(
+            x4, mode="constant", constant_value=0.5,
+            pad_width=(0, 0, 0, 0, 1, 1, 2, 2)),
+        "UpSampling": lambda: mx.nd.UpSampling(
+            x4, scale=2, sample_type="nearest"),
+        "CTCLoss": lambda: mx.nd.CTCLoss(
+            A(6, 2, 5), mx.nd.array([[1, 2, 0], [2, 3, 1]], ctx=ctx)),
+        "SoftmaxOutput": lambda: mx.nd.SoftmaxOutput(
+            A(4, 5), mx.nd.array([0, 2, 1, 4], ctx=ctx)),
+        "LeakyReLU": lambda: mx.nd.LeakyReLU(A(4, 4), act_type="elu",
+                                             slope=0.3),
+        "Activation": lambda: mx.nd.Activation(A(4, 4),
+                                               act_type="tanh"),
+        "SoftmaxActivation": lambda: mx.nd.SoftmaxActivation(A(4, 5)),
+        "topk": lambda: mx.nd.topk(A(3, 9), k=3, ret_typ="value"),
+        "one_hot": lambda: mx.nd.one_hot(I(4, high=5), 5),
+        "take": lambda: mx.nd.take(A(6, 3), I(4, high=6)),
+        "pick": lambda: mx.nd.pick(A(4, 5), I(4, high=5)),
+        "gather_nd": lambda: mx.nd.gather_nd(
+            A(4, 5), mx.nd.array([[0, 2, 1], [1, 3, 0]], ctx=ctx)),
+        "scatter_nd": lambda: mx.nd.scatter_nd(
+            A(3), mx.nd.array([[0, 2, 4]], ctx=ctx), shape=(6,)),
+        "Concat": lambda: mx.nd.concat(A(2, 3), A(2, 4), dim=1),
+        "stack": lambda: mx.nd.stack(A(3, 4), A(3, 4), axis=1),
+        "split_v2": lambda: mx.nd.split_v2(A(4, 6), 2, axis=1)[0],
+        "SliceChannel": lambda: mx.nd.SliceChannel(
+            A(4, 6), num_outputs=2, axis=1)[0],
+        "slice": lambda: mx.nd.slice(x4, begin=(0, 1, 2, 2),
+                                     end=(2, 3, 6, 7)),
+        "slice_axis": lambda: mx.nd.slice_axis(x4, axis=2, begin=1,
+                                               end=5),
+        "slice_like": lambda: mx.nd.slice_like(A(6, 7), A(4, 5)),
+        "reshape": lambda: mx.nd.reshape(x4, shape=(2, -1)),
+        "transpose": lambda: mx.nd.transpose(x4, axes=(0, 2, 3, 1)),
+        "tile": lambda: mx.nd.tile(A(2, 3), reps=(2, 2)),
+        "repeat": lambda: mx.nd.repeat(A(2, 3), repeats=2, axis=1),
+        "flip": lambda: mx.nd.flip(x4, axis=2),
+        "reverse": lambda: mx.nd.reverse(x4, axis=2),
+        "expand_dims": lambda: mx.nd.expand_dims(A(3, 4), axis=1),
+        "squeeze": lambda: mx.nd.squeeze(A(3, 1, 4)),
+        "clip": lambda: mx.nd.clip(A(4, 4), -0.5, 0.5),
+        "dot": lambda: mx.nd.dot(A(5, 4), A(5, 6), transpose_a=True),
+        "batch_dot": lambda: mx.nd.batch_dot(A(2, 3, 4), A(2, 4, 5)),
+        "where": lambda: mx.nd.where(A(4, 4) > 0, A(4, 4) + 1.0,
+                                     A(4, 4) - 1.0),
+        "arange_like": lambda: mx.nd.arange_like(A(3, 4), axis=1),
+        "diag": lambda: mx.nd.diag(A(4, 4)),
+        "argsort": lambda: mx.nd.argsort(A(3, 9), axis=1),
+        "argmax": lambda: mx.nd.argmax(A(3, 9), axis=1),
+        "argmin": lambda: mx.nd.argmin(A(3, 9), axis=1),
+        "sort": lambda: mx.nd.sort(A(3, 9), axis=1),
+        "smooth_l1": lambda: mx.nd.smooth_l1(A(4, 4), scalar=1.5),
+        "Flatten": lambda: mx.nd.Flatten(x4),
+        "BlockGrad": lambda: mx.nd.BlockGrad(A(3, 3)),
+        "MakeLoss": lambda: mx.nd.MakeLoss(mx.nd.abs(A(3, 3))),
+        "Cast": lambda: mx.nd.Cast(A(3, 3), dtype="float16"),
+        "cast_storage": lambda: mx.nd.cast_storage(A(3, 3),
+                                                   stype="default"),
+        "broadcast_to": lambda: mx.nd.broadcast_to(A(1, 4),
+                                                   shape=(3, 4)),
+        "broadcast_like": lambda: mx.nd.broadcast_like(A(1, 4), A(3, 4)),
+        "broadcast_axis": lambda: mx.nd.broadcast_axis(A(1, 4), axis=0,
+                                                       size=3),
+        "SVMOutput": lambda: mx.nd.SVMOutput(
+            A(4, 5), mx.nd.array([0, 2, 1, 4], ctx=ctx)),
+        "LinearRegressionOutput": lambda: mx.nd.LinearRegressionOutput(
+            A(4, 3), A(4, 3)),
+        "MAERegressionOutput": lambda: mx.nd.MAERegressionOutput(
+            A(4, 3), A(4, 3)),
+        "LogisticRegressionOutput": lambda: mx.nd.LogisticRegressionOutput(
+            A(4, 3), mx.nd.abs(A(4, 3))),
+        "IdentityAttachKLSparseReg": lambda:
+            mx.nd.IdentityAttachKLSparseReg(mx.nd.sigmoid(A(4, 3))),
+        "softmax_cross_entropy": lambda: mx.nd.softmax_cross_entropy(
+            A(4, 5), mx.nd.array([0, 2, 1, 4], ctx=ctx)),
+        # linalg family: SPD / triangular operands built deterministically
+        "_linalg_det": lambda: mx.nd.linalg.det(_spd(A, 4)),
+        "_linalg_slogdet": lambda: mx.nd.linalg.slogdet(_spd(A, 4))[1],
+        "_linalg_inverse": lambda: mx.nd.linalg.inverse(_spd(A, 4)),
+        "_linalg_potrf": lambda: mx.nd.linalg.potrf(_spd(A, 4)),
+        "_linalg_potri": lambda: mx.nd.linalg.potri(
+            mx.nd.linalg.potrf(_spd(A, 4))),
+        "_linalg_sumlogdiag": lambda: mx.nd.linalg.sumlogdiag(
+            mx.nd.linalg.potrf(_spd(A, 4))),
+        "_linalg_gemm": lambda: mx.nd.linalg.gemm(
+            A(3, 4), A(4, 5), A(3, 5), alpha=1.5, beta=0.5),
+        "_linalg_gemm2": lambda: mx.nd.linalg.gemm2(A(3, 4), A(4, 5)),
+        "_linalg_trmm": lambda: mx.nd.linalg.trmm(
+            mx.nd.linalg.potrf(_spd(A, 4)), A(4, 3)),
+        "_linalg_trsm": lambda: mx.nd.linalg.trsm(
+            mx.nd.linalg.potrf(_spd(A, 4)), A(4, 3)),
+        "_linalg_syevd": lambda: mx.nd.linalg.syevd(_spd(A, 4))[1],
+        "_linalg_syrk": lambda: mx.nd.linalg.syrk(A(3, 4)),
+        "_linalg_maketrian": lambda: mx.nd.linalg.maketrian(A(2, 10)),
+        "_linalg_extracttrian": lambda: mx.nd.linalg.extracttrian(
+            _spd(A, 4)),
+        "_contrib_ROIAlign": lambda: mx.nd.contrib.ROIAlign(
+            x4, mx.nd.array([[0, 0, 0, 7, 7], [1, 1, 1, 6, 6]], ctx=ctx),
+            pooled_size=(2, 2), spatial_scale=1.0),
+        "_contrib_boolean_mask": lambda: mx.nd.contrib.boolean_mask(
+            A(5, 3), mx.nd.array([1, 0, 1, 1, 0], ctx=ctx)),
+        "_contrib_index_copy": lambda: mx.nd.contrib.index_copy(
+            A(5, 3), mx.nd.array([1, 3], ctx=ctx), A(2, 3)),
+        "_contrib_count_sketch": lambda: mx.nd.contrib.count_sketch(
+            A(3, 8), mx.nd.array([1, 0, 1, 1, 0, 1, 0, 1], ctx=ctx),
+            I(8, high=4), out_dim=4),
+        "_contrib_quantize": lambda: mx.nd.contrib.quantize(
+            A(4, 4), mx.nd.array([-1.0], ctx=ctx),
+            mx.nd.array([1.0], ctx=ctx), out_type="int8")[0],
+        "_contrib_dequantize": lambda: mx.nd.contrib.dequantize(
+            mx.nd.contrib.quantize_v2(A(4, 4), out_type="int8")[0],
+            mx.nd.array([-2.0], ctx=ctx), mx.nd.array([2.0], ctx=ctx)),
+        "batch_take": lambda: mx.nd.batch_take(A(4, 5), I(4, high=5)),
+        "broadcast_power": lambda: mx.nd.broadcast_power(
+            mx.nd.abs(A(3, 4)) + 0.5, mx.nd.abs(A(1, 4))),
+        "arccosh": lambda: mx.nd.arccosh(mx.nd.abs(A(3, 4)) + 1.5),
+        "im2col": lambda: mx.nd.im2col(x4, kernel=(3, 3), pad=(1, 1)),
+        "col2im": lambda: mx.nd.col2im(
+            mx.nd.im2col(x4, kernel=(3, 3), pad=(1, 1)),
+            output_size=(8, 8), kernel=(3, 3), pad=(1, 1)),
+        "sgd_update": lambda: mx.nd.sgd_update(A(4, 3), A(4, 3), lr=0.1),
+        "sgd_mom_update": lambda: mx.nd.sgd_mom_update(
+            A(4, 3), A(4, 3), A(4, 3), lr=0.1, momentum=0.9),
+        "adam_update": lambda: mx.nd.adam_update(
+            A(4, 3), A(4, 3), A(4, 3), mx.nd.abs(A(4, 3)), lr=0.1),
+        "rmsprop_update": lambda: mx.nd.rmsprop_update(
+            A(4, 3), A(4, 3), mx.nd.abs(A(4, 3)), lr=0.1),
+        "ftrl_update": lambda: mx.nd.ftrl_update(
+            A(4, 3), A(4, 3), A(4, 3), mx.nd.abs(A(4, 3)), lr=0.1),
+        "signsgd_update": lambda: mx.nd.signsgd_update(
+            A(4, 3), A(4, 3), lr=0.1),
+    }
+
+
+def _spd(A, n):
+    """Deterministic symmetric positive-definite matrix."""
+    m = A(n, n)
+    import mxnet_tpu as _mx
+    return _mx.nd.dot(m, m, transpose_b=True) + _mx.nd.array(
+        np.eye(n, dtype="float32") * n)
+
+
+_POSITIVE_OPS = {
+    "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "cbrt", "rcbrt",
+    "gammaln", "gamma", "digamma", "reciprocal", "_power", "power",
+    "arccosh", "log_softmax", "softmax", "softmin", "erfinv",
+}
+_UNIT_OPS = {"arcsin", "arccos", "arctanh", "erfinv"}   # domain (-1, 1)
+
+
+def sweep_batch(mx, ctx, collect_skips=None):
+    """name → NDArray for every sweepable registered op (deterministic)."""
+    from mxnet_tpu.ops import registry
+
+    def A(*shape, scale=1.0):
+        rng = np.random.RandomState(abs(hash(shape)) % (2 ** 31))
+        return mx.nd.array(rng.randn(*shape).astype("float32") * scale,
+                           ctx=ctx)
+
+    def I(n, high):
+        rng = np.random.RandomState(n * 1000 + high)
+        return mx.nd.array(rng.randint(0, high, size=(n,))
+                           .astype("float32"), ctx=ctx)
+
+    specs = _specs(mx, ctx, A, I)
+    out = {}
+    skips = {}
+
+    def record(name, thunk):
+        try:
+            r = thunk()
+        except Exception as e:                        # noqa: BLE001
+            skips[name] = f"{type(e).__name__}: {e}"
+            return
+        if isinstance(r, (list, tuple)):
+            r = r[0]
+        arr = r.asnumpy()
+        if not np.isfinite(arr.astype("float64")).all():
+            skips[name] = "non-finite output"
+            return
+        out[name] = r
+
+    seen_fns = set()
+    for name in sorted(registry.list_ops()):
+        op = registry.get(name)
+        if name in SKIP or name.startswith(("_backward", "_np", "_image",
+                                            "_contrib_int8")):
+            skips[name] = "skip-listed"
+            continue
+        if id(op.fn) in seen_fns:
+            skips[name] = "alias of swept op"
+            continue
+        seen_fns.add(id(op.fn))
+        if name in specs:
+            record(name, specs[name])
+            continue
+        fn = getattr(mx.nd, name, None)
+        if fn is None:
+            skips[name] = "no nd frontend"
+            continue
+        try:
+            params = inspect.signature(op.fn).parameters
+        except (TypeError, ValueError):
+            skips[name] = "no signature"
+            continue
+        if any(p.kind == inspect.Parameter.VAR_POSITIONAL
+               for p in params.values()) or op.wrap_list:
+            n_req = 2
+        else:
+            n_req = sum(1 for p in params.values()
+                        if p.default is inspect.Parameter.empty
+                        and p.kind in (p.POSITIONAL_ONLY,
+                                       p.POSITIONAL_OR_KEYWORD)
+                        and p.name not in ("key",))
+        if n_req == 0 or n_req > 3:
+            skips[name] = f"needs {n_req} args"
+            continue
+
+        base = name.lstrip("_")
+        if base in _UNIT_OPS:
+            mk = lambda *s: mx.nd.clip(A(*s), -0.9, 0.9)
+        elif base in _POSITIVE_OPS:
+            mk = lambda *s: mx.nd.abs(A(*s)) + 0.5
+        else:
+            mk = A
+        done = False
+        for shape in (_GENERIC_4D, _GENERIC_2D):
+            try:
+                r = fn(*[mk(*shape) for _ in range(n_req)])
+                if isinstance(r, (list, tuple)):
+                    r = r[0]
+                arr = r.asnumpy()
+                if np.isfinite(arr.astype("float64")).all():
+                    out[name] = r
+                    done = True
+                    break
+            except Exception:                        # noqa: BLE001
+                continue
+        if not done:
+            skips[name] = "generic synthesis failed"
+    if collect_skips is not None:
+        collect_skips.update(skips)
+    return out

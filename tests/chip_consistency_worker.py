@@ -87,9 +87,13 @@ def main():
         print("NO_ACCELERATOR")
         return 0
     ctx = mx.gpu(0)
+    from chip_consistency_sweep import sweep_batch
     with jax.default_matmul_precision("highest"):
         outs = op_batch(mx, ctx)
         arrays = {k: v.asnumpy() for k, v in outs.items()}
+        if os.environ.get("CHIP_SWEEP", "1") != "0":
+            for k, v in sweep_batch(mx, ctx).items():
+                arrays[f"sweep:{k}"] = v.asnumpy()
     np.savez(out_path, **arrays)
     print(f"CHIP_OK n={len(arrays)} device={accel[0].device_kind!r}")
     return 0

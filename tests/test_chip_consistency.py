@@ -18,6 +18,18 @@ import pytest
 
 import mxnet_tpu as mx
 from chip_consistency_worker import op_batch
+from chip_consistency_sweep import sweep_batch
+
+
+def test_sweep_coverage_floor():
+    """The generated sweep must cover ≥250 registered ops on this build —
+    a silent synthesis regression would otherwise hollow out the
+    chip-consistency guarantee (reference runs its whole operator suite
+    on the second backend)."""
+    skips = {}
+    out = sweep_batch(mx, mx.cpu(), collect_skips=skips)
+    assert len(out) >= 250, (len(out), sorted(
+        k for k, v in skips.items() if "synthesis failed" in v)[:30])
 
 
 def test_op_batch_matches_chip(tmp_path):
@@ -25,6 +37,8 @@ def test_op_batch_matches_chip(tmp_path):
 
     with jax.default_matmul_precision("highest"):
         want = {k: v.asnumpy() for k, v in op_batch(mx, mx.cpu()).items()}
+        for k, v in sweep_batch(mx, mx.cpu()).items():
+            want[f"sweep:{k}"] = v.asnumpy()
 
     out_path = str(tmp_path / "chip.npz")
     env = {k: v for k, v in os.environ.items()

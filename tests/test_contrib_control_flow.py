@@ -134,8 +134,8 @@ def test_sym_while_loop_padded_outputs():
     i_v = mx.sym.Variable("i")
     tot = mx.sym.Variable("tot")
     outs, fvars = mx.sym.contrib.while_loop(
-        cond=lambda vs: vs[1] < 10,
-        func=lambda vs: (vs[0], [vs[0] + 1, vs[1] + vs[0]]),
+        cond=lambda i, tot: tot < 10,
+        func=lambda i, tot: (i, [i + 1, tot + i]),
         loop_vars=[i_v, tot], max_iterations=8)
     g = mx.sym.Group([outs, fvars[0], fvars[1]])
     ex = g.simple_bind(ctx=mx.cpu(), i=(1,), tot=(1,))
@@ -152,8 +152,8 @@ def test_sym_while_loop_padded_outputs():
 def test_sym_while_loop_requires_max_iterations():
     v = mx.sym.Variable("v")
     with pytest.raises(ValueError):
-        mx.sym.contrib.while_loop(lambda vs: vs[0] < 1,
-                                  lambda vs: (vs[0], [vs[0]]),
+        mx.sym.contrib.while_loop(lambda v: v < 1,
+                                  lambda v: (v, [v]),
                                   [v], max_iterations=None)
 
 
@@ -204,8 +204,8 @@ def test_sym_while_loop_inactive_iterations_cannot_poison_gradients():
     # 1/0 at an inactive step NaN'd the gradient through the where-mask.
     v = mx.sym.Variable("v")
     outs, fvars = mx.sym.contrib.while_loop(
-        cond=lambda vs: vs[0] > 0,
-        func=lambda vs: (1.0 / vs[0], [vs[0] - 1]),
+        cond=lambda v: v > 0,
+        func=lambda v: (1.0 / v, [v - 1]),
         loop_vars=[v], max_iterations=4)
     loss = mx.sym.sum(outs)
     ex = loss.simple_bind(ctx=mx.cpu(), v=(1,), grad_req="write")
@@ -257,8 +257,8 @@ def test_while_loop_json_roundtrip(tmp_path):
     i0 = mx.sym.Variable("i0")
     acc0 = mx.sym.Variable("acc0")
     outs, vars_ = mx.sym.contrib.while_loop(
-        cond=lambda vs: vs[0] < 5,
-        func=lambda vs: ([vs[1]], [vs[0] + 1, vs[1] * 2]),
+        cond=lambda i, acc: i < 5,
+        func=lambda i, acc: ([acc], [i + 1, acc * 2]),
         loop_vars=[i0, acc0], max_iterations=8)
     g = mx.sym.Group([outs[0], vars_[1]])
     f = str(tmp_path / "wl-symbol.json")

@@ -8,7 +8,8 @@ import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu.gluon.contrib.estimator import (
-    Estimator, EarlyStoppingHandler, CheckpointHandler,
+    Estimator, EarlyStoppingHandler, CheckpointHandler, LoggingHandler,
+    MetricHandler,
 )
 
 
@@ -43,7 +44,9 @@ def test_estimator_early_stopping_and_checkpoint(tmp_path):
     loader = mx.gluon.data.DataLoader(mx.gluon.data.ArrayDataset(x, y),
                                       batch_size=32)
     handlers = [EarlyStoppingHandler(est.train_metrics[0], patience=1),
-                CheckpointHandler(str(tmp_path), epoch_period=1)]
+                CheckpointHandler(str(tmp_path), epoch_period=1),
+                MetricHandler(est.train_metrics),
+                LoggingHandler(metrics=est.train_metrics)]
     est.fit(loader, epochs=5, event_handlers=handlers)
     assert any(f.endswith(".params") for f in os.listdir(tmp_path))
 

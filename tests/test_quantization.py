@@ -161,3 +161,81 @@ def test_stale_threshold_table_fails_loudly():
     sym = _mlp()
     with pytest.raises(ValueError, match="none of the .* threshold keys"):
         qz.quantize_graph(sym, {}, {"no_such_node:0": (0.0, 1.0)})
+
+
+def test_fused_int8_lowering_mlp():
+    """lower_int8_inference on the toy MLP: FC layers fuse to int8 dot
+    kernels and the logits track fp32 (r4 fast path)."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(64, 8).astype("float32")
+    sym = _mlp()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    it = mx.io.NDArrayIter(x, np.zeros(64, "float32"), batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    arg_params, aux_params = mod.get_params()
+    fp32_out = mod.predict(it).asnumpy()
+
+    qsym, qargs, qauxs = qz.quantize_model(
+        sym, arg_params, aux_params, calib_mode="naive", calib_data=it,
+        num_calib_examples=64, lowering="fused_int8")
+    ops = [n.op.name for n in qsym._topo() if n.op is not None]
+    assert ops.count("_contrib_int8_fc_fused") == 2, ops
+    assert "FullyConnected" not in ops
+    qmod = mx.mod.Module(qsym, context=mx.cpu())
+    qmod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+              for_training=False)
+    qmod.set_params(qargs, qauxs, allow_missing=False)
+    int8_out = qmod.predict(it).asnumpy()
+    assert np.max(np.abs(int8_out - fp32_out)) < 0.05
+    assert (int8_out.argmax(1) == fp32_out.argmax(1)).mean() > 0.95
+
+
+def test_fused_int8_lowering_convnet_residual():
+    """Conv+BN+relu chains and a residual add fuse completely; numerics
+    track fp32 within int8 tolerance."""
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, name="c1", kernel=(3, 3), pad=(1, 1),
+                            num_filter=8, no_bias=True)
+    b1 = mx.sym.BatchNorm(c1, name="b1", fix_gamma=False)
+    a1 = mx.sym.Activation(b1, name="a1", act_type="relu")
+    c2 = mx.sym.Convolution(a1, name="c2", kernel=(1, 1), num_filter=8,
+                            no_bias=True)
+    b2 = mx.sym.BatchNorm(c2, name="b2", fix_gamma=False)
+    s = mx.sym.broadcast_add(b2, a1, name="res")
+    out = mx.sym.Activation(s, name="a2", act_type="relu")
+    sym = mx.sym.Pooling(out, name="gp", global_pool=True, pool_type="avg",
+                         kernel=(1, 1))
+    sym = mx.sym.FullyConnected(sym, name="fc", num_hidden=3)
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 4, 8, 8).astype("float32")
+    args = {"c1_weight": mx.nd.array(rng.randn(8, 4, 3, 3) * 0.3),
+            "c2_weight": mx.nd.array(rng.randn(8, 8, 1, 1) * 0.3),
+            "b1_gamma": mx.nd.array(1 + 0.1 * rng.randn(8)),
+            "b1_beta": mx.nd.array(0.1 * rng.randn(8)),
+            "b2_gamma": mx.nd.array(1 + 0.1 * rng.randn(8)),
+            "b2_beta": mx.nd.array(0.1 * rng.randn(8)),
+            "fc_weight": mx.nd.array(rng.randn(3, 8) * 0.3),
+            "fc_bias": mx.nd.zeros(3)}
+    auxs = {"b1_moving_mean": mx.nd.array(0.05 * rng.randn(8)),
+            "b1_moving_var": mx.nd.array(1 + 0.1 * rng.rand(8)),
+            "b2_moving_mean": mx.nd.array(0.05 * rng.randn(8)),
+            "b2_moving_var": mx.nd.array(1 + 0.1 * rng.rand(8))}
+    xin = mx.nd.array(x)
+    ref = sym.bind(mx.cpu(), {**args, "data": xin}, aux_states=auxs) \
+        .forward(is_train=False)[0].asnumpy()
+
+    it = mx.io.NDArrayIter(x, np.zeros(4, "float32"), batch_size=4)
+    qsym, qargs, qauxs = qz.quantize_model(
+        sym, args, auxs, calib_mode="naive", calib_data=it,
+        num_calib_examples=4, lowering="fused_int8")
+    ops = [n.op.name for n in qsym._topo() if n.op is not None]
+    assert ops.count("_contrib_int8_conv_fused") == 2, ops
+    assert ops.count("_contrib_int8_add_act") == 1, ops
+    assert "BatchNorm" not in ops and "Convolution" not in ops, ops
+    got = qsym.bind(mx.cpu(), {**qargs, "data": xin}, aux_states=qauxs) \
+        .forward(is_train=False)[0].asnumpy()
+    scale = np.abs(ref).max()
+    assert np.abs(got - ref).max() < 0.05 * scale + 0.02, \
+        (np.abs(got - ref).max(), scale)

@@ -77,3 +77,16 @@ def test_model_zoo_resnet18_int8_within_tolerance():
     # the reference's published contract: ~1% degradation on real nets;
     # on this fixture allow 2 points of top-1
     assert int8_acc >= fp32_acc - 0.02, (fp32_acc, int8_acc)
+
+    # the FAST path (r4): fused int8 lowering — offline per-channel int8
+    # weights, folded BN, int8 MXU matmuls, int8 NHWC activations.  Same
+    # accuracy contract as the fake-quant formulation.
+    calib.reset()
+    fsym, farg, faux = quantize_model(
+        sym, arg_params, aux_params, calib_mode="entropy",
+        calib_data=calib, num_calib_examples=96, lowering="fused_int8")
+    ops = {n.op.name for n in fsym._topo() if n.op is not None}
+    assert "_contrib_int8_conv_fused" in ops, ops
+    assert "Convolution" not in ops, "a conv fell back to fp32"
+    fused_acc = top1(fsym, farg, faux)
+    assert fused_acc >= fp32_acc - 0.02, (fp32_acc, fused_acc)
