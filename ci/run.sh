@@ -66,6 +66,8 @@ stage_examples() {
   python example/gan/dcgan.py --iters 120
   python example/image-classification/fine-tune.py
   python example/multi-task/multi_task.py
+  python example/numpy-ops/custom_softmax.py --epochs 5
+  python example/amp/finetune_amp.py --epochs 3
 }
 
 stage_bench() {

@@ -165,9 +165,10 @@ def _clip(sym, ins, attrs, name):
 
 @register("Gather")
 def _gather(sym, ins, attrs, name):
-    # (weight, indices) → Embedding(indices, weight); importer fixes arity
-    assert int(attrs.get("axis", 0)) == 0, "Gather axis != 0 unsupported"
-    return ("__gather__", {})
+    # axis-0 gather from a 2-D weight → Embedding (the lookup pattern);
+    # anything else (foreign exporters emit Gather for tensor indexing,
+    # e.g. torch x[:, :, i] → Gather axis=2) lowers to ``take``
+    return ("__gather__", {"axis": int(attrs.get("axis", 0))})
 
 
 @register("LayerNormalization")
@@ -553,6 +554,15 @@ _INPUT_FORM = {
     "Clip": [(1, "min"), (2, "max")],
     "Pad": [(1, "pads"), (2, "value")],
     "ReduceSum": [(1, "axes")],
+    "ReduceMean": [(1, "axes")],     # opset 18 moved axes to an input
+    "ReduceMax": [(1, "axes")],      # for EVERY Reduce* op
+    "ReduceMin": [(1, "axes")],
+    "ReduceProd": [(1, "axes")],
+    "ReduceL2": [(1, "axes")],
+    "ReduceL1": [(1, "axes")],
+    "ReduceLogSum": [(1, "axes")],
+    "ReduceLogSumExp": [(1, "axes")],
+    "ReduceSumSquare": [(1, "axes")],
     "Split": [(1, "split")],
     "Expand": [(1, "shape")],
     "Tile": [(1, "repeats")],
@@ -640,11 +650,25 @@ def _import_graph_impl(graph):
             out = getattr(sym_mod, "_batched_gather")(ins[0], idx,
                                                       name=n["name"])
         elif mx_op == "__gather__":
-            out = getattr(sym_mod, "Embedding")(
-                ins[1], ins[0],
-                input_dim=int(inits[n["inputs"][0]].shape[0]),
-                output_dim=int(inits[n["inputs"][0]].shape[1]),
-                name=n["name"])
+            ax = kw.get("axis", 0)
+            src = n["inputs"][0]
+            if ax == 0 and src in inits and inits[src].ndim == 2:
+                in_dim = int(inits[src].shape[0])
+                # ONNX negative indices count from the end; Embedding
+                # clips — wrap first so both Gather lowerings agree
+                idx = sym_mod._mod_scalar(ins[1] + float(in_dim),
+                                          scalar=float(in_dim))
+                out = getattr(sym_mod, "Embedding")(
+                    idx, ins[0],
+                    input_dim=in_dim,
+                    output_dim=int(inits[src].shape[1]),
+                    name=n["name"])
+            else:
+                # mode='wrap' gives ONNX's negative-index semantics
+                # (idx mod dim maps -1 → last); clip would silently send
+                # negatives to 0
+                out = sym_mod.take(ins[0], ins[1], axis=ax, mode="wrap",
+                                   name=n["name"])
         elif mx_op == "__reshape__":
             shape = tuple(int(x) for x in inits[n["inputs"][1]])
             out = sym_mod.Reshape(ins[0], shape=shape, name=n["name"])

@@ -23,6 +23,11 @@ def _axes(axis, ndim, exclude=False):
         return None if not exclude else ()
     if isinstance(axis, (int,)):
         axis = (axis,)
+    for a in axis:
+        if not -ndim <= a < ndim:
+            raise ValueError(
+                f"axis {a} out of range for a {ndim}-dimensional input "
+                f"(reference: CHECK on reduce axis bounds)")
     axis = tuple(a % ndim for a in axis)
     if parse_bool(exclude):
         axis = tuple(a for a in range(ndim) if a not in axis)

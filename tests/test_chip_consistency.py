@@ -52,7 +52,14 @@ def test_op_batch_matches_chip(tmp_path):
     if "NO_ACCELERATOR" in proc.stdout:
         pytest.skip("no accelerator reachable from this box")
     got = np.load(out_path)
-    assert set(got.files) == set(want)
+    # decompositional linalg (cholesky/eigh/inverse/...) has no TPU
+    # lowering on this target — those sweep entries run CPU-only, like
+    # the reference's per-op GPU skip markers.  Everything else must be
+    # present on BOTH backends.
+    missing = set(want) - set(got.files)
+    assert all(k.startswith("sweep:_linalg_") for k in missing), missing
+    assert not set(got.files) - set(want)
+    want = {k: v for k, v in want.items() if k not in missing}
     # tolerance: transcendentals (erf, gammaln, exp/log inside softmax)
     # use different polynomial approximations per backend — observed
     # cross-backend deltas are ~6e-5; real defects (wrong axis, layout,

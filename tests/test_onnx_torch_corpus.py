@@ -1,0 +1,154 @@
+"""Foreign-framework ONNX corpus (VERDICT r4 item 3): graphs exported by
+torch — a genuinely external producer — must parse through the
+hand-written wire-format reader and import with value-level agreement
+against torch's own eval outputs.
+
+This is the first true external check of both the protobuf parser and the
+converter semantics (reference imports foreign graphs via
+``python/mxnet/contrib/onnx/onnx2mx/import_onnx.py``).  The image has
+torch but no ``onnx``/``torchvision`` wheels, so serialization calls
+torch's C++ proto exporter directly (the python wrapper insists on the
+``onnx`` module purely for its checker) and the models are plain-torch
+equivalents of the torchvision fixtures.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import onnx as onnx_mod
+
+
+def _export_onnx_bytes(model, args, opset=13):
+    """torch model → real ONNX ModelProto bytes, without the onnx wheel."""
+    import warnings
+    from torch.onnx.utils import _model_to_graph
+
+    model.eval()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        graph, params_dict, _ = _model_to_graph(
+            model, args, do_constant_folding=True)
+        proto, _export_map, *_ = graph._export_onnx(
+            params_dict, opset, {}, False,
+            torch._C._onnx.OperatorExportTypes.ONNX, True, True, {},
+            True, "", {})
+    return proto
+
+
+def _run_imported(proto, x_np):
+    sym, arg_params, aux_params = onnx_mod.import_model(proto)
+    data_names = [n for n in sym.list_arguments()
+                  if n not in arg_params and n not in aux_params]
+    assert len(data_names) == 1, data_names
+    ex = sym.bind(mx.cpu(),
+                  {**arg_params, data_names[0]: mx.nd.array(x_np)},
+                  aux_states=aux_params)
+    return ex.forward(is_train=False)[0].asnumpy()
+
+
+class _ResidualCNN(torch.nn.Module):
+    """resnet-basic-block shaped fixture: conv/BN/relu chains, a residual
+    add, stride-2 downsample, global average pool, linear head."""
+
+    def __init__(self):
+        super().__init__()
+        n = torch.nn
+        self.stem = n.Sequential(n.Conv2d(3, 16, 3, padding=1, bias=False),
+                                 n.BatchNorm2d(16), n.ReLU())
+        self.c1 = n.Sequential(n.Conv2d(16, 16, 3, padding=1, bias=False),
+                               n.BatchNorm2d(16), n.ReLU(),
+                               n.Conv2d(16, 16, 3, padding=1, bias=False),
+                               n.BatchNorm2d(16))
+        self.down = n.Sequential(n.Conv2d(16, 32, 1, stride=2, bias=False),
+                                 n.BatchNorm2d(32))
+        self.c2 = n.Sequential(n.Conv2d(16, 32, 3, stride=2, padding=1,
+                                        bias=False),
+                               n.BatchNorm2d(32))
+        self.head = n.Linear(32, 10)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = torch.relu(x + self.c1(x))
+        x = torch.relu(self.down(x) + self.c2(x))
+        x = torch.nn.functional.adaptive_avg_pool2d(x, 1).flatten(1)
+        return self.head(x)
+
+
+class _TinyTransformer(torch.nn.Module):
+    """Small encoder: embedding-free (takes float sequences), one
+    self-attention block + MLP, layernorm, mean-pool head."""
+
+    def __init__(self, d=32, heads=4):
+        super().__init__()
+        n = torch.nn
+        self.d = d
+        self.qkv = n.Linear(d, 3 * d)
+        self.proj = n.Linear(d, d)
+        self.ln1 = n.LayerNorm(d)
+        self.ln2 = n.LayerNorm(d)
+        self.mlp = n.Sequential(n.Linear(d, 4 * d), n.GELU(),
+                                n.Linear(4 * d, d))
+        self.head = n.Linear(d, 5)
+        self.heads = heads
+
+    def forward(self, x):                      # (B, T, d)
+        b, t, d = x.shape
+        h = self.ln1(x)
+        qkv = self.qkv(h).reshape(b, t, 3, self.heads, d // self.heads)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = q.transpose(1, 2)                  # (B, H, T, hd)
+        k = k.transpose(1, 2)
+        v = v.transpose(1, 2)
+        att = torch.softmax(q @ k.transpose(-1, -2) /
+                            (d // self.heads) ** 0.5, dim=-1)
+        y = (att @ v).transpose(1, 2).reshape(b, t, d)
+        x = x + self.proj(y)
+        x = x + self.mlp(self.ln2(x))
+        return self.head(x.mean(dim=1))
+
+
+def test_torch_convnet_imports_with_matching_logits():
+    torch.manual_seed(0)
+    n = torch.nn
+    m = n.Sequential(
+        n.Conv2d(3, 8, 3, padding=1), n.BatchNorm2d(8), n.ReLU(),
+        n.MaxPool2d(2), n.Conv2d(8, 16, 3, padding=1), n.ReLU(),
+        n.AvgPool2d(2), n.Flatten(), n.Linear(16 * 4 * 4, 10))
+    m.eval()
+    x = torch.randn(2, 3, 16, 16)
+    proto = _export_onnx_bytes(m, (x,))
+    with torch.no_grad():
+        want = m(x).numpy()
+    got = _run_imported(proto, x.numpy())
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_torch_residual_cnn_imports_with_matching_logits():
+    torch.manual_seed(1)
+    m = _ResidualCNN()
+    # non-trivial BN running stats (fresh init has mean 0 / var 1)
+    m.train()
+    with torch.no_grad():
+        for _ in range(3):
+            m(torch.randn(8, 3, 32, 32))
+    m.eval()
+    x = torch.randn(2, 3, 32, 32)
+    proto = _export_onnx_bytes(m, (x,))
+    with torch.no_grad():
+        want = m(x).numpy()
+    got = _run_imported(proto, x.numpy())
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_torch_transformer_imports_with_matching_logits():
+    torch.manual_seed(2)
+    m = _TinyTransformer()
+    m.eval()
+    x = torch.randn(2, 6, 32)
+    proto = _export_onnx_bytes(m, (x,))
+    with torch.no_grad():
+        want = m(x).numpy()
+    got = _run_imported(proto, x.numpy())
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
