@@ -651,6 +651,10 @@ def bench_e2e_train_with_io():
         rate = float(np.median(rates))
         exposed_ms = max(0.0, (batch / rate - synth_step) * 1e3)
         return {"items_per_sec": round(rate, 2),
+                "bound": "host->device staging through the measurement "
+                         "tunnel (~17 MB/s, see imagerecorditer_pipeline."
+                         "device_roundtrip_mb_per_sec); on direct-attached "
+                         "TPU the pipeline feeds at the decode rate",
                 "images_per_epoch": n,
                 "epochs_timed": 3,
                 "synthetic_step_ms": round(synth_step * 1e3, 3),

@@ -10,8 +10,32 @@ sum/sumsq accumulators; the only cross-lane fold is the final (C, 128) →
 (C,) pass over the tiny accumulator, done once in XLA.
 
 Measures both against the framework's current one-pass XLA formulation
-(shifted E[x], E[x^2] — ops/nn.py batch_norm) on the four ResNet-50 BN
-activation shapes, batch 32, bf16 activations / f32 statistics.
+(shifted E[x], E[x^2] — ops/nn.py batch_norm) on all nine ResNet-50 BN
+activation geometries, batch 32, bf16 activations / f32 statistics.
+
+MEASURED RESULT (r4, v5e via axon; 600 dispatches per timed block so the
+~100 ms tunnel sync RTT is amortized; values stable across reruns):
+
+  shape                 xla_us  pallas_us  pallas_vs_xla
+  (32,  64, 112, 112)    353.0     303.2       1.16
+  (32,  64,  56,  56)    177.0     277.1       0.64
+  (32, 256,  56,  56)    313.8     266.5       1.18
+  (32, 128,  28,  28)    282.1     274.2       1.03
+  (32, 512,  28,  28)    289.2     303.8       0.95
+  (32, 256,  14,  14)    284.1     252.1       1.13
+  (32,1024,  14,  14)    295.3     348.0       0.85
+  (32, 512,   7,   7)    224.4     400.7       0.56
+  (32,2048,   7,   7)    300.4     228.4       1.32
+  TOTAL                 2.519 ms  2.654 ms     0.95x
+
+Conclusion: with the lane-aligned formulation the kernel is numerically
+exact and competitive per shape (0.56-1.32x), but the AGGREGATE over the
+ResNet-50 inventory is a 5% LOSS vs XLA's fused reduction — XLA's BN
+stats are already near the memory-bandwidth bound; the standalone-kernel
+headroom the r3 analysis hoped for does not exist.  (The kernel cannot
+fuse with the producing convolution, which is where any real win would
+have to come from.)  The r3/r4 ResNet-50 train MFU item retires on this
+evidence per VERDICT r4 item 2's criterion.
 
 Usage: python benchmark/pallas_bn_stats.py
 """
@@ -96,21 +120,11 @@ def main():
     rng = np.random.RandomState(0)
     results = {}
 
-    def time_fn(fn, x, reps=40, blocks=5):
+    def time_fn(fn, x, reps=600, blocks=5):
+        # sub-millisecond kernels: the block must dwarf the ~100 ms tunnel
+        # sync RTT or the subtraction noise swamps the signal
         c = jax.jit(fn).lower(x).compile()
-        m, v = c(x)
-
-        def block():
-            for _ in range(reps):
-                r = c(x)
-            return r
-
-        out = block()
-
-        def sync():
-            return float(np.asarray(out[0][0]) + np.asarray(block()[0][0]))
-
-        # time with a chained-fetch sync per block
+        c(x)                            # compile + warm
         holder = {}
 
         def tblock():

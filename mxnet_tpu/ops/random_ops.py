@@ -38,6 +38,19 @@ def _shape_dtype(shape, dtype):
     return shape, dt
 
 
+def _require_positive(name, value, allow_zero=False):
+    """Static distribution parameters must be valid at the CALL SITE
+    (reference dmlc-param CHECK in the sampler structs — its engine
+    rethrows at the wait point; eager dispatch raises earlier)."""
+    if value is None:
+        return
+    v = float(value)
+    if v < 0 or (v == 0 and not allow_zero):
+        raise ValueError(
+            f"random sampler parameter {name}={v} must be "
+            f"{'non-negative' if allow_zero else 'positive'}")
+
+
 @_register_random("_random_uniform", aliases=("uniform", "random_uniform"))
 def random_uniform(key, low=0.0, high=1.0, shape=None, dtype=None, ctx=None):
     shape, dt = _shape_dtype(shape, dtype)
@@ -47,24 +60,30 @@ def random_uniform(key, low=0.0, high=1.0, shape=None, dtype=None, ctx=None):
 @_register_random("_random_normal", aliases=("normal", "random_normal"))
 def random_normal(key, loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None):
     shape, dt = _shape_dtype(shape, dtype)
+    _require_positive("scale", parse_float(scale, 1.0), allow_zero=True)
     return jax.random.normal(key, shape, dt) * parse_float(scale, 1.0) + parse_float(loc, 0.0)
 
 
 @_register_random("_random_gamma", aliases=("gamma", "random_gamma"))
 def random_gamma(key, alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None):
     shape, dt = _shape_dtype(shape, dtype)
+    _require_positive("alpha", parse_float(alpha, 1.0))
+    _require_positive("beta", parse_float(beta, 1.0))
     return jax.random.gamma(key, parse_float(alpha, 1.0), shape, dt) * parse_float(beta, 1.0)
 
 
 @_register_random("_random_exponential", aliases=("exponential", "random_exponential"))
 def random_exponential(key, lam=1.0, shape=None, dtype=None, ctx=None):
     shape, dt = _shape_dtype(shape, dtype)
+    _require_positive("lam", parse_float(lam, 1.0))
     return jax.random.exponential(key, shape, dt) / parse_float(lam, 1.0)
 
 
 @_register_random("_random_poisson", aliases=("poisson", "random_poisson"))
 def random_poisson(key, lam=1.0, shape=None, dtype=None, ctx=None):
     shape, dt = _shape_dtype(shape, dtype)
+    # lam == 0 is the valid degenerate case (reference CHECK lam >= 0)
+    _require_positive("lam", parse_float(lam, 1.0), allow_zero=True)
     return jax.random.poisson(key, parse_float(lam, 1.0), shape).astype(dt)
 
 

@@ -58,3 +58,79 @@ def test_waitall_after_error_is_clean():
         pass
     mx.nd.waitall()  # must not rethrow (stricter-than-reference semantics)
     assert float(mx.nd.ones((1,)).asscalar()) == 1.0
+
+
+# ---- the reference's async-error matrix, under the call-site contract
+# (tests/python/unittest/test_exc_handling.py — its errors defer to the
+# wait point; ours raise at the call site, which is strictly earlier, so
+# each scenario asserts the error fires AND later work is unpoisoned)
+
+def test_exc_invalid_random_scale_imperative():
+    """reference test_exc_imperative: normal() with negative scale."""
+    with pytest.raises(Exception):
+        mx.nd.random.normal(0, -1, (2, 2)).asnumpy()
+    # the failure must not poison the next op (reference test_exc_post_fail)
+    ok = mx.nd.random.normal(0, 1, (2, 2))
+    assert np.isfinite(ok.asnumpy()).all()
+
+
+def test_exc_invalid_random_scale_symbolic():
+    """reference test_exc_symbolic: the bad op embedded mid-graph fails
+    the bound executor loudly, forward or forward+backward."""
+    x = mx.sym.Variable("x")
+    with pytest.raises(Exception):
+        # the invalid parameter surfaces no later than bind+forward (here
+        # it is caught already at graph construction — even earlier than
+        # the reference's wait-point rethrow)
+        out = mx.sym.dot(x, mx.sym.random.normal(0, -1, (2, 2)))
+        out = mx.sym.make_loss(out)
+        ex = out.simple_bind(ctx=mx.cpu(), x=(2, 2), grad_req="write")
+        ex.arg_dict["x"][:] = 1.0
+        ex.forward()
+        ex.outputs[0].asnumpy()
+
+
+def test_exc_invalid_random_scale_gluon():
+    """reference test_exc_gluon: the failure fires INSIDE a Gluon
+    forward (the bad op lives in the block body), and the block stays
+    usable afterwards."""
+    from mxnet_tpu import gluon
+
+    class Bad(gluon.Block):
+        def __init__(self, scale, **kw):
+            super().__init__(**kw)
+            self.scale = scale
+            with self.name_scope():
+                self.dense = gluon.nn.Dense(4, in_units=4)
+
+        def forward(self, x):
+            noise = mx.nd.random.normal(0, self.scale, (2, 4))
+            return self.dense(x + noise)
+
+    net = Bad(scale=-10.0)
+    net.initialize()
+    with pytest.raises(Exception):
+        net(mx.nd.ones((2, 4))).asnumpy()
+    net.scale = 1.0                   # the block still works after the error
+    out = net(mx.nd.ones((2, 4)))
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_exc_repeated_failures_each_raise():
+    """reference test_exc_multiple_waits: every failed call raises — the
+    first rethrow must not clear or mask the second."""
+    for _ in range(2):
+        with pytest.raises(Exception):
+            mx.nd.random.normal(0, -1, (2, 2)).asnumpy()
+
+
+def test_exc_mutable_var_failure_leaves_var_usable():
+    """reference test_exc_mutable_var_fail: a failed op writing to an
+    existing array must not corrupt it for later reads."""
+    a = mx.nd.ones((2, 2))
+    try:
+        bad = mx.nd.random.normal(0, -1, (2, 2))
+        a[:] = bad
+    except Exception:
+        pass
+    assert np.isfinite(a.asnumpy()).all()

@@ -474,3 +474,21 @@ def test_bare_symbol_block_save_load_roundtrip(tmp_path):
     sb2.load_parameters(f)
     np.testing.assert_allclose(sb2(x).asnumpy(), y1.asnumpy(),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_stale_cache_nested_child_add():
+    """A structural edit in a NESTED child invalidates the hybridized
+    ancestor's cached executable too (r4 review: only the mutated block's
+    own cache used to be cleared)."""
+    outer = nn.HybridSequential()
+    inner = nn.HybridSequential()
+    with inner.name_scope():
+        inner.add(nn.Dense(10, weight_initializer="zeros",
+                           bias_initializer="ones", flatten=False))
+    with outer.name_scope():
+        outer.add(inner)
+    outer.hybridize()
+    outer.initialize()
+    assert outer(mx.nd.ones((2, 3, 5))).shape == (2, 3, 10)
+    inner.add(nn.Flatten())            # nested structural change
+    assert outer(mx.nd.ones((2, 3, 5))).shape == (2, 30)

