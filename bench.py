@@ -12,9 +12,10 @@ precision (JAX's default on TPU is bf16 compute over fp32 params; the
 Headline metric — the LAST stdout line is a SHORT JSON object
 (metric/value/unit/vs_baseline only; the full result dict goes to
 ``bench_full.json`` and the second-to-last line): ResNet-50 training
-throughput, batch 32, AMP mixed precision (bf16 activations/compute, fp32
-master weights — clearly labeled), vs the reference's published 298.51
-img/s — ResNet-50 train bs32 fp32 1×V100 (``docs/faq/perf.md:239``; see
+throughput, batch 32, at the FASTEST honestly-labeled precision config
+(amp / pure-bf16-storage / default are all measured; the winner is named
+in the metric string), vs the reference's published 298.51 img/s —
+ResNet-50 train bs32 fp32 1×V100 (``docs/faq/perf.md:239``; see
 BASELINE.md).  All other configs are nested under ``"extra"``:
 
 - ``headline``: AMP train (above) + train at default precision (bf16
@@ -24,10 +25,13 @@ BASELINE.md).  All other configs are nested under ``"extra"``:
 - ``fp32``: train at fp32-HIGHEST matmul precision
 - ``bert``: BERT-base pretraining step (b32 × s128, BASELINE config 3)
 - ``ssd``: SSD-300 VGG16 train step (b8, BASELINE config 4)
-- ``int8``: naive-calibrated int8 ResNet-50 inference (quantization flow)
+- ``int8``: fused int8 ResNet-50 inference (folded BN, per-channel int8
+  weights, int8 MXU matmuls — ``lower_int8_inference``)
 - ``io``: ImageRecordIter pipeline (host decode img/s + round-trip MB/s)
+- ``e2e``: training FED BY the ImageRecordIter pipeline (combined img/s
+  + exposed-IO split; the literal ``train_imagenet.py`` metric)
 
-Select a subset with BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,io.
+Select a subset with BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,int8,io,e2e.
 """
 import json
 import os

@@ -298,6 +298,9 @@ class CosineEmbeddingLoss(Loss):
 
     def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
         input1 = _reshape_like(F, input1, input2)
+        # reference loss.py:805: label must be column-shaped or the ==
+        # masks broadcast (N,) against the (N,1) cos_dist into (N,N)
+        label = label.reshape((-1, 1))
         cos_dist = self._cosine_similarity(F, input1, input2)
         y_1 = label == 1
         y_minus_1 = label == -1

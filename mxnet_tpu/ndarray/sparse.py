@@ -263,6 +263,24 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
         indptr = _np.asarray(indptr.asnumpy() if isinstance(indptr, NDArray)
                              else indptr, dtype=_np.int64).ravel()
         assert shape is not None, "shape is required for (data,indices,indptr)"
+        # validate the CSR invariants loudly at construction (the
+        # reference defers to check_format(full_check=True); here the
+        # eager densify would otherwise die with a bare IndexError)
+        if len(indptr) != shape[0] + 1 or (len(indptr) and indptr[0] != 0) \
+                or (len(indptr) and indptr[-1] != data.size) \
+                or _np.any(_np.diff(indptr) < 0):
+            raise ValueError(
+                f"invalid CSR: indptr must be monotonically non-decreasing "
+                f"with indptr[0]==0, indptr[-1]==nnz ({data.size}), and "
+                f"length rows+1 ({shape[0] + 1}); got {indptr.tolist()}")
+        if indices.size != data.size:
+            raise ValueError(
+                f"invalid CSR: indices has {indices.size} entries but "
+                f"data has {data.size}")
+        if data.size and (indices.min() < 0 or indices.max() >= shape[1]):
+            raise ValueError(
+                f"invalid CSR: column indices out of range for "
+                f"{shape[1]} columns")
         dense = _np.zeros(shape, dtype=data.dtype)
         for row in range(shape[0]):
             for k in range(indptr[row], indptr[row + 1]):

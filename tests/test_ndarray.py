@@ -224,3 +224,102 @@ def test_iteration():
     rows = [r.asnumpy() for r in a]
     assert len(rows) == 3
     assert np.allclose(rows[1], [2, 3])
+
+
+# --- r4 depth additions (reference test_ndarray.py remainder)
+
+def test_moveaxis_swapaxes():
+    x = mx.nd.array(np.arange(24, dtype="float32").reshape(2, 3, 4))
+    np.testing.assert_allclose(mx.nd.moveaxis(x, 0, 2).asnumpy(),
+                               np.moveaxis(x.asnumpy(), 0, 2))
+    np.testing.assert_allclose(mx.nd.swapaxes(x, 0, 2).asnumpy(),
+                               np.swapaxes(x.asnumpy(), 0, 2))
+
+
+def test_arange_variants():
+    np.testing.assert_allclose(mx.nd.arange(5).asnumpy(), np.arange(5))
+    np.testing.assert_allclose(mx.nd.arange(2, 10, 3).asnumpy(),
+                               np.arange(2, 10, 3))
+    out = mx.nd.arange(0, 4, repeat=2)
+    np.testing.assert_allclose(out.asnumpy(), [0, 0, 1, 1, 2, 2, 3, 3])
+    assert mx.nd.arange(3, dtype="int32").dtype == np.int32
+
+
+def test_full_and_ones_like():
+    f = mx.nd.full((2, 3), 7.5)
+    np.testing.assert_allclose(f.asnumpy(), np.full((2, 3), 7.5))
+    o = mx.nd.ones_like(f)
+    np.testing.assert_allclose(o.asnumpy(), np.ones((2, 3)))
+    z = mx.nd.zeros_like(f)
+    np.testing.assert_allclose(z.asnumpy(), np.zeros((2, 3)))
+
+
+def test_negative_step_slicing():
+    x = mx.nd.array(np.arange(10, dtype="float32"))
+    np.testing.assert_allclose(x[::-1].asnumpy(), np.arange(10)[::-1])
+    np.testing.assert_allclose(x[8:2:-2].asnumpy(),
+                               np.arange(10)[8:2:-2])
+
+
+def test_copyto_and_copy_semantics():
+    a = mx.nd.array(np.ones((2, 2), "float32"))
+    b = mx.nd.zeros((2, 2))
+    a.copyto(b)
+    np.testing.assert_allclose(b.asnumpy(), np.ones((2, 2)))
+    c = a.copy()
+    a += 1
+    np.testing.assert_allclose(c.asnumpy(), np.ones((2, 2)))  # deep copy
+
+
+def test_iadd_preserves_attached_grad_buffer():
+    """In-place arithmetic on a grad-attached array keeps autograd
+    working (reference in-place semantics)."""
+    x = mx.nd.array(np.ones(3, "float32"))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = (x * 3).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [3, 3, 3])
+    x += 1                        # in-place outside record
+    with mx.autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2, 2, 2])
+
+
+def test_tolist_asscalar_item():
+    x = mx.nd.array(np.array([[1.0, 2.0], [3.0, 4.0]]))
+    assert x[0].asnumpy().tolist() == [1.0, 2.0]
+    s = mx.nd.array([42.0])
+    assert s.asscalar() == 42.0
+    with pytest.raises(Exception):
+        x.asscalar()              # non-size-1 must refuse
+
+
+def test_expand_dims_squeeze_roundtrip():
+    x = mx.nd.zeros((3, 4))
+    y = mx.nd.expand_dims(x, axis=0)
+    assert y.shape == (1, 3, 4)
+    assert mx.nd.squeeze(y, axis=0).shape == (3, 4)
+    assert mx.nd.squeeze(mx.nd.zeros((1, 3, 1))).shape == (3,)
+
+
+def test_size_ndim_properties():
+    x = mx.nd.zeros((2, 3, 4))
+    assert x.size == 24 and x.ndim == 3
+    assert len(x) == 2
+
+
+def test_broadcast_like_and_axis():
+    a = mx.nd.array(np.arange(4, dtype="float32").reshape(1, 4))
+    b = mx.nd.broadcast_like(a, mx.nd.zeros((3, 4)))
+    assert b.shape == (3, 4)
+    c = mx.nd.broadcast_axis(a, axis=0, size=5)
+    assert c.shape == (5, 4)
+    np.testing.assert_allclose(c.asnumpy()[4], a.asnumpy()[0])
+
+
+def test_concatenate_alias():
+    a, b = mx.nd.ones((2, 2)), mx.nd.zeros((2, 2))
+    out = mx.nd.concatenate([a, b], axis=0)
+    assert out.shape == (4, 2)

@@ -147,3 +147,118 @@ def test_updater_serialization():
     u2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
     u2.set_states(states)
     assert 0 in u2.states
+
+
+# --- r4 depth: per-optimizer update-formula matrix vs numpy references
+# (reference test_optimizer.py per-optimizer comparators with wd/
+# rescale_grad/clip_gradient combinations)
+
+def _np_sgd_mom(w, g, mom, lr, m, wd, rescale, clip):
+    g = g * rescale
+    if clip > 0:
+        g = np.clip(g, -clip, clip)
+    g = g + wd * w
+    mom_new = m * mom + g
+    return w - lr * mom_new, mom_new
+
+
+@pytest.mark.parametrize("wd,rescale,clip", [
+    (0.0, 1.0, -1.0), (0.01, 1.0, -1.0), (0.0, 0.5, -1.0),
+    (0.01, 0.25, 0.5),
+])
+def test_sgd_momentum_full_matrix(wd, rescale, clip):
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(6).astype("float32")
+    g0 = rng.randn(6).astype("float32") * 4
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=wd,
+                           rescale_grad=rescale, clip_gradient=clip)
+    upd = mx.optimizer.get_updater(opt)
+    w = mx.nd.array(w0.copy())
+    want_w, want_m = w0.copy(), np.zeros(6, "float32")
+    for _ in range(3):
+        upd(0, mx.nd.array(g0), w)
+        want_w, want_m = _np_sgd_mom(want_w, g0, 0.9, 0.1, want_m, wd,
+                                     rescale, clip)
+    np.testing.assert_allclose(w.asnumpy(), want_w, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("wd", [0.0, 0.05])
+def test_nag_matches_numpy(wd):
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(5).astype("float32")
+    g0 = rng.randn(5).astype("float32")
+    lr, m = 0.1, 0.9
+    opt = mx.optimizer.NAG(learning_rate=lr, momentum=m, wd=wd)
+    upd = mx.optimizer.get_updater(opt)
+    w = mx.nd.array(w0.copy())
+    want_w, mom = w0.copy(), np.zeros(5, "float32")
+    for _ in range(3):
+        upd(0, mx.nd.array(g0), w)
+        g = g0 + wd * want_w
+        mom = m * mom + g
+        want_w = want_w - lr * (g + m * mom)    # reference nag_update
+        np.testing.assert_allclose(w.asnumpy(), want_w, rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_adagrad_matches_numpy():
+    rng = np.random.RandomState(2)
+    w0 = rng.randn(5).astype("float32")
+    g0 = rng.randn(5).astype("float32")
+    lr, eps = 0.1, 1e-7
+    opt = mx.optimizer.AdaGrad(learning_rate=lr, eps=eps)
+    upd = mx.optimizer.get_updater(opt)
+    w = mx.nd.array(w0.copy())
+    want_w, hist = w0.copy(), np.zeros(5, "float32")
+    for _ in range(3):
+        upd(0, mx.nd.array(g0), w)
+        hist = hist + g0 * g0
+        want_w = want_w - lr * g0 / (np.sqrt(hist) + eps)
+    np.testing.assert_allclose(w.asnumpy(), want_w, rtol=1e-4, atol=1e-5)
+
+
+def test_adamw_update_op_decoupled_weight_decay():
+    """The contrib adamw_update op decouples wd from the gradient
+    (reference src/operator/contrib/adamw.cc; the reference likewise has
+    no AdamW optimizer CLASS — consumers drive the op directly): with
+    zero gradients the weight shrinks by exactly eta*wd*w (reference
+    adamw-inl.h:137 — wd is NOT scaled by lr, unlike torch's AdamW)."""
+    w0 = np.ones(4, "float32")
+    w = mx.nd.array(w0.copy())
+    g = mx.nd.zeros(4)
+    mean, var = mx.nd.zeros(4), mx.nd.zeros(4)
+    out = mx.nd.contrib.adamw_update(
+        w, g, mean, var, mx.nd.array([1.0]),   # rescale_grad tensor
+        lr=0.1, eta=1.0, wd=0.5, beta1=0.9, beta2=0.999, epsilon=1e-8)
+    got = out[0].asnumpy() if isinstance(out, (list, tuple)) else out.asnumpy()
+    np.testing.assert_allclose(got, w0 - 1.0 * 0.5 * w0, rtol=1e-5)
+
+
+def test_signum_sign_update():
+    rng = np.random.RandomState(3)
+    w0 = rng.randn(5).astype("float32")
+    g0 = rng.randn(5).astype("float32")
+    opt = mx.optimizer.create("signum", learning_rate=0.1, momentum=0.9,
+                              wd=0.0)
+    upd = mx.optimizer.get_updater(opt)
+    w = mx.nd.array(w0.copy())
+    upd(0, mx.nd.array(g0), w)
+    mom = 0.9 * np.zeros(5) - (1 - 0.9) * g0   # reference signum momentum
+    want = w0 + 0.1 * np.sign(mom)
+    np.testing.assert_allclose(w.asnumpy(), want, rtol=1e-5, atol=1e-6)
+
+
+def test_idx_based_wd_mult_through_updater():
+    """Per-parameter wd multipliers resolve through set_wd_mult and the
+    updater's idx→name mapping (reference lr/wd mult state machine)."""
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.1)
+    opt.idx2name = {0: "w_weight", 1: "b_bias"}
+    opt.set_wd_mult({})                         # bias gets wd 0 by default
+    upd = mx.optimizer.get_updater(opt)
+    w = mx.nd.ones(3)
+    b = mx.nd.ones(3)
+    upd(0, mx.nd.zeros(3), w)
+    upd(1, mx.nd.zeros(3), b)
+    # weight decays, bias does not
+    assert w.asnumpy()[0] < 1.0
+    np.testing.assert_allclose(b.asnumpy(), np.ones(3))

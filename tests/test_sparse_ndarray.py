@@ -82,3 +82,82 @@ def test_rand_ndarray_sparse():
     arr = tu.rand_ndarray((20, 10), stype="row_sparse", density=0.3)
     frac = (arr.asnumpy() != 0).mean()
     assert 0.05 < frac < 0.6
+
+
+# --- r4 depth additions: retain, format checking, save/load, astype,
+# the compressed-payload contract (reference test_sparse_ndarray.py
+# remainder)
+
+def test_row_sparse_retain_subsets_rows():
+    idx = np.array([0, 2, 5], dtype="int64")
+    vals = np.arange(9, dtype="float32").reshape(3, 3)
+    a = sparse.row_sparse_array((vals, idx), shape=(6, 3))
+    kept = a.retain(mx.nd.array([2, 5]))
+    want = np.zeros((6, 3), "float32")
+    want[2] = vals[1]
+    want[5] = vals[2]
+    np.testing.assert_allclose(kept.asnumpy(), want)
+
+
+def test_csr_check_format_accepts_valid():
+    rng = np.random.RandomState(5)
+    d = rng.randn(4, 4).astype("float32") * (rng.rand(4, 4) < 0.5)
+    sparse.csr_matrix(mx.nd.array(d)).check_format()
+
+
+def test_csr_check_format_rejects_bad_indptr():
+    # invalid invariants fail LOUDLY at construction (stricter than the
+    # reference, which defers to check_format(full_check=True))
+    with pytest.raises(ValueError, match="indptr"):
+        sparse.csr_matrix(
+            (np.array([1.0], "float32"), np.array([0]),
+             np.array([0, 2, 1, 1, 1])), shape=(4, 4))
+    with pytest.raises(ValueError, match="indices"):
+        sparse.csr_matrix(
+            (np.array([1.0], "float32"), np.array([9]),
+             np.array([0, 1, 1, 1, 1])), shape=(4, 4))
+
+
+def test_sparse_save_load_roundtrip(tmp_path):
+    rng = np.random.RandomState(6)
+    d = rng.randn(6, 5).astype("float32") * (rng.rand(6, 5) < 0.4)
+    a = sparse.csr_matrix(mx.nd.array(d))
+    idx = np.array([1, 3], dtype="int64")
+    vals = np.ones((2, 5), dtype="float32")
+    r = sparse.row_sparse_array((vals, idx), shape=(6, 5))
+    f = str(tmp_path / "sp.nd")
+    mx.nd.save(f, {"c": a, "r": r})
+    loaded = mx.nd.load(f)
+    np.testing.assert_allclose(loaded["c"].asnumpy(), d, rtol=1e-6)
+    np.testing.assert_allclose(loaded["r"].asnumpy(), r.asnumpy())
+
+
+def test_csr_astype_preserves_structure():
+    rng = np.random.RandomState(4)
+    d = rng.randn(5, 5).astype("float32") * (rng.rand(5, 5) < 0.4)
+    a = sparse.csr_matrix(mx.nd.array(d))
+    b = a.astype("float16")
+    assert b.dtype == np.float16
+    np.testing.assert_allclose(b.asnumpy().astype("float64"), d,
+                               atol=1e-2)
+
+
+def test_sparse_dot_matches_dense():
+    rng = np.random.RandomState(8)
+    d1 = rng.randn(5, 4).astype("float32") * (rng.rand(5, 4) < 0.3)
+    d2 = rng.randn(4, 3).astype("float32")
+    a = sparse.csr_matrix(mx.nd.array(d1))
+    out = sparse.dot(a, mx.nd.array(d2))
+    np.testing.assert_allclose(out.asnumpy(), d1 @ d2, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_row_sparse_compressed_memory_contract():
+    """The RowSparse payload stores O(nnz_rows), not O(rows) — the r2
+    'genuinely compressed' contract must not silently regress."""
+    idx = np.array([7], dtype="int64")
+    vals = np.ones((1, 8), dtype="float32")
+    a = sparse.row_sparse_array((vals, idx), shape=(100000, 8))
+    assert a.is_compressed
+    assert a.data.shape[0] == 1          # payload rows == nnz rows
+    assert a.shape == (100000, 8)
