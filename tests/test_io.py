@@ -302,3 +302,74 @@ def test_test_utils_download_local(tmp_path):
     with _pytest.raises(RuntimeError, match="egress"):
         mx.test_utils.download("http://example.com/x.bin",
                                fname=str(tmp_path / "nope.bin"))
+
+
+# --- r4 depth: gluon.data remainder (reference test_gluon_data.py —
+# multi-worker loaders, batchify of structures, interval sampler,
+# dataset compositions)
+
+def test_dataloader_num_workers_matches_single_process():
+    X = np.arange(64, dtype="float32").reshape(16, 4)
+    y = np.arange(16, dtype="float32")
+    ds = mx.gluon.data.ArrayDataset(mx.nd.array(X), mx.nd.array(y))
+    single = [b[0].asnumpy() for b in
+              mx.gluon.data.DataLoader(ds, 4, shuffle=False,
+                                       num_workers=0)]
+    multi = [b[0].asnumpy() for b in
+             mx.gluon.data.DataLoader(ds, 4, shuffle=False,
+                                      num_workers=2)]
+    assert len(single) == len(multi) == 4
+    for a, b in zip(single, multi):
+        np.testing.assert_allclose(a, b)
+
+
+def test_dataloader_batchify_tuple_structures():
+    """Default batchify stacks each element of a tuple sample
+    independently (reference default_batchify_fn)."""
+    class PairDataset(mx.gluon.data.Dataset):
+        def __len__(self):
+            return 6
+
+        def __getitem__(self, i):
+            return (np.full((2,), i, "float32"),
+                    np.float32(i * 10))
+
+    loader = mx.gluon.data.DataLoader(PairDataset(), batch_size=3,
+                                      shuffle=False)
+    batches = list(loader)
+    a, b = batches[0]
+    assert a.shape == (3, 2) and b.shape == (3,)
+    np.testing.assert_allclose(b.asnumpy(), [0, 10, 20])
+
+
+def test_interval_sampler_and_batch_sampler():
+    from mxnet_tpu.gluon.data import sampler as S
+    seq = list(S.SequentialSampler(6))
+    assert seq == [0, 1, 2, 3, 4, 5]
+    rnd = list(S.RandomSampler(6))
+    assert sorted(rnd) == seq
+    bs = list(S.BatchSampler(S.SequentialSampler(7), 3,
+                             last_batch="discard"))
+    assert bs == [[0, 1, 2], [3, 4, 5]]
+    bs_keep = list(S.BatchSampler(S.SequentialSampler(7), 3,
+                                  last_batch="keep"))
+    assert bs_keep[-1] == [6]
+    bs_roll = list(S.BatchSampler(S.SequentialSampler(7), 3,
+                                  last_batch="rollover"))
+    assert bs_roll == [[0, 1, 2], [3, 4, 5]]   # 6 rolls to next epoch
+
+
+def test_simple_dataset_take():
+    ds = mx.gluon.data.SimpleDataset(list(range(10)))
+    t = ds.take(4)
+    assert len(t) == 4 and t[3] == 3
+
+
+def test_transform_first_only_touches_data():
+    X = np.ones((4, 2), "float32")
+    y = np.arange(4, dtype="float32")
+    ds = mx.gluon.data.ArrayDataset(mx.nd.array(X), mx.nd.array(y))
+    t = ds.transform_first(lambda x: x * 5)
+    data, label = t[1]
+    np.testing.assert_allclose(data.asnumpy(), X[1] * 5)
+    assert float(label.asscalar()) == 1.0
