@@ -158,8 +158,21 @@ class Parameter:
             if data is None:
                 host = _np.zeros(self._shape, dtype=self._dtype)
                 view = _HostArrayView(host)
-                initializer.create(init if init is not None else default_init)(
-                    initializer.InitDesc(self.name), view)
+                desc = initializer.InitDesc(self.name)
+                if init is not None and init is not default_init:
+                    # explicit per-parameter initializer: dispatch straight
+                    # to its payload — the name-suffix rules would
+                    # otherwise eat it (e.g. LSTMBias on '*_bias' params;
+                    # reference parameter.py routes via desc['__init__']).
+                    # Composite/callable initializers (Mixed, Load, bare
+                    # functions) define only __call__ — invoke them whole.
+                    initer = initializer.create(init)
+                    if isinstance(initer, initializer.Initializer):
+                        initer._init_weight(desc, view)
+                    else:
+                        initer(desc, view)
+                else:
+                    initializer.create(default_init)(desc, view)
                 data = nd.array(host, ctx=ctx[0], dtype=self._dtype)
             self._init_impl(data, ctx)
 

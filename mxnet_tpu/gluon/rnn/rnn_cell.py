@@ -23,12 +23,43 @@ def _cells_begin_state(cells, **kwargs):
     return sum([c.begin_state(**kwargs) for c in cells], [])
 
 
+def _split_time_major(stacked, length):
+    """(T, ...) tensor → list of T per-step tensors; Symbol-safe
+    (``sym[t]`` would index graph OUTPUTS, not timesteps)."""
+    from ...symbol import Symbol as _Symbol
+    if isinstance(stacked, _Symbol):
+        from ... import symbol as _sym_mod
+        return list(_sym_mod.split(stacked, num_outputs=length, axis=0,
+                                   squeeze_axis=1))
+    return [stacked[t] for t in range(length)]
+
+
 def _format_sequence(length, inputs, layout, merge, in_layout=None):
     """Normalize inputs to a list of per-step arrays or a merged tensor
     (reference ``rnn_cell.py:48``)."""
+    from ...symbol import Symbol as _Symbol
+    from ... import symbol as _sym_mod
     assert inputs is not None
     axis = layout.find("T")
     batch_axis = layout.find("N")
+    if isinstance(inputs, _Symbol):
+        # symbolic unroll (reference rnn_cell.py: F=symbol branch)
+        assert len(inputs.list_outputs()) == 1, \
+            "unroll doesn't allow grouped symbol as input"
+        if merge is False:
+            assert length is not None, \
+                "length must be specified for symbolic unroll"
+            inputs = list(_sym_mod.split(inputs, num_outputs=length,
+                                         axis=axis, squeeze_axis=1))
+        return inputs, axis, 0           # batch size is symbolic
+    if isinstance(inputs, (list, tuple)) and inputs \
+            and isinstance(inputs[0], _Symbol):
+        assert length is None or len(inputs) == length
+        if merge is True:
+            inputs = _sym_mod.concat(
+                *[_sym_mod.expand_dims(i, axis=axis) for i in inputs],
+                dim=axis)
+        return inputs, axis, 0
     if isinstance(inputs, nd.NDArray):
         batch_size = inputs.shape[batch_axis]
         if merge is False:
@@ -54,8 +85,10 @@ def _mask_sequence_variable_length(data, length, valid_length, time_axis,
     outputs = nd.SequenceMask(data, sequence_length=valid_length,
                               use_sequence_length=True, axis=time_axis)
     if not merge:
-        outputs = [x.squeeze(axis=time_axis) for x in
-                   nd.split(outputs, num_outputs=data.shape[time_axis],
+        # use the caller-supplied length, not data.shape — Symbols have
+        # no shape before bind
+        outputs = [nd.squeeze(x, axis=time_axis) for x in
+                   nd.split(outputs, num_outputs=length,
                             axis=time_axis, squeeze_axis=False)]
     return outputs
 
@@ -116,19 +149,41 @@ class RecurrentCell(Block):
                                       sequence_length=valid_length,
                                       use_sequence_length=True, axis=0)
                       for ele_list in zip(*all_states)]
+            # merge only if the caller wants merged outputs (None defaults
+            # to merged when valid_length is given, reference
+            # rnn_cell.py:205) — a caller asking for a LIST
+            # (BidirectionalCell) must get one, or its per-step reversal
+            # would iterate the batch axis of a merged array
+            merge = merge_outputs is None or bool(merge_outputs)
             outputs = _mask_sequence_variable_length(outputs, length,
-                                                     valid_length, axis, True)
+                                                     valid_length, axis,
+                                                     merge)
         if merge_outputs:
             if isinstance(outputs, (list, tuple)):
                 outputs = nd.concat(*[nd.expand_dims(o, axis=axis)
                                       for o in outputs], dim=axis)
-        elif merge_outputs is None and valid_length is not None \
-                and isinstance(outputs, nd.NDArray):
-            pass
         return outputs, states
 
     def _get_begin_state(self, inputs, begin_state, batch_size):
         if begin_state is None:
+            from ...symbol import Symbol as _Symbol
+            first = inputs if not isinstance(inputs, (list, tuple)) \
+                else inputs[0]
+            if isinstance(first, _Symbol):
+                # symbolic zeros with the INPUT's (deferred) batch dim:
+                # zeros_like a (N, C) step sliced to (N, 1), broadcast to
+                # each state's hidden width (reference uses F.zeros with
+                # 0-batch shape inference)
+                from ... import symbol as _sym_mod
+                begin_state = []
+                for info in self.state_info(0):
+                    h = int(info["shape"][1])
+                    z = _sym_mod.broadcast_axis(
+                        _sym_mod.slice_axis(_sym_mod.zeros_like(first),
+                                            axis=1, begin=0, end=1),
+                        axis=1, size=h)
+                    begin_state.append(z)
+                return begin_state
             ctx = inputs.context if isinstance(inputs, nd.NDArray) \
                 else inputs[0].context
             begin_state = self.begin_state(batch_size, ctx=ctx)
@@ -171,11 +226,19 @@ class _BaseRNNCell(HybridRecurrentCell):
         self._gates = ng
 
     def _finish_shapes(self, inputs):
+        from ...symbol import Symbol as _Symbol
+        if isinstance(inputs, _Symbol):
+            return                      # shapes resolve at bind time
         if self.i2h_weight.shape[1] == 0:
             self.i2h_weight.shape = (self._gates * self._hidden_size,
                                      inputs.shape[-1])
 
     def _dense(self, x, w, b, n_out):
+        from ...symbol import Symbol as _Symbol
+        if isinstance(x, _Symbol):
+            from ... import symbol as _sym_mod
+            return _sym_mod.FullyConnected(x, w.var(), b.var(),
+                                           num_hidden=n_out, flatten=False)
         return nd.FullyConnected(x, w.data(x.context), b.data(x.context),
                                  num_hidden=n_out, flatten=False)
 
@@ -447,13 +510,27 @@ class BidirectionalCell(HybridRecurrentCell):
         l_outputs, l_states = l_cell.unroll(
             length, inputs=inputs, begin_state=states[:n_l], layout=layout,
             merge_outputs=False, valid_length=valid_length)
+        if valid_length is not None:
+            # reverse each sequence WITHIN its valid length (reference
+            # rnn_cell.py BidirectionalCell: SequenceReverse with
+            # sequence_length) — a plain buffer reversal would feed the
+            # right cell padding steps first for short sequences
+            stacked = nd.stack(*inputs, axis=0)          # (T, N, C)
+            rev = nd.SequenceReverse(stacked, sequence_length=valid_length,
+                                     use_sequence_length=True, axis=0)
+            reversed_inputs = _split_time_major(rev, length)
+        else:
+            reversed_inputs = list(reversed(inputs))
         r_outputs, r_states = r_cell.unroll(
-            length, inputs=list(reversed(inputs)),
+            length, inputs=reversed_inputs,
             begin_state=states[n_l:], layout=layout, merge_outputs=False,
             valid_length=valid_length)
         if valid_length is not None:
-            r_outputs = _mask_sequence_variable_length(
-                list(reversed(r_outputs)), length, valid_length, axis, False)
+            r_stacked = nd.stack(*r_outputs, axis=0)
+            r_rev = nd.SequenceReverse(r_stacked,
+                                       sequence_length=valid_length,
+                                       use_sequence_length=True, axis=0)
+            r_outputs = _split_time_major(r_rev, length)
         else:
             r_outputs = list(reversed(r_outputs))
         outputs = [nd.concat(l_o, r_o, dim=1)

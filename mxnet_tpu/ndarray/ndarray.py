@@ -563,7 +563,9 @@ def _never_jit(op):
 
 def _eager_attrs_key(attrs):
     try:
-        return tuple(sorted((k, v) for k, v in attrs.items()))
+        items = tuple(sorted((k, v) for k, v in attrs.items()))
+        hash(items)        # array-valued attrs sort fine but can't key
+        return items
     except TypeError:
         return None
 
@@ -649,6 +651,13 @@ def _log_operands(nd_inputs, nd_outs):
 
 def invoke(op, nd_inputs, attrs, out=None):
     nd_inputs = [x if isinstance(x, NDArray) else _as_nd(x) for x in nd_inputs]
+    if any(isinstance(v, NDArray) for v in attrs.values()):
+        # optional tensor parameters passed by keyword (e.g.
+        # ``SequenceLast(x, sequence_length=sl)``) route through attrs —
+        # kernels take raw arrays, so unwrap (reference ops declare these
+        # as optional inputs, not params)
+        attrs = {k: (v._data if isinstance(v, NDArray) else v)
+                 for k, v in attrs.items()}
     raw = [x._data for x in nd_inputs]
     if _AMP_HOOK is not None:
         raw = _AMP_HOOK(op, raw)

@@ -81,6 +81,16 @@ def _input_param_names(op, stochastic):
 
 _ARRAY_TYPES = (NDArray, _np.ndarray)
 
+_SYM_CLS = None
+
+
+def _sym_class():
+    global _SYM_CLS
+    if _SYM_CLS is None:
+        from ..symbol.symbol import Symbol
+        _SYM_CLS = Symbol
+    return _SYM_CLS
+
 
 def make_op_func(op):
     name = op.name
@@ -92,6 +102,21 @@ def make_op_func(op):
     input_names = _input_param_names(op, stochastic)
 
     def fn(*args, out=None, name=None, ctx=None, **kwargs):
+        # Symbol operands delegate to the symbolic twin — lets ND-written
+        # library code (gluon RNN cell steps etc.) trace symbolically
+        # without an F parameter (the reference threads F=nd/sym instead).
+        # Cheap on the eager hot path: one cached-class isinstance scan.
+        sym_cls = _sym_class()
+        if (args and any(isinstance(a, sym_cls) for a in args)) or \
+                (kwargs and any(isinstance(v, sym_cls)
+                                for v in kwargs.values())):
+            from .. import symbol as _sym_ns
+            sym_fn = getattr(_sym_ns, op.name, None)
+            if sym_fn is None:
+                raise TypeError(f"op {op.name} has no symbolic form")
+            if name is not None:
+                kwargs["name"] = name
+            return sym_fn(*args, **kwargs)
         # split positional args into array inputs and positional attrs
         i = 0
         nd_inputs = []

@@ -1,0 +1,179 @@
+"""RNN depth tranche (reference ``test_gluon_rnn.py`` remainder):
+forget-bias initializer layout, zoneout shape contract, variant-length
+unroll masking for every cell family, fill-shape deferred init.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def test_lstm_forget_bias_layout():
+    """LSTMBias puts ``forget_bias`` exactly in the f-gate quarter
+    (reference test_lstm_forget_bias; i/f/c/o gate order)."""
+    forget_bias = 2.0
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(
+        100, i2h_bias_initializer=mx.init.LSTMBias(forget_bias),
+        prefix="l0_"))
+    stack.add(gluon.rnn.LSTMCell(
+        100, i2h_bias_initializer=mx.init.LSTMBias(forget_bias),
+        prefix="l1_"))
+    stack.initialize()
+    stack(mx.nd.ones((32, 200)), stack.begin_state(batch_size=32))
+    expected = np.hstack([np.zeros(100), forget_bias * np.ones(100),
+                          np.zeros(200)])
+    for name, param in stack.collect_params().items():
+        if name.endswith("i2h_bias"):
+            np.testing.assert_allclose(param.data().asnumpy(), expected)
+
+
+def test_zoneout_shapes_and_eval_identity():
+    """ZoneoutCell keeps output shapes; at inference it is the identity
+    wrapper (reference test_zoneout + zoneout semantics)."""
+    cell = gluon.rnn.ZoneoutCell(gluon.rnn.RNNCell(100, prefix="rnn_"),
+                                 zoneout_outputs=0.5, zoneout_states=0.5)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(10, 3, 50))
+    outs, states = cell.unroll(3, x, layout="NTC", merge_outputs=False)
+    assert len(outs) == 3
+    assert all(o.shape == (10, 100) for o in outs)
+    # inference mode: zoneout is deterministic (identity mixing)
+    y1, _ = cell(x[:, 0, :], cell.begin_state(batch_size=10))
+    y2, _ = cell(x[:, 0, :], cell.begin_state(batch_size=10))
+    np.testing.assert_allclose(y1.asnumpy(), y2.asnumpy(), rtol=1e-6)
+
+
+@pytest.mark.parametrize("cell_fn", [
+    lambda: gluon.rnn.RNNCell(20),
+    lambda: gluon.rnn.LSTMCell(20),
+    lambda: gluon.rnn.GRUCell(20),
+    lambda: gluon.rnn.BidirectionalCell(gluon.rnn.LSTMCell(20),
+                                        gluon.rnn.LSTMCell(20)),
+])
+def test_unroll_variant_length_masks_and_matches(cell_fn):
+    """reference test_rnn_unroll_variant_length: per-sequence
+    valid_length unroll equals the explicit shorter unroll, and padded
+    steps are zeroed."""
+    cell = cell_fn()
+    cell.initialize()
+    batch, max_len, dim = 4, 10, 20
+    valid = [3, 10, 5, 6]
+    rng = np.random.RandomState(0)
+    data = mx.nd.array(rng.randn(batch, max_len, dim).astype("float32"))
+    outs, states = cell.unroll(max_len, data,
+                               valid_length=mx.nd.array(valid),
+                               merge_outputs=True, layout="NTC")
+    for i, vl in enumerate(valid):
+        ele_out, ele_states = cell.unroll(
+            vl, data[i:i + 1, :vl, :], merge_outputs=True, layout="NTC")
+        np.testing.assert_allclose(outs.asnumpy()[i:i + 1, :vl, :],
+                                   ele_out.asnumpy(), rtol=1e-4,
+                                   atol=1e-4)
+        if vl < max_len:
+            np.testing.assert_allclose(
+                outs.asnumpy()[i:i + 1, vl:, :], 0.0, atol=1e-6)
+        for vs, gs in zip(states, ele_states):
+            np.testing.assert_allclose(vs.asnumpy()[i:i + 1],
+                                       gs.asnumpy(), rtol=1e-4,
+                                       atol=1e-4)
+
+
+def test_unroll_variant_length_residual_stack():
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.ResidualCell(gluon.rnn.RNNCell(20)))
+    stack.add(gluon.rnn.ResidualCell(gluon.rnn.RNNCell(20)))
+    stack.initialize()
+    rng = np.random.RandomState(1)
+    data = mx.nd.array(rng.randn(4, 10, 20).astype("float32"))
+    valid = mx.nd.array([3, 10, 5, 6])
+    outs, _ = stack.unroll(10, data, valid_length=valid,
+                           merge_outputs=True, layout="NTC")
+    np.testing.assert_allclose(outs.asnumpy()[0, 3:, :], 0.0, atol=1e-6)
+
+
+def test_unroll_tnc_layout_variant_length():
+    cell = gluon.rnn.LSTMCell(16)
+    cell.initialize()
+    rng = np.random.RandomState(2)
+    data = mx.nd.array(rng.randn(10, 4, 8).astype("float32"))   # TNC
+    valid = [2, 7, 10, 4]
+    outs, states = cell.unroll(10, data,
+                               valid_length=mx.nd.array(valid),
+                               merge_outputs=True, layout="TNC")
+    for i, vl in enumerate(valid):
+        ele_out, ele_states = cell.unroll(
+            vl, data[:vl, i:i + 1, :], merge_outputs=True, layout="TNC")
+        np.testing.assert_allclose(outs.asnumpy()[:vl, i:i + 1, :],
+                                   ele_out.asnumpy(), rtol=1e-4,
+                                   atol=1e-4)
+        for vs, gs in zip(states, ele_states):
+            np.testing.assert_allclose(vs.asnumpy()[i:i + 1],
+                                       gs.asnumpy(), rtol=1e-4,
+                                       atol=1e-4)
+
+
+def test_cell_and_layer_fill_shape():
+    """reference test_cell_fill_shape / test_layer_fill_shape: deferred
+    input-size inference on first forward."""
+    cell = gluon.rnn.LSTMCell(10)
+    cell.initialize()
+    out, _ = cell.unroll(3, mx.nd.ones((2, 3, 7)), merge_outputs=True)
+    assert cell.i2h_weight.shape[1] == 7
+    layer = gluon.rnn.LSTM(10)
+    layer.initialize()
+    layer(mx.nd.ones((3, 2, 7)))
+    found = [p for n, p in layer.collect_params().items()
+             if "i2h_weight" in n and "l0" in n]
+    assert found and found[0].shape[1] == 7
+
+
+def test_symbolic_variant_length_binds():
+    """The valid_length path must also work symbolically (reference tail
+    of test_rnn_unroll_variant_length)."""
+    data = mx.sym.var("data")
+    valid_length = mx.sym.var("valid_length")
+    cell = gluon.rnn.RNNCell(32)
+    outs, states = cell.unroll(10, data, valid_length=valid_length,
+                               merge_outputs=True, layout="NTC")
+    mod = mx.mod.Module(states[0], data_names=("data", "valid_length"),
+                        label_names=None, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10, 2)),
+                          ("valid_length", (4,))], label_shapes=None)
+    mod.init_params()
+    mod.forward(mx.io.DataBatch([mx.nd.random.normal(0, 1, (4, 10, 2)),
+                                 mx.nd.array([3, 6, 10, 2])]))
+    out = mod.get_outputs()[0].asnumpy()
+    assert np.isfinite(out).all()
+
+
+def test_symbolic_bidirectional_variant_length_binds():
+    """Symbolic bidirectional unroll with valid_length (r4 review case:
+    per-step Symbol slicing must split timesteps, not graph outputs)."""
+    data = mx.sym.var("data")
+    valid_length = mx.sym.var("valid_length")
+    cell = gluon.rnn.BidirectionalCell(gluon.rnn.RNNCell(8),
+                                       gluon.rnn.RNNCell(8))
+    outs, states = cell.unroll(6, data, valid_length=valid_length,
+                               merge_outputs=True, layout="NTC")
+    mod = mx.mod.Module(outs, data_names=("data", "valid_length"),
+                        label_names=None, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (3, 6, 4)),
+                          ("valid_length", (3,))], label_shapes=None)
+    mod.init_params()
+    mod.forward(mx.io.DataBatch([mx.nd.random.normal(0, 1, (3, 6, 4)),
+                                 mx.nd.array([2, 6, 4])]))
+    out = mod.get_outputs()[0].asnumpy()
+    assert out.shape == (3, 6, 16)
+    np.testing.assert_allclose(out[0, 2:, :], 0.0, atol=1e-6)
+
+
+def test_mixed_initializer_still_callable():
+    """Composite initializers (Mixed) used as an explicit param init must
+    dispatch through __call__, not _init_weight (r4 review case)."""
+    p = mx.gluon.Parameter(
+        "w", shape=(2, 2),
+        init=mx.init.Mixed([".*"], [mx.init.One()]))
+    p.initialize()
+    np.testing.assert_allclose(p.data().asnumpy(), np.ones((2, 2)))
