@@ -64,9 +64,11 @@ def _format_sequence(length, inputs, layout, merge, in_layout=None):
         batch_size = inputs.shape[batch_axis]
         if merge is False:
             assert length is None or length == inputs.shape[axis]
-            inputs = [x.squeeze(axis=axis) for x in
-                      nd.split(inputs, num_outputs=inputs.shape[axis],
-                               axis=axis, squeeze_axis=False)]
+            parts = nd.split(inputs, num_outputs=inputs.shape[axis],
+                             axis=axis, squeeze_axis=False)
+            if not isinstance(parts, (list, tuple)):
+                parts = [parts]        # length-1 sequences
+            inputs = [x.squeeze(axis=axis) for x in parts]
     else:
         assert length is None or len(inputs) == length
         batch_size = inputs[0].shape[batch_axis]
@@ -87,9 +89,13 @@ def _mask_sequence_variable_length(data, length, valid_length, time_axis,
     if not merge:
         # use the caller-supplied length, not data.shape — Symbols have
         # no shape before bind
-        outputs = [nd.squeeze(x, axis=time_axis) for x in
-                   nd.split(outputs, num_outputs=length,
-                            axis=time_axis, squeeze_axis=False)]
+        parts = nd.split(outputs, num_outputs=length, axis=time_axis,
+                         squeeze_axis=False)
+        if not isinstance(parts, (list, tuple)):
+            # a Symbol's outputs iterate as single-output symbols; a bare
+            # NDArray means split(num_outputs=1)
+            parts = list(parts) if hasattr(parts, "list_outputs")                 else [parts]
+        outputs = [nd.squeeze(x, axis=time_axis) for x in parts]
     return outputs
 
 

@@ -114,6 +114,17 @@ def make_op_func(op):
             sym_fn = getattr(_sym_ns, op.name, None)
             if sym_fn is None:
                 raise TypeError(f"op {op.name} has no symbolic form")
+            if out is not None:
+                raise TypeError(
+                    f"op {op.name}: out= is not supported with Symbol "
+                    f"operands (a graph node has no output buffer)")
+            mixed = [a for a in list(args) + list(kwargs.values())
+                     if isinstance(a, NDArray)]
+            if mixed:
+                raise TypeError(
+                    f"op {op.name}: cannot mix Symbol and NDArray "
+                    f"operands — wrap constants as mx.sym.Variable-fed "
+                    f"inputs or run the op imperatively")
             if name is not None:
                 kwargs["name"] = name
             return sym_fn(*args, **kwargs)

@@ -177,3 +177,27 @@ def test_mixed_initializer_still_callable():
         init=mx.init.Mixed([".*"], [mx.init.One()]))
     p.initialize()
     np.testing.assert_allclose(p.data().asnumpy(), np.ones((2, 2)))
+
+
+def test_unroll_length_one():
+    """length-1 unroll (r4 review case: split(num_outputs=1) returns a
+    bare array; the unmerge helpers must re-wrap it)."""
+    cell = gluon.rnn.LSTMCell(6)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(2, 1, 3))
+    outs, states = cell.unroll(1, x, layout="NTC", merge_outputs=False)
+    assert len(outs) == 1 and outs[0].shape == (2, 6)
+    outs2, _ = cell.unroll(1, x, valid_length=mx.nd.array([1, 1]),
+                           merge_outputs=False, layout="NTC")
+    assert len(outs2) == 1 and outs2[0].shape == (2, 6)
+
+
+def test_symbol_ndarray_mix_rejected():
+    """Mixing Symbol and NDArray operands fails loudly at the call site
+    (r4 review case: it used to embed a live NDArray in the graph and
+    die at bind with an unrelated error)."""
+    with pytest.raises(TypeError, match="mix Symbol and NDArray"):
+        mx.nd.broadcast_add(mx.sym.var("a"), mx.nd.ones((2, 2)))
+    with pytest.raises(TypeError, match="out="):
+        mx.nd.elemwise_add(mx.sym.var("a"), mx.sym.var("b"),
+                           out=mx.nd.ones((2, 2)))

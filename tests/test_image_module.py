@@ -1,0 +1,139 @@
+"""mx.image depth tranche (reference
+``tests/python/unittest/test_image.py``): decode forms, scale_down,
+resize_short geometry, color_normalize, crop geometry contracts,
+augmenter pipeline, ImageIter epoch.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+
+def _jpg_bytes(h=32, w=48, seed=0):
+    # smooth gradient + low-frequency pattern: JPEG-friendly so decode
+    # fidelity is testable (random noise has ~50 mean error at q95)
+    yy, xx = np.mgrid[0:h, 0:w]
+    img = np.stack([(xx * 255 // max(w - 1, 1)),
+                    (yy * 255 // max(h - 1, 1)),
+                    ((xx + yy) * 255 // max(h + w - 2, 1))],
+                   axis=2).astype("uint8")
+    header = recordio.IRHeader(0, 0.0, 0, 0)
+    # pack_img takes cv2-convention BGR input; imdecode(to_rgb=True)
+    # returns RGB — feed BGR so the round-trip compares against img
+    packed = recordio.pack_img(header, img[..., ::-1], quality=95)
+    _, payload = recordio.unpack(packed)
+    return img, payload
+
+
+def test_imdecode_forms():
+    img, payload = _jpg_bytes()
+    a = mx.image.imdecode(payload)
+    assert a.shape == img.shape and a.dtype == np.uint8
+    b = mx.image.imdecode(bytearray(payload))
+    np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+    # lossy jpeg: mean error small
+    assert np.abs(a.asnumpy().astype("int32") -
+                  img.astype("int32")).mean() < 3
+
+
+def test_imdecode_empty_and_invalid_raise():
+    with pytest.raises(Exception):
+        mx.image.imdecode(b"")
+    with pytest.raises(Exception):
+        mx.image.imdecode(b"not an image at all")
+
+
+def test_imread_not_found():
+    with pytest.raises(Exception):
+        mx.image.imread("/no/such/file.jpg")
+
+
+def test_scale_down_geometry():
+    # reference test_scale_down: crop must fit inside the source
+    assert mx.image.scale_down((640, 480), (720, 120)) == (640, 106)
+    assert mx.image.scale_down((360, 1000), (480, 500)) == (360, 375)
+    assert mx.image.scale_down((300, 400), (200, 300)) == (200, 300)
+
+
+def test_resize_short_geometry():
+    img, _ = _jpg_bytes(h=30, w=60)
+    out = mx.image.resize_short(mx.nd.array(img), 15)
+    # shorter side (h=30) → 15, aspect preserved
+    assert out.shape == (15, 30, 3)
+    tall = mx.image.resize_short(mx.nd.array(img.transpose(1, 0, 2)), 15)
+    assert tall.shape == (30, 15, 3)
+
+
+def test_imresize_and_color_normalize():
+    img, _ = _jpg_bytes()
+    r = mx.image.imresize(mx.nd.array(img), 16, 20)
+    assert r.shape == (20, 16, 3)
+    src = mx.nd.array(img.astype("float32"))
+    mean = mx.nd.array([1.0, 2.0, 3.0])
+    std = mx.nd.array([2.0, 4.0, 8.0])
+    out = mx.image.color_normalize(src, mean, std)
+    want = (img.astype("float32") - [1, 2, 3]) / [2, 4, 8]
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5)
+
+
+def test_crop_contracts():
+    img, _ = _jpg_bytes(h=40, w=40)
+    src = mx.nd.array(img)
+    out, rect = mx.image.random_crop(src, (24, 20))
+    assert out.shape == (20, 24, 3)
+    x0, y0, w, h = rect
+    assert 0 <= x0 <= 40 - 24 and 0 <= y0 <= 40 - 20
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  img[y0:y0 + h, x0:x0 + w])
+    cout, crect = mx.image.center_crop(src, (24, 20))
+    assert crect[0] == (40 - 24) // 2 and crect[1] == (40 - 20) // 2
+    sout, srect = mx.image.random_size_crop(src, (16, 16), (0.3, 0.8),
+                                            (0.8, 1.25))
+    assert sout.shape == (16, 16, 3)
+
+
+def test_fixed_crop_resizes():
+    img, _ = _jpg_bytes(h=40, w=40)
+    out = mx.image.fixed_crop(mx.nd.array(img), 4, 6, 20, 10,
+                              size=(10, 8))
+    assert out.shape == (8, 10, 3)
+
+
+def test_augmenter_pipeline_and_dumps():
+    img, _ = _jpg_bytes(h=64, w=64)
+    src = mx.nd.array(img.astype("float32"))
+    augs = mx.image.CreateAugmenter(data_shape=(3, 32, 32),
+                                    resize=48, rand_mirror=True,
+                                    mean=np.array([1.0, 2.0, 3.0]),
+                                    std=np.array([1.0, 1.0, 1.0]))
+    out = src
+    for a in augs:
+        out = a(out)
+    assert out.shape == (32, 32, 3)
+    # every augmenter serializes (reference Augmenter.dumps round-trip)
+    for a in augs:
+        s = a.dumps()
+        assert isinstance(s, str) and len(s) > 2
+
+
+def test_imageiter_epoch(tmp_path):
+    rec_path = str(tmp_path / "imgs.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = (rng.rand(36, 36, 3) * 255).astype("uint8")
+        header = recordio.IRHeader(0, float(i % 3), i, 0)
+        rec.write(recordio.pack_img(header, img, quality=90))
+    rec.close()
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                            path_imgrec=rec_path)
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 32, 32)
+        n += 4
+    assert n >= 8
+    it.reset()
+    assert next(iter(it)).data[0].shape == (4, 3, 32, 32)
