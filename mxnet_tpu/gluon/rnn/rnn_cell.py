@@ -94,7 +94,8 @@ def _mask_sequence_variable_length(data, length, valid_length, time_axis,
         if not isinstance(parts, (list, tuple)):
             # a Symbol's outputs iterate as single-output symbols; a bare
             # NDArray means split(num_outputs=1)
-            parts = list(parts) if hasattr(parts, "list_outputs")                 else [parts]
+            parts = (list(parts) if hasattr(parts, "list_outputs")
+                 else [parts])
         outputs = [nd.squeeze(x, axis=time_axis) for x in parts]
     return outputs
 

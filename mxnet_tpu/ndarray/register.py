@@ -119,7 +119,7 @@ def make_op_func(op):
                     f"op {op.name}: out= is not supported with Symbol "
                     f"operands (a graph node has no output buffer)")
             mixed = [a for a in list(args) + list(kwargs.values())
-                     if isinstance(a, NDArray)]
+                     if isinstance(a, _ARRAY_TYPES)]
             if mixed:
                 raise TypeError(
                     f"op {op.name}: cannot mix Symbol and NDArray "

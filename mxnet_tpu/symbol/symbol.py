@@ -957,3 +957,92 @@ def _input_order(op, named_inputs):
               "indices", "condition", "x", "y", "a", "b"]
     keys = list(named_inputs.keys())
     return sorted(keys, key=lambda k: common.index(k) if k in common else 99)
+
+
+# --------------------------------------------------------------------------
+# Fluent tensor methods (reference symbol.py generates these from the op
+# registry — the curated inventory below mirrors its FLUENT list)
+_FLUENT_METHODS = (
+    "max", "min", "prod", "argmax", "argmin", "argsort", "sort", "topk",
+    "sqrt", "rsqrt", "cbrt", "log", "log2", "log10", "log1p", "exp",
+    "expm1", "square", "abs", "sign", "round", "rint", "floor", "ceil",
+    "trunc", "sigmoid", "tanh", "relu", "softmax", "log_softmax", "erf",
+    "flatten", "norm", "nansum", "nanprod", "clip", "expand_dims",
+    "squeeze", "split", "slice_axis", "slice_like", "take", "one_hot",
+    "tile", "repeat", "pad", "flip", "reshape_like", "broadcast_to",
+    "broadcast_like", "swapaxes", "diag", "sin", "cos", "tan", "arcsin",
+    "arccos", "arctan", "sinh", "cosh", "arctanh", "degrees", "radians",
+    "gamma", "gammaln",
+)
+
+
+def _install_fluent_methods():
+    for _name in _FLUENT_METHODS:
+        if hasattr(Symbol, _name):
+            continue
+        _op = _reg.get(_name)
+        if _op is None:
+            continue
+
+        # make_sym_func's fn takes the data symbol first — it IS the
+        # bound method
+        setattr(Symbol, _name, make_sym_func(_op))
+
+
+_install_fluent_methods()
+
+
+def _symbol_call(self, *args, name=None, **kwargs):
+    """Compose: re-bind this symbol's variable inputs to other symbols
+    (reference ``symbol.cc Compose`` / ``Symbol.__call__``).  Positional
+    arguments map onto free variables in ``list_arguments`` order that are
+    not already bound by keyword."""
+    repl = {}
+    for k, v in kwargs.items():
+        if not isinstance(v, Symbol):
+            raise TypeError(f"compose expects Symbol for {k!r}")
+        repl[k] = v
+    if args:
+        free = [n for n in self.list_arguments() if n not in repl]
+        if len(args) > len(free):
+            raise ValueError("too many positional compose arguments")
+        for a, n in zip(args, free):
+            if not isinstance(a, Symbol):
+                raise TypeError("compose expects Symbol arguments")
+            repl[n] = a
+    unknown = set(repl) - set(self.list_arguments()) \
+        - set(self.list_auxiliary_states())
+    if unknown:
+        raise ValueError(f"compose: no variable named {sorted(unknown)}")
+    for k, v in repl.items():
+        if len(v._outputs) != 1:
+            raise ValueError(
+                f"compose: {k!r} is bound to a grouped symbol with "
+                f"{len(v._outputs)} outputs — composition only supports "
+                f"single-output operands (reference symbol.cc Compose)")
+
+    new_out = {}        # id(old node) -> list[(new node, out idx)]
+    for node in self._topo():
+        if node.op is None:
+            if node.name in repl:
+                new_out[id(node)] = list(repl[node.name]._outputs)
+            else:
+                v = _Node(None, node.name, [], {}, 1, dict(node.attr_dict))
+                new_out[id(node)] = [(v, 0)]
+            continue
+        inputs = [new_out[id(p)][i] for (p, i) in node.inputs]
+        nn = _Node(node.op, node.name, inputs, dict(node.attrs),
+                   node.num_outputs, dict(node.attr_dict))
+        nn.subgraphs = node.subgraphs
+        new_out[id(node)] = [(nn, i) for i in range(node.num_outputs)]
+    outs = []
+    for (n, i) in self._outputs:
+        outs.append(new_out[id(n)][i])
+    result = Symbol(outs)
+    if name is not None and len(result._outputs) == 1 \
+            and result._outputs[0][0].op is not None:
+        result._outputs[0][0].name = name      # reference renames the head
+    return result
+
+
+Symbol.__call__ = _symbol_call

@@ -1,0 +1,218 @@
+"""Symbol API depth tranche (reference
+``tests/python/unittest/test_symbol.py``): compose, copy/pickle,
+internals/children, infer_type, fluent methods, zero-prop, grouping,
+same-name children.
+"""
+import pickle
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=10)
+    net = mx.sym.Activation(net, name="act1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=4)
+    return net
+
+
+def test_symbol_basic_listing():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"]
+    assert net.list_outputs() == ["fc2_output"]
+    assert net.name == "fc2"
+
+
+def test_symbol_compose_call():
+    """reference test_symbol_compose: calling a symbol re-binds its
+    variable inputs."""
+    data = mx.sym.Variable("data")
+    net1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=10)
+    net1 = mx.sym.FullyConnected(net1, name="fc2", num_hidden=100)
+
+    net2 = mx.sym.FullyConnected(mx.sym.Variable("data2"), name="fc3",
+                                 num_hidden=10)
+    net2 = mx.sym.Activation(net2, act_type="relu")
+    net2 = mx.sym.FullyConnected(net2, name="fc4", num_hidden=20)
+    composed = net2(data2=net1, name="composed")
+    args = composed.list_arguments()
+    assert "fc1_weight" in args and "fc4_bias" in args
+    assert "data2" not in args          # replaced by net1's graph
+    # the composed graph runs
+    ex = composed.simple_bind(ctx=mx.cpu(), data=(2, 8))
+    ex.forward()
+    assert ex.outputs[0].shape == (2, 20)
+
+
+def test_symbol_copy_independent():
+    net = _mlp()
+    c = net.__copy__() if hasattr(net, "__copy__") else pickle.loads(
+        pickle.dumps(net))
+    assert c.list_arguments() == net.list_arguments()
+    assert c.tojson() == net.tojson()
+
+
+def test_symbol_pickle_roundtrip():
+    net = _mlp()
+    s = pickle.dumps(net)
+    net2 = pickle.loads(s)
+    assert net2.tojson() == net.tojson()
+    ex = net2.simple_bind(ctx=mx.cpu(), data=(2, 6))
+    ex.forward()
+    assert ex.outputs[0].shape == (2, 4)
+
+
+def test_symbol_internals_and_children():
+    net = _mlp()
+    internals = net.get_internals()
+    outs = internals.list_outputs()
+    assert "fc1_output" in outs and "act1_output" in outs
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+    children = net.get_children()
+    assert "act1_output" in children.list_outputs()
+    # grandchildren
+    gc = children.get_children() if hasattr(children, "get_children") \
+        else None
+
+
+def test_symbol_infer_type():
+    data = mx.sym.Variable("data")
+    f32 = mx.sym.FullyConnected(data, name="fc1", num_hidden=3)
+    arg_types, out_types, aux_types = f32.infer_type(data="float32")
+    assert all(t == np.float32 for t in arg_types)
+    assert out_types[0] == np.float32
+
+
+def test_symbol_infer_shape_backward_inference():
+    """reference test_symbol_infer_shape: shapes flow from the OUTPUT
+    side too (partial inference given an intermediate)."""
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, name="fc", num_hidden=12)
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(7, 5))
+    assert out_shapes == [(7, 12)]
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert d["fc_weight"] == (12, 5) and d["fc_bias"] == (12,)
+
+
+def test_symbol_fluent_methods():
+    """reference test_symbol_fluent: tensor methods exist on symbols and
+    compute identically to their nd twins."""
+    x_np = np.random.RandomState(0).rand(2, 3, 4).astype("float32") + 0.5
+    checks = [
+        ("reshape", lambda s: s.reshape((2, 12)),
+         lambda a: a.reshape(2, 12)),
+        ("transpose", lambda s: s.transpose((1, 0, 2)),
+         lambda a: a.transpose(1, 0, 2)),
+        ("sum", lambda s: s.sum(axis=1), lambda a: a.sum(axis=1)),
+        ("mean", lambda s: s.mean(axis=0), lambda a: a.mean(axis=0)),
+        ("max", lambda s: s.max(axis=2), lambda a: a.max(axis=2)),
+        ("log", lambda s: s.log(), lambda a: np.log(a)),
+        ("sqrt", lambda s: s.sqrt(), lambda a: np.sqrt(a)),
+        ("square", lambda s: s.square(), lambda a: a * a),
+        ("flatten", lambda s: s.flatten(), lambda a: a.reshape(2, 12)),
+        ("expand_dims", lambda s: s.expand_dims(axis=0),
+         lambda a: a[None]),
+        ("clip", lambda s: s.clip(0.6, 1.0),
+         lambda a: np.clip(a, 0.6, 1.0)),
+        ("abs", lambda s: s.abs(), lambda a: np.abs(a)),
+    ]
+    for nm, sym_fn, np_fn in checks:
+        v = mx.sym.Variable("x")
+        try:
+            out = sym_fn(v)
+        except AttributeError:
+            pytest.fail(f"Symbol lacks fluent method {nm}")
+        ex = out.simple_bind(ctx=mx.cpu(), x=x_np.shape)
+        ex.arg_dict["x"][:] = mx.nd.array(x_np)
+        ex.forward()
+        np.testing.assert_allclose(ex.outputs[0].asnumpy(), np_fn(x_np),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"fluent {nm}")
+
+
+def test_blockgrad_stops_gradient():
+    x = mx.sym.Variable("x")
+    y = mx.sym.BlockGrad(x * 2) + x
+    ex = y.simple_bind(ctx=mx.cpu(), x=(3,), grad_req="write")
+    ex.arg_dict["x"][:] = 1.0
+    ex.forward(is_train=True)
+    ex.backward()
+    # only the un-blocked path contributes
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), [1, 1, 1])
+
+
+def test_zero_prop_unused_input_gets_zero_grad():
+    """reference test_zero_prop: an argument that doesn't reach the loss
+    gets zero gradient, not garbage."""
+    x = mx.sym.Variable("x")
+    u = mx.sym.Variable("unused")
+    y = mx.sym.sum(x * 3)
+    g = mx.sym.Group([y, mx.sym.BlockGrad(u)])
+    ex = g.simple_bind(ctx=mx.cpu(), x=(2, 2), unused=(2, 2),
+                       grad_req="write")
+    ex.arg_dict["x"][:] = 1.0
+    ex.arg_dict["unused"][:] = 5.0
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.ones(()), mx.nd.ones((2, 2))])
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(),
+                               np.full((2, 2), 3.0))
+    np.testing.assert_allclose(ex.grad_dict["unused"].asnumpy(),
+                               np.zeros((2, 2)))
+
+
+def test_children_same_name():
+    """reference test_children_same_name: two uses of one symbol keep a
+    consistent graph."""
+    a = mx.sym.Variable("data")
+    b = a + a
+    for c in b.get_children():
+        assert c.list_outputs()[0] == "data"
+
+
+def test_group_and_multi_output_indexing():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    g = mx.sym.Group([a * 2, b + 1])
+    assert len(g.list_outputs()) == 2
+    first = g[0]
+    ex = first.simple_bind(ctx=mx.cpu(), a=(2,))
+    ex.arg_dict["a"][:] = 3.0
+    ex.forward()
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), [6, 6])
+
+
+def test_symbol_attr_round_trip():
+    with mx.AttrScope(ctx_group="dev1"):
+        v = mx.sym.Variable("v", lr_mult=2.0)
+    assert v.attr("ctx_group") == "dev1"
+    assert float(v.attr("lr_mult")) == 2.0
+    net = mx.sym.FullyConnected(v, name="fc", num_hidden=2)
+    d = net.attr_dict()
+    assert d["v"]["ctx_group"] == "dev1"
+    assert d["fc"]["num_hidden"] == "2"
+
+
+def test_compose_rejects_grouped_operand():
+    net = mx.sym.sqrt(mx.sym.Variable("x"))
+    g = mx.sym.Group([mx.sym.Variable("a") * 2,
+                      mx.sym.Variable("b") + 1])
+    with pytest.raises(ValueError, match="grouped"):
+        net(x=g)
+
+
+def test_compose_renames_head():
+    net = mx.sym.FullyConnected(mx.sym.Variable("d"), name="fc",
+                                num_hidden=2)
+    composed = net(d=mx.sym.Variable("other") * 2, name="composed")
+    assert composed.name == "composed"
+    assert composed.list_outputs() == ["composed_output"]
+
+
+def test_symbol_numpy_mix_rejected():
+    with pytest.raises(TypeError, match="mix Symbol"):
+        mx.nd.broadcast_add(mx.sym.var("a"), np.ones((2, 2)))
