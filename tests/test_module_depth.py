@@ -1,0 +1,171 @@
+"""Module API depth tranche (reference ``test_module.py`` remainder):
+forward with changing shapes, monitor capture, forward dtypes, bucketing
+grad_req / switch-bucket sharing, layout handling, initializer kwargs.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc", num_hidden=4)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_forward_reshape_across_batches():
+    """reference test_forward_reshape: consecutive forwards with
+    DIFFERENT batch sizes / spatial shapes work without an explicit
+    reshape call."""
+    mod = mx.mod.Module(_net(), context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    for bs in (8, 4, 10):
+        batch = mx.io.DataBatch(
+            [mx.nd.random.uniform(shape=(bs, 6))],
+            [mx.nd.zeros((bs,))])
+        mod.forward(batch, is_train=False)
+        assert mod.get_outputs()[0].shape == (bs, 4)
+
+
+def test_module_reshape_method():
+    mod = mx.mod.Module(_net(), context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    w0 = mod.get_params()[0]["fc_weight"].asnumpy()
+    mod.reshape(data_shapes=[("data", (2, 6))],
+                label_shapes=[("softmax_label", (2,))])
+    batch = mx.io.DataBatch([mx.nd.ones((2, 6))], [mx.nd.zeros((2,))])
+    mod.forward(batch, is_train=False)
+    assert mod.get_outputs()[0].shape == (2, 4)
+    np.testing.assert_allclose(mod.get_params()[0]["fc_weight"].asnumpy(),
+                               w0)
+
+
+def test_monitor_captures_internal_tensors():
+    """reference test_monitor: a Monitor installed on the module sees
+    per-op tensors with finite stats."""
+    seen = []
+    mon = mx.monitor.Monitor(1, stat_func=lambda x: mx.nd.norm(x),
+                             pattern=".*")
+    mod = mx.mod.Module(_net(), context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.install_monitor(mon)
+    mon.tic()
+    batch = mx.io.DataBatch([mx.nd.random.uniform(shape=(4, 6))],
+                            [mx.nd.zeros((4,))])
+    mod.forward(batch, is_train=True)
+    rows = mon.toc()
+    assert rows, "monitor captured nothing"
+    for _, name, val in rows:
+        if hasattr(val, "asscalar"):
+            v = float(val.asscalar())
+        else:
+            import re as _re
+            nums = _re.findall(r"[-+0-9.eE]+", str(val))
+            v = float(nums[0]) if nums else 0.0
+        assert np.isfinite(v)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float16"])
+def test_forward_types(dtype):
+    """reference test_forward_types: the module runs end-to-end in the
+    bound dtype."""
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, name="fc", num_hidden=3)
+    mod = mx.mod.Module(out, context=mx.cpu(), label_names=None)
+    mod.bind(data_shapes=[mx.io.DataDesc("data", (4, 5), dtype=dtype)],
+             label_shapes=None, for_training=False)
+    mod.init_params()
+    batch = mx.io.DataBatch(
+        [mx.nd.ones((4, 5), dtype=dtype)])
+    mod.forward(batch, is_train=False)
+    out_arr = mod.get_outputs()[0]
+    assert out_arr.shape == (4, 3)
+    assert np.isfinite(out_arr.asnumpy().astype("float64")).all()
+
+
+def test_module_initializer_kwargs():
+    """reference test_module_initializer: init_params honours a custom
+    initializer for specific params."""
+    mod = mx.mod.Module(_net(), context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(initializer=mx.init.One())
+    w = mod.get_params()[0]["fc_weight"].asnumpy()
+    np.testing.assert_allclose(w, np.ones_like(w))
+
+
+def test_bucketing_switch_shares_params():
+    """reference test_module_switch_bucket: switching buckets preserves
+    the shared parameters (same arrays drive every bucket)."""
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, name="fc", num_hidden=4)
+        return mx.sym.SoftmaxOutput(net, name="softmax"), ("data",), \
+            ("softmax_label",)
+
+    # buckets share parameters, so keep the input width fixed (the fc
+    # weight shape must match across buckets) and vary the batch
+    mod2 = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                  context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (4, 10))],
+              label_shapes=[("softmax_label", (4,))])
+    mod2.init_params(initializer=mx.init.One())
+    mod2.switch_bucket(11, data_shapes=[("data", (2, 10))],
+                       label_shapes=[("softmax_label", (2,))])
+    w = mod2.get_params()[0]["fc_weight"].asnumpy()
+    np.testing.assert_allclose(w, np.ones_like(w))
+    batch = mx.io.DataBatch([mx.nd.ones((2, 10))], [mx.nd.zeros((2,))],
+                            bucket_key=11)
+    mod2.forward(batch, is_train=False)
+    assert mod2.get_outputs()[0].shape == (2, 4)
+
+
+def test_module_save_load_checkpoint_epochs(tmp_path):
+    """reference test_save_load: save_checkpoint/load round-trip with
+    epoch numbering and optimizer states."""
+    mod = mx.mod.Module(_net(), context=mx.cpu(),
+                        label_names=("softmax_label",))
+    it = mx.io.NDArrayIter(np.random.rand(32, 6).astype("float32"),
+                           np.zeros(32, "float32"), batch_size=8)
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    prefix = str(tmp_path / "mdl")
+    mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 2)
+    assert "fc_weight" in arg
+    mod2 = mx.mod.Module.load(prefix, 2, load_optimizer_states=True,
+                              label_names=("softmax_label",))
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    w1 = mod.get_params()[0]["fc_weight"].asnumpy()
+    w2 = mod2.get_params()[0]["fc_weight"].asnumpy()
+    np.testing.assert_allclose(w1, w2)
+
+
+def test_module_input_grads_flag():
+    """reference test_module_input_grads: inputs_need_grad exposes
+    gradients w.r.t. data."""
+    mod = mx.mod.Module(_net(), context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))],
+             inputs_need_grad=True)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd")
+    batch = mx.io.DataBatch([mx.nd.random.uniform(shape=(4, 6))],
+                            [mx.nd.array([0, 1, 2, 3])])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    g = mod.get_input_grads()[0]
+    assert g.shape == (4, 6)
+    assert float(mx.nd.abs(g).sum().asscalar()) > 0
