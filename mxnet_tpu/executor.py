@@ -231,9 +231,47 @@ class Executor:
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Rebind with new shapes (jit recompiles per shape — the analog of
-        the reference's shared-memory rebind)."""
+        the reference's shared-memory rebind).  Arrays whose shape is
+        unchanged (parameters) are SHARED with the source executor, like
+        the reference's memory-sharing reshape (graph_executor.cc
+        Reshape): updating a weight through either executor is visible in
+        both."""
         new_shapes = {}
         for n in self.arg_names:
             new_shapes[n] = kwargs.get(n, self.arg_dict[n].shape)
-        return self._symbol.simple_bind(
-            ctx=self._ctx, grad_req=self.grad_req, **new_shapes)
+            old_shape = self.arg_dict[n].shape
+            new_shape = tuple(new_shapes[n])
+            if new_shape == old_shape:
+                continue
+            if len(new_shape) != len(old_shape) and not partial_shaping:
+                raise ValueError(
+                    f"reshape: arg {n!r} changes rank "
+                    f"{old_shape} -> {new_shape}; set partial_shaping=True "
+                    f"(reference executor.py reshape contract)")
+            if any(ns > os for ns, os in zip(new_shape, old_shape)) \
+                    and not allow_up_sizing:
+                raise ValueError(
+                    f"reshape: new shape {new_shape} of {n!r} is larger "
+                    f"than the bound {old_shape}; set allow_up_sizing="
+                    f"True (reference executor.py reshape contract)")
+        new_exe = self._symbol.simple_bind(
+            ctx=self._ctx, grad_req=self.grad_req,
+            type_dict={n: self.arg_dict[n].dtype for n in self.arg_names},
+            **new_shapes)
+        for n in self.arg_names:
+            if n not in new_exe.arg_dict:
+                continue
+            old, new = self.arg_dict[n], new_exe.arg_dict[n]
+            if new.shape == old.shape:
+                new_exe.arg_dict[n] = old
+            elif new.ndim == old.ndim and \
+                    all(ns <= os for ns, os in zip(new.shape, old.shape)):
+                # down-sized arg: seed from the leading slice of the old
+                # buffer (the reference aliases the memory; jax buffers
+                # are immutable, so this is a snapshot, not a live view)
+                sl = tuple(slice(0, s) for s in new.shape)
+                new._data = old._data[sl]
+        for n, v in self.aux_dict.items():
+            if n in new_exe.aux_dict and new_exe.aux_dict[n].shape == v.shape:
+                new_exe.aux_dict[n] = v
+        return new_exe

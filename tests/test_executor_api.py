@@ -1,0 +1,136 @@
+"""Executor API depth tranche (reference
+``tests/python/unittest/test_executor.py``): binary fwd/bwd bind matrix
+across ranks, dot gradients at random shapes, Executor.reshape sharing.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _check_bind_with_uniform(ufunc, gfunc, dim, sf=None, lshape=None,
+                             rshape=None, rng=None):
+    """reference check_bind_with_uniform: bind lhs/rhs, forward+backward,
+    compare against the analytic numpy fwd/grad."""
+    rng = rng or np.random.RandomState(0)
+    shape = lshape or tuple(rng.randint(1, 8, size=dim))
+    lhs = mx.sym.Variable("lhs")
+    rhs = mx.sym.Variable("rhs")
+    ret = sf(lhs, rhs) if sf is not None else ufunc(lhs, rhs)
+
+    lhs_arr = mx.nd.array(rng.uniform(-1, 1, lshape or shape)
+                          .astype("float32") + 2.0)
+    rhs_arr = mx.nd.array(rng.uniform(-1, 1, rshape or shape)
+                          .astype("float32") + 2.0)
+    lhs_grad = mx.nd.zeros((lshape or shape))
+    rhs_grad = mx.nd.zeros((rshape or shape))
+    ex = ret.bind(mx.cpu(), args=[lhs_arr, rhs_arr],
+                  args_grad=[lhs_grad, rhs_grad])
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    want = ufunc(lhs_arr.asnumpy(), rhs_arr.asnumpy())
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+    out_grad = mx.nd.array(np.ones(out.shape, "float32"))
+    ex.backward([out_grad])
+    lg, rg = gfunc(out_grad.asnumpy(), lhs_arr.asnumpy(),
+                   rhs_arr.asnumpy())
+    np.testing.assert_allclose(lhs_grad.asnumpy(), lg, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(rhs_grad.asnumpy(), rg, rtol=1e-5,
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("dim", [1, 2, 3])
+def test_bind_binary_matrix(dim):
+    rng = np.random.RandomState(dim)
+    _check_bind_with_uniform(lambda x, y: x + y,
+                             lambda g, x, y: (g, g), dim, rng=rng)
+    _check_bind_with_uniform(lambda x, y: x - y,
+                             lambda g, x, y: (g, -g), dim, rng=rng)
+    _check_bind_with_uniform(lambda x, y: x * y,
+                             lambda g, x, y: (y * g, x * g), dim, rng=rng)
+    _check_bind_with_uniform(lambda x, y: x / y,
+                             lambda g, x, y: (g / y, -x * g / (y ** 2)),
+                             dim, rng=rng)
+
+
+@pytest.mark.parametrize("dim", [1, 2])
+def test_bind_minmax_matrix(dim):
+    rng = np.random.RandomState(10 + dim)
+    _check_bind_with_uniform(lambda x, y: np.maximum(x, y),
+                             lambda g, x, y: (g * (x >= y), g * (y > x)),
+                             dim, sf=mx.sym.maximum, rng=rng)
+    _check_bind_with_uniform(lambda x, y: np.minimum(x, y),
+                             lambda g, x, y: (g * (x <= y), g * (y < x)),
+                             dim, sf=mx.sym.minimum, rng=rng)
+
+
+def test_dot_random_shapes():
+    rng = np.random.RandomState(3)
+    for _ in range(5):
+        s = tuple(rng.randint(1, 50, size=3))
+        _check_bind_with_uniform(
+            lambda x, y: np.dot(x, y),
+            lambda g, x, y: (np.dot(g, y.T), np.dot(x.T, g)), 2,
+            lshape=(s[0], s[1]), rshape=(s[1], s[2]), sf=mx.sym.dot,
+            rng=rng)
+    # 1-D inner product
+    s = int(rng.randint(1, 50))
+    _check_bind_with_uniform(
+        lambda x, y: np.dot(x, y),
+        lambda g, x, y: (g * y, g * x), 1,
+        lshape=(s,), rshape=(s,), sf=mx.sym.dot, rng=rng)
+
+
+def test_executor_reshape_shares_weights():
+    """reference test_reshape: reshaped executor shares parameter arrays
+    with the base executor but gets fresh data buffers."""
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x, num_hidden=4)
+    exe = y.simple_bind(mx.cpu(), x=(5, 4), grad_req="null")
+    exe.arg_arrays[0][:] = 1
+    exe.arg_arrays[1][:] = mx.nd.ones((4, 4))
+    exe.arg_arrays[2][:] = 0
+
+    new_exe = exe.reshape(x=(3, 4))
+    new_exe.forward(is_train=False)
+    assert np.all(new_exe.outputs[0].asnumpy() == 4)
+
+    # weight update through one executor is visible in the other
+    exe.arg_arrays[1][:] = 2.0
+    new_exe.forward(is_train=False)
+    assert np.all(new_exe.outputs[0].asnumpy() == 8)
+
+    # base executor still works at its own shape
+    exe.forward(is_train=False)
+    assert exe.outputs[0].shape == (5, 4)
+    assert np.all(exe.outputs[0].asnumpy() == 8)
+
+
+def test_executor_outputs_listing_and_grad_dict():
+    a = mx.sym.Variable("a")
+    out = mx.sym.Group([a * 2, a + 1])
+    ex = out.simple_bind(mx.cpu(), a=(2, 2), grad_req="write")
+    ex.arg_dict["a"][:] = 1.0
+    ex.forward(is_train=True)
+    assert len(ex.outputs) == 2
+    ex.backward([mx.nd.ones((2, 2)), mx.nd.ones((2, 2))])
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(),
+                               np.full((2, 2), 3.0))
+
+
+def test_executor_reshape_guards_and_dtype():
+    """Up-sizing without allow_up_sizing and rank changes without
+    partial_shaping raise (reference contract); dtypes survive reshape."""
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x, num_hidden=4)
+    exe = y.simple_bind(mx.cpu(), x=(5, 4), grad_req="null",
+                        type_dict={"x": "float16"})
+    with pytest.raises(ValueError, match="allow_up_sizing"):
+        exe.reshape(x=(9, 4))
+    with pytest.raises(ValueError, match="partial_shaping"):
+        exe.reshape(x=(5, 2, 2))
+    bigger = exe.reshape(allow_up_sizing=True, x=(9, 4))
+    assert bigger.arg_dict["x"].shape == (9, 4)
+    assert bigger.arg_dict["x"].dtype == np.float16
