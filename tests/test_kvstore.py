@@ -190,3 +190,59 @@ def test_gradient_compression_rejects_bad_params():
         kv.set_gradient_compression({"type": "1bit"})
     with pytest.raises(ValueError):
         kv.set_gradient_compression({"type": "2bit", "threshold": -1})
+
+
+# --- r4 depth: sparse aggregation, invalid pull, init semantics
+# (reference test_kvstore.py remainder)
+
+def test_sparse_aggregator_row_sparse_push():
+    """Multiple row_sparse pushes to one key aggregate by row (reference
+    test_sparse_aggregator)."""
+    from mxnet_tpu.ndarray import sparse
+    kv = mx.kv.create("local")
+    shape = (6, 3)
+    kv.init("a", sparse.zeros("row_sparse", shape))
+    v1 = sparse.row_sparse_array(
+        (np.ones((2, 3), "float32"), np.array([0, 2])), shape=shape)
+    v2 = sparse.row_sparse_array(
+        (2 * np.ones((2, 3), "float32"), np.array([2, 5])), shape=shape)
+    kv.push("a", [v1, v2])
+    out = mx.nd.zeros(shape)
+    kv.pull("a", out=out, ignore_sparse=False)
+    want = v1.asnumpy() + v2.asnumpy()
+    np.testing.assert_allclose(out.asnumpy(), want)
+    # row_sparse_pull of a subset
+    rows = mx.nd.array([2])
+    sub = sparse.zeros("row_sparse", shape)
+    kv.row_sparse_pull("a", out=sub, row_ids=rows)
+    np.testing.assert_allclose(sub.asnumpy()[2], want[2])
+
+
+def test_invalid_pull_uninitialized_key():
+    kv = mx.kv.create("local")
+    out = mx.nd.zeros((2, 2))
+    with pytest.raises(Exception):
+        kv.pull("never_initialized", out=out)
+
+
+def test_double_init_keeps_first_value():
+    """reference init semantics: re-initializing an existing key is
+    ignored (the first value wins)."""
+    kv = mx.kv.create("local")
+    kv.init("k", mx.nd.ones((2, 2)))
+    try:
+        kv.init("k", mx.nd.full((2, 2), 7.0))
+    except Exception:
+        pass                               # raising loudly is also fine
+    out = mx.nd.zeros((2, 2))
+    kv.pull("k", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones((2, 2)))
+
+
+def test_pull_into_multiple_outs():
+    kv = mx.kv.create("local")
+    kv.init("m", mx.nd.full((2,), 3.0))
+    outs = [mx.nd.zeros((2,)), mx.nd.zeros((2,))]
+    kv.pull("m", out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), [3, 3])
