@@ -68,6 +68,7 @@ stage_examples() {
   python example/multi-task/multi_task.py
   python example/numpy-ops/custom_softmax.py --epochs 5
   python example/amp/finetune_amp.py --epochs 3
+  python example/autoencoder/denoising_ae.py --epochs 15
 }
 
 stage_bench() {
