@@ -112,3 +112,56 @@ def test_optimizer_uses_scheduler():
     opt.update(0, w, g, state)
     lr1 = float(1 - w.asnumpy()[0])  # effective lr of first step
     assert lr1 > 0
+
+
+# --- r4 depth: reference test_init.py remainder
+
+def test_variable_init_attr():
+    """reference test_variable_init: a Variable's init attr drives its
+    initialization through simple_bind."""
+    import json
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("myweight", init=mx.init.One(),
+                        shape=(10, 5))
+    net = mx.sym.FullyConnected(data, weight=w, name="fc", num_hidden=10,
+                                no_bias=True)
+    ex = net.simple_bind(ctx=mx.cpu(), data=(3, 5))
+    # simple_bind allocates zeros; init through an initializer honouring
+    # the __init__ attr
+    for name, arr in ex.arg_dict.items():
+        desc = mx.init.InitDesc(name, {"__init__": "one"}
+                                if name == "myweight" else {})
+        if name != "data":
+            mx.init.Uniform(0.1)(desc, arr)
+    np.testing.assert_allclose(ex.arg_dict["myweight"].asnumpy(),
+                               np.ones((10, 5)))
+
+
+def test_bilinear_init_upsampling_kernel():
+    """reference test_bilinear_init: 'upsampling*weight' params get the
+    bilinear kernel by name dispatch."""
+    arr = mx.nd.zeros((1, 1, 4, 4))
+    mx.init.Initializer()(mx.init.InitDesc("upsampling0_weight"), arr)
+    w = arr.asnumpy()[0, 0]
+    want = np.array([[0.0625, 0.1875, 0.1875, 0.0625],
+                     [0.1875, 0.5625, 0.5625, 0.1875],
+                     [0.1875, 0.5625, 0.5625, 0.1875],
+                     [0.0625, 0.1875, 0.1875, 0.0625]])
+    np.testing.assert_allclose(w, want, rtol=1e-5)
+
+
+def test_initializer_dumps_json_roundtrip():
+    """Initializers serialize to JSON (reference Initializer.dumps)."""
+    import json
+    for init in (mx.init.Uniform(0.3), mx.init.Normal(0.1),
+                 mx.init.Xavier(magnitude=2.5), mx.init.One()):
+        s = init.dumps()
+        name, kwargs = json.loads(s)
+        rebuilt = mx.init.create(name, **kwargs)
+        assert type(rebuilt) is type(init)
+
+
+def test_constant_initializer_value():
+    arr = mx.nd.zeros((3, 3))
+    mx.init.Constant(2.5)._init_weight("w", arr)
+    np.testing.assert_allclose(arr.asnumpy(), np.full((3, 3), 2.5))
