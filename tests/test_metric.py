@@ -138,3 +138,82 @@ def test_local_global_split():
     assert m.get_global()[1] == 0.75         # epoch total: 3/4
     m.reset()
     assert np.isnan(m.get_global()[1])
+
+
+# --- r4 depth: reference test_metric.py remainder
+
+def test_acc_2d_label_flattens():
+    """reference test_acc_2d_label: labels provided as 2-D arrays are
+    raveled before comparison."""
+    pred = mx.nd.array([[0.3, 0.7], [0, 1.], [0.4, 0.6],
+                        [0.8, 0.2], [0.3, 0.5], [0.6, 0.4]])
+    label = mx.nd.array([[0, 1, 1], [1, 0, 1]])
+    metric = mx.metric.create("acc")
+    metric.update([label], [pred])
+    _, acc = metric.get()
+    want = (np.argmax(pred.asnumpy(), axis=1) ==
+            label.asnumpy().ravel()).sum() / float(label.asnumpy().size)
+    assert acc == want
+
+
+def test_loss_update_array_or_list():
+    """reference test_loss_update: update accepts a bare array or a
+    list."""
+    pred = mx.nd.array([[0.3, 0.7], [0, 1.], [0.4, 0.6]])
+    m1 = mx.metric.create("loss")
+    m2 = mx.metric.create("loss")
+    m1.update(None, [pred])
+    m2.update(None, pred)
+    assert m1.get()[1] == m2.get()[1]
+
+
+def test_single_array_input_regression_metrics():
+    """reference test_single_array_input: mse/mae/rmse with bare-array
+    updates."""
+    pred = mx.nd.array([[1.0, 2.0, 3.0, 4.0]])
+    label = pred + 0.1
+    mse = mx.metric.create("mse")
+    mse.update(label, pred)
+    np.testing.assert_almost_equal(mse.get()[1], 0.01, decimal=5)
+    mae = mx.metric.create("mae")
+    mae.update(label, pred)
+    np.testing.assert_almost_equal(mae.get()[1], 0.1, decimal=5)
+    rmse = mx.metric.create("rmse")
+    rmse.update(label, pred)
+    np.testing.assert_almost_equal(rmse.get()[1], 0.1, decimal=5)
+
+
+def test_nll_loss_metric():
+    """reference test_nll_loss."""
+    metric = mx.metric.create("nll_loss")
+    pred = mx.nd.array([[0.2, 0.3, 0.5], [0.6, 0.1, 0.3]])
+    label = mx.nd.array([2, 1])
+    metric.update([label], [pred])
+    _, loss = metric.get()
+    want = -(np.log(0.5) + np.log(0.1)) / 2
+    np.testing.assert_almost_equal(loss, want, decimal=5)
+
+
+def test_pcc_matches_mcc_on_binary():
+    """reference test_pcc: PCC reduces to MCC for binary problems."""
+    pred = mx.nd.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4], [0.3, 0.7]])
+    label = mx.nd.array([0, 1, 1, 1])
+    pcc = mx.metric.create("pcc")
+    pcc.update([label], [pred])
+    mcc = mx.metric.create("mcc")
+    mcc.update([label], [pred])
+    np.testing.assert_almost_equal(pcc.get()[1], mcc.get()[1], decimal=6)
+
+
+def test_pcc_multiclass_and_global():
+    """PCC on a 3-class problem with local/global split."""
+    pcc = mx.metric.create("pcc")
+    pred = mx.nd.array([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1],
+                        [0.2, 0.2, 0.6], [0.5, 0.4, 0.1]])
+    label = mx.nd.array([0, 1, 2, 1])
+    pcc.update([label], [pred])
+    name, v = pcc.get()
+    assert name == "pcc" and np.isfinite(v) and 0 < v <= 1
+    pcc.reset_local()
+    _, g = pcc.get_global()
+    np.testing.assert_almost_equal(g, v, decimal=6)
