@@ -63,6 +63,12 @@ class SparseEmbedding(Block):
             self._embed = nn.Embedding(input_dim, output_dim, dtype=dtype,
                                        weight_initializer=weight_initializer)
 
+    @property
+    def weight(self):
+        """The embedding table Parameter (the reference exposes it
+        directly as ``self.weight``)."""
+        return self._embed.weight
+
     def forward(self, x):
         return self._embed(x)
 

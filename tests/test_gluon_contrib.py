@@ -78,3 +78,50 @@ def test_sync_batchnorm_alias():
     x = mx.nd.random.uniform(shape=(2, 4, 5, 5))
     out = bn(x)
     assert out.shape == x.shape
+
+
+# --- r4 depth: Concurrent/Identity/SparseEmbedding (reference
+# test_gluon_contrib.py remainder)
+
+def test_concurrent_blocks():
+    from mxnet_tpu.gluon.contrib.nn import Concurrent, HybridConcurrent
+    from mxnet_tpu.gluon import nn
+    model = HybridConcurrent(axis=1)
+    model.add(nn.Dense(16, activation="tanh", in_units=10))
+    model.add(nn.Dense(8, activation="tanh", in_units=10))
+    model.add(nn.Dense(4, in_units=10))
+    model2 = Concurrent(axis=1)
+    model2.add(nn.Dense(16, activation="tanh", in_units=10))
+    model2.add(nn.Dense(8, activation="tanh", in_units=10))
+    model2.add(nn.Dense(4, in_units=10))
+    model.initialize(mx.init.Xavier(magnitude=2.24))
+    model2.initialize(mx.init.Xavier(magnitude=2.24))
+    x = model(mx.nd.zeros((32, 10)))
+    x2 = model2(mx.nd.zeros((32, 10)))
+    assert x.shape == (32, 28)
+    assert x2.shape == (32, 28)
+
+
+def test_identity_block():
+    from mxnet_tpu.gluon.contrib.nn import Identity
+    model = Identity()
+    x = mx.nd.random.uniform(shape=(16, 3, 8))
+    np.testing.assert_allclose(model(x).asnumpy(), x.asnumpy())
+
+
+def test_sparse_embedding_row_gradients():
+    """reference test_sparse_embedding: only the touched rows get
+    gradients."""
+    from mxnet_tpu.gluon.contrib.nn import SparseEmbedding
+    layer = SparseEmbedding(10, 7)
+    layer.initialize()
+    mx.gluon.Trainer(layer.collect_params(), "sgd")
+    x = mx.nd.array([3, 4, 2, 0, 1])
+    with mx.autograd.record():
+        y = layer(x)
+        y.backward()
+    g = layer.weight.grad()
+    g_np = g.asnumpy() if not hasattr(g, "tostype") or g.stype == "default" \
+        else g.tostype("default").asnumpy()
+    assert (g_np[:5] == 1).all()
+    assert (g_np[5:] == 0).all()
