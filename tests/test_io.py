@@ -373,3 +373,70 @@ def test_transform_first_only_touches_data():
     data, label = t[1]
     np.testing.assert_allclose(data.asnumpy(), X[1] * 5)
     assert float(label.asscalar()) == 1.0
+
+
+# --- r4 depth: vision transforms semantics (reference
+# test_gluon_data_vision.py)
+
+def test_to_tensor_and_normalize_values():
+    from mxnet_tpu.gluon.data.vision import transforms
+    img = mx.nd.array((np.arange(24).reshape(4, 2, 3) * 10)
+                      .astype("uint8"))
+    t = transforms.ToTensor()(img)
+    assert t.shape == (3, 4, 2)           # HWC -> CHW
+    np.testing.assert_allclose(
+        t.asnumpy(), img.asnumpy().transpose(2, 0, 1) / 255.0,
+        rtol=1e-5)
+    norm = transforms.Normalize(mean=(0.5, 0.5, 0.5),
+                                std=(0.2, 0.2, 0.2))(t)
+    np.testing.assert_allclose(norm.asnumpy(),
+                               (t.asnumpy() - 0.5) / 0.2, rtol=1e-5)
+
+
+def test_center_crop_and_resize_geometry():
+    from mxnet_tpu.gluon.data.vision import transforms
+    img = mx.nd.array(np.arange(30 * 40 * 3).reshape(30, 40, 3)
+                      .astype("uint8") % 255)
+    out = transforms.CenterCrop((20, 10))(img)     # (w, h)
+    assert out.shape == (10, 20, 3)
+    r = transforms.Resize(16)(img)
+    assert r.shape[2] == 3 and min(r.shape[:2]) == 16
+
+
+def test_random_flip_transforms_preserve_content():
+    from mxnet_tpu.gluon.data.vision import transforms
+    mx.random.seed(7)
+    img = mx.nd.array(np.arange(12).reshape(2, 2, 3).astype("float32"))
+    lr = transforms.RandomFlipLeftRight()
+    outs = {tuple(lr(img).asnumpy().ravel()) for _ in range(20)}
+    want = {tuple(img.asnumpy().ravel()),
+            tuple(img.asnumpy()[:, ::-1].ravel())}
+    assert outs <= want and len(outs) == 2     # both variants occur
+
+
+def test_color_jitter_stays_in_range():
+    from mxnet_tpu.gluon.data.vision import transforms
+    mx.random.seed(1)
+    img = mx.nd.array(np.random.RandomState(0).rand(8, 8, 3)
+                      .astype("float32"))
+    jit = transforms.RandomColorJitter(brightness=0.2, contrast=0.2,
+                                       saturation=0.2)
+    out = jit(img)
+    assert out.shape == img.shape
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_compose_in_dataloader_pipeline():
+    from mxnet_tpu.gluon.data.vision import transforms
+    rng = np.random.RandomState(0)
+    imgs = (rng.rand(8, 12, 12, 3) * 255).astype("uint8")
+    labels = np.arange(8).astype("float32")
+    ds = mx.gluon.data.ArrayDataset(mx.nd.array(imgs),
+                                    mx.nd.array(labels))
+    fn = transforms.Compose([transforms.Resize(8),
+                             transforms.ToTensor()])
+    loader = mx.gluon.data.DataLoader(ds.transform_first(fn),
+                                      batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert batches[0][0].shape == (4, 3, 8, 8)
+    np.testing.assert_allclose(batches[0][1].asnumpy(), [0, 1, 2, 3])
