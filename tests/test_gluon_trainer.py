@@ -1,0 +1,124 @@
+"""Gluon Trainer depth tranche (reference
+``tests/python/unittest/test_gluon_trainer.py``): step math with
+momentum, lr_mult, save/load states, set_learning_rate, lr scheduler
+stepping, multi-trainer guard.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def test_trainer_step_math_and_lr_mult():
+    """reference test_trainer: sgd+momentum trajectory on grad==1, then
+    lr_mult rescales the effective step."""
+    x = gluon.Parameter("x", shape=(10,))
+    x.initialize(init="zeros")
+    trainer = gluon.Trainer([x], "sgd",
+                            {"learning_rate": 1.0, "momentum": 0.5})
+    with mx.autograd.record():
+        y = x.data() + 1
+        y.backward()
+    trainer.step(1)
+    np.testing.assert_allclose(x.data().asnumpy(), np.full(10, -1.0))
+    with mx.autograd.record():
+        y = x.data() + 1
+        y.backward()
+    trainer.step(1)
+    # momentum: v = 0.5*v + g = 1.5; x = -1 - 1.5 = -2.5
+    np.testing.assert_allclose(x.data().asnumpy(), np.full(10, -2.5))
+
+    x.lr_mult = 0.5
+    with mx.autograd.record():
+        y = x.data() + 1
+        y.backward()
+    trainer.step(1)
+    # MXNet folds lr INTO the momentum buffer (sgd-inl.h):
+    # mom = 0.5*(-1.5) - (1.0*0.5)*1 = -1.25; x = -2.5 - 1.25
+    np.testing.assert_allclose(x.data().asnumpy(),
+                               np.full(10, -3.75), rtol=1e-6)
+
+
+def test_trainer_save_load_states(tmp_path):
+    """reference test_trainer_save_load: optimizer state (momentum)
+    round-trips through save_states/load_states."""
+    x = gluon.Parameter("x", shape=(4,))
+    x.initialize(init="zeros")
+    trainer = gluon.Trainer([x], "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    for _ in range(3):
+        with mx.autograd.record():
+            (x.data() * 2).sum().backward()
+        trainer.step(1)
+    w_before = x.data().asnumpy().copy()
+    f = str(tmp_path / "t.states")
+    trainer.save_states(f)
+
+    # continue one step, then restore and replay: identical trajectory
+    with mx.autograd.record():
+        (x.data() * 2).sum().backward()
+    trainer.step(1)
+    w_after1 = x.data().asnumpy().copy()
+
+    x.set_data(mx.nd.array(w_before))
+    trainer.load_states(f)
+    with mx.autograd.record():
+        (x.data() * 2).sum().backward()
+    trainer.step(1)
+    np.testing.assert_allclose(x.data().asnumpy(), w_after1, rtol=1e-6)
+
+
+def test_trainer_learning_rate_property_and_sched():
+    """reference test_trainer_lr_sched: FactorScheduler decays across
+    steps; set_learning_rate overrides."""
+    x = gluon.Parameter("x", shape=(4,))
+    x.initialize(init="zeros")
+    sched = mx.lr_scheduler.FactorScheduler(2, factor=0.1, base_lr=1.0)
+    trainer = gluon.Trainer([x], "sgd",
+                            {"learning_rate": 1.0, "lr_scheduler": sched})
+    lr = 1.0
+    for i in range(6):
+        with mx.autograd.record():
+            (x.data() + 1).backward()
+        trainer.step(1)
+        if i % 2 == 0:
+            np.testing.assert_allclose(trainer.learning_rate, lr,
+                                       rtol=1e-6)
+            lr *= 0.1
+
+    x2 = gluon.Parameter("x2", shape=(4,))
+    x2.initialize(init="zeros")
+    t2 = gluon.Trainer([x2], "sgd", {"learning_rate": 0.5})
+    t2.set_learning_rate(0.05)
+    assert abs(t2.learning_rate - 0.05) < 1e-9
+
+
+def test_trainer_step_requires_gradients():
+    """Stepping without a recorded backward must not corrupt weights
+    (zero grads → weight unchanged for sgd w/o wd)."""
+    x = gluon.Parameter("x", shape=(3,))
+    x.initialize(init="ones")
+    trainer = gluon.Trainer([x], "sgd", {"learning_rate": 0.5})
+    with mx.autograd.record():
+        x.data().sum().backward()
+    trainer.step(1)
+    w1 = x.data().asnumpy().copy()
+    x.zero_grad()
+    trainer.step(1)
+    np.testing.assert_allclose(x.data().asnumpy(), w1)
+
+
+def test_trainer_multiple_params_distinct_states():
+    a = gluon.Parameter("a", shape=(2,))
+    b = gluon.Parameter("b", shape=(3,))
+    a.initialize(init="zeros")
+    b.initialize(init="zeros")
+    trainer = gluon.Trainer([a, b], "sgd",
+                            {"learning_rate": 1.0, "momentum": 0.9})
+    with mx.autograd.record():
+        (a.data() + 1).backward()
+        (b.data() * 2).sum().backward()
+    trainer.step(1)
+    np.testing.assert_allclose(a.data().asnumpy(), [-1, -1])
+    np.testing.assert_allclose(b.data().asnumpy(), [-2, -2, -2])
