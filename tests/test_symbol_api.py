@@ -216,3 +216,45 @@ def test_compose_renames_head():
 def test_symbol_numpy_mix_rejected():
     with pytest.raises(TypeError, match="mix Symbol"):
         mx.nd.broadcast_add(mx.sym.var("a"), np.ones((2, 2)))
+
+
+# --- r4: reference test_attr.py family
+
+def test_attr_scope_precedence_and_pickle():
+    """reference test_attr_basic: explicit attrs beat the enclosing
+    scope; attrs survive pickling."""
+    with mx.AttrScope(group="4", data="great"):
+        data = mx.sym.Variable("data", attr={"dtype": "data",
+                                             "group": "1"}, lr_mult=1)
+        gdata = mx.sym.Variable("data2")
+    assert gdata.attr("group") == "4"
+    assert data.attr("group") == "1"
+    assert str(data.attr("lr_mult")) == "1"
+    d2 = pickle.loads(pickle.dumps(data))
+    assert d2.attr("dtype") == data.attr("dtype")
+
+
+def test_attr_scope_applies_to_ops_and_nests():
+    """reference test_operator: scopes attach to op nodes and nest."""
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(__data__="great"):
+        fc1 = mx.sym.Activation(data, act_type="relu")
+        with mx.AttrScope(__init_bias__="0.0"):
+            fc2 = mx.sym.FullyConnected(fc1, num_hidden=10, name="fc2")
+    assert fc1.attr("__data__") == "great"
+    assert fc2.attr("__data__") == "great"
+    assert fc2.attr("__init_bias__") == "0.0"
+    fc2copy = pickle.loads(pickle.dumps(fc2))
+    assert fc2copy.tojson() == fc2.tojson()
+
+
+def test_attr_dict_collects_per_node():
+    """reference test_attr_dict: attr_dict exposes variable attrs and op
+    hyperparameters per node."""
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    op = mx.sym.Convolution(data=data, name="conv", kernel=(1, 1),
+                            num_filter=1)
+    d = op.attr_dict()
+    assert d["data"]["mood"] == "angry"
+    assert d["conv"]["num_filter"] == "1"
+    assert d["conv"]["kernel"] == "(1, 1)"
