@@ -231,10 +231,22 @@ class Symbol:
             return None, None, None
 
     def infer_type(self, *args, **kwargs):
+        """Type inference (reference ``c_api_symbolic.cc InferType``).
+        Floating networks are dtype-uniform in the reference's registry
+        (FInferType same-type rules), so given dtypes propagate to every
+        unspecified argument/output; explicit per-arg dtypes win."""
         arg_names = self.list_arguments()
-        dt = _np.float32
-        return [dt] * len(arg_names), [dt] * len(self._outputs), \
-            [dt] * len(self.list_auxiliary_states())
+        given = {}
+        for n, t in zip(arg_names, args):
+            if t is not None:
+                given[n] = _np.dtype(t)
+        for k, v in kwargs.items():
+            if v is not None:
+                given[k] = _np.dtype(v)
+        default = next(iter(given.values()), _np.dtype(_np.float32))
+        arg_types = [given.get(n, default) for n in arg_names]
+        return arg_types, [default] * len(self._outputs), \
+            [default] * len(self.list_auxiliary_states())
 
     def _make_arg_specs(self, shapes, dtypes=None):
         """Resolve ShapeDtypeStructs for every variable, inferring parameter

@@ -258,3 +258,58 @@ def test_attr_dict_collects_per_node():
     assert d["data"]["mood"] == "angry"
     assert d["conv"]["num_filter"] == "1"
     assert d["conv"]["kernel"] == "(1, 1)"
+
+
+# --- r4: reference test_infer_shape.py family
+
+def test_mlp2_infer_shape_full():
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, name="fc1", num_hidden=1000)
+    out = mx.sym.Activation(out, act_type="relu")
+    out = mx.sym.FullyConnected(out, name="fc2", num_hidden=10)
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(100, 100))
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (1000, 100)
+    assert d["fc1_bias"] == (1000,)
+    assert d["fc2_weight"] == (10, 1000)
+    assert out_shapes == [(100, 10)]
+
+
+def test_infer_shape_error_is_loud():
+    """reference test_mlp2_infer_error: inconsistent shapes raise."""
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, name="fc1", num_hidden=1000)
+    out = mx.sym.elemwise_add(out, mx.sym.Variable("extra"))
+    with pytest.raises(Exception):
+        out.infer_shape(data=(100, 100), extra=(50, 50))
+
+
+def test_incomplete_infer_partial():
+    """reference test_incomplete_infer_*: infer_shape_partial returns
+    what it can without raising."""
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, name="fc", num_hidden=8)
+    res = out.infer_shape_partial()
+    assert res is not None              # no exception with nothing known
+
+
+def test_conv_infer_shape_chain():
+    """reference test_incomplete_infer_convolution analog with full
+    input: conv weight/bias shapes derive from data."""
+    data = mx.sym.Variable("data")
+    out = mx.sym.Convolution(data, name="conv", kernel=(3, 3),
+                             num_filter=6, pad=(1, 1))
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(2, 5, 9, 9))
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert d["conv_weight"] == (6, 5, 3, 3)
+    assert d["conv_bias"] == (6,)
+    assert out_shapes == [(2, 6, 9, 9)]
+
+
+def test_fc_infer_type_f16():
+    """reference test_fc_infer_type: dtype propagates through FC."""
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, name="fc", num_hidden=3)
+    arg_types, out_types, _ = out.infer_type(data="float16")
+    d = dict(zip(out.list_arguments(), arg_types))
+    assert out_types[0] == np.float16
