@@ -173,7 +173,20 @@ class NDArray:
     as_in_ctx = as_in_context
 
     def to_dlpack_for_read(self):
-        return jax.dlpack.to_dlpack(self._data)
+        """DLPack capsule for zero-copy export (reference
+        ``ndarray.py to_dlpack_for_read``; consumers: torch/cupy/...)."""
+        return self._data.__dlpack__()
+
+    def to_dlpack_for_write(self):
+        """Reference API twin; jax buffers are immutable so the capsule
+        is the same read view — consumers must copy before mutating."""
+        return self._data.__dlpack__()
+
+    def __dlpack__(self, *args, **kwargs):
+        return self._data.__dlpack__(*args, **kwargs)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
 
     def tostype(self, stype):
         if stype == "default":
@@ -991,3 +1004,20 @@ def load(fname):
     if all(n.startswith("arr_") for n in names):
         return arrays
     return dict(zip(names, arrays))
+
+
+def from_dlpack(ext):
+    """Import a DLPack capsule / __dlpack__-bearing object as an NDArray
+    (reference ``ndarray.py from_dlpack``): zero-copy where the backend
+    allows, e.g. torch CPU tensors."""
+    return _wrap(jax.dlpack.from_dlpack(ext))
+
+
+def to_dlpack_for_read(arr):
+    """Module-level twin of ``NDArray.to_dlpack_for_read`` (reference
+    surface)."""
+    return arr.to_dlpack_for_read()
+
+
+def to_dlpack_for_write(arr):
+    return arr.to_dlpack_for_write()

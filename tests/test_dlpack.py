@@ -1,0 +1,38 @@
+"""DLPack interop (reference ``tests/python/unittest/test_dlpack.py``):
+zero-copy exchange with foreign frameworks — torch (CPU) is the live
+consumer/producer available in this image.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import mxnet_tpu as mx
+
+
+def test_to_dlpack_torch_consumes():
+    x = mx.nd.array(np.arange(6, dtype="float32").reshape(2, 3))
+    t = torch.utils.dlpack.from_dlpack(x.to_dlpack_for_read())
+    np.testing.assert_allclose(t.numpy(), x.asnumpy())
+    # protocol form: torch consumes the NDArray directly
+    t2 = torch.from_dlpack(x)
+    np.testing.assert_allclose(t2.numpy(), x.asnumpy())
+
+
+def test_from_dlpack_torch_produces():
+    t = torch.arange(8, dtype=torch.float32).reshape(2, 4) * 1.5
+    a = mx.nd.from_dlpack(t)
+    assert isinstance(a, mx.nd.NDArray)
+    np.testing.assert_allclose(a.asnumpy(), t.numpy())
+    # round-trip
+    t3 = torch.from_dlpack(a)
+    np.testing.assert_allclose(t3.numpy(), t.numpy())
+
+
+def test_module_level_capsule_functions():
+    x = mx.nd.ones((3,))
+    cap = mx.nd.to_dlpack_for_read(x)
+    t = torch.utils.dlpack.from_dlpack(cap)
+    np.testing.assert_allclose(t.numpy(), [1, 1, 1])
+    cap2 = mx.nd.to_dlpack_for_write(x)
+    assert cap2 is not None
