@@ -1,0 +1,141 @@
+"""Tricky operator semantics vs numpy (reference ``test_operator.py``
+families not yet pinned): Pad modes, bilinear UpSampling values,
+GridGenerator affine grids, softmax temperature, pick keepdims, take
+modes, Embedding gradient accumulation on repeated indices, LRN formula,
+smooth_l1 branches.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+@pytest.mark.parametrize("mode", ["edge", "reflect"])
+def test_pad_modes(mode):
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    out = mx.nd.Pad(mx.nd.array(x), mode=mode,
+                    pad_width=(0, 0, 0, 0, 1, 1, 2, 2))
+    np_mode = {"edge": "edge", "reflect": "reflect"}[mode]
+    want = np.pad(x, ((0, 0), (0, 0), (1, 1), (2, 2)), mode=np_mode)
+    np.testing.assert_allclose(out.asnumpy(), want)
+
+
+def test_pad_constant_value():
+    x = np.ones((1, 1, 2, 2), "float32")
+    out = mx.nd.Pad(mx.nd.array(x), mode="constant", constant_value=9.0,
+                    pad_width=(0, 0, 0, 0, 1, 1, 1, 1))
+    w = out.asnumpy()
+    assert w[0, 0, 0, 0] == 9.0 and w[0, 0, 1, 1] == 1.0
+
+
+def test_upsampling_nearest_values():
+    x = np.array([[[[1.0, 2.0], [3.0, 4.0]]]], "float32")
+    out = mx.nd.UpSampling(mx.nd.array(x), scale=2,
+                           sample_type="nearest")
+    want = x.repeat(2, axis=2).repeat(2, axis=3)
+    np.testing.assert_allclose(out.asnumpy(), want)
+
+
+def test_grid_generator_affine_identity():
+    """Identity affine → a uniform [-1, 1] grid (reference
+    grid_generator.cc)."""
+    theta = mx.nd.array([[1.0, 0, 0, 0, 1.0, 0]])
+    grid = mx.nd.GridGenerator(theta, transform_type="affine",
+                               target_shape=(3, 3))
+    g = grid.asnumpy()[0]
+    # channel 0 = x coords, channel 1 = y coords; corners at ±1
+    assert g.shape == (2, 3, 3)
+    np.testing.assert_allclose(g[0][:, 0], [-1, -1, -1], atol=1e-6)
+    np.testing.assert_allclose(g[0][:, 2], [1, 1, 1], atol=1e-6)
+    np.testing.assert_allclose(g[1][0, :], [-1, -1, -1], atol=1e-6)
+    np.testing.assert_allclose(g[1][2, :], [1, 1, 1], atol=1e-6)
+
+
+def test_softmax_temperature():
+    x = np.array([[1.0, 2.0, 3.0]], "float32")
+    out = mx.nd.softmax(mx.nd.array(x), temperature=2.0)
+    e = np.exp(x / 2.0 - (x / 2.0).max())
+    np.testing.assert_allclose(out.asnumpy(), e / e.sum(), rtol=1e-5)
+
+
+def test_pick_keepdims_and_modes():
+    x = np.arange(12, dtype="float32").reshape(3, 4)
+    idx = mx.nd.array([0, 2, 3])
+    out = mx.nd.pick(mx.nd.array(x), idx, axis=1, keepdims=True)
+    assert out.shape == (3, 1)
+    np.testing.assert_allclose(out.asnumpy().ravel(), [0, 6, 11])
+
+
+def test_take_modes():
+    x = mx.nd.array(np.arange(5, dtype="float32"))
+    idx = mx.nd.array([-1.0, 7.0])
+    clipd = mx.nd.take(x, idx, mode="clip")
+    np.testing.assert_allclose(clipd.asnumpy(), [0, 4])
+    wrapped = mx.nd.take(x, idx, mode="wrap")
+    np.testing.assert_allclose(wrapped.asnumpy(), [4, 2])
+
+
+def test_embedding_grad_accumulates_repeated_indices():
+    """Repeated lookups of one row SUM their gradients (reference
+    embedding backward AddTakeGrad)."""
+    w = mx.nd.array(np.zeros((4, 2), "float32"))
+    w.attach_grad()
+    idx = mx.nd.array([1, 1, 1, 3])
+    with mx.autograd.record():
+        out = mx.nd.Embedding(idx, w, input_dim=4, output_dim=2)
+        out.sum().backward()
+    g = w.grad.asnumpy()
+    np.testing.assert_allclose(g[1], [3, 3])
+    np.testing.assert_allclose(g[3], [1, 1])
+    np.testing.assert_allclose(g[0], [0, 0])
+
+
+def test_lrn_formula():
+    """LRN vs the explicit cross-channel formula (reference lrn.cc:
+    out = x / (knorm + alpha/n * sum(x^2 over window))^beta)."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(1, 5, 2, 2).astype("float32")
+    nsize, alpha, beta, knorm = 3, 1e-2, 0.75, 2.0
+    out = mx.nd.LRN(mx.nd.array(x), nsize=nsize, alpha=alpha, beta=beta,
+                    knorm=knorm)
+    want = np.zeros_like(x)
+    half = nsize // 2
+    for c in range(5):
+        lo, hi = max(0, c - half), min(5, c + half + 1)
+        sq = (x[:, lo:hi] ** 2).sum(axis=1)
+        want[:, c] = x[:, c] / (knorm + alpha / nsize * sq) ** beta
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-4, atol=1e-5)
+
+
+def test_smooth_l1_branches():
+    sigma = 2.0
+    x = np.array([-2.0, -0.1, 0.1, 2.0], "float32")
+    out = mx.nd.smooth_l1(mx.nd.array(x), scalar=sigma)
+    s2 = sigma ** 2
+    want = np.where(np.abs(x) < 1.0 / s2, 0.5 * s2 * x * x,
+                    np.abs(x) - 0.5 / s2)
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5)
+
+
+def test_log_softmax_gradient():
+    x = mx.nd.array(np.array([[1.0, 2.0, 3.0]], "float32"))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.log_softmax(x)
+        y[0, 0].backward()
+    # d log_softmax_0 / dx = e_0 - softmax
+    sm = np.exp([1, 2, 3]) / np.exp([1, 2, 3]).sum()
+    want = np.eye(3)[0] - sm
+    np.testing.assert_allclose(x.grad.asnumpy()[0], want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_ravel_unravel_roundtrip():
+    shape = (3, 4, 5)
+    idx = mx.nd.array([[0, 1, 2], [1, 2, 3], [2, 3, 4]], dtype="float32")
+    flat = mx.nd.ravel_multi_index(idx, shape=shape)
+    np.testing.assert_allclose(flat.asnumpy(),
+                               np.ravel_multi_index(
+                                   idx.asnumpy().astype("int64"), shape))
+    back = mx.nd.unravel_index(flat, shape=shape)
+    np.testing.assert_allclose(back.asnumpy(), idx.asnumpy())
