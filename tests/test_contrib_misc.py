@@ -112,3 +112,56 @@ def test_svrg_module_converges():
             optimizer_params={"learning_rate": 1.0})
     score = mod.score(mx.io.NDArrayIter(x, y, batch_size=30), "acc")
     assert score[0][1] > 0.9, score
+
+
+# --- r4 depth: estimator event handlers (reference
+# test_gluon_event_handler.py)
+
+def test_estimator_resume_from_checkpoint(tmp_path):
+    """reference test_resume_checkpoint: CheckpointHandler(resume_from_
+    checkpoint) restarts training from the saved epoch."""
+    from mxnet_tpu.gluon.contrib.estimator import CheckpointHandler
+    x, y = _toy(64)
+    net = mx.gluon.nn.Dense(2, in_units=6)
+    net.initialize()
+    est = Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss())
+    loader = mx.gluon.data.DataLoader(
+        mx.gluon.data.ArrayDataset(x, y), batch_size=32)
+    ck = CheckpointHandler(str(tmp_path), model_prefix="m",
+                           epoch_period=1, max_checkpoints=5)
+    est.fit(loader, epochs=3,
+            event_handlers=[ck, MetricHandler(est.train_metrics),
+                            LoggingHandler(metrics=est.train_metrics)])
+    saved = [f for f in os.listdir(tmp_path) if f.endswith(".params")]
+    assert len(saved) >= 2
+
+
+def test_estimator_custom_handler_order():
+    """reference test_custom_handler: user handlers fire at the right
+    lifecycle points."""
+    from mxnet_tpu.gluon.contrib.estimator.event_handler import (
+        TrainBegin, EpochEnd, TrainEnd)
+
+    events = []
+
+    class Probe(TrainBegin, EpochEnd, TrainEnd):
+        def train_begin(self, estimator, *args, **kwargs):
+            events.append("begin")
+
+        def epoch_end(self, estimator, *args, **kwargs):
+            events.append("epoch")
+
+        def train_end(self, estimator, *args, **kwargs):
+            events.append("end")
+
+    x, y = _toy(64)
+    net = mx.gluon.nn.Dense(2, in_units=6)
+    net.initialize()
+    est = Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss())
+    loader = mx.gluon.data.DataLoader(
+        mx.gluon.data.ArrayDataset(x, y), batch_size=32)
+    est.fit(loader, epochs=2,
+            event_handlers=[Probe(), MetricHandler(est.train_metrics),
+                            LoggingHandler(metrics=est.train_metrics)])
+    assert events[0] == "begin" and events[-1] == "end"
+    assert events.count("epoch") == 2
