@@ -7,3 +7,4 @@ from . import text  # noqa: F401
 from . import svrg_optimization  # noqa: F401
 from . import tensorboard  # noqa: F401
 from . import tensorrt  # noqa: F401
+from . import io  # noqa: F401

@@ -1,0 +1,55 @@
+"""contrib IO (reference ``python/mxnet/contrib/io.py``): wrap a Gluon
+``DataLoader`` as a legacy ``DataIter`` so Module/FeedForward consumers
+can ride the DataLoader's dataset/sampler/worker machinery."""
+from __future__ import annotations
+
+from ..io.io import DataBatch, DataDesc, DataIter
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Reference ``contrib/io.py:DataLoaderIter``: iterates a
+    ``gluon.data.DataLoader``, exposing ``provide_data``/
+    ``provide_label`` from the first batch."""
+
+    def __init__(self, loader, data_name="data",
+                 label_name="softmax_label"):
+        super().__init__()
+        self._loader = loader
+        self._iter = iter(loader)
+        self._data_name = data_name
+        self._label_name = label_name
+        try:
+            first = next(self._iter)
+        except StopIteration:
+            raise ValueError("DataLoaderIter: empty loader")
+        self._first = first
+        data, label = self._split(first)
+        self.batch_size = data[0].shape[0]
+        self.provide_data = [DataDesc(data_name, tuple(data[0].shape))]
+        self.provide_label = [DataDesc(label_name, tuple(label[0].shape))] \
+            if label else []
+
+    @staticmethod
+    def _split(batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) >= 2:
+                return [batch[0]], [batch[1]]
+            return [batch[0]], []
+        return [batch], []
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._first = None
+
+    def next(self):
+        if self._first is not None:
+            batch, self._first = self._first, None
+        else:
+            batch = next(self._iter)        # StopIteration ends the epoch
+        data, label = self._split(batch)
+        pad = self.batch_size - data[0].shape[0]
+        return DataBatch(data=data, label=label, pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -165,3 +165,32 @@ def test_estimator_custom_handler_order():
                             LoggingHandler(metrics=est.train_metrics)])
     assert events[0] == "begin" and events[-1] == "end"
     assert events.count("epoch") == 2
+
+
+def test_contrib_dataloader_iter_wraps_gluon_loader():
+    """reference test_contrib_io: DataLoaderIter drives Module.fit from a
+    gluon DataLoader."""
+    from mxnet_tpu.contrib.io import DataLoaderIter
+    x, y = _toy(96)
+    loader = mx.gluon.data.DataLoader(
+        mx.gluon.data.ArrayDataset(x, y), batch_size=32, shuffle=False)
+    it = DataLoaderIter(loader)
+    assert it.batch_size == 32
+    assert it.provide_data[0].shape == (32, 6)
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape[0] <= 32
+        n += batch.data[0].shape[0]
+    assert n == 96
+    it.reset()
+    # drives the Module API end-to-end
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc", num_hidden=2)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.fit(it, num_epoch=6, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    it.reset()
+    acc = mod.score(it, "acc")[0][1]
+    assert acc > 0.9, acc
