@@ -34,7 +34,13 @@ class DataLoaderIter(DataIter):
     @staticmethod
     def _split(batch):
         if isinstance(batch, (list, tuple)):
-            if len(batch) >= 2:
+            if len(batch) > 2:
+                raise ValueError(
+                    f"DataLoaderIter expects (data,) or (data, label) "
+                    f"batches; got {len(batch)} elements — wrap extra "
+                    f"fields into the data structure or use the "
+                    f"DataLoader directly")
+            if len(batch) == 2:
                 return [batch[0]], [batch[1]]
             return [batch[0]], []
         return [batch], []
@@ -44,12 +50,25 @@ class DataLoaderIter(DataIter):
         self._first = None
 
     def next(self):
+        from .. import ndarray as nd
         if self._first is not None:
             batch, self._first = self._first, None
         else:
             batch = next(self._iter)        # StopIteration ends the epoch
         data, label = self._split(batch)
         pad = self.batch_size - data[0].shape[0]
+        if pad:
+            # DataBatch.pad contract (NDArrayIter semantics): arrays ARE
+            # full batch_size with the last ``pad`` rows as filler —
+            # consumers (predict/score) slice them off.  Emitting the
+            # bare partial batch would make predict() drop real samples
+            # and violate the bound provide_data shapes.
+            def _pad(arrs):
+                return [nd.concat(a, nd.zeros((pad,) + tuple(a.shape[1:]),
+                                              dtype=a.dtype), dim=0)
+                        for a in arrs]
+            data = _pad(data)
+            label = _pad(label) if label else label
         return DataBatch(data=data, label=label, pad=pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)

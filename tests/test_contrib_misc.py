@@ -171,7 +171,7 @@ def test_contrib_dataloader_iter_wraps_gluon_loader():
     """reference test_contrib_io: DataLoaderIter drives Module.fit from a
     gluon DataLoader."""
     from mxnet_tpu.contrib.io import DataLoaderIter
-    x, y = _toy(96)
+    x, y = _toy(100)                      # NOT divisible: exercises pad
     loader = mx.gluon.data.DataLoader(
         mx.gluon.data.ArrayDataset(x, y), batch_size=32, shuffle=False)
     it = DataLoaderIter(loader)
@@ -179,9 +179,10 @@ def test_contrib_dataloader_iter_wraps_gluon_loader():
     assert it.provide_data[0].shape == (32, 6)
     n = 0
     for batch in it:
-        assert batch.data[0].shape[0] <= 32
-        n += batch.data[0].shape[0]
-    assert n == 96
+        # pad contract: arrays are always full batch_size
+        assert batch.data[0].shape[0] == 32
+        n += 32 - (batch.pad or 0)
+    assert n == 100
     it.reset()
     # drives the Module API end-to-end
     data = mx.sym.Variable("data")
