@@ -668,15 +668,15 @@ def lower_int8_inference(sym, arg_params, aux_params, thresholds,
         din = node.inputs[0]
         dkey = (id(din[0]), din[1])
         dshape = shape_of.get(dkey)
-        dst = state.get(dkey)
-        if dshape is None and dst is not None and dst[1] in (_I8, _BF16):
-            # input is NHWC from a fused producer but its shape is
-            # unknown (no data_shapes given): the weight-column
-            # permutation below cannot be verified — fall back to fp32
-            # rather than silently flatten against NCHW-ordered columns
+        if dshape is None:
+            # unknown input shape (no data_shapes given): for NHWC
+            # producers (_I8/_BF16) the weight-column permutation below
+            # cannot be verified, and for _F32 producers to_i8 would
+            # NHWC-transpose a possibly-4D tensor against unpermuted
+            # NCHW weight columns — fall back to fp32 in both cases
+            # rather than risk a silently wrong flatten order
             return None
-        if dshape is not None and len(dshape) == 4 and \
-                (dshape[2] != 1 or dshape[3] != 1):
+        if len(dshape) == 4 and (dshape[2] != 1 or dshape[3] != 1):
             # NHWC flatten ≠ NCHW flatten when H*W > 1: permute weight
             # columns (O, C, H, W) → (O, H, W, C)
             o, (c, h, wd) = w.shape[0], dshape[1:]
@@ -762,9 +762,13 @@ def lower_int8_inference(sym, arg_params, aux_params, thresholds,
              "pad": attrs.get("pad"), "pool_type": ptype,
              "global_pool": gpool, "in_scale": in_scale})
         n_fused[0] += 1
-        if ptype == "max" and not gpool:
+        if ptype == "max":
+            # max pooling (windowed or global) is scale-preserving: the
+            # op emits raw int8 codes (or bf16 values), so the producer's
+            # quantization state carries through unchanged
             state[(id(node), 0)] = (out, dst[1], dst[2])
         else:
+            # avg pooling accumulates in f32 and the op applied in_scale:
             # fp32 NHWC; restore NCHW for generic consumers (free when
             # global: H=W=1)
             back = _invoke_sym_by_name(

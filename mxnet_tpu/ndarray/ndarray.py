@@ -199,8 +199,30 @@ class NDArray:
 
     # --------------------------------------------------------------- autograd
     def attach_grad(self, grad_req="write", stype=None):
-        """Reference ``python/mxnet/ndarray/ndarray.py attach_grad``."""
-        buf = zeros_like(self)
+        """Attach a gradient buffer, optionally with a sparse storage
+        type (reference ``python/mxnet/ndarray/ndarray.py:2158`` — the
+        ``stype`` parameter allocates the grad via ``zeros(stype=...)``).
+
+        ``stype='row_sparse'`` allocates a *compressed* zero-row buffer
+        (O(nnz) memory): sparse backwards (e.g. Embedding with
+        ``sparse_grad=True``) adopt their rows without densifying, and
+        ``self.grad.stype`` reports ``'row_sparse'``."""
+        if stype is None or stype == "default":
+            buf = zeros_like(self)
+        elif stype == "row_sparse":
+            import jax.numpy as jnp
+            from .sparse import RowSparseNDArray
+            shape = tuple(self.shape)
+            buf = RowSparseNDArray.from_rows(
+                jnp.zeros((0,), jnp.int32),
+                jnp.zeros((0,) + shape[1:], self.dtype), shape)
+        elif stype == "csr":
+            from . import sparse as _sp
+            buf = _sp.zeros("csr", tuple(self.shape), dtype=self.dtype)
+        else:
+            raise ValueError(
+                f"invalid stype {stype!r}: must be default, row_sparse "
+                "or csr")
         _ag.mark_variables([self], [buf], grad_reqs=[grad_req])
 
     def detach(self):

@@ -231,22 +231,54 @@ class Symbol:
             return None, None, None
 
     def infer_type(self, *args, **kwargs):
-        """Type inference (reference ``c_api_symbolic.cc InferType``).
-        Floating networks are dtype-uniform in the reference's registry
-        (FInferType same-type rules), so given dtypes propagate to every
-        unspecified argument/output; explicit per-arg dtypes win."""
+        """Type inference (reference ``c_api_symbolic.cc:571``
+        MXSymbolInferType): a bidirectional fixpoint pass over per-op
+        dtype rules (``symbol/dtype_infer.py`` ≙ the per-op FInferType
+        registrations — ElemwiseType unification by default, dedicated
+        rules for dtype-forcing ops like Cast/amp_cast/quantize/Embedding
+        and mixed-dtype signatures like BatchNorm).  Dtypes that remain
+        unconstrained after the fixpoint default to float32, the
+        reference executor's default for unannotated variables."""
+        t, by_name = self._run_type_pass(args, kwargs)
+        f32 = _np.dtype(_np.float32)
+        arg_types = [by_name.get(n) or f32 for n in self.list_arguments()]
+        aux_types = [by_name.get(n) or f32
+                     for n in self.list_auxiliary_states()]
+        out_types = [t[(id(n), i)] or f32 for (n, i) in self._outputs]
+        return arg_types, out_types, aux_types
+
+    def infer_type_partial(self, *args, **kwargs):
+        """Partial type inference (reference ``infer_type_partial``):
+        like ``infer_type`` but leaves unconstrained slots as ``None``
+        instead of defaulting, and never raises on conflicts."""
+        t, by_name = self._run_type_pass(args, kwargs,
+                                         raise_on_conflict=False)
+        arg_types = [by_name.get(n) for n in self.list_arguments()]
+        aux_types = [by_name.get(n) for n in self.list_auxiliary_states()]
+        out_types = [t[(id(n), i)] for (n, i) in self._outputs]
+        return arg_types, out_types, aux_types
+
+    def _run_type_pass(self, args, kwargs, raise_on_conflict=True):
+        """Returns (tensor-key dtype map, {variable name: dtype})."""
+        from .dtype_infer import infer_dtypes, parse_dtype
         arg_names = self.list_arguments()
+        var_nodes = {n.name: n for n in self._topo() if n.op is None}
         given = {}
-        for n, t in zip(arg_names, args):
-            if t is not None:
-                given[n] = _np.dtype(t)
+        for n, ty in zip(arg_names, args):
+            if ty is not None:
+                given[n] = parse_dtype(ty)
         for k, v in kwargs.items():
-            if v is not None:
-                given[k] = _np.dtype(v)
-        default = next(iter(given.values()), _np.dtype(_np.float32))
-        arg_types = [given.get(n, default) for n in arg_names]
-        return arg_types, [default] * len(self._outputs), \
-            [default] * len(self.list_auxiliary_states())
+            if v is None:
+                continue
+            if k not in var_nodes:
+                raise ValueError(
+                    "infer_type keyword %r matches no variable in this "
+                    "symbol (arguments: %s)" % (k, arg_names))
+            given[k] = parse_dtype(v)
+        t = infer_dtypes(self, given, raise_on_conflict=raise_on_conflict)
+        by_name = {name: t[(id(node), 0)]
+                   for name, node in var_nodes.items()}
+        return t, by_name
 
     def _make_arg_specs(self, shapes, dtypes=None):
         """Resolve ShapeDtypeStructs for every variable, inferring parameter

@@ -239,3 +239,66 @@ def test_fused_int8_lowering_convnet_residual():
     scale = np.abs(ref).max()
     assert np.abs(got - ref).max() < 0.05 * scale + 0.02, \
         (np.abs(got - ref).max(), scale)
+
+
+def test_fused_int8_lowering_global_max_pool():
+    """Global *max* pool keeps the quantized state (raw int8 codes are
+    scale-preserving); regression for the r4 bug where the lowering
+    dequantized the codes with scale 1.0 — wrong by 1/in_scale."""
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, name="c1", kernel=(3, 3), pad=(1, 1),
+                            num_filter=8, no_bias=True)
+    a1 = mx.sym.Activation(c1, name="a1", act_type="relu")
+    gp = mx.sym.Pooling(a1, name="gp", global_pool=True, pool_type="max",
+                        kernel=(1, 1))
+    sym = mx.sym.FullyConnected(gp, name="fc", num_hidden=3)
+
+    rng = np.random.RandomState(7)
+    x = rng.rand(4, 4, 8, 8).astype("float32")
+    args = {"c1_weight": mx.nd.array(rng.randn(8, 4, 3, 3) * 0.3),
+            "fc_weight": mx.nd.array(rng.randn(3, 8) * 0.3),
+            "fc_bias": mx.nd.zeros(3)}
+    xin = mx.nd.array(x)
+    ref = sym.bind(mx.cpu(), {**args, "data": xin}) \
+        .forward(is_train=False)[0].asnumpy()
+
+    it = mx.io.NDArrayIter(x, np.zeros(4, "float32"), batch_size=4)
+    qsym, qargs, qauxs = qz.quantize_model(
+        sym, args, {}, calib_mode="naive", calib_data=it,
+        num_calib_examples=4, lowering="fused_int8")
+    ops = [n.op.name for n in qsym._topo() if n.op is not None]
+    assert "_contrib_int8_pool" in ops, ops
+    got = qsym.bind(mx.cpu(), {**qargs, "data": xin}) \
+        .forward(is_train=False)[0].asnumpy()
+    scale = np.abs(ref).max()
+    assert np.abs(got - ref).max() < 0.05 * scale + 0.02, \
+        (np.abs(got - ref).max(), scale)
+
+
+def test_fused_int8_fc_unknown_shape_fp32_falls_back():
+    """A 4-D fp32 FC input with H*W>1 and *no* data_shapes must fall back
+    to fp32 (the NHWC quantize transpose cannot be matched against the
+    unpermuted NCHW weight columns) — regression for silently-wrong
+    flatten order."""
+    data = mx.sym.Variable("data")
+    sym = mx.sym.FullyConnected(data, name="fc", num_hidden=3)
+
+    rng = np.random.RandomState(11)
+    x = rng.rand(2, 3, 4, 4).astype("float32")
+    args = {"fc_weight": mx.nd.array(rng.randn(3, 48) * 0.2),
+            "fc_bias": mx.nd.zeros(3)}
+    xin = mx.nd.array(x)
+    ref = sym.bind(mx.cpu(), {**args, "data": xin}) \
+        .forward(is_train=False)[0].asnumpy()
+
+    it = mx.io.NDArrayIter(x, np.zeros(2, "float32"), batch_size=2)
+    th = qz._collect_thresholds(sym, args, {}, it, ("data",), 2, None,
+                                mode="naive", boundaries="all")
+    qsym, qargs, qauxs = qz.lower_int8_inference(
+        sym, args, {}, th, data_shapes=None)
+    ops = [n.op.name for n in qsym._topo() if n.op is not None]
+    assert "FullyConnected" in ops, ops          # stayed fp32
+    assert "_contrib_int8_fc_fused" not in ops, ops
+    got = qsym.bind(mx.cpu(), {**qargs, "data": xin}) \
+        .forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

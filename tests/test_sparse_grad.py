@@ -12,6 +12,7 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
+from mxnet_tpu import autograd
 from mxnet_tpu.ndarray.sparse import RowSparseNDArray
 
 VOCAB, DIM = 50000, 16
@@ -207,3 +208,50 @@ def test_observing_grad_does_not_change_semantics():
     changed = np.nonzero(np.abs(emb.weight.data().asnumpy() - w0)
                          .sum(axis=1))[0].tolist()
     assert sorted(changed) == [1, 2], "lazy update must survive observation"
+
+
+def test_attach_grad_stype_row_sparse():
+    """Raw-NDArray sparse-grad contract (reference ndarray.py:2158):
+    attach_grad(stype='row_sparse') yields a compressed row_sparse grad
+    with O(nnz) rows after an Embedding(sparse_grad=True) backward."""
+    rng = np.random.RandomState(0)
+    w = mx.nd.array(rng.randn(50, 4).astype("float32"))
+    w.attach_grad(stype="row_sparse")
+    idx = mx.nd.array([1, 3, 3], dtype="int32")
+    with autograd.record():
+        e = mx.nd.Embedding(idx, w, input_dim=50, output_dim=4,
+                            sparse_grad=True)
+        loss = e.sum()
+    loss.backward()
+    g = w.grad
+    assert g.stype == "row_sparse"
+    assert g.is_compressed()                      # O(nnz), not (50, 4)
+    np.testing.assert_array_equal(np.sort(g.indices.asnumpy()), [1, 3])
+    assert g.data.shape == (2, 4)
+    ref = np.zeros((50, 4), "float32")
+    ref[1] += 1.0
+    ref[3] += 2.0
+    np.testing.assert_allclose(g.asnumpy(), ref)
+
+
+def test_attach_grad_stype_default_and_invalid():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad(stype="default")
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0])
+    with pytest.raises(ValueError):
+        mx.nd.array([1.0]).attach_grad(stype="block_sparse")
+
+
+def test_attach_grad_stype_dense_backward_densifies():
+    """A dense backward into a row_sparse-attached grad still produces
+    correct values (the buffer adopts a dense-equivalent result)."""
+    w = mx.nd.array(np.ones((6, 2), "float32"))
+    w.attach_grad(stype="row_sparse")
+    with autograd.record():
+        loss = (w * 3.0).sum()
+    loss.backward()
+    assert w.grad.stype == "row_sparse"
+    np.testing.assert_allclose(w.grad.asnumpy(), np.full((6, 2), 3.0))
