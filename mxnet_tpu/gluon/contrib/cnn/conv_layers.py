@@ -1,0 +1,124 @@
+"""Deformable convolution Gluon layer (reference
+``python/mxnet/gluon/contrib/cnn/conv_layers.py:30``).
+
+Bundles the offset-predicting ordinary convolution and the deformable
+convolution itself (``_contrib_DeformableConvolution`` in
+``ops/detection_ops.py`` — bilinear-tap im2col + one MXU matmul) into one
+HybridBlock, with the reference's parameter names
+(``offset_weight``/``offset_bias``/``deformable_conv_weight``/
+``deformable_conv_bias``) so checkpoints interchange.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+
+__all__ = ["DeformableConvolution"]
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class DeformableConvolution(HybridBlock):
+    """2-D deformable convolution v1 (Dai et al., 2017).
+
+    The sampling offsets are produced by a learned ordinary convolution
+    over the same input (initialised to zero, so training starts from the
+    regular grid), then applied by the deformable convolution that
+    produces the output features.
+
+    Parameters mirror the reference layer: ``channels``, ``kernel_size``,
+    ``strides``, ``padding``, ``dilation``, ``groups``,
+    ``num_deformable_group``, ``layout`` ('NCHW' only), ``use_bias``,
+    ``in_channels``, ``activation``, ``weight_initializer``,
+    ``bias_initializer``, ``offset_weight_initializer`` (default zeros),
+    ``offset_bias_initializer`` (default zeros), ``offset_use_bias``.
+    """
+
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, layout="NCHW", use_bias=True,
+                 in_channels=0, activation=None, weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if layout != "NCHW":
+            raise ValueError(
+                "DeformableConvolution supports layout='NCHW' only "
+                f"(got {layout!r})")
+        kernel_size = _pair(kernel_size)
+        strides = _pair(strides)
+        padding = _pair(padding)
+        dilation = _pair(dilation)
+        with self.name_scope():
+            self._channels = channels
+            self._in_channels = in_channels
+            self._groups = groups
+            offset_channels = 2 * kernel_size[0] * kernel_size[1] \
+                * num_deformable_group
+            geom = {"kernel": kernel_size, "stride": strides,
+                    "pad": padding, "dilate": dilation, "num_group": groups}
+            self._kwargs_offset = dict(geom, num_filter=offset_channels,
+                                       no_bias=not offset_use_bias)
+            self._kwargs_deform = dict(
+                geom, num_filter=channels,
+                num_deformable_group=num_deformable_group,
+                no_bias=not use_bias)
+
+            ic = in_channels // groups if in_channels else 0
+            self.offset_weight = self.params.get(
+                "offset_weight",
+                shape=(offset_channels, ic) + kernel_size,
+                init=offset_weight_initializer, allow_deferred_init=True)
+            self.offset_bias = self.params.get(
+                "offset_bias", shape=(offset_channels,),
+                init=offset_bias_initializer,
+                allow_deferred_init=True) if offset_use_bias else None
+            self.deformable_conv_weight = self.params.get(
+                "deformable_conv_weight",
+                shape=(channels, ic) + kernel_size,
+                init=weight_initializer, allow_deferred_init=True)
+            self.deformable_conv_bias = self.params.get(
+                "deformable_conv_bias", shape=(channels,),
+                init=bias_initializer,
+                allow_deferred_init=True) if use_bias else None
+            if activation is not None:
+                from ...nn.activations import Activation
+                self.act = Activation(activation, prefix=activation + "_")
+            else:
+                self.act = None
+
+    def infer_shape(self, x, *args):
+        ic = x.shape[1] // self._groups
+        k = self._kwargs_offset["kernel"]
+        self.offset_weight.shape = \
+            (self._kwargs_offset["num_filter"], ic) + k
+        self.deformable_conv_weight.shape = (self._channels, ic) + k
+
+    def hybrid_forward(self, F, x, offset_weight, deformable_conv_weight,
+                       offset_bias=None, deformable_conv_bias=None):
+        if offset_bias is None:
+            offset = F.Convolution(x, offset_weight,
+                                   **self._kwargs_offset)
+        else:
+            offset = F.Convolution(x, offset_weight, offset_bias,
+                                   **dict(self._kwargs_offset,
+                                          no_bias=False))
+        if deformable_conv_bias is None:
+            out = F.contrib.DeformableConvolution(
+                x, offset, deformable_conv_weight, **self._kwargs_deform)
+        else:
+            out = F.contrib.DeformableConvolution(
+                x, offset, deformable_conv_weight, deformable_conv_bias,
+                **dict(self._kwargs_deform, no_bias=False))
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        k = self._kwargs_deform
+        return (f"{type(self).__name__}({self._in_channels} -> "
+                f"{self._channels}, kernel_size={k['kernel']}, "
+                f"stride={k['stride']})")

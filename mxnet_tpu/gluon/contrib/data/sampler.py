@@ -1,0 +1,36 @@
+"""Interval sampler (reference
+``python/mxnet/gluon/contrib/data/sampler.py``)."""
+from __future__ import annotations
+
+from ...data import sampler
+
+__all__ = ["IntervalSampler"]
+
+
+class IntervalSampler(sampler.Sampler):
+    """Visit ``[0, length)`` with stride ``interval``; with ``rollover``
+    (default) the sweep restarts at 1, 2, … until every index is seen —
+    e.g. length=13, interval=3 → 0 3 6 9 12 1 4 7 10 2 5 8 11.  Without
+    rollover only the first stride-0 sweep is produced."""
+
+    def __init__(self, length, interval, rollover=True):
+        if interval > length:
+            raise AssertionError(
+                f"Interval {interval} must be smaller than or equal to "
+                f"length {length}")
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        starts = range(self._interval) if self._rollover else (0,)
+        for start in starts:
+            yield from range(start, self._length, self._interval)
+
+    def __len__(self):
+        # actual yield count (the reference returns length even with
+        # rollover=False, which overstates it by the skipped items and
+        # mis-sizes DataLoaders built on top — deliberate fix here)
+        if self._rollover:
+            return self._length
+        return -(-self._length // self._interval)

@@ -1,0 +1,143 @@
+"""WikiText language-modeling datasets (reference
+``python/mxnet/gluon/contrib/data/text.py:1``).
+
+Zero-egress environment: like the vision datasets, these load from
+``root`` when the token files (or the official zip archive) are already
+present and raise a clear error naming the expected layout otherwise.
+Samples are ``(data, label)`` windows of ``seq_len`` token indices with
+the label shifted one token ahead; ``<eos>`` closes every line and the
+vocabulary is built from the segment's token stream exactly as the
+reference does (``contrib.text`` counter → Vocabulary).
+"""
+from __future__ import annotations
+
+import io
+import os
+import zipfile
+
+import numpy as np
+
+from .... import ndarray as nd
+from ....contrib import text as _text
+from ...data import dataset
+
+__all__ = ["WikiText2", "WikiText103"]
+
+EOS_TOKEN = "<eos>"
+
+
+class _WikiText(dataset.Dataset):
+    """Shared loader: locate the segment's ``.tokens`` file under
+    ``root`` (extracting a locally-provided official zip if needed),
+    tokenise, index, and window into ``seq_len`` samples."""
+
+    #: subclasses: archive file name and {segment: token file name}
+    _archive_file = None
+    _data_files = None
+
+    def __init__(self, root, segment, vocab, seq_len):
+        if segment not in self._data_files:
+            raise ValueError(
+                f"segment must be one of {sorted(self._data_files)}, "
+                f"got {segment!r}")
+        self._root = os.path.expanduser(root)
+        self._segment = segment
+        self._seq_len = int(seq_len)
+        self._vocab = vocab
+        self._counter = None
+        os.makedirs(self._root, exist_ok=True)
+        self._load()
+
+    @property
+    def vocabulary(self):
+        return self._vocab
+
+    @property
+    def frequencies(self):
+        return self._counter
+
+    def _locate(self):
+        fname = self._data_files[self._segment]
+        path = os.path.join(self._root, fname)
+        if os.path.exists(path):
+            return path
+        # an official archive dropped into root out-of-band?
+        archive = os.path.join(self._root, self._archive_file)
+        if os.path.exists(archive):
+            import shutil
+            with zipfile.ZipFile(archive, "r") as zf:
+                for member in zf.namelist():
+                    base = os.path.basename(member)
+                    if base:
+                        with zf.open(member) as src, \
+                                open(os.path.join(self._root, base),
+                                     "wb") as dst:
+                            shutil.copyfileobj(src, dst)
+            if os.path.exists(path):
+                return path
+        raise OSError(
+            f"{type(self).__name__}: {fname!r} not found under "
+            f"{self._root!r}. This environment has no network access — "
+            f"place the token file (or the official {self._archive_file} "
+            "archive) there out of band.")
+
+    def _load(self):
+        with io.open(self._locate(), "r", encoding="utf8") as f:
+            content = f.read()
+        if self._counter is None:
+            self._counter = _text.utils.count_tokens_from_str(content)
+        if self._vocab is None:
+            self._vocab = _text.vocab.Vocabulary(
+                counter=self._counter, reserved_tokens=[EOS_TOKEN])
+        stream = []
+        for line in content.splitlines():
+            tokens = line.strip().split()
+            if tokens:
+                stream.extend(tokens)
+                stream.append(EOS_TOKEN)
+        indices = self._vocab.to_indices(stream)
+        data = np.asarray(indices[:-1], dtype=np.int32)
+        label = np.asarray(indices[1:], dtype=np.int32)
+        n = (len(data) // self._seq_len) * self._seq_len
+        self._data = nd.array(data[:n].reshape(-1, self._seq_len),
+                              dtype="int32")
+        self._label = nd.array(label[:n].reshape(-1, self._seq_len),
+                               dtype="int32")
+
+    def __getitem__(self, idx):
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+
+class WikiText2(_WikiText):
+    """WikiText-2 word-level LM dataset (Merity et al.; CC BY-SA).
+    Expects ``wiki.{train,valid,test}.tokens`` (or the official
+    ``wikitext-2-v1.zip``) under ``root``."""
+
+    _archive_file = "wikitext-2-v1.zip"
+    _data_files = {"train": "wiki.train.tokens",
+                   "validation": "wiki.valid.tokens",
+                   "test": "wiki.test.tokens"}
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "wikitext-2"),
+                 segment="train", vocab=None, seq_len=35):
+        super().__init__(root, segment, vocab, seq_len)
+
+
+class WikiText103(_WikiText):
+    """WikiText-103 word-level LM dataset (Merity et al.; CC BY-SA).
+    Expects ``wiki.{train,valid,test}.tokens`` (or the official
+    ``wikitext-103-v1.zip``) under ``root``."""
+
+    _archive_file = "wikitext-103-v1.zip"
+    _data_files = {"train": "wiki.train.tokens",
+                   "validation": "wiki.valid.tokens",
+                   "test": "wiki.test.tokens"}
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "wikitext-103"),
+                 segment="train", vocab=None, seq_len=35):
+        super().__init__(root, segment, vocab, seq_len)

@@ -369,24 +369,40 @@ def deformable_convolution(data, offset, weight, bias=None, kernel=None,
     oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
     ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
 
+    ndg = parse_int(num_deformable_group, 1)
+    g = parse_int(num_group, 1)
+    if c % ndg or c % g or nf % g:
+        raise ValueError(
+            "DeformableConvolution: channels %d / num_filter %d must be "
+            "divisible by num_deformable_group %d and num_group %d"
+            % (c, nf, ndg, g))
+    if offset.shape[1:] != (2 * ndg * kh * kw, oh, ow):
+        raise ValueError(
+            "DeformableConvolution: offset shape %s does not match "
+            "(N, 2*num_deformable_group*kh*kw=%d, out_h=%d, out_w=%d)"
+            % (offset.shape, 2 * ndg * kh * kw, oh, ow))
+
     padded = jnp.pad(data, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
     hh, ww = h + 2 * ph, w + 2 * pw
+    cpd = c // ndg                     # channels per deformable group
 
-    base_y = jnp.arange(oh, dtype=jnp.float32)[:, None] * sh
-    base_x = jnp.arange(ow, dtype=jnp.float32)[None, :] * sw
+    base_y = jnp.arange(oh, dtype=jnp.float32)[None, None, :, None] * sh
+    base_x = jnp.arange(ow, dtype=jnp.float32)[None, None, None, :] * sw
 
-    # offset channels interleave per tap: [dy0, dx0, dy1, dx1, ...]
-    # (reference deformable_im2col layout); one deformable group used
-    off = offset.reshape(n, -1, kh * kw, 2, oh, ow)[:, 0]
+    # offset channels: per deformable group, taps interleave
+    # [dy0, dx0, dy1, dx1, ...] (reference deformable_im2col layout);
+    # each group's offsets steer its own contiguous channel chunk
+    off = offset.reshape(n, ndg, kh * kw, 2, oh, ow)
+    padded_g = padded.reshape(n, ndg, cpd, hh * ww)
 
     cols = []
     for ki in range(kh):
         for kj in range(kw):
             t = ki * kw + kj
-            oy = off[:, t, 0]  # (N, oh, ow)
-            ox = off[:, t, 1]
-            gy = base_y[None] + ki * dh + oy
-            gx = base_x[None] + kj * dw + ox
+            oy = off[:, :, t, 0]  # (N, ndg, oh, ow)
+            ox = off[:, :, t, 1]
+            gy = base_y + ki * dh + oy
+            gx = base_x + kj * dw + ox
             y0 = jnp.floor(gy)
             x0 = jnp.floor(gx)
 
@@ -394,23 +410,25 @@ def deformable_convolution(data, offset, weight, bias=None, kernel=None,
                 inside = (yy >= 0) & (yy < hh) & (xx >= 0) & (xx < ww)
                 yc = jnp.clip(yy, 0, hh - 1).astype(jnp.int32)
                 xc = jnp.clip(xx, 0, ww - 1).astype(jnp.int32)
-                flat = padded.reshape(n, c, hh * ww)
-                idx = (yc * ww + xc).reshape(n, 1, -1)
-                vals = jnp.take_along_axis(flat, idx, axis=2)
-                return vals.reshape(n, c, oh, ow) * \
-                    inside[:, None].astype(data.dtype)
+                idx = (yc * ww + xc).reshape(n, ndg, 1, oh * ow)
+                vals = jnp.take_along_axis(padded_g, idx, axis=3)
+                vals = vals.reshape(n, ndg, cpd, oh, ow) * \
+                    inside[:, :, None].astype(data.dtype)
+                return vals
 
-            wx = (gx - x0)[:, None]
-            wy = (gy - y0)[:, None]
+            wx = (gx - x0)[:, :, None]
+            wy = (gy - y0)[:, :, None]
             tap = (gather(y0, x0) * (1 - wx) * (1 - wy) +
                    gather(y0, x0 + 1) * wx * (1 - wy) +
                    gather(y0 + 1, x0) * (1 - wx) * wy +
                    gather(y0 + 1, x0 + 1) * wx * wy)
-            cols.append(tap)
+            cols.append(tap.reshape(n, c, oh, ow))
     col = jnp.stack(cols, axis=2)  # (N, C, kh*kw, oh, ow)
-    col = col.reshape(n, c * kh * kw, oh * ow)
-    wmat = weight.reshape(nf, -1)  # (nf, C*kh*kw)
-    out = jnp.einsum("fk,nkp->nfp", wmat, col,
+    # grouped matmul: weight is (nf, C/g, kh, kw); group channels stay
+    # contiguous so both groupings reshape without permutes
+    col = col.reshape(n, g, (c // g) * kh * kw, oh * ow)
+    wmat = weight.reshape(g, nf // g, (c // g) * kh * kw)
+    out = jnp.einsum("gfk,ngkp->ngfp", wmat, col,
                      preferred_element_type=jnp.float32).astype(data.dtype)
     out = out.reshape(n, nf, oh, ow)
     if bias is not None and not parse_bool(no_bias):

@@ -250,10 +250,16 @@ def _rule_int8_deq_static(attrs, ins, outs, name):
 
 
 def _rule_int8_pool(attrs, ins, outs, name):
-    # max pooling preserves the input representation; avg emits f32
+    # max pooling preserves the input representation; avg accumulates in
+    # f32 and requantizes to int8 only when out_scale > 0 (int8_ops.py)
     if str(attrs.get("pool_type", "max")) == "max":
         return _unify(ins, outs, name, in_idx=(0,), out_idx=(0,))
-    return _assign(outs, 0, _F32, name)
+    try:
+        requant = float(attrs.get("out_scale", 0) or 0) > 0
+    except (TypeError, ValueError):
+        requant = False
+    return _assign(outs, 0,
+                   _np.dtype(_np.int8) if requant else _F32, name)
 
 
 def _rule_amp_multicast(attrs, ins, outs, name):

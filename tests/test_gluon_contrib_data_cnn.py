@@ -1,0 +1,275 @@
+"""gluon.contrib.data (WikiText2/103, IntervalSampler — reference
+``python/mxnet/gluon/contrib/data/{text,sampler}.py``) and
+gluon.contrib.cnn (DeformableConvolution layer — reference
+``python/mxnet/gluon/contrib/cnn/conv_layers.py:30``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.contrib.data import (IntervalSampler, WikiText2,
+                                          WikiText103)
+
+
+# ------------------------------------------------------------- sampler
+
+def test_interval_sampler_rollover():
+    """Doctest case from the reference sampler.py."""
+    assert list(IntervalSampler(13, interval=3)) == \
+        [0, 3, 6, 9, 12, 1, 4, 7, 10, 2, 5, 8, 11]
+
+
+def test_interval_sampler_no_rollover():
+    assert list(IntervalSampler(13, interval=3, rollover=False)) == \
+        [0, 3, 6, 9, 12]
+
+
+def test_interval_sampler_covers_all_and_len():
+    s = IntervalSampler(10, interval=4)
+    assert sorted(s) == list(range(10))
+    assert len(s) == 10
+    with pytest.raises(AssertionError):
+        IntervalSampler(3, interval=5)
+
+
+def test_interval_sampler_in_dataloader():
+    data = gluon.data.ArrayDataset(mx.nd.arange(12).reshape(12, 1))
+    loader = gluon.data.DataLoader(
+        data, batch_size=4, sampler=IntervalSampler(12, interval=3))
+    batches = [b.asnumpy().ravel().tolist() for b in loader]
+    assert batches[0] == [0.0, 3.0, 6.0, 9.0]
+    assert sorted(x for b in batches for x in b) == [float(i)
+                                                    for i in range(12)]
+
+
+# ---------------------------------------------------------------- text
+
+_TRAIN = """
+ = Heading =
+
+ the quick brown fox jumps over the lazy dog
+ the dog sleeps
+ a fox runs
+""".strip("\n")
+
+_VALID = " the fox sleeps\n the dog runs\n"
+
+
+@pytest.fixture()
+def wikitext_root(tmp_path):
+    (tmp_path / "wiki.train.tokens").write_text(_TRAIN, encoding="utf8")
+    (tmp_path / "wiki.valid.tokens").write_text(_VALID, encoding="utf8")
+    return str(tmp_path)
+
+
+def test_wikitext2_windows_and_vocab(wikitext_root):
+    ds = WikiText2(root=wikitext_root, segment="train", seq_len=5)
+    assert len(ds) >= 2
+    data, label = ds[0]
+    assert data.shape == (5,) and label.shape == (5,)
+    assert data.dtype == np.int32
+    # label is data shifted by one token
+    d_all = np.concatenate([ds[i][0].asnumpy() for i in range(len(ds))])
+    l_all = np.concatenate([ds[i][1].asnumpy() for i in range(len(ds))])
+    np.testing.assert_array_equal(d_all[1:], l_all[:-1])
+    # vocab: built with <eos> reserved, 'the' indexed, unknown at 0
+    vocab = ds.vocabulary
+    assert "<eos>" in vocab.token_to_idx
+    assert "the" in vocab.token_to_idx
+    assert ds.frequencies["the"] == 3
+    # every line break contributed an <eos>
+    eos = vocab.token_to_idx["<eos>"]
+    assert (np.concatenate([d_all, l_all[-1:]]) == eos).sum() >= 3
+
+
+def test_wikitext2_shared_vocab_across_segments(wikitext_root):
+    train = WikiText2(root=wikitext_root, segment="train", seq_len=4)
+    valid = WikiText2(root=wikitext_root, segment="validation",
+                      vocab=train.vocabulary, seq_len=4)
+    assert valid.vocabulary is train.vocabulary
+    tok = train.vocabulary.token_to_idx
+    d, _ = valid[0]
+    decoded = [train.vocabulary.idx_to_token[i]
+               for i in d.asnumpy().tolist()]
+    assert decoded[0] == "the" and tok["the"] == d.asnumpy()[0]
+
+
+def test_wikitext_missing_file_raises(tmp_path):
+    with pytest.raises(OSError, match="wiki.train.tokens"):
+        WikiText2(root=str(tmp_path), segment="train")
+    with pytest.raises(ValueError, match="segment"):
+        WikiText2(root=str(tmp_path), segment="dev")
+
+
+def test_wikitext103_reads_same_layout(wikitext_root):
+    ds = WikiText103(root=wikitext_root, segment="train", seq_len=3)
+    assert len(ds) >= 4
+    d, l = ds[1]
+    assert d.shape == (3,) and l.shape == (3,)
+
+
+def test_wikitext_dataloader_batches(wikitext_root):
+    ds = WikiText2(root=wikitext_root, segment="train", seq_len=4)
+    loader = gluon.data.DataLoader(ds, batch_size=2)
+    d, l = next(iter(loader))
+    assert d.shape == (2, 4) and l.shape == (2, 4)
+
+
+# -------------------------------------------------- DeformableConvolution
+
+def test_deformable_layer_zero_offset_matches_conv2d():
+    """Freshly-initialised offsets are zero, so the layer must equal an
+    ordinary Conv2D with the same weights."""
+    from mxnet_tpu.gluon.contrib.cnn import DeformableConvolution
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(2, 3, 8, 8).astype("float32"))
+
+    layer = DeformableConvolution(4, kernel_size=3, padding=1,
+                                  in_channels=3)
+    layer.initialize()
+    out = layer(x)
+    assert out.shape == (2, 4, 8, 8)
+
+    conv = gluon.nn.Conv2D(4, kernel_size=3, padding=1, in_channels=3)
+    conv.initialize()
+    conv.weight.set_data(layer.deformable_conv_weight.data())
+    conv.bias.set_data(layer.deformable_conv_bias.data())
+    np.testing.assert_allclose(out.asnumpy(), conv(x).asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_layer_matches_raw_op():
+    """Layer output == offset conv + raw op invocation."""
+    from mxnet_tpu.gluon.contrib.cnn import DeformableConvolution
+    rng = np.random.RandomState(1)
+    x = mx.nd.array(rng.randn(1, 2, 6, 6).astype("float32"))
+    layer = DeformableConvolution(3, kernel_size=3, padding=1,
+                                  in_channels=2)
+    layer.initialize()
+    # give the offset branch non-trivial weights
+    layer.offset_weight.set_data(mx.nd.array(
+        0.1 * rng.randn(*layer.offset_weight.shape).astype("float32")))
+    out = layer(x)
+
+    offset = mx.nd.Convolution(
+        x, layer.offset_weight.data(), layer.offset_bias.data(),
+        kernel=(3, 3), stride=(1, 1), pad=(1, 1), dilate=(1, 1),
+        num_filter=18, num_group=1)
+    ref = mx.nd.contrib.DeformableConvolution(
+        x, offset, layer.deformable_conv_weight.data(),
+        layer.deformable_conv_bias.data(), kernel=(3, 3), stride=(1, 1),
+        pad=(1, 1), dilate=(1, 1), num_filter=3, num_group=1,
+        num_deformable_group=1)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_deformable_layer_deferred_init_and_hybridize():
+    from mxnet_tpu.gluon.contrib.cnn import DeformableConvolution
+    rng = np.random.RandomState(2)
+    x = mx.nd.array(rng.randn(2, 5, 7, 7).astype("float32"))
+    layer = DeformableConvolution(4, kernel_size=3, padding=1)
+    layer.initialize()
+    eager = layer(x)                       # in_channels inferred = 5
+    assert layer.deformable_conv_weight.shape == (4, 5, 3, 3)
+    assert layer.offset_weight.shape == (18, 5, 3, 3)
+    layer.hybridize()
+    np.testing.assert_allclose(layer(x).asnumpy(), eager.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_deformable_layer_trains():
+    """Offsets receive gradients and a step changes the output."""
+    from mxnet_tpu.gluon.contrib.cnn import DeformableConvolution
+    from mxnet_tpu import autograd
+    rng = np.random.RandomState(3)
+    x = mx.nd.array(rng.randn(2, 3, 6, 6).astype("float32"))
+    layer = DeformableConvolution(2, kernel_size=3, padding=1,
+                                  in_channels=3,
+                                  offset_weight_initializer="uniform")
+    layer.initialize()
+    trainer = gluon.Trainer(layer.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    with autograd.record():
+        loss = (layer(x) ** 2).mean()
+    loss.backward()
+    g = layer.offset_weight.grad()
+    assert float(mx.nd.norm(g).asscalar()) > 0
+    before = layer(x).asnumpy()
+    trainer.step(1)
+    assert np.abs(layer(x).asnumpy() - before).max() > 0
+
+
+def test_deformable_layer_param_names_match_reference():
+    from mxnet_tpu.gluon.contrib.cnn import DeformableConvolution
+    layer = DeformableConvolution(2, kernel_size=1, in_channels=2)
+    names = sorted(p.split("_", 1)[1] if p.startswith("deformableconvolution")
+                   else p for p in layer.collect_params().keys())
+    joined = " ".join(names)
+    for want in ("offset_weight", "offset_bias", "deformable_conv_weight",
+                 "deformable_conv_bias"):
+        assert want in joined, (want, names)
+
+
+def test_interval_sampler_len_no_rollover():
+    """len() reports the actual yield count (fixes the reference's
+    overstated __len__ with rollover=False)."""
+    s = IntervalSampler(12, interval=3, rollover=False)
+    assert len(s) == len(list(s)) == 4
+    s13 = IntervalSampler(13, interval=3, rollover=False)
+    assert len(s13) == len(list(s13)) == 5
+
+
+def test_deformable_groups_and_offset_groups():
+    """num_deformable_group=2: each channel half follows its own offset
+    field; num_group=2: grouped weights work (op-level parity with the
+    reference's deformable_convolution.cc group handling)."""
+    rng = np.random.RandomState(5)
+    x = mx.nd.array(rng.randn(1, 4, 8, 8).astype("float32"))
+    w = mx.nd.array(np.zeros((4, 4, 1, 1), "float32"))
+    for i in range(4):
+        w[i, i, 0, 0] = 1.0                 # identity 1x1 conv
+    # group 0 offsets: zero; group 1 offsets: shift sampling down 1 row
+    offset = np.zeros((1, 2 * 2 * 1 * 1, 8, 8), "float32")
+    offset[:, 2] = 1.0                      # ndg=1 slot: dy of group 1
+    out = mx.nd.contrib.DeformableConvolution(
+        x, mx.nd.array(offset), w, kernel=(1, 1), num_filter=4,
+        num_deformable_group=2, no_bias=True)
+    xn = x.asnumpy()
+    # channels 0-1 unshifted, channels 2-3 sample one row down
+    np.testing.assert_allclose(out.asnumpy()[0, :2], xn[0, :2], atol=1e-5)
+    np.testing.assert_allclose(out.asnumpy()[0, 2:, :7], xn[0, 2:, 1:],
+                               atol=1e-5)
+
+    # grouped weights: 2 groups of 2-in/2-out == two independent convs
+    wg = mx.nd.array(rng.randn(4, 2, 3, 3).astype("float32"))
+    off0 = mx.nd.zeros((1, 18, 6, 6))
+    outg = mx.nd.contrib.DeformableConvolution(
+        x, off0, wg, kernel=(3, 3), num_filter=4, num_group=2,
+        no_bias=True)
+    refg = mx.nd.Convolution(x, wg, kernel=(3, 3), num_filter=4,
+                             num_group=2, no_bias=True)
+    np.testing.assert_allclose(outg.asnumpy(), refg.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deformable_layer_ndg2_trains_both_offset_groups():
+    """Layer with num_deformable_group=2: gradients reach the offsets of
+    BOTH groups (regression: group-1 offsets used to be ignored)."""
+    from mxnet_tpu.gluon.contrib.cnn import DeformableConvolution
+    from mxnet_tpu import autograd
+    rng = np.random.RandomState(6)
+    x = mx.nd.array(rng.randn(2, 4, 6, 6).astype("float32"))
+    layer = DeformableConvolution(4, kernel_size=3, padding=1,
+                                  in_channels=4, num_deformable_group=2,
+                                  offset_weight_initializer="uniform")
+    layer.initialize()
+    with autograd.record():
+        loss = (layer(x) ** 2).mean()
+    loss.backward()
+    g = layer.offset_weight.grad().asnumpy()     # (36, 4, 3, 3)
+    assert np.abs(g[:18]).max() > 0              # group 0
+    assert np.abs(g[18:]).max() > 0              # group 1

@@ -295,3 +295,17 @@ def test_moments_var_output_keeps_data_dtype():
     y = mx.sym.broadcast_add(m[1], z)
     arg_t, out_t, _ = y.infer_type(x="float16", z="float16")
     assert out_t[0] == np.float16
+
+
+def test_int8_pool_avg_requant_dtype():
+    """avg int8_pool emits int8 when out_scale>0, f32 otherwise — the
+    rule must match ops/int8_ops.py execution."""
+    d = mx.sym.Variable("d")
+    s1 = mx.sym.contrib.int8_pool(d, kernel=(2, 2), pool_type="avg",
+                                  in_scale=0.5, out_scale=2.0)
+    _, out_t, _ = s1.infer_type(d="int8")
+    assert out_t[0] == np.int8
+    s2 = mx.sym.contrib.int8_pool(d, kernel=(2, 2), pool_type="avg",
+                                  in_scale=0.5)
+    _, out_t, _ = s2.infer_type(d="int8")
+    assert out_t[0] == np.float32
