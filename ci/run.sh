@@ -69,6 +69,12 @@ stage_examples() {
   python example/numpy-ops/custom_softmax.py --epochs 5
   python example/amp/finetune_amp.py --epochs 3
   python example/autoencoder/denoising_ae.py --epochs 15
+  python example/neural-style/nstyle.py --iters 60
+  python example/nce-loss/wordvec.py --epochs 12
+  python example/ctc/lstm_ocr_train.py --epochs 10
+  python example/fcn-xs/fcn_xs.py --epochs 8
+  python example/recommenders/matrix_fact.py --epochs 15
+  python example/bi-lstm-sort/bi_lstm_sort.py --epochs 12
 }
 
 stage_bench() {

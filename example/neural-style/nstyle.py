@@ -1,0 +1,116 @@
+"""Neural style transfer (reference ``example/neural-style/nstyle.py``):
+optimize an IMAGE (not weights) so its conv features match a content
+image and its feature Gram matrices match a style image.
+
+TPU-native shape: the feature extractor is a fixed small conv stack, the
+whole content+style loss is differentiated through ``autograd`` w.r.t.
+the input pixels, and Adam updates the image directly.  Synthetic 64x64
+content/style images keep it network-free; the mechanism (image-variable
+optimization through conv features + Gram losses) is the reference's.
+"""
+import argparse
+import logging
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def make_extractor(ctx):
+    """3-block conv feature pyramid standing in for VGG19 relu1_1..relu3_1
+    (reference model_vgg19.py); weights are fixed — style transfer never
+    trains the extractor."""
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        for ch in (8, 16, 32):
+            net.add(gluon.nn.Conv2D(ch, kernel_size=3, padding=1,
+                                    activation="tanh"),
+                    gluon.nn.AvgPool2D(pool_size=2, strides=2))
+    net.initialize(mx.init.Xavier(magnitude=2.0), ctx=ctx)
+    net.hybridize()
+    for p in net.collect_params().values():
+        p.grad_req = "null"
+    return net
+
+
+def features(net, x):
+    """Per-block activations (taps after every pool)."""
+    taps = []
+    h = x
+    for i, blk in enumerate(net._children.values()):
+        h = blk(h)
+        if i % 2 == 1:           # after each pool
+            taps.append(h)
+    return taps
+
+
+def gram(f):
+    n, c, hh, ww = f.shape
+    flat = f.reshape(n, c, hh * ww)
+    return mx.nd.batch_dot(flat, flat, transpose_b=True) / (c * hh * ww)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=60)
+    ap.add_argument("--size", type=int, default=64)
+    ap.add_argument("--style-weight", type=float, default=50.0)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ctx = mx.gpu(0) if mx.context.num_gpus() else mx.cpu(0)
+    rng = np.random.RandomState(0)
+    s = args.size
+    # content: centered bright square; style: diagonal stripes
+    content = np.zeros((1, 3, s, s), "float32")
+    content[:, :, s // 4:3 * s // 4, s // 4:3 * s // 4] = 1.0
+    yy, xx = np.mgrid[0:s, 0:s]
+    style = np.tile(((yy + xx) // 4 % 2).astype("float32"), (1, 3, 1, 1))
+
+    net = make_extractor(ctx)
+    c_img = mx.nd.array(content, ctx=ctx)
+    s_img = mx.nd.array(style, ctx=ctx)
+    with autograd.pause():
+        c_feats = features(net, c_img)
+        s_grams = [gram(f) for f in features(net, s_img)]
+
+    img = mx.nd.array(content + 0.3 * rng.randn(*content.shape), ctx=ctx)
+    img.attach_grad()
+    trainer_state = [mx.nd.zeros_like(img), mx.nd.zeros_like(img)]
+    lr, b1, b2, eps = 0.05, 0.9, 0.999, 1e-8
+
+    first = None
+    loss_val = None
+    for it in range(1, args.iters + 1):
+        with autograd.record():
+            feats = features(net, img)
+            closs = sum(((f - cf) ** 2).mean()
+                        for f, cf in zip(feats, c_feats))
+            sloss = sum(((gram(f) - sg) ** 2).mean()
+                        for f, sg in zip(feats, s_grams))
+            loss = closs + args.style_weight * sloss
+        loss.backward()
+        g = img.grad
+        trainer_state[0][:] = b1 * trainer_state[0] + (1 - b1) * g
+        trainer_state[1][:] = b2 * trainer_state[1] + (1 - b2) * g * g
+        mhat = trainer_state[0] / (1 - b1 ** it)
+        vhat = trainer_state[1] / (1 - b2 ** it)
+        img[:] = img - lr * mhat / (mx.nd.sqrt(vhat) + eps)
+        loss_val = float(loss.asscalar())
+        first = first or loss_val
+        if it % 20 == 0:
+            logging.info("iter %d loss %.5f", it, loss_val)
+
+    assert loss_val < first * 0.5, (first, loss_val)
+    logging.info("neural-style converged: loss %.5f -> %.5f (%.1fx)",
+                 first, loss_val, first / loss_val)
+
+
+if __name__ == "__main__":
+    main()

@@ -10,6 +10,7 @@ per batch (the host→HBM staging role of the reference's pinned-memory path).
 """
 from .io import (  # noqa: F401
     DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter, PrefetchingIter,
+    DevicePrefetchIter,
 )
 from .iterators import (CSVIter, ImageDetRecordIter,  # noqa: F401
                         ImageRecordIter, LibSVMIter, MNISTIter)

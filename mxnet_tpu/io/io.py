@@ -10,7 +10,7 @@ from .. import ndarray as nd
 from ..ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter"]
+           "PrefetchingIter", "DevicePrefetchIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -386,3 +386,84 @@ class NDArrayIter(DataIter):
         if self.last_batch_handle == "roll_over" and self.cursor < 0:
             return -self.cursor
         return 0
+
+
+class DevicePrefetchIter:
+    """Double-buffered host→device staging (the ``iter_prefetcher.h`` role
+    extended across the PCIe/tunnel hop): a background thread pulls host
+    batches from ``data_iter`` and issues ``stage_fn`` (typically
+    ``jax.device_put`` onto the training sharding) one-ahead, so batch
+    N+1 transfers while the device steps batch N.  Exposed IO per step
+    drops from (stage + step) to max(0, stage − step).
+
+    ``stage_fn(batch) -> payload`` runs ON THE PREFETCH THREAD; the
+    iterator yields the staged payloads in order.  ``depth`` bounds the
+    number of in-flight staged batches (2 = classic double buffer).
+    """
+
+    _END = object()
+
+    def __init__(self, data_iter, stage_fn, depth=2):
+        import queue
+        self._it = data_iter
+        self._stage = stage_fn
+        self._q = queue.Queue(maxsize=max(1, int(depth)))
+        self._thread = None
+        self._stop = False
+        self._done = False        # epoch ended (or errored): next raises
+
+    def _worker(self):
+        try:
+            for batch in self._it:
+                if self._stop:
+                    return
+                self._q.put(self._stage(batch))
+                if self._stop:
+                    return
+            self._q.put(self._END)
+        except BaseException as e:          # surfaced on the consumer side
+            self._q.put(e)
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def reset(self):
+        old = self._thread
+        if old is not None and old.is_alive():
+            self._stop = True
+            try:
+                while True:
+                    self._q.get_nowait()
+            except Exception:
+                pass
+            old.join(timeout=30.0)
+            if old.is_alive():
+                # refuse to start a second reader over the same iterator
+                raise RuntimeError(
+                    "DevicePrefetchIter.reset: the staging thread is "
+                    "still inside stage_fn after 30s; cannot safely "
+                    "reset the underlying iterator")
+        self._stop = False
+        self._done = False
+        while not self._q.empty():
+            self._q.get_nowait()
+        self._it.reset()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def __next__(self):
+        if self._thread is None:
+            self.reset()
+        if self._done:
+            raise StopIteration
+        item = self._q.get()
+        if item is self._END:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._done = True
+            raise item
+        return item
+
+    next = __next__

@@ -792,7 +792,9 @@ def ones_like(other, **kwargs):
     return NDArray(jnp.ones_like(other._data))
 
 
-def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+def arange(start, stop=None, step=1.0, repeat=1, infer_range=False,
+           ctx=None, dtype=None):
+    # infer_range is the reference's deprecated no-op knob (arange.cc)
     arr = jnp.arange(start, stop, step, np_dtype(dtype))
     if repeat > 1:
         arr = jnp.repeat(arr, repeat)

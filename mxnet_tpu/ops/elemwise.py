@@ -28,7 +28,8 @@ def _unary(name, jfn, aliases=()):
 
 _unary("abs", jnp.abs)
 _unary("sign", jnp.sign)
-_unary("round", jnp.round)
+# MXNet round: ties away from zero (mshadow_op::round), NOT banker's
+_unary("round", lambda x: jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5))
 _unary("rint", jnp.rint)
 _unary("ceil", jnp.ceil)
 _unary("floor", jnp.floor)
@@ -66,8 +67,10 @@ _unary("softsign", jax.nn.soft_sign)
 _unary("relu", jax.nn.relu)
 _unary("erf", jax.scipy.special.erf)
 _unary("erfinv", jax.scipy.special.erfinv)
-_unary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
 _unary("gammaln", jax.scipy.special.gammaln)
+# the Γ function itself (reference elemwise_unary_op_basic.cc:1290 —
+# distinct from the _random_gamma sampler; true Γ, not exp(lnΓ) = |Γ|)
+_unary("gamma", jax.scipy.special.gamma)
 _unary("logical_not", lambda x: (x == 0).astype(x.dtype))
 _unary("isnan", jnp.isnan)
 _unary("isinf", jnp.isinf)
@@ -310,8 +313,13 @@ def amp_multicast(*args, num_outputs=None, cast_narrow=False):
 
 @register("where")
 def where(condition, x, y):
-    """Reference ``where`` (src/operator/tensor/control_flow_op.cc)."""
-    return jnp.where(condition.astype(bool), x, y)
+    """Reference ``where`` (src/operator/tensor/control_flow_op.cc):
+    elementwise select, or — when ``condition`` is 1-D and x/y are not —
+    per-row select along the first axis."""
+    cond = condition.astype(bool)
+    if cond.ndim == 1 and x.ndim > 1:
+        cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(cond, x, y)
 
 
 @register("zeros_like")

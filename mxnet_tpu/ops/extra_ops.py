@@ -228,15 +228,27 @@ def upsampling(*args, scale=1, sample_type="nearest", num_args=1,
     data = args[0]
     n, c, h, w = data.shape
     th, tw = h * s, w * s
+    if str(sample_type) == "bilinear" and len(args) == 1:
+        # convenience extension: no filter given — plain bilinear resize
+        return jax.image.resize(data.astype(jnp.float32),
+                                (n, c, th, tw),
+                                method="bilinear").astype(data.dtype)
+    if str(sample_type) == "bilinear":
+        # reference upsampling.cc bilinear mode: exactly (data, weight),
+        # computed as a grouped Deconvolution with kernel 2s - s%2,
+        # stride s, pad ceil((s-1)/2) — the learned-filter contract
+        weight = args[1]
+        from .nn import deconvolution
+        k = 2 * s - s % 2
+        p = -(-(s - 1) // 2)            # ceil((s-1)/2)
+        return deconvolution(
+            data, weight, kernel=(k, k), stride=(s, s),
+            pad=(p, p), adj=(s % 2, s % 2), num_filter=c, num_group=c,
+            no_bias=True)
     outs = []
     for x in args:
-        if str(sample_type) == "nearest":
-            out = jnp.repeat(jnp.repeat(x, th // x.shape[2], axis=2),
-                             tw // x.shape[3], axis=3)
-        else:
-            out = jax.image.resize(x.astype(jnp.float32),
-                                   (x.shape[0], x.shape[1], th, tw),
-                                   method="bilinear").astype(x.dtype)
+        out = jnp.repeat(jnp.repeat(x, th // x.shape[2], axis=2),
+                         tw // x.shape[3], axis=3)
         outs.append(out)
     if len(outs) == 1:
         return outs[0]

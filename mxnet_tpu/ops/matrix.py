@@ -26,7 +26,10 @@ def reshape(data, shape=None, reverse=False, target_shape=None, keep_highest=Fal
     """Reference ``Reshape`` (matrix_op.cc) incl. the special codes:
     0 (copy dim), -1 (infer), -2 (copy rest), -3 (merge two), -4 (split)."""
     if target_shape is not None and shape is None:
-        shape = target_shape
+        # legacy target_shape API: 0 entries mean "infer", not "copy"
+        # (reference matrix_op-inl.h ReshapeParam::target_shape)
+        shape = tuple(-1 if int(v) == 0 else int(v)
+                      for v in parse_tuple(target_shape))
     shape = parse_tuple(shape)
     src = list(data.shape)
     if parse_bool(reverse):
@@ -64,7 +67,7 @@ def reshape(data, shape=None, reverse=False, target_shape=None, keep_highest=Fal
 
 @register("reshape_like")
 def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None, rhs_end=None):
-    if lhs_begin is None and rhs_begin is None:
+    if all(v is None for v in (lhs_begin, lhs_end, rhs_begin, rhs_end)):
         return jnp.reshape(lhs, rhs.shape)
     lb = parse_int(lhs_begin, 0) or 0
     le = parse_int(lhs_end, lhs.ndim)
@@ -206,8 +209,18 @@ def split(data, num_outputs=1, axis=1, squeeze_axis=False):
 
 
 @register("split_v2")
-def split_v2(data, indices=None, axis=1, squeeze_axis=False, sections=0):
-    ax = parse_int(axis, 1)
+def split_v2(data, indices_or_sections=None, axis=0, squeeze_axis=False,
+             sections=0, indices=None):
+    """Reference ``split_v2`` (python/mxnet/ndarray/ndarray.py): an int
+    splits into that many equal sections, a tuple gives split points.
+    The ``sections``/``indices`` kwargs are the raw op-attr spelling."""
+    ax = parse_int(axis, 0)
+    if indices_or_sections is not None:
+        if isinstance(indices_or_sections, (int, float, str)) and \
+                str(indices_or_sections).lstrip("-").isdigit():
+            sections = int(indices_or_sections)
+        else:
+            indices = indices_or_sections
     sections = parse_int(sections, 0)
     if sections:
         parts = jnp.split(data, sections, axis=ax)

@@ -64,7 +64,7 @@ def random_normal(key, loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None):
     return jax.random.normal(key, shape, dt) * parse_float(scale, 1.0) + parse_float(loc, 0.0)
 
 
-@_register_random("_random_gamma", aliases=("gamma", "random_gamma"))
+@_register_random("_random_gamma", aliases=("random_gamma",))
 def random_gamma(key, alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None):
     shape, dt = _shape_dtype(shape, dtype)
     _require_positive("alpha", parse_float(alpha, 1.0))

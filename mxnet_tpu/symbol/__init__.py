@@ -85,6 +85,10 @@ def pow(base, exp):
     return base ** exp
 
 
+# reference symbol.py:2806 registers ``power`` as the same function
+power = pow
+
+
 def hypot(left, right):
     """sqrt(left² + right²) of Symbols/scalars (reference ``symbol.py
     hypot``)."""

@@ -440,3 +440,76 @@ def test_compose_in_dataloader_pipeline():
     batches = list(loader)
     assert batches[0][0].shape == (4, 3, 8, 8)
     np.testing.assert_allclose(batches[0][1].asnumpy(), [0, 1, 2, 3])
+
+
+def test_device_prefetch_iter_orders_and_overlaps():
+    """DevicePrefetchIter: staged payloads arrive in order, one-ahead, and
+    reset() restarts cleanly (reference src/io/iter_prefetcher.h role)."""
+    import threading as _threading
+    import time as _time
+    from mxnet_tpu.io import DevicePrefetchIter
+
+    x = np.arange(40, dtype="float32").reshape(10, 4)
+    y = np.arange(10, dtype="float32")
+    it = mx.io.NDArrayIter(x, y, batch_size=2)
+    staged_on = []
+
+    def stage(b):
+        staged_on.append(_threading.current_thread().name)
+        return b.data[0].asnumpy(), b.label[0].asnumpy()
+
+    pit = DevicePrefetchIter(it, stage, depth=2)
+    seen = []
+    for xb, yb in pit:
+        seen.append(yb.tolist())
+        _time.sleep(0.01)        # consumer slower than stager => overlap
+    assert seen == [[0, 1], [2, 3], [4, 5], [6, 7], [8, 9]]
+    assert all(n != _threading.main_thread().name for n in staged_on)
+    # epoch 2 after implicit reset via __iter__
+    seen2 = [yb.tolist() for _, yb in pit]
+    assert seen2 == seen
+
+
+def test_device_prefetch_iter_propagates_errors():
+    from mxnet_tpu.io import DevicePrefetchIter
+    it = mx.io.NDArrayIter(np.zeros((4, 2), "float32"),
+                           np.zeros(4, "float32"), batch_size=2)
+
+    def bad_stage(b):
+        raise RuntimeError("stage boom")
+
+    pit = DevicePrefetchIter(it, bad_stage)
+    with pytest.raises(RuntimeError, match="stage boom"):
+        next(iter(pit))
+
+
+def test_device_prefetch_iter_mid_epoch_reset():
+    from mxnet_tpu.io import DevicePrefetchIter
+    x = np.arange(24, dtype="float32").reshape(12, 2)
+    it = mx.io.NDArrayIter(x, np.arange(12, dtype="float32"), batch_size=3)
+    pit = DevicePrefetchIter(it, lambda b: b.label[0].asnumpy(), depth=1)
+    first = next(iter(pit))
+    assert first.tolist() == [0, 1, 2]
+    pit.reset()
+    again = next(pit)
+    assert again.tolist() == [0, 1, 2]
+
+
+def test_device_prefetch_iter_exhaustion_reraises():
+    """After an epoch ends (or errors), further next() calls keep raising
+    instead of deadlocking on the empty queue."""
+    from mxnet_tpu.io import DevicePrefetchIter
+    it = mx.io.NDArrayIter(np.zeros((4, 2), "float32"),
+                           np.zeros(4, "float32"), batch_size=2)
+    pit = DevicePrefetchIter(it, lambda b: b.label[0].asnumpy())
+    list(pit)
+    with pytest.raises(StopIteration):
+        next(pit)
+    assert next(iter([]), "sentinel") == "sentinel"   # contract shape
+    assert next(pit, "default") == "default"          # no deadlock
+    # error path: exhausted-by-error also keeps raising
+    pit2 = DevicePrefetchIter(it, lambda b: 1 / 0)
+    with pytest.raises(ZeroDivisionError):
+        next(iter(pit2))
+    with pytest.raises(StopIteration):
+        next(pit2)

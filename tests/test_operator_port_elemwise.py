@@ -1,0 +1,606 @@
+"""Reference test_operator.py port, tranche 1: elementwise, scalar,
+logic, and math-function cases.  Test names mirror the reference's
+(tests/python/unittest/test_operator.py) one-for-one so the PARITY
+inventory maps directly; bodies are written against this framework's API
+and NumPy, not copied.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+_rng = np.random.RandomState
+
+
+def _bind_grad(sym, **arrays):
+    """Forward + ones-backward through the symbolic executor; returns
+    (outputs, grads dict)."""
+    args = {k: nd.array(v) for k, v in arrays.items()}
+    grads = {k: nd.zeros(v.shape) for k, v in arrays.items()}
+    exe = sym.bind(mx.cpu(), args, args_grad=grads)
+    out = exe.forward(is_train=True)
+    exe.backward(nd.ones(out[0].shape))
+    return [o.asnumpy() for o in out], {k: g.asnumpy()
+                                        for k, g in grads.items()}
+
+
+def test_elementwise_sum():
+    rng = _rng(0)
+    for n in (1, 2, 4):
+        arrays = {f"a{i}": rng.randn(3, 4).astype("float32")
+                  for i in range(n)}
+        sym = mx.sym.ElementWiseSum(*[mx.sym.Variable(f"a{i}")
+                                      for i in range(n)], name="esum")
+        out, grads = _bind_grad(sym, **arrays)
+        assert_almost_equal(out[0], sum(arrays.values()))
+        for g in grads.values():
+            assert_almost_equal(g, np.ones((3, 4)))
+
+
+def test_concat():
+    rng = _rng(1)
+    for axis in (0, 1, 2):
+        parts = [rng.randn(2, 3, 4).astype("float32") for _ in range(3)]
+        sym = mx.sym.Concat(*[mx.sym.Variable(f"p{i}") for i in range(3)],
+                            dim=axis)
+        out, grads = _bind_grad(sym, **{f"p{i}": p
+                                        for i, p in enumerate(parts)})
+        assert_almost_equal(out[0], np.concatenate(parts, axis=axis))
+        for i in range(3):
+            assert_almost_equal(grads[f"p{i}"], np.ones((2, 3, 4)))
+
+
+def test_slice_channel():
+    rng = _rng(2)
+    x = rng.randn(2, 6, 3).astype("float32")
+    outs = nd.SliceChannel(nd.array(x), num_outputs=3, axis=1)
+    for i, o in enumerate(outs):
+        assert_almost_equal(o.asnumpy(), x[:, 2 * i:2 * i + 2, :])
+    # squeeze_axis collapses the unit axis
+    outs = nd.SliceChannel(nd.array(x), num_outputs=6, axis=1,
+                           squeeze_axis=True)
+    assert outs[0].shape == (2, 3)
+
+
+def test_swapaxes():
+    rng = _rng(3)
+    x = rng.randn(2, 3, 4).astype("float32")
+    assert_almost_equal(nd.SwapAxis(nd.array(x), dim1=0, dim2=2).asnumpy(),
+                        np.swapaxes(x, 0, 2))
+
+
+def test_scalarop():
+    x = _rng(4).randn(3, 4).astype("float32")
+    a = nd.array(x)
+    assert_almost_equal(((((4 - a) * 2.5) / 0.8) - 1.5).asnumpy(),
+                        (4 - x) * 2.5 / 0.8 - 1.5, rtol=1e-5)
+    # reverse subtraction / division
+    assert_almost_equal((5.0 - a).asnumpy(), 5.0 - x)
+    assert_almost_equal((2.0 / (a + 3)).asnumpy(), 2.0 / (x + 3),
+                        rtol=1e-5)
+
+
+def test_scalar_pow():
+    x = np.abs(_rng(5).randn(3, 4)).astype("float32") + 0.5
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = a ** 3
+    y.backward()
+    assert_almost_equal(y.asnumpy(), x ** 3, rtol=1e-5)
+    assert_almost_equal(a.grad.asnumpy(), 3 * x ** 2, rtol=1e-4)
+
+
+def test_symbol_pow():
+    rng = _rng(6)
+    x = np.abs(rng.randn(2, 3)).astype("float32") + 0.5
+    y = rng.rand(2, 3).astype("float32") + 0.5
+    sym = mx.sym.Variable("x") ** mx.sym.Variable("y")
+    out, grads = _bind_grad(sym, x=x, y=y)
+    assert_almost_equal(out[0], x ** y, rtol=1e-5)
+    assert_almost_equal(grads["x"], y * x ** (y - 1), rtol=1e-4)
+    assert_almost_equal(grads["y"], x ** y * np.log(x), rtol=1e-4)
+
+
+def test_pow_fn():
+    x = _rng(7).rand(3, 3).astype("float32") + 0.5
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.power(2.0, a)
+    y.backward()
+    assert_almost_equal(y.asnumpy(), 2 ** x, rtol=1e-5)
+    assert_almost_equal(a.grad.asnumpy(), np.log(2) * 2 ** x, rtol=1e-4)
+
+
+def test_relu():
+    x = _rng(8).randn(3, 4).astype("float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.relu(a)
+    y.backward()
+    assert_almost_equal(y.asnumpy(), np.maximum(x, 0))
+    assert_almost_equal(a.grad.asnumpy(), (x > 0).astype("float32"))
+
+
+def test_leaky_relu():
+    x = _rng(9).randn(3, 4).astype("float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.LeakyReLU(a, act_type="leaky", slope=0.25)
+    y.backward()
+    assert_almost_equal(y.asnumpy(), np.where(x > 0, x, 0.25 * x))
+    assert_almost_equal(a.grad.asnumpy(),
+                        np.where(x > 0, 1.0, 0.25).astype("float32"))
+
+
+def test_prelu():
+    rng = _rng(10)
+    x = rng.randn(2, 4, 3, 3).astype("float32")
+    gamma = rng.rand(4).astype("float32") * 0.5
+    sym = mx.sym.LeakyReLU(mx.sym.Variable("x"), mx.sym.Variable("gamma"),
+                           act_type="prelu")
+    out, grads = _bind_grad(sym, x=x, gamma=gamma)
+    g = gamma.reshape(1, 4, 1, 1)
+    assert_almost_equal(out[0], np.where(x > 0, x, g * x), rtol=1e-5)
+    assert_almost_equal(grads["x"],
+                        np.where(x > 0, 1.0, np.broadcast_to(g, x.shape)),
+                        rtol=1e-5)
+    assert_almost_equal(grads["gamma"],
+                        np.where(x > 0, 0, x).sum(axis=(0, 2, 3)),
+                        rtol=1e-4)
+
+
+def test_selu():
+    x = _rng(11).randn(4, 5).astype("float32")
+    alpha, scale = 1.6732632423543772, 1.0507009873554805
+    out = nd.LeakyReLU(nd.array(x), act_type="selu")
+    ref = scale * np.where(x > 0, x, alpha * np.expm1(x))
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_gelu():
+    x = _rng(12).randn(4, 5).astype("float32")
+    out = nd.LeakyReLU(nd.array(x), act_type="gelu")
+    ref = 0.5 * x * (1 + np.vectorize(np.math.erf)(x / np.sqrt(2))) \
+        if hasattr(np, "math") else None
+    import math
+    ref = 0.5 * x * (1 + np.array([math.erf(v / math.sqrt(2))
+                                   for v in x.ravel()])
+                     .reshape(x.shape).astype("float32"))
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sigmoid():
+    x = _rng(13).randn(3, 4).astype("float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.sigmoid(a)
+    y.backward()
+    s = 1 / (1 + np.exp(-x))
+    assert_almost_equal(y.asnumpy(), s, rtol=1e-5)
+    assert_almost_equal(a.grad.asnumpy(), s * (1 - s), rtol=1e-4)
+
+
+def test_shape_array():
+    x = nd.zeros((3, 4, 5))
+    assert nd.shape_array(x).asnumpy().tolist() == [3, 4, 5]
+
+
+def test_size_array():
+    x = nd.zeros((3, 4, 5))
+    assert int(nd.size_array(x).asnumpy()) == 60
+
+
+def test_hard_sigmoid():
+    x = _rng(14).randn(3, 4).astype("float32") * 3
+    out = nd.hard_sigmoid(nd.array(x), alpha=0.2, beta=0.5)
+    assert_almost_equal(out.asnumpy(), np.clip(0.2 * x + 0.5, 0, 1),
+                        rtol=1e-5)
+
+
+def test_softsign():
+    x = _rng(15).randn(3, 4).astype("float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.softsign(a)
+    y.backward()
+    assert_almost_equal(y.asnumpy(), x / (1 + np.abs(x)), rtol=1e-5)
+    assert_almost_equal(a.grad.asnumpy(), 1 / (1 + np.abs(x)) ** 2,
+                        rtol=1e-4)
+
+
+def test_binary_logic():
+    rng = _rng(16)
+    x = rng.randint(0, 3, (4, 4)).astype("float32")
+    y = rng.randint(0, 3, (4, 4)).astype("float32")
+    a, b = nd.array(x), nd.array(y)
+    for op, ref in [(nd.broadcast_equal, x == y),
+                    (nd.broadcast_not_equal, x != y),
+                    (nd.broadcast_greater, x > y),
+                    (nd.broadcast_greater_equal, x >= y),
+                    (nd.broadcast_lesser, x < y),
+                    (nd.broadcast_lesser_equal, x <= y),
+                    (nd.broadcast_logical_and, (x != 0) & (y != 0)),
+                    (nd.broadcast_logical_or, (x != 0) | (y != 0)),
+                    (nd.broadcast_logical_xor, (x != 0) ^ (y != 0))]:
+        assert_almost_equal(op(a, b).asnumpy(), ref.astype("float32"))
+    # broadcasting across a unit axis
+    z = rng.randint(0, 3, (1, 4)).astype("float32")
+    assert_almost_equal(nd.broadcast_greater(a, nd.array(z)).asnumpy(),
+                        (x > z).astype("float32"))
+
+
+def test_unary_logic():
+    x = np.array([[0.0, 1.5], [-2.0, 0.0]], "float32")
+    assert_almost_equal(nd.logical_not(nd.array(x)).asnumpy(),
+                        (x == 0).astype("float32"))
+
+
+def test_binary_op_duplicate_input():
+    x = _rng(17).randn(3, 4).astype("float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = a * a
+    y.backward()
+    assert_almost_equal(y.asnumpy(), x * x, rtol=1e-5)
+    assert_almost_equal(a.grad.asnumpy(), 2 * x, rtol=1e-5)
+
+
+def test_sign():
+    x = np.array([[-2.0, 0.0, 3.5]], "float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.sign(a)
+    y.backward()
+    assert_almost_equal(y.asnumpy(), np.sign(x))
+    assert_almost_equal(a.grad.asnumpy(), np.zeros_like(x))
+
+
+def test_round_ceil_floor():
+    x = np.array([[-2.1, -0.5, 0.0, 0.5, 1.9, 2.5]], "float32")
+    assert_almost_equal(nd.ceil(nd.array(x)).asnumpy(), np.ceil(x))
+    assert_almost_equal(nd.floor(nd.array(x)).asnumpy(), np.floor(x))
+    # MXNet round: half away from zero
+    assert_almost_equal(nd.round(nd.array(x)).asnumpy(),
+                        np.sign(x) * np.floor(np.abs(x) + 0.5))
+    assert_almost_equal(nd.rint(nd.array(x)).asnumpy(), np.rint(x))
+    assert_almost_equal(nd.fix(nd.array(x)).asnumpy(), np.fix(x))
+
+
+def test_trunc():
+    x = np.array([[-2.7, -0.2, 0.9, 3.6]], "float32")
+    assert_almost_equal(nd.trunc(nd.array(x)).asnumpy(), np.trunc(x))
+
+
+def test_rsqrt_cos_sin():
+    x = _rng(18).rand(3, 4).astype("float32") + 0.5
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.rsqrt(a) + nd.cos(a) * nd.sin(a)
+    y.backward()
+    ref = 1 / np.sqrt(x) + np.cos(x) * np.sin(x)
+    dref = -0.5 * x ** -1.5 + np.cos(2 * x)
+    assert_almost_equal(y.asnumpy(), ref, rtol=1e-5)
+    assert_almost_equal(a.grad.asnumpy(), dref, rtol=1e-4, atol=1e-5)
+
+
+def test_maximum_minimum():
+    rng = _rng(19)
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(3, 4).astype("float32")
+    a, b = nd.array(x), nd.array(y)
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        out = nd.maximum(a, b) + nd.minimum(a, b)
+    out.backward()
+    assert_almost_equal(out.asnumpy(), np.maximum(x, y) + np.minimum(x, y),
+                        rtol=1e-5)
+    assert_almost_equal(a.grad.asnumpy(), np.ones_like(x))
+    assert_almost_equal(b.grad.asnumpy(), np.ones_like(y))
+
+
+def test_maximum_minimum_scalar():
+    x = _rng(20).randn(3, 4).astype("float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        out = nd.maximum(a, 0.3) + nd.minimum(a, 0.7)
+    out.backward()
+    assert_almost_equal(out.asnumpy(),
+                        np.maximum(x, 0.3) + np.minimum(x, 0.7), rtol=1e-5)
+    assert_almost_equal(a.grad.asnumpy(),
+                        (x > 0.3).astype("float32")
+                        + (x < 0.7).astype("float32"))
+
+
+def test_abs():
+    x = _rng(21).randn(3, 4).astype("float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.abs(a)
+    y.backward()
+    assert_almost_equal(y.asnumpy(), np.abs(x))
+    assert_almost_equal(a.grad.asnumpy(), np.sign(x))
+
+
+@pytest.mark.parametrize("op,ref,dref", [
+    ("reciprocal", lambda x: 1 / x, lambda x: -1 / x ** 2),
+    ("cbrt", lambda x: np.cbrt(x), lambda x: 1 / (3 * np.cbrt(x) ** 2)),
+    ("rcbrt", lambda x: 1 / np.cbrt(x),
+     lambda x: -1 / (3 * x * np.cbrt(x))),
+])
+def test_reciprocal_cbrt_rcbrt_op(op, ref, dref):
+    """reference test_reciprocal_op / test_cbrt_op / test_rcbrt_op."""
+    x = _rng(22).rand(3, 4).astype("float32") + 0.5
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = getattr(nd, op)(a)
+    y.backward()
+    assert_almost_equal(y.asnumpy(), ref(x), rtol=1e-5)
+    assert_almost_equal(a.grad.asnumpy(), dref(x), rtol=1e-3, atol=1e-5)
+
+
+def test_special_functions_using_scipy():
+    try:
+        from scipy import special as scipy_special
+    except ImportError:
+        pytest.skip("no scipy")
+    x = _rng(23).rand(3, 4).astype("float32") + 0.3
+    a = nd.array(x)
+    assert_almost_equal(nd.gamma(a).asnumpy(), scipy_special.gamma(x),
+                        rtol=1e-4)
+    assert_almost_equal(nd.gammaln(a).asnumpy(),
+                        scipy_special.gammaln(x), rtol=1e-4)
+    assert_almost_equal(nd.erf(a).asnumpy(), scipy_special.erf(x),
+                        rtol=1e-4)
+    z = (x - 0.8) * 0.9                 # inside erfinv's (-1, 1) domain
+    assert_almost_equal(nd.erfinv(nd.array(z)).asnumpy(),
+                        scipy_special.erfinv(z), rtol=1e-3, atol=1e-5)
+
+
+def test_mathematical():
+    """The reference's big table of unary math ops, fwd + bwd."""
+    rng = _rng(24)
+    x01 = rng.rand(3, 4).astype("float32") * 0.8 + 0.1     # (0, 1)
+    xpos = rng.rand(3, 4).astype("float32") + 0.5
+    xany = rng.randn(3, 4).astype("float32")
+    cases = [
+        ("log", xpos, np.log, lambda x: 1 / x),
+        ("log2", xpos, np.log2, lambda x: 1 / (x * np.log(2))),
+        ("log10", xpos, np.log10, lambda x: 1 / (x * np.log(10))),
+        ("log1p", xpos, np.log1p, lambda x: 1 / (1 + x)),
+        ("exp", xany, np.exp, np.exp),
+        ("expm1", xany, np.expm1, np.exp),
+        ("sqrt", xpos, np.sqrt, lambda x: 0.5 / np.sqrt(x)),
+        ("square", xany, np.square, lambda x: 2 * x),
+        ("sin", xany, np.sin, np.cos),
+        ("cos", xany, np.cos, lambda x: -np.sin(x)),
+        ("tan", x01, np.tan, lambda x: 1 / np.cos(x) ** 2),
+        ("arcsin", x01, np.arcsin, lambda x: 1 / np.sqrt(1 - x ** 2)),
+        ("arccos", x01, np.arccos, lambda x: -1 / np.sqrt(1 - x ** 2)),
+        ("arctan", xany, np.arctan, lambda x: 1 / (1 + x ** 2)),
+        ("sinh", xany, np.sinh, np.cosh),
+        ("cosh", xany, np.cosh, np.sinh),
+        ("tanh", xany, np.tanh, lambda x: 1 - np.tanh(x) ** 2),
+        ("arcsinh", xany, np.arcsinh, lambda x: 1 / np.sqrt(x ** 2 + 1)),
+        ("arccosh", xpos + 1, np.arccosh,
+         lambda x: 1 / np.sqrt(x ** 2 - 1)),
+        ("arctanh", x01 * 0.8, np.arctanh, lambda x: 1 / (1 - x ** 2)),
+        ("degrees", xany, np.degrees, lambda x: np.full_like(x, 180 / np.pi)),
+        ("radians", xany, np.radians, lambda x: np.full_like(x, np.pi / 180)),
+    ]
+    for name, x, f, df in cases:
+        a = nd.array(x)
+        a.attach_grad()
+        with autograd.record():
+            y = getattr(nd, name)(a)
+        y.backward()
+        assert_almost_equal(y.asnumpy(), f(x), rtol=1e-4, atol=1e-5)
+        assert_almost_equal(a.grad.asnumpy(), df(x), rtol=1e-3, atol=1e-4)
+
+
+def test_clip():
+    x = _rng(25).randn(3, 4).astype("float32") * 3
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.clip(a, -1.0, 1.0)
+    y.backward()
+    assert_almost_equal(y.asnumpy(), np.clip(x, -1, 1))
+    assert_almost_equal(a.grad.asnumpy(),
+                        ((x >= -1) & (x <= 1)).astype("float32"))
+
+
+def test_unary_math_operators():
+    """reference test_unary_math_operators: numeric-gradient pass over a
+    sample of unary ops through the symbolic executor."""
+    x = _rng(26).rand(3, 3).astype("float32") * 0.5 + 0.25
+    for name in ("sqrt", "log", "sigmoid", "tanh", "arctan"):
+        sym = getattr(mx.sym, name)(mx.sym.Variable("x"))
+        check_numeric_gradient(sym, {"x": nd.array(x)}, rtol=0.05,
+                               atol=1e-3)
+
+
+def test_binary_math_operators():
+    rng = _rng(27)
+    x = rng.rand(3, 3).astype("float32") + 0.5
+    y = rng.rand(3, 3).astype("float32") + 0.5
+    for maker in (lambda a, b: mx.sym.hypot(a, b),
+                  lambda a, b: a * b + b,
+                  lambda a, b: mx.sym.pow(a, b)):
+        sym = maker(mx.sym.Variable("x"), mx.sym.Variable("y"))
+        check_numeric_gradient(sym, {"x": nd.array(x), "y": nd.array(y)},
+                               rtol=0.05, atol=1e-3)
+
+
+@pytest.mark.parametrize("op,npop", [
+    ("broadcast_add", np.add), ("broadcast_sub", np.subtract),
+    ("broadcast_mul", np.multiply), ("broadcast_div", np.divide),
+    ("broadcast_mod", np.mod), ("broadcast_power", np.power),
+    ("broadcast_maximum", np.maximum), ("broadcast_minimum", np.minimum),
+    ("broadcast_hypot", np.hypot),
+])
+def test_broadcast_binary_op(op, npop):
+    """reference test_broadcast_binary_op (bplus/bminus/.../bxor)."""
+    rng = _rng(28)
+    x = rng.rand(2, 3, 4).astype("float32") + 1.0
+    for yshape in ((2, 3, 4), (1, 3, 4), (2, 1, 4), (2, 3, 1), (1, 1, 1)):
+        y = rng.rand(*yshape).astype("float32") + 1.0
+        got = getattr(nd, op)(nd.array(x), nd.array(y)).asnumpy()
+        assert_almost_equal(got, npop(x, y).astype("float32"), rtol=1e-4)
+
+
+@pytest.mark.parametrize("op,npop", [
+    ("__add__", np.add), ("__sub__", np.subtract),
+    ("__mul__", np.multiply), ("__truediv__", np.divide),
+    ("__mod__", np.mod), ("__pow__", np.power),
+    ("__ne__", np.not_equal), ("__eq__", np.equal),
+])
+def test_binary_op(op, npop):
+    """reference test_binary_op (bplus/bminus/.../bneq on same shapes)."""
+    rng = _rng(29)
+    x = rng.rand(3, 4).astype("float32") + 1.0
+    y = rng.rand(3, 4).astype("float32") + 1.0
+    got = getattr(nd.array(x), op)(nd.array(y))
+    assert_almost_equal(got.asnumpy(), npop(x, y).astype("float32"),
+                        rtol=1e-4)
+
+
+def test_bmod_int():
+    rng = _rng(30)
+    x = rng.randint(1, 100, (3, 4)).astype("int32")
+    y = rng.randint(1, 10, (3, 4)).astype("int32")
+    got = (nd.array(x, dtype="int32") % nd.array(y, dtype="int32"))
+    assert (got.asnumpy() == x % y).all()
+
+
+def test_all_finite():
+    good = nd.array([[1.0, 2.0]])
+    bad = nd.array([[np.nan, 1.0]])
+    inf = nd.array([[np.inf, 1.0]])
+    assert int(nd.all_finite(good).asnumpy()) == 1
+    assert int(nd.all_finite(bad).asnumpy()) == 0
+    assert int(nd.all_finite(inf).asnumpy()) == 0
+    # multi_all_finite across several arrays
+    out = nd.multi_all_finite(good, bad, num_arrays=2)
+    assert int(out.asnumpy()) == 0
+
+
+def test_cast():
+    x = _rng(31).randn(3, 4).astype("float32") * 10
+    x = np.abs(x)                      # uint8: stay in range
+    for dst in ("float16", "float32", "int32", "uint8"):
+        got = nd.Cast(nd.array(x), dtype=dst)
+        assert got.dtype == np.dtype(dst)
+        assert_almost_equal(np.asarray(got.asnumpy(), "float64"),
+                            np.asarray(x.astype(dst), "float64"))
+
+
+def test_cast_float32_to_float16():
+    """Values straddling fp16 range: overflow goes inf, subnormals keep
+    (reference CastStorage/CastCompute contract)."""
+    x = np.array([1e-8, 70000.0, -70000.0, 1.0009765625], "float32")
+    got = nd.Cast(nd.array(x), dtype="float16").asnumpy()
+    ref = x.astype("float16")
+    assert got.dtype == np.float16
+    assert np.isinf(got[1]) and np.isinf(got[2])
+    assert_almost_equal(np.asarray(got, "float64"),
+                        np.asarray(ref, "float64"))
+
+
+def test_amp_multicast():
+    rng = _rng(32)
+    a = nd.array(rng.randn(2, 2).astype("float16"))
+    b = nd.array(rng.randn(2, 2).astype("float32"))
+    outs = nd.amp_multicast(a, b, num_outputs=2)
+    assert outs[0].dtype == np.float32 and outs[1].dtype == np.float32
+    c = nd.amp_cast(b, dtype="float16")
+    assert c.dtype == np.float16
+
+
+def test_blockgrad():
+    x = _rng(33).randn(3, 4).astype("float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.BlockGrad(a) * 2 + a
+    y.backward()
+    assert_almost_equal(a.grad.asnumpy(), np.ones_like(x))  # only +a path
+
+
+def test_div_sqrt_dim():
+    x = _rng(34).randn(2, 3, 16).astype("float32")
+    got = nd.contrib.div_sqrt_dim(nd.array(x))
+    assert_almost_equal(got.asnumpy(), x / np.sqrt(16), rtol=1e-5)
+
+
+def test_quadratic_function():
+    """reference test_quadratic_function: the contrib quadratic op
+    a*x^2 + b*x + c, fwd + bwd."""
+    x = _rng(35).randn(3, 4).astype("float32")
+    a_, b_, c_ = 2.0, -0.5, 1.5
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.contrib.quadratic(a, a=a_, b=b_, c=c_)
+    y.backward()
+    assert_almost_equal(y.asnumpy(), a_ * x ** 2 + b_ * x + c_, rtol=1e-5)
+    assert_almost_equal(a.grad.asnumpy(), 2 * a_ * x + b_, rtol=1e-5)
+
+
+def test_histogram():
+    x = np.array([0.1, 0.5, 2.5, 2.6, 9.9, 7.3], "float32")
+    cnt, edges = nd.histogram(nd.array(x), bin_cnt=10, range=(0.0, 10.0))
+    ref_cnt, ref_edges = np.histogram(x, bins=10, range=(0.0, 10.0))
+    assert (cnt.asnumpy().astype("int64") == ref_cnt).all()
+    assert_almost_equal(edges.asnumpy(), ref_edges.astype("float32"))
+
+
+def test_sequence_last():
+    rng = _rng(36)
+    x = rng.randn(4, 3, 5).astype("float32")      # (T, N, C)
+    lens = np.array([2, 4, 1], "float32")
+    got = nd.SequenceLast(nd.array(x), nd.array(lens),
+                          use_sequence_length=True)
+    ref = np.stack([x[int(l) - 1, i] for i, l in enumerate(lens)])
+    assert_almost_equal(got.asnumpy(), ref)
+
+
+def test_sequence_mask():
+    rng = _rng(37)
+    x = rng.randn(4, 3, 2).astype("float32")
+    lens = np.array([2, 3, 1], "float32")
+    got = nd.SequenceMask(nd.array(x), nd.array(lens),
+                          use_sequence_length=True, value=-1.0)
+    ref = x.copy()
+    for i, l in enumerate(lens):
+        ref[int(l):, i] = -1.0
+    assert_almost_equal(got.asnumpy(), ref)
+
+
+def test_sequence_reverse():
+    rng = _rng(38)
+    x = rng.randn(4, 3, 2).astype("float32")
+    lens = np.array([2, 4, 3], "float32")
+    got = nd.SequenceReverse(nd.array(x), nd.array(lens),
+                             use_sequence_length=True)
+    ref = x.copy()
+    for i, l in enumerate(lens):
+        ref[:int(l), i] = x[:int(l), i][::-1]
+    assert_almost_equal(got.asnumpy(), ref)
+    # no lengths: full flip on time axis
+    got = nd.SequenceReverse(nd.array(x))
+    assert_almost_equal(got.asnumpy(), x[::-1])

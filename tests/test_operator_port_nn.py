@@ -1,0 +1,602 @@
+"""Reference test_operator.py port, tranche 3: NN operator cases.
+Names mirror tests/python/unittest/test_operator.py.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+_rng = np.random.RandomState
+
+
+def test_regression():
+    """Linear/Logistic/MAE regression outputs: fwd is identity (or
+    sigmoid), bwd is (pred - label) style."""
+    rng = _rng(0)
+    x = rng.randn(4, 3).astype("float32")
+    y = rng.rand(4, 3).astype("float32")
+
+    def run(op):
+        d = mx.sym.Variable("data")
+        l = mx.sym.Variable("label")
+        s = op(d, l)
+        args = {"data": nd.array(x), "label": nd.array(y)}
+        grads = {"data": nd.zeros(x.shape), "label": nd.zeros(y.shape)}
+        exe = s.bind(mx.cpu(), args, args_grad=grads)
+        out = exe.forward(is_train=True)[0].asnumpy()
+        exe.backward()
+        return out, grads["data"].asnumpy()
+
+    # reference test_operator.py:485 — grads normalize by the output
+    # dim (shape[1]), not the batch
+    out, g = run(mx.sym.LinearRegressionOutput)
+    assert_almost_equal(out, x, rtol=1e-5)
+    assert_almost_equal(g, (x - y) / 3, rtol=1e-4)
+    out, g = run(mx.sym.LogisticRegressionOutput)
+    s = 1 / (1 + np.exp(-x))
+    assert_almost_equal(out, s, rtol=1e-5)
+    assert_almost_equal(g, (s - y) / 3, rtol=1e-4)
+    out, g = run(mx.sym.MAERegressionOutput)
+    assert_almost_equal(out, x, rtol=1e-5)
+    assert_almost_equal(g, np.sign(x - y) / 3, rtol=1e-4)
+
+
+def test_deconvolution():
+    """Deconvolution is the gradient of convolution: fwd shape math and
+    numeric check vs an explicit upsample-by-scatter reference."""
+    rng = _rng(1)
+    x = rng.randn(2, 3, 5, 5).astype("float32")
+    w = rng.randn(3, 4, 3, 3).astype("float32") * 0.2
+    out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                           num_filter=4, no_bias=True)
+    assert out.shape == (2, 4, 7, 7)
+    # deconv(x, w) == conv_transpose: cross-check via jax-free numpy
+    ref = np.zeros((2, 4, 7, 7), "float32")
+    for n in range(2):
+        for ci in range(3):
+            for hh in range(5):
+                for ww_ in range(5):
+                    ref[n, :, hh:hh + 3, ww_:ww_ + 3] += \
+                        x[n, ci, hh, ww_] * w[ci]
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-3, atol=1e-4)
+    # stride-2 output shape: (in-1)*s - 2p + k
+    out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                           stride=(2, 2), num_filter=4, no_bias=True)
+    assert out.shape == (2, 4, 11, 11)
+    # adj grows the output
+    out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                           stride=(2, 2), adj=(1, 1), num_filter=4,
+                           no_bias=True)
+    assert out.shape == (2, 4, 12, 12)
+
+
+def test_deconvolution_forward_with_bias():
+    rng = _rng(2)
+    x = rng.randn(1, 2, 4, 4).astype("float32")
+    w = rng.randn(2, 3, 3, 3).astype("float32") * 0.2
+    b = rng.randn(3).astype("float32")
+    no_b = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                            num_filter=3, no_bias=True)
+    with_b = nd.Deconvolution(nd.array(x), nd.array(w), nd.array(b),
+                              kernel=(3, 3), num_filter=3, no_bias=False)
+    assert_almost_equal(with_b.asnumpy(),
+                        no_b.asnumpy() + b.reshape(1, 3, 1, 1),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_nearest_upsampling():
+    rng = _rng(3)
+    x = rng.randn(1, 2, 3, 3).astype("float32")
+    out = nd.UpSampling(nd.array(x), scale=2, sample_type="nearest")
+    ref = np.repeat(np.repeat(x, 2, axis=2), 2, axis=3)
+    assert_almost_equal(out.asnumpy(), ref)
+
+
+def test_bilinear_upsampling():
+    rng = _rng(4)
+    x = rng.randn(1, 1, 4, 4).astype("float32")
+    w = nd.ones((1, 1, 4, 4))
+    out = nd.UpSampling(nd.array(x), w, scale=2, sample_type="bilinear",
+                        num_filter=1)
+    assert out.shape == (1, 1, 8, 8)
+
+
+def test_batchnorm_training():
+    """Training-mode BN normalizes with batch statistics; gamma/beta
+    gradients match the analytic form; numeric gradient passes."""
+    rng = _rng(5)
+    x = rng.randn(4, 3, 5, 5).astype("float32") * 2 + 1
+    gamma = rng.rand(3).astype("float32") + 0.5
+    beta = rng.randn(3).astype("float32")
+    d = mx.sym.Variable("data")
+    s = mx.sym.BatchNorm(d, mx.sym.Variable("gamma"),
+                         mx.sym.Variable("beta"),
+                         mx.sym.Variable("mm"), mx.sym.Variable("mv"),
+                         fix_gamma=False)
+    args = {"data": nd.array(x), "gamma": nd.array(gamma),
+            "beta": nd.array(beta)}
+    auxs = {"mm": nd.zeros(3), "mv": nd.ones(3)}
+    exe = s.bind(mx.cpu(), args, aux_states=auxs)
+    out = exe.forward(is_train=True)[0].asnumpy()
+    mean = x.mean(axis=(0, 2, 3), keepdims=True)
+    var = x.var(axis=(0, 2, 3), keepdims=True)
+    ref = (x - mean) / np.sqrt(var + 1e-3)
+    ref = ref * gamma.reshape(1, 3, 1, 1) + beta.reshape(1, 3, 1, 1)
+    assert_almost_equal(out, ref, rtol=1e-2, atol=1e-3)
+
+
+def test_batchnorm():
+    """Inference-mode BN uses the moving statistics."""
+    rng = _rng(6)
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    gamma = rng.rand(3).astype("float32") + 0.5
+    beta = rng.randn(3).astype("float32")
+    mm = rng.randn(3).astype("float32") * 0.1
+    mv = rng.rand(3).astype("float32") + 0.5
+    out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                       nd.array(mm), nd.array(mv), fix_gamma=False,
+                       use_global_stats=True, eps=1e-3)
+    ref = (x - mm.reshape(1, 3, 1, 1)) / \
+        np.sqrt(mv.reshape(1, 3, 1, 1) + 1e-3)
+    ref = ref * gamma.reshape(1, 3, 1, 1) + beta.reshape(1, 3, 1, 1)
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-3, atol=1e-4)
+    # fix_gamma treats gamma as 1
+    out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                       nd.array(mm), nd.array(mv), fix_gamma=True,
+                       use_global_stats=True, eps=1e-3)
+    ref1 = (x - mm.reshape(1, 3, 1, 1)) / \
+        np.sqrt(mv.reshape(1, 3, 1, 1) + 1e-3) + beta.reshape(1, 3, 1, 1)
+    assert_almost_equal(out.asnumpy(), ref1, rtol=1e-3, atol=1e-4)
+
+
+def test_convolution_grouping():
+    """num_group splits channels into independent convolutions."""
+    rng = _rng(7)
+    g = 2
+    x = rng.randn(2, 4, 6, 6).astype("float32")
+    w = rng.randn(6, 2, 3, 3).astype("float32") * 0.3
+    b = rng.randn(6).astype("float32")
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), num_filter=6, num_group=g)
+    # reference: concat of per-group convs
+    parts = []
+    for gi in range(g):
+        xg = x[:, 2 * gi:2 * gi + 2]
+        wg = w[3 * gi:3 * gi + 3]
+        bg = b[3 * gi:3 * gi + 3]
+        parts.append(nd.Convolution(nd.array(xg), nd.array(wg),
+                                    nd.array(bg), kernel=(3, 3),
+                                    num_filter=3).asnumpy())
+    assert_almost_equal(out.asnumpy(), np.concatenate(parts, axis=1),
+                        rtol=1e-3, atol=1e-4)
+
+
+def test_depthwise_convolution():
+    """num_group == channels — every channel its own filter."""
+    rng = _rng(8)
+    c = 4
+    x = rng.randn(2, c, 5, 5).astype("float32")
+    w = rng.randn(c, 1, 3, 3).astype("float32") * 0.3
+    out = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         num_filter=c, num_group=c, no_bias=True)
+    from scipy.signal import correlate2d
+    ref = np.stack([
+        np.stack([correlate2d(x[n, ch], w[ch, 0], mode="valid")
+                  for ch in range(c)])
+        for n in range(2)]).astype("float32")
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_convolution_dilated_impulse_response():
+    """A centered impulse through a dilated conv reproduces the dilated
+    kernel footprint (reference test_run_convolution_dilated_impulse_
+    response)."""
+    for dil in ((1, 1), (2, 2), (3, 3)):
+        x = np.zeros((1, 1, 15, 15), "float32")
+        x[0, 0, 7, 7] = 1.0
+        w = np.ones((1, 1, 3, 3), "float32")
+        out = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                             dilate=dil, pad=(dil[0], dil[1]),
+                             num_filter=1, no_bias=True).asnumpy()
+        # nonzero taps exactly at the dilated offsets around the center
+        nz = np.argwhere(out[0, 0] > 0.5)
+        want = [(7 + dy * dil[0], 7 + dx * dil[1])
+                for dy in (-1, 0, 1) for dx in (-1, 0, 1)]
+        assert sorted(map(tuple, nz.tolist())) == sorted(want), dil
+
+
+def test_dot():
+    rng = _rng(9)
+    a = rng.randn(3, 4).astype("float32")
+    b = rng.randn(4, 5).astype("float32")
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b)).asnumpy(),
+                        a @ b, rtol=1e-4)
+    assert_almost_equal(
+        nd.dot(nd.array(a), nd.array(b.T), transpose_b=True).asnumpy(),
+        a @ b, rtol=1e-4)
+    assert_almost_equal(
+        nd.dot(nd.array(a.T), nd.array(b), transpose_a=True).asnumpy(),
+        a @ b, rtol=1e-4)
+    # gradients
+    x, y = nd.array(a), nd.array(b)
+    x.attach_grad()
+    y.attach_grad()
+    with autograd.record():
+        z = nd.dot(x, y)
+    z.backward()
+    assert_almost_equal(x.grad.asnumpy(),
+                        np.ones((3, 5), "float32") @ b.T, rtol=1e-4)
+    assert_almost_equal(y.grad.asnumpy(),
+                        a.T @ np.ones((3, 5), "float32"), rtol=1e-4)
+
+
+def test_batch_dot():
+    rng = _rng(10)
+    a = rng.randn(3, 2, 4).astype("float32")
+    b = rng.randn(3, 4, 5).astype("float32")
+    got = nd.batch_dot(nd.array(a), nd.array(b))
+    assert_almost_equal(got.asnumpy(), np.einsum("bij,bjk->bik", a, b),
+                        rtol=1e-4)
+    got = nd.batch_dot(nd.array(a), nd.array(b.transpose(0, 2, 1)),
+                       transpose_b=True)
+    assert_almost_equal(got.asnumpy(), np.einsum("bij,bjk->bik", a, b),
+                        rtol=1e-4)
+
+
+def test_support_vector_machine_l1_svm():
+    rng = _rng(11)
+    x = rng.randn(4, 3).astype("float32")
+    y = np.array([0, 2, 1, 0], "float32")
+    d = mx.sym.Variable("data")
+    l = mx.sym.Variable("label")
+    s = mx.sym.SVMOutput(d, l, margin=1.0, use_linear=True)
+    args = {"data": nd.array(x), "label": nd.array(y)}
+    grads = {"data": nd.zeros(x.shape), "label": nd.zeros(y.shape)}
+    exe = s.bind(mx.cpu(), args, args_grad=grads)
+    out = exe.forward(is_train=True)[0].asnumpy()
+    assert_almost_equal(out, x)     # fwd is identity
+    exe.backward()
+    g = grads["data"].asnumpy()
+    assert g.shape == x.shape and np.abs(g).sum() > 0
+
+
+def test_support_vector_machine_l2_svm():
+    rng = _rng(12)
+    x = rng.randn(4, 3).astype("float32")
+    y = np.array([1, 0, 2, 1], "float32")
+    s = mx.sym.SVMOutput(mx.sym.Variable("data"),
+                         mx.sym.Variable("label"), margin=1.0,
+                         use_linear=False)
+    args = {"data": nd.array(x), "label": nd.array(y)}
+    grads = {"data": nd.zeros(x.shape), "label": nd.zeros(y.shape)}
+    exe = s.bind(mx.cpu(), args, args_grad=grads)
+    out = exe.forward(is_train=True)[0].asnumpy()
+    assert_almost_equal(out, x)
+    exe.backward()
+    assert np.abs(grads["data"].asnumpy()).sum() > 0
+
+
+def test_roipooling():
+    x = np.arange(1 * 1 * 6 * 6, dtype="float32").reshape(1, 1, 6, 6)
+    rois = np.array([[0, 0, 0, 5, 5]], "float32")
+    out = nd.ROIPooling(nd.array(x), nd.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    # max pool over each 3x3 quadrant
+    ref = np.array([[[[14, 17], [32, 35]]]], "float32")
+    assert_almost_equal(out.asnumpy(), ref)
+
+
+def test_pad():
+    rng = _rng(13)
+    x = rng.randn(1, 2, 3, 3).astype("float32")
+    pw = (0, 0, 0, 0, 1, 2, 1, 1)
+    out = nd.Pad(nd.array(x), mode="constant", constant_value=3.5,
+                 pad_width=pw)
+    ref = np.pad(x, ((0, 0), (0, 0), (1, 2), (1, 1)), mode="constant",
+                 constant_values=3.5)
+    assert_almost_equal(out.asnumpy(), ref)
+    out = nd.Pad(nd.array(x), mode="edge", pad_width=pw)
+    assert_almost_equal(out.asnumpy(),
+                        np.pad(x, ((0, 0), (0, 0), (1, 2), (1, 1)),
+                               mode="edge"))
+    out = nd.Pad(nd.array(x), mode="reflect", pad_width=pw)
+    assert_almost_equal(out.asnumpy(),
+                        np.pad(x, ((0, 0), (0, 0), (1, 2), (1, 1)),
+                               mode="reflect"))
+
+
+def test_instance_normalization():
+    rng = _rng(14)
+    x = rng.randn(2, 3, 4, 5).astype("float32")
+    gamma = rng.rand(3).astype("float32") + 0.5
+    beta = rng.randn(3).astype("float32")
+    out = nd.InstanceNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                          eps=1e-5)
+    mean = x.mean(axis=(2, 3), keepdims=True)
+    var = x.var(axis=(2, 3), keepdims=True)
+    ref = (x - mean) / np.sqrt(var + 1e-5)
+    ref = ref * gamma.reshape(1, 3, 1, 1) + beta.reshape(1, 3, 1, 1)
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_l2_normalization():
+    rng = _rng(15)
+    x = rng.randn(2, 3, 4).astype("float32")
+    for mode, axes in (("instance", (1, 2)), ("channel", (1,)),
+                       ("spatial", (2,))):
+        out = nd.L2Normalization(nd.array(x), mode=mode, eps=1e-10)
+        norm = np.sqrt((x ** 2).sum(axis=axes, keepdims=True) + 1e-10)
+        assert_almost_equal(out.asnumpy(), x / norm, rtol=1e-4,
+                            atol=1e-5)
+
+
+def test_norm():
+    rng = _rng(16)
+    x = rng.randn(3, 4, 5).astype("float32")
+    assert_almost_equal(float(nd.norm(nd.array(x)).asnumpy()),
+                        np.linalg.norm(x.ravel()), rtol=1e-4)
+    got = nd.norm(nd.array(x), ord=2, axis=1)
+    assert_almost_equal(got.asnumpy(), np.sqrt((x ** 2).sum(axis=1)),
+                        rtol=1e-4)
+    got = nd.norm(nd.array(x), ord=1, axis=2)
+    assert_almost_equal(got.asnumpy(), np.abs(x).sum(axis=2), rtol=1e-4)
+    got = nd.norm(nd.array(x), ord=2, axis=(1, 2), keepdims=True)
+    assert got.shape == (3, 1, 1)
+
+
+def test_layer_norm():
+    rng = _rng(17)
+    x = rng.randn(3, 4, 8).astype("float32")
+    gamma = rng.rand(8).astype("float32") + 0.5
+    beta = rng.randn(8).astype("float32")
+    out = nd.LayerNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                       axis=-1, eps=1e-5)
+    mean = x.mean(axis=-1, keepdims=True)
+    var = x.var(axis=-1, keepdims=True)
+    ref = (x - mean) / np.sqrt(var + 1e-5) * gamma + beta
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-3, atol=1e-4)
+    # axis=1
+    g1 = rng.rand(4).astype("float32") + 0.5
+    b1 = rng.randn(4).astype("float32")
+    out = nd.LayerNorm(nd.array(x), nd.array(g1), nd.array(b1), axis=1)
+    mean = x.mean(axis=1, keepdims=True)
+    var = x.var(axis=1, keepdims=True)
+    ref = (x - mean) / np.sqrt(var + 1e-5) * g1.reshape(1, 4, 1) \
+        + b1.reshape(1, 4, 1)
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_softmin():
+    x = _rng(18).randn(3, 5).astype("float32")
+    got = nd.softmin(nd.array(x), axis=-1)
+    e = np.exp(-x - (-x).max(axis=-1, keepdims=True))
+    assert_almost_equal(got.asnumpy(), e / e.sum(axis=-1, keepdims=True),
+                        rtol=1e-4)
+
+
+def test_new_softmax():
+    x = _rng(19).randn(2, 3, 4).astype("float32")
+    for axis in (0, 1, 2, -1):
+        got = nd.softmax(nd.array(x), axis=axis)
+        e = np.exp(x - x.max(axis=axis, keepdims=True))
+        assert_almost_equal(got.asnumpy(),
+                            e / e.sum(axis=axis, keepdims=True),
+                            rtol=1e-4)
+
+
+def test_softmax_with_temperature():
+    x = _rng(20).randn(2, 6).astype("float32")
+    for t in (0.1, 1.0, 5.0):
+        got = nd.softmax(nd.array(x), temperature=t)
+        e = np.exp(x / t - (x / t).max(axis=-1, keepdims=True))
+        assert_almost_equal(got.asnumpy(),
+                            e / e.sum(axis=-1, keepdims=True), rtol=1e-3,
+                            atol=1e-5)
+
+
+def test_log_softmax():
+    x = _rng(21).randn(3, 6).astype("float32") * 3
+    got = nd.log_softmax(nd.array(x))
+    e = x - x.max(axis=-1, keepdims=True)
+    ref = e - np.log(np.exp(e).sum(axis=-1, keepdims=True))
+    assert_almost_equal(got.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_with_large_inputs():
+    x = np.array([[1e4, 1e4 + 1], [-1e4, -1e4 + 1]], "float32")
+    got = nd.softmax(nd.array(x)).asnumpy()
+    ref = np.array([[1 / (1 + np.e), np.e / (1 + np.e)]] * 2, "float32")
+    assert_almost_equal(got, ref, rtol=1e-4)
+    assert np.isfinite(nd.log_softmax(nd.array(x)).asnumpy()).all()
+
+
+def test_softmax_dtype():
+    x = _rng(22).randn(3, 4).astype("float16")
+    got = nd.softmax(nd.array(x, dtype="float16"))
+    assert got.dtype == np.float16
+    got = nd.softmax(nd.array(x, dtype="float16"), dtype="float32")
+    assert got.dtype == np.float32
+
+
+def test_softmax_output_normalization():
+    """SoftmaxOutput normalization modes scale the backward gradient."""
+    rng = _rng(23)
+    x = rng.randn(4, 3).astype("float32")
+    y = np.array([0, 1, 2, 1], "float32")
+
+    def grad_with(norm):
+        d = mx.sym.Variable("data")
+        l = mx.sym.Variable("label")
+        s = mx.sym.SoftmaxOutput(d, l, normalization=norm)
+        args = {"data": nd.array(x), "label": nd.array(y)}
+        grads = {"data": nd.zeros(x.shape), "label": nd.zeros(y.shape)}
+        exe = s.bind(mx.cpu(), args, args_grad=grads)
+        exe.forward(is_train=True)
+        exe.backward()
+        return grads["data"].asnumpy()
+
+    g_batch = grad_with("batch")
+    g_null = grad_with("null")
+    assert_almost_equal(g_batch * 4, g_null, rtol=1e-4, atol=1e-6)
+
+
+def test_stn():
+    """SpatialTransformer with an identity affine theta reproduces the
+    input (reference test_stn sanity core)."""
+    rng = _rng(24)
+    x = rng.randn(1, 1, 6, 6).astype("float32")
+    theta = np.array([[1, 0, 0, 0, 1, 0]], "float32")
+    out = nd.SpatialTransformer(
+        nd.array(x), nd.array(theta), target_shape=(6, 6),
+        transform_type="affine", sampler_type="bilinear")
+    assert_almost_equal(out.asnumpy(), x, rtol=1e-4, atol=1e-4)
+
+
+def test_grid_generator():
+    theta = np.array([[1, 0, 0, 0, 1, 0]], "float32")
+    grid = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                            target_shape=(4, 5))
+    assert grid.shape == (1, 2, 4, 5)
+    # identity grid spans [-1, 1]
+    g = grid.asnumpy()
+    assert_almost_equal(g[0, 0, :, 0], np.linspace(-1, 1, 5)[0]
+                        * np.ones(4), atol=1e-5)
+    # warp with the identity grid reproduces the input
+    x = _rng(25).randn(1, 2, 4, 5).astype("float32")
+    out = nd.BilinearSampler(nd.array(x), grid)
+    assert_almost_equal(out.asnumpy(), x, rtol=1e-4, atol=1e-4)
+
+
+def test_dropout():
+    rng = _rng(26)
+    x = np.ones((200, 200), "float32")
+    a = nd.array(x)
+    # inference: identity
+    assert_almost_equal(nd.Dropout(a, p=0.5).asnumpy(), x)
+    # training: ~p zeroed, survivors scaled by 1/(1-p)
+    with autograd.record(train_mode=True):
+        out = nd.Dropout(a, p=0.5)
+    o = out.asnumpy()
+    frac = (o == 0).mean()
+    assert 0.45 < frac < 0.55, frac
+    assert_almost_equal(np.unique(o[o > 0]), np.array([2.0], "float32"))
+    # mode='always' applies dropout outside training too
+    o2 = nd.Dropout(a, p=0.5, mode="always").asnumpy()
+    assert 0.4 < (o2 == 0).mean() < 0.6
+
+
+def test_adaptive_avg_pool_op():
+    rng = _rng(27)
+    x = rng.randn(1, 2, 8, 8).astype("float32")
+    out = nd.contrib.AdaptiveAvgPooling2D(nd.array(x), output_size=4)
+    ref = x.reshape(1, 2, 4, 2, 4, 2).mean(axis=(3, 5))
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-4)
+    out = nd.contrib.AdaptiveAvgPooling2D(nd.array(x), output_size=1)
+    assert_almost_equal(out.asnumpy(), x.mean(axis=(2, 3),
+                                              keepdims=True), rtol=1e-4)
+
+
+def test_bilinear_resize_op():
+    rng = _rng(28)
+    x = rng.randn(1, 2, 4, 4).astype("float32")
+    out = nd.contrib.BilinearResize2D(nd.array(x), height=8, width=8)
+    assert out.shape == (1, 2, 8, 8)
+    # corners align with the input corners (align_corners convention)
+    assert_almost_equal(out.asnumpy()[..., 0, 0], x[..., 0, 0],
+                        rtol=1e-4)
+    assert_almost_equal(out.asnumpy()[..., -1, -1], x[..., -1, -1],
+                        rtol=1e-4)
+
+
+def test_moments():
+    rng = _rng(29)
+    x = rng.randn(3, 4, 5).astype("float32")
+    mean, var = nd.moments(nd.array(x), axes=(0, 2))
+    assert_almost_equal(mean.asnumpy(), x.mean(axis=(0, 2)), rtol=1e-4)
+    assert_almost_equal(var.asnumpy(), x.var(axis=(0, 2)), rtol=1e-3,
+                        atol=1e-5)
+    mean, var = nd.moments(nd.array(x), axes=1, keepdims=True)
+    assert mean.shape == (3, 1, 5)
+
+
+def test_pooling_kernel_size_validation():
+    """reference test_invalid_kernel_size / test_valid_kernel_size /
+    pad-type 'same' validation family."""
+    x = nd.zeros((1, 1, 4, 4))
+    with pytest.raises(Exception):
+        nd.Pooling(x, kernel=(0, 0), pool_type="max").asnumpy()
+    out = nd.Pooling(x, kernel=(2, 2), pool_type="max")
+    assert out.shape == (1, 1, 3, 3) or out.shape == (1, 1, 2, 2)
+
+
+def test_image_normalize():
+    rng = _rng(30)
+    x = rng.rand(3, 4, 4).astype("float32")
+    out = nd.image.normalize(nd.array(x), mean=(0.5, 0.4, 0.3),
+                             std=(0.2, 0.25, 0.3))
+    ref = (x - np.array([0.5, 0.4, 0.3]).reshape(3, 1, 1)) \
+        / np.array([0.2, 0.25, 0.3]).reshape(3, 1, 1)
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+    # batched input normalizes per image
+    xb = rng.rand(2, 3, 4, 4).astype("float32")
+    out = nd.image.normalize(nd.array(xb), mean=(0.5, 0.4, 0.3),
+                             std=(0.2, 0.25, 0.3))
+    refb = (xb - np.array([0.5, 0.4, 0.3]).reshape(1, 3, 1, 1)) \
+        / np.array([0.2, 0.25, 0.3]).reshape(1, 3, 1, 1)
+    assert_almost_equal(out.asnumpy(), refb, rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss():
+    """CTC loss against a tiny hand-checkable case + batch shape
+    contract (reference test_ctc_loss family)."""
+    # T=2, B=1, C=3 (blank=last); label "0"
+    pred = np.full((2, 1, 3), 1.0 / 3, "float32")
+    label = np.array([[0]], "float32")
+    loss = nd.CTCLoss(nd.array(pred), nd.array(label),
+                      blank_label="last")
+    # alignment paths for label {0}: (0,b),(b,0),(0,0) each p=1/9
+    want = -np.log(3.0 / 9.0)
+    assert_almost_equal(float(loss.asnumpy()[0]), want, rtol=1e-3)
+
+
+def test_ctc_loss_grad():
+    """CTC gradient via autograd matches numeric finite differences."""
+    rng = _rng(31)
+    t, b, c = 6, 2, 5
+    logits = rng.randn(t, b, c).astype("float32") * 0.5
+    label = np.array([[1, 2], [3, 0]], "float32")
+
+    def loss_of(arr):
+        a = nd.array(arr)
+        a.attach_grad()
+        with autograd.record():
+            sm = nd.softmax(a, axis=-1)
+            l = nd.CTCLoss(sm, nd.array(label), blank_label="last").sum()
+        l.backward()
+        return float(l.asnumpy()), a.grad.asnumpy()
+
+    base, grad = loss_of(logits)
+    eps = 1e-2
+    for _ in range(4):
+        i = tuple(rng.randint(0, s) for s in logits.shape)
+        pert = logits.copy()
+        pert[i] += eps
+        up, _ = loss_of(pert)
+        pert[i] -= 2 * eps
+        dn, _ = loss_of(pert)
+        fd = (up - dn) / (2 * eps)
+        assert abs(fd - grad[i]) < 0.05 + 0.1 * abs(fd), (fd, grad[i])
+
+
+def test_ctc_loss_with_large_classes():
+    rng = _rng(32)
+    t, b, c = 10, 2, 6000
+    pred = nd.softmax(nd.array(rng.randn(t, b, c).astype("float32")),
+                      axis=-1)
+    label = nd.array(rng.randint(0, c - 1, (b, 4)).astype("float32"))
+    loss = nd.CTCLoss(pred, label, blank_label="last")
+    assert loss.shape == (b,)
+    assert np.isfinite(loss.asnumpy()).all()

@@ -1,0 +1,605 @@
+"""Reference test_operator.py port, tranche 2: shape manipulation and
+indexing cases.  Names mirror tests/python/unittest/test_operator.py.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+_rng = np.random.RandomState
+
+
+def test_reshape():
+    """The reference's big reshape spec table: 0 (copy dim), -1 (infer),
+    -2 (copy rest), -3 (merge two), -4 (split)."""
+    rng = _rng(0)
+    # the reference's authoritative case table (test_operator.py:2360)
+    cases = [
+        ((2, 3, 5, 5), (0, -1), False, (2, 75)),
+        ((2, 3, 5, 5), (0, 0, -1), False, (2, 3, 25)),
+        ((5, 3, 4, 5), (0, -1, 0), False, (5, 15, 4)),
+        ((2, 3, 5, 4), (-1, 0, 0), False, (8, 3, 5)),
+        ((2, 3, 5, 5), (0, 0, 0, 0), False, (2, 3, 5, 5)),
+        ((2, 4, 5, 3), (-1, 2, 2, 1), False, (30, 2, 2, 1)),
+        ((2, 3, 5, 6), (-2,), False, (2, 3, 5, 6)),
+        ((2, 3, 5, 6), (6, 1, -2), False, (6, 1, 5, 6)),
+        ((2, 3, 5, 6), (-3, -3), False, (6, 30)),
+        ((2, 3, 5, 6), (-3, -1), False, (6, 30)),
+        ((64,), (-4, 16, 4), False, (16, 4)),
+        ((64,), (-4, 16, -1), False, (16, 4)),
+        ((64, 1, 2, 3), (-4, 16, -1, -2), False, (16, 4, 1, 2, 3)),
+        ((2, 3, 5, 5), (0, -1), True, (5, 30)),
+        ((2, 3, 5, 5), (0, 0, -1), True, (3, 5, 10)),
+        ((5, 3, 4, 5), (0, -1, 0), True, (3, 20, 5)),
+        ((2, 3, 5, 4), (-1, 0, 0), True, (6, 5, 4)),
+        ((2, 3, 4, 5), (3, -1, 0), True, (3, 8, 5)),
+        ((2, 3, 5, 5), (5, 3, 0, -1), True, (5, 3, 5, 2)),
+        ((2, 3, 5, 5), (0, 0, 0, 0), True, (2, 3, 5, 5)),
+        ((2, 3, 5, 6), (-2,), True, (2, 3, 5, 6)),
+        ((2, 3, 5, 6), (-2, 1, 30), True, (2, 3, 1, 30)),
+        ((2, 3, 5, 6), (-3, -3), True, (6, 30)),
+        ((64,), (16, 4, -4), True, (16, 4)),
+        ((64,), (16, -1, -4), True, (16, 4)),
+        ((1, 2, 3, 64), (-2, -1, 16, -4), True, (1, 2, 3, 4, 16)),
+    ]
+    for src_shape, spec, reverse, want in cases:
+        x = rng.randn(*src_shape).astype("float32")
+        got = nd.reshape(nd.array(x), shape=spec, reverse=reverse)
+        assert got.shape == want, (src_shape, spec, reverse, got.shape)
+        assert_almost_equal(got.asnumpy().ravel(), x.ravel())
+    # legacy target_shape api
+    s = mx.sym.Reshape(mx.sym.Variable("data"), target_shape=(2, 0))
+    _, oshape, _ = s.infer_shape(data=(2, 3, 5, 5))
+    assert oshape[0] == (2, 75)
+
+
+def test_reshape_new():
+    """Gradient flows through reshape unchanged."""
+    x = _rng(1).randn(2, 3, 4).astype("float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = (nd.reshape(a, shape=(4, 6)) * 2).sum()
+    y.backward()
+    assert_almost_equal(a.grad.asnumpy(), np.full_like(x, 2.0))
+
+
+def test_reshape_like():
+    rng = _rng(2)
+    x = rng.randn(2, 12).astype("float32")
+    tmpl = nd.zeros((4, 3, 2))
+    got = nd.reshape_like(nd.array(x), tmpl)
+    assert got.shape == (4, 3, 2)
+    assert_almost_equal(got.asnumpy().ravel(), x.ravel())
+
+
+def test_reshape_like_new():
+    """lhs_begin/lhs_end/rhs_begin/rhs_end partial reshape."""
+    # reference case table (test_operator.py:2438)
+    x = _rng(3).randn(30).astype("float32")
+    tmpl = nd.zeros((15, 2, 4))
+    got = nd.reshape_like(nd.array(x), tmpl, lhs_begin=0, lhs_end=None,
+                          rhs_begin=0, rhs_end=2)
+    assert got.shape == (15, 2)
+    got = nd.reshape_like(nd.array(x), tmpl, lhs_begin=None, lhs_end=1,
+                          rhs_begin=None, rhs_end=2)
+    assert got.shape == (15, 2)
+
+
+def test_reshape_like_different_types():
+    x = nd.array(_rng(4).randn(2, 6).astype("float32"))
+    tmpl = nd.zeros((3, 4), dtype="int32")
+    got = nd.reshape_like(x, tmpl)
+    assert got.shape == (3, 4) and got.dtype == np.float32
+
+
+def test_slice_like_different_types():
+    x = nd.array(_rng(5).randn(5, 6).astype("float32"))
+    tmpl = nd.zeros((3, 4), dtype="int32")
+    got = nd.slice_like(x, tmpl)
+    assert got.shape == (3, 4)
+
+
+def test_reduce():
+    """sum/mean/prod/max/min/nansum/nanprod over axis combos, fwd+bwd."""
+    rng = _rng(6)
+    x = rng.rand(2, 3, 4).astype("float32") + 0.2
+    for name, ref in [("sum", np.sum), ("mean", np.mean),
+                      ("prod", np.prod), ("max", np.max), ("min", np.min)]:
+        for axis in (None, 0, 1, 2, (0, 2), (1, 2)):
+            kw = {} if axis is None else {"axis": axis}
+            got = getattr(nd, name)(nd.array(x), **kw)
+            want = ref(x) if axis is None else ref(x, axis=axis)
+            assert_almost_equal(got.asnumpy(), np.asarray(want,
+                                                          "float32"),
+                                rtol=1e-4)
+            kw["keepdims"] = True
+            got = getattr(nd, name)(nd.array(x), **kw)
+            want = ref(x, axis=axis, keepdims=True) if axis is not None \
+                else ref(x, keepdims=True)
+            assert_almost_equal(got.asnumpy(),
+                                np.asarray(want, "float32"), rtol=1e-4)
+    # nansum / nanprod skip NaNs
+    xn = x.copy()
+    xn[0, 0, 0] = np.nan
+    assert_almost_equal(nd.nansum(nd.array(xn), axis=0).asnumpy(),
+                        np.nansum(xn, axis=0), rtol=1e-4)
+    assert_almost_equal(nd.nanprod(nd.array(xn), axis=0).asnumpy(),
+                        np.nanprod(xn, axis=0), rtol=1e-4)
+
+
+def test_reduce_inner():
+    """sum gradient broadcasts the head grad back over reduced axes."""
+    x = _rng(7).rand(3, 4).astype("float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.sum(a, axis=1)
+    y.backward()
+    assert_almost_equal(a.grad.asnumpy(), np.ones_like(x))
+    with autograd.record():
+        y = nd.max(a, axis=1)
+    y.backward()
+    onehot = (x == x.max(axis=1, keepdims=True)).astype("float32")
+    assert_almost_equal(a.grad.asnumpy(), onehot)
+
+
+def test_broadcast():
+    rng = _rng(8)
+    x = rng.randn(1, 3, 1).astype("float32")
+    got = nd.broadcast_to(nd.array(x), shape=(2, 3, 4))
+    assert_almost_equal(got.asnumpy(), np.broadcast_to(x, (2, 3, 4)))
+    got = nd.broadcast_axis(nd.array(x), axis=(0, 2), size=(2, 4))
+    assert_almost_equal(got.asnumpy(), np.broadcast_to(x, (2, 3, 4)))
+    tmpl = nd.zeros((2, 3, 4))
+    got = nd.broadcast_like(nd.array(x), tmpl)
+    assert_almost_equal(got.asnumpy(), np.broadcast_to(x, (2, 3, 4)))
+    # backward of broadcast = sum over broadcast axes
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.broadcast_to(a, shape=(2, 3, 4))
+    y.backward()
+    assert_almost_equal(a.grad.asnumpy(), np.full((1, 3, 1), 8.0))
+
+
+def test_transpose():
+    rng = _rng(9)
+    x = rng.randn(2, 3, 4).astype("float32")
+    assert_almost_equal(nd.transpose(nd.array(x)).asnumpy(), x.T)
+    for axes in ((0, 2, 1), (2, 0, 1), (1, 2, 0)):
+        assert_almost_equal(nd.transpose(nd.array(x), axes=axes).asnumpy(),
+                            np.transpose(x, axes))
+
+
+def test_expand_dims():
+    x = _rng(10).randn(2, 3).astype("float32")
+    for axis in (0, 1, 2, -1, -2):
+        got = nd.expand_dims(nd.array(x), axis=axis)
+        assert_almost_equal(got.asnumpy(), np.expand_dims(x, axis))
+
+
+def test_crop():
+    x = _rng(11).randn(2, 3, 4).astype("float32")
+    got = nd.crop(nd.array(x), begin=(0, 1, 1), end=(2, 3, 3))
+    assert_almost_equal(got.asnumpy(), x[0:2, 1:3, 1:3])
+
+
+def test_slice_axis():
+    x = _rng(12).randn(3, 4, 5).astype("float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.slice_axis(a, axis=1, begin=1, end=3)
+    y.backward()
+    assert_almost_equal(y.asnumpy(), x[:, 1:3])
+    want = np.zeros_like(x)
+    want[:, 1:3] = 1
+    assert_almost_equal(a.grad.asnumpy(), want)
+    # negative begin/end
+    got = nd.slice_axis(nd.array(x), axis=2, begin=-3, end=None)
+    assert_almost_equal(got.asnumpy(), x[:, :, -3:])
+
+
+def test_slice_like():
+    rng = _rng(13)
+    x = rng.randn(4, 5).astype("float32")
+    tmpl = nd.zeros((2, 3))
+    assert_almost_equal(nd.slice_like(nd.array(x), tmpl).asnumpy(),
+                        x[:2, :3])
+    # axes restricts which dims are sliced
+    got = nd.slice_like(nd.array(x), tmpl, axes=(0,))
+    assert_almost_equal(got.asnumpy(), x[:2, :])
+
+
+def test_flip():
+    x = _rng(14).randn(2, 3, 4).astype("float32")
+    for axis in (0, 1, 2):
+        got = nd.flip(nd.array(x), axis=axis)
+        assert_almost_equal(got.asnumpy(), np.flip(x, axis))
+
+
+def test_stack():
+    rng = _rng(15)
+    parts = [rng.randn(3, 4).astype("float32") for _ in range(3)]
+    for axis in (0, 1, 2):
+        got = nd.stack(*[nd.array(p) for p in parts], axis=axis)
+        assert_almost_equal(got.asnumpy(), np.stack(parts, axis=axis))
+
+
+def test_repeat():
+    """reference test_repeat (forward/backward/numeric)."""
+    x = _rng(16).randn(2, 3).astype("float32")
+    # flat repeat
+    got = nd.repeat(nd.array(x), repeats=2)
+    assert_almost_equal(got.asnumpy(), np.repeat(x, 2))
+    for axis in (0, 1):
+        got = nd.repeat(nd.array(x), repeats=3, axis=axis)
+        assert_almost_equal(got.asnumpy(), np.repeat(x, 3, axis=axis))
+    # backward: grads accumulate across the repeats
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.repeat(a, repeats=2, axis=0)
+    y.backward()
+    assert_almost_equal(a.grad.asnumpy(), np.full_like(x, 2.0))
+    sym = mx.sym.repeat(mx.sym.Variable("x"), repeats=2, axis=1)
+    check_numeric_gradient(sym, {"x": nd.array(x)}, rtol=0.05, atol=1e-3)
+
+
+def test_tile():
+    """reference test_tile: normal / empty reps / backward / numeric /
+    invalid."""
+    x = _rng(17).randn(2, 3).astype("float32")
+    got = nd.tile(nd.array(x), reps=(2, 2))
+    assert_almost_equal(got.asnumpy(), np.tile(x, (2, 2)))
+    got = nd.tile(nd.array(x), reps=(1, 2, 3))
+    assert_almost_equal(got.asnumpy(), np.tile(x, (1, 2, 3)))
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.tile(a, reps=(2, 3))
+    y.backward()
+    assert_almost_equal(a.grad.asnumpy(), np.full_like(x, 6.0))
+    sym = mx.sym.tile(mx.sym.Variable("x"), reps=(2, 2))
+    check_numeric_gradient(sym, {"x": nd.array(x)}, rtol=0.05, atol=1e-3)
+
+
+def test_reverse():
+    x = _rng(18).randn(2, 3, 4).astype("float32")
+    got = nd.reverse(nd.array(x), axis=(0, 2))
+    assert_almost_equal(got.asnumpy(), x[::-1, :, ::-1])
+
+
+def test_one_hot():
+    """normal / empty indices / zero depth cases."""
+    idx = np.array([1, 0, 2, 1], "float32")
+    got = nd.one_hot(nd.array(idx), depth=3)
+    assert_almost_equal(got.asnumpy(), np.eye(3, dtype="float32")[
+        idx.astype(int)])
+    got = nd.one_hot(nd.array(idx), depth=3, on_value=5.0, off_value=-1.0)
+    ref = np.full((4, 3), -1.0, "float32")
+    ref[np.arange(4), idx.astype(int)] = 5.0
+    assert_almost_equal(got.asnumpy(), ref)
+    # out-of-range indices produce all-off rows (reference contract)
+    got = nd.one_hot(nd.array(np.array([3.0, 1.0], "float32")), depth=3)
+    assert_almost_equal(got.asnumpy()[0], np.zeros(3, "float32"))
+
+
+def test_where():
+    """reference test_where: helper + numeric grad + 1-d cond."""
+    rng = _rng(19)
+    cond = rng.randint(0, 2, (3, 4)).astype("float32")
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(3, 4).astype("float32")
+    got = nd.where(nd.array(cond), nd.array(x), nd.array(y))
+    assert_almost_equal(got.asnumpy(), np.where(cond, x, y))
+    # gradient routes to the selected branch only
+    a, b = nd.array(x), nd.array(y)
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        out = nd.where(nd.array(cond), a, b)
+    out.backward()
+    assert_almost_equal(a.grad.asnumpy(), cond)
+    assert_almost_equal(b.grad.asnumpy(), 1 - cond)
+    # 1-d cond selects along the batch axis
+    cond1 = np.array([1, 0, 1], "float32")
+    got = nd.where(nd.array(cond1), nd.array(x), nd.array(y))
+    ref = np.where(cond1[:, None].astype(bool), x, y)
+    assert_almost_equal(got.asnumpy(), ref)
+
+
+def test_take():
+    """reference test_take: axes x clip/wrap modes, fwd + bwd."""
+    rng = _rng(20)
+    x = rng.randn(4, 5, 6).astype("float32")
+    for axis in (0, 1, 2):
+        idx = rng.randint(0, x.shape[axis], (2, 3)).astype("float32")
+        got = nd.take(nd.array(x), nd.array(idx), axis=axis)
+        assert_almost_equal(got.asnumpy(),
+                            np.take(x, idx.astype(int), axis=axis))
+    # clip mode on out-of-range
+    idx = np.array([[-1, 7]], "float32")
+    got = nd.take(nd.array(x), nd.array(idx), axis=0, mode="clip")
+    assert_almost_equal(got.asnumpy(),
+                        np.take(x, [[0, 3]], axis=0))
+    got = nd.take(nd.array(x), nd.array(idx), axis=0, mode="wrap")
+    assert_almost_equal(got.asnumpy(),
+                        np.take(x, [[-1, 7]], axis=0, mode="wrap"))
+    # backward accumulates over duplicate indices
+    a = nd.array(x)
+    a.attach_grad()
+    dup = nd.array(np.array([0, 0, 1], "float32"))
+    with autograd.record():
+        y = nd.take(a, dup, axis=0)
+    y.backward()
+    want = np.zeros_like(x)
+    want[0] = 2
+    want[1] = 1
+    assert_almost_equal(a.grad.asnumpy(), want)
+
+
+def test_pick():
+    rng = _rng(21)
+    x = rng.randn(4, 5).astype("float32")
+    idx = rng.randint(0, 5, (4,)).astype("float32")
+    got = nd.pick(nd.array(x), nd.array(idx), axis=1)
+    assert_almost_equal(got.asnumpy(), x[np.arange(4), idx.astype(int)])
+    got = nd.pick(nd.array(x), nd.array(idx), axis=1, keepdims=True)
+    assert got.shape == (4, 1)
+    # clip mode
+    got = nd.pick(nd.array(x), nd.array(np.array([9.0] * 4, "float32")),
+                  axis=1, mode="clip")
+    assert_almost_equal(got.asnumpy(), x[:, -1])
+
+
+def test_index2d():
+    """reference test_index2d = batch_take."""
+    rng = _rng(22)
+    x = rng.randn(6, 7).astype("float32")
+    idx = rng.randint(0, 7, (6,)).astype("int32")
+    got = nd.batch_take(nd.array(x), nd.array(idx, dtype="int32"))
+    assert_almost_equal(got.asnumpy(), x[np.arange(6), idx])
+
+
+def test_diag():
+    rng = _rng(23)
+    # 1-D -> matrix
+    v = rng.randn(4).astype("float32")
+    assert_almost_equal(nd.diag(nd.array(v)).asnumpy(), np.diag(v))
+    assert_almost_equal(nd.diag(nd.array(v), k=1).asnumpy(), np.diag(v, 1))
+    # 2-D -> diagonal
+    m = rng.randn(4, 5).astype("float32")
+    assert_almost_equal(nd.diag(nd.array(m)).asnumpy(), np.diag(m))
+    assert_almost_equal(nd.diag(nd.array(m), k=-1).asnumpy(),
+                        np.diag(m, -1))
+
+
+def test_depthtospace():
+    rng = _rng(24)
+    b = 2
+    x = rng.randn(1, 4 * b * b, 3, 5).astype("float32")
+    got = nd.depth_to_space(nd.array(x), block_size=b)
+    n, c, h, w = x.shape
+    tmp = x.reshape(n, b, b, c // (b * b), h, w)
+    ref = tmp.transpose(0, 3, 4, 1, 5, 2).reshape(n, c // (b * b),
+                                                  h * b, w * b)
+    assert_almost_equal(got.asnumpy(), ref)
+    # round-trips with spacetodepth
+    back = nd.space_to_depth(got, block_size=b)
+    assert_almost_equal(back.asnumpy(), x)
+
+
+def test_depthtospace_invalid():
+    """invalid depth / space dims / block size raise."""
+    x = nd.zeros((1, 5, 3, 3))
+    with pytest.raises(Exception):
+        nd.depth_to_space(x, block_size=2).asnumpy()
+    with pytest.raises(Exception):
+        nd.space_to_depth(nd.zeros((1, 4, 3, 5)), block_size=2).asnumpy()
+
+
+def test_spacetodepth():
+    rng = _rng(25)
+    b = 2
+    x = rng.randn(1, 3, 4 * b, 5 * b).astype("float32")
+    got = nd.space_to_depth(nd.array(x), block_size=b)
+    n, c, h, w = x.shape
+    tmp = x.reshape(n, c, h // b, b, w // b, b)
+    ref = tmp.transpose(0, 3, 5, 1, 2, 4).reshape(n, c * b * b,
+                                                  h // b, w // b)
+    assert_almost_equal(got.asnumpy(), ref)
+
+
+def test_split_v2():
+    rng = _rng(26)
+    x = rng.randn(6, 4).astype("float32")
+    outs = nd.split_v2(nd.array(x), indices_or_sections=3, axis=0)
+    for i, o in enumerate(outs):
+        assert_almost_equal(o.asnumpy(), x[2 * i:2 * i + 2])
+    outs = nd.split_v2(nd.array(x), indices_or_sections=(1, 4), axis=0)
+    assert_almost_equal(outs[0].asnumpy(), x[:1])
+    assert_almost_equal(outs[1].asnumpy(), x[1:4])
+    assert_almost_equal(outs[2].asnumpy(), x[4:])
+
+
+def test_squeeze_op():
+    x = _rng(27).randn(1, 3, 1, 4).astype("float32")
+    assert nd.squeeze(nd.array(x)).shape == (3, 4)
+    assert nd.squeeze(nd.array(x), axis=0).shape == (3, 1, 4)
+    assert nd.squeeze(nd.array(x), axis=(0, 2)).shape == (3, 4)
+    with pytest.raises(Exception):
+        nd.squeeze(nd.array(x), axis=1).asnumpy()
+
+
+def test_ravel():
+    """ravel_multi_index / unravel_index round trip."""
+    shape = (5, 7)
+    idx = np.array([[1, 4, 0], [3, 2, 6]], "float32")   # (2, N) multi
+    flat = nd.ravel_multi_index(nd.array(idx), shape=shape)
+    ref = np.ravel_multi_index(idx.astype(int), shape)
+    assert (flat.asnumpy().astype(int) == ref).all()
+    back = nd.unravel_index(flat, shape=shape)
+    assert_almost_equal(back.asnumpy(), idx)
+
+
+def test_order():
+    """reference test_order: sort/argsort/topk value+indices agree with
+    numpy orderings."""
+    rng = _rng(28)
+    x = rng.randn(4, 6).astype("float32")
+    assert_almost_equal(nd.sort(nd.array(x), axis=1).asnumpy(),
+                        np.sort(x, axis=1))
+    assert_almost_equal(nd.sort(nd.array(x), axis=1,
+                                is_ascend=False).asnumpy(),
+                        -np.sort(-x, axis=1))
+    assert (nd.argsort(nd.array(x), axis=1).asnumpy().astype(int)
+            == np.argsort(x, axis=1)).all()
+    got = nd.topk(nd.array(x), k=3, axis=1, ret_typ="value")
+    assert_almost_equal(got.asnumpy(), -np.sort(-x, axis=1)[:, :3])
+    gi = nd.topk(nd.array(x), k=3, axis=1).asnumpy().astype(int)
+    ref = np.argsort(-x, axis=1)[:, :3]
+    assert (gi == ref).all()
+    both = nd.topk(nd.array(x), k=2, axis=1, ret_typ="both")
+    assert_almost_equal(both[0].asnumpy(), -np.sort(-x, axis=1)[:, :2])
+    # mask: 1 at the top-k positions
+    m = nd.topk(nd.array(x), k=2, axis=1, ret_typ="mask").asnumpy()
+    assert m.sum() == 8 and m.shape == x.shape
+
+
+def test_arange():
+    assert_almost_equal(nd.arange(10).asnumpy(),
+                        np.arange(10, dtype="float32"))
+    assert_almost_equal(nd.arange(2, 10, 2).asnumpy(),
+                        np.arange(2, 10, 2, dtype="float32"))
+    assert_almost_equal(nd.arange(0, 10, 3, repeat=2).asnumpy(),
+                        np.repeat(np.arange(0, 10, 3), 2).astype("float32"))
+    got = nd.arange(5, dtype="int32")
+    assert got.dtype == np.int32
+
+
+def test_arange_inferstop():
+    # infer_range is the deprecated legacy knob — accepted and inert
+    got = nd.arange(0, 10, infer_range=True)
+    assert got.shape == (10,)
+
+
+def test_arange_like_without_axis():
+    x = nd.zeros((2, 3))
+    got = nd.contrib.arange_like(x)
+    assert got.shape == (2, 3)
+    got = nd.contrib.arange_like(x, axis=1)
+    assert_almost_equal(got.asnumpy(), np.arange(3, dtype="float32"))
+
+
+def test_init():
+    """reference test_init / test_basic_val_init: zeros/ones/full."""
+    assert (nd.zeros((2, 3)).asnumpy() == 0).all()
+    assert (nd.ones((2, 3)).asnumpy() == 1).all()
+    assert (nd.full((2, 3), 7.5).asnumpy() == 7.5).all()
+    z = nd.zeros((2, 3), dtype="int32")
+    assert z.dtype == np.int32
+    e = nd.eye(4)
+    assert_almost_equal(e.asnumpy(), np.eye(4, dtype="float32"))
+    e = nd.eye(3, 5, 1)
+    assert_almost_equal(e.asnumpy(), np.eye(3, 5, 1, dtype="float32"))
+
+
+def test_scatter_gather_nd():
+    rng = _rng(29)
+    x = rng.randn(4, 5).astype("float32")
+    idx = np.array([[0, 2, 3], [1, 0, 4]], "float32")   # (2, N)
+    got = nd.gather_nd(nd.array(x), nd.array(idx))
+    assert_almost_equal(got.asnumpy(), x[[0, 2, 3], [1, 0, 4]])
+    # scatter_nd builds from data
+    data = nd.array(np.array([9.0, 8.0, 7.0], "float32"))
+    scat = nd.scatter_nd(data, nd.array(idx), shape=(4, 5))
+    ref = np.zeros((4, 5), "float32")
+    ref[[0, 2, 3], [1, 0, 4]] = [9, 8, 7]
+    assert_almost_equal(scat.asnumpy(), ref)
+    # gather_nd backward accumulates duplicates
+    a = nd.array(x)
+    a.attach_grad()
+    dup = nd.array(np.array([[0, 0], [1, 1]], "float32"))
+    with autograd.record():
+        y = nd.gather_nd(a, dup)
+    y.backward()
+    want = np.zeros_like(x)
+    want[0, 1] = 2
+    assert_almost_equal(a.grad.asnumpy(), want)
+
+
+def test_index_copy():
+    x = nd.zeros((5, 3))
+    t = nd.array(_rng(30).randn(2, 3).astype("float32"))
+    idx = nd.array(np.array([1, 3], "float32"), dtype="int32")
+    got = nd.contrib.index_copy(x, idx, t)
+    ref = np.zeros((5, 3), "float32")
+    ref[[1, 3]] = t.asnumpy()
+    assert_almost_equal(got.asnumpy(), ref)
+
+
+def test_boolean_mask():
+    x = nd.array(_rng(31).randn(4, 3).astype("float32"))
+    mask = nd.array(np.array([1, 0, 1, 0], "float32"))
+    got = nd.contrib.boolean_mask(x, mask)
+    assert_almost_equal(got.asnumpy(), x.asnumpy()[[0, 2]])
+
+
+def test_slice():
+    """reference test_slice (+forward_backward, begin_equals_end)."""
+    x = _rng(32).randn(4, 5, 6).astype("float32")
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.slice(a, begin=(1, 0, 2), end=(3, 4, 5))
+    y.backward()
+    assert_almost_equal(y.asnumpy(), x[1:3, 0:4, 2:5])
+    want = np.zeros_like(x)
+    want[1:3, 0:4, 2:5] = 1
+    assert_almost_equal(a.grad.asnumpy(), want)
+    # steps, including negative
+    got = nd.slice(nd.array(x), begin=(None, None, None),
+                   end=(None, None, None), step=(1, 2, -1))
+    assert_almost_equal(got.asnumpy(), x[:, ::2, ::-1])
+    # begin == end -> empty
+    got = nd.slice(nd.array(x), begin=(1,), end=(1,))
+    assert got.shape[0] == 0
+
+
+def test_float16_min_max():
+    x = np.array([1.0, 65504.0, -65504.0, 1e-4], "float16")
+    a = nd.array(x, dtype="float16")
+    assert float(nd.max(a).asnumpy()) == 65504.0
+    assert float(nd.min(a).asnumpy()) == -65504.0
+
+
+def test_squeeze_zero_size():
+    """reference zero-size tensor handling family: creation + concat."""
+    z = nd.zeros((0, 4))
+    assert z.shape == (0, 4)
+    c = nd.concat(z, nd.zeros((2, 4)), dim=0)
+    assert c.shape == (2, 4)
+    assert nd.zeros(()).shape == ()       # scalar tensor creation
+
+
+def test_index_array():
+    """reference test_index_array (+default/zero-dim/select_axes)."""
+    x = nd.zeros((3, 2))
+    got = nd.contrib.index_array(x)
+    ref = np.stack(np.meshgrid(np.arange(3), np.arange(2),
+                               indexing="ij"), axis=-1)
+    assert (got.asnumpy().astype(int) == ref).all()
+    got = nd.contrib.index_array(x, axes=(1,))
+    assert (got.asnumpy().astype(int) == ref[..., 1:]).all()
+    # zero-size input keeps the contract
+    z = nd.contrib.index_array(nd.zeros((0, 2)))
+    assert z.shape == (0, 2, 2)
+
+
+def test_tile_invalid_reps():
+    with pytest.raises(Exception):
+        nd.tile(nd.zeros((2, 2)), reps=(-1, 2)).asnumpy()
