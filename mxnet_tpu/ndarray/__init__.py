@@ -8,7 +8,8 @@ import sys as _sys
 from .ndarray import (  # noqa: F401
     NDArray, add, arange, array, concatenate, divide, empty, equal, eye, full,
     greater, greater_equal, invoke, invoke_fn, invoke_op, lesser, lesser_equal,
-    from_dlpack, load, logical_and, logical_or, logical_xor, maximum,
+    from_dlpack, load, load_frombuffer, logical_and, logical_or,
+    logical_xor, maximum,
     minimum, modulo, moveaxis, multiply, not_equal, ones, ones_like, power,
     save, stack, subtract, to_dlpack_for_read, to_dlpack_for_write,
     transpose, waitall, zeros, zeros_like, _as_nd, _wrap,

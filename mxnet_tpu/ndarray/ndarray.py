@@ -541,8 +541,13 @@ def _index_key(key, shape=None):
         _check_int_bounds(key, shape)
     if isinstance(key, NDArray):
         return _index_raw(key)
+    if isinstance(key, list):
+        # advanced indexing with a python list (reference ndarray
+        # indexing); jax requires an array, not a bare sequence
+        return _np.asarray(key)
     if isinstance(key, tuple):
-        return tuple(_index_raw(k) if isinstance(k, NDArray) else k
+        return tuple(_index_raw(k) if isinstance(k, NDArray)
+                     else (_np.asarray(k) if isinstance(k, list) else k)
                      for k in key)
     return key
 
@@ -891,10 +896,15 @@ def _write_shape(f, shape):
 
 def _save_one(f, arr):
     import struct
-    a = _np.ascontiguousarray(arr.asnumpy())
+    # NOT ascontiguousarray: it promotes 0-d scalars to 1-d
+    a = _np.asarray(arr.asnumpy(), order="C")
     if a.dtype == _np.float64:
         pass  # float64 is a legal type flag
-    f.write(struct.pack("<I", _ND_V2_MAGIC))
+    # 0-dim (scalar) arrays need the V3 header: the reference's V2
+    # loader reads ndim==0 as "empty NDArray" and stops (ndarray.cc
+    # legacy load), so scalars round-trip under V3 only
+    f.write(struct.pack("<I", _ND_V3_MAGIC if a.ndim == 0
+                        else _ND_V2_MAGIC))
     f.write(struct.pack("<i", 0))                     # kDefaultStorage
     _write_shape(f, a.shape)
     f.write(struct.pack("<ii", 1, 0))                 # Context: cpu(0)
@@ -924,7 +934,8 @@ def _load_one(f):
         if nad > 0:
             storage_shape = _read_shape(f)
         shape = _read_shape(f)
-        if len(shape) == 0:
+        if len(shape) == 0 and magic == _ND_V2_MAGIC:
+            # legacy "empty NDArray" sentinel — nothing follows it
             return array(_np.zeros(()))
         struct.unpack("<ii", f.read(8))  # context
         (flag,) = struct.unpack("<i", f.read(4))
@@ -1000,27 +1011,24 @@ def save(fname, data):
             f.write(b)
 
 
+def load_frombuffer(buf):
+    """Load NDArrays from in-memory bytes (reference
+    ``ndarray.py load_frombuffer`` / MXNDArrayLoadFromBuffer)."""
+    import io as _io
+    out = _load_stream(_io.BytesIO(buf))
+    if out is None:
+        raise ValueError(
+            "load_frombuffer: buffer is not a dmlc NDArray list stream")
+    return out
+
+
 def load(fname):
     """Load NDArrays (dmlc format incl. legacy versions; `.npz` files from
     earlier dev builds still load)."""
-    import struct
     with open(fname, "rb") as f:
-        head = f.read(16)
-        if len(head) == 16:
-            magic, _reserved = struct.unpack("<QQ", head)
-        else:
-            magic = None
-        if magic == _ND_LIST_MAGIC:
-            (count,) = struct.unpack("<Q", f.read(8))
-            arrays = [_load_one(f) for _ in range(count)]
-            (n_names,) = struct.unpack("<Q", f.read(8))
-            names = []
-            for _ in range(n_names):
-                (ln,) = struct.unpack("<Q", f.read(8))
-                names.append(f.read(ln).decode("utf-8"))
-            if names:
-                return dict(zip(names, arrays))
-            return arrays
+        out = _load_stream(f)
+    if out is not None:
+        return out
     # fallback: .npz container from earlier builds
     d = _np.load(fname, allow_pickle=True)
     names = [str(n) for n in d["__mx_names__"]]
@@ -1028,6 +1036,27 @@ def load(fname):
     if all(n.startswith("arr_") for n in names):
         return arrays
     return dict(zip(names, arrays))
+
+
+def _load_stream(f):
+    import struct
+    head = f.read(16)
+    if len(head) == 16:
+        magic, _reserved = struct.unpack("<QQ", head)
+    else:
+        magic = None
+    if magic == _ND_LIST_MAGIC:
+        (count,) = struct.unpack("<Q", f.read(8))
+        arrays = [_load_one(f) for _ in range(count)]
+        (n_names,) = struct.unpack("<Q", f.read(8))
+        names = []
+        for _ in range(n_names):
+            (ln,) = struct.unpack("<Q", f.read(8))
+            names.append(f.read(ln).decode("utf-8"))
+        if names:
+            return dict(zip(names, arrays))
+        return arrays
+    return None
 
 
 def from_dlpack(ext):

@@ -241,10 +241,10 @@ def upsampling(*args, scale=1, sample_type="nearest", num_args=1,
         from .nn import deconvolution
         k = 2 * s - s % 2
         p = -(-(s - 1) // 2)            # ceil((s-1)/2)
+        # (h-1)*s - 2p + k == s*h exactly for every s — no adj
         return deconvolution(
             data, weight, kernel=(k, k), stride=(s, s),
-            pad=(p, p), adj=(s % 2, s % 2), num_filter=c, num_group=c,
-            no_bias=True)
+            pad=(p, p), num_filter=c, num_group=c, no_bias=True)
     outs = []
     for x in args:
         out = jnp.repeat(jnp.repeat(x, th // x.shape[2], axis=2),
@@ -591,3 +591,19 @@ def _onnx_matmul(a, b):
     contract of ONNX MatMul; the onnx importer maps MatMul here since mx
     ``dot``/``batch_dot`` split that contract by rank."""
     return jnp.matmul(a, b)
+
+
+@register("choose_element_0index")
+def choose_element_0index(lhs, rhs):
+    """Legacy pick-along-dim-1 (reference legacy ``choose_element_0index``
+    in src/operator/tensor/broadcast_reduce_op_index.cc aliases)."""
+    idx = jnp.clip(rhs.astype(jnp.int32), 0, lhs.shape[1] - 1)
+    return jnp.take_along_axis(lhs, idx[:, None], axis=1)[:, 0]
+
+
+@register("fill_element_0index")
+def fill_element_0index(lhs, mhs, rhs):
+    """Legacy fill-along-dim-1: out[i, rhs[i]] = mhs[i] (reference legacy
+    ``fill_element_0index``)."""
+    idx = jnp.clip(rhs.astype(jnp.int32), 0, lhs.shape[1] - 1)
+    return lhs.at[jnp.arange(lhs.shape[0]), idx].set(mhs)

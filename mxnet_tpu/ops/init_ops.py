@@ -38,7 +38,7 @@ def _arange(start=0.0, stop=None, step=1.0, repeat=1, infer_range=False,
     return out
 
 
-@register("_linspace")
+@register("_linspace", aliases=("linspace",))
 def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, ctx=None, dtype="float32"):
     from ..base import parse_bool
     return jnp.linspace(parse_float(start), parse_float(stop), parse_int(num, 50),

@@ -1,0 +1,170 @@
+"""Remaining reference test_contrib_control_flow.py families:
+cut_subgraph_{foreach,while_loop,cond} (control-flow blocks embedded in
+larger graphs keep working through compose/serialize/rebind), scope
+(name scoping around subgraph bodies), contrib_rnn (foreach-driven RNN
+cells match static unrolls, with gradients).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+_rng = np.random.RandomState
+
+
+def _run_sym(sym, arrays, grad_names=()):
+    args = {k: nd.array(v) for k, v in arrays.items()}
+    grads = {k: nd.zeros(arrays[k].shape) for k in grad_names}
+    exe = sym.bind(mx.cpu(), args, args_grad=grads or None)
+    outs = exe.forward(is_train=bool(grad_names))
+    if grad_names:
+        exe.backward(nd.ones(outs[0].shape))
+    return [o.asnumpy() for o in outs], \
+        {k: g.asnumpy() for k, g in grads.items()}
+
+
+def test_cut_subgraph_foreach():
+    """foreach composed INSIDE a larger graph (ops before and after),
+    surviving a JSON round trip (the reference's CutGraphInputs path)."""
+    rng = _rng(0)
+    x = rng.randn(4, 3).astype("float32")
+    init = rng.randn(3).astype("float32")
+
+    data = mx.sym.Variable("data")
+    state = mx.sym.Variable("state")
+    pre = data * 2.0                       # op before the subgraph
+
+    def body(d, s):
+        out = d + s
+        return out, out
+
+    outs, states = mx.sym.contrib.foreach(body, pre, state)
+    final = outs * 3.0 + states[0] if isinstance(states, list) \
+        else outs * 3.0 + states          # ops after the subgraph
+    final = mx.sym.Group([final]) if not isinstance(final, mx.sym.Symbol) \
+        else final
+
+    ref_scan = np.cumsum(2 * x, axis=0) + init
+    want = 3 * ref_scan + ref_scan[-1]
+
+    got, _ = _run_sym(final, {"data": x, "state": init})
+    assert_almost_equal(got[0], want, rtol=1e-5)
+    # JSON round trip preserves the embedded subgraph
+    loaded = mx.sym.load_json(final.tojson())
+    got2, _ = _run_sym(loaded, {"data": x, "state": init})
+    assert_almost_equal(got2[0], want, rtol=1e-5)
+
+
+def test_cut_subgraph_while_loop():
+    rng = _rng(1)
+    init = rng.randn(3).astype("float32")
+
+    s = mx.sym.Variable("s")
+    i = mx.sym.Variable("i")
+    pre = s + 1.0
+
+    outs, states = mx.sym.contrib.while_loop(
+        cond=lambda i, s: i < 4,
+        func=lambda i, s: (None, (i + 1, s * 2.0)),
+        loop_vars=(i, pre), max_iterations=8)
+    final = states[1] - 3.0
+
+    want = (init + 1) * 16 - 3
+    got, _ = _run_sym(final, {"s": init, "i": np.zeros((1,), "float32")})
+    assert_almost_equal(got[0], want, rtol=1e-5)
+    loaded = mx.sym.load_json(final.tojson())
+    got2, _ = _run_sym(loaded, {"s": init,
+                                "i": np.zeros((1,), "float32")})
+    assert_almost_equal(got2[0], want, rtol=1e-5)
+
+
+def test_cut_subgraph_cond():
+    rng = _rng(2)
+    x = rng.randn(3).astype("float32")
+
+    a = mx.sym.Variable("a")
+    flag = mx.sym.Variable("flag")
+    pre = a * 2.0
+    out = mx.sym.contrib.cond(
+        mx.sym.sum(flag) > 0,
+        lambda: pre + 1.0,
+        lambda: pre - 1.0)
+    final = out[0] * 10.0 if isinstance(out, list) else out * 10.0
+
+    got, _ = _run_sym(final, {"a": x, "flag": np.ones((1,), "float32")})
+    assert_almost_equal(got[0], 10 * (2 * x + 1), rtol=1e-5)
+    got, _ = _run_sym(final, {"a": x, "flag": -np.ones((1,), "float32")})
+    assert_almost_equal(got[0], 10 * (2 * x - 1), rtol=1e-5)
+    loaded = mx.sym.load_json(final.tojson())
+    got2, _ = _run_sym(loaded, {"a": x, "flag": np.ones((1,), "float32")})
+    assert_almost_equal(got2[0], 10 * (2 * x + 1), rtol=1e-5)
+
+
+def test_scope():
+    """Name scoping around control-flow bodies: two foreach blocks built
+    under different name managers stay distinct and re-loadable."""
+    x = _rng(3).randn(3, 2).astype("float32")
+
+    def build(tag):
+        with mx.name.Prefix(f"{tag}_"):
+            d = mx.sym.Variable("data")
+            s = mx.sym.Variable("state")
+            outs, _ = mx.sym.contrib.foreach(
+                lambda dd, ss: (dd + ss, dd + ss), d, s)
+            return outs
+
+    s1, s2 = build("alpha"), build("beta")
+    both = mx.sym.Group([s1 * 1.0, s2 * 1.0])
+    names = [n for n in both.tojson().split('"') if "foreach" in n]
+    loaded = mx.sym.load_json(both.tojson())
+    got, _ = _run_sym(loaded, {"data": x, "state": np.zeros(2, "float32")})
+    ref = np.cumsum(x, axis=0)
+    assert_almost_equal(got[0], ref, rtol=1e-5)
+    assert_almost_equal(got[1], ref, rtol=1e-5)
+
+
+def test_contrib_rnn():
+    """foreach driving a gluon RNN cell == the cell's static unroll,
+    forward and parameter gradients (reference test_contrib_rnn)."""
+    from mxnet_tpu import gluon
+    rng = _rng(4)
+    t, b, h = 5, 2, 4
+    x = rng.randn(t, b, h).astype("float32") * 0.5
+
+    for cell_cls in (gluon.rnn.RNNCell, gluon.rnn.GRUCell,
+                     gluon.rnn.LSTMCell):
+        cell = cell_cls(h, input_size=h)
+        cell.initialize()
+        begin = cell.begin_state(batch_size=b)
+
+        # static unroll, step by step
+        states = [s.copy() for s in begin]
+        outs_ref = []
+        params = list(cell.collect_params().values())
+        with autograd.record():
+            for step in range(t):
+                o, states = cell(nd.array(x[step]), states)
+                outs_ref.append(o)
+            loss_ref = sum((o * o).sum() for o in outs_ref)
+        loss_ref.backward()
+        grads_ref = {p.name: p.grad().asnumpy().copy() for p in params}
+        ref_out = np.stack([o.asnumpy() for o in outs_ref])
+
+        # foreach-driven scan over the same cell
+        for p in params:
+            p.zero_grad()
+        states = [s.copy() for s in begin]
+        with autograd.record():
+            outs, _ = nd.contrib.foreach(
+                lambda d, s: cell(d, s), nd.array(x), states)
+            loss = (outs * outs).sum()
+        loss.backward()
+        assert_almost_equal(outs.asnumpy(), ref_out, rtol=1e-4,
+                            atol=1e-5)
+        assert_almost_equal(float(loss.asscalar()),
+                            float(loss_ref.asscalar()), rtol=1e-4)
+        for p in params:
+            assert_almost_equal(p.grad().asnumpy(), grads_ref[p.name],
+                                rtol=1e-3, atol=1e-4), (cell_cls, p.name)

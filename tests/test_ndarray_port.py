@@ -1,0 +1,380 @@
+"""Reference test_ndarray.py port: names mirror
+tests/python/unittest/test_ndarray.py one-for-one; cases already covered
+by tests/test_ndarray.py keep their deeper variants there.
+"""
+import os
+import pickle
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+_rng = np.random.RandomState
+
+
+def test_ndarray_setitem():
+    x = nd.zeros((4, 5))
+    x[1] = 7.0
+    ref = np.zeros((4, 5), "float32")
+    ref[1] = 7
+    assert_almost_equal(x.asnumpy(), ref)
+    x[2, 3] = -1.0
+    ref[2, 3] = -1
+    assert_almost_equal(x.asnumpy(), ref)
+    x[0:2, 1:3] = nd.ones((2, 2))
+    ref[0:2, 1:3] = 1
+    assert_almost_equal(x.asnumpy(), ref)
+    # numpy-array rhs and python-list index
+    x[[3]] = np.full((1, 5), 2.5, "float32")
+    ref[3] = 2.5
+    assert_almost_equal(x.asnumpy(), ref)
+    # negative index
+    x[-1, -1] = 9.0
+    ref[-1, -1] = 9
+    assert_almost_equal(x.asnumpy(), ref)
+
+
+def test_ndarray_elementwise():
+    rng = _rng(0)
+    for dtype in ("float32", "float16"):
+        a = rng.rand(3, 4).astype(dtype) + 0.5
+        b = rng.rand(3, 4).astype(dtype) + 0.5
+        na, nb = nd.array(a, dtype=dtype), nd.array(b, dtype=dtype)
+        rtol = 1e-3 if dtype == "float16" else 1e-5
+        assert_almost_equal((na + nb).asnumpy(), a + b, rtol=rtol)
+        assert_almost_equal((na - nb).asnumpy(), a - b, rtol=rtol,
+                            atol=1e-3 if dtype == "float16" else 1e-6)
+        assert_almost_equal((na * nb).asnumpy(), a * b, rtol=rtol)
+        assert_almost_equal((na / nb).asnumpy(), a / b, rtol=rtol)
+        assert (na + nb).dtype == np.dtype(dtype)
+
+
+def test_ndarray_elementwisesum():
+    rng = _rng(1)
+    arrs = [rng.randn(2, 3).astype("float32") for _ in range(4)]
+    got = nd.ElementWiseSum(*[nd.array(a) for a in arrs])
+    assert_almost_equal(got.asnumpy(), sum(arrs), rtol=1e-5)
+    got = nd.add_n(*[nd.array(a) for a in arrs])
+    assert_almost_equal(got.asnumpy(), sum(arrs), rtol=1e-5)
+
+
+def test_ndarray_negate():
+    x = _rng(2).randn(3, 4).astype("float32")
+    a = nd.array(x)
+    assert_almost_equal((-a).asnumpy(), -x)
+    # negation leaves the original untouched
+    assert_almost_equal(a.asnumpy(), x)
+
+
+def test_ndarray_reshape():
+    x = nd.array(np.arange(24, dtype="float32"))
+    # method form with tuple, ints, and kwargs
+    assert x.reshape((4, 6)).shape == (4, 6)
+    assert x.reshape(4, 6).shape == (4, 6)
+    assert x.reshape((-1, 6)).shape == (4, 6)
+    assert x.reshape(shape=(2, 12)).shape == (2, 12)
+    y = x.reshape(2, 3, 4)
+    assert_almost_equal(y.asnumpy().ravel(), x.asnumpy())
+    # -2/-3/-4 codes through the method
+    assert y.reshape((-3, 4)).shape == (6, 4)
+    assert y.reshape((0, 0, -4, 2, 2)).shape == (2, 3, 2, 2)
+
+
+def test_ndarray_choose():
+    rng = _rng(3)
+    x = rng.randn(4, 5).astype("float32")
+    idx = np.array([1, 0, 3, 4], "float32")
+    got = nd.choose_element_0index(nd.array(x), nd.array(idx))
+    assert_almost_equal(got.asnumpy(), x[np.arange(4), idx.astype(int)])
+
+
+def test_ndarray_fill():
+    rng = _rng(4)
+    x = rng.randn(4, 5).astype("float32")
+    idx = np.array([1, 0, 3, 4], "float32")
+    vals = np.array([9.0, 8.0, 7.0, 6.0], "float32")
+    got = nd.fill_element_0index(nd.array(x), nd.array(vals),
+                                 nd.array(idx))
+    ref = x.copy()
+    ref[np.arange(4), idx.astype(int)] = vals
+    assert_almost_equal(got.asnumpy(), ref)
+
+
+def test_ndarray_onehot():
+    idx = nd.array(np.array([2, 0, 1], "float32"))
+    got = nd.one_hot(idx, depth=3)
+    assert_almost_equal(got.asnumpy(),
+                        np.eye(3, dtype="float32")[[2, 0, 1]])
+
+
+def test_init_from_scalar():
+    x = nd.array(3.5)
+    assert x.shape == () and float(x.asnumpy()) == 3.5
+    y = nd.array([5])
+    assert y.shape == (1,)
+
+
+def test_ndarray_copy():
+    x = nd.array(_rng(5).randn(3, 4).astype("float32"))
+    y = x.copy()
+    y[0] = 0.0
+    assert np.abs(x.asnumpy()[0]).sum() > 0     # deep copy
+
+
+def test_ndarray_scalar():
+    x = nd.zeros((2, 3))
+    x[:] = 5.0
+    assert (x.asnumpy() == 5).all()
+    x[:] = x + 1.0
+    assert (x.asnumpy() == 6).all()
+    assert float((x * 0 + 2).sum().asscalar()) == 12.0
+
+
+def test_ndarray_pickle():
+    x = nd.array(_rng(6).randn(3, 4).astype("float32"))
+    data = pickle.dumps(x)
+    y = pickle.loads(data)
+    assert_almost_equal(x.asnumpy(), y.asnumpy())
+    # sparse round trip
+    from mxnet_tpu.ndarray import sparse as sp
+    s = sp.csr_matrix(np.eye(3, dtype="float32"))
+    s2 = pickle.loads(pickle.dumps(s))
+    assert s2.stype == "csr"
+    assert_almost_equal(s2.asnumpy(), np.eye(3, dtype="float32"))
+
+
+def test_ndarray_saveload():
+    rng = _rng(7)
+    arrays = [nd.array(rng.randn(3, 4).astype("float32")),
+              nd.array(rng.randn(5).astype("float16"), dtype="float16")]
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "arrs")
+        # list save/load
+        nd.save(path, arrays)
+        loaded = nd.load(path)
+        assert isinstance(loaded, list)
+        for a, b in zip(arrays, loaded):
+            assert a.dtype == b.dtype
+            assert_almost_equal(np.asarray(a.asnumpy(), "float64"),
+                                np.asarray(b.asnumpy(), "float64"))
+        # dict save/load
+        nd.save(path, {"w": arrays[0], "b": arrays[1]})
+        loaded = nd.load(path)
+        assert sorted(loaded) == ["b", "w"]
+        assert_almost_equal(loaded["w"].asnumpy(), arrays[0].asnumpy())
+
+
+def test_buffer_load():
+    """load_buffer parses the same bytes save() wrote."""
+    x = nd.array(_rng(8).randn(2, 3).astype("float32"))
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "one")
+        nd.save(path, [x])
+        raw = open(path, "rb").read()
+    loaded = nd.load_frombuffer(raw) if hasattr(nd, "load_frombuffer") \
+        else nd.load_buffer(raw)
+    assert_almost_equal(loaded[0].asnumpy(), x.asnumpy())
+
+
+def test_ndarray_slice():
+    x = _rng(9).randn(6, 4).astype("float32")
+    a = nd.array(x)
+    assert_almost_equal(a[2:5].asnumpy(), x[2:5])
+    assert_almost_equal(a.slice(begin=(1, 0), end=(3, 2)).asnumpy(),
+                        x[1:3, 0:2])
+    # writes through a slice land in the base array
+    a[2:4] = 0.0
+    x[2:4] = 0
+    assert_almost_equal(a.asnumpy(), x)
+
+
+def test_ndarray_crop():
+    x = _rng(10).randn(4, 5, 6).astype("float32")
+    got = nd.crop(nd.array(x), begin=(1, 1, 2), end=(3, 4, 5))
+    assert_almost_equal(got.asnumpy(), x[1:3, 1:4, 2:5])
+
+
+def test_ndarray_concatenate():
+    rng = _rng(11)
+    parts = [rng.randn(2, 3).astype("float32") for _ in range(3)]
+    got = nd.concatenate([nd.array(p) for p in parts], axis=0)
+    assert_almost_equal(got.asnumpy(), np.concatenate(parts, axis=0))
+    got = nd.concatenate([nd.array(p) for p in parts], axis=1)
+    assert_almost_equal(got.asnumpy(), np.concatenate(parts, axis=1))
+
+
+def test_moveaxis():
+    x = _rng(12).randn(2, 3, 4).astype("float32")
+    got = nd.moveaxis(nd.array(x), 0, 2)
+    assert_almost_equal(got.asnumpy(), np.moveaxis(x, 0, 2))
+    got = nd.moveaxis(nd.array(x), -1, 0)
+    assert_almost_equal(got.asnumpy(), np.moveaxis(x, -1, 0))
+
+
+def test_linspace():
+    got = nd.linspace(2, 9, 7)
+    assert_almost_equal(got.asnumpy(),
+                        np.linspace(2, 9, 7).astype("float32"))
+    got = nd.linspace(0, 1, 5, endpoint=False)
+    assert_almost_equal(got.asnumpy(),
+                        np.linspace(0, 1, 5, endpoint=False)
+                        .astype("float32"))
+
+
+@pytest.mark.parametrize("op,npop", [
+    ("__eq__", np.equal), ("__ne__", np.not_equal),
+    ("__gt__", np.greater), ("__ge__", np.greater_equal),
+    ("__lt__", np.less), ("__le__", np.less_equal),
+])
+def test_ndarray_comparisons(op, npop):
+    """reference test_ndarray_equal/_not_equal/_greater/... family."""
+    rng = _rng(13)
+    x = rng.randint(0, 3, (4, 4)).astype("float32")
+    y = rng.randint(0, 3, (4, 4)).astype("float32")
+    got = getattr(nd.array(x), op)(nd.array(y))
+    assert_almost_equal(got.asnumpy(), npop(x, y).astype("float32"))
+    # against a scalar
+    got = getattr(nd.array(x), op)(1.0)
+    assert_almost_equal(got.asnumpy(), npop(x, 1.0).astype("float32"))
+
+
+def test_iter():
+    x = nd.array(np.arange(12, dtype="float32").reshape(4, 3))
+    rows = [r.asnumpy() for r in x]
+    assert len(rows) == 4
+    assert_almost_equal(np.stack(rows), x.asnumpy())
+
+
+def test_output():
+    """out= keyword writes into the destination array."""
+    x = nd.array(np.ones((3, 3), "float32"))
+    out = nd.zeros((3, 3))
+    nd.elemwise_add(x, x, out=out)
+    assert (out.asnumpy() == 2).all()
+
+
+def test_ndarray_fluent():
+    """Fluent method chaining mirrors the functional ops."""
+    rng = _rng(14)
+    x = np.abs(rng.randn(3, 4)).astype("float32") + 0.5
+    a = nd.array(x)
+    assert_almost_equal(a.sqrt().asnumpy(), np.sqrt(x), rtol=1e-5)
+    assert_almost_equal(a.log().exp().asnumpy(), x, rtol=1e-4)
+    assert_almost_equal(a.square().asnumpy(), x * x, rtol=1e-5)
+    assert_almost_equal(a.sum(axis=1).asnumpy(), x.sum(axis=1),
+                        rtol=1e-5)
+    assert_almost_equal(a.mean().asnumpy(), x.mean(), rtol=1e-5)
+    assert_almost_equal(a.clip(0.6, 1.0).asnumpy(), np.clip(x, 0.6, 1.0))
+    assert_almost_equal(a.transpose().asnumpy(), x.T)
+    assert a.expand_dims(0).shape == (1, 3, 4)
+    assert_almost_equal(a.flatten().asnumpy(), x.reshape(3, 4))
+    assert_almost_equal(a.abs().asnumpy(), np.abs(x))
+    assert_almost_equal(a.sign().asnumpy(), np.sign(x))
+    assert a.argmax(axis=1).shape == (3,)
+    assert_almost_equal(a.max().asnumpy(), x.max(), rtol=1e-6)
+    assert_almost_equal(a.softmax().asnumpy(),
+                        np.exp(x) / np.exp(x).sum(axis=-1, keepdims=True),
+                        rtol=1e-4)
+
+
+def test_bool_ambiguous():
+    with pytest.raises(Exception):
+        bool(nd.ones((2, 2)))
+
+
+def test_bool():
+    assert bool(nd.ones((1,)))
+    assert not bool(nd.zeros((1,)))
+    assert bool(nd.array([3.0]))
+
+
+def test_assign_float_value_to_ndarray():
+    x = nd.zeros((2, 2))
+    x[0, 0] = 2.5
+    assert float(x.asnumpy()[0, 0]) == 2.5
+    x[:] = 1.25
+    assert (x.asnumpy() == 1.25).all()
+
+
+def test_assign_large_int_to_ndarray():
+    x = nd.zeros((2, 2), dtype="int32")
+    x[0, 0] = 2 ** 30
+    assert int(x.asnumpy()[0, 0]) == 2 ** 30
+
+
+def test_assign_a_row_to_ndarray():
+    rng = _rng(15)
+    x = nd.array(rng.randn(3, 4).astype("float32"))
+    row = rng.randn(4).astype("float32")
+    x[1] = nd.array(row)
+    assert_almost_equal(x.asnumpy()[1], row)
+    x[0] = row                      # numpy rhs
+    assert_almost_equal(x.asnumpy()[0], row)
+
+
+def test_ndarray_astype():
+    x = nd.array(np.array([1.6, -1.6, 2.0], "float32"))
+    for dtype in ("float16", "int32", "uint8", "float32"):
+        y = x.astype(dtype) if dtype != "uint8" \
+            else nd.array(np.array([1.6, 0.2, 2.0], "float32")) \
+            .astype(dtype)
+        assert y.dtype == np.dtype(dtype)
+    z = x.astype("int32")
+    assert z.asnumpy().tolist() == [1, -1, 2]   # truncation, not rounding
+    # astype(copy=False) may return self when dtype already matches
+    w = x.astype("float32", copy=False)
+    assert w.dtype == np.float32
+
+
+def test_ndarray_is_inf():
+    x = nd.array(np.array([np.inf, -np.inf, 1.0, np.nan], "float32"))
+    got = nd.contrib.isinf(x) if hasattr(nd.contrib, "isinf") \
+        else nd.isinf(x)
+    assert got.asnumpy().astype(bool).tolist() == [True, True, False,
+                                                   False]
+
+
+def test_ndarray_is_finite():
+    x = nd.array(np.array([np.inf, 1.0, np.nan, -2.0], "float32"))
+    got = nd.isfinite(x)
+    assert got.asnumpy().astype(bool).tolist() == [False, True, False,
+                                                   True]
+
+
+def test_ndarray_is_nan():
+    x = nd.array(np.array([np.nan, 1.0, np.inf], "float32"))
+    got = nd.isnan(x)
+    assert got.asnumpy().astype(bool).tolist() == [True, False, False]
+
+
+def test_ndarray_nan_comparison():
+    """reference mshadow maximum = (a > b ? a : b): a NaN lhs loses the
+    comparison, a NaN rhs is returned — NOT ieee fmax."""
+    a = nd.array(np.array([np.nan, 1.0, 2.0], "float32"))
+    b = nd.array(np.array([1.0, 1.0, np.nan], "float32"))
+    mx_max = nd.maximum(a, b).asnumpy()
+    assert not np.isnan(mx_max[0]) and mx_max[0] == 1.0
+    assert mx_max[1] == 1.0
+    assert np.isnan(mx_max[2])
+    eq = (a == a).asnumpy()
+    assert eq[0] == 0.0             # NaN != NaN
+
+
+def test_zero_from_numpy():
+    z = nd.array(np.zeros((0, 4), "float32"))
+    assert z.shape == (0, 4)
+    assert z.asnumpy().shape == (0, 4)
+
+
+def test_save_load_scalar_zero_size_ndarrays():
+    arrays = [nd.array(3.0), nd.zeros((0, 3))]
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "mixed")
+        nd.save(path, arrays)
+        loaded = nd.load(path)
+    assert loaded[0].shape == () and float(loaded[0].asnumpy()) == 3.0
+    assert loaded[1].shape == (0, 3)

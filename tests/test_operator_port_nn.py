@@ -600,3 +600,18 @@ def test_ctc_loss_with_large_classes():
     loss = nd.CTCLoss(pred, label, blank_label="last")
     assert loss.shape == (b,)
     assert np.isfinite(loss.asnumpy()).all()
+
+
+def test_bilinear_upsampling_odd_scale():
+    """Regression: odd scales must give exactly s*h (no adj term)."""
+    rng = _rng(33)
+    x = rng.randn(1, 2, 4, 4).astype("float32")
+    w = nd.ones((2, 1, 5, 5))
+    out = nd.UpSampling(nd.array(x), w, scale=3, sample_type="bilinear",
+                        num_filter=2)
+    assert out.shape == (1, 2, 12, 12)
+    # scale=1 with a 1x1 weight is the identity conv
+    w1 = nd.ones((2, 1, 1, 1))
+    out = nd.UpSampling(nd.array(x), w1, scale=1, sample_type="bilinear",
+                        num_filter=2)
+    np.testing.assert_allclose(out.asnumpy(), x, rtol=1e-5)
