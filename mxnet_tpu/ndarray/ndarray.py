@@ -542,14 +542,22 @@ def _index_key(key, shape=None):
     if isinstance(key, NDArray):
         return _index_raw(key)
     if isinstance(key, list):
-        # advanced indexing with a python list (reference ndarray
-        # indexing); jax requires an array, not a bare sequence
-        return _np.asarray(key)
+        return _list_index(key)
     if isinstance(key, tuple):
         return tuple(_index_raw(k) if isinstance(k, NDArray)
-                     else (_np.asarray(k) if isinstance(k, list) else k)
+                     else (_list_index(k) if isinstance(k, list) else k)
                      for k in key)
     return key
+
+
+def _list_index(key):
+    # advanced indexing with a python list (reference ndarray indexing);
+    # jax requires an integer ARRAY — empty and float lists cast to
+    # int32 like _index_raw does for NDArray indexers
+    arr = _np.asarray(key)
+    if arr.dtype == bool:
+        return arr
+    return arr.astype(_np.int32, copy=False)
 
 
 def _wrap(raw):

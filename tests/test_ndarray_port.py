@@ -378,3 +378,14 @@ def test_save_load_scalar_zero_size_ndarrays():
         loaded = nd.load(path)
     assert loaded[0].shape == () and float(loaded[0].asnumpy()) == 3.0
     assert loaded[1].shape == (0, 3)
+
+
+def test_list_index_empty_and_float():
+    """Empty and float list indexers cast to int like NDArray indexers
+    (review regression)."""
+    x = nd.array(np.arange(6, dtype="float32").reshape(2, 3))
+    assert x[[]].shape == (0, 3)
+    got = x[[0.0, 1.0]]
+    assert_almost_equal(got.asnumpy(), x.asnumpy())
+    m = x[[True, False]]
+    assert m.shape == (1, 3)
