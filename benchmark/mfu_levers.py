@@ -55,19 +55,29 @@ def run_config(name, batch, layout, mutate=None, note=None):
     flops = bench._cost_flops(compiled) or \
         bench._RESNET50_TRAIN_FLOPS * batch
 
-    for _ in range(3):
-        state, loss = compiled(state, x, y, key, t)
-    float(np.asarray(loss))
-    times = []
-    rtt = bench._fetch_rtt()
-    for _ in range(5):
-        t0 = time.perf_counter()
+    # reuse bench.py's measurement harness: param-leaf value-fetch sync
+    # (the loss alone is ready before the final backward+update) + the
+    # rtt-subtracted block timing with unreliability flagging
+    state_box = [state]
+
+    def run_block():
+        st = state_box[0]
         for _ in range(20):
-            state, loss = compiled(state, x, y, key, t)
-        float(np.asarray(loss))
-        times.append(max(time.perf_counter() - t0 - rtt, 0.0) / 20)
-    p50 = float(np.percentile(times, 50))
+            st, _loss = compiled(st, x, y, key, t)
+        state_box[0] = st
+
+    def sync():
+        float(np.asarray(jnp.sum(jax.tree_util.tree_leaves(
+            state_box[0])[0].astype(jnp.float32))))
+
+    run_block()          # warm (post-compile)
+    sync()
+    times = bench._time_blocks(run_block, 5, sync)
+    per_step = [bt / 20 for bt in times]
+    p50 = max(float(np.percentile(per_step, 50)), 1e-12)
     out = {"config": name, "batch": batch, "layout": layout,
+           "sync_dominated_blocks":
+               getattr(bench._time_blocks, "last_sync_dominated", 0),
            "step_ms_p50": round(p50 * 1e3, 3),
            "img_per_sec": round(batch / p50, 1),
            "flops_per_step": float(f"{flops:.4g}"),

@@ -75,6 +75,9 @@ stage_examples() {
   python example/fcn-xs/fcn_xs.py --epochs 8
   python example/recommenders/matrix_fact.py --epochs 15
   python example/bi-lstm-sort/bi_lstm_sort.py --epochs 12
+  python example/adversary/adversary_generation.py --epochs 10
+  python example/cnn_text_classification/text_cnn.py --epochs 8
+  python example/svm_mnist/svm_mnist.py --epochs 8
 }
 
 stage_bench() {

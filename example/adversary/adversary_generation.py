@@ -1,0 +1,91 @@
+"""FGSM adversarial examples (reference
+``example/adversary/adversary_generation.ipynb``): train a small
+classifier, then perturb inputs along the sign of the input gradient and
+show accuracy collapses while the perturbation stays tiny.
+
+TPU-native shape: the attack is one ``autograd`` pass w.r.t. the INPUT
+(``x.attach_grad()``), the same tape that trains the weights.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def synth_digits(rng, n, protos, noise=0.4):
+    """4-class 'digit' blobs: shared 8x8 prototypes + noise."""
+    y = rng.randint(0, 4, n)
+    x = protos[y] + noise * rng.randn(n, 8, 8).astype("float32")
+    return x.astype("float32"), y.astype("float32")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--samples", type=int, default=1024)
+    ap.add_argument("--epsilon", type=float, default=0.6)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ctx = mx.gpu(0) if mx.context.num_gpus() else mx.cpu(0)
+    rng = np.random.RandomState(0)
+    protos = (rng.rand(4, 8, 8) > 0.5).astype("float32")
+    X, Y = synth_digits(rng, args.samples, protos)
+
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(64, activation="relu"),
+                gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    net.hybridize()
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+
+    batch = 128
+    for epoch in range(args.epochs):
+        tot, nb = 0.0, 0
+        perm = rng.permutation(args.samples)
+        for i in range(0, args.samples - batch + 1, batch):
+            idx = perm[i:i + batch]
+            xb = mx.nd.array(X[idx], ctx=ctx)
+            yb = mx.nd.array(Y[idx], ctx=ctx)
+            with autograd.record():
+                loss = sce(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asscalar())
+            nb += 1
+        logging.info("epoch %d loss %.4f", epoch, tot / nb)
+
+    Xt, Yt = synth_digits(rng, 512, protos)
+    xt = mx.nd.array(Xt, ctx=ctx)
+    yt = mx.nd.array(Yt, ctx=ctx)
+    clean_acc = float((net(xt).argmax(axis=1).asnumpy()
+                       == Yt).mean())
+
+    # FGSM: x_adv = x + eps * sign(d loss / d x)
+    xt.attach_grad()
+    with autograd.record():
+        loss = sce(net(xt), yt).sum()
+    loss.backward()
+    x_adv = xt + args.epsilon * mx.nd.sign(xt.grad)
+    adv_acc = float((net(x_adv).argmax(axis=1).asnumpy() == Yt).mean())
+    linf = float(mx.nd.abs(x_adv - xt).max().asscalar())
+
+    assert clean_acc > 0.9, clean_acc
+    assert adv_acc < clean_acc - 0.3, (clean_acc, adv_acc)
+    assert linf <= args.epsilon + 1e-5
+    logging.info("FGSM adversary: clean acc %.3f -> adversarial %.3f at "
+                 "L-inf %.2f", clean_acc, adv_acc, linf)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""CNN text classification (reference
+``example/cnn_text_classification/text_cnn.py``, Kim 2014): embed a
+token sequence, run parallel 1-D convolutions with several kernel
+widths, global-max-pool each, concat, classify.
+
+Synthetic task: class = which keyword n-gram appears in the sequence;
+exactly what width-matched conv filters + max-over-time detect.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+VOCAB, SEQ = 100, 20
+
+
+class TextCNN(gluon.nn.HybridBlock):
+    def __init__(self, vocab, embed, classes, widths=(2, 3, 4),
+                 channels=16, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.embed = gluon.nn.Embedding(vocab, embed)
+            self.convs = []
+            for i, w in enumerate(widths):
+                conv = gluon.nn.Conv1D(channels, kernel_size=w,
+                                       activation="relu")
+                setattr(self, f"conv{i}", conv)   # registers the child
+                self.convs.append(conv)
+            self.pool = gluon.nn.GlobalMaxPool1D()
+            self.out = gluon.nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        e = self.embed(x).transpose((0, 2, 1))     # (B, E, T)
+        feats = [self.pool(conv(e)).flatten() for conv in self.convs]
+        return self.out(F.concat(*feats, dim=1))
+
+
+def synth(rng, n):
+    """Plant one of 3 keyword bigrams/trigrams into random token noise."""
+    patterns = [(7, 8), (11, 12, 13), (17, 18)]
+    x = rng.randint(20, VOCAB, (n, SEQ))
+    y = rng.randint(0, len(patterns), n)
+    for i in range(n):
+        pat = patterns[y[i]]
+        pos = rng.randint(0, SEQ - len(pat))
+        x[i, pos:pos + len(pat)] = pat
+    return x.astype("int32"), y.astype("float32")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--samples", type=int, default=2048)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ctx = mx.gpu(0) if mx.context.num_gpus() else mx.cpu(0)
+    rng = np.random.RandomState(0)
+    X, Y = synth(rng, args.samples)
+    Xt, Yt = synth(rng, 512)
+
+    net = TextCNN(VOCAB, embed=16, classes=3)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    net.hybridize()
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.005})
+
+    batch = 128
+    first = avg = None
+    for epoch in range(args.epochs):
+        tot, nb = 0.0, 0
+        perm = rng.permutation(args.samples)
+        for i in range(0, args.samples - batch + 1, batch):
+            idx = perm[i:i + batch]
+            xb = mx.nd.array(X[idx], ctx=ctx, dtype="int32")
+            yb = mx.nd.array(Y[idx], ctx=ctx)
+            with autograd.record():
+                loss = sce(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asscalar())
+            nb += 1
+        avg = tot / nb
+        first = first or avg
+        logging.info("epoch %d loss %.4f", epoch, avg)
+
+    acc = float((net(mx.nd.array(Xt, ctx=ctx, dtype="int32"))
+                 .argmax(axis=1).asnumpy() == Yt).mean())
+    assert avg < first * 0.3, (first, avg)
+    assert acc > 0.9, acc
+    logging.info("text-cnn: loss %.3f->%.3f, held-out acc %.3f",
+                 first, avg, acc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""SVM output layer training (reference ``example/svm_mnist``): an MLP
+trained with the max-margin ``SVMOutput`` head (L2-SVM) through the
+Module API instead of softmax cross-entropy.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def synth_clusters(rng, n, centers):
+    y = rng.randint(0, centers.shape[0], n)
+    x = centers[y] + 0.6 * rng.randn(n, centers.shape[1])         .astype("float32")
+    return x, y.astype("float32")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--samples", type=int, default=2048)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    centers = rng.randn(4, 16).astype("float32") * 2.0
+    X, Y = synth_clusters(rng, args.samples, centers)
+    Xt, Yt = synth_clusters(rng, 512, centers)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SVMOutput(net, mx.sym.Variable("svm_label"),
+                           margin=1.0, regularization_coefficient=1e-3,
+                           use_linear=False, name="svm")
+
+    ctx = mx.gpu(0) if mx.context.num_gpus() else mx.cpu(0)
+    train_it = mx.io.NDArrayIter(X, Y, batch_size=128, shuffle=True,
+                                 label_name="svm_label")
+    val_it = mx.io.NDArrayIter(Xt, Yt, batch_size=128,
+                               label_name="svm_label")
+    mod = mx.mod.Module(net, context=ctx, label_names=("svm_label",))
+    mod.fit(train_it, eval_data=val_it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.init.Xavier(), num_epoch=args.epochs,
+            eval_metric="acc")
+
+    score = dict(mod.score(val_it, mx.metric.Accuracy()))
+    acc = score["accuracy"]
+    assert acc > 0.9, acc
+    logging.info("svm_mnist: max-margin SVMOutput training reached "
+                 "held-out acc %.3f", acc)
+
+
+if __name__ == "__main__":
+    main()

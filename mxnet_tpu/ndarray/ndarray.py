@@ -515,7 +515,7 @@ def _check_int_bounds(key, shape):
     # only pure basic indexing is checked: masks and index arrays follow
     # advanced/take semantics (clamp like nd.take), and a bool/array
     # element consumes a variable number of axes the walker cannot track
-    if any(isinstance(k, (bool, _np.bool_, NDArray, _np.ndarray))
+    if any(isinstance(k, (bool, _np.bool_, NDArray, _np.ndarray, list))
            or hasattr(k, "dtype") for k in keys):
         return
     dim = 0

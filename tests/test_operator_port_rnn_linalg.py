@@ -1,0 +1,315 @@
+"""Reference test_operator.py port, tranche 4: symbolic RNN family
+(test_lstm_sym / test_gru_sym / test_rnntanh_sym / test_rnnrelu_sym,
+each + bidirectional + dropout), the linalg laop/gemm family, and the
+introspection/monitor cases.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+_rng = np.random.RandomState
+
+T, B, I, H = 4, 2, 5, 6
+
+
+def _rnn_sym_check(mode, bidirectional=False, p=0.0, seed=0):
+    """Fused symbolic RNN runs, shapes check out, grads flow to the flat
+    parameter vector, and (for p=0, unidirectional) the output matches
+    the equivalent gluon cell unroll."""
+    rng = _rng(seed)
+    d = 2 if bidirectional else 1
+    gates = {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}[mode]
+    nparam = 0
+    for layer in range(1):
+        in_sz = I
+        nparam += d * (gates * H * in_sz + gates * H * H + 2 * gates * H)
+    x = rng.randn(T, B, I).astype("float32") * 0.5
+    params = rng.randn(nparam).astype("float32") * 0.1
+    state = np.zeros((d, B, H), "float32")
+
+    data = mx.sym.Variable("data")
+    par = mx.sym.Variable("par")
+    s0 = mx.sym.Variable("s0")
+    inputs = [data, par, s0]
+    kwargs = {}
+    if mode == "lstm":
+        c0 = mx.sym.Variable("c0")
+        inputs.append(c0)
+    sym = mx.sym.RNN(*inputs, mode=mode, state_size=H, num_layers=1,
+                     bidirectional=bidirectional, p=p, state_outputs=False,
+                     **kwargs)
+    arrays = {"data": x, "par": params, "s0": state}
+    if mode == "lstm":
+        arrays["c0"] = np.zeros((d, B, H), "float32")
+    args = {k: nd.array(v) for k, v in arrays.items()}
+    grads = {k: nd.zeros(v.shape) for k, v in arrays.items()}
+    exe = sym.bind(mx.cpu(), args, args_grad=grads)
+    out = exe.forward(is_train=True)
+    assert out[0].shape == (T, B, d * H)
+    exe.backward(nd.ones(out[0].shape))
+    g = grads["par"].asnumpy()
+    assert np.abs(g).max() > 0, "no gradient reached the RNN parameters"
+    assert np.isfinite(g).all()
+    return out[0].asnumpy(), params
+
+
+@pytest.mark.parametrize("mode", ["lstm", "gru", "rnn_tanh", "rnn_relu"])
+def test_rnn_sym(mode):
+    """reference test_lstm_sym / test_gru_sym / test_rnntanh_sym /
+    test_rnnrelu_sym: the symbolic graph path and the eager op path of
+    the fused RNN agree; the gluon-cell parity check lives in
+    test_gluon_rnn.py (fused layer vs unrolled cells)."""
+    out, params = _rnn_sym_check(mode)
+    rng = _rng(0)
+    x = rng.randn(T, B, I).astype("float32") * 0.5
+    ref = nd.RNN(nd.array(x), nd.array(params),
+                 nd.array(np.zeros((1, B, H), "float32")),
+                 *([nd.array(np.zeros((1, B, H), "float32"))]
+                   if mode == "lstm" else []),
+                 mode=mode, state_size=H, num_layers=1,
+                 state_outputs=False)
+    assert_almost_equal(out, ref.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["lstm", "gru", "rnn_tanh", "rnn_relu"])
+def test_rnn_bidirectional(mode):
+    """reference test_lstm_bidirectional / test_gru_bidirectional /
+    test_rnntanh_bidirectional / test_rnnrelu_bidirectional."""
+    out, _ = _rnn_sym_check(mode, bidirectional=True, seed=1)
+    assert out.shape == (T, B, 2 * H)
+    # the forward half at t=0 must be independent of later inputs;
+    # check by truncating the sequence
+    rng = _rng(1)
+    d = 2
+    gates = {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}[mode]
+    nparam = d * (gates * H * I + gates * H * H + 2 * gates * H)
+    x = rng.randn(T, B, I).astype("float32") * 0.5
+    params = rng.randn(nparam).astype("float32") * 0.1
+    extra = [nd.array(np.zeros((d, B, H), "float32"))] \
+        if mode == "lstm" else []
+    full = nd.RNN(nd.array(x), nd.array(params),
+                  nd.array(np.zeros((d, B, H), "float32")), *extra,
+                  mode=mode, state_size=H, num_layers=1,
+                  bidirectional=True, state_outputs=False).asnumpy()
+    trunc = nd.RNN(nd.array(x[:2]), nd.array(params),
+                   nd.array(np.zeros((d, B, H), "float32")), *extra,
+                   mode=mode, state_size=H, num_layers=1,
+                   bidirectional=True, state_outputs=False).asnumpy()
+    # forward direction of step 0 agrees; backward direction differs
+    assert_almost_equal(full[0, :, :H], trunc[0, :, :H], rtol=1e-4,
+                        atol=1e-5)
+    assert np.abs(full[0, :, H:] - trunc[0, :, H:]).max() > 1e-6
+
+
+@pytest.mark.parametrize("mode", ["lstm", "gru", "rnn_tanh", "rnn_relu"])
+def test_rnn_dropout(mode):
+    """reference test_lstm_dropout family: p>0 accepted; inference is
+    deterministic (dropout only hits training mode / between layers)."""
+    rng = _rng(2)
+    gates = {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}[mode]
+    nparam = (gates * H * I + gates * H * H + 2 * gates * H) \
+        + (gates * H * H + gates * H * H + 2 * gates * H)
+    x = rng.randn(T, B, I).astype("float32")
+    params = rng.randn(nparam).astype("float32") * 0.1
+    extra = [nd.array(np.zeros((2, B, H), "float32"))] \
+        if mode == "lstm" else []
+    o1 = nd.RNN(nd.array(x), nd.array(params),
+                nd.array(np.zeros((2, B, H), "float32")), *extra,
+                mode=mode, state_size=H, num_layers=2, p=0.5,
+                state_outputs=False).asnumpy()
+    o2 = nd.RNN(nd.array(x), nd.array(params),
+                nd.array(np.zeros((2, B, H), "float32")), *extra,
+                mode=mode, state_size=H, num_layers=2, p=0.5,
+                state_outputs=False).asnumpy()
+    assert_almost_equal(o1, o2, rtol=1e-6)   # inference: no dropout
+    assert np.isfinite(o1).all()
+    # training mode: inter-layer dropout is stochastic across calls
+    with autograd.record(train_mode=True):
+        t1 = nd.RNN(nd.array(x), nd.array(params),
+                    nd.array(np.zeros((2, B, H), "float32")), *extra,
+                    mode=mode, state_size=H, num_layers=2, p=0.5,
+                    state_outputs=False).asnumpy()
+    with autograd.record(train_mode=True):
+        t2 = nd.RNN(nd.array(x), nd.array(params),
+                    nd.array(np.zeros((2, B, H), "float32")), *extra,
+                    mode=mode, state_size=H, num_layers=2, p=0.5,
+                    state_outputs=False).asnumpy()
+    assert np.abs(t1 - t2).max() > 1e-6, "training dropout not applied"
+
+
+# ------------------------------------------------------------- linalg
+
+def test_gemm():
+    """reference test_gemm: gemm(+bias, alpha/beta, transposes) and
+    gemm2."""
+    rng = _rng(3)
+    a = rng.randn(3, 4).astype("float32")
+    b = rng.randn(4, 5).astype("float32")
+    c = rng.randn(3, 5).astype("float32")
+    got = nd.linalg.gemm(nd.array(a), nd.array(b), nd.array(c),
+                         alpha=2.0, beta=0.5)
+    assert_almost_equal(got.asnumpy(), 2 * (a @ b) + 0.5 * c, rtol=1e-4)
+    got = nd.linalg.gemm2(nd.array(a), nd.array(b), alpha=1.5)
+    assert_almost_equal(got.asnumpy(), 1.5 * (a @ b), rtol=1e-4)
+    got = nd.linalg.gemm2(nd.array(a.T), nd.array(b), transpose_a=True)
+    assert_almost_equal(got.asnumpy(), a @ b, rtol=1e-4)
+    got = nd.linalg.gemm2(nd.array(a), nd.array(b.T), transpose_b=True)
+    assert_almost_equal(got.asnumpy(), a @ b, rtol=1e-4)
+    # batched
+    ab = rng.randn(2, 3, 4).astype("float32")
+    bb = rng.randn(2, 4, 5).astype("float32")
+    got = nd.linalg.gemm2(nd.array(ab), nd.array(bb))
+    assert_almost_equal(got.asnumpy(), np.einsum("bij,bjk->bik", ab, bb),
+                        rtol=1e-4)
+
+
+def _spd(rng, n):
+    m = rng.randn(n, n).astype("float32")
+    return m @ m.T + n * np.eye(n, dtype="float32")
+
+
+def test_laop():
+    """reference test_laop: potrf/potri/trsm/trmm round trips."""
+    rng = _rng(4)
+    spd = _spd(rng, 4)
+    L = nd.linalg.potrf(nd.array(spd)).asnumpy()
+    assert_almost_equal(L @ L.T, spd, rtol=1e-3, atol=1e-3)
+    inv = nd.linalg.potri(nd.array(L)).asnumpy()
+    assert_almost_equal(inv @ spd, np.eye(4), rtol=1e-2, atol=1e-2)
+    # trsm solves L x = alpha * b
+    bmat = rng.randn(4, 3).astype("float32")
+    x = nd.linalg.trsm(nd.array(L), nd.array(bmat), alpha=1.0).asnumpy()
+    assert_almost_equal(L @ x, bmat, rtol=1e-3, atol=1e-3)
+    y = nd.linalg.trmm(nd.array(L), nd.array(bmat)).asnumpy()
+    assert_almost_equal(y, L @ bmat, rtol=1e-4, atol=1e-4)
+
+
+def test_laop_2():
+    """syrk + sumlogdiag + makediag/extractdiag."""
+    rng = _rng(5)
+    a = rng.randn(3, 4).astype("float32")
+    got = nd.linalg.syrk(nd.array(a), alpha=1.0).asnumpy()
+    assert_almost_equal(got, a @ a.T, rtol=1e-4)
+    got = nd.linalg.syrk(nd.array(a), transpose=True).asnumpy()
+    assert_almost_equal(got, a.T @ a, rtol=1e-4)
+    spd = _spd(rng, 3)
+    L = np.linalg.cholesky(spd).astype("float32")
+    sld = float(nd.linalg.sumlogdiag(nd.array(L)).asnumpy())
+    assert_almost_equal(sld, np.log(np.diag(L)).sum(), rtol=1e-4)
+    v = rng.randn(4).astype("float32")
+    D = nd.linalg.makediag(nd.array(v)).asnumpy()
+    assert_almost_equal(D, np.diag(v))
+    back = nd.linalg.extractdiag(nd.array(D)).asnumpy()
+    assert_almost_equal(back, v)
+
+
+def test_laop_3():
+    """gelqf: LQ decomposition reconstructs and Q is orthonormal."""
+    rng = _rng(6)
+    a = rng.randn(3, 5).astype("float32")
+    q, l = nd.linalg.gelqf(nd.array(a))
+    qn, ln = q.asnumpy(), l.asnumpy()
+    assert_almost_equal(ln @ qn, a, rtol=1e-3, atol=1e-3)
+    assert_almost_equal(qn @ qn.T, np.eye(3), rtol=1e-3, atol=1e-3)
+
+
+def test_laop_4():
+    """syevd: eigendecomposition of a symmetric matrix."""
+    rng = _rng(7)
+    spd = _spd(rng, 4)
+    u, lam = nd.linalg.syevd(nd.array(spd))
+    un, ln = u.asnumpy(), lam.asnumpy()
+    # rows of U are eigenvectors: U^T diag(lam) U ... reference layout
+    rec = un.T @ np.diag(ln) @ un
+    assert_almost_equal(rec, spd, rtol=1e-2, atol=1e-2)
+
+
+def test_laop_5():
+    """det / slogdet / inverse."""
+    rng = _rng(8)
+    spd = _spd(rng, 3)
+    d = float(nd.linalg.det(nd.array(spd)).asnumpy())
+    assert_almost_equal(d, np.linalg.det(spd), rtol=1e-3)
+    sign, logabs = nd.linalg.slogdet(nd.array(spd))
+    assert float(sign.asnumpy()) == 1.0
+    assert_almost_equal(float(logabs.asnumpy()),
+                        np.log(np.linalg.det(spd)), rtol=1e-3)
+    inv = nd.linalg.inverse(nd.array(spd)).asnumpy()
+    assert_almost_equal(inv @ spd, np.eye(3), rtol=1e-2, atol=1e-2)
+
+
+def test_laop_6():
+    """Gradients through potrf/gemm2 via autograd."""
+    rng = _rng(9)
+    spd = _spd(rng, 3)
+    a = nd.array(spd)
+    a.attach_grad()
+    with autograd.record():
+        L = nd.linalg.potrf(a)
+        out = nd.linalg.sumlogdiag(L)   # = 1/2 log det(A)
+    out.backward()
+    # d/dA (1/2 logdet A) = 1/2 A^{-T}; symmetrized variants accepted
+    want = 0.5 * np.linalg.inv(spd).T
+    got = a.grad.asnumpy()
+    assert_almost_equal(got + got.T, want + want.T, rtol=1e-2,
+                        atol=1e-3)
+
+
+# ------------------------------------------- introspection / monitor
+
+def test_op_output_names_monitor():
+    """Monitor sees per-op output names (reference
+    test_op_output_names_monitor)."""
+    from mxnet_tpu.monitor import Monitor
+    seen = []
+    mon = Monitor(1, stat_func=lambda x: x,
+                  pattern=".*", sort=True)
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    act = mx.sym.Activation(fc, act_type="relu", name="act")
+    mod = mx.mod.Module(act, context=mx.cpu(), label_names=None)
+    mod.bind(data_shapes=[("data", (2, 4))], for_training=False)
+    mod.init_params()
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward(mx.io.DataBatch(data=[nd.ones((2, 4))]), is_train=False)
+    names = [k for _n, k, _v in mon.toc()]   # (step, name, stat)
+    joined = " ".join(str(n) for n in names)
+    assert "fc" in joined and "act" in joined, joined
+
+
+def test_get_all_registered_operators():
+    from mxnet_tpu.ops import registry
+    ops = registry.list_ops() if hasattr(registry, "list_ops") else \
+        list(registry._OPS if hasattr(registry, "_OPS") else [])
+    assert len(ops) > 250
+    assert "Convolution" in ops and "FullyConnected" in ops
+
+
+def test_get_operator_arguments():
+    """Operator signatures are introspectable (reference
+    mx.operator.get_operator_arguments)."""
+    import inspect
+    from mxnet_tpu.ops import registry
+    op = registry.get("Convolution")
+    sig = inspect.signature(op.fn)
+    names = list(sig.parameters)
+    for want in ("kernel", "stride", "pad", "num_filter"):
+        assert want in names, names
+
+
+def test_context_num_gpus():
+    n = mx.context.num_gpus()
+    assert isinstance(n, int) and n >= 0
+
+
+def test_np_shape_decorator():
+    """np_shape context/decorator exists and is a no-op-safe toggle
+    (zero-dim shapes are always on in this build)."""
+    if hasattr(mx.util, "np_shape"):
+        with mx.util.np_shape(True):
+            assert nd.zeros(()).shape == ()
+    assert nd.zeros(()).shape == ()
