@@ -1,0 +1,206 @@
+"""Reference test_operator.py port, tranche 5: detection + misc cases —
+test_op_roi_align / test_roi_align_value / test_roi_align_autograd,
+test_multi_proposal_op, test_stn_valid_sampling,
+test_psroipooling_with_type, test_custom_op_exc, test_correlation.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+_rng = np.random.RandomState
+
+
+def _roi_align_ref(data, rois, pooled, scale, s=2):
+    """NumPy ROIAlign (average, sample grid s x s per bin) mirroring
+    roi_align.cc semantics with clipped sample coords."""
+    n_roi = rois.shape[0]
+    c, h, w = data.shape[1:]
+    ph, pw = pooled
+    out = np.zeros((n_roi, c, ph, pw), "float32")
+    for r in range(n_roi):
+        bi = int(rois[r, 0])
+        x1, y1, x2, y2 = rois[r, 1:] * scale
+        rw = max(x2 - x1, 1.0)
+        rh = max(y2 - y1, 1.0)
+        bh, bw = rh / ph, rw / pw
+        img = data[bi]
+        for py in range(ph):
+            for px in range(pw):
+                acc = np.zeros(c, "float32")
+                for sy in range(s):
+                    for sx in range(s):
+                        yv = np.clip(y1 + (py + (sy + 0.5) / s) * bh,
+                                     0, h - 1)
+                        xv = np.clip(x1 + (px + (sx + 0.5) / s) * bw,
+                                     0, w - 1)
+                        y0, x0 = int(yv), int(xv)
+                        y1_, x1_ = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+                        wy, wx = yv - y0, xv - x0
+                        acc += (img[:, y0, x0] * (1 - wy) * (1 - wx)
+                                + img[:, y0, x1_] * (1 - wy) * wx
+                                + img[:, y1_, x0] * wy * (1 - wx)
+                                + img[:, y1_, x1_] * wy * wx)
+                out[r, :, py, px] = acc / (s * s)
+    return out
+
+
+def test_op_roi_align():
+    rng = _rng(0)
+    data = rng.randn(2, 3, 10, 10).astype("float32")
+    rois = np.array([[0, 1, 1, 8, 8], [1, 0, 2, 6, 9]], "float32")
+    got = nd.contrib.ROIAlign(nd.array(data), nd.array(rois),
+                              pooled_size=(3, 3), spatial_scale=1.0,
+                              sample_ratio=2)
+    ref = _roi_align_ref(data, rois, (3, 3), 1.0)
+    assert_almost_equal(got.asnumpy(), ref, rtol=1e-3, atol=1e-4)
+    assert got.dtype == np.float32
+
+
+def test_roi_align_value():
+    """Spatial scale scales roi coords into feature space."""
+    rng = _rng(1)
+    data = rng.randn(1, 2, 8, 8).astype("float32")
+    rois = np.array([[0, 4, 4, 28, 28]], "float32")   # image coords
+    got = nd.contrib.ROIAlign(nd.array(data), nd.array(rois),
+                              pooled_size=(2, 2), spatial_scale=0.25,
+                              sample_ratio=2)
+    ref = _roi_align_ref(data, rois, (2, 2), 0.25)
+    assert_almost_equal(got.asnumpy(), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_roi_align_autograd():
+    """Gradients flow to the feature map; the roi box regions receive
+    nonzero gradient, far-outside regions stay zero."""
+    rng = _rng(2)
+    data = nd.array(rng.randn(1, 2, 12, 12).astype("float32"))
+    rois = nd.array(np.array([[0, 1, 1, 5, 5]], "float32"))
+    data.attach_grad()
+    with autograd.record():
+        out = nd.contrib.ROIAlign(data, rois, pooled_size=(2, 2),
+                                  spatial_scale=1.0, sample_ratio=2)
+        loss = out.sum()
+    loss.backward()
+    g = data.grad.asnumpy()
+    assert np.abs(g[0, :, 1:6, 1:6]).sum() > 0
+    assert np.abs(g[0, :, 9:, 9:]).sum() == 0
+
+
+def test_multi_proposal_op():
+    """Proposal/MultiProposal emit (batch_idx, x1, y1, x2, y2) boxes
+    inside the image, ranked by score (reference test_multi_proposal_op
+    contract surface)."""
+    rng = _rng(3)
+    n, a, h, w = 1, 3, 8, 8
+    cls_prob = nd.array(rng.rand(n, 2 * a, h, w).astype("float32"))
+    bbox_pred = nd.array(
+        0.1 * rng.randn(n, 4 * a, h, w).astype("float32"))
+    im_info = nd.array(np.array([[128.0, 128.0, 1.0]], "float32"))
+    out = nd.contrib.MultiProposal(
+        cls_prob, bbox_pred, im_info, feature_stride=16,
+        scales=(8,), ratios=(0.5, 1, 2), rpn_pre_nms_top_n=50,
+        rpn_post_nms_top_n=10, threshold=0.7, rpn_min_size=4)
+    boxes = out.asnumpy() if not isinstance(out, (list, tuple)) \
+        else out[0].asnumpy()
+    assert boxes.shape[1] == 5
+    x1, y1, x2, y2 = boxes[:, 1], boxes[:, 2], boxes[:, 3], boxes[:, 4]
+    assert (x2 >= x1 - 1e-3).all() and (y2 >= y1 - 1e-3).all()
+    assert (x1 >= -1e-3).all() and (y1 >= -1e-3).all()
+    assert (x2 <= 128 + 1e-3).all() and (y2 <= 128 + 1e-3).all()
+
+
+def test_stn_valid_sampling():
+    """A shifted affine theta samples the shifted image region; samples
+    falling outside pad with zeros (reference test_stn_valid_sampling
+    boundary contract)."""
+    x = np.zeros((1, 1, 6, 6), "float32")
+    x[0, 0] = np.arange(36, dtype="float32").reshape(6, 6)
+    # translate by a full image width: all but the boundary-sampling
+    # first column lands outside and pads with zeros (column 0 samples
+    # exactly x_src = width-1; columns 1+ are fully out of range)
+    theta = np.array([[1, 0, 2.0, 0, 1, 0]], "float32")
+    out = nd.SpatialTransformer(
+        nd.array(x), nd.array(theta), target_shape=(6, 6),
+        transform_type="affine", sampler_type="bilinear").asnumpy()
+    assert np.abs(out[..., :, 1:]).sum() == 0
+    assert_almost_equal(out[0, 0, :, 0], x[0, 0, :, 5], rtol=1e-4,
+                        atol=1e-4)
+    # identity theta reproduces the input exactly
+    theta_id = np.array([[1, 0, 0, 0, 1, 0]], "float32")
+    out = nd.SpatialTransformer(
+        nd.array(x), nd.array(theta_id), target_shape=(6, 6),
+        transform_type="affine", sampler_type="bilinear").asnumpy()
+    assert_almost_equal(out, x, rtol=1e-4, atol=1e-4)
+
+
+def test_psroipooling_with_type():
+    """PSROIPooling: output shape contract and group-sensitive pooling
+    behavior for multiple dtypes' inputs (f32 path; f16 casts)."""
+    rng = _rng(4)
+    od, g = 2, 3
+    data = rng.randn(1, od * g * g, 12, 12).astype("float32")
+    rois = np.array([[0, 0, 0, 11, 11]], "float32")
+    out = nd.contrib.PSROIPooling(
+        nd.array(data), nd.array(rois), spatial_scale=1.0,
+        output_dim=od, pooled_size=g)
+    assert out.shape == (1, od, g, g)
+    assert np.isfinite(out.asnumpy()).all()
+    # f16 input: runs and returns finite values
+    out16 = nd.contrib.PSROIPooling(
+        nd.array(data.astype("float16"), dtype="float16"),
+        nd.array(rois), spatial_scale=1.0, output_dim=od,
+        pooled_size=g)
+    assert np.isfinite(out16.asnumpy().astype("float32")).all()
+
+
+def test_custom_op_exc():
+    """Exceptions raised inside a CustomOp surface at the call site
+    (reference test_custom_op_exc; stricter than the reference's
+    deferred engine rethrow)."""
+    import mxnet_tpu.operator as operator
+
+    class BoomProp(operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class Boom(operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    raise RuntimeError("custom forward boom")
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    pass
+            return Boom()
+
+    operator.register("boom_port")(BoomProp)
+    with pytest.raises(Exception, match="boom"):
+        nd.Custom(nd.ones((2, 2)), op_type="boom_port").asnumpy()
+
+
+def test_correlation():
+    """Correlation layer: zero displacement channel equals the mean of
+    the elementwise product (reference test_correlation numerics core;
+    infer_type seeding covered in test_infer_type.py)."""
+    rng = _rng(5)
+    a = rng.randn(1, 4, 6, 6).astype("float32")
+    b = rng.randn(1, 4, 6, 6).astype("float32")
+    out = nd.Correlation(nd.array(a), nd.array(b), kernel_size=1,
+                         max_displacement=2, stride1=1, stride2=1,
+                         pad_size=2, is_multiply=True)
+    o = out.asnumpy()
+    assert o.shape[1] == 25                      # (2*2+1)^2 channels
+    center = o[0, 12]                            # zero displacement
+    ref = (a[0] * b[0]).mean(axis=0)
+    assert_almost_equal(center, ref, rtol=1e-4, atol=1e-5)
