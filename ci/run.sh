@@ -78,6 +78,9 @@ stage_examples() {
   python example/adversary/adversary_generation.py --epochs 10
   python example/cnn_text_classification/text_cnn.py --epochs 8
   python example/svm_mnist/svm_mnist.py --epochs 8
+  python example/multivariate_time_series/lstnet_forecast.py --epochs 14
+  python example/named_entity_recognition/ner.py --epochs 8
+  python example/stochastic-depth/sd_resnet.py --epochs 10
 }
 
 stage_bench() {

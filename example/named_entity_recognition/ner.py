@@ -1,0 +1,102 @@
+"""Named-entity recognition (reference
+``example/named_entity_recognition``): a BiLSTM token tagger over
+fixed-length sequences, per-token BIO tag classification.
+
+Synthetic corpus: entity tokens are drawn from class-specific vocab
+ranges planted in random context; tagging them back (BIO-style tag per
+token) requires bidirectional context because entity spans run over
+multiple tokens.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+VOCAB, SEQ, TAGS = 120, 16, 3     # O, B-ENT, I-ENT
+
+
+class Tagger(gluon.nn.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.embed = gluon.nn.Embedding(VOCAB, 24)
+            self.lstm = gluon.rnn.LSTM(32, num_layers=1,
+                                       bidirectional=True)
+            self.out = gluon.nn.Dense(TAGS, flatten=False)
+
+    def hybrid_forward(self, F, x):
+        h = self.embed(x).transpose((1, 0, 2))
+        return self.out(self.lstm(h)).transpose((1, 0, 2))  # (B,T,TAGS)
+
+
+def synth(rng, n):
+    x = rng.randint(40, VOCAB, (n, SEQ))          # context tokens
+    y = np.zeros((n, SEQ), "int64")               # O
+    for i in range(n):
+        span = rng.randint(2, 4)
+        pos = rng.randint(0, SEQ - span)
+        x[i, pos:pos + span] = rng.randint(0, 20, span)   # entity range
+        y[i, pos] = 1                                      # B-ENT
+        y[i, pos + 1:pos + span] = 2                       # I-ENT
+    return x.astype("int32"), y.astype("float32")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--samples", type=int, default=2048)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ctx = mx.gpu(0) if mx.context.num_gpus() else mx.cpu(0)
+    rng = np.random.RandomState(0)
+    X, Y = synth(rng, args.samples)
+    Xt, Yt = synth(rng, 512)
+
+    net = Tagger()
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    net.hybridize()
+    sce = gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.005})
+
+    batch = 128
+    first = avg = None
+    for epoch in range(args.epochs):
+        tot, nb = 0.0, 0
+        perm = rng.permutation(args.samples)
+        for i in range(0, args.samples - batch + 1, batch):
+            idx = perm[i:i + batch]
+            xb = mx.nd.array(X[idx], ctx=ctx, dtype="int32")
+            yb = mx.nd.array(Y[idx], ctx=ctx)
+            with autograd.record():
+                loss = sce(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asscalar())
+            nb += 1
+        avg = tot / nb
+        first = first or avg
+        logging.info("epoch %d tag-loss %.4f", epoch, avg)
+
+    pred = net(mx.nd.array(Xt, ctx=ctx, dtype="int32")).asnumpy() \
+        .argmax(-1)
+    token_acc = float((pred == Yt).mean())
+    ent = Yt > 0
+    ent_f1_proxy = float((pred[ent] == Yt[ent]).mean())
+    assert avg < first * 0.3, (first, avg)
+    assert token_acc > 0.95, token_acc
+    assert ent_f1_proxy > 0.85, ent_f1_proxy
+    logging.info("ner tagger: token acc %.3f, entity-token recall %.3f",
+                 token_acc, ent_f1_proxy)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+"""Stochastic depth (reference ``example/stochastic-depth``, Huang
+2016): residual blocks are randomly DROPPED (identity-passed) during
+training with linearly-decaying survival probability, and scaled by
+their survival probability at inference.
+
+TPU-native shape: the drop decision uses a per-block Bernoulli drawn
+through the framework RNG inside ``autograd`` training mode; inference
+is deterministic scaling, so hybridized graphs stay static.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+class SDResBlock(gluon.nn.HybridBlock):
+    """Residual block with stochastic depth survival probability."""
+
+    def __init__(self, channels, p_survive, **kw):
+        super().__init__(**kw)
+        self.p = p_survive
+        with self.name_scope():
+            self.c1 = gluon.nn.Conv2D(channels, 3, padding=1,
+                                      activation="relu")
+            self.c2 = gluon.nn.Conv2D(channels, 3, padding=1)
+
+    def hybrid_forward(self, F, x):
+        res = self.c2(self.c1(x))
+        if autograd.is_training():
+            gate = F.random.uniform(0, 1, shape=(1,)) < self.p
+            return x + res * gate.astype("float32")   # drop or keep
+        return x + res * self.p                       # expected value
+
+    # inference applies E[gate] = p — the reference's test-time rule
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--samples", type=int, default=1024)
+    ap.add_argument("--blocks", type=int, default=4)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ctx = mx.gpu(0) if mx.context.num_gpus() else mx.cpu(0)
+    rng = np.random.RandomState(0)
+    # 4-class blob images
+    protos = rng.rand(4, 1, 8, 8).astype("float32")
+    y = rng.randint(0, 4, args.samples)
+    X = protos[y] + 0.3 * rng.randn(args.samples, 1, 8, 8) \
+        .astype("float32")
+    Y = y.astype("float32")
+
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Conv2D(8, 3, padding=1, activation="relu"))
+        for i in range(args.blocks):
+            # linear decay: deeper blocks die more often (p_L = 0.5)
+            p = 1.0 - (i + 1) / args.blocks * 0.5
+            net.add(SDResBlock(8, p))
+        net.add(gluon.nn.MaxPool2D(2, 2), gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    net.hybridize()
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+
+    batch = 128
+    first = avg = None
+    for epoch in range(args.epochs):
+        tot, nb = 0.0, 0
+        perm = rng.permutation(args.samples)
+        for i in range(0, args.samples - batch + 1, batch):
+            idx = perm[i:i + batch]
+            xb = mx.nd.array(X[idx], ctx=ctx)
+            yb = mx.nd.array(Y[idx], ctx=ctx)
+            with autograd.record():
+                loss = sce(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asscalar())
+            nb += 1
+        avg = tot / nb
+        first = first or avg
+        logging.info("epoch %d loss %.4f", epoch, avg)
+
+    Xt = protos[y[:256]] + 0.3 * rng.randn(256, 1, 8, 8) \
+        .astype("float32")
+    acc = float((net(mx.nd.array(Xt, ctx=ctx)).argmax(axis=1).asnumpy()
+                 == Y[:256]).mean())
+    # inference is deterministic (expected-value scaling)
+    o1 = net(mx.nd.array(Xt[:8], ctx=ctx)).asnumpy()
+    o2 = net(mx.nd.array(Xt[:8], ctx=ctx)).asnumpy()
+    assert np.allclose(o1, o2), "inference must be deterministic"
+    assert avg < first * 0.5, (first, avg)
+    assert acc > 0.9, acc
+    logging.info("stochastic-depth resnet: held-out acc %.3f with "
+                 "%d residual blocks at p_L=0.5", acc, args.blocks)
+
+
+if __name__ == "__main__":
+    main()
