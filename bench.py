@@ -32,7 +32,14 @@ BASELINE.md).  All other configs are nested under ``"extra"``:
 - ``e2e``: training FED BY the ImageRecordIter pipeline (combined img/s
   + exposed-IO split; the literal ``train_imagenet.py`` metric)
 
-Select a subset with BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,int8,io,e2e.
+- ``eager``: eager op-dispatch microbench (telemetry off vs on — the
+  <2% disabled-overhead contract for ``mxnet_tpu.telemetry``)
+
+Select a subset with
+BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,int8,io,e2e,eager.
+The full json carries a ``telemetry`` sub-dict (recompile count,
+collective bytes, io wait ms — disable with BENCH_TELEMETRY=0) so each
+BENCH record carries its own diagnosis.
 """
 import json
 import os
@@ -188,6 +195,8 @@ def _trainer_bench(net, loss_fn, data, label, *, n_in=1, warm=3,
     t = jnp.uint32(0)
     lowered = step_jit.lower(state, data, label, key, t)
     compiled = lowered.compile()
+    from mxnet_tpu import telemetry
+    telemetry.record_collectives(compiled, prefix="trainer")
     flops = _cost_flops(compiled) or flops_fallback
 
     holder = {"state": state}
@@ -649,7 +658,9 @@ def bench_e2e_train_with_io():
         x0 = jax.device_put(
             rng.rand(batch, 3, hw, hw).astype("float32"), batch_sh)
         y0 = jax.device_put(np.zeros(batch, "float32"), batch_sh)
+        from mxnet_tpu import telemetry
         compiled = step_jit.lower(state, x0, y0, key, t).compile()
+        telemetry.record_collectives(compiled, prefix="trainer")
         flops = _cost_flops(compiled) or _RESNET50_TRAIN_FLOPS * batch
 
         # synthetic (device-resident) step rate for the IO-exposure split
@@ -772,12 +783,84 @@ def bench_e2e_train_with_io():
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def bench_eager_dispatch():
+    """Eager op-dispatch microbench: a 500-op add chain through the
+    jit-cached imperative path, telemetry off vs on.  This is the number
+    behind the telemetry overhead contract: with the bus DISABLED each
+    dispatch site costs one module-attribute check, so `off` must be
+    within noise of the pre-telemetry dispatch rate; `on` quantifies the
+    enabled counter cost."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+
+    x = mx.nd.ones((8, 8))
+
+    def loop(n):
+        y = x
+        for _ in range(n):
+            y = y + 1.0
+        y.wait_to_read()
+
+    loop(200)                      # warm the eager jit cache
+
+    def rate():
+        best = 0.0
+        for _ in range(5):
+            t0 = time.perf_counter()
+            loop(500)
+            best = max(best, 500 / (time.perf_counter() - t0))
+        return best
+
+    was_on = telemetry.is_enabled()
+    telemetry.disable()
+    off = rate()
+    telemetry.enable()
+    on = rate()
+    if not was_on:
+        telemetry.disable()
+    return {"ops_per_sec_telemetry_off": round(off, 1),
+            "ops_per_sec_telemetry_on": round(on, 1),
+            "telemetry_on_overhead_pct": round((1 - on / off) * 100, 2),
+            "op": "broadcast_add (8x8 f32), jit-cache hit path"}
+
+
+def _telemetry_summary():
+    """The diagnosis sub-dict attached to the BENCH json: recompile count,
+    collective bytes, io wait — the numbers that explain the throughput
+    trajectory, not just state it."""
+    from mxnet_tpu import telemetry
+    snap = telemetry.snapshot()
+    c, g = snap["counters"], snap["gauges"]
+    return {
+        "cachedop_recompiles": c.get("cachedop.recompiles", 0),
+        "jit_cache_misses": c.get("dispatch.jit_cache_misses", 0),
+        "jit_cache_hits": c.get("dispatch.jit_cache_hits", 0),
+        "eager_op_calls": c.get("dispatch.op_calls", 0),
+        "backend_compiles": c.get("jax.compile_events", 0),
+        "backend_compile_s": round(c.get("jax.compile_seconds", 0.0), 2),
+        "collective_ops_per_step": g.get("trainer.collective_ops", 0),
+        "collective_bytes_per_step": g.get("trainer.collective_bytes", 0),
+        "kvstore_push_bytes": c.get("kvstore.push_bytes", 0),
+        "io_consumer_wait_ms": round(c.get("io.consumer_wait_ms", 0.0), 1),
+        "io_producer_wait_ms": round(c.get("io.producer_wait_ms", 0.0), 1),
+        "io_batches": c.get("io.batches", 0),
+    }
+
+
 def main():
     sel = [s.strip() for s in
            os.environ.get("BENCH_CONFIGS",
-                          "headline,infer,fp32,amp,bert,ssd,int8,io,e2e"
+                          "headline,infer,fp32,amp,bert,ssd,int8,io,e2e,eager"
                           ).split(",")]
     extra = {}
+
+    # telemetry rides along for diagnosis (counters only — the configs
+    # above run AOT-compiled steps, so enabled-bus cost is off their hot
+    # path; the `eager` config measures the enabled cost explicitly)
+    from mxnet_tpu import telemetry
+    if os.environ.get("BENCH_TELEMETRY", "1") not in ("0", "false"):
+        telemetry.reset()
+        telemetry.enable()
 
     headline = None
     headline_label = "amp_bf16"
@@ -847,6 +930,11 @@ def main():
             extra["e2e_train_with_io"] = bench_e2e_train_with_io()
         except Exception as e:           # pragma: no cover
             extra["e2e_train_with_io"] = {"error": repr(e)}
+    if "eager" in sel:
+        try:
+            extra["eager_dispatch"] = bench_eager_dispatch()
+        except Exception as e:           # pragma: no cover
+            extra["eager_dispatch"] = {"error": repr(e)}
 
     value = headline.get("items_per_sec") if headline else None
     full = {
@@ -857,6 +945,8 @@ def main():
         "detail": headline,
         "extra": extra,
     }
+    if telemetry.is_enabled():
+        full["telemetry"] = _telemetry_summary()
     if headline and headline.get("unreliable"):
         full["unreliable"] = True
     # full results: a file plus an EARLIER stdout line.  The driver's tail

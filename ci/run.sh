@@ -7,7 +7,9 @@
 #   gate       - multichip SPMD dry-run (dp/tp/sp/pp/ep) via __graft_entry__
 #   examples   - fast example-script smoke runs (synthetic data)
 #   bench      - quick headline benchmark sanity (img/s > 0)
-# Usage: ci/run.sh [stage ...]   (default: unit gate)
+#   telemetry  - MXNET_TELEMETRY=1 hybridized train step; assert the
+#                chrome trace has >=4 subsystems and >=1 recompile event
+# Usage: ci/run.sh [stage ...]   (default: unit gate telemetry)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -94,8 +96,51 @@ print("bench ok:", d["value"], d["unit"])
 PY
 }
 
+stage_telemetry() {
+  MXNET_TELEMETRY=1 JAX_PLATFORMS=cpu python - <<'PY'
+import json, os, tempfile
+import numpy as np
+import mxnet_tpu as mx
+
+assert mx.telemetry.is_enabled(), "MXNET_TELEMETRY=1 must enable the bus"
+
+net = mx.gluon.nn.Dense(4)
+net.initialize()
+net.hybridize()
+trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+kv = mx.kv.create("local")
+kv.init("w", mx.nd.ones((4, 4)))
+kv.push("w", mx.nd.ones((4, 4)))
+it = mx.io.PrefetchingIter(
+    mx.io.NDArrayIter(np.ones((8, 3), "float32"),
+                      np.zeros(8, "float32"), batch_size=8))
+for batch in it:
+    with mx.autograd.record():
+        loss = net(batch.data[0]).sum()
+    loss.backward()
+    trainer.step(8)
+
+path = os.path.join(tempfile.mkdtemp(prefix="telsmoke_"), "trace.json")
+mx.telemetry.dump_trace(path)
+with open(path) as f:
+    doc = json.load(f)                      # valid JSON or this raises
+events = doc["traceEvents"]
+cats = {e.get("cat") for e in events} - {None}
+missing = {"cachedop", "trainer", "kvstore", "io"} - cats
+assert not missing, f"trace missing subsystems: {missing} (have {cats})"
+recompiles = [e for e in events if e["name"] == "cachedop.recompile"]
+assert recompiles, "expected >=1 cachedop.recompile event"
+snap = mx.telemetry.snapshot()
+assert snap["counters"]["cachedop.recompiles"] >= 1
+assert "dispatch.jit_cache_misses" in snap["counters"]
+print("telemetry smoke ok:", sorted(cats),
+      f"recompiles={len(recompiles)}")
+PY
+}
+
 stages=("$@")
-[ $# -eq 0 ] && stages=(unit gate)
+[ $# -eq 0 ] && stages=(unit gate telemetry)
 for s in "${stages[@]}"; do
   echo "=== ci stage: $s ==="
   "stage_$s"

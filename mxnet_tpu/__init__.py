@@ -40,6 +40,7 @@ from . import gluon  # noqa: F401
 from . import parallel  # noqa: F401
 from . import image  # noqa: F401
 from . import profiler  # noqa: F401
+from . import telemetry  # noqa: F401
 from . import runtime  # noqa: F401
 from . import test_utils  # noqa: F401
 from . import visualization  # noqa: F401

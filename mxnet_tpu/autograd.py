@@ -143,9 +143,25 @@ def record_op(fn, attrs, input_ndarrays, raw_inputs, output_ndarrays,
                   parents=parents, n_out=len(output_ndarrays))
     if out_tuple is not None:
         node.out_tuple = out_tuple
-    node.out_avals = [jax.typeof(o._data) for o in output_ndarrays]
+    node.out_avals = [_aval_of(o._data) for o in output_ndarrays]
     for i, o in enumerate(output_ndarrays):
         o._ag_node = (node, i)
+
+
+_TYPEOF = getattr(jax, "typeof", None)   # probed once: jax.__getattr__ on
+#                                          a missing name raises internally
+
+
+def _aval_of(x):
+    """Shape/dtype abstract value of an array or tracer.  ``jax.typeof``
+    only exists in newer JAX; ``ShapeDtypeStruct`` carries the two fields
+    the backward pass reads and works on every version."""
+    if _TYPEOF is not None:
+        try:
+            return _TYPEOF(x)
+        except Exception:
+            pass
+    return jax.ShapeDtypeStruct(x.shape, x.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -387,7 +403,7 @@ class Function:
             if any(p is not None for p in parents):
                 node = AGNode(fn=None, attrs={}, in_nds=list(inputs),
                               parents=parents, n_out=len(outs))
-                node.out_avals = [jax.typeof(o._data) for o in outs]
+                node.out_avals = [_aval_of(o._data) for o in outs]
                 func = self
 
                 def custom_vjp(gout_nds):

@@ -2,12 +2,18 @@
 ``bulk``/``set_bulk_size`` batch engine ops to amortize dispatch).
 
 TPU-native: XLA fusion + the eager per-op jit cache subsume op bulking; the
-knobs are accepted so reference scripts run, and the ``bulk`` scope is kept
-as a (behaviorally inert) context manager.
+knobs are accepted so reference scripts run.  The ``bulk`` scope stays a
+behavioral no-op but is OBSERVABLE: with telemetry on, each scope lands in
+the trace as an ``engine.bulk`` span carrying the requested size and the
+number of eager ops dispatched inside it — so a reference script's bulking
+intent (and whether the ops it meant to batch actually hit the jit cache)
+is visible instead of silently dropped.
 """
 from __future__ import annotations
 
 import contextlib
+
+from .telemetry import bus as _tel
 
 __all__ = ["set_bulk_size", "bulk"]
 
@@ -18,14 +24,24 @@ def set_bulk_size(size):
     """Reference ``engine.py:set_bulk_size``; returns the previous value."""
     prev = _bulk_size[0]
     _bulk_size[0] = int(size)
+    if _tel.enabled:
+        _tel.count("engine.set_bulk_size_calls")
+        _tel.gauge("engine.bulk_size", _bulk_size[0])
     return prev
 
 
 @contextlib.contextmanager
 def bulk(size):
-    """Reference ``engine.py:bulk`` scope."""
+    """Reference ``engine.py:bulk`` scope — an observable no-op: records a
+    span with the op count dispatched inside it."""
     prev = set_bulk_size(size)
+    sp = _tel.span("engine.bulk", size=int(size))
+    ops0 = _tel.counter_value("dispatch.op_calls")
     try:
-        yield
+        with sp:
+            yield
+            sp.set(ops_in_scope=_tel.counter_value("dispatch.op_calls")
+                   - ops0)
     finally:
+        _tel.count("engine.bulk_scopes")
         set_bulk_size(prev)

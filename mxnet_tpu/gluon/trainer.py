@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from .. import optimizer as opt
 from ..ndarray import NDArray
+from ..telemetry import bus as _tel
 from .parameter import Parameter
 
 __all__ = ["Trainer"]
@@ -161,8 +162,13 @@ class Trainer:
             self._init_kvstore()
         if self._params_to_init:
             self._init_params()
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        with _tel.span("trainer.step", batch_size=batch_size,
+                       n_params=len(self._params)):
+            with _tel.span("trainer.allreduce_grads"):
+                self._allreduce_grads()
+            with _tel.span("trainer.update"):
+                self._update(ignore_stale_grad)
+        _tel.count("trainer.steps")
 
     def _check_and_rescale_grad(self, scale):
         if self._update_on_kvstore and self._kvstore and self._kv_initialized:

@@ -2,12 +2,14 @@
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict, namedtuple
 
 import numpy as np
 
 from .. import ndarray as nd
 from ..ndarray import NDArray
+from ..telemetry import bus as _tel
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "DevicePrefetchIter"]
@@ -175,11 +177,20 @@ class PrefetchingIter(DataIter):
 
         def prefetch_func(self, i):
             while True:
+                # producer wait: the decode thread blocked on the consumer
+                # taking the previous batch — device-bound when large.
+                # Counted only when a batch follows: the shutdown wake-up
+                # is not a stall (same rule as DevicePrefetchIter).
+                t0 = time.perf_counter()
                 self.data_taken[i].wait()
                 if not self.started:
                     break
+                if _tel.enabled:
+                    _tel.count("io.producer_wait_ms",
+                               (time.perf_counter() - t0) * 1e3)
                 try:
-                    self.next_batch[i] = self.iters[i].next()
+                    with _tel.span("io.produce_batch", iter=i):
+                        self.next_batch[i] = self.iters[i].next()
                 except StopIteration:
                     self.next_batch[i] = None
                 self.data_taken[i].clear()
@@ -227,12 +238,22 @@ class PrefetchingIter(DataIter):
             e.set()
 
     def iter_next(self):
+        # consumer wait: the training loop blocked on decode — host-bound
+        # when large (the BENCH_r05 "host-staging-bound" diagnosis as a
+        # first-class number)
+        t0 = time.perf_counter()
         for e in self.data_ready:
             e.wait()
         if self.next_batch[0] is None:
+            # epoch-end sentinel: discovering StopIteration is not a
+            # pipeline stall (same rule as DevicePrefetchIter)
             for i in self.next_batch:
                 assert i is None, "Number of entry mismatches between iterators"
             return False
+        if _tel.enabled:
+            _tel.count("io.consumer_wait_ms",
+                       (time.perf_counter() - t0) * 1e3)
+            _tel.count("io.batches")
         for batch in self.next_batch:
             assert batch.pad == self.next_batch[0].pad, \
                 "Different pad size between iterators"
@@ -417,7 +438,14 @@ class DevicePrefetchIter:
             for batch in self._it:
                 if self._stop:
                     return
-                self._q.put(self._stage(batch))
+                with _tel.span("io.stage_batch"):
+                    staged = self._stage(batch)
+                t0 = time.perf_counter()
+                self._q.put(staged)
+                if _tel.enabled:
+                    # blocked on a full queue: the device is the slow side
+                    _tel.count("io.producer_wait_ms",
+                               (time.perf_counter() - t0) * 1e3)
                 if self._stop:
                     return
             self._q.put(self._END)
@@ -457,6 +485,7 @@ class DevicePrefetchIter:
             self.reset()
         if self._done:
             raise StopIteration
+        t0 = time.perf_counter()
         item = self._q.get()
         if item is self._END:
             self._done = True
@@ -464,6 +493,13 @@ class DevicePrefetchIter:
         if isinstance(item, BaseException):
             self._done = True
             raise item
+        if _tel.enabled:
+            # blocked on an empty queue: staging/decode is the slow side.
+            # Counted only for real batches — the end-of-epoch sentinel
+            # drain is not a pipeline stall.
+            _tel.count("io.consumer_wait_ms",
+                       (time.perf_counter() - t0) * 1e3)
+            _tel.count("io.batches")
         return item
 
     next = __next__

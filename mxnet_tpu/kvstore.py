@@ -31,8 +31,31 @@ import numpy as np
 
 from .ndarray import NDArray
 from . import optimizer as opt
+from .telemetry import bus as _tel
 
 __all__ = ["KVStore", "create"]
+
+
+def _payload_bytes(val_lists):
+    """Total bytes across grouped value lists (telemetry accounting).
+
+    A compressed RowSparseNDArray bills its actual values+indices payload
+    (the wire size), never the dense shape — and is never densified just
+    to be counted (``.size`` would touch the lazy ``._data``)."""
+    total = 0
+    for vs in val_lists:
+        for v in vs:
+            rs = getattr(v, "_rs", None)
+            if rs is not None:
+                idx, vals = rs
+                total += int(vals.size) * vals.dtype.itemsize \
+                    + int(idx.size) * idx.dtype.itemsize
+                continue
+            n = 1
+            for d in v.shape:
+                n *= int(d)
+            total += n * v.dtype.itemsize
+    return total
 
 
 def _group_kv(keys, values):
@@ -118,6 +141,8 @@ class KVStore:
         """Initialize key(s) with value(s) (reference ``kvstore.py:116``)."""
         keys, vals = _group_kv(key, value)
         self._check_keys(keys)
+        if _tel.enabled:
+            _tel.count("kvstore.init_calls", type=self._type)
         from .ndarray.sparse import RowSparseNDArray
         for k, vs in zip(keys, vals):
             if k in self._store:
@@ -165,6 +190,11 @@ class KVStore:
         plain ``CopyFromTo``)."""
         keys, vals = _group_kv(key, value)
         self._check_keys(keys)
+        if _tel.enabled:
+            nbytes = _payload_bytes(vals)
+            _tel.count("kvstore.push_calls", type=self._type)
+            _tel.count("kvstore.push_bytes", nbytes)
+            _tel.instant("kvstore.push", n_keys=len(keys), bytes=nbytes)
         # priority mirrors the engine's comm/compute overlap hint; XLA's async
         # dispatch already overlaps transfers, so it is accepted and ignored.
         for k, vs in zip(keys, vals):
@@ -199,6 +229,11 @@ class KVStore:
         assert out is not None
         keys, outs = _group_kv(key, out)
         self._check_keys(keys)
+        if _tel.enabled:
+            nbytes = _payload_bytes(outs)
+            _tel.count("kvstore.pull_calls", type=self._type)
+            _tel.count("kvstore.pull_bytes", nbytes)
+            _tel.instant("kvstore.pull", n_keys=len(keys), bytes=nbytes)
         for k, os_ in zip(keys, outs):
             stored = self._store[k]
             for o in os_:
@@ -220,6 +255,8 @@ class KVStore:
         assert out is not None and row_ids is not None
         keys, outs = _group_kv(key, out)
         self._check_keys(keys)
+        if _tel.enabled:
+            _tel.count("kvstore.row_sparse_pull_calls", type=self._type)
         if isinstance(row_ids, NDArray):
             row_ids = [row_ids] * len(keys)
         from .ndarray.sparse import RowSparseNDArray

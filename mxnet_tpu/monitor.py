@@ -60,6 +60,7 @@ class Monitor:
                 if self.re_prog.match(name):
                     self.queue.append((self.step, name,
                                        self.stat_func(array)))
+        self._append_telemetry()
         self.activated = False
         res = []
         if self.sort:
@@ -73,6 +74,22 @@ class Monitor:
             res.append((n, k, s))
         self.queue = []
         return res
+
+    def _append_telemetry(self):
+        """With the telemetry bus enabled, framework counters matching the
+        monitor's pattern ride along in the stat stream as
+        ``telemetry:<counter>`` rows — the reference Monitor shows tensor
+        stats per interval; this adds the framework-behavior stats
+        (recompiles, cache misses, io waits) on the same cadence."""
+        from . import telemetry
+        if not telemetry.is_enabled():
+            return
+        for name, value in sorted(telemetry.snapshot()["counters"].items()):
+            label = f"telemetry:{name}"
+            if self.re_prog.match(label) or self.re_prog.match(name):
+                # raw number, not str: toc() wraps non-list values in a
+                # one-element list before joining
+                self.queue.append((self.step, label, value))
 
     def toc_print(self):
         res = self.toc()

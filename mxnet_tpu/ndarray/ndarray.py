@@ -31,6 +31,7 @@ from .. import autograd as _ag
 from ..base import np_dtype, bfloat16  # noqa: F401
 from ..context import Context, current_context, context_from_jax_device
 from ..ops import registry as _reg
+from ..telemetry import bus as _tel
 
 
 def _to_jax_device(ctx):
@@ -622,15 +623,29 @@ _EAGER_JIT_ENABLED = os.environ.get("MXNET_EAGER_JIT", "1") not in ("0", "false"
 
 
 def _call_op(op, raw, attrs):
+    if _tel.enabled:
+        # per-op call counts with a periodic trace sample — the sampled
+        # 'C' events keep the hot counter visible in chrome://tracing
+        # without one event per dispatch
+        n = _tel.count("dispatch.op_calls", op=op.name)
+        if n % 256 == 0:
+            _tel.counter_sample("dispatch.op_calls", n)
     if not _EAGER_JIT_ENABLED or id(op.fn) in _EAGER_NOJIT or _never_jit(op):
+        if _tel.enabled:
+            _tel.count("dispatch.jit_bypass")
         return op.fn(*raw, **attrs)
     akey = _eager_attrs_key(attrs)
     if akey is None or any(isinstance(r, jax.core.Tracer) for r in raw):
         # unhashable attrs (arrays) or already inside a trace: call direct
+        if _tel.enabled:
+            _tel.count("dispatch.jit_bypass")
         return op.fn(*raw, **attrs)
     key = (id(op.fn), akey)
     fn = _EAGER_JIT.get(key)
     if fn is None:
+        if _tel.enabled:
+            _tel.count("dispatch.jit_cache_misses", op=op.name)
+            _tel.instant("dispatch.jit_compile", op=op.name)
         misses = _EAGER_MISSES.get(id(op.fn), 0) + 1
         _EAGER_MISSES[id(op.fn)] = misses
         if misses > _EAGER_MISS_LIMIT:
@@ -649,6 +664,8 @@ def _call_op(op, raw, attrs):
         if len(_EAGER_JIT) > 16384:
             _EAGER_JIT.clear()
         return result
+    if _tel.enabled:
+        _tel.count("dispatch.jit_cache_hits")
     return fn(*raw)
 
 
@@ -732,6 +749,9 @@ def invoke_fn(fn, nd_inputs, attrs=None, op_name=None):
     (used for ``__getitem__`` under recording, custom functions, and the
     higher-order-gradient path)."""
     attrs = attrs or {}
+    if _tel.enabled:
+        _tel.count("dispatch.fn_calls", op=op_name or getattr(
+            fn, "__name__", "<fn>"))
     nd_inputs = [x if isinstance(x, NDArray) else _as_nd(x) for x in nd_inputs]
     raw = [x._data for x in nd_inputs]
     result = fn(*raw, **attrs)

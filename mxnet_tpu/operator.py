@@ -126,7 +126,7 @@ def _invoke_custom(op_type, inputs, kwargs):
             in_detached = [x.detach() for x in inputs]
             node = _ag.AGNode(fn=None, attrs={}, in_nds=list(inputs),
                               parents=parents, n_out=len(out_data))
-            node.out_avals = [jax.typeof(o._data) for o in out_data]
+            node.out_avals = [_ag._aval_of(o._data) for o in out_data]
 
             def custom_vjp(gout_nds):
                 in_grad = [nd.zeros(x.shape, dtype=x.dtype)

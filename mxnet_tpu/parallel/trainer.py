@@ -18,6 +18,8 @@ from .. import autograd
 from .. import ndarray as nd_mod
 from .. import random as _rnd
 from ..ndarray import NDArray
+from ..telemetry import bus as _tel
+from ..telemetry import jax_hooks as _tel_jax
 from .optim import FunctionalOptimizer
 from .sharding import infer_param_specs, named_sharding
 
@@ -242,13 +244,41 @@ class SPMDTrainer:
             if isinstance(data, (tuple, list)) else _raw(data)
         label = _raw(label)
         key = _rnd.next_key()
+        if _tel.enabled and self._t == 0:
+            self._record_telemetry(data, label, key)
         # the scope matters while jax traces the step (first call / retrace):
         # attention layers consult it to route through ring attention
-        with self._sp_scope():
+        with self._sp_scope(), \
+                _tel.span("trainer.step", t=self._t):
             self._state, loss = self._step_fn(self._state, data, label, key,
                                               jnp.uint32(self._t))
+        _tel.count("trainer.steps")
         self._t += 1
         return NDArray(loss)
+
+    def _record_telemetry(self, data, label, key):
+        """One-time gauges: donated-buffer bytes (the state XLA updates
+        in place) and the psum/collective payload the lowered HLO moves
+        per step.  Only runs with telemetry on, before the first step.
+
+        The collective analysis needs the SPMD-partitioned HLO, which
+        costs one extra trace + compile at step 0 (the result is not
+        shared with jax's jit cache).  Worth it on the CPU test mesh and
+        small models; set ``MXNET_TELEMETRY_HLO=0`` to keep telemetry on
+        but skip the analysis on models where startup compile dominates."""
+        import os
+        nbytes = sum(getattr(leaf, "nbytes", 0)
+                     for leaf in jax.tree_util.tree_leaves(self._state))
+        _tel.gauge("trainer.donated_bytes", int(nbytes))
+        if os.environ.get("MXNET_TELEMETRY_HLO", "1") in ("0", "false"):
+            return
+        try:
+            with self._sp_scope():
+                lowered = self._step_fn.lower(self._state, data, label, key,
+                                              jnp.uint32(0))
+            _tel_jax.record_collectives(lowered, prefix="trainer")
+        except Exception:
+            pass   # lowering is best-effort diagnosis, never a step failure
 
     def sync_to_block(self):
         params, _, aux_arrays = self._state

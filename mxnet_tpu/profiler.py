@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 import time
 import warnings
+import weakref
 
 _config = {"profile_all": False, "profile_symbolic": True,
            "profile_imperative": True, "profile_memory": False,
@@ -22,6 +23,9 @@ _config = {"profile_all": False, "profile_symbolic": True,
 _state = {"running": False, "dir": None, "preexisting": set()}
 _aggregate = {}
 _parse_cache = {}
+# live Counter objects (weak so a dropped Counter leaves the table) —
+# dumps() reads their CURRENT values; previously Counter was write-only
+_counters = weakref.WeakSet()
 
 
 def set_config(**kwargs):
@@ -183,9 +187,41 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
     for name, (calls, total) in sorted(_aggregate.items(),
                                        key=lambda kv: -kv[1][1]):
         lines.append("%-50s %8d %12.3f" % (name[:50], calls, total * 1e3))
+    counter_rows = sorted((c.name, c.value) for c in _counters)
+    if counter_rows:
+        lines.append("")
+        lines.append("Counters")
+        lines.append("%-50s %12s" % ("Name", "Value"))
+        for name, value in counter_rows:
+            lines.append("%-50s %12s" % (name[:50], value))
+    lines.extend(_telemetry_section())
     if reset:
         _aggregate.clear()
     return "\n".join(lines)
+
+
+def _telemetry_section():
+    """Framework events recorded by ``mxnet_tpu.telemetry`` — shown in the
+    same aggregate-table UX as the reference's per-op rows, so one
+    ``dumps()`` answers both "what ran on device" (XPlane section) and
+    "what did the framework do" (spans + counters)."""
+    from . import telemetry
+    snap = telemetry.snapshot()
+    if not (snap["spans"] or snap["counters"]):
+        return []
+    lines = ["", "Framework events (telemetry)"]
+    if snap["spans"]:
+        lines.append("%-50s %8s %12s" % ("Span", "Calls", "Total(ms)"))
+        for name, row in sorted(snap["spans"].items(),
+                                key=lambda kv: -kv[1]["total_ms"]):
+            lines.append("%-50s %8d %12.3f" % (name[:50], row["calls"],
+                                               row["total_ms"]))
+    if snap["counters"]:
+        lines.append("%-50s %12s" % ("Counter", "Value"))
+        for name, value in sorted(snap["counters"].items()):
+            val = round(value, 3) if isinstance(value, float) else value
+            lines.append("%-50s %12s" % (name[:50], val))
+    return lines
 
 
 class _Scope:
@@ -265,6 +301,7 @@ class Counter:
     def __init__(self, domain, name, value=None):
         self.name = f"{domain.name}::{name}"
         self.value = value or 0
+        _counters.add(self)   # read back by dumps() — values are live
 
     def set_value(self, value):
         self.value = value

@@ -1,0 +1,66 @@
+"""Runtime telemetry: structured events, counters, and exporters.
+
+The reference MXNet answers "why is this step slow?" with an
+engine-integrated profiler (``src/profiler/``): every op lands in a chrome
+trace plus an aggregate table.  On TPU the per-op story belongs to
+``jax.profiler`` (XPlane traces of the fused executables — see
+``mxnet_tpu/profiler.py``); what the XPlane trace *cannot* show is the
+framework-level cause of a slow step: a silent CachedOp recompile, an eager
+jit-cache miss storm, KVStore push volume, or an input pipeline stall.  This
+subsystem records exactly those.
+
+Usage::
+
+    import mxnet_tpu as mx
+    mx.telemetry.enable()            # or MXNET_TELEMETRY=1 in the env
+    ... train ...
+    mx.telemetry.snapshot()          # dict: counters/gauges/span aggregates
+    mx.telemetry.dump_trace("t.json")   # chrome://tracing / perfetto
+    print(mx.telemetry.dump_metrics())  # Prometheus text exposition
+
+Instrumented subsystems (event-name prefix = subsystem):
+
+- ``dispatch.*``  — eager op calls, per-op jit-cache hits/misses/compiles
+  (``ndarray/ndarray.py``)
+- ``cachedop.*``  — hybridized-block recompiles with the
+  shape/dtype/training-flag key that triggered them (``gluon/block.py``)
+- ``trainer.*``   — per-step spans, donated-buffer bytes, collective
+  payload bytes from the lowered HLO (``parallel/trainer.py``,
+  ``gluon/trainer.py``)
+- ``kvstore.*``   — push/pull call counts and payload bytes
+- ``io.*``        — prefetch producer/consumer wait (host-bound shows up
+  as a number)
+- ``engine.*``    — ``engine.bulk`` scopes (reference bulking intent)
+- ``jax.*``       — backend compilations via ``jax.monitoring``
+
+Everything is off by default; when disabled each site costs one module
+attribute read (<2% on the eager microbench, see ``bench.py`` config
+``eager``).
+"""
+from . import bus  # noqa: F401
+from . import exporters  # noqa: F401
+from . import jax_hooks  # noqa: F401
+from .bus import (  # noqa: F401
+    count,
+    counter_sample,
+    counter_value,
+    disable,
+    enable,
+    gauge,
+    instant,
+    is_enabled,
+    reset,
+    snapshot,
+    span,
+    span_aggregates,
+)
+from .exporters import dump_metrics, dump_trace, trace_events  # noqa: F401
+from .jax_hooks import collective_stats, record_collectives  # noqa: F401
+
+__all__ = [
+    "enable", "disable", "is_enabled", "reset", "snapshot",
+    "span", "count", "gauge", "instant", "counter_sample", "counter_value",
+    "span_aggregates", "dump_trace", "dump_metrics", "trace_events",
+    "collective_stats", "record_collectives", "bus", "exporters",
+    "jax_hooks",
+]

@@ -1,0 +1,88 @@
+"""Telemetry exporters: chrome://tracing JSON and Prometheus text.
+
+The chrome exporter mirrors the reference profiler's output contract
+(``src/profiler/profiler.cc EmitEvents`` writes a chrome trace the user
+opens in chrome://tracing or perfetto); the Prometheus dump gives scrapers
+and tests a flat text form of the counters/gauges.
+"""
+from __future__ import annotations
+
+import json
+import re
+
+from . import bus
+
+__all__ = ["trace_events", "dump_trace", "dump_metrics"]
+
+_PROCESS_NAME = "mxnet_tpu"
+
+
+def trace_events():
+    """The ring's events as chrome trace-event dicts (ts/dur in us)."""
+    out = []
+    for kind, name, cat, ts, dur, tid, attrs in bus.events():
+        ev = {"name": name, "cat": cat, "ts": round(ts, 3), "pid": 1,
+              "tid": tid}
+        if kind == "X":
+            ev["ph"] = "X"
+            ev["dur"] = round(dur, 3)
+        elif kind == "I":
+            ev["ph"] = "i"
+            ev["s"] = "t"       # thread-scoped instant
+        elif kind == "C":
+            ev["ph"] = "C"
+        if attrs:
+            ev["args"] = {k: v for k, v in attrs.items()}
+        out.append(ev)
+    return out
+
+
+def dump_trace(path=None):
+    """Write (or return) a chrome://tracing-loadable JSON object with every
+    span/instant/counter-sample currently in the ring, plus one metadata
+    event naming the process.  ``path=None`` returns the dict."""
+    events = [{"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+               "args": {"name": _PROCESS_NAME}}]
+    events.extend(trace_events())
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc
+
+
+_METRIC_OK = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name):
+    return "mxnet_" + _METRIC_OK.sub("_", name)
+
+
+def dump_metrics():
+    """Prometheus-style text exposition of counters and gauges.
+
+    Counter totals come first, then per-label breakdowns, then gauges;
+    span aggregates export as ``_calls`` / ``_total_ms`` pairs."""
+    snap = bus.snapshot()
+    lines = []
+    for name in sorted(snap["counters"]):
+        metric = _prom_name(name)
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {snap['counters'][name]}")
+        for labels, val in sorted(
+                snap["counters_by_label"].get(name, {}).items()):
+            lines.append(f"{metric}{labels} {val}")
+    for name in sorted(snap["gauges"]):
+        base, _, labels = name.partition("{")
+        metric = _prom_name(base)
+        lines.append(f"# TYPE {metric} gauge")
+        suffix = "{" + labels if labels else ""
+        lines.append(f"{metric}{suffix} {snap['gauges'][name]}")
+    for name in sorted(snap["spans"]):
+        row = snap["spans"][name]
+        metric = _prom_name(name)
+        lines.append(f"# TYPE {metric}_calls counter")
+        lines.append(f"{metric}_calls {row['calls']}")
+        lines.append(f"# TYPE {metric}_total_ms counter")
+        lines.append(f"{metric}_total_ms {row['total_ms']}")
+    return "\n".join(lines) + ("\n" if lines else "")

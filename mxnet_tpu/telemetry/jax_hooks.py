@@ -1,0 +1,156 @@
+"""JAX-side telemetry taps: compilation-cache events and HLO collectives.
+
+Two integrations:
+
+- ``install()`` registers ``jax.monitoring`` listeners so every backend
+  compilation (the silent TPU perf killer — a recompile is tens of seconds
+  of stall) lands on the bus as a counter + instant event.  JAX publishes
+  these under ``/jax/...compile...`` event keys; listeners cannot be
+  unregistered in current JAX, so the callbacks gate on ``bus.enabled``
+  and installation is once-per-process.
+
+- ``record_collectives(lowered)`` parses a lowered computation's StableHLO
+  text for collective ops (all-reduce/all-gather/reduce-scatter/permute —
+  the psums XLA inserted for the SPMD trainer) and records their payload
+  bytes, so "how much is this step moving over ICI" is a number in
+  ``snapshot()`` instead of a guess.
+"""
+from __future__ import annotations
+
+import re
+
+from . import bus
+
+__all__ = ["install", "record_collectives", "collective_stats"]
+
+_installed = False
+
+# a collective *invocation*: the op name directly followed by its argument
+# list — `%all-reduce` used as a fusion operand must not count again.
+# Matches both StableHLO (`"stablehlo.all_reduce"(...)`) and post-compile
+# HLO (`all-reduce(...)`, async `all-reduce-start(...)`) spellings.
+_COLLECTIVE_RE = re.compile(
+    r"\b(all[-_]reduce|all[-_]gather|reduce[-_]scatter|"
+    r"collective[-_]permute|all[-_]to[-_]all)"
+    r"(?:-start)?(?:\.[0-9]+)?\"?\(")
+# payload types: StableHLO `tensor<8x4xf32>` and HLO `f32[8,4]{1,0}`
+_TENSOR_RE = re.compile(r"tensor<((?:[0-9]+x)*)([a-z][a-z0-9]*)>")
+_HLO_SHAPE_RE = re.compile(
+    r"\b(f64|f32|bf16|f16|f8e[0-9a-z]+|s64|u64|s32|u32|s16|u16|s8|u8|"
+    r"pred|c64|c128)\[([0-9,]*)\]")
+# StableHLO op attribute block `<{...}>` — metadata (replica_groups etc.),
+# never payload
+_ATTR_RE = re.compile(r"<\{.*?\}>")
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2,
+                "i64": 8, "ui64": 8, "s64": 8, "u64": 8,
+                "i32": 4, "ui32": 4, "s32": 4, "u32": 4,
+                "i16": 2, "ui16": 2, "s16": 2, "u16": 2,
+                "i8": 1, "ui8": 1, "s8": 1, "u8": 1,
+                "i1": 1, "pred": 1, "c64": 8, "c128": 16}
+
+
+def _type_bytes(dtype, dims):
+    n = 1
+    for d in dims:
+        if d:
+            n *= int(d)
+    if dtype.startswith("f8"):
+        return n
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def install():
+    """Register jax.monitoring listeners (idempotent, never raises)."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    try:
+        from jax import monitoring
+    except Exception:
+        return
+
+    def _on_event(event, **kw):
+        if bus.enabled and "compile" in event:
+            bus.count("jax.compile_events", event=event)
+
+    def _on_duration(event, duration_secs, **kw):
+        if bus.enabled and "compile" in event:
+            bus.count("jax.compile_seconds", duration_secs)
+            bus.instant("jax.backend_compile", event=event,
+                        duration_ms=round(duration_secs * 1e3, 3))
+
+    try:
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        pass
+
+
+def collective_stats(hlo_text):
+    """``(n_collectives, payload_bytes)`` from StableHLO or compiled-HLO
+    text.
+
+    Per collective line the payload is the LARGEST tensor type mentioned
+    (operand and result of an all-reduce are the same shape; an
+    all-gather's result is the actual wire payload), so one invocation
+    bills its bytes once."""
+    def line_bytes(line):
+        # drop StableHLO attribute blocks first — replica_groups carries
+        # its own `dense<...> : tensor<NxMxi64>` that is metadata, not
+        # payload
+        line = _ATTR_RE.sub("", line)
+        best = 0
+        for dims, dtype in _TENSOR_RE.findall(line):
+            best = max(best, _type_bytes(dtype, dims.split("x")))
+        for dtype, dims in _HLO_SHAPE_RE.findall(line):
+            best = max(best, _type_bytes(dtype, dims.split(",")))
+        return best
+
+    n_ops = 0
+    total = 0
+    # StableHLO region form: `"stablehlo.all_reduce"(%x) <{...}> ({` opens a
+    # reducer region whose scalar body must NOT be billed; the payload type
+    # sits on the region-closing `}) : (tensor<...>) -> ...` line.  pending
+    # counts down so a malformed/unclosed region can't eat the whole text.
+    pending = 0
+    for line in hlo_text.splitlines():
+        if pending:
+            pending -= 1
+            if line.lstrip().startswith("})"):
+                total += line_bytes(line)
+                pending = 0
+            continue
+        if not _COLLECTIVE_RE.search(line):
+            continue
+        n_ops += 1
+        b = line_bytes(line)
+        if b:
+            total += b
+        elif line.rstrip().endswith("{"):
+            pending = 50
+    return n_ops, total
+
+
+def record_collectives(computation, prefix="trainer"):
+    """Record collective op count + payload bytes from a ``jax.jit``
+    ``.lower(...)`` result (or its ``.compile()``d executable) as gauges.
+
+    The SPMD partitioner inserts the data-parallel psums during XLA
+    compilation, so a Lowered whose StableHLO shows no collectives is
+    compiled (once — only with telemetry on) and the optimized HLO parsed
+    instead.  Pass the already-compiled object where the caller has one to
+    avoid that extra compile.  Safe with telemetry off (returns (0, 0))."""
+    if not bus.enabled:
+        return 0, 0
+    try:
+        n_ops, nbytes = collective_stats(computation.as_text())
+        if nbytes == 0 and hasattr(computation, "compile"):
+            n_ops, nbytes = collective_stats(
+                computation.compile().as_text())
+    except Exception:
+        return 0, 0
+    bus.gauge(f"{prefix}.collective_ops", n_ops)
+    bus.gauge(f"{prefix}.collective_bytes", nbytes)
+    return n_ops, nbytes

@@ -32,7 +32,13 @@ def test_sweep_coverage_floor():
         k for k, v in skips.items() if "synthesis failed" in v)[:30])
 
 
+@pytest.mark.slow
 def test_op_batch_matches_chip(tmp_path):
+    # ~8 min: a 250+ op sweep on CPU plus a real-accelerator subprocess
+    # through the tunnel — over half the tier-1 'not slow' time budget
+    # for one dot, starving a third of the suite out of the smoke window.
+    # It stays in ci/run.sh's unit/unit_heavy stages (HEAVY_TESTS already
+    # lists this file as wall-time-dominating).
     import jax
 
     with jax.default_matmul_precision("highest"):
