@@ -34,9 +34,12 @@ BASELINE.md).  All other configs are nested under ``"extra"``:
 
 - ``eager``: eager op-dispatch microbench (telemetry off vs on — the
   <2% disabled-overhead contract for ``mxnet_tpu.telemetry``)
+- ``optimizer``: aggregated vs per-param optimizer update on ~200
+  ResNet-like tensors (dispatches/step + update ms, the
+  ``multi_sgd_mom_update`` / MXNET_OPTIMIZER_AGGREGATION_SIZE workload)
 
 Select a subset with
-BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,int8,io,e2e,eager.
+BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,int8,io,e2e,eager,optimizer.
 The full json carries a ``telemetry`` sub-dict (recompile count,
 collective bytes, io wait ms — disable with BENCH_TELEMETRY=0) so each
 BENCH record carries its own diagnosis.
@@ -783,6 +786,100 @@ def bench_e2e_train_with_io():
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def bench_optimizer_update():
+    """Aggregated vs per-parameter optimizer update on a ResNet-like set of
+    ~200 small tensors (the reference's ``multi_sgd_mom_update`` /
+    ``MXNET_OPTIMIZER_AGGREGATION_SIZE`` workload): per-param dispatch cost
+    dominates when tensors are many and small, aggregation fuses each group
+    into one jitted, donated call.  Reports update ms and dispatches/step
+    for both paths plus the steady-state compile-miss count (must be 0
+    after warmup — the zero-recompile contract)."""
+    import jax
+    from mxnet_tpu import nd, telemetry
+    from mxnet_tpu import optimizer as opt
+
+    steps = int(os.environ.get("BENCH_OPTIMIZER_STEPS", "30"))
+    warm = min(3, steps)
+    rng = np.random.RandomState(0)
+
+    # ResNet-50-like tensor census at reduced width: 66 conv+BN trios
+    # (kernel, gamma, beta) + the classifier pair = 200 tensors
+    shapes = []
+    widths = (16, 16, 32, 32, 64, 64, 128, 128)
+    for rep in range(66):
+        cin = widths[rep % len(widths)]
+        cout = widths[(rep + 1) % len(widths)]
+        shapes.append((cout, cin, 3, 3))
+        shapes.append((cout,))
+        shapes.append((cout,))
+    shapes.append((100, 128))
+    shapes.append((100,))
+    grads_np = [(rng.rand(*s).astype("float32") - 0.5) for s in shapes]
+    w_np = [rng.rand(*s).astype("float32") for s in shapes]
+
+    # dispatch accounting needs the bus; deltas keep other configs' counters
+    was_on = telemetry.is_enabled()
+    telemetry.enable()
+
+    def run(aggregate_num):
+        o = opt.SGD(learning_rate=0.01, momentum=0.9, wd=1e-4)
+        o.aggregate_num = aggregate_num
+        indices = list(range(len(shapes)))
+        ws = [nd.array(w.copy()) for w in w_np]
+        gs = [nd.array(g) for g in grads_np]
+        states = [o.create_state_multi_precision(i, w)
+                  for i, w in zip(indices, ws)]
+
+        def step():
+            o.update_multi(indices, ws, gs, states)
+
+        def sync():
+            jax.block_until_ready([w._data for w in ws])
+
+        for _ in range(warm):
+            step()
+        sync()
+        c0 = telemetry.counter_value("optimizer.update_calls")
+        m0 = telemetry.counter_value("optimizer.compile_misses")
+        ts = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            step()
+            sync()
+            ts.append(time.perf_counter() - t0)
+        dispatches = (telemetry.counter_value("optimizer.update_calls")
+                      - c0) / steps
+        snap = telemetry.snapshot()
+        return {
+            "update_ms_p50": round(float(np.percentile(ts, 50)) * 1e3, 3),
+            "update_ms_p90": round(float(np.percentile(ts, 90)) * 1e3, 3),
+            "dispatches_per_step": round(dispatches, 1),
+            "steady_state_compile_misses":
+                telemetry.counter_value("optimizer.compile_misses") - m0,
+            "update_groups": snap["gauges"].get("optimizer.update_groups"),
+            "state_bytes": snap["gauges"].get("optimizer.state_bytes"),
+        }
+
+    aggregated = run(int(os.environ.get(
+        "MXNET_OPTIMIZER_AGGREGATION_SIZE", "256")))
+    per_param = run(1)
+    if not was_on:
+        telemetry.disable()
+    out = {"n_params": len(shapes),
+           "steps_timed": steps,
+           "optimizer": "sgd_momentum",
+           "per_param": per_param,
+           "aggregated": aggregated}
+    if aggregated["dispatches_per_step"]:
+        out["dispatch_reduction"] = round(
+            per_param["dispatches_per_step"]
+            / aggregated["dispatches_per_step"], 1)
+        out["update_speedup"] = round(
+            per_param["update_ms_p50"]
+            / max(aggregated["update_ms_p50"], 1e-9), 2)
+    return out
+
+
 def bench_eager_dispatch():
     """Eager op-dispatch microbench: a 500-op add chain through the
     jit-cached imperative path, telemetry off vs on.  This is the number
@@ -840,6 +937,13 @@ def _telemetry_summary():
         "backend_compile_s": round(c.get("jax.compile_seconds", 0.0), 2),
         "collective_ops_per_step": g.get("trainer.collective_ops", 0),
         "collective_bytes_per_step": g.get("trainer.collective_bytes", 0),
+        "optimizer_update_ms": round(
+            snap["spans"].get("trainer.update", {}).get("total_ms", 0.0), 1),
+        "optimizer_update_dispatches": c.get("optimizer.update_calls", 0),
+        "optimizer_update_groups": g.get("optimizer.update_groups", 0),
+        "optimizer_compile_misses": c.get("optimizer.compile_misses", 0),
+        "optimizer_state_bytes": g.get("optimizer.state_bytes", 0),
+        "checkpoint_bytes_written": c.get("checkpoint.bytes_written", 0),
         "kvstore_push_bytes": c.get("kvstore.push_bytes", 0),
         "io_consumer_wait_ms": round(c.get("io.consumer_wait_ms", 0.0), 1),
         "io_producer_wait_ms": round(c.get("io.producer_wait_ms", 0.0), 1),
@@ -850,8 +954,8 @@ def _telemetry_summary():
 def main():
     sel = [s.strip() for s in
            os.environ.get("BENCH_CONFIGS",
-                          "headline,infer,fp32,amp,bert,ssd,int8,io,e2e,eager"
-                          ).split(",")]
+                          "headline,infer,fp32,amp,bert,ssd,int8,io,e2e,"
+                          "eager,optimizer").split(",")]
     extra = {}
 
     # telemetry rides along for diagnosis (counters only — the configs
@@ -935,6 +1039,11 @@ def main():
             extra["eager_dispatch"] = bench_eager_dispatch()
         except Exception as e:           # pragma: no cover
             extra["eager_dispatch"] = {"error": repr(e)}
+    if "optimizer" in sel:
+        try:
+            extra["optimizer_update"] = bench_optimizer_update()
+        except Exception as e:           # pragma: no cover
+            extra["optimizer_update"] = {"error": repr(e)}
 
     value = headline.get("items_per_sec") if headline else None
     full = {

@@ -9,7 +9,10 @@
 #   bench      - quick headline benchmark sanity (img/s > 0)
 #   telemetry  - MXNET_TELEMETRY=1 hybridized train step; assert the
 #                chrome trace has >=4 subsystems and >=1 recompile event
-# Usage: ci/run.sh [stage ...]   (default: unit gate telemetry)
+#   optimizer  - aggregated multi-tensor update smoke: the new tests plus
+#                a 2-step optimizer_update bench sanity check (>=10x
+#                dispatch reduction, zero steady-state compile misses)
+# Usage: ci/run.sh [stage ...]   (default: unit gate telemetry optimizer)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -139,8 +142,22 @@ print("telemetry smoke ok:", sorted(cats),
 PY
 }
 
+stage_optimizer() {
+  JAX_PLATFORMS=cpu python -m pytest tests/test_optimizer_aggregate.py -q
+  JAX_PLATFORMS=cpu BENCH_OPTIMIZER_STEPS=2 python - <<'PY'
+import bench
+r = bench.bench_optimizer_update()
+pp, ag = r["per_param"], r["aggregated"]
+assert ag["dispatches_per_step"] * 10 <= pp["dispatches_per_step"], r
+assert ag["steady_state_compile_misses"] == 0, r
+print("optimizer bench ok:", pp["dispatches_per_step"], "->",
+      ag["dispatches_per_step"], "dispatches/step,",
+      f"{r.get('update_speedup')}x update time")
+PY
+}
+
 stages=("$@")
-[ $# -eq 0 ] && stages=(unit gate telemetry)
+[ $# -eq 0 ] && stages=(unit gate telemetry optimizer)
 for s in "${stages[@]}"; do
   echo "=== ci stage: $s ==="
   "stage_$s"

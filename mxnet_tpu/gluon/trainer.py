@@ -155,7 +155,14 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """One optimization step: allreduce grads then update (reference
-        ``trainer.py:305``)."""
+        ``trainer.py:305``).
+
+        ``ignore_stale_grad`` is accepted for API parity and is a
+        **documented no-op** here: the reference flag suppresses (or warns
+        about) updates from gradients whose version counter did not advance
+        since the last step, but in this frontend gradients only exist when
+        the autograd tape's backward wrote them, so there is no stale-grad
+        state to detect (see ``_update``)."""
         rescale_grad = self._scale / batch_size
         self._check_and_rescale_grad(rescale_grad)
         if not self._kv_initialized:
@@ -194,13 +201,21 @@ class Trainer:
         self._allreduce_grads()
 
     def _allreduce_grads(self):
-        if self._kvstore:
-            for i, param in enumerate(self._params):
-                if param.grad_req != "null":
-                    self._kvstore.push(i, param.list_grad(), priority=-i)
-                    if not self._update_on_kvstore:
-                        self._kvstore.pull(i, param.list_grad(), priority=-i,
-                                           ignore_sparse=False)
+        if not self._kvstore:
+            return
+        # one batched push (and pull) for every gradient-bearing param: the
+        # kvstore groups the key list itself, and with update_on_kvstore the
+        # server-side Updater sees the whole batch in one call — which is
+        # what lets it take the aggregated multi-tensor update path
+        keys = [i for i, param in enumerate(self._params)
+                if param.grad_req != "null"]
+        if not keys:
+            return
+        grads = [self._params[i].list_grad() for i in keys]
+        self._kvstore.push(keys, grads, priority=-keys[0])
+        if not self._update_on_kvstore:
+            self._kvstore.pull(keys, grads, priority=-keys[0],
+                               ignore_sparse=False)
 
     def update(self, batch_size, ignore_stale_grad=False):
         """Apply the optimizer assuming grads are already reduced (reference
@@ -217,19 +232,32 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        """Run the updaters over every gradient-bearing parameter.
+
+        ``ignore_stale_grad`` is a documented no-op (see ``step``): grads
+        here are exactly the arrays the tape's backward wrote, so the
+        reference's version-counter staleness cannot occur.  The batched
+        ``updater(indices, grads, weights)`` call is what feeds the
+        aggregated multi-tensor update path (``optimizer/aggregate.py``).
+        """
+        del ignore_stale_grad
         updates = [[] for _ in self._updaters]
+        kv_pull_keys = []
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
-            if not ignore_stale_grad:
-                pass  # version tracking subsumed by tape: grads written by backward
             if self._kvstore and self._update_on_kvstore:
                 if param._stype == "default":
-                    self._kvstore.pull(i, param.list_data(), priority=-i)
+                    kv_pull_keys.append(i)
                 continue
             for upd, arr, grad in zip(updates, param.list_data(),
                                       param.list_grad()):
                 upd.append((i, grad, arr))
+        if kv_pull_keys:
+            self._kvstore.pull(
+                kv_pull_keys,
+                [self._params[i].list_data() for i in kv_pull_keys],
+                priority=-kv_pull_keys[0])
         if not (self._kvstore and self._update_on_kvstore):
             for updater, upd in zip(self._updaters, updates):
                 if upd:
@@ -243,14 +271,21 @@ class Trainer:
             self._init_kvstore()
         if self._params_to_init:
             self._init_params()
-        if self._update_on_kvstore:
-            assert not self._params_to_init, \
-                "Cannot save trainer states when some parameters are not yet " \
-                "initialized in kvstore."
-            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
-        else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+        with _tel.span("checkpoint.save", kind="trainer_states") as sp:
+            if self._update_on_kvstore:
+                assert not self._params_to_init, \
+                    "Cannot save trainer states when some parameters are " \
+                    "not yet initialized in kvstore."
+                self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+                import os as _os
+                sp.set(bytes_written=_os.path.getsize(fname))
+            else:
+                with _tel.span("checkpoint.serialize"):
+                    payload = self._updaters[0].get_states(dump_optimizer=True)
+                with _tel.span("checkpoint.io", bytes=len(payload)):
+                    with open(fname, "wb") as fout:
+                        fout.write(payload)
+                sp.set(bytes_written=len(payload))
 
     def load_states(self, fname):
         """Load optimizer/updater states (reference ``trainer.py:465``)."""
@@ -258,15 +293,21 @@ class Trainer:
             self._init_kvstore()
         if self._params_to_init:
             self._init_params()
-        if self._update_on_kvstore:
-            self._kvstore.load_optimizer_states(fname)
-            self._optimizer = self._kvstore._updater.optimizer
-        else:
-            with open(fname, "rb") as f:
-                states = f.read()
-            for updater in self._updaters:
-                updater.set_states(states)
-                updater.optimizer = self._updaters[0].optimizer
-            self._optimizer = self._updaters[0].optimizer
+        with _tel.span("checkpoint.restore", kind="trainer_states") as sp:
+            if self._update_on_kvstore:
+                self._kvstore.load_optimizer_states(fname)
+                self._optimizer = self._kvstore._updater.optimizer
+                import os as _os
+                sp.set(bytes_read=_os.path.getsize(fname))
+            else:
+                with _tel.span("checkpoint.io"):
+                    with open(fname, "rb") as f:
+                        states = f.read()
+                sp.set(bytes_read=len(states))
+                with _tel.span("checkpoint.deserialize"):
+                    for updater in self._updaters:
+                        updater.set_states(states)
+                        updater.optimizer = self._updaters[0].optimizer
+                self._optimizer = self._updaters[0].optimizer
         param_dict = {i: param for i, param in enumerate(self._params)}
         self._optimizer.param_dict = param_dict

@@ -197,6 +197,7 @@ class KVStore:
             _tel.instant("kvstore.push", n_keys=len(keys), bytes=nbytes)
         # priority mirrors the engine's comm/compute overlap hint; XLA's async
         # dispatch already overlaps transfers, so it is accepted and ignored.
+        batch = []     # (key, merged, stored) rows awaiting the updater
         for k, vs in zip(keys, vals):
             if k not in self._store:
                 raise ValueError(f"key {k} has not been initialized")
@@ -212,8 +213,7 @@ class KVStore:
                 merged = self._global_allreduce(merged)
             stored = self._store[k]
             if self._updater is not None:
-                self._updater(k, merged, stored)
-                self._store[k] = stored
+                batch.append((k, merged, stored))
             else:
                 newv = merged.as_in_context(stored.context)
                 if newv is vs[0]:
@@ -222,6 +222,21 @@ class KVStore:
                     # CopyFromTo), not alias a live gradient buffer.
                     newv = newv.copy()
                 self._store[k] = newv
+        if batch:
+            # a multi-key push hands the stock Updater the whole batch in
+            # one call, so it can take the aggregated multi-tensor update
+            # path (optimizer/aggregate.py).  Anything else — plain
+            # functions AND Updater subclasses, which may override
+            # __call__ against the scalar contract — keeps the reference's
+            # one-call-per-key behavior.
+            if len(batch) > 1 and type(self._updater) is opt.Updater:
+                bk, bm, bs = (list(x) for x in zip(*batch))
+                self._updater(bk, bm, bs)
+            else:
+                for k, merged, stored in batch:
+                    self._updater(k, merged, stored)
+            for k, _merged, stored in batch:
+                self._store[k] = stored
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Copy the stored value into out array(s) (reference

@@ -325,31 +325,26 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
         self._params_dirty = True
+        # batched key lists: one kvstore push/pull and ONE updater call per
+        # step, so the server/local Updater can aggregate the whole batch
+        # into fused multi-tensor updates (optimizer/aggregate.py)
+        live = [(i, name, self._exec.grad_dict.get(name))
+                for i, name in enumerate(self._param_names)
+                if self._grad_req.get(name, "write") != "null"
+                and self._exec.grad_dict.get(name) is not None]
+        if not live:
+            return
+        keys = [i for i, _n, _g in live]
+        grads = [g for _i, _n, g in live]
+        weights = [self._exec.arg_dict[name] for _i, name, _g in live]
         if self._kvstore and self._update_on_kvstore:
-            for i, name in enumerate(self._param_names):
-                if self._grad_req.get(name, "write") == "null":
-                    continue
-                grad = self._exec.grad_dict.get(name)
-                if grad is None:
-                    continue
-                weight = self._exec.arg_dict[name]
-                self._kvstore.push(i, grad, priority=-i)
-                self._kvstore.pull(i, weight, priority=-i)
+            self._kvstore.push(keys, grads, priority=-keys[0])
+            self._kvstore.pull(keys, weights, priority=-keys[0])
         else:
             if self._kvstore:
-                for i, name in enumerate(self._param_names):
-                    grad = self._exec.grad_dict.get(name)
-                    if grad is None:
-                        continue
-                    self._kvstore.push(i, grad, priority=-i)
-                    self._kvstore.pull(i, grad, priority=-i)
-            for i, name in enumerate(self._param_names):
-                if self._grad_req.get(name, "write") == "null":
-                    continue
-                grad = self._exec.grad_dict.get(name)
-                if grad is None:
-                    continue
-                self._updater(i, grad, self._exec.arg_dict[name])
+                self._kvstore.push(keys, grads, priority=-keys[0])
+                self._kvstore.pull(keys, grads, priority=-keys[0])
+            self._updater(keys, grads, weights)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

@@ -159,9 +159,17 @@ class NDArray:
             return NDArray(jax.device_put(self._data, _to_jax_device(other)))
         if isinstance(other, NDArray):
             dat = self._data
-            if dat.dtype != other._data.dtype:
+            converted = dat.dtype != other._data.dtype
+            if converted:
                 dat = dat.astype(other._data.dtype)
-            other._data = jax.device_put(dat, list(other._data.devices())[0])
+            target = list(other._data.devices())[0]
+            if not converted and target in dat.devices():
+                # same-device device_put would ALIAS the source buffer
+                # (reference CopyFromTo always copies): a genuine copy keeps
+                # the destination alive when the source is later donated by
+                # the aggregated optimizer path
+                dat = jnp.copy(dat)
+            other._data = jax.device_put(dat, target)
             other._invalidate_views()
             return other
         raise TypeError(f"copyto does not support type {type(other)}")

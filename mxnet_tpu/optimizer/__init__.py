@@ -1,5 +1,6 @@
 """Optimizer package (reference ``python/mxnet/optimizer/__init__.py``)."""
 from .optimizer import *  # noqa: F401,F403
+from . import aggregate  # noqa: F401
 from . import optimizer  # noqa: F401
 
-__all__ = optimizer.__all__
+__all__ = optimizer.__all__ + ["aggregate"]

@@ -1,0 +1,499 @@
+"""Aggregated (multi-tensor) optimizer updates — one jit call per group.
+
+Reference being rebuilt: the ``multi_sgd_update`` / ``multi_sgd_mom_update`` /
+``multi_mp_sgd*`` kernel family (``src/operator/optimizer_op.cc:345-476``) and
+the ``MXNET_OPTIMIZER_AGGREGATION_SIZE`` knob (``optimizer.py:511`` SGD): on
+models with hundreds of small tensors the per-parameter update launch
+dominates step time, so MXNet 1.5 batches up to N parameters into one fused
+kernel launch.
+
+TPU-native redesign: instead of hand-written variadic kernels, parameters are
+grouped by (optimizer class, weight dtype, static hyperparameter signature,
+multi-precision, sparsity) and each group's whole ``(weights, grads, states)``
+pytree is updated by ONE jitted function with ``donate_argnums`` on weights
+and optimizer state — the in-place HBM semantics of the reference engine's
+write-dependency model.  Scalar hyperparameters that change across steps
+(lr schedules, rescale_grad, per-parameter lr/wd multipliers, Adam's
+bias-corrected lr) are *traced* arguments, so steady-state steps replay the
+same executable: after step 1 the group-signature cache takes zero compile
+misses (observable via the ``optimizer.compile_miss`` telemetry event).
+
+Fallbacks (per-parameter ``update_multi_precision``) are taken for:
+row-sparse / compressed gradients (the lazy_update O(nnz) kernels stay
+per-parameter), bare-fp16 weights without multi_precision, optimizer classes
+without a registered rule (or subclasses of one — they may override
+``update``), and ``MXNET_OPTIMIZER_AGGREGATION_SIZE <= 1``.
+
+Telemetry (when the bus is enabled): ``optimizer.update_group`` sub-spans
+inside ``trainer.update``, ``optimizer.update_groups`` / count the group
+dispatches, ``optimizer.state_bytes`` gauges the tracked slot memory, and
+``optimizer.update_calls`` counts dispatches (group calls + per-param
+fallbacks) so dispatches/step is a measurable number (``bench.py``
+``optimizer`` config).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from ..ndarray import NDArray
+from ..telemetry import bus as _tel
+
+__all__ = ["update_multi", "registered_rules", "cache_info", "clear_cache"]
+
+
+def _is_dense(arr):
+    """True for a plain dense NDArray (no row-sparse backing)."""
+    return isinstance(arr, NDArray) and getattr(arr, "_rs", None) is None
+
+
+def _state_leaves(state):
+    """Flatten an optimizer state pytree to its NDArray leaves (None leaves
+    are structural absence — e.g. momentum==0 — and are dropped; the static
+    group signature fixes the arity).  Returns None if a leaf is neither
+    None nor a dense NDArray (custom state objects → fallback)."""
+    if state is None:
+        return ()
+    if isinstance(state, NDArray):
+        return (state,) if _is_dense(state) else None
+    if isinstance(state, (tuple, list)):
+        out = []
+        for s in state:
+            leaves = _state_leaves(s)
+            if leaves is None:
+                return None
+            out.extend(leaves)
+        return tuple(out)
+    return None
+
+
+def _clip(g, hyper, has_clip):
+    if has_clip:
+        return jnp.clip(g, -hyper["clip_gradient"], hyper["clip_gradient"])
+    return g
+
+
+# --------------------------------------------------------------------- rules
+def _has_clip(opt):
+    """Clipping is armed only for a POSITIVE clip_gradient — the exact gate
+    of the per-param ops (``_apply_wd`` requires ``> 0``; the optimizer
+    kwargs use truthiness), so 0.0 / negative values stay no-ops."""
+    return opt.clip_gradient is not None and opt.clip_gradient > 0
+
+
+class _Rule:
+    """One aggregation recipe per optimizer class.
+
+    ``signature``/``hyper`` split the optimizer's knobs into the static part
+    (changes recompile: momentum on/off, clipping on/off, centered, ...) and
+    the traced scalar part (changes are free: lr, wd, rescale_grad, betas).
+    ``step`` is the pure per-tensor update — its math must match the eager
+    per-parameter op bit-for-bit in structure so aggregated == per-param
+    within float tolerance (asserted by tests/test_optimizer_aggregate.py).
+    """
+
+    def signature(self, opt):
+        return (_has_clip(opt),)
+
+    def hyper(self, opt):
+        return {"rescale_grad": float(opt.rescale_grad),
+                "clip_gradient": float(opt.clip_gradient or 0.0)}
+
+    def state_arity(self, sig):
+        raise NotImplementedError
+
+    def lrs(self, opt, indices):
+        """Per-tensor learning rates (already bias-corrected where the
+        per-param path folds the correction into lr, e.g. Adam)."""
+        return opt._get_lrs(indices)
+
+    def step(self, w, g, state, lr, wd, hyper, sig):
+        raise NotImplementedError
+
+
+class _SGDRule(_Rule):
+    def signature(self, opt):
+        return (opt.momentum != 0.0, _has_clip(opt))
+
+    def hyper(self, opt):
+        h = super().hyper(opt)
+        h["momentum"] = float(opt.momentum)
+        return h
+
+    def state_arity(self, sig):
+        has_mom, _ = sig
+        return 1 if has_mom else 0
+
+    def step(self, w, g, state, lr, wd, hyper, sig):
+        has_mom, has_clip = sig
+        g = _clip(g * hyper["rescale_grad"], hyper, has_clip) + wd * w
+        if has_mom:
+            (mom,) = state
+            new_mom = hyper["momentum"] * mom - lr * g
+            return w + new_mom, (new_mom,)
+        return w - lr * g, ()
+
+
+class _NAGRule(_Rule):
+    def signature(self, opt):
+        return (opt.momentum != 0.0, _has_clip(opt))
+
+    def hyper(self, opt):
+        h = super().hyper(opt)
+        h["momentum"] = float(opt.momentum)
+        return h
+
+    def state_arity(self, sig):
+        has_mom, _ = sig
+        return 1 if has_mom else 0
+
+    def step(self, w, g, state, lr, wd, hyper, sig):
+        has_mom, has_clip = sig
+        g = _clip(g * hyper["rescale_grad"], hyper, has_clip) + wd * w
+        if has_mom:
+            (mom,) = state
+            mu = hyper["momentum"]
+            new_mom = mu * mom + g
+            return w - lr * (g + mu * new_mom), (new_mom,)
+        return w - lr * g, ()
+
+
+class _SignumRule(_Rule):
+    def signature(self, opt):
+        return (opt.momentum != 0.0, _has_clip(opt))
+
+    def hyper(self, opt):
+        h = super().hyper(opt)
+        h["momentum"] = float(opt.momentum)
+        h["wd_lh"] = float(opt.wd_lh)
+        return h
+
+    def state_arity(self, sig):
+        has_mom, _ = sig
+        return 1 if has_mom else 0
+
+    def step(self, w, g, state, lr, wd, hyper, sig):
+        has_mom, has_clip = sig
+        g = _clip(g * hyper["rescale_grad"], hyper, has_clip)
+        if has_mom:
+            (mom,) = state
+            mu = hyper["momentum"]
+            new_mom = mu * mom - (1 - mu) * g
+            return w + lr * (jnp.sign(new_mom) - hyper["wd_lh"] * w), \
+                (new_mom,)
+        return w - lr * (jnp.sign(g) + wd * w), ()
+
+
+class _AdamRule(_Rule):
+    def hyper(self, opt):
+        h = super().hyper(opt)
+        h.update(beta1=float(opt.beta1), beta2=float(opt.beta2),
+                 epsilon=float(opt.epsilon))
+        return h
+
+    def state_arity(self, sig):
+        return 2
+
+    def lrs(self, opt, indices):
+        # per-param path folds the bias correction into lr with the
+        # per-index step count t (optimizer.py Adam.update)
+        out = []
+        for lr, i in zip(opt._get_lrs(indices), indices):
+            t = opt._index_update_count[i]
+            out.append(lr * (1. - opt.beta2 ** t) ** 0.5
+                       / (1. - opt.beta1 ** t))
+        return out
+
+    def step(self, w, g, state, lr, wd, hyper, sig):
+        (has_clip,) = sig
+        mean, var = state
+        b1, b2 = hyper["beta1"], hyper["beta2"]
+        g = _clip(g * hyper["rescale_grad"], hyper, has_clip) + wd * w
+        new_mean = b1 * mean + (1 - b1) * g
+        new_var = b2 * var + (1 - b2) * jnp.square(g)
+        new_w = w - lr * new_mean / (jnp.sqrt(new_var) + hyper["epsilon"])
+        return new_w, (new_mean, new_var)
+
+
+class _RMSPropRule(_Rule):
+    def signature(self, opt):
+        return (bool(opt.centered), _has_clip(opt),
+                opt.clip_weights is not None and opt.clip_weights > 0)
+
+    def hyper(self, opt):
+        h = super().hyper(opt)
+        h.update(gamma1=float(opt.gamma1), gamma2=float(opt.gamma2),
+                 epsilon=float(opt.epsilon),
+                 clip_weights=float(opt.clip_weights or 0.0))
+        return h
+
+    def state_arity(self, sig):
+        centered, _, _ = sig
+        return 3 if centered else 1
+
+    def step(self, w, g, state, lr, wd, hyper, sig):
+        centered, has_clip, has_cw = sig
+        gr = _clip(g * hyper["rescale_grad"], hyper, has_clip) + wd * w
+        g1 = hyper["gamma1"]
+        if centered:
+            n, gbar, delta = state
+            new_n = (1 - g1) * jnp.square(gr) + g1 * n
+            new_g = (1 - g1) * gr + g1 * gbar
+            new_delta = hyper["gamma2"] * delta - lr * gr / jnp.sqrt(
+                new_n - jnp.square(new_g) + hyper["epsilon"])
+            new_w = w + new_delta
+            if has_cw:
+                new_w = jnp.clip(new_w, -hyper["clip_weights"],
+                                 hyper["clip_weights"])
+            return new_w, (new_n, new_g, new_delta)
+        (n,) = state
+        new_n = (1 - g1) * jnp.square(gr) + g1 * n
+        new_w = w - lr * gr / jnp.sqrt(new_n + hyper["epsilon"])
+        if has_cw:
+            new_w = jnp.clip(new_w, -hyper["clip_weights"],
+                             hyper["clip_weights"])
+        return new_w, (new_n,)
+
+
+class _AdaGradRule(_Rule):
+    def hyper(self, opt):
+        h = super().hyper(opt)
+        h["epsilon"] = float(opt.float_stable_eps)
+        return h
+
+    def state_arity(self, sig):
+        return 1
+
+    def step(self, w, g, state, lr, wd, hyper, sig):
+        (has_clip,) = sig
+        (history,) = state
+        g = _clip(g * hyper["rescale_grad"], hyper, has_clip)
+        new_hist = history + jnp.square(g)
+        div = g / jnp.sqrt(new_hist + hyper["epsilon"])
+        return w + (div + w * wd) * -lr, (new_hist,)
+
+
+def _rules():
+    """Exact-class rule table, built lazily to dodge the import cycle with
+    optimizer.py.  Exact ``type()`` match only: a subclass may override
+    ``update`` and must keep the per-parameter path."""
+    from .optimizer import SGD, NAG, Adam, AdaGrad, RMSProp, Signum
+    return {SGD: ("sgd", _SGDRule()),
+            NAG: ("nag", _NAGRule()),
+            Signum: ("signum", _SignumRule()),
+            Adam: ("adam", _AdamRule()),
+            RMSProp: ("rmsprop", _RMSPropRule()),
+            AdaGrad: ("adagrad", _AdaGradRule())}
+
+
+_RULES = None
+
+
+def registered_rules():
+    global _RULES
+    if _RULES is None:
+        _RULES = _rules()
+    return _RULES
+
+
+# ------------------------------------------------------------ compiled cache
+# (rule_name, static_sig, mp, members_sig) -> jitted group update.  Each
+# entry compiles exactly once, so a cache miss IS a compile (the telemetry
+# event the "zero recompiles after step 1" acceptance check reads).
+_compiled = {}
+
+
+def cache_info():
+    """(n_entries, keys) of the compiled-group cache — test/debug surface."""
+    return len(_compiled), list(_compiled)
+
+
+def clear_cache():
+    _compiled.clear()
+
+
+def _build_group_fn(rule, sig, mp):
+    """One jitted update over the whole group pytree.  Weights (arg 0) and
+    state (arg 2) are donated: their HBM buffers are reused for the outputs,
+    matching the reference engine's in-place write-dependency model.  Grads
+    are NOT donated (callers may inspect or re-reduce them)."""
+
+    def group_update(weights, grads, states, lrs, wds, hyper):
+        new_ws, new_ss = [], []
+        for w, g, s, lr, wd in zip(weights, grads, states, lrs, wds):
+            if mp:
+                master, inner = s[0], tuple(s[1:])
+                new_master, new_inner = rule.step(
+                    master, g.astype(jnp.float32), inner, lr, wd, hyper, sig)
+                new_ws.append(new_master.astype(w.dtype))
+                new_ss.append([new_master] + list(new_inner))
+            else:
+                new_w, new_s = rule.step(w, g, tuple(s), lr, wd, hyper, sig)
+                new_ws.append(new_w)
+                new_ss.append(list(new_s))
+        return new_ws, new_ss
+
+    return jax.jit(group_update, donate_argnums=(0, 2))
+
+
+def _members_sig(weights, grads, state_leaves):
+    sig = []
+    for w, g, leaves in zip(weights, grads, state_leaves):
+        sig.append((tuple(w.shape), str(w.dtype), str(g.dtype),
+                    tuple((tuple(s.shape), str(s.dtype)) for s in leaves)))
+    return tuple(sig)
+
+
+def _group_key_for(opt, rule_entry, weight, grad, state):
+    """Group key + flattened state for one member, or None → fallback."""
+    name, rule = rule_entry
+    if not (_is_dense(weight) and _is_dense(grad)):
+        return None
+    # one jit call commits to one device: parameters living on different
+    # devices land in different groups, and a member whose grad sits on
+    # another device than its weight falls back to the per-param path
+    devices = frozenset(weight._data.devices())
+    if frozenset(grad._data.devices()) != devices:
+        return None
+    sig = rule.signature(opt)
+    mp = False
+    leaves = None
+    if weight.dtype == numpy.float16:
+        # aggregate fp16 only through the fp32-master multi-precision path
+        # (bare-fp16 accumulation keeps the per-param warning behavior)
+        if not (opt.multi_precision and isinstance(state, (tuple, list))
+                and len(state) == 2 and _is_dense(state[0])
+                and state[0].dtype == numpy.float32):
+            return None
+        inner = _state_leaves(state[1])
+        if inner is None or len(inner) != rule.state_arity(sig):
+            return None
+        mp = True
+        leaves = (state[0],) + inner
+    else:
+        leaves = _state_leaves(state)
+        if leaves is None or len(leaves) != rule.state_arity(sig):
+            return None
+        if grad.dtype != weight.dtype:
+            return None
+    for leaf in leaves:
+        if frozenset(leaf._data.devices()) != devices:
+            return None
+    return (name, rule, sig, mp, str(weight.dtype), devices), leaves
+
+
+def update_multi(opt, indices, weights, grads, states):
+    """Apply ``opt`` to parallel lists of (index, weight, grad, state),
+    aggregating compatible members into one jitted call per group and
+    falling back to ``update_multi_precision`` for the rest.
+
+    Weight and state NDArrays are mutated in place (handle rebinding), so
+    state identity — and ``Updater.get_states`` serialization — is
+    byte-compatible with the per-parameter path.
+    """
+    agg_size = getattr(opt, "aggregate_num", 0)
+    rule_entry = registered_rules().get(type(opt)) \
+        if agg_size and agg_size > 1 else None
+
+    groups = {}     # key -> list of (position, state_leaves)
+    fallback = []
+    if rule_entry is not None:
+        donated = set()   # backing-buffer ids already claimed for donation
+        for pos, (w, g, s) in enumerate(zip(weights, grads, states)):
+            keyed = _group_key_for(opt, rule_entry, w, g, s)
+            if keyed is None:
+                fallback.append(pos)
+                continue
+            key, leaves = keyed
+            # a buffer may be donated at most once per step: tied handles
+            # (shared weights, aliased state) take the per-param path
+            bufs = {id(w._data)} | {id(leaf._data) for leaf in leaves}
+            if len(bufs) < 1 + len(leaves) or bufs & donated:
+                fallback.append(pos)
+                continue
+            donated |= bufs
+            groups.setdefault(key, []).append((pos, leaves))
+    else:
+        fallback = list(range(len(weights)))
+
+    tel_on = _tel.enabled
+    n_dispatch = 0
+    for key, members in groups.items():
+        name, rule, sig, mp, _dtype, _devices = key
+        for lo in range(0, len(members), agg_size):
+            chunk = members[lo:lo + agg_size]
+            n_dispatch += 1
+            _run_group(opt, name, rule, sig, mp, chunk, indices, weights,
+                       grads, tel_on)
+
+    for pos in fallback:
+        n_dispatch += 1
+        opt.update_multi_precision(indices[pos], weights[pos], grads[pos],
+                                   states[pos])
+
+    if tel_on:
+        _tel.count("optimizer.update_calls", n_dispatch)
+        _tel.count("optimizer.aggregated_params",
+                   len(weights) - len(fallback))
+        if fallback:
+            _tel.count("optimizer.fallback_params", len(fallback))
+        _tel.gauge("optimizer.update_groups", len(groups))
+        _tel.gauge("optimizer.state_bytes", _state_bytes(states))
+
+
+def _state_bytes(states):
+    total = 0
+    for s in states:
+        leaves = _state_leaves(s) if not isinstance(s, NDArray) \
+            else (s,)
+        if leaves:
+            for leaf in leaves:
+                n = 1
+                for d in leaf.shape:
+                    n *= int(d)
+                total += n * leaf.dtype.itemsize
+    return total
+
+
+def _run_group(opt, name, rule, sig, mp, chunk, indices, weights, grads,
+               tel_on):
+    """Dispatch one compiled group update and rebind the outputs."""
+    positions = [pos for pos, _ in chunk]
+    idxs = [indices[pos] for pos in positions]
+    ws = [weights[pos] for pos in positions]
+    gs = [grads[pos] for pos in positions]
+    leaf_lists = [list(leaves) for _, leaves in chunk]
+
+    # reference aggregated path: bump every member's update count first,
+    # then resolve the scheduled lr/wd for the whole chunk
+    opt._update_count(idxs)
+    lrs = [float(lr) for lr in rule.lrs(opt, idxs)]
+    wds = [float(wd) for wd in opt._get_wds(idxs)]
+    hyper = rule.hyper(opt)
+
+    w_data = [w._data for w in ws]
+    g_data = [g._data for g in gs]
+    s_data = [[leaf._data for leaf in leaves] for leaves in leaf_lists]
+
+    cache_key = (name, sig, mp, _members_sig(ws, gs, leaf_lists))
+    fn = _compiled.get(cache_key)
+    if fn is None:
+        fn = _build_group_fn(rule, sig, mp)
+        _compiled[cache_key] = fn
+        if tel_on:
+            _tel.count("optimizer.compile_misses")
+            _tel.instant("optimizer.compile_miss", opt=name, n=len(ws),
+                         signature=repr((sig, mp)),
+                         shapes=repr([m[0] for m in cache_key[3]]))
+
+    with _tel.span("optimizer.update_group", opt=name, n=len(ws), mp=mp):
+        new_w, new_s = fn(w_data, g_data, s_data, lrs, wds, hyper)
+
+    # rebind in place: same NDArray handles, fresh (donated) buffers —
+    # the frontend analog of the engine writing through WriteTo vars
+    for w, nw in zip(ws, new_w):
+        w._data = nw
+    for leaves, ns in zip(leaf_lists, new_s):
+        for leaf, nleaf in zip(leaves, ns):
+            leaf._data = nleaf

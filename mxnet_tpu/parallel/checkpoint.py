@@ -6,10 +6,18 @@ for mesh-sharded training that single-host file is both a bottleneck and a
 resharding hazard, so the SPMD path checkpoints through **orbax**: every
 host writes its own shards, restore reshards onto the current mesh, and
 ``async_save`` overlaps serialization with the next training steps.
+
+Telemetry: every save/restore lands as a ``checkpoint.save`` /
+``checkpoint.restore`` span carrying the tree's payload bytes, split into a
+``checkpoint.serialize`` sub-span (tree construction + draining pending
+device compute, so async dispatch is not billed to storage) and a
+``checkpoint.io`` sub-span (the orbax write/read itself).
 """
 from __future__ import annotations
 
 import os
+
+from ..telemetry import bus as _tel
 
 __all__ = ["save_spmd_checkpoint", "load_spmd_checkpoint",
            "SPMDCheckpointManager"]
@@ -20,36 +28,72 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
-def save_spmd_checkpoint(path, trainer, step=None):
-    """Write the trainer's full state (params, optimizer slots, aux, step)
-    as a sharded orbax checkpoint."""
+def _tree_bytes(tree):
+    """Payload bytes across the tree's array leaves."""
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+    return total
+
+
+def _build_tree(trainer, step=None):
+    """Trainer state as the checkpoint pytree, with pending device compute
+    drained (counted as serialize time, not IO)."""
+    import jax
     params, opt_state, aux = trainer._state
     tree = {"params": params,
             "opt_state": {k: list(v) for k, v in opt_state.items()},
             "aux": list(aux),
             "step": trainer._t if step is None else step}
-    _checkpointer().save(os.path.abspath(path), tree, force=True)
+    jax.block_until_ready([leaf for leaf in jax.tree_util.tree_leaves(tree)
+                           if hasattr(leaf, "block_until_ready")])
+    return tree
+
+
+def save_spmd_checkpoint(path, trainer, step=None):
+    """Write the trainer's full state (params, optimizer slots, aux, step)
+    as a sharded orbax checkpoint."""
+    with _tel.span("checkpoint.save", kind="spmd") as sp:
+        with _tel.span("checkpoint.serialize"):
+            tree = _build_tree(trainer, step)
+        nbytes = _tree_bytes(tree)
+        sp.set(bytes_written=nbytes, path=str(path))
+        with _tel.span("checkpoint.io", bytes=nbytes):
+            _checkpointer().save(os.path.abspath(path), tree, force=True)
+        _tel.count("checkpoint.saves")
+        _tel.count("checkpoint.bytes_written", nbytes)
 
 
 def load_spmd_checkpoint(path, trainer):
     """Restore into an existing SPMDTrainer (resharding onto its mesh)."""
     import jax
 
-    params, opt_state, aux = trainer._state
-    template = {"params": params,
-                "opt_state": {k: list(v) for k, v in opt_state.items()},
-                "aux": list(aux),
-                "step": 0}
-    import orbax.checkpoint as ocp
-    restored = _checkpointer().restore(
-        os.path.abspath(path),
-        restore_args=jax.tree.map(
-            lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding)
-            if hasattr(x, "sharding") else ocp.RestoreArgs(), template))
-    trainer._state = (restored["params"],
-                      {k: tuple(v) for k, v in restored["opt_state"].items()},
-                      list(restored["aux"]))
-    trainer._t = int(restored["step"])
+    with _tel.span("checkpoint.restore", kind="spmd") as sp:
+        params, opt_state, aux = trainer._state
+        template = {"params": params,
+                    "opt_state": {k: list(v) for k, v in opt_state.items()},
+                    "aux": list(aux),
+                    "step": 0}
+        import orbax.checkpoint as ocp
+        with _tel.span("checkpoint.io"):
+            restored = _checkpointer().restore(
+                os.path.abspath(path),
+                restore_args=jax.tree.map(
+                    lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding)
+                    if hasattr(x, "sharding") else ocp.RestoreArgs(),
+                    template))
+        with _tel.span("checkpoint.deserialize"):
+            trainer._state = (restored["params"],
+                              {k: tuple(v)
+                               for k, v in restored["opt_state"].items()},
+                              list(restored["aux"]))
+            trainer._t = int(restored["step"])
+        nbytes = _tree_bytes(restored)
+        sp.set(bytes_read=nbytes, path=str(path))
+        _tel.count("checkpoint.restores")
+        _tel.count("checkpoint.bytes_read", nbytes)
     return trainer
 
 
@@ -65,13 +109,17 @@ class SPMDCheckpointManager:
 
     def save(self, step, trainer):
         import orbax.checkpoint as ocp
-        params, opt_state, aux = trainer._state
-        tree = {"params": params,
-                "opt_state": {k: list(v) for k, v in opt_state.items()},
-                "aux": list(aux),
-                "step": trainer._t}
-        self._mgr.save(step, args=ocp.args.PyTreeSave(tree))
-        self._mgr.wait_until_finished()
+        with _tel.span("checkpoint.save", kind="spmd_managed",
+                       step=step) as sp:
+            with _tel.span("checkpoint.serialize"):
+                tree = _build_tree(trainer)
+            nbytes = _tree_bytes(tree)
+            sp.set(bytes_written=nbytes)
+            with _tel.span("checkpoint.io", bytes=nbytes):
+                self._mgr.save(step, args=ocp.args.PyTreeSave(tree))
+                self._mgr.wait_until_finished()
+            _tel.count("checkpoint.saves")
+            _tel.count("checkpoint.bytes_written", nbytes)
 
     def latest_step(self):
         return self._mgr.latest_step()
@@ -80,21 +128,32 @@ class SPMDCheckpointManager:
         import jax
         import orbax.checkpoint as ocp
         step = step if step is not None else self._mgr.latest_step()
-        params, opt_state, aux = trainer._state
-        template = {"params": params,
-                    "opt_state": {k: list(v) for k, v in opt_state.items()},
-                    "aux": list(aux),
-                    "step": 0}
-        restored = self._mgr.restore(
-            step, args=ocp.args.PyTreeRestore(
-                template,
-                restore_args=jax.tree.map(
-                    lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding)
-                    if hasattr(x, "sharding") else ocp.RestoreArgs(),
-                    template)))
-        trainer._state = (restored["params"],
-                          {k: tuple(v)
-                           for k, v in restored["opt_state"].items()},
-                          list(restored["aux"]))
-        trainer._t = int(restored["step"])
+        with _tel.span("checkpoint.restore", kind="spmd_managed",
+                       step=step) as sp:
+            params, opt_state, aux = trainer._state
+            template = {"params": params,
+                        "opt_state": {k: list(v)
+                                      for k, v in opt_state.items()},
+                        "aux": list(aux),
+                        "step": 0}
+            with _tel.span("checkpoint.io"):
+                restored = self._mgr.restore(
+                    step, args=ocp.args.PyTreeRestore(
+                        template,
+                        restore_args=jax.tree.map(
+                            lambda x: ocp.ArrayRestoreArgs(
+                                sharding=x.sharding)
+                            if hasattr(x, "sharding")
+                            else ocp.RestoreArgs(), template)))
+            with _tel.span("checkpoint.deserialize"):
+                trainer._state = (restored["params"],
+                                  {k: tuple(v)
+                                   for k, v in
+                                   restored["opt_state"].items()},
+                                  list(restored["aux"]))
+                trainer._t = int(restored["step"])
+            nbytes = _tree_bytes(restored)
+            sp.set(bytes_read=nbytes)
+            _tel.count("checkpoint.restores")
+            _tel.count("checkpoint.bytes_read", nbytes)
         return trainer

@@ -28,6 +28,10 @@ Instrumented subsystems (event-name prefix = subsystem):
   payload bytes from the lowered HLO (``parallel/trainer.py``,
   ``gluon/trainer.py``)
 - ``kvstore.*``   — push/pull call counts and payload bytes
+- ``optimizer.*`` — aggregated-update group spans, dispatch counts,
+  group-signature compile misses, state bytes (``optimizer/aggregate.py``)
+- ``checkpoint.*``— save/restore spans with bytes and serialize-vs-IO
+  split (``gluon/trainer.py``, ``parallel/checkpoint.py``)
 - ``io.*``        — prefetch producer/consumer wait (host-bound shows up
   as a number)
 - ``engine.*``    — ``engine.bulk`` scopes (reference bulking intent)
@@ -40,6 +44,7 @@ attribute read (<2% on the eager microbench, see ``bench.py`` config
 from . import bus  # noqa: F401
 from . import exporters  # noqa: F401
 from . import jax_hooks  # noqa: F401
+from . import sampler  # noqa: F401
 from .bus import (  # noqa: F401
     count,
     counter_sample,
@@ -56,11 +61,17 @@ from .bus import (  # noqa: F401
 )
 from .exporters import dump_metrics, dump_trace, trace_events  # noqa: F401
 from .jax_hooks import collective_stats, record_collectives  # noqa: F401
+from .sampler import (  # noqa: F401
+    sampler_running,
+    start_counter_sampler,
+    stop_counter_sampler,
+)
 
 __all__ = [
     "enable", "disable", "is_enabled", "reset", "snapshot",
     "span", "count", "gauge", "instant", "counter_sample", "counter_value",
     "span_aggregates", "dump_trace", "dump_metrics", "trace_events",
-    "collective_stats", "record_collectives", "bus", "exporters",
-    "jax_hooks",
+    "collective_stats", "record_collectives",
+    "start_counter_sampler", "stop_counter_sampler", "sampler_running",
+    "bus", "exporters", "jax_hooks", "sampler",
 ]

@@ -1,0 +1,81 @@
+"""Periodic background counter sampler — counter *timelines* in the trace.
+
+Counters (``bus.count``) are cheap running totals; the chrome-trace exporter
+can only chart them over time if someone periodically emits 'C' samples
+(``bus.counter_sample``).  Doing that inline would put a clock read on hot
+paths, so this module runs an opt-in daemon thread that samples registered
+counters every ``interval_ms`` — long runs get io/dispatch/optimizer counter
+timelines without touching the instrumented code.
+
+Usage::
+
+    mx.telemetry.start_counter_sampler(interval_ms=200)          # all counters
+    mx.telemetry.start_counter_sampler(["io.batches"], 50)       # a subset
+    ... train ...
+    mx.telemetry.stop_counter_sampler()
+
+The thread samples only while the bus is enabled (a disabled bus makes
+``counter_sample`` a no-op, so disable()/enable() pauses/resumes the
+timeline without tearing the thread down).  ``start`` is idempotent per
+configuration: calling it again restarts the thread with the new settings.
+"""
+from __future__ import annotations
+
+import threading
+
+from . import bus
+
+__all__ = ["start_counter_sampler", "stop_counter_sampler",
+           "sampler_running"]
+
+_lock = threading.Lock()
+_thread = None
+_stop_event = None
+
+
+def _run(names, interval_s, stop_event):
+    while not stop_event.wait(interval_s):
+        if not bus.enabled:
+            continue
+        targets = names if names is not None else list(bus._counters)
+        for name in targets:
+            bus.counter_sample(name)
+
+
+def start_counter_sampler(names=None, interval_ms=100):
+    """Start (or restart) the background sampler.
+
+    ``names``: iterable of counter names to sample, or None to sample every
+    counter the bus knows at each tick (new counters join the timeline as
+    they first increment).  ``interval_ms``: sampling period.
+    """
+    global _thread, _stop_event
+    interval_s = max(float(interval_ms), 1.0) / 1e3
+    names = list(names) if names is not None else None
+    with _lock:
+        _stop_unlocked()
+        _stop_event = threading.Event()
+        _thread = threading.Thread(
+            target=_run, args=(names, interval_s, _stop_event),
+            name="mxnet_tpu-counter-sampler", daemon=True)
+        _thread.start()
+    return _thread
+
+
+def _stop_unlocked():
+    global _thread, _stop_event
+    if _thread is not None:
+        _stop_event.set()
+        _thread.join(timeout=5.0)
+        _thread, _stop_event = None, None
+
+
+def stop_counter_sampler():
+    """Stop the sampler thread (no-op when not running)."""
+    with _lock:
+        _stop_unlocked()
+
+
+def sampler_running():
+    with _lock:
+        return _thread is not None and _thread.is_alive()

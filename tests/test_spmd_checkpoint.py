@@ -59,3 +59,35 @@ def test_checkpoint_manager_rotation(tmp_path):
     for k in tr._state[0]:
         np.testing.assert_allclose(np.asarray(tr._state[0][k]),
                                    np.asarray(tr2._state[0][k]), rtol=1e-6)
+
+
+def test_checkpoint_telemetry_spans(tmp_path):
+    """save/restore land as checkpoint.* spans with bytes and the
+    serialize-vs-IO split (ISSUE 2 satellite)."""
+    from mxnet_tpu import telemetry
+    telemetry.disable()
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        x, y = _data()
+        tr, _ = _trainer()
+        tr.step(x, y)
+        save_spmd_checkpoint(str(tmp_path / "ckpt"), tr)
+        load_spmd_checkpoint(str(tmp_path / "ckpt"), tr)
+        spans = telemetry.span_aggregates()
+        for name in ("checkpoint.save", "checkpoint.restore",
+                     "checkpoint.serialize", "checkpoint.io",
+                     "checkpoint.deserialize"):
+            assert name in spans, (name, sorted(spans))
+        snap = telemetry.snapshot()
+        c = snap["counters"]
+        assert c["checkpoint.saves"] == 1
+        assert c["checkpoint.restores"] == 1
+        assert c["checkpoint.bytes_written"] > 0
+        assert c["checkpoint.bytes_read"] == c["checkpoint.bytes_written"]
+        evs = {e[1]: e for e in telemetry.bus.events()}
+        assert evs["checkpoint.save"][6]["bytes_written"] > 0
+        assert evs["checkpoint.restore"][6]["bytes_read"] > 0
+    finally:
+        telemetry.disable()
+        telemetry.reset()

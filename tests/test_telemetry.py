@@ -442,3 +442,57 @@ def test_trace_has_multisubsystem_events():
     assert {"cachedop", "trainer", "kvstore", "io"} <= cats
     assert any(e["name"] == "cachedop.recompile"
                for e in doc["traceEvents"])
+
+
+# ------------------------------------------------- background counter sampler
+def test_counter_sampler_produces_timeline():
+    """The opt-in sampler thread emits periodic 'C' samples so long runs
+    get counter timelines in the chrome trace (ISSUE 2 satellite)."""
+    import time as _time
+
+    telemetry.enable()
+    telemetry.count("samp.work", 5)
+    telemetry.start_counter_sampler(["samp.work"], interval_ms=5)
+    try:
+        assert telemetry.sampler_running()
+        _time.sleep(0.1)
+    finally:
+        telemetry.stop_counter_sampler()
+    assert not telemetry.sampler_running()
+    samples = [e for e in telemetry.bus.events()
+               if e[0] == "C" and e[1] == "samp.work"]
+    assert len(samples) >= 2
+    assert all(e[6]["value"] == 5 for e in samples)
+    # timeline appears in the exported chrome trace as counter events
+    doc = telemetry.dump_trace()
+    cevents = [e for e in doc["traceEvents"]
+               if e.get("ph") == "C" and e.get("name") == "samp.work"]
+    assert len(cevents) >= 2
+
+
+def test_counter_sampler_all_counters_and_pause():
+    """names=None samples every live counter; a disabled bus pauses the
+    timeline without stopping the thread."""
+    import time as _time
+
+    telemetry.enable()
+    telemetry.count("samp.a")
+    telemetry.count("samp.b", 3)
+    telemetry.start_counter_sampler(interval_ms=5)
+    try:
+        _time.sleep(0.05)
+        names = {e[1] for e in telemetry.bus.events() if e[0] == "C"}
+        assert {"samp.a", "samp.b"} <= names
+        telemetry.disable()
+        _time.sleep(0.03)
+        n_disabled = len([e for e in telemetry.bus.events()
+                          if e[0] == "C"])
+        _time.sleep(0.05)
+        assert len([e for e in telemetry.bus.events()
+                    if e[0] == "C"]) == n_disabled
+        telemetry.enable()
+        _time.sleep(0.05)
+        assert len([e for e in telemetry.bus.events()
+                    if e[0] == "C"]) > n_disabled
+    finally:
+        telemetry.stop_counter_sampler()
