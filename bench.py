@@ -37,9 +37,13 @@ BASELINE.md).  All other configs are nested under ``"extra"``:
 - ``optimizer``: aggregated vs per-param optimizer update on ~200
   ResNet-like tensors (dispatches/step + update ms, the
   ``multi_sgd_mom_update`` / MXNET_OPTIMIZER_AGGREGATION_SIZE workload)
+- ``serving``: dynamic-batching inference runtime (``mxnet_tpu.serving``)
+  vs per-request baseline — 64 concurrent single-item requests, p50/p99
+  latency + throughput + padding-waste ratio + steady-state compile
+  misses (must be 0)
 
 Select a subset with
-BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,int8,io,e2e,eager,optimizer.
+BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,int8,io,e2e,eager,optimizer,serving.
 The full json carries a ``telemetry`` sub-dict (recompile count,
 collective bytes, io wait ms — disable with BENCH_TELEMETRY=0) so each
 BENCH record carries its own diagnosis.
@@ -880,6 +884,143 @@ def bench_optimizer_update():
     return out
 
 
+def bench_serving():
+    """Dynamic-batching serving runtime (``mxnet_tpu.serving``) vs a
+    per-request baseline: the same AOT-warmed model answering the same 64
+    concurrent single-item requests, once through the Batcher's micro-batch
+    coalescing (pad-to-bucket, zero steady-state compiles) and once one
+    synchronous call per request from n client threads.  The batched side
+    is driven the way its API is meant to be used — ``submit()`` returns a
+    future, so all n requests stay outstanding at once without an OS
+    thread pinned per request.  Reports p50/p99 request latency,
+    throughput, the batched-vs-per-request speedup, and the padding-waste
+    ratio — the acceptance numbers for the serving subsystem."""
+    import threading
+    import time as _time
+    from concurrent.futures import ThreadPoolExecutor
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import Batcher, ModelRuntime
+
+    n_requests = int(os.environ.get("BENCH_SERVING_REQUESTS", "64"))
+    rounds = int(os.environ.get("BENCH_SERVING_ROUNDS", "5"))
+    feat, max_batch = 256, 16
+    net = mx.gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(mx.gluon.nn.Dense(512, activation="relu"))
+        net.add(mx.gluon.nn.Dense(512, activation="relu"))
+        net.add(mx.gluon.nn.Dense(128))
+    net.initialize()
+
+    was_on = telemetry.is_enabled()
+    telemetry.enable()
+    rng = np.random.RandomState(0)
+    reqs = [rng.rand(feat).astype("float32") for _ in range(n_requests)]
+    rt = ModelRuntime(net, item_shapes=(feat,), max_batch=max_batch)
+    batcher = Batcher(rt, max_latency_ms=2.0, queue_depth=4 * n_requests)
+    clients = ThreadPoolExecutor(max_workers=n_requests)
+
+    def batched_round():
+        """One round with all n single-item requests outstanding at once:
+        ``submit()`` returns a future, so the client keeps every request
+        in flight without blocking a thread per request.  Latency is
+        stamped submit→done by the future's callback; the round waits on
+        the LAST CALLBACK (``set_result`` wakes ``result()`` waiters
+        before running callbacks, so waiting on futures alone could read
+        the list short)."""
+        lat = []
+        all_done = threading.Event()
+
+        def on_done(_f, ts):
+            lat.append(_time.perf_counter() - ts)
+            if len(lat) == n_requests:
+                all_done.set()
+
+        t0 = _time.perf_counter()
+        futs = []
+        for r in reqs:
+            ts = _time.perf_counter()
+            f = batcher.submit(r)
+            f.add_done_callback(lambda f, ts=ts: on_done(f, ts))
+            futs.append(f)
+        if not all_done.wait(timeout=120):
+            raise RuntimeError("serving bench round timed out")
+        for f in futs:
+            f.result(timeout=60)               # propagate any errors
+        return _time.perf_counter() - t0, sorted(lat)
+
+    def per_request_round():
+        """Same n concurrent requests against the SAME warmed runtime, one
+        synchronous call per request from n client threads (bucket-1
+        executable replay) — a server without dynamic batching."""
+        lat = []
+        lock = threading.Lock()
+
+        def client(r):
+            t0 = _time.perf_counter()
+            rt(r)
+            dt = _time.perf_counter() - t0
+            with lock:
+                lat.append(dt)
+
+        t0 = _time.perf_counter()
+        futs = [clients.submit(client, r) for r in reqs]
+        for f in futs:
+            f.result()
+        return _time.perf_counter() - t0, sorted(lat)
+
+    def measure(run_round):
+        walls, lats = [], []
+        for _ in range(rounds):
+            w, l = run_round()
+            walls.append(w)
+            lats.extend(l)
+        lats.sort()
+        return {
+            "req_per_sec": round(n_requests * rounds / sum(walls), 1),
+            "latency_ms_p50": round(
+                lats[len(lats) // 2] * 1e3, 3),
+            "latency_ms_p99": round(
+                lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3, 3),
+        }
+
+    # cleanup must run even if a round raises: main() records the error
+    # and moves on, and a leaked worker/executor/force-enabled bus would
+    # skew every config measured after this one
+    try:
+        # batched path: miss accounting starts after the warm round
+        batched_round()                            # warm
+        misses0 = telemetry.counter_value("serving.compile_miss")
+        items0 = telemetry.counter_value("serving.batch_items")
+        padded0 = telemetry.counter_value("serving.padded_items")
+        batched = measure(batched_round)
+        misses = telemetry.counter_value("serving.compile_miss") - misses0
+        items = telemetry.counter_value("serving.batch_items") - items0
+        padded = telemetry.counter_value("serving.padded_items") - padded0
+
+        per_request_round()                        # warm
+        per_request = measure(per_request_round)
+    finally:
+        batcher.close(drain=False)
+        clients.shutdown(wait=False)
+        if not was_on:
+            telemetry.disable()
+    return {
+        "n_requests_concurrent": n_requests,
+        "rounds": rounds,
+        "model": "mlp_256_512_512_128",
+        "max_batch": max_batch,
+        "max_latency_ms": 2.0,
+        "buckets": list(rt.buckets),
+        "batched": batched,
+        "per_request": per_request,
+        "speedup_vs_per_request": round(
+            batched["req_per_sec"] / per_request["req_per_sec"], 2),
+        "steady_state_compile_misses": misses,
+        "padding_waste_ratio": round(padded / max(items + padded, 1), 4),
+    }
+
+
 def bench_eager_dispatch():
     """Eager op-dispatch microbench: a 500-op add chain through the
     jit-cached imperative path, telemetry off vs on.  This is the number
@@ -947,7 +1088,15 @@ def _telemetry_summary():
         "kvstore_push_bytes": c.get("kvstore.push_bytes", 0),
         "io_consumer_wait_ms": round(c.get("io.consumer_wait_ms", 0.0), 1),
         "io_producer_wait_ms": round(c.get("io.producer_wait_ms", 0.0), 1),
+        "io_decode_wait_ms": round(c.get("io.decode_wait_ms", 0.0), 1),
         "io_batches": c.get("io.batches", 0),
+        "serving_batches": c.get("serving.batches", 0),
+        "serving_batch_items": c.get("serving.batch_items", 0),
+        "serving_padded_items": c.get("serving.padded_items", 0),
+        "serving_compile_misses": c.get("serving.compile_miss", 0),
+        "serving_rejections": c.get("serving.rejections", 0),
+        "serving_queue_wait_ms": round(
+            c.get("serving.queue_wait_ms", 0.0), 1),
     }
 
 
@@ -955,7 +1104,7 @@ def main():
     sel = [s.strip() for s in
            os.environ.get("BENCH_CONFIGS",
                           "headline,infer,fp32,amp,bert,ssd,int8,io,e2e,"
-                          "eager,optimizer").split(",")]
+                          "eager,optimizer,serving").split(",")]
     extra = {}
 
     # telemetry rides along for diagnosis (counters only — the configs
@@ -1044,6 +1193,11 @@ def main():
             extra["optimizer_update"] = bench_optimizer_update()
         except Exception as e:           # pragma: no cover
             extra["optimizer_update"] = {"error": repr(e)}
+    if "serving" in sel:
+        try:
+            extra["serving_dynamic_batching"] = bench_serving()
+        except Exception as e:           # pragma: no cover
+            extra["serving_dynamic_batching"] = {"error": repr(e)}
 
     value = headline.get("items_per_sec") if headline else None
     full = {

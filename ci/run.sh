@@ -12,7 +12,11 @@
 #   optimizer  - aggregated multi-tensor update smoke: the new tests plus
 #                a 2-step optimizer_update bench sanity check (>=10x
 #                dispatch reduction, zero steady-state compile misses)
-# Usage: ci/run.sh [stage ...]   (default: unit gate telemetry optimizer)
+#   serving    - dynamic-batching inference runtime smoke: test_serving.py
+#                plus a short serving bench sanity check (>=3x batched
+#                throughput, zero steady-state compile misses, deadline
+#                rejection on a full queue)
+# Usage: ci/run.sh [stage ...]   (default: unit gate telemetry optimizer serving)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -156,8 +160,39 @@ print("optimizer bench ok:", pp["dispatches_per_step"], "->",
 PY
 }
 
+stage_serving() {
+  JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
+  JAX_PLATFORMS=cpu BENCH_SERVING_ROUNDS=2 python - <<'PY'
+import bench
+import mxnet_tpu as mx
+
+r = bench.bench_serving()
+assert r["speedup_vs_per_request"] >= 3.0, r
+assert r["steady_state_compile_misses"] == 0, r
+
+# load shedding: a deadlined submit against a full queue rejects, not hangs
+import numpy as np
+net = mx.gluon.nn.Dense(4)
+net.initialize()
+rt = mx.serving.ModelRuntime(net, item_shapes=(8,), max_batch=2)
+b = mx.serving.Batcher(rt, queue_depth=1, start=False)
+b.submit(np.zeros(8, "float32"))
+try:
+    b.submit(np.zeros(8, "float32"), deadline_ms=50)
+    raise AssertionError("full queue + expired deadline must reject")
+except mx.serving.RequestRejected as e:
+    assert e.reason == "deadline", e
+b.close(drain=True)
+print("serving bench ok:", r["per_request"]["req_per_sec"], "->",
+      r["batched"]["req_per_sec"], "req/s",
+      f"({r['speedup_vs_per_request']}x),",
+      f"p99 {r['batched']['latency_ms_p99']}ms,",
+      f"padding waste {r['padding_waste_ratio']:.1%}")
+PY
+}
+
 stages=("$@")
-[ $# -eq 0 ] && stages=(unit gate telemetry optimizer)
+[ $# -eq 0 ] && stages=(unit gate telemetry optimizer serving)
 for s in "${stages[@]}"; do
   echo "=== ci stage: $s ==="
   "stage_$s"

@@ -15,11 +15,13 @@ from __future__ import annotations
 import gzip
 import os
 import struct
+import time
 
 import numpy as np
 
 from .. import ndarray as nd
 from ..base import parse_tuple
+from ..telemetry import bus as _tel
 from .io import DataBatch, DataDesc, DataIter
 
 __all__ = ["CSVIter", "MNISTIter", "ImageRecordIter", "LibSVMIter"]
@@ -399,22 +401,50 @@ class ImageRecordIter(DataIter):
         else:
             pad = end - self.num_data
             sel = np.concatenate([self._order[start:], self._order[:pad]])
-        raws = self._read_many(sel)
+        with _tel.span("io.read_records", n=len(sel)):
+            raws = self._read_many(sel)
         flips = self._rng.rand(len(sel)) < 0.5 if self._rand_mirror \
             else np.zeros(len(sel), dtype=bool)
         crops = self._rng.rand(len(sel), 2)
         from .. import _native
         native = None
+        # decode waits exported per caller (ROADMAP io.* item): the caller
+        # of next() — the training loop, or a PrefetchingIter producer
+        # thread — blocks on the iterator's INTERNAL decode pool (or the
+        # native batch decoder) for exactly this long.  A dedicated name,
+        # not io.consumer_wait_ms: the wrappers own the loop-vs-pipeline
+        # split, this counter attributes the stall to jpeg decode itself.
+        t0 = time.perf_counter()
         if _native.decode_available():
             native = self._decode_batch_native(raws, flips, crops)
         if native is not None:
             data, labels = native
+            # stamp before astype: the pool branch's stack/astype is
+            # outside its span too, so the two decoder labels stay
+            # comparable
+            if _tel.enabled:
+                wait = time.perf_counter() - t0
+                _tel.count("io.decode_wait_ms", wait * 1e3,
+                           decoder="native")
+                _tel.record_span("io.decode_batch", t0,
+                                 decoder="native", n=len(sel))
             data = data.astype(self._dtype, copy=False)
         else:
-            decoded = list(self._pool.map(self._decode_one, raws, flips,
-                                          crops))
+            # restamp: the failed native attempt (non-JPEG sniff) is not
+            # pool wait — keep the counter aligned with the pool span
+            t0 = time.perf_counter()
+            with _tel.span("io.decode_batch", decoder="pool",
+                           n=len(sel), threads=self._threads):
+                decoded = list(self._pool.map(self._decode_one, raws, flips,
+                                              crops))
+            if _tel.enabled:
+                _tel.count("io.decode_wait_ms",
+                           (time.perf_counter() - t0) * 1e3,
+                           decoder="pool")
             data = np.stack([d for d, _ in decoded]).astype(self._dtype)
             labels = np.stack([l for _, l in decoded])
+        if _tel.enabled:
+            _tel.count("io.record_batches")
         return DataBatch(data=[nd.array(data)], label=[nd.array(labels)],
                          pad=pad, index=sel.copy())
 

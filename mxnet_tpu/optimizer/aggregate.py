@@ -40,7 +40,8 @@ import numpy
 from ..ndarray import NDArray
 from ..telemetry import bus as _tel
 
-__all__ = ["update_multi", "registered_rules", "cache_info", "clear_cache"]
+__all__ = ["update_multi", "functional_update", "registered_rules",
+           "cache_info", "clear_cache"]
 
 
 def _is_dense(arr):
@@ -454,6 +455,75 @@ def _state_bytes(states):
                     n *= int(d)
                 total += n * leaf.dtype.itemsize
     return total
+
+
+def functional_update(fopt, params, grads, state, lr):
+    """ONE jitted dispatch for a whole :class:`FunctionalOptimizer` step.
+
+    The SPMD follow-up to the eager path above (ROADMAP): an eager caller
+    driving ``parallel.FunctionalOptimizer.update`` directly — outside
+    ``make_train_step``'s jit — would pay one dispatch per parameter per
+    slot.  Here the whole ``(params, grads, state)`` dict updates in one
+    jitted call compiled once per (optimizer signature, members signature)
+    through the SAME compiled-group cache as ``update_multi``, with the same
+    ``optimizer.compile_miss`` telemetry: steady-state steps take zero
+    compile misses and ``lr`` (schedules, Adam bias correction) is traced,
+    so changing it never recompiles.
+
+    Purely functional — nothing is donated or mutated: callers keep their
+    input arrays (``update`` returns fresh ``(params', state')``).  The
+    per-tensor math is ``fopt.update_one`` itself (the ``optimizer_ops``
+    kernels), so numerics are identical to the inline path bit for bit.
+    """
+    names = tuple(sorted(params))
+    # every non-lr hyperparameter is baked into the trace (update_one reads
+    # them off fopt), so they key the cache; lr is the traced argument —
+    # schedules and bias correction never recompile
+    static = (fopt.name, float(fopt.momentum), float(fopt.wd),
+              float(fopt.beta1), float(fopt.beta2), float(fopt.epsilon),
+              float(fopt.gamma1), float(fopt.rescale_grad),
+              float(fopt.clip_gradient))
+    members = tuple(
+        (k, tuple(params[k].shape), str(params[k].dtype),
+         str(grads[k].dtype),
+         tuple((tuple(s.shape), str(s.dtype)) for s in state[k]))
+        for k in names)
+    cache_key = ("functional", static, False, members)
+    fn = _compiled.get(cache_key)
+    tel_on = _tel.enabled
+    if fn is None:
+        # close over a FROZEN copy, not the live fopt: the cache key holds
+        # these hyperparam VALUES, but jax may retrace the closure long
+        # after this miss (e.g. lr arriving as a new aval) — a caller who
+        # mutated fopt in the meantime would otherwise bake stale values
+        # into an entry keyed by the old ones
+        import copy
+        snap = copy.copy(fopt)
+        (snap.momentum, snap.wd, snap.beta1, snap.beta2, snap.epsilon,
+         snap.gamma1, snap.rescale_grad, snap.clip_gradient) = static[1:]
+
+        def group_update(params, grads, state, lr):
+            new_params, new_state = {}, {}
+            for k in names:
+                w, s = snap.update_one(params[k], grads[k], state[k], lr)
+                new_params[k] = w
+                new_state[k] = s
+            return new_params, new_state
+
+        fn = jax.jit(group_update)
+        _compiled[cache_key] = fn
+        if tel_on:
+            _tel.count("optimizer.compile_misses")
+            _tel.instant("optimizer.compile_miss", opt=fopt.name,
+                         n=len(names), signature="functional",
+                         shapes=repr([m[1] for m in members]))
+    if tel_on:
+        _tel.count("optimizer.update_calls")
+        _tel.count("optimizer.aggregated_params", len(names))
+        _tel.gauge("optimizer.update_groups", 1)
+    with _tel.span("optimizer.update_group", opt=fopt.name, n=len(names),
+                   mp=False):
+        return fn(params, grads, state, lr)
 
 
 def _run_group(opt, name, rule, sig, mp, chunk, indices, weights, grads,

@@ -112,11 +112,29 @@ class FunctionalOptimizer:
     def update(self, params, grads, state, t=None):
         """Apply one step over the whole param dict.  ``t`` (0-based step) is
         used for Adam bias correction the way the eager path does it
-        (reference ``optimizer.py:1146`` scales lr by the correction)."""
+        (reference ``optimizer.py:1146`` scales lr by the correction).
+
+        Called on concrete arrays (outside a jit trace), the whole dict
+        updates through ONE jitted dispatch compiled via the shared
+        aggregated-group cache (``optimizer/aggregate.py`` —
+        ``optimizer.compile_miss`` telemetry, zero steady-state misses), so
+        an eager SPMD driver gets the same 1-dispatch/step update path as
+        the multi-tensor eager optimizers.  Under a trace (e.g. inside
+        ``make_train_step``'s jitted step) the per-tensor loop inlines into
+        the surrounding jit exactly as before."""
         lr = self.learning_rate
         if self.name in ("adam", "adamw") and t is not None:
             tt = t + 1
             lr = lr * jnp.sqrt(1.0 - self.beta2 ** tt) / (1.0 - self.beta1 ** tt)
+        # exact type() only, like the eager aggregation rules: a subclass
+        # may override update_one, and the compiled-group cache is keyed
+        # by hyperparam VALUES — two classes sharing a key would replay
+        # each other's math
+        leaves = jax.tree_util.tree_leaves((params, grads, state, t))
+        if type(self) is FunctionalOptimizer and leaves and \
+                not any(isinstance(x, jax.core.Tracer) for x in leaves):
+            from ..optimizer.aggregate import functional_update
+            return functional_update(self, params, grads, state, lr)
         new_params, new_state = {}, {}
         for k in params:
             w, s = self.update_one(params[k], grads[k], state[k], lr)

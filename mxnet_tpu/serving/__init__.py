@@ -1,0 +1,40 @@
+"""``mxnet_tpu.serving`` — dynamic-batching inference runtime.
+
+The training side of this framework compiles a step once and replays it;
+this package gives *inference* the same discipline under organic traffic:
+
+- :class:`ModelRuntime` (``runtime.py``) — a hybridized block AOT-compiled
+  at a ladder of batch buckets (powers of two up to ``max_batch``), every
+  bucket warmed at load through the CachedOp path
+  (``HybridBlock.compile_for``).  Micro-batches pad up to their bucket, so
+  steady state has **zero** XLA recompiles (``serving.compile_miss``).
+- :class:`Batcher` (``batcher.py``) — a worker thread coalescing concurrent
+  ``submit()`` futures into micro-batches (flush on ``max_batch`` or
+  ``max_latency_ms``), with a bounded queue (backpressure), per-request
+  deadlines (load-shedding :class:`RequestRejected`), and worker-crash
+  recovery.
+- :class:`ModelRegistry` (``registry.py``) — multi-model map with atomic
+  hot-swap: new traffic routes to the new weights instantly, the old
+  batcher drains.
+
+Observability rides on :mod:`mxnet_tpu.telemetry` (``serving.*`` events:
+queue-wait/run spans, batch-size and padding-waste counters, compile
+misses, rejections — see docs/serving.md and docs/telemetry.md).
+
+Minimal use::
+
+    import mxnet_tpu as mx
+
+    net = ...                                    # HybridBlock, initialized
+    rt = mx.serving.ModelRuntime(net, item_shapes=(3, 224, 224),
+                                 max_batch=32)
+    srv = mx.serving.Batcher(rt, max_latency_ms=5)
+    fut = srv.submit(image, deadline_ms=100)     # from any thread
+    probs = fut.result()
+"""
+from .batcher import Batcher, RequestRejected  # noqa: F401
+from .registry import ModelRegistry  # noqa: F401
+from .runtime import ModelRuntime, default_buckets  # noqa: F401
+
+__all__ = ["ModelRuntime", "Batcher", "ModelRegistry", "RequestRejected",
+           "default_buckets"]

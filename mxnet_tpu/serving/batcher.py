@@ -1,0 +1,303 @@
+"""Dynamic micro-batching — the request side of the serving stack.
+
+Clipper-style adaptive batching: concurrent ``submit()`` calls coalesce
+into micro-batches on a worker thread.  A batch closes when ``max_batch``
+requests are pending or ``max_latency_ms`` has elapsed since its first
+request was enqueued, whichever comes first — so an idle server answers a
+lone request within the latency budget and a loaded server fills buckets.
+
+Robustness contract:
+
+- **Bounded queue, backpressure.**  ``submit()`` on a full queue blocks the
+  caller (a natural producer throttle) — unless the request carries a
+  deadline, in which case it is *rejected* the moment the deadline expires
+  while still waiting for space.  A full queue never hangs a deadlined
+  request.
+- **Load shedding.**  Requests whose deadline passed while queued are
+  rejected at dequeue instead of wasting a bucket slot on an answer nobody
+  is waiting for.
+- **Worker-crash recovery.**  A model exception fails that batch's futures
+  and the worker keeps serving; if the worker thread itself ever dies,
+  the next ``submit()`` respawns it.
+
+Every rejection carries a ``reason`` (``deadline`` / ``shutdown``) both on
+the raised :class:`RequestRejected` and on the ``serving.rejections``
+telemetry counter.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+from ..telemetry import bus as _tel
+
+__all__ = ["Batcher", "RequestRejected"]
+
+
+class RequestRejected(RuntimeError):
+    """A request was load-shed instead of served.
+
+    ``reason`` is ``"deadline"`` (expired while queued or while waiting for
+    queue space) or ``"shutdown"`` (batcher closed without drain)."""
+
+    def __init__(self, reason, detail=""):
+        msg = f"request rejected ({reason})"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+        self.reason = reason
+
+
+class _Request:
+    __slots__ = ("rows", "future", "deadline", "t_submit", "t_enqueue")
+
+    def __init__(self, rows, deadline, t_submit):
+        self.rows = rows
+        self.future = Future()
+        self.deadline = deadline
+        # t_submit: when the client entered submit() — queue-wait telemetry
+        # must include time spent blocked on backpressure, or the metric
+        # reads near-zero in exactly the overload regime it exists for.
+        # t_enqueue: when the request actually entered the queue — the
+        # batch flush timer anchors here so one long-blocked request does
+        # not force every batch after it to flush immediately.
+        self.t_submit = t_submit
+        self.t_enqueue = time.perf_counter()
+
+
+class Batcher:
+    """Coalesces concurrent ``submit()`` calls into micro-batches for one
+    :class:`~mxnet_tpu.serving.ModelRuntime`.
+
+    Parameters
+    ----------
+    runtime : ModelRuntime
+    max_batch : int, optional
+        Flush threshold; defaults to (and is capped at) the runtime's
+        ``max_batch``.
+    max_latency_ms : float
+        Longest a request waits for batch-mates before a partial batch is
+        flushed.
+    queue_depth : int
+        Bound on queued requests; beyond it ``submit()`` exerts
+        backpressure (or sheds load, if the request has a deadline).
+    start : bool
+        Start the worker thread now (default).  ``start=False`` lets tests
+        enqueue deterministically and then :meth:`start`.
+    """
+
+    def __init__(self, runtime, max_batch=None, max_latency_ms=5.0,
+                 queue_depth=256, start=True):
+        self._runtime = runtime
+        if max_batch is not None and int(max_batch) < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = min(int(max_batch) if max_batch is not None
+                             else runtime.max_batch, runtime.max_batch)
+        self.max_latency = float(max_latency_ms) / 1e3
+        if int(queue_depth) < 1:
+            # 0 would make every deadline-less submit() block forever
+            raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        self.queue_depth = int(queue_depth)
+        self._queue = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._closed = False
+        self._started = False
+        self._worker = None
+        self.batches_failed = 0
+        if start:
+            self.start()
+
+    # --------------------------------------------------------------- client
+    def submit(self, payload, deadline_ms=None):
+        """Enqueue one request; returns a ``concurrent.futures.Future``
+        resolving to the per-request model output.
+
+        ``deadline_ms`` is a wall-clock budget from now: once it expires the
+        request is rejected wherever it is — waiting for queue space, or
+        queued but not yet dispatched.  Without a deadline, a full queue
+        blocks the caller (backpressure)."""
+        t_submit = time.perf_counter()
+        rows = self._runtime._normalize(payload)   # malformed → raise HERE
+        deadline = (t_submit + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        with self._lock:
+            if self._closed:
+                self._count_rejection("shutdown")
+                raise RequestRejected("shutdown", "batcher is closed")
+            if self._started:
+                self._respawn_worker_locked()
+            while len(self._queue) >= self.queue_depth:
+                remaining = None if deadline is None \
+                    else deadline - time.perf_counter()
+                if remaining is not None and remaining <= 0:
+                    self._count_rejection("deadline")
+                    raise RequestRejected(
+                        "deadline", "queue stayed full past the deadline")
+                self._not_full.wait(timeout=remaining)
+                if self._closed:
+                    self._count_rejection("shutdown")
+                    raise RequestRejected("shutdown", "batcher is closed")
+            req = _Request(rows, deadline, t_submit)
+            self._queue.append(req)
+            if _tel.enabled:
+                _tel.count("serving.requests", model=self._runtime.name)
+                _tel.gauge("serving.queue_depth", len(self._queue),
+                           model=self._runtime.name)
+            self._not_empty.notify()
+        return req.future
+
+    def infer(self, payload, deadline_ms=None):
+        """Synchronous convenience: ``submit(...).result()``."""
+        timeout = None if deadline_ms is None \
+            else deadline_ms / 1e3 + self.max_latency + 30.0
+        return self.submit(payload, deadline_ms=deadline_ms).result(timeout)
+
+    def pending(self):
+        with self._lock:
+            return len(self._queue)
+
+    # --------------------------------------------------------------- worker
+    def start(self):
+        """Start (or restart) the worker thread."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            self._started = True
+            self._respawn_worker_locked()
+
+    def _respawn_worker_locked(self):
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"serving-batcher-{self._runtime.name}")
+            self._worker.start()
+
+    def _run(self):
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            if batch:
+                self._process(batch)
+
+    def _collect(self):
+        """Block for the next micro-batch.  Returns ``None`` at shutdown,
+        else a (possibly deadline-pruned-later) list of requests."""
+        with self._lock:
+            while not self._queue:
+                if self._closed:
+                    return None
+                self._not_empty.wait()
+            first = self._queue.popleft()
+            batch = [first]
+            # the latency budget is anchored at the FIRST request's enqueue:
+            # max_latency_ms bounds time-in-queue, not time-since-dequeue
+            flush_at = first.t_enqueue + self.max_latency
+            while len(batch) < self.max_batch:
+                if self._queue:
+                    batch.append(self._queue.popleft())
+                    continue
+                if self._closed:
+                    break
+                remaining = flush_at - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._not_empty.wait(timeout=remaining)
+                if not self._queue and \
+                        time.perf_counter() >= flush_at:
+                    break
+            self._not_full.notify_all()
+            if _tel.enabled:
+                _tel.gauge("serving.queue_depth", len(self._queue),
+                           model=self._runtime.name)
+        return batch
+
+    def _process(self, batch):
+        now = time.perf_counter()
+        live = []
+        for req in batch:
+            if req.deadline is not None and now > req.deadline:
+                # load shedding: nobody is waiting for this answer anymore
+                self._count_rejection("deadline")
+                req.future.set_exception(RequestRejected(
+                    "deadline", "expired while queued"))
+                continue
+            if req.future.set_running_or_notify_cancel():
+                live.append(req)
+        if not live:
+            return
+        tel_on = _tel.enabled
+        if tel_on:
+            for req in live:
+                _tel.record_span("serving.queue_wait", req.t_submit, now,
+                                 model=self._runtime.name)
+                _tel.count("serving.queue_wait_ms",
+                           (now - req.t_submit) * 1e3,
+                           model=self._runtime.name)
+        try:
+            with _tel.span("serving.run", model=self._runtime.name,
+                           batch=len(live),
+                           bucket=self._runtime.bucket_for(len(live))):
+                outs = self._runtime.run_batch([r.rows for r in live])
+        except BaseException as e:
+            # a model crash fails THIS batch's futures; the worker survives
+            self.batches_failed += 1
+            if tel_on:
+                _tel.count("serving.batch_failures",
+                           model=self._runtime.name)
+                _tel.instant("serving.batch_failure",
+                             model=self._runtime.name, error=repr(e))
+            for req in live:
+                req.future.set_exception(e)
+            return
+        if tel_on:
+            _tel.count("serving.batches", model=self._runtime.name)
+        for req, out in zip(live, outs):
+            req.future.set_result(out)
+
+    # ------------------------------------------------------------- shutdown
+    def close(self, drain=True, timeout=30.0):
+        """Stop the batcher.  ``drain=True`` (default) serves everything
+        already queued before returning — the hot-swap path, so in-flight
+        requests complete against the old weights; ``drain=False`` rejects
+        the queue with ``reason="shutdown"``."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            if not drain:
+                while self._queue:
+                    req = self._queue.popleft()
+                    self._count_rejection("shutdown")
+                    req.future.set_exception(
+                        RequestRejected("shutdown", "batcher closed"))
+            worker = self._worker
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+        if worker is not None and worker.is_alive():
+            worker.join(timeout=timeout)
+        # drain with no live worker (never started, or crashed): inline
+        while drain:
+            with self._lock:
+                if not self._queue:
+                    break
+                take = min(self.max_batch, len(self._queue))
+                batch = [self._queue.popleft() for _ in range(take)]
+            self._process(batch)
+
+    def _count_rejection(self, reason):
+        if _tel.enabled:
+            _tel.count("serving.rejections", model=self._runtime.name,
+                       reason=reason)
+            _tel.instant("serving.rejection", model=self._runtime.name,
+                         reason=reason)
+
+    def __del__(self):
+        try:
+            self.close(drain=False, timeout=1.0)
+        except Exception:
+            pass

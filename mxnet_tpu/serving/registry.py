@@ -1,0 +1,112 @@
+"""Multi-model registry with atomic hot-swap.
+
+One process serves many models (and many *versions* of a model: swap
+installs new weights without dropping requests).  The registry maps a name
+to a live :class:`Batcher`; ``swap()`` routes new traffic to the
+replacement atomically and drains the old batcher, so every request is
+answered by exactly one consistent set of weights.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..telemetry import bus as _tel
+from .batcher import Batcher
+
+__all__ = ["ModelRegistry"]
+
+
+class ModelRegistry:
+    """Name → :class:`Batcher` map with atomic replace semantics."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._batchers = {}
+
+    def _make(self, model, kwargs):
+        if isinstance(model, Batcher):
+            if kwargs:
+                raise ValueError(
+                    "batcher kwargs are only accepted with a ModelRuntime")
+            return model
+        return Batcher(model, **kwargs)
+
+    def register(self, name, model, **batcher_kwargs):
+        """Install ``model`` (a :class:`Batcher`, or a ``ModelRuntime`` plus
+        ``Batcher`` kwargs) under ``name``.  Refuses to shadow a live model —
+        use :meth:`swap` for that."""
+        with self._lock:
+            # duplicate check BEFORE construction: Batcher.__init__ starts
+            # a worker thread, which would leak if we built it first and
+            # then refused the name
+            if name in self._batchers:
+                raise ValueError(
+                    f"model {name!r} is already registered; use swap()")
+            batcher = self._make(model, batcher_kwargs)
+            self._batchers[name] = batcher
+        if _tel.enabled:
+            _tel.count("serving.models_registered")
+            _tel.instant("serving.register", model=name)
+        return batcher
+
+    def swap(self, name, model, drain=True, **batcher_kwargs):
+        """Atomically replace ``name``.
+
+        New ``submit()`` calls route to the new model the moment this swaps
+        the map entry; the old batcher then drains (queued requests complete
+        against the OLD weights — no request ever sees half a swap) and
+        shuts down.  Refuses a name that was never registered (the mirror
+        of ``register()`` refusing to shadow): a typo'd swap must not leave
+        the real model silently serving stale weights."""
+        with self._lock:
+            if name not in self._batchers:
+                raise KeyError(
+                    f"no model {name!r} to swap; registered: "
+                    f"{sorted(self._batchers)} — use register() for a "
+                    "new name")
+            batcher = self._make(model, batcher_kwargs)
+            old = self._batchers[name]
+            self._batchers[name] = batcher
+        if _tel.enabled:
+            _tel.count("serving.model_swaps", model=name)
+            _tel.instant("serving.swap", model=name)
+        if old is not None:
+            old.close(drain=drain)
+        return batcher
+
+    def unregister(self, name, drain=True):
+        """Remove and shut down ``name``."""
+        with self._lock:
+            batcher = self._batchers.pop(name)
+        batcher.close(drain=drain)
+
+    def get(self, name):
+        with self._lock:
+            try:
+                return self._batchers[name]
+            except KeyError:
+                raise KeyError(
+                    f"no model {name!r}; registered: {sorted(self._batchers)}"
+                ) from None
+
+    def names(self):
+        with self._lock:
+            return sorted(self._batchers)
+
+    def __contains__(self, name):
+        with self._lock:
+            return name in self._batchers
+
+    def submit(self, name, payload, deadline_ms=None):
+        return self.get(name).submit(payload, deadline_ms=deadline_ms)
+
+    def infer(self, name, payload, deadline_ms=None):
+        return self.get(name).infer(payload, deadline_ms=deadline_ms)
+
+    def close(self, drain=True):
+        """Shut every model down (drained by default)."""
+        with self._lock:
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+        for b in batchers:
+            b.close(drain=drain)

@@ -33,7 +33,10 @@ Instrumented subsystems (event-name prefix = subsystem):
 - ``checkpoint.*``— save/restore spans with bytes and serialize-vs-IO
   split (``gluon/trainer.py``, ``parallel/checkpoint.py``)
 - ``io.*``        — prefetch producer/consumer wait (host-bound shows up
-  as a number)
+  as a number) and ``ImageRecordIter``'s internal decode-pool waits
+- ``serving.*``   — inference runtime: request queue waits, micro-batch
+  runs, padding waste, compile misses, rejections
+  (``mxnet_tpu/serving/``)
 - ``engine.*``    — ``engine.bulk`` scopes (reference bulking intent)
 - ``jax.*``       — backend compilations via ``jax.monitoring``
 
@@ -54,6 +57,7 @@ from .bus import (  # noqa: F401
     gauge,
     instant,
     is_enabled,
+    record_span,
     reset,
     snapshot,
     span,
@@ -70,6 +74,7 @@ from .sampler import (  # noqa: F401
 __all__ = [
     "enable", "disable", "is_enabled", "reset", "snapshot",
     "span", "count", "gauge", "instant", "counter_sample", "counter_value",
+    "record_span",
     "span_aggregates", "dump_trace", "dump_metrics", "trace_events",
     "collective_stats", "record_collectives",
     "start_counter_sampler", "stop_counter_sampler", "sampler_running",

@@ -33,7 +33,7 @@ from collections import deque
 
 __all__ = ["enable", "disable", "is_enabled", "span", "count", "gauge",
            "instant", "counter_sample", "counter_value", "snapshot", "reset",
-           "events", "DEFAULT_CAPACITY"]
+           "events", "record_span", "DEFAULT_CAPACITY"]
 
 DEFAULT_CAPACITY = 65536
 
@@ -198,15 +198,9 @@ class Span:
             # a span still open when disable() lands (e.g. a prefetch
             # thread mid-batch) must not pollute the post-disable window
             return False
-        dt = time.perf_counter() - self._t0
-        ts = (self._t0 - _epoch) * 1e6
-        _events.append(("X", self.name, self.name.split(".", 1)[0], ts,
-                        dt * 1e6, threading.get_ident(),
-                        self.attrs or None))
-        with _lock:
-            row = _span_agg.setdefault(self.name, [0, 0.0])
-            row[0] += 1
-            row[1] += dt
+        # attrs as a dict, NOT **kwargs: an attribute named t1/name/t0
+        # must stay an attribute, not collide with record_span's params
+        _emit_span(self.name, self._t0, None, self.attrs or None)
         return False
 
 
@@ -216,6 +210,33 @@ def span(name, **attrs):
     if not enabled:
         return _NOOP
     return Span(name, attrs)
+
+
+def record_span(name, t0, t1=None, **attrs):
+    """Record an already-timed scope as a complete ('X') span event.
+
+    For scopes measured across threads — e.g. a serving request's queue wait
+    between ``submit()`` (client thread) and dequeue (batcher worker) — a
+    ``with span(...)`` cannot bracket the code; the caller stamps
+    ``time.perf_counter()`` at both ends instead.  Feeds the same per-name
+    aggregates as :class:`Span`."""
+    if not enabled:
+        return
+    _emit_span(name, t0, t1, attrs or None)
+
+
+def _emit_span(name, t0, t1, attrs):
+    """Shared emit for Span.__exit__ and record_span — ONE place owns the
+    ('X', ...) event layout and the per-name aggregate shape."""
+    if t1 is None:
+        t1 = time.perf_counter()
+    dt = max(t1 - t0, 0.0)
+    _events.append(("X", name, name.split(".", 1)[0], (t0 - _epoch) * 1e6,
+                    dt * 1e6, threading.get_ident(), attrs))
+    with _lock:
+        row = _span_agg.setdefault(name, [0, 0.0])
+        row[0] += 1
+        row[1] += dt
 
 
 def span_aggregates():

@@ -168,6 +168,32 @@ def test_image_record_iter(tmp_path):
     assert len(list(it)) == 3
 
 
+def test_image_record_iter_decode_telemetry(tmp_path):
+    """ImageRecordIter exports its internal decode-pool waits (ROADMAP io.*
+    item): io.decode_wait_ms counter (decoder-labeled) + io.decode_batch /
+    io.read_records spans + io.record_batches progress."""
+    from mxnet_tpu import telemetry
+    frec, fidx = _write_img_rec(tmp_path, n=8)
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        it = mx.io.ImageRecordIter(
+            path_imgrec=frec, path_imgidx=fidx, data_shape=(3, 32, 32),
+            batch_size=4, preprocess_threads=2)
+        n = len(list(it))
+        assert n == 2
+        snap = telemetry.snapshot()
+        assert snap["counters"]["io.record_batches"] == n
+        assert snap["counters"]["io.decode_wait_ms"] >= 0
+        assert any(k.startswith('{decoder="') for k in
+                   snap["counters_by_label"]["io.decode_wait_ms"])
+        assert snap["spans"]["io.decode_batch"]["calls"] == n
+        assert snap["spans"]["io.read_records"]["calls"] == n
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
 def test_image_record_dataset(tmp_path):
     frec, _ = _write_img_rec(tmp_path, n=6)
     ds = mx.gluon.data.vision.ImageRecordDataset(frec)

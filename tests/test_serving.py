@@ -1,0 +1,379 @@
+"""mxnet_tpu.serving: bucketed AOT runtime, dynamic batcher, registry
+(ISSUE 3 tentpole + satellites)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.serving import (Batcher, ModelRegistry, ModelRuntime,
+                               RequestRejected, default_buckets)
+
+ITEM = (12,)
+
+
+@pytest.fixture(autouse=True)
+def _clean_bus():
+    """Every test starts with a fresh, disabled bus and leaves it that way."""
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _make_net(const=None):
+    net = mx.gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(mx.gluon.nn.Dense(16, activation="relu"))
+        net.add(mx.gluon.nn.Dense(4))
+    net.initialize(mx.init.Constant(const) if const is not None else None)
+    return net
+
+
+def _reqs(n, shape=ITEM, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.rand(*shape).astype("float32") for _ in range(n)]
+
+
+# ------------------------------------------------------------------ buckets
+def test_default_buckets():
+    assert default_buckets(1) == (1,)
+    assert default_buckets(8) == (1, 2, 4, 8)
+    # a non-power-of-two cap is itself the top bucket
+    assert default_buckets(12) == (1, 2, 4, 8, 12)
+    with pytest.raises(ValueError):
+        default_buckets(0)
+
+
+def test_bucket_for_and_validation():
+    rt = ModelRuntime(_make_net(), ITEM, max_batch=8, warm=False)
+    assert [rt.bucket_for(n) for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+    with pytest.raises(ValueError):
+        rt.bucket_for(9)
+    with pytest.raises(ValueError):
+        rt._normalize(np.zeros((3, 7), "float32"))    # wrong item shape
+    with pytest.raises(ValueError):
+        rt._normalize((np.zeros(ITEM), np.zeros(ITEM)))  # wrong arity
+    with pytest.raises(ValueError):
+        ModelRuntime(_make_net(), ITEM, max_batch=8, buckets=(1, 2),
+                     warm=False)  # ladder must end at max_batch
+
+
+# ----------------------------------------------------------------- numerics
+def test_padded_numerics_parity():
+    """A padded bucket run returns exactly what an unpadded forward would."""
+    net = _make_net()
+    rt = ModelRuntime(net, ITEM, max_batch=8)
+    for n in (1, 3, 5, 8):
+        reqs = _reqs(n, seed=n)
+        outs = rt.run_batch([rt._normalize(r) for r in reqs])
+        assert len(outs) == n
+        direct = net(mx.nd.array(np.stack(reqs))).asnumpy()
+        np.testing.assert_allclose(np.stack(outs), direct, rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_single_call_convenience():
+    net = _make_net()
+    rt = ModelRuntime(net, ITEM, max_batch=4)
+    x = _reqs(1)[0]
+    out = rt(x)
+    direct = net(mx.nd.array(x[None])).asnumpy()[0]
+    np.testing.assert_allclose(out, direct, rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------ compile-miss contract
+def test_warmup_compiles_buckets_then_zero_steady_misses():
+    telemetry.enable()
+    rt = ModelRuntime(_make_net(), ITEM, max_batch=8)
+    snap = telemetry.snapshot()
+    assert snap["counters"]["serving.warmup_compiles"] == 4
+    recompiles_after_warm = snap["counters"].get("cachedop.recompiles", 0)
+    b = Batcher(rt, max_latency_ms=2)
+    futs = []
+    for n in (1, 3, 8, 5, 2, 7):
+        futs += [b.submit(r) for r in _reqs(n, seed=n)]
+    for f in futs:
+        f.result(timeout=30)
+    b.close()
+    snap = telemetry.snapshot()
+    # every size hit a warmed bucket: no serving miss, no XLA retrace
+    assert snap["counters"].get("serving.compile_miss", 0) == 0
+    assert snap["counters"].get("cachedop.recompiles", 0) == \
+        recompiles_after_warm
+    assert snap["counters"]["serving.batch_items"] == 26
+    # queue-wait spans landed (cross-thread record_span path)
+    assert "serving.queue_wait" in snap["spans"]
+    assert "serving.run" in snap["spans"]
+
+
+def test_unwarmed_shape_counts_as_miss():
+    telemetry.enable()
+    rt = ModelRuntime(_make_net(), ITEM, max_batch=8, warm=False)
+    rt.run_batch([rt._normalize(r) for r in _reqs(3)])
+    assert telemetry.counter_value("serving.compile_miss") == 1
+    # second batch at the same bucket replays the now-compiled executable
+    rt.run_batch([rt._normalize(r) for r in _reqs(4)])
+    assert telemetry.counter_value("serving.compile_miss") == 1
+
+
+def test_training_trace_is_not_an_inference_warmup():
+    """The CachedOp cache is keyed by autograd mode: a shape traced only
+    under training replays NOTHING at inference, so it must still count as
+    a serving.compile_miss (compiled_signatures(training=False) filter)."""
+    net = _make_net()
+    net.hybridize()
+    with mx.autograd.record():
+        net(mx.nd.array(np.zeros((4,) + ITEM, "float32")))
+    sigs = net.compiled_signatures()
+    assert sigs and not net.compiled_signatures(training=False)
+    telemetry.enable()
+    rt = ModelRuntime(net, ITEM, max_batch=4, buckets=(4,), warm=False)
+    rt.run_batch([rt._normalize(r) for r in _reqs(3)])
+    assert telemetry.counter_value("serving.compile_miss") == 1
+
+
+# ------------------------------------------------------------------ batcher
+def test_timeout_flush_serves_lone_request():
+    """An idle server answers a single request within the latency budget —
+    the timer flush, not max_batch, closes the batch."""
+    telemetry.enable()
+    rt = ModelRuntime(_make_net(), ITEM, max_batch=8)
+    b = Batcher(rt, max_latency_ms=20)
+    t0 = time.perf_counter()
+    out = b.submit(_reqs(1)[0]).result(timeout=30)
+    took = time.perf_counter() - t0
+    assert out.shape == (4,)
+    assert took < 10.0
+    snap = telemetry.snapshot()
+    assert snap["counters"]["serving.batches"] == 1
+    assert snap["counters"]["serving.batch_items"] == 1
+    assert snap["counters"].get("serving.padded_items", 0) == 0  # bucket 1
+    b.close()
+
+
+def test_max_batch_flush_coalesces():
+    """Queued requests coalesce into full buckets when the worker starts."""
+    telemetry.enable()
+    rt = ModelRuntime(_make_net(), ITEM, max_batch=4)
+    b = Batcher(rt, max_latency_ms=200, queue_depth=64, start=False)
+    futs = [b.submit(r) for r in _reqs(8)]
+    assert b.pending() == 8
+    b.start()
+    outs = [f.result(timeout=30) for f in futs]
+    assert len(outs) == 8
+    snap = telemetry.snapshot()
+    # two full buckets, no padding, well under the 200ms timer
+    assert snap["counters"]["serving.batches"] == 2
+    assert snap["counters"]["serving.batch_items"] == 8
+    assert snap["counters"].get("serving.padded_items", 0) == 0
+    b.close()
+
+
+def test_deadline_rejection_when_queue_full():
+    """A deadlined submit() against a full queue REJECTS at the deadline
+    instead of hanging (the load-shedding acceptance criterion)."""
+    telemetry.enable()
+    rt = ModelRuntime(_make_net(), ITEM, max_batch=4)
+    b = Batcher(rt, queue_depth=2, start=False)
+    b.submit(_reqs(1)[0])
+    b.submit(_reqs(1)[0])
+    t0 = time.perf_counter()
+    with pytest.raises(RequestRejected) as ei:
+        b.submit(_reqs(1)[0], deadline_ms=60)
+    took = time.perf_counter() - t0
+    assert ei.value.reason == "deadline"
+    assert 0.04 < took < 5.0
+    by_label = telemetry.snapshot()["counters_by_label"]
+    assert any('reason="deadline"' in k
+               for k in by_label["serving.rejections"])
+    b.close(drain=True)     # the two queued requests still get served
+
+
+def test_deadline_expired_while_queued_is_shed():
+    rt = ModelRuntime(_make_net(), ITEM, max_batch=4)
+    b = Batcher(rt, start=False)
+    fut = b.submit(_reqs(1)[0], deadline_ms=10)
+    time.sleep(0.05)
+    b.start()
+    with pytest.raises(RequestRejected) as ei:
+        fut.result(timeout=30)
+    assert ei.value.reason == "deadline"
+    b.close()
+
+
+def test_backpressure_blocks_then_completes():
+    """Deadline-less submits on a full queue block (backpressure) but make
+    progress as the worker drains — nothing is dropped."""
+    rt = ModelRuntime(_make_net(), ITEM, max_batch=2)
+    b = Batcher(rt, max_latency_ms=1, queue_depth=2)
+    futs = [b.submit(r) for r in _reqs(12)]
+    outs = [f.result(timeout=60) for f in futs]
+    assert len(outs) == 12
+    b.close()
+
+
+def test_worker_survives_model_crash():
+    """A model exception fails that batch's futures; later requests run."""
+    telemetry.enable()
+    rt = ModelRuntime(_make_net(), ITEM, max_batch=4)
+    b = Batcher(rt, max_latency_ms=2)
+    real = rt.run_batch
+    boom = {"armed": True}
+
+    def flaky(rows):
+        if boom.pop("armed", False):
+            raise RuntimeError("model exploded")
+        return real(rows)
+
+    rt.run_batch = flaky
+    with pytest.raises(RuntimeError, match="model exploded"):
+        b.submit(_reqs(1)[0]).result(timeout=30)
+    out = b.submit(_reqs(1)[0]).result(timeout=30)   # worker still alive
+    assert out.shape == (4,)
+    assert b.batches_failed == 1
+    assert telemetry.counter_value("serving.batch_failures") == 1
+    b.close()
+
+
+def test_dead_worker_respawns_on_submit():
+    rt = ModelRuntime(_make_net(), ITEM, max_batch=4)
+    b = Batcher(rt, max_latency_ms=2)
+    dead = threading.Thread(target=lambda: None)
+    dead.start()
+    dead.join()
+    b._worker = dead        # simulate an unexpectedly dead worker thread
+    out = b.submit(_reqs(1)[0]).result(timeout=30)
+    assert out.shape == (4,)
+    assert b._worker.is_alive() or b.pending() == 0
+    b.close()
+
+
+def test_submit_after_close_rejects():
+    rt = ModelRuntime(_make_net(), ITEM, max_batch=4)
+    b = Batcher(rt)
+    b.close()
+    with pytest.raises(RequestRejected) as ei:
+        b.submit(_reqs(1)[0])
+    assert ei.value.reason == "shutdown"
+
+
+def test_close_without_drain_rejects_queue():
+    rt = ModelRuntime(_make_net(), ITEM, max_batch=4)
+    b = Batcher(rt, start=False)
+    futs = [b.submit(r) for r in _reqs(3)]
+    b.close(drain=False)
+    for f in futs:
+        with pytest.raises(RequestRejected) as ei:
+            f.result(timeout=5)
+        assert ei.value.reason == "shutdown"
+
+
+# ----------------------------------------------------------------- registry
+def test_registry_swap_routes_and_drains():
+    telemetry.enable()
+    reg = ModelRegistry()
+    rt1 = ModelRuntime(_make_net(const=0.1), ITEM, max_batch=4, name="m")
+    rt2 = ModelRuntime(_make_net(const=0.3), ITEM, max_batch=4, name="m")
+    old = reg.register("m", rt1, max_latency_ms=2)
+    with pytest.raises(ValueError):
+        reg.register("m", rt2)          # no silent shadowing
+    x = _reqs(1)[0]
+    out1 = reg.infer("m", x)
+    reg.swap("m", rt2, max_latency_ms=2)
+    out2 = reg.infer("m", x)
+    assert not np.allclose(out1, out2)  # new weights answer
+    np.testing.assert_allclose(out2, rt2(x), rtol=1e-5, atol=1e-6)
+    # the old batcher was drained and closed by the swap
+    with pytest.raises(RequestRejected):
+        old.submit(x)
+    assert telemetry.counter_value("serving.model_swaps") == 1
+    assert reg.names() == ["m"]
+    reg.unregister("m")
+    with pytest.raises(KeyError):
+        reg.get("m")
+    assert "m" not in reg
+
+
+def test_registry_close_all():
+    reg = ModelRegistry()
+    reg.register("a", ModelRuntime(_make_net(), ITEM, max_batch=2))
+    reg.register("b", ModelRuntime(_make_net(), ITEM, max_batch=2))
+    assert reg.names() == ["a", "b"]
+    reg.close()
+    assert reg.names() == []
+
+
+# ------------------------------------------------------------- import paths
+def test_from_exported_parity(tmp_path):
+    net = _make_net()
+    net.hybridize()
+    net(mx.nd.array(np.zeros((2,) + ITEM, "float32")))
+    prefix = str(tmp_path / "m")
+    net.export(prefix)
+    rt = ModelRuntime.from_exported(prefix + "-symbol.json", "data",
+                                    prefix + "-0000.params", ITEM,
+                                    max_batch=4)
+    x = _reqs(3, seed=7)
+    outs = rt.run_batch([rt._normalize(r) for r in x])
+    direct = net(mx.nd.array(np.stack(x))).asnumpy()
+    np.testing.assert_allclose(np.stack(outs), direct, rtol=1e-5, atol=1e-6)
+
+
+def test_multi_input_model():
+    class TwoIn(mx.gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.proj = mx.gluon.nn.Dense(4)
+
+        def hybrid_forward(self, F, a, b):
+            return self.proj(a) + b
+
+    net = TwoIn()
+    net.initialize()
+    rt = ModelRuntime(net, item_shapes=((6,), (4,)), max_batch=4)
+    b = Batcher(rt, max_latency_ms=2)
+    rng = np.random.RandomState(3)
+    pairs = [(rng.rand(6).astype("float32"), rng.rand(4).astype("float32"))
+             for _ in range(5)]
+    outs = [b.submit(p).result(timeout=30) for p in pairs]
+    direct = net(mx.nd.array(np.stack([a for a, _ in pairs])),
+                 mx.nd.array(np.stack([c for _, c in pairs]))).asnumpy()
+    np.testing.assert_allclose(np.stack(outs), direct, rtol=1e-5, atol=1e-6)
+    b.close()
+
+
+# ------------------------------------------------- block.py entry-point API
+def test_compile_for_requires_hybridize():
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()
+    with pytest.raises(RuntimeError, match="hybridize"):
+        net.compile_for(mx.nd.ones((1, 8)))
+
+
+def test_compiled_signatures_membership():
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()
+    net.hybridize()
+    assert net.compiled_signatures() == frozenset()
+    sig = net.compile_for(mx.nd.ones((2, 8)))
+    assert sig == (((2, 8),), ("float32",))
+    assert sig in net.compiled_signatures()
+    assert (((4, 8),), ("float32",)) not in net.compiled_signatures()
+
+
+def test_record_span_cross_thread():
+    telemetry.enable()
+    t0 = time.perf_counter()
+    time.sleep(0.01)
+    telemetry.record_span("serving.queue_wait", t0, model="t")
+    agg = telemetry.span_aggregates()
+    assert agg["serving.queue_wait"][0] == 1
+    assert agg["serving.queue_wait"][1] >= 0.01
+    (ev,) = [e for e in telemetry.trace_events()
+             if e["name"] == "serving.queue_wait"]
+    assert ev["ph"] == "X" and ev["dur"] >= 1e4   # >= 10ms in us
