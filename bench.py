@@ -41,9 +41,13 @@ BASELINE.md).  All other configs are nested under ``"extra"``:
   vs per-request baseline — 64 concurrent single-item requests, p50/p99
   latency + throughput + padding-waste ratio + steady-state compile
   misses (must be 0)
+- ``resilience``: durable-checkpoint save/restore latency, recovery time
+  after a mid-save kill (restore + first step of a fresh
+  ``ResilientTrainer``), and the per-step cost of the opt-in
+  ``nan_guard`` (``mxnet_tpu.resilience``)
 
 Select a subset with
-BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,int8,io,e2e,eager,optimizer,serving.
+BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,int8,io,e2e,eager,optimizer,serving,resilience.
 The full json carries a ``telemetry`` sub-dict (recompile count,
 collective bytes, io wait ms — disable with BENCH_TELEMETRY=0) so each
 BENCH record carries its own diagnosis.
@@ -1021,6 +1025,114 @@ def bench_serving():
     }
 
 
+def bench_resilience():
+    """Fault-tolerance latency numbers (``mxnet_tpu.resilience``): what a
+    durable checkpoint costs on cadence (atomic tmp+rename commit with a
+    checksummed manifest), how fast a killed run is back training
+    (ResilientTrainer construct/restore + first step), and what the opt-in
+    ``nan_guard`` adds to a step.  The zero-overhead contract for DISABLED
+    hooks is covered by the ``optimizer_update``/``serving`` configs
+    staying flat: no fault site is armed and no retry policy is installed
+    on their paths."""
+    import shutil
+    import tempfile
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import (FunctionalOptimizer, make_mesh,
+                                    SPMDCheckpointManager, SPMDTrainer)
+    from mxnet_tpu.resilience import ResilientTrainer, faults
+
+    rounds = int(os.environ.get("BENCH_RESILIENCE_ROUNDS", "8"))
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 256).astype("float32")
+    y = rng.randint(0, 10, 64).astype("float32")
+
+    def trainer(seed=0, **kw):
+        mx.random.seed(seed)
+        np.random.seed(seed)
+        net = mx.gluon.nn.HybridSequential(prefix="rnet_")
+        with net.name_scope():
+            net.add(mx.gluon.nn.Dense(512, activation="relu", in_units=256),
+                    mx.gluon.nn.Dense(512, activation="relu", in_units=512),
+                    mx.gluon.nn.Dense(10, in_units=512))
+        net.initialize()
+        return SPMDTrainer(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                           FunctionalOptimizer("adam", 1e-3),
+                           make_mesh(n_devices=1, dp=1), **kw)
+
+    def step_ms_p50(**kw):
+        t = trainer(**kw)
+        for _ in range(3):
+            float(t.step(x, y).asnumpy())
+        ts = []
+        for _ in range(max(rounds, 5)):
+            t0 = time.perf_counter()
+            float(t.step(x, y).asnumpy())
+            ts.append(time.perf_counter() - t0)
+        return float(np.percentile(ts, 50)) * 1e3
+
+    root = tempfile.mkdtemp(prefix="bench_resilience_")
+    try:
+        # --- durable checkpoint save / restore latency
+        tr = trainer()
+        tr.step(x, y)
+        mgr = SPMDCheckpointManager(os.path.join(root, "ckpt"),
+                                    max_to_keep=2)
+        save_ts = []
+        for _ in range(rounds):
+            tr.step(x, y)
+            t0 = time.perf_counter()
+            mgr.save(tr._t, tr)
+            save_ts.append(time.perf_counter() - t0)
+        ckpt_bytes = os.path.getsize(os.path.join(
+            mgr._step_dir(mgr.latest_step()), "state.bin"))
+        probe = trainer(seed=1)
+        probe.step(x, y)               # compile before timing restores
+        restore_ts = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            mgr.restore(probe)
+            restore_ts.append(time.perf_counter() - t0)
+
+        # --- recovery after a kill: the run checkpoints every 5 steps,
+        # its latest save dies mid-write at the armed fault site ("the
+        # kill"); recovery = construct a fresh ResilientTrainer over the
+        # directory (auto-restore of the surviving checkpoint) and take
+        # the first step, fresh jit compile included — the same bill a
+        # restarted process pays
+        run_dir = os.path.join(root, "run")
+        rt = ResilientTrainer(trainer(), run_dir, save_every=5)
+        for _ in range(12):
+            rt.step(x, y)
+        faults.configure("checkpoint.write:fail:1")
+        for _ in range(3):
+            rt.step(x, y)
+        rt.flush()                     # the save at t=15 dies mid-write
+        faults.clear()
+        killed_at = rt.step_count
+        fresh = trainer(seed=7)        # process startup, not recovery
+        t0 = time.perf_counter()
+        rt2 = ResilientTrainer(fresh, run_dir, save_every=5)
+        resumed_at = rt2.step_count
+        float(rt2.step(x, y).asnumpy())
+        recovery_s = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    return {
+        "model": "mlp_256_512_512_10_adam",
+        "checkpoint_bytes": ckpt_bytes,
+        "save_ms_p50": round(float(np.percentile(save_ts, 50)) * 1e3, 2),
+        "restore_ms_p50": round(
+            float(np.percentile(restore_ts, 50)) * 1e3, 2),
+        "killed_at_step": killed_at,
+        "resumed_at_step": resumed_at,
+        "replayed_steps": killed_at - resumed_at,
+        "recovery_after_kill_ms": round(recovery_s * 1e3, 2),
+        "step_ms_p50_unguarded": round(step_ms_p50(), 3),
+        "step_ms_p50_nan_guard": round(step_ms_p50(nan_guard=True), 3),
+    }
+
+
 def bench_eager_dispatch():
     """Eager op-dispatch microbench: a 500-op add chain through the
     jit-cached imperative path, telemetry off vs on.  This is the number
@@ -1097,6 +1209,16 @@ def _telemetry_summary():
         "serving_rejections": c.get("serving.rejections", 0),
         "serving_queue_wait_ms": round(
             c.get("serving.queue_wait_ms", 0.0), 1),
+        "serving_worker_restarts": c.get("serving.worker_restart", 0),
+        "resilience_faults_injected": c.get("resilience.fault_injected", 0),
+        "resilience_retries": c.get("resilience.retry", 0),
+        "resilience_give_ups": c.get("resilience.give_up", 0),
+        "resilience_checkpoint_fallbacks":
+            c.get("resilience.checkpoint_fallback", 0),
+        "resilience_nan_steps": c.get("resilience.nan_steps", 0),
+        "resilience_rollbacks": c.get("resilience.rollbacks", 0),
+        "io_worker_errors": c.get("io.worker_error", 0),
+        "amp_overflows": c.get("amp.overflow", 0),
     }
 
 
@@ -1104,7 +1226,7 @@ def main():
     sel = [s.strip() for s in
            os.environ.get("BENCH_CONFIGS",
                           "headline,infer,fp32,amp,bert,ssd,int8,io,e2e,"
-                          "eager,optimizer,serving").split(",")]
+                          "eager,optimizer,serving,resilience").split(",")]
     extra = {}
 
     # telemetry rides along for diagnosis (counters only — the configs
@@ -1198,6 +1320,11 @@ def main():
             extra["serving_dynamic_batching"] = bench_serving()
         except Exception as e:           # pragma: no cover
             extra["serving_dynamic_batching"] = {"error": repr(e)}
+    if "resilience" in sel:
+        try:
+            extra["resilience"] = bench_resilience()
+        except Exception as e:           # pragma: no cover
+            extra["resilience"] = {"error": repr(e)}
 
     value = headline.get("items_per_sec") if headline else None
     full = {

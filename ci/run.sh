@@ -16,7 +16,12 @@
 #                plus a short serving bench sanity check (>=3x batched
 #                throughput, zero steady-state compile misses, deadline
 #                rejection on a full queue)
-# Usage: ci/run.sh [stage ...]   (default: unit gate telemetry optimizer serving)
+#   resilience - fault-tolerance smoke: test_resilience.py plus a 20-step
+#                train loop under MXNET_FAULTS-injected checkpoint-write
+#                crashes and one forced NaN step — exact loss parity with
+#                a fault-free run, bitwise-identical crash/resume
+# Usage: ci/run.sh [stage ...]   (default: unit gate telemetry optimizer
+#                                 serving resilience)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -191,8 +196,71 @@ print("serving bench ok:", r["per_request"]["req_per_sec"], "->",
 PY
 }
 
+stage_resilience() {
+  JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q
+  JAX_PLATFORMS=cpu MXNET_FAULTS="checkpoint.write:fail:2" python - <<'PY'
+import tempfile
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import FunctionalOptimizer, SPMDTrainer, make_mesh
+from mxnet_tpu.resilience import ResilientTrainer, faults
+
+assert faults.active, "MXNET_FAULTS env spec must arm the registry at import"
+
+def trainer(seed):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = mx.gluon.nn.HybridSequential(prefix="net_")
+    with net.name_scope():
+        net.add(mx.gluon.nn.Dense(32, activation="relu", in_units=8),
+                mx.gluon.nn.Dense(4, in_units=32))
+    net.initialize()
+    return SPMDTrainer(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                       FunctionalOptimizer("sgd", 1e-2),
+                       make_mesh(n_devices=1, dp=1), nan_guard=True)
+
+rng = np.random.RandomState(0)
+batches = [(rng.randn(16, 8).astype("float32"),
+            rng.randint(0, 4, 16).astype("float32")) for _ in range(20)]
+
+# fault-free 20-step reference run (it never checkpoints, so the armed
+# checkpoint.write spec stays untouched for the faulty run below)
+ref_tr = trainer(0)
+ref = [float(ref_tr.step(x, y).asnumpy()) for x, y in batches]
+
+# the same 20 steps under a ResilientTrainer checkpointing every 5 steps:
+# the env-injected mid-write crashes kill the first two saves, and one
+# forced all-NaN step mid-run must be skipped on-device
+d = tempfile.mkdtemp(prefix="ci_resilience_")
+rt = ResilientTrainer(trainer(0), d, save_every=5)
+losses = []
+for i, (x, y) in enumerate(batches):
+    if i == 8:
+        bad = float(rt.step(np.full_like(x, np.nan), y).asnumpy())
+        assert not np.isfinite(bad), "forced NaN step must report NaN loss"
+    losses.append(float(rt.step(x, y).asnumpy()))
+rt.flush()    # judge the final step so its cadence checkpoint commits
+assert rt.checkpoint_failures == 2, rt.checkpoint_failures
+assert losses == ref, "fault-injected run must match the fault-free run"
+latest = rt.manager.latest_step()
+assert latest == 20, (latest, rt.manager.complete_steps())
+
+# crash/resume is idempotent: two independent "restarted processes" resume
+# at the checkpointed step and replay bitwise-identical steps
+probes = []
+for seed in (7, 11):
+    p = ResilientTrainer(trainer(seed), d, save_every=100)
+    assert p.resumed_from == latest and p.step_count == latest, \
+        (p.resumed_from, p.step_count)
+    probes.append([float(p.step(x, y).asnumpy()) for x, y in batches[:3]])
+assert probes[0] == probes[1], probes
+print("resilience smoke ok: 20 steps, 2 injected save crashes absorbed,",
+      f"1 NaN step skipped, exact loss parity, resume at step {latest}")
+PY
+}
+
 stages=("$@")
-[ $# -eq 0 ] && stages=(unit gate telemetry optimizer serving)
+[ $# -eq 0 ] && stages=(unit gate telemetry optimizer serving resilience)
 for s in "${stages[@]}"; do
   echo "=== ci stage: $s ==="
   "stage_$s"

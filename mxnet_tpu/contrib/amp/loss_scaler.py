@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import logging
 
+from ...telemetry import bus as _tel
+
 
 class LossScaler:
     def __init__(self, init_scale=2.**16, scale_factor=2., scale_window=2000,
@@ -33,8 +35,15 @@ class LossScaler:
             self.loss_scale = max(self.loss_scale / self._scale_factor, 1)
             self._unskipped = 0
             logging.info("AMP: decreasing loss scale to %f", self.loss_scale)
+            if _tel.enabled:
+                # scale collapse is invisible in loss curves until too
+                # late — a counter + gauge pair makes it a trace fact
+                _tel.count("amp.overflow")
+                _tel.instant("amp.overflow", scale=self.loss_scale)
+                _tel.gauge("amp.loss_scale", self.loss_scale)
         else:
             self._unskipped += 1
         if self._unskipped == self._scale_window:
             self.loss_scale *= self._scale_factor
             self._unskipped = 0
+            _tel.gauge("amp.loss_scale", self.loss_scale)

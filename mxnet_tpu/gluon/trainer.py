@@ -13,6 +13,8 @@ from __future__ import annotations
 
 from .. import optimizer as opt
 from ..ndarray import NDArray
+from ..resilience import durable as _durable
+from ..resilience import faults as _faults
 from ..telemetry import bus as _tel
 from .parameter import Parameter
 
@@ -52,6 +54,9 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = None
         self._params_to_init = []
+        # optional resilience.RetryPolicy for save_states/load_states IO
+        # (set attribute directly; None = no retry wrapping)
+        self.retry_policy = None
         self._reset_kvstore()
 
     def _check_contexts(self):
@@ -265,7 +270,9 @@ class Trainer:
                     updater(i, g, w)
 
     def save_states(self, fname):
-        """Save optimizer/updater states (reference ``trainer.py:436``)."""
+        """Save optimizer/updater states (reference ``trainer.py:436``),
+        atomically (temp file + rename); set ``trainer.retry_policy`` to
+        retry transient IO failures with backoff."""
         assert self._optimizer is not None
         if not self._kv_initialized:
             self._init_kvstore()
@@ -276,16 +283,27 @@ class Trainer:
                 assert not self._params_to_init, \
                     "Cannot save trainer states when some parameters are " \
                     "not yet initialized in kvstore."
-                self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
-                import os as _os
-                sp.set(bytes_written=_os.path.getsize(fname))
+                assert self._kvstore._updater is not None, \
+                    "updater is not initialized"
+                with _tel.span("checkpoint.serialize"):
+                    payload = self._kvstore._updater.get_states(
+                        dump_optimizer=True)
             else:
                 with _tel.span("checkpoint.serialize"):
-                    payload = self._updaters[0].get_states(dump_optimizer=True)
-                with _tel.span("checkpoint.io", bytes=len(payload)):
-                    with open(fname, "wb") as fout:
-                        fout.write(payload)
-                sp.set(bytes_written=len(payload))
+                    payload = self._updaters[0].get_states(
+                        dump_optimizer=True)
+            with _tel.span("checkpoint.io", bytes=len(payload)):
+                # the shared durable idiom (temp + fsync + replace +
+                # parent-dir fsync, mid-payload ``checkpoint.write`` fault
+                # site): a crash leaves the old complete states file or
+                # the new one, never a truncated ``fname``
+                if self.retry_policy is not None:
+                    self.retry_policy.call(_durable.replace_file_atomic,
+                                           fname, payload,
+                                           site="checkpoint.save")
+                else:
+                    _durable.replace_file_atomic(fname, payload)
+            sp.set(bytes_written=len(payload))
 
     def load_states(self, fname):
         """Load optimizer/updater states (reference ``trainer.py:465``)."""
@@ -293,17 +311,31 @@ class Trainer:
             self._init_kvstore()
         if self._params_to_init:
             self._init_params()
+        def _read():
+            if _faults.active:
+                _faults.check("checkpoint.read")
+            with open(fname, "rb") as f:
+                return f.read()
+
         with _tel.span("checkpoint.restore", kind="trainer_states") as sp:
+            with _tel.span("checkpoint.io"):
+                # both branches read through the retried fault-sited
+                # closure: the transient IO error save_states absorbs must
+                # not kill the matching restore just because the states
+                # live on the kvstore
+                if self.retry_policy is not None:
+                    states = self.retry_policy.call(
+                        _read, site="checkpoint.read")
+                else:
+                    states = _read()
+            sp.set(bytes_read=len(states))
             if self._update_on_kvstore:
-                self._kvstore.load_optimizer_states(fname)
+                assert self._kvstore._updater is not None, \
+                    "updater is not initialized"
+                with _tel.span("checkpoint.deserialize"):
+                    self._kvstore._updater.set_states(states)
                 self._optimizer = self._kvstore._updater.optimizer
-                import os as _os
-                sp.set(bytes_read=_os.path.getsize(fname))
             else:
-                with _tel.span("checkpoint.io"):
-                    with open(fname, "rb") as f:
-                        states = f.read()
-                sp.set(bytes_read=len(states))
                 with _tel.span("checkpoint.deserialize"):
                     for updater in self._updaters:
                         updater.set_states(states)

@@ -9,6 +9,7 @@ import numpy as np
 
 from .. import ndarray as nd
 from ..ndarray import NDArray
+from ..resilience import faults as _faults
 from ..telemetry import bus as _tel
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
@@ -174,6 +175,9 @@ class PrefetchingIter(DataIter):
         self.started = True
         self.current_batch = [None for _ in range(self.n_iter)]
         self.next_batch = [None for _ in range(self.n_iter)]
+        # a worker exception parks here (never swallowed): iter_next
+        # re-raises it on the consumer thread with the original traceback
+        self.worker_exc = [None for _ in range(self.n_iter)]
 
         def prefetch_func(self, i):
             while True:
@@ -189,10 +193,26 @@ class PrefetchingIter(DataIter):
                     _tel.count("io.producer_wait_ms",
                                (time.perf_counter() - t0) * 1e3)
                 try:
+                    if _faults.active:
+                        _faults.check("io.prefetch")
                     with _tel.span("io.produce_batch", iter=i):
                         self.next_batch[i] = self.iters[i].next()
                 except StopIteration:
                     self.next_batch[i] = None
+                except BaseException as e:
+                    # a raising worker used to die silently, stranding the
+                    # consumer on data_ready forever; park the exception
+                    # for the consumer and stop this worker (the iterator
+                    # is broken — reset() restarts nothing here)
+                    self.worker_exc[i] = e
+                    self.next_batch[i] = None
+                    if _tel.enabled:
+                        _tel.count("io.worker_error", stage="prefetch")
+                        _tel.instant("io.worker_error", stage="prefetch",
+                                     iter=i, error=repr(e))
+                    self.data_taken[i].clear()
+                    self.data_ready[i].set()
+                    return
                 self.data_taken[i].clear()
                 self.data_ready[i].set()
 
@@ -228,8 +248,17 @@ class PrefetchingIter(DataIter):
                     for r, i in zip(self.rename_label, self.iters)], [])
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
+        # bounded like iter_next: resetting a pipeline whose worker died
+        # (sticky parked exception, thread exited) must raise, not hang
+        # forever on a data_ready event nothing will ever set again
+        for i, e in enumerate(self.data_ready):
+            while self.worker_exc[i] is None and not e.wait(timeout=1.0):
+                if not self.prefetch_threads[i].is_alive():
+                    raise RuntimeError(
+                        f"PrefetchingIter worker {i} died without "
+                        "producing a batch or an exception")
+            if self.worker_exc[i] is not None:
+                raise self.worker_exc[i]
         for i in self.iters:
             i.reset()
         for e in self.data_ready:
@@ -240,10 +269,28 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         # consumer wait: the training loop blocked on decode — host-bound
         # when large (the BENCH_r05 "host-staging-bound" diagnosis as a
-        # first-class number)
+        # first-class number).  Bounded waits: a prefetch worker that died
+        # without parking an exception (killed interpreter-side) must not
+        # hang the training loop forever.
         t0 = time.perf_counter()
-        for e in self.data_ready:
-            e.wait()
+        for i, e in enumerate(self.data_ready):
+            while not e.wait(timeout=1.0):
+                if self.worker_exc[i] is not None:
+                    raise self.worker_exc[i]
+                if not self.prefetch_threads[i].is_alive():
+                    raise RuntimeError(
+                        f"PrefetchingIter worker {i} died without "
+                        "producing a batch or an exception")
+        for i, exc in enumerate(self.worker_exc):
+            if exc is not None:
+                # re-raise on the consumer thread; the exception object
+                # still carries the worker's original traceback.  STICKY:
+                # the worker is dead and next_batch may hold a mix of
+                # parked batches and Nones, so a later call must keep
+                # raising rather than misreport a clean epoch end (or
+                # trip over a None batch) after the caller swallowed the
+                # first raise
+                raise exc
         if self.next_batch[0] is None:
             # epoch-end sentinel: discovering StopIteration is not a
             # pipeline stall (same rule as DevicePrefetchIter)
@@ -438,6 +485,8 @@ class DevicePrefetchIter:
             for batch in self._it:
                 if self._stop:
                     return
+                if _faults.active:
+                    _faults.check("io.prefetch")
                 with _tel.span("io.stage_batch"):
                     staged = self._stage(batch)
                 t0 = time.perf_counter()
@@ -450,6 +499,10 @@ class DevicePrefetchIter:
                     return
             self._q.put(self._END)
         except BaseException as e:          # surfaced on the consumer side
+            if _tel.enabled:
+                _tel.count("io.worker_error", stage="stage")
+                _tel.instant("io.worker_error", stage="stage",
+                             error=repr(e))
             self._q.put(e)
 
     def __iter__(self):
@@ -485,8 +538,27 @@ class DevicePrefetchIter:
             self.reset()
         if self._done:
             raise StopIteration
+        import queue as _queue
         t0 = time.perf_counter()
-        item = self._q.get()
+        while True:
+            # bounded gets: a staging thread that died without queueing its
+            # exception (interpreter teardown, killed thread) must surface
+            # as an error here, not hang the training loop forever
+            try:
+                item = self._q.get(timeout=1.0)
+                break
+            except _queue.Empty:
+                if not self._thread.is_alive():
+                    # one last non-blocking look: the thread may have
+                    # queued its final item right as the timeout landed
+                    try:
+                        item = self._q.get_nowait()
+                        break
+                    except _queue.Empty:
+                        self._done = True
+                        raise RuntimeError(
+                            "DevicePrefetchIter staging thread died "
+                            "without a result") from None
         if item is self._END:
             self._done = True
             raise StopIteration

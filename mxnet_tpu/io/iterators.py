@@ -21,6 +21,7 @@ import numpy as np
 
 from .. import ndarray as nd
 from ..base import parse_tuple
+from ..resilience import faults as _faults
 from ..telemetry import bus as _tel
 from .io import DataBatch, DataDesc, DataIter
 
@@ -415,6 +416,8 @@ class ImageRecordIter(DataIter):
         # not io.consumer_wait_ms: the wrappers own the loop-vs-pipeline
         # split, this counter attributes the stall to jpeg decode itself.
         t0 = time.perf_counter()
+        if _faults.active:
+            _faults.check("io.decode")
         if _native.decode_available():
             native = self._decode_batch_native(raws, flips, crops)
         if native is not None:
@@ -433,10 +436,22 @@ class ImageRecordIter(DataIter):
             # restamp: the failed native attempt (non-JPEG sniff) is not
             # pool wait — keep the counter aligned with the pool span
             t0 = time.perf_counter()
-            with _tel.span("io.decode_batch", decoder="pool",
-                           n=len(sel), threads=self._threads):
-                decoded = list(self._pool.map(self._decode_one, raws, flips,
-                                              crops))
+            try:
+                with _tel.span("io.decode_batch", decoder="pool",
+                               n=len(sel), threads=self._threads):
+                    decoded = list(self._pool.map(self._decode_one, raws,
+                                                  flips, crops))
+            except Exception as e:
+                # a decode-pool worker raised (truncated jpeg, bad record):
+                # surface it to the caller AS the worker saw it — the bare
+                # re-raise keeps the original traceback — and leave a
+                # telemetry trail; the pool itself survives for the next
+                # batch (executors discard failed work items)
+                if _tel.enabled:
+                    _tel.count("io.worker_error", stage="decode")
+                    _tel.instant("io.worker_error", stage="decode",
+                                 error=repr(e))
+                raise
             if _tel.enabled:
                 _tel.count("io.decode_wait_ms",
                            (time.perf_counter() - t0) * 1e3,

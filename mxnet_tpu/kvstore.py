@@ -31,6 +31,7 @@ import numpy as np
 
 from .ndarray import NDArray
 from . import optimizer as opt
+from .resilience import faults as _faults
 from .telemetry import bus as _tel
 
 __all__ = ["KVStore", "create"]
@@ -104,6 +105,35 @@ class KVStore:
         self._str_key_check = None
         self._compression_params = None
         self._optimizer = None
+        self._retry = None
+
+    def set_retry_policy(self, policy):
+        """Retry the transport hop of push/pull under ``policy`` (a
+        :class:`mxnet_tpu.resilience.RetryPolicy`, or None to disable).
+
+        The role of ps-lite's van-level resend: a transient transport
+        failure — a flaky interconnect surfacing as OSError, or an
+        injected ``kvstore.push``/``kvstore.pull`` fault — is retried with
+        backoff instead of killing the step.  Off by default; when unset
+        the hot path has no retry wrapping at all."""
+        self._retry = policy
+
+    def _transport_push(self, merged):
+        """The cross-worker hop of a push — the only transiently-failing
+        part (local reduction is device compute).  Fault site
+        ``kvstore.push`` lives here so injected failures exercise the
+        retry path exactly where a real transport error would land."""
+        if _faults.active:
+            _faults.check("kvstore.push")
+        if "dist" in self._type and self.num_workers > 1:
+            merged = self._global_allreduce(merged)
+        return merged
+
+    def _transport_pull(self, stored, out):
+        """One stored->out copy of a pull (fault site ``kvstore.pull``)."""
+        if _faults.active:
+            _faults.check("kvstore.pull")
+        stored.copyto(out)
 
     # ------------------------------------------------------------------ util
     @property
@@ -208,9 +238,22 @@ class KVStore:
             merged = self._local_reduce(vs)
             if self._compression_params is not None and \
                     self._compression_params.get("type") == "2bit":
+                # compress OUTSIDE the retried transport: _compress
+                # advances the per-key error-feedback residual, so a retry
+                # re-entering it would double-count the residual
                 merged = self._compress(k, merged)
-            if "dist" in self._type and self.num_workers > 1:
-                merged = self._global_allreduce(merged)
+            if self._retry is not None and not (
+                    "dist" in self._type and self.num_workers > 1):
+                merged = self._retry.call(self._transport_push, merged,
+                                          site="kvstore.push")
+            else:
+                # a cross-worker allreduce is never retried unilaterally:
+                # one worker re-entering the collective while the others
+                # have advanced to their next one mispairs the collective
+                # order across the mesh (deadlock, or gradients summed
+                # against the wrong key).  A dist transport error fails
+                # the step; all workers restart it together.
+                merged = self._transport_push(merged)
             stored = self._store[k]
             if self._updater is not None:
                 batch.append((k, merged, stored))
@@ -252,7 +295,11 @@ class KVStore:
         for k, os_ in zip(keys, outs):
             stored = self._store[k]
             for o in os_:
-                stored.copyto(o)
+                if self._retry is not None:
+                    self._retry.call(self._transport_pull, stored, o,
+                                     site="kvstore.pull")
+                else:
+                    self._transport_pull(stored, o)
 
     def pushpull(self, key, value, out=None, priority=0):
         """Fused push+pull (MXNet 1.5 ``kvstore.py`` byteps-style surface)."""

@@ -11,16 +11,43 @@ Telemetry: every save/restore lands as a ``checkpoint.save`` /
 ``checkpoint.restore`` span carrying the tree's payload bytes, split into a
 ``checkpoint.serialize`` sub-span (tree construction + draining pending
 device compute, so async dispatch is not billed to storage) and a
-``checkpoint.io`` sub-span (the orbax write/read itself).
+``checkpoint.io`` sub-span (the write/read itself).
+
+Durability (ISSUE 4): :class:`SPMDCheckpointManager` owns its on-disk
+format instead of delegating rotation to orbax, because the fault-tolerance
+contract needs byte-level control:
+
+- **Atomic commits.**  Each step serializes into a hidden temp directory
+  and is ``os.rename``d into place only after payload + manifest are
+  written and fsynced — a crash mid-write leaves a truncated temp dir (GCd
+  later), never a corrupt committed checkpoint.
+- **Checksummed manifests.**  ``manifest.json`` records size + crc32 of
+  every payload file; ``restore()`` verifies before deserializing and
+  falls back to the previous complete step on mismatch (with a
+  ``resilience.checkpoint_fallback`` event).
+- **Safe retention.**  GC keeps the newest ``max_to_keep`` *complete*
+  checkpoints and never deletes the last complete one — a run whose recent
+  saves all failed mid-write still has a resume point.
+- **Injection + retry.**  The write/read paths are threaded with fault
+  sites (``checkpoint.write`` / ``checkpoint.manifest`` /
+  ``checkpoint.commit`` / ``checkpoint.read``) and optionally wrapped in a
+  :class:`~mxnet_tpu.resilience.retry.RetryPolicy`.
 """
 from __future__ import annotations
 
+import json
 import os
+import pickle
+import shutil
+import time
+import zlib
 
+from ..resilience import durable as _durable
+from ..resilience import faults as _faults
 from ..telemetry import bus as _tel
 
 __all__ = ["save_spmd_checkpoint", "load_spmd_checkpoint",
-           "SPMDCheckpointManager"]
+           "SPMDCheckpointManager", "CheckpointCorrupted"]
 
 
 def _checkpointer():
@@ -97,63 +124,296 @@ def load_spmd_checkpoint(path, trainer):
     return trainer
 
 
+class CheckpointCorrupted(IOError):
+    """A committed checkpoint failed manifest/checksum verification."""
+
+
+_MANIFEST = "manifest.json"
+_PAYLOAD = "state.bin"
+_FORMAT = 1
+
+
 class SPMDCheckpointManager:
-    """Rotating checkpoint manager (keep max_to_keep, resume latest) — the
-    ``do_checkpoint``-per-epoch role for SPMD jobs."""
+    """Rotating durable checkpoint manager (keep ``max_to_keep``, resume
+    latest) — the ``do_checkpoint``-per-epoch role for SPMD jobs, with the
+    crash-safety contract described in the module docstring.
 
-    def __init__(self, directory, max_to_keep=3):
-        import orbax.checkpoint as ocp
-        self._mgr = ocp.CheckpointManager(
-            os.path.abspath(directory),
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+    On-disk layout (one directory per committed step)::
 
-    def save(self, step, trainer):
-        import orbax.checkpoint as ocp
+        directory/
+          step_0000000005/
+            state.bin        # pickled host-side pytree (+ extra dict)
+            manifest.json    # {"files": {"state.bin": {crc32, size}}, ...}
+          .tmp-step_...      # in-flight write (crash leftover until GC)
+
+    A step directory is **complete** iff its manifest parses and every
+    listed file exists at its recorded size; only complete steps are resume
+    candidates.  ``restore`` additionally verifies crc32 checksums and
+    falls back to the next-older complete step on mismatch.
+
+    Parameters
+    ----------
+    directory : str
+    max_to_keep : int
+        Complete checkpoints retained after each save (the newest complete
+        one is never deleted, regardless).
+    retry : resilience.RetryPolicy, optional
+        Wraps the write and read IO (site ``checkpoint.save`` /
+        ``checkpoint.read``); transient failures — including injected ones
+        — are retried with backoff before surfacing.
+    """
+
+    # another process's in-flight tmp commit younger than this is presumed
+    # live; older ones are crash leftovers and fair game for _gc
+    _TMP_GRACE_S = 3600.0
+
+    def __init__(self, directory, max_to_keep=3, retry=None):
+        if int(max_to_keep) < 1:
+            raise ValueError(f"max_to_keep must be >= 1, got {max_to_keep}")
+        self._dir = os.path.abspath(directory)
+        self._keep = int(max_to_keep)
+        self._retry = retry
+        self._tmp_seq = 0
+        self.restored_extra = None
+        os.makedirs(self._dir, exist_ok=True)
+
+    # ------------------------------------------------------------ layout
+    @property
+    def directory(self):
+        return self._dir
+
+    def _step_dir(self, step):
+        return os.path.join(self._dir, f"step_{int(step):010d}")
+
+    def _manifest_of(self, step):
+        """Parsed manifest if the step directory is complete, else None."""
+        d = self._step_dir(step)
+        try:
+            with open(os.path.join(d, _MANIFEST)) as f:
+                manifest = json.load(f)
+            for name, meta in manifest["files"].items():
+                if os.path.getsize(os.path.join(d, name)) != meta["size"]:
+                    return None
+            return manifest
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def all_steps(self):
+        """Every step with a committed directory (complete or not)."""
+        steps = []
+        try:
+            entries = os.listdir(self._dir)
+        except OSError:
+            return steps
+        for name in entries:
+            if name.startswith("step_"):
+                try:
+                    steps.append(int(name[len("step_"):]))
+                except ValueError:
+                    pass
+        return sorted(steps)
+
+    def complete_steps(self):
+        """Steps that are valid resume candidates (manifest + files ok)."""
+        return [s for s in self.all_steps()
+                if self._manifest_of(s) is not None]
+
+    def latest_step(self):
+        """Newest complete step, or None (matches the orbax-era API)."""
+        complete = self.complete_steps()
+        return complete[-1] if complete else None
+
+    # -------------------------------------------------------------- save
+    def save(self, step, trainer, extra=None):
+        """Atomically commit the trainer's full state as step ``step``.
+
+        ``extra`` is an optional picklable dict stored alongside the state
+        tree (``ResilientTrainer`` keeps the RNG stream there); it comes
+        back via ``restored_extra`` after :meth:`restore`."""
+        step = int(step)
         with _tel.span("checkpoint.save", kind="spmd_managed",
                        step=step) as sp:
             with _tel.span("checkpoint.serialize"):
-                tree = _build_tree(trainer)
-            nbytes = _tree_bytes(tree)
-            sp.set(bytes_written=nbytes)
-            with _tel.span("checkpoint.io", bytes=nbytes):
-                self._mgr.save(step, args=ocp.args.PyTreeSave(tree))
-                self._mgr.wait_until_finished()
-            _tel.count("checkpoint.saves")
-            _tel.count("checkpoint.bytes_written", nbytes)
+                import jax
+                import numpy as np
 
-    def latest_step(self):
-        return self._mgr.latest_step()
+                def _to_host(x):
+                    # this manager gathers the whole state to one host;
+                    # a multi-process mesh leaf is not fully addressable
+                    # and np.asarray would raise a cryptic RuntimeError
+                    # deep in jax — fail with the actual limitation
+                    if getattr(x, "is_fully_addressable", True) is False:
+                        raise NotImplementedError(
+                            "SPMDCheckpointManager gathers state to one "
+                            "host; multi-host (non-fully-addressable) "
+                            "arrays are not yet supported — see ROADMAP "
+                            "(cross-host checkpointing)")
+                    return np.asarray(x)
+
+                tree = _build_tree(trainer)
+                host_tree = jax.tree_util.tree_map(_to_host, tree)
+                blob = pickle.dumps({"tree": host_tree, "extra": extra},
+                                    protocol=pickle.HIGHEST_PROTOCOL)
+            sp.set(bytes_written=len(blob))
+            with _tel.span("checkpoint.io", bytes=len(blob)):
+                if self._retry is not None:
+                    self._retry.call(self._commit_step, step, blob,
+                                     site="checkpoint.save")
+                else:
+                    self._commit_step(step, blob)
+            self._gc()
+            _tel.count("checkpoint.saves")
+            _tel.count("checkpoint.bytes_written", len(blob))
+
+    def _commit_step(self, step, blob):
+        """One write attempt: tmp dir -> payload -> manifest -> rename.
+        Raises with the tmp dir removed, so a retry starts clean; committed
+        step directories are never touched by a failed attempt."""
+        final = self._step_dir(step)
+        if self._manifest_of(step) is not None:
+            # idempotent re-save of a committed step (the auto-resume
+            # re-run path): the bytes on disk are already a complete
+            # checkpoint of this step — replacing them buys nothing and
+            # risks losing it to a crash mid-replace.
+            return
+        self._tmp_seq += 1
+        tmp = os.path.join(
+            self._dir, f".tmp-step_{step:010d}-{os.getpid()}-{self._tmp_seq}")
+        try:
+            os.makedirs(tmp, exist_ok=True)
+            _durable.fsync_write(os.path.join(tmp, _PAYLOAD), blob)
+            if _faults.active:
+                _faults.check("checkpoint.manifest")
+            manifest = {"format": _FORMAT, "step": step,
+                        "files": {_PAYLOAD: {"size": len(blob),
+                                             "crc32": zlib.crc32(blob)}}}
+            _durable.fsync_write(os.path.join(tmp, _MANIFEST),
+                                 json.dumps(manifest, indent=1).encode())
+            if _faults.active:
+                _faults.check("checkpoint.commit")
+            # directory fsyncs: the files' entries live in the tmp dir's
+            # metadata and the rename in the parent's — without both, the
+            # committed checkpoint can vanish on power loss even though
+            # every payload byte was fsynced
+            _durable.fsync_dir(tmp)
+            if os.path.isdir(final):
+                # a previous incomplete commit of this step: replace it
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+            _durable.fsync_dir(self._dir)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+
+    def _gc(self):
+        """Drop all but the newest ``max_to_keep`` complete checkpoints,
+        plus any incomplete/tmp leftovers older than the newest complete
+        one.  The newest complete checkpoint is structurally exempt."""
+        complete = self.complete_steps()
+        doomed = complete[:-self._keep]
+        newest = complete[-1] if complete else None
+        for s in self.all_steps():
+            if s in doomed or (newest is not None and s < newest
+                               and s not in complete):
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        try:
+            for name in os.listdir(self._dir):
+                if not name.startswith(".tmp-"):
+                    continue
+                path = os.path.join(self._dir, name)
+                # only collect OUR leftovers (pid in the name) or clearly
+                # stale ones: another live writer sharing this directory
+                # may be between fsync and rename on its tmp dir, and
+                # deleting it would fail a save that did nothing wrong
+                if f"-{os.getpid()}-" not in name:
+                    try:
+                        age = time.time() - os.path.getmtime(path)
+                    except OSError:
+                        continue
+                    if age < self._TMP_GRACE_S:
+                        continue
+                shutil.rmtree(path, ignore_errors=True)
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------ restore
+    def _read_verified(self, step):
+        """Read + checksum-verify one complete step's payload."""
+        manifest = self._manifest_of(step)
+        if manifest is None:
+            raise CheckpointCorrupted(f"step {step}: no complete manifest")
+        if _faults.active:
+            _faults.check("checkpoint.read")
+        path = os.path.join(self._step_dir(step), _PAYLOAD)
+        with open(path, "rb") as f:
+            blob = f.read()
+        meta = manifest["files"][_PAYLOAD]
+        if len(blob) != meta["size"] or zlib.crc32(blob) != meta["crc32"]:
+            raise CheckpointCorrupted(
+                f"step {step}: checksum mismatch in {path} "
+                f"(crc {zlib.crc32(blob)} != manifest {meta['crc32']})")
+        return blob
 
     def restore(self, trainer, step=None):
+        """Restore the newest complete checkpoint (or ``step``) into
+        ``trainer``, verifying checksums; a corrupt candidate falls back to
+        the next-older complete step with a ``resilience.checkpoint_fallback``
+        event.  Raises ``FileNotFoundError`` when nothing restorable exists.
+        """
+        complete = self.complete_steps()
+        if step is not None:
+            candidates = [int(step)] + [s for s in reversed(complete)
+                                        if s < int(step)]
+        else:
+            candidates = list(reversed(complete))
+        if not candidates:
+            raise FileNotFoundError(
+                f"no complete checkpoint under {self._dir}")
+        last_err = None
+        for i, cand in enumerate(candidates):
+            with _tel.span("checkpoint.restore", kind="spmd_managed",
+                           step=cand) as sp:
+                try:
+                    with _tel.span("checkpoint.io"):
+                        if self._retry is not None:
+                            blob = self._retry.call(self._read_verified,
+                                                    cand,
+                                                    site="checkpoint.read")
+                        else:
+                            blob = self._read_verified(cand)
+                except (CheckpointCorrupted, OSError) as e:
+                    last_err = e
+                    sp.set(corrupt=True)
+                    _tel.count("resilience.checkpoint_fallback")
+                    _tel.instant("resilience.checkpoint_fallback",
+                                 step=cand, error=repr(e))
+                    continue
+                with _tel.span("checkpoint.deserialize"):
+                    payload = pickle.loads(blob)
+                    self._adopt(trainer, payload["tree"])
+                    self.restored_extra = payload.get("extra")
+                sp.set(bytes_read=len(blob))
+                _tel.count("checkpoint.restores")
+                _tel.count("checkpoint.bytes_read", len(blob))
+                return trainer
+        raise CheckpointCorrupted(
+            f"every checkpoint candidate under {self._dir} failed "
+            f"verification; last error: {last_err!r}")
+
+    def _adopt(self, trainer, host_tree):
+        """Put the host-side tree back onto the trainer's shardings (the
+        resharding hop: device placement comes from the CURRENT mesh)."""
         import jax
-        import orbax.checkpoint as ocp
-        step = step if step is not None else self._mgr.latest_step()
-        with _tel.span("checkpoint.restore", kind="spmd_managed",
-                       step=step) as sp:
-            params, opt_state, aux = trainer._state
-            template = {"params": params,
-                        "opt_state": {k: list(v)
-                                      for k, v in opt_state.items()},
-                        "aux": list(aux),
-                        "step": 0}
-            with _tel.span("checkpoint.io"):
-                restored = self._mgr.restore(
-                    step, args=ocp.args.PyTreeRestore(
-                        template,
-                        restore_args=jax.tree.map(
-                            lambda x: ocp.ArrayRestoreArgs(
-                                sharding=x.sharding)
-                            if hasattr(x, "sharding")
-                            else ocp.RestoreArgs(), template)))
-            with _tel.span("checkpoint.deserialize"):
-                trainer._state = (restored["params"],
-                                  {k: tuple(v)
-                                   for k, v in
-                                   restored["opt_state"].items()},
-                                  list(restored["aux"]))
-                trainer._t = int(restored["step"])
-            nbytes = _tree_bytes(restored)
-            sp.set(bytes_read=nbytes)
-            _tel.count("checkpoint.restores")
-            _tel.count("checkpoint.bytes_read", nbytes)
-        return trainer
+        params, opt_state, aux = trainer._state
+        template = {"params": params,
+                    "opt_state": {k: list(v) for k, v in opt_state.items()},
+                    "aux": list(aux),
+                    "step": 0}
+        restored = jax.tree_util.tree_map(
+            lambda h, t: jax.device_put(h, t.sharding)
+            if hasattr(t, "sharding") else h, host_tree, template)
+        trainer._state = (restored["params"],
+                          {k: tuple(v)
+                           for k, v in restored["opt_state"].items()},
+                          list(restored["aux"]))
+        trainer._t = int(restored["step"])

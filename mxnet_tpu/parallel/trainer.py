@@ -62,7 +62,7 @@ def make_train_step(net, loss_fn, optimizer, mesh, data_spec=None,
                     label_spec=None,
                     param_rules=None, tp_axis="tp", dp_axis="dp",
                     donate=True, n_in=1, amp_bf16=False,
-                    param_dtype=None):
+                    param_dtype=None, nan_guard=False):
     """Build ``(step_fn, init_args)`` for SPMD training of ``net``.
 
     - ``net``: an initialized (non-hybridized) Gluon block.
@@ -71,6 +71,14 @@ def make_train_step(net, loss_fn, optimizer, mesh, data_spec=None,
     - ``data_spec``: PartitionSpec for each input batch (default: first axis
       sharded over ``dp``).
     - ``amp_bf16``: fp32 master weights, bf16 compute+activations (AMP).
+    - ``nan_guard``: compile a non-finite-step guard into the jitted step
+      (resilience layer): when the loss or any gradient is non-finite the
+      params/optimizer slots/aux keep their OLD values — the bad update is
+      skipped entirely on-device, no host round-trip.  The loss is still
+      returned non-finite so a host-side ``StepGuard`` can count the streak
+      and escalate to a checkpoint rollback.  Off by default: the guard
+      adds an isfinite reduction over every gradient plus a select over the
+      state, so the unguarded hot path is left untouched.
     - ``param_dtype=jnp.bfloat16``: pure-bf16 STORAGE — params and
       optimizer state live in bf16 (half the HBM prefetch traffic of the
       AMP master copies); the optimizer update itself computes in fp32
@@ -155,6 +163,10 @@ def make_train_step(net, loss_fn, optimizer, mesh, data_spec=None,
         params, opt_state, aux_raw = state
         (loss, new_aux), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params, aux_raw, data, label, key)
+        if nan_guard:
+            ok = jnp.isfinite(loss)
+            for g in jax.tree_util.tree_leaves(grads):
+                ok = ok & jnp.all(jnp.isfinite(g))
         if param_dtype is not None:
             # bf16 storage: do the update arithmetic in fp32 (a fused
             # convert on each side), round the results back to storage
@@ -169,6 +181,14 @@ def make_train_step(net, loss_fn, optimizer, mesh, data_spec=None,
         else:
             new_params, new_opt = optimizer.update(params, grads,
                                                    opt_state, t)
+        if nan_guard:
+            # non-finite step: keep the old state wholesale.  jnp.where on
+            # a scalar predicate lowers to a select XLA fuses into the
+            # update; donation stays valid (old buffers feed the select).
+            keep = lambda new, old: jnp.where(ok, new, old)
+            new_params = jax.tree_util.tree_map(keep, new_params, params)
+            new_opt = jax.tree_util.tree_map(keep, new_opt, opt_state)
+            new_aux = jax.tree_util.tree_map(keep, new_aux, list(aux_raw))
         return (new_params, new_opt, new_aux), loss
 
     state_sh = (
@@ -194,6 +214,10 @@ class SPMDTrainer:
     ``step(data, label)`` runs the fused forward/backward/allreduce/update,
     ``sync_to_block()`` writes the (sharded) weights back into the block's
     Parameters for eager inference / ``save_parameters``.
+
+    Keyword args forward to :func:`make_train_step` — pass
+    ``nan_guard=True`` to skip non-finite updates on-device (pair with
+    ``resilience.ResilientTrainer`` for checkpoint/rollback handling).
     """
 
     def __init__(self, net, loss_fn, optimizer, mesh,

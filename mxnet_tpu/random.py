@@ -90,6 +90,38 @@ def next_key():
         return sub
 
 
+def get_state():
+    """Snapshot the full key-stream state (global key + pre-split pool) as
+    host numpy arrays — picklable, and byte-exact.
+
+    Restoring this snapshot with :func:`set_state` makes the subsequent
+    ``next_key()`` sequence bitwise-identical to what the snapshotted
+    process would have drawn: this is how ``ResilientTrainer`` checkpoints
+    randomness so a crash/resume boundary does not fork the RNG stream.
+    Does NOT capture the numpy initializer stream (``np_rng``) — parameter
+    init happens before training, which is what checkpoints bracket."""
+    with _lock:
+        return {
+            "key": _np.asarray(_key[0]).copy(),
+            "pool_keys": None if _pool["keys"] is None
+            else _pool["keys"].copy(),
+            "pool_i": _pool["i"],
+            "pool_last": None if _pool["last"] is None
+            else _np.asarray(_pool["last"]).copy(),
+        }
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot (exact stream continuation)."""
+    with _lock:
+        _key[0] = jax.numpy.asarray(state["key"])
+        _pool["keys"] = None if state["pool_keys"] is None \
+            else _np.asarray(state["pool_keys"]).copy()
+        _pool["i"] = int(state["pool_i"])
+        _pool["last"] = None if state.get("pool_last") is None \
+            else _np.asarray(state["pool_last"])
+
+
 def current_key():
     """The most recently issued key — consumers that *re-run* the last
     stochastic computation must see the same stream the forward drew, and

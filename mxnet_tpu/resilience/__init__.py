@@ -1,0 +1,49 @@
+"""Fault tolerance + fault injection (ISSUE 4).
+
+The reference MXNet leaned on ps-lite's server-side replication and
+restartable workers for its production story; the TPU-native rebuild keeps
+everything in-process, so resilience is a *library* concern:
+
+- :mod:`.faults` — deterministic fault-injection registry
+  (``MXNET_FAULTS=checkpoint.write:fail:2,io.decode:delay:50ms`` env spec,
+  programmatic :func:`faults.inject`), with named sites threaded through
+  checkpoint writes, io workers, kvstore transport and the serving batcher
+  — the failure paths run in CI, not for the first time in production.
+- :mod:`.retry` — :class:`RetryPolicy`: bounded retries, exponential
+  backoff + seeded jitter, ``resilience.retry``/``resilience.give_up``
+  telemetry; applied to checkpoint IO and kvstore transport.
+- :mod:`.guard` — :class:`StepGuard`: non-finite loss/grad detection,
+  AMP ``LossScaler`` integration, skip-vs-rollback escalation.
+- :mod:`.resume` — :class:`ResilientTrainer`: checkpoint-every-N wrapper
+  over ``SPMDTrainer`` that auto-resumes (step + RNG + optimizer state)
+  on construction, turning a process crash into an idempotent re-run.
+
+Everything is opt-in and zero-overhead when idle: injection sites guard on
+one module attribute, and no retry wrapping touches the hot step path
+unless explicitly configured.  See docs/resilience.md.
+"""
+from . import durable  # noqa: F401
+from . import faults  # noqa: F401
+from . import retry  # noqa: F401
+from . import guard  # noqa: F401
+from .faults import InjectedFault  # noqa: F401
+from .guard import StepGuard  # noqa: F401
+from .retry import RetryPolicy  # noqa: F401
+
+__all__ = ["durable", "faults", "retry", "guard", "resume", "InjectedFault",
+           "RetryPolicy", "StepGuard", "ResilientTrainer"]
+
+
+def __getattr__(name):
+    # resume imports parallel/ (trainer, checkpoint) — heavier than the
+    # rest of this package and a cycle hazard for modules that import
+    # resilience.faults early (kvstore, io); load it on first touch.
+    if name in ("resume", "ResilientTrainer"):
+        # importlib, not ``from . import resume``: the fromlist lookup
+        # re-enters this __getattr__ before the submodule import starts
+        import importlib
+        mod = importlib.import_module(__name__ + ".resume")
+        globals()["resume"] = mod
+        globals()["ResilientTrainer"] = mod.ResilientTrainer
+        return globals()[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

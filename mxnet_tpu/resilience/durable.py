@@ -1,0 +1,57 @@
+"""Durable file-write primitives shared by the checkpoint writers.
+
+One copy of the tricky idiom (mid-write fault site, fsync discipline,
+directory-entry durability) so that `parallel/checkpoint.py` and
+`gluon/trainer.py` cannot drift apart on crash-safety semantics.
+"""
+from __future__ import annotations
+
+import os
+
+from . import faults as _faults
+
+__all__ = ["fsync_write", "fsync_dir", "replace_file_atomic"]
+
+
+def fsync_write(path, data, site="checkpoint.write"):
+    """Write bytes durably, with the mid-write fault site: an injected
+    failure at ``site`` leaves a deliberately truncated file — the exact
+    artifact a real crash mid-write produces."""
+    half = len(data) // 2
+    with open(path, "wb") as f:
+        f.write(data[:half])
+        if _faults.active:
+            _faults.check(site)
+        f.write(data[half:])
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def fsync_dir(path):
+    """fsync a DIRECTORY.  New entries and renames live in the parent
+    directory's metadata, which ``os.fsync`` on the file alone does not
+    flush — without this a committed checkpoint can vanish on power loss
+    even though every payload byte was fsynced."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def replace_file_atomic(fname, payload, site="checkpoint.write"):
+    """Durably replace ``fname`` with ``payload``: temp file + fsync +
+    ``os.replace`` + parent-directory fsync.  A crash at any point leaves
+    either the old complete file or the new complete file — never a
+    truncated ``fname``."""
+    tmp = f"{fname}.tmp-{os.getpid()}"
+    try:
+        fsync_write(tmp, payload, site=site)
+        os.replace(tmp, fname)
+        fsync_dir(os.path.dirname(os.path.abspath(fname)))
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

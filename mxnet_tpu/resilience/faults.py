@@ -1,0 +1,304 @@
+"""Deterministic fault-injection registry.
+
+The reference MXNet's failure paths (ps-lite server timeouts, dmlc IO
+retries) were exercised by killing real processes in integration rigs; this
+rebuild keeps every failure domain in-process, so the failure paths can be
+driven *deterministically* instead: named injection sites are threaded
+through checkpoint writes, io decode/prefetch workers, kvstore transport and
+the serving batcher, and a registry decides per call whether that site
+fails, delays, or passes.
+
+Spec grammar (``MXNET_FAULTS`` env var, or :func:`configure`)::
+
+    site:action[:arg[:count]][, site:action...]
+
+    checkpoint.write:fail          # fail the next call, then pass
+    checkpoint.write:fail:2        # fail the next 2 calls, then pass
+    io.decode:delay:50ms           # sleep 50ms on every call
+    io.decode:delay:50ms:3         # ... on the next 3 calls only
+    kvstore.push:flaky:0.25        # each call fails with p=0.25 (seeded)
+
+Durations accept ``us``/``ms``/``s`` suffixes (bare numbers are ms).
+Probabilistic policies draw from a ``random.Random`` seeded from
+``MXNET_FAULTS_SEED`` (default 0) xor the site name, so a failing run
+replays **exactly** under the same spec + seed.
+
+Zero overhead when idle: instrumented sites guard on the module-global
+``active`` bool (one attribute read — the same discipline as
+``telemetry.bus.enabled``); the registry only flips it on when at least one
+policy is armed.
+
+Failures raise :class:`InjectedFault`, an ``IOError`` subclass — so retry
+policies whose ``retryable`` filter covers ``OSError`` (the default)
+treat injected faults exactly like real transient IO errors.
+
+Known sites (see docs/resilience.md for the full table):
+
+=====================  =====================================================
+``checkpoint.write``   mid-payload-write inside the checkpoint manager — a
+                       ``fail`` here leaves a truncated temp file behind,
+                       never a corrupt committed checkpoint
+``checkpoint.manifest``/``checkpoint.commit``/``checkpoint.read``
+                       manifest write / pre-rename / restore read
+``io.decode``          ImageRecordIter batch decode
+``io.prefetch``        PrefetchingIter / DevicePrefetchIter worker body
+``kvstore.push`` / ``kvstore.pull``
+                       transport hop of a push / per-key pull copy
+``serving.batch``      batcher worker, inside the per-batch try (an
+                       injected fault fails that batch's futures)
+=====================  =====================================================
+"""
+from __future__ import annotations
+
+import os
+import random as _random
+import re
+import threading
+import time
+import zlib
+
+from ..telemetry import bus as _tel
+
+__all__ = ["InjectedFault", "Policy", "configure", "inject", "clear",
+           "check", "scope", "sites", "parse_spec", "active"]
+
+# Fast-path flag: sites do ``if faults.active: faults.check(site)``.
+# Mutated only under _lock, read without it (single attribute load).
+active = False
+
+_lock = threading.RLock()
+_sites = {}            # site -> [Policy, ...]
+_seed = int(os.environ.get("MXNET_FAULTS_SEED", "0"))
+
+
+class InjectedFault(IOError):
+    """Raised by an armed ``fail``/``flaky`` policy at its site.
+
+    An ``IOError`` on purpose: retry policies with the default
+    ``retryable=(OSError,)`` filter recover from injected faults the same
+    way they recover from real transient IO errors."""
+
+    def __init__(self, site, action="fail"):
+        super().__init__(f"injected fault at {site!r} ({action})")
+        self.site = site
+        self.action = action
+
+
+_DUR = re.compile(r"^(\d+(?:\.\d+)?)(us|ms|s)?$")
+
+
+def _parse_duration(text):
+    """Duration string -> seconds (``us``/``ms``/``s``; bare = ms)."""
+    m = _DUR.match(text.strip())
+    if not m:
+        raise ValueError(f"bad duration {text!r} (want e.g. 50ms, 1.5s)")
+    val = float(m.group(1))
+    unit = m.group(2) or "ms"
+    return val * {"us": 1e-6, "ms": 1e-3, "s": 1.0}[unit]
+
+
+class Policy:
+    """One armed behavior at a site: ``fail``, ``delay`` or ``flaky``.
+
+    ``count`` bounds how many calls the policy affects (None = unlimited);
+    exhausted policies are dropped from the registry automatically.
+    """
+
+    __slots__ = ("action", "count", "delay", "prob", "_rng", "_seed",
+                 "_site")
+
+    def __init__(self, action, count=None, delay=0.0, prob=1.0, seed=None):
+        if action not in ("fail", "delay", "flaky"):
+            raise ValueError(f"unknown fault action {action!r}")
+        self.action = action
+        self.count = None if count is None else int(count)
+        self.delay = float(delay)
+        self.prob = float(prob)
+        self._seed = seed
+        self._rng = _random.Random(seed)
+        self._site = None
+
+    def _arm(self, site):
+        """Bind the deterministic stream.  A policy built without an
+        explicit ``seed`` derives one as MXNET_FAULTS_SEED ^ crc32(site),
+        so the same spec replays the same per-site decisions regardless of
+        how other sites interleave; an explicit ``seed`` keeps the user's
+        own stream untouched."""
+        self._site = site
+        if self.action == "flaky" and self._seed is None:
+            self._rng.seed(_seed ^ zlib.crc32(site.encode()))
+
+    def _decide(self):
+        """Under _lock: does this call trip, and is the policy spent?
+        Returns (tripped, spent)."""
+        if self.count is not None and self.count <= 0:
+            return False, True
+        if self.action == "flaky" and self._rng.random() >= self.prob:
+            return False, False
+        if self.count is not None:
+            self.count -= 1
+            return True, self.count <= 0
+        return True, False
+
+    def __repr__(self):
+        extra = ""
+        if self.action == "delay":
+            extra = f", delay={self.delay * 1e3:g}ms"
+        if self.action == "flaky":
+            extra = f", prob={self.prob:g}"
+        return (f"Policy({self.action!r}, count={self.count}{extra}, "
+                f"site={self._site!r})")
+
+
+def parse_policy(text, seed=None):
+    """``"fail:2"`` / ``"delay:50ms:3"`` / ``"flaky:0.25"`` -> Policy."""
+    parts = [p for p in text.strip().split(":") if p != ""]
+    if not parts:
+        raise ValueError("empty fault policy")
+    action, args = parts[0], parts[1:]
+    if action == "fail":
+        count = int(args[0]) if args else 1
+        return Policy("fail", count=count, seed=seed)
+    if action == "delay":
+        if not args:
+            raise ValueError("delay needs a duration, e.g. delay:50ms")
+        delay = _parse_duration(args[0])
+        count = int(args[1]) if len(args) > 1 else None
+        return Policy("delay", count=count, delay=delay, seed=seed)
+    if action == "flaky":
+        if not args:
+            raise ValueError("flaky needs a probability, e.g. flaky:0.25")
+        prob = float(args[0])
+        count = int(args[1]) if len(args) > 1 else None
+        return Policy("flaky", count=count, prob=prob, seed=seed)
+    raise ValueError(f"unknown fault action {action!r} in {text!r}")
+
+
+def parse_spec(spec):
+    """Full ``MXNET_FAULTS`` spec -> list of (site, Policy)."""
+    out = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if ":" not in item:
+            raise ValueError(f"bad fault spec item {item!r} "
+                             "(want site:action[:arg])")
+        site, policy = item.split(":", 1)
+        out.append((site.strip(), parse_policy(policy)))
+    return out
+
+
+def _refresh_active_locked():
+    global active
+    active = bool(_sites)
+
+
+def inject(site, policy):
+    """Arm ``policy`` (a :class:`Policy` or policy string like ``"fail:2"``)
+    at ``site``.  Multiple policies per site stack (all are consulted)."""
+    if isinstance(policy, str):
+        policy = parse_policy(policy)
+    policy._arm(site)
+    with _lock:
+        _sites.setdefault(site, []).append(policy)
+        _refresh_active_locked()
+    return policy
+
+
+def configure(spec):
+    """Replace the whole registry from a spec string (the ``MXNET_FAULTS``
+    grammar).  An empty/None spec clears everything."""
+    parsed = parse_spec(spec) if spec else []
+    with _lock:
+        _sites.clear()
+        for site, policy in parsed:
+            policy._arm(site)
+            _sites.setdefault(site, []).append(policy)
+        _refresh_active_locked()
+
+
+def clear(site=None):
+    """Disarm one site, or every site when ``site`` is None."""
+    with _lock:
+        if site is None:
+            _sites.clear()
+        else:
+            _sites.pop(site, None)
+        _refresh_active_locked()
+
+
+def sites():
+    """Snapshot {site: [repr(policy), ...]} of armed policies."""
+    with _lock:
+        return {s: [repr(p) for p in ps] for s, ps in _sites.items()}
+
+
+class scope:
+    """Context manager for tests: arm a spec on enter, restore the previous
+    registry on exit — nested scopes compose."""
+
+    def __init__(self, spec):
+        self._spec = spec
+        self._saved = None
+
+    def __enter__(self):
+        with _lock:
+            self._saved = {s: list(ps) for s, ps in _sites.items()}
+        configure(self._spec)
+        return self
+
+    def __exit__(self, *exc):
+        with _lock:
+            _sites.clear()
+            _sites.update(self._saved)
+            _refresh_active_locked()
+        return False
+
+
+def check(site):
+    """Consult the registry at an injection site.
+
+    Sleeps for armed ``delay`` policies and raises :class:`InjectedFault`
+    for tripped ``fail``/``flaky`` policies.  Call sites guard with the
+    module-global ``active`` flag so the idle cost is one attribute read.
+    """
+    if not active:
+        return
+    delay = 0.0
+    fail = None
+    with _lock:
+        policies = _sites.get(site)
+        if not policies:
+            return
+        for p in list(policies):
+            tripped, spent = p._decide()
+            if spent:
+                policies.remove(p)
+            if not tripped:
+                continue
+            if p.action == "delay":
+                delay += p.delay
+            else:
+                fail = p
+        if not policies:
+            _sites.pop(site, None)
+        _refresh_active_locked()
+    if delay > 0.0:
+        if _tel.enabled:
+            _tel.count("resilience.fault_injected", site=site, action="delay")
+            _tel.instant("resilience.fault_injected", site=site,
+                         action="delay", delay_ms=round(delay * 1e3, 3))
+        time.sleep(delay)
+    if fail is not None:
+        if _tel.enabled:
+            _tel.count("resilience.fault_injected", site=site,
+                       action=fail.action)
+            _tel.instant("resilience.fault_injected", site=site,
+                         action=fail.action)
+        raise InjectedFault(site, fail.action)
+
+
+_env_spec = os.environ.get("MXNET_FAULTS", "")
+if _env_spec:
+    configure(_env_spec)

@@ -1,0 +1,78 @@
+"""Non-finite step detection — the host-side half of the NaN guard.
+
+A NaN loss in the reference poisoned every subsequent step silently (the
+engine has no notion of "bad update"; AMP's ``LossScaler`` only skips when
+the *gradients* overflow).  Here the guard has two cooperating halves:
+
+- **In-jit** (``make_train_step(nan_guard=True)``): the compiled step
+  checks loss + gradient finiteness and keeps the OLD params/optimizer
+  state when the step is bad — the update is skipped on-device, with no
+  host round-trip on the hot path.
+- **Host-side** (:class:`StepGuard`): observes the per-step loss value,
+  counts consecutive bad steps, drives the AMP :class:`LossScaler`'s
+  halve-on-overflow dynamics, and escalates to ``"rollback"`` after K
+  consecutive bad steps — persistent NaNs mean skipping is not enough and
+  the run should rewind to its last checkpoint
+  (:class:`~mxnet_tpu.resilience.resume.ResilientTrainer` acts on the
+  verdict).
+"""
+from __future__ import annotations
+
+import math
+
+from ..telemetry import bus as _tel
+
+__all__ = ["StepGuard"]
+
+
+class StepGuard:
+    """Classify each observed step as ``"ok"`` / ``"skip"`` / ``"rollback"``.
+
+    Parameters
+    ----------
+    max_consecutive : int
+        Bad-step streak that escalates ``"skip"`` to ``"rollback"``.
+    scaler : contrib.amp.LossScaler, optional
+        Driven on every observation: ``update_scale(overflow=True)`` on a
+        bad step (halves the scale, emits ``amp.overflow``), ``False``
+        otherwise (grows it every ``scale_window`` clean steps).
+    """
+
+    def __init__(self, max_consecutive=3, scaler=None):
+        if int(max_consecutive) < 1:
+            raise ValueError(
+                f"max_consecutive must be >= 1, got {max_consecutive}")
+        self.max_consecutive = int(max_consecutive)
+        self.scaler = scaler
+        self.bad_streak = 0
+        self.total_bad = 0
+        self.total_steps = 0
+
+    def observe(self, loss, grad_norm=None):
+        """Judge one step from its (host) loss value and optional grad norm.
+
+        Returns ``"ok"`` (step was clean), ``"skip"`` (non-finite — the
+        update should be / was skipped), or ``"rollback"`` (the streak hit
+        ``max_consecutive``; rewind to the last checkpoint)."""
+        self.total_steps += 1
+        bad = not math.isfinite(float(loss))
+        if grad_norm is not None:
+            bad = bad or not math.isfinite(float(grad_norm))
+        if self.scaler is not None:
+            self.scaler.update_scale(bad)
+        if not bad:
+            self.bad_streak = 0
+            return "ok"
+        self.bad_streak += 1
+        self.total_bad += 1
+        if _tel.enabled:
+            _tel.count("resilience.nan_steps")
+            _tel.instant("resilience.nan_step", loss=repr(loss),
+                         streak=self.bad_streak)
+        if self.bad_streak >= self.max_consecutive:
+            return "rollback"
+        return "skip"
+
+    def reset(self):
+        """Clear the streak (after a rollback restored known-good state)."""
+        self.bad_streak = 0

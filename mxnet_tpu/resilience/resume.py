@@ -1,0 +1,182 @@
+"""Crash-tolerant training driver: periodic checkpoints + idempotent resume.
+
+The reference's recovery story is "restart the worker, reload the epoch
+checkpoint, replay the epoch"; :class:`ResilientTrainer` tightens that to
+seconds of replayed work: it wraps an
+:class:`~mxnet_tpu.parallel.SPMDTrainer`, checkpoints every ``save_every``
+steps through the durable :class:`~mxnet_tpu.parallel.SPMDCheckpointManager`
+(atomic commits, checksums, retention), and **on construction** restores the
+newest complete checkpoint — step counter, params, optimizer slots AND the
+``mx.random`` key stream — so re-running a crashed script is idempotent: the
+re-run resumes at the checkpointed step with bitwise-identical RNG/step
+state and takes the exact steps the crashed run would have taken.
+
+Failure handling per step:
+
+- a **failed checkpoint save** (after the manager's retries) never kills
+  training — it is counted (``resilience.checkpoint_failed``) and the next
+  interval tries again;
+- a **non-finite loss** is judged by the :class:`StepGuard`: the update is
+  skipped (pair with ``SPMDTrainer(..., nan_guard=True)`` so the skip
+  happens on-device), and after ``max_consecutive`` bad steps in a row the
+  trainer **rolls back** to the last checkpoint
+  (``resilience.rollbacks``) instead of grinding forward on poisoned state.
+
+Judgment is **deferred by one step** so guarding never serializes the
+async dispatch pipeline: ``step()`` returns its loss NDArray immediately
+and judges the *previous* step's loss — by then the value has
+materialized while the host was preparing the next batch, so the read is
+(nearly) free instead of a per-step device sync.  Verdict actions —
+cadence checkpoint, rollback — land at the start of the following
+``step()`` call; :meth:`flush` forces the pending judgment now (call it
+after the last step of a loop, or use :meth:`save_now`, which flushes).
+"""
+from __future__ import annotations
+
+from .. import random as _rnd
+from ..parallel.checkpoint import SPMDCheckpointManager
+from ..telemetry import bus as _tel
+from .guard import StepGuard
+
+__all__ = ["ResilientTrainer"]
+
+
+class ResilientTrainer:
+    """Fault-tolerant wrapper over an ``SPMDTrainer``.
+
+    Parameters
+    ----------
+    trainer : SPMDTrainer
+        Build it with ``nan_guard=True`` so non-finite steps are skipped
+        on-device (this wrapper's guard then only counts and escalates).
+    directory : str
+        Checkpoint root (an ``SPMDCheckpointManager`` layout).
+    save_every : int
+        Checkpoint cadence in steps.
+    max_to_keep : int
+        Retention (newest complete checkpoint is never GCd).
+    guard : StepGuard, optional
+        Defaults to ``StepGuard(max_consecutive=3)``; pass your own to
+        attach an AMP ``LossScaler`` or change the rollback threshold.
+    retry : RetryPolicy, optional
+        Handed to the checkpoint manager for its IO.
+    save_rng : bool
+        Capture/restore the ``mx.random`` stream with each checkpoint
+        (bitwise-identical randomness across a crash/resume boundary).
+    """
+
+    def __init__(self, trainer, directory, save_every=100, max_to_keep=3,
+                 guard=None, retry=None, save_rng=True):
+        if int(save_every) < 1:
+            raise ValueError(f"save_every must be >= 1, got {save_every}")
+        self._trainer = trainer
+        self._save_every = int(save_every)
+        self._save_rng = bool(save_rng)
+        self._mgr = SPMDCheckpointManager(directory, max_to_keep=max_to_keep,
+                                          retry=retry)
+        self._guard = guard if guard is not None else StepGuard()
+        self._pending = None       # last step's loss, not yet judged
+        self.checkpoint_failures = 0
+        self.rollbacks = 0
+        self.resumed_from = None
+        latest = self._mgr.latest_step()
+        if latest is not None:
+            self._restore()
+            self.resumed_from = self._trainer._t
+            _tel.count("resilience.resumes")
+            _tel.instant("resilience.resumed", step=self._trainer._t,
+                         checkpoint=latest)
+
+    # ------------------------------------------------------------- plumbing
+    @property
+    def trainer(self):
+        return self._trainer
+
+    @property
+    def manager(self):
+        return self._mgr
+
+    @property
+    def guard(self):
+        return self._guard
+
+    @property
+    def step_count(self):
+        return self._trainer._t
+
+    def sync_to_block(self):
+        self._trainer.sync_to_block()
+
+    # ----------------------------------------------------------------- step
+    def step(self, data, label):
+        """One guarded training step.
+
+        Judges the PREVIOUS step's loss (acting on the verdict: cadence
+        checkpoint after a clean step, rollback after ``max_consecutive``
+        bad steps), then dispatches this step and returns its loss
+        NDArray immediately — no host sync on the hot path (non-finite on
+        a skipped step once materialized)."""
+        self.flush()
+        loss = self._trainer.step(data, label)
+        self._pending = loss
+        return loss
+
+    def flush(self):
+        """Judge the pending step's loss now (blocks on its value) and
+        act on the verdict.  Call after the final step of a loop — its
+        cadence checkpoint / rollback only happens once judged."""
+        if self._pending is None:
+            return
+        loss, self._pending = self._pending, None
+        verdict = self._guard.observe(float(loss.asnumpy()))
+        if verdict == "rollback":
+            self.rollback()
+        elif verdict == "ok" and self._trainer._t % self._save_every == 0:
+            self._save()
+
+    # ------------------------------------------------------------ lifecycle
+    def save_now(self):
+        """Flush the pending judgment, then checkpoint the current state.
+        A save that fails even after the manager's retries is absorbed
+        (training goes on; the next cadence point tries again) and
+        counted."""
+        self.flush()
+        return self._save()
+
+    def _save(self):
+        try:
+            self._mgr.save(self._trainer._t, self._trainer,
+                           extra=self._extra())
+            return True
+        except Exception as e:
+            self.checkpoint_failures += 1
+            _tel.count("resilience.checkpoint_failed")
+            _tel.instant("resilience.checkpoint_failed",
+                         step=self._trainer._t, error=repr(e))
+            return False
+
+    def rollback(self):
+        """Rewind to the newest complete checkpoint (after persistent NaN
+        steps).  Raises if no checkpoint exists — with nothing to rewind
+        to, continuing silently would train on poisoned state."""
+        if self._mgr.latest_step() is None:
+            raise RuntimeError(
+                "StepGuard demanded a rollback but no complete checkpoint "
+                f"exists under {self._mgr.directory}")
+        self._pending = None       # a loss from poisoned state: never judge
+        from_step = self._trainer._t
+        self._restore()
+        self._guard.reset()
+        self.rollbacks += 1
+        _tel.count("resilience.rollbacks")
+        _tel.instant("resilience.rollback", from_step=from_step,
+                     to_step=self._trainer._t)
+
+    def _extra(self):
+        return {"rng": _rnd.get_state()} if self._save_rng else None
+
+    def _restore(self):
+        self._mgr.restore(self._trainer)
+        extra = self._mgr.restored_extra or {}
+        if self._save_rng and extra.get("rng") is not None:
+            _rnd.set_state(extra["rng"])

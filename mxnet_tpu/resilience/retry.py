@@ -1,0 +1,110 @@
+"""Reusable retry with exponential backoff + jitter.
+
+The reference's transport retries live inside ps-lite (``van.cc`` resends)
+and dmlc-core's IO streams; the rebuild's failure domains — checkpoint
+storage and kvstore transport — get one shared policy object instead, so
+every retry in the framework reports through the same telemetry
+(``resilience.retry`` / ``resilience.give_up``) and tests can reason about
+one backoff implementation.
+
+A :class:`RetryPolicy` is immutable configuration; ``call``/``wrap`` apply
+it.  Only exceptions matching ``retryable`` are retried — everything else
+(assertion bugs, keyboard interrupt) propagates on the first throw.
+:class:`~mxnet_tpu.resilience.faults.InjectedFault` subclasses ``IOError``,
+so the default filter retries injected faults like real ones.
+"""
+from __future__ import annotations
+
+import functools
+import random as _random
+import time
+
+from ..telemetry import bus as _tel
+
+__all__ = ["RetryPolicy"]
+
+
+class RetryPolicy:
+    """Bounded retries with exponential backoff and seeded jitter.
+
+    Parameters
+    ----------
+    max_attempts : int
+        Total tries (1 = no retry).
+    base_delay_ms / max_delay_ms : float
+        Backoff starts at ``base`` and doubles (``multiplier``) per failed
+        attempt, capped at ``max``.
+    multiplier : float
+        Backoff growth factor.
+    jitter : float
+        Each sleep is scaled by ``1 + jitter * U[0, 1)`` — de-synchronizes
+        retry storms across workers.  0 disables jitter.
+    retryable : tuple of exception types
+        Only these are retried.  Default ``(OSError, TimeoutError)`` —
+        which covers ``IOError`` and therefore ``InjectedFault``.
+    seed : int or None
+        Seeds the jitter stream (deterministic backoff in tests).
+    sleep : callable
+        Injectable for tests (defaults to ``time.sleep``).
+    """
+
+    def __init__(self, max_attempts=3, base_delay_ms=50.0, max_delay_ms=2000.0,
+                 multiplier=2.0, jitter=0.5,
+                 retryable=(OSError, TimeoutError), seed=None,
+                 sleep=time.sleep):
+        if int(max_attempts) < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        self.max_attempts = int(max_attempts)
+        self.base_delay = float(base_delay_ms) / 1e3
+        self.max_delay = float(max_delay_ms) / 1e3
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.retryable = tuple(retryable)
+        self._rng = _random.Random(seed)
+        self._sleep = sleep
+
+    def backoff(self, attempt):
+        """Sleep seconds after failed attempt number ``attempt`` (1-based)."""
+        delay = min(self.base_delay * self.multiplier ** (attempt - 1),
+                    self.max_delay)
+        if self.jitter:
+            delay *= 1.0 + self.jitter * self._rng.random()
+        return delay
+
+    def call(self, fn, *args, site="", **kwargs):
+        """Run ``fn(*args, **kwargs)`` under this policy.
+
+        ``site`` labels the telemetry (``resilience.retry`` counts each
+        recovery attempt, ``resilience.give_up`` the final surrender)."""
+        attempt = 1
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except self.retryable as e:
+                if attempt >= self.max_attempts:
+                    if _tel.enabled:
+                        _tel.count("resilience.give_up", site=site)
+                        _tel.instant("resilience.give_up", site=site,
+                                     attempts=attempt, error=repr(e))
+                    raise
+                delay = self.backoff(attempt)
+                if _tel.enabled:
+                    _tel.count("resilience.retry", site=site)
+                    _tel.instant("resilience.retry", site=site,
+                                 attempt=attempt, error=repr(e),
+                                 backoff_ms=round(delay * 1e3, 3))
+                self._sleep(delay)
+                attempt += 1
+
+    def wrap(self, fn, site=""):
+        """Decorator form: ``reader = policy.wrap(reader, site="...")``."""
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return self.call(fn, *args, site=site, **kwargs)
+        return wrapped
+
+    def __repr__(self):
+        return (f"RetryPolicy(max_attempts={self.max_attempts}, "
+                f"base_delay_ms={self.base_delay * 1e3:g}, "
+                f"max_delay_ms={self.max_delay * 1e3:g}, "
+                f"jitter={self.jitter:g})")

@@ -18,11 +18,18 @@ Robustness contract:
   is waiting for.
 - **Worker-crash recovery.**  A model exception fails that batch's futures
   and the worker keeps serving; if the worker thread itself ever dies,
-  the next ``submit()`` respawns it.
+  the next ``submit()`` respawns it (counted as
+  ``serving.worker_restart``).
+- **Circuit breaker.**  After ``breaker_threshold`` *consecutive* batch
+  failures the batcher stops hot-looping crash/respawn and sheds load
+  instead: ``submit()`` rejects with ``reason="unhealthy"`` for a
+  ``breaker_cooldown_ms`` window, then lets traffic probe again
+  (half-open); one clean batch closes the breaker.  ``Batcher.healthy``
+  exposes the state for registry readiness probes.
 
-Every rejection carries a ``reason`` (``deadline`` / ``shutdown``) both on
-the raised :class:`RequestRejected` and on the ``serving.rejections``
-telemetry counter.
+Every rejection carries a ``reason`` (``deadline`` / ``shutdown`` /
+``unhealthy``) both on the raised :class:`RequestRejected` and on the
+``serving.rejections`` telemetry counter.
 """
 from __future__ import annotations
 
@@ -31,6 +38,7 @@ import time
 from collections import deque
 from concurrent.futures import Future
 
+from ..resilience import faults as _faults
 from ..telemetry import bus as _tel
 
 __all__ = ["Batcher", "RequestRejected"]
@@ -39,8 +47,10 @@ __all__ = ["Batcher", "RequestRejected"]
 class RequestRejected(RuntimeError):
     """A request was load-shed instead of served.
 
-    ``reason`` is ``"deadline"`` (expired while queued or while waiting for
-    queue space) or ``"shutdown"`` (batcher closed without drain)."""
+    ``reason`` is ``"deadline"`` (expired while queued or while waiting
+    for queue space), ``"shutdown"`` (batcher closed without drain), or
+    ``"unhealthy"`` (circuit breaker open after consecutive batch
+    failures)."""
 
     def __init__(self, reason, detail=""):
         msg = f"request rejected ({reason})"
@@ -86,10 +96,17 @@ class Batcher:
     start : bool
         Start the worker thread now (default).  ``start=False`` lets tests
         enqueue deterministically and then :meth:`start`.
+    breaker_threshold : int or None
+        Consecutive batch failures that open the circuit breaker (None
+        disables it).
+    breaker_cooldown_ms : float
+        How long an open breaker sheds load before letting traffic probe
+        the model again.
     """
 
     def __init__(self, runtime, max_batch=None, max_latency_ms=5.0,
-                 queue_depth=256, start=True):
+                 queue_depth=256, start=True,
+                 breaker_threshold=8, breaker_cooldown_ms=1000.0):
         self._runtime = runtime
         if max_batch is not None and int(max_batch) < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -108,6 +125,16 @@ class Batcher:
         self._started = False
         self._worker = None
         self.batches_failed = 0
+        self.worker_restarts = 0
+        if breaker_threshold is not None and int(breaker_threshold) < 1:
+            raise ValueError(
+                f"breaker_threshold must be >= 1 or None, "
+                f"got {breaker_threshold}")
+        self._breaker_threshold = None if breaker_threshold is None \
+            else int(breaker_threshold)
+        self._breaker_cooldown = float(breaker_cooldown_ms) / 1e3
+        self._consecutive_failures = 0
+        self._breaker_open_until = 0.0
         if start:
             self.start()
 
@@ -128,6 +155,16 @@ class Batcher:
             if self._closed:
                 self._count_rejection("shutdown")
                 raise RequestRejected("shutdown", "batcher is closed")
+            if self._breaker_open_until and \
+                    time.perf_counter() < self._breaker_open_until:
+                # open breaker: shed load for the cool-down window instead
+                # of feeding a crashing model a hot loop of batches
+                self._count_rejection("unhealthy")
+                raise RequestRejected(
+                    "unhealthy",
+                    f"circuit breaker open after "
+                    f"{self._consecutive_failures} consecutive batch "
+                    f"failures")
             if self._started:
                 self._respawn_worker_locked()
             while len(self._queue) >= self.queue_depth:
@@ -171,6 +208,17 @@ class Batcher:
 
     def _respawn_worker_locked(self):
         if self._worker is None or not self._worker.is_alive():
+            if self._worker is not None:
+                # the previous worker died unexpectedly (it only exits
+                # cleanly at close); count the restart so a crash/respawn
+                # loop is visible in traces
+                self.worker_restarts += 1
+                if _tel.enabled:
+                    _tel.count("serving.worker_restart",
+                               model=self._runtime.name)
+                    _tel.instant("serving.worker_restart",
+                                 model=self._runtime.name,
+                                 restarts=self.worker_restarts)
             self._worker = threading.Thread(
                 target=self._run, daemon=True,
                 name=f"serving-batcher-{self._runtime.name}")
@@ -239,6 +287,8 @@ class Batcher:
                            (now - req.t_submit) * 1e3,
                            model=self._runtime.name)
         try:
+            if _faults.active:
+                _faults.check("serving.batch")
             with _tel.span("serving.run", model=self._runtime.name,
                            batch=len(live),
                            bucket=self._runtime.bucket_for(len(live))):
@@ -251,13 +301,47 @@ class Batcher:
                            model=self._runtime.name)
                 _tel.instant("serving.batch_failure",
                              model=self._runtime.name, error=repr(e))
+            self._record_batch_failure()
             for req in live:
                 req.future.set_exception(e)
             return
+        self._consecutive_failures = 0
         if tel_on:
             _tel.count("serving.batches", model=self._runtime.name)
         for req, out in zip(live, outs):
             req.future.set_result(out)
+
+    def _record_batch_failure(self):
+        """Advance the circuit breaker.  The failure streak is NOT reset
+        when the breaker opens: after the cool-down a probe batch that
+        fails re-opens it immediately (half-open semantics)."""
+        if self._breaker_threshold is None:
+            return
+        self._consecutive_failures += 1
+        if self._consecutive_failures >= self._breaker_threshold:
+            self._breaker_open_until = \
+                time.perf_counter() + self._breaker_cooldown
+            if _tel.enabled:
+                _tel.count("serving.breaker_open",
+                           model=self._runtime.name)
+                _tel.instant("serving.breaker_open",
+                             model=self._runtime.name,
+                             failures=self._consecutive_failures,
+                             cooldown_ms=self._breaker_cooldown * 1e3)
+
+    @property
+    def healthy(self):
+        """Readiness probe: accepting and able to serve work right now.
+
+        False while closed or while the circuit breaker is open.  A dead
+        worker thread does NOT make the batcher unhealthy — the next
+        ``submit()`` respawns it."""
+        if self._closed:
+            return False
+        if self._breaker_open_until and \
+                time.perf_counter() < self._breaker_open_until:
+            return False
+        return True
 
     # ------------------------------------------------------------- shutdown
     def close(self, drain=True, timeout=30.0):

@@ -93,6 +93,20 @@ class ModelRegistry:
         with self._lock:
             return sorted(self._batchers)
 
+    def healthy(self, name=None):
+        """Readiness probe over :attr:`Batcher.healthy`.
+
+        With a ``name``: is that model accepting work (registered, not
+        closed, circuit breaker not open)?  Without: is EVERY registered
+        model healthy (the pod-level readiness answer — an empty registry
+        is not ready)."""
+        with self._lock:
+            if name is not None:
+                batcher = self._batchers.get(name)
+                return batcher is not None and batcher.healthy
+            return bool(self._batchers) and \
+                all(b.healthy for b in self._batchers.values())
+
     def __contains__(self, name):
         with self._lock:
             return name in self._batchers

@@ -1,4 +1,8 @@
 """SPMD sharded checkpoint/resume tests (SURVEY.md §5.4)."""
+import json
+import os
+import zlib
+
 import numpy as np
 import pytest
 
@@ -59,6 +63,50 @@ def test_checkpoint_manager_rotation(tmp_path):
     for k in tr._state[0]:
         np.testing.assert_allclose(np.asarray(tr._state[0][k]),
                                    np.asarray(tr2._state[0][k]), rtol=1e-6)
+
+
+def test_manager_layout_is_checksummed_manifest(tmp_path):
+    """The durable on-disk format (ISSUE 4): one directory per committed
+    step with a manifest recording size + crc32 of the payload, and the
+    ``extra`` dict riding along through restore."""
+    x, y = _data()
+    tr, _ = _trainer()
+    mgr = SPMDCheckpointManager(str(tmp_path), max_to_keep=2)
+    tr.step(x, y)
+    mgr.save(1, tr, extra={"note": "hello"})
+    d = os.path.join(str(tmp_path), "step_%010d" % 1)
+    with open(os.path.join(d, "manifest.json")) as f:
+        manifest = json.load(f)
+    with open(os.path.join(d, "state.bin"), "rb") as f:
+        blob = f.read()
+    meta = manifest["files"]["state.bin"]
+    assert manifest["step"] == 1
+    assert meta["size"] == len(blob)
+    assert meta["crc32"] == zlib.crc32(blob)
+    tr2, _ = _trainer(seed=1)
+    mgr.restore(tr2)
+    assert mgr.restored_extra == {"note": "hello"}
+
+
+def test_manager_empty_directory(tmp_path):
+    mgr = SPMDCheckpointManager(str(tmp_path), max_to_keep=2)
+    assert mgr.latest_step() is None
+    assert mgr.complete_steps() == []
+    tr, _ = _trainer()
+    with pytest.raises(FileNotFoundError):
+        mgr.restore(tr)
+
+
+def test_manager_restore_specific_step(tmp_path):
+    x, y = _data()
+    tr, _ = _trainer()
+    mgr = SPMDCheckpointManager(str(tmp_path), max_to_keep=5)
+    for s in (1, 2, 3):
+        tr.step(x, y)
+        mgr.save(s, tr)
+    tr2, _ = _trainer(seed=1)
+    mgr.restore(tr2, step=2)
+    assert tr2._t == 2
 
 
 def test_checkpoint_telemetry_spans(tmp_path):
