@@ -45,9 +45,13 @@ BASELINE.md).  All other configs are nested under ``"extra"``:
   after a mid-save kill (restore + first step of a fresh
   ``ResilientTrainer``), and the per-step cost of the opt-in
   ``nan_guard`` (``mxnet_tpu.resilience``)
+- ``engine``: lazy eager dispatch (``engine.bulk``) — a 64-op eager
+  elementwise chain, per-op jit dispatch vs fused multi-op segments:
+  wall time/chain, dispatches/step, steady-state segment compile misses
+  (must be 0)
 
 Select a subset with
-BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,int8,io,e2e,eager,optimizer,serving,resilience.
+BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,int8,io,e2e,eager,engine,optimizer,serving,resilience.
 The full json carries a ``telemetry`` sub-dict (recompile count,
 collective bytes, io wait ms — disable with BENCH_TELEMETRY=0) so each
 BENCH record carries its own diagnosis.
@@ -1174,6 +1178,88 @@ def bench_eager_dispatch():
             "op": "broadcast_add (8x8 f32), jit-cache hit path"}
 
 
+def bench_engine_bulk(n_ops=64, shape=(256, 256), bulk=16):
+    """Lazy eager dispatch (engine.bulk): an N-op eager elementwise chain,
+    per-op dispatch vs fused multi-op jit segments.  Reports wall time per
+    chain, dispatches/step (N per-op jit calls vs <=N/bulk fused segment
+    dispatches), and steady-state segment compile misses (must be 0) —
+    the ISSUE 5 acceptance workload."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine, telemetry
+    from mxnet_tpu.engine import recorder
+
+    x = mx.nd.ones(shape)
+
+    def chain():
+        y = x
+        for _ in range(n_ops // 2):
+            y = y * 1.0001
+            y = y + 0.001
+        return y
+
+    rounds = int(os.environ.get("BENCH_ENGINE_ROUNDS", "5"))
+    iters = int(os.environ.get("BENCH_ENGINE_ITERS", "20"))
+
+    # warm both paths (per-op jit cache + segment cache)
+    chain().wait_to_read()
+    for _ in range(3):
+        with engine.bulk(bulk):
+            chain().wait_to_read()
+
+    def best_rate(f):
+        best = float("inf")
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                f().wait_to_read()
+            best = min(best, (time.perf_counter() - t0) / iters)
+        return best
+
+    was_on = telemetry.is_enabled()
+    try:
+        telemetry.disable()           # measure the production (off) cost
+        eager_s = best_rate(chain)
+
+        def bulked():
+            with engine.bulk(bulk):
+                return chain()
+
+        fused_s = best_rate(bulked)
+
+        # instrumented pass: dispatch + segment accounting, steady misses
+        telemetry.enable()
+        c0 = telemetry.snapshot()["counters"]
+        steps = 5
+        for _ in range(steps):
+            bulked().wait_to_read()
+        c1 = telemetry.snapshot()["counters"]
+    finally:
+        # an exception above must not leave the bus disabled for the
+        # configs (and the final diagnosis) that run after this one
+        if was_on:
+            telemetry.enable()
+        else:
+            telemetry.disable()
+    segs = (c1.get("dispatch.segments_flushed", 0)
+            - c0.get("dispatch.segments_flushed", 0)) / steps
+    fused_ops = (c1.get("dispatch.ops_fused", 0)
+                 - c0.get("dispatch.ops_fused", 0)) / steps
+    misses = (c1.get("dispatch.segment_compile_miss", 0)
+              - c0.get("dispatch.segment_compile_miss", 0))
+    return {
+        "op_chain": f"{n_ops}-op mul/add chain on {shape} f32",
+        "bulk_size": bulk,
+        "per_op": {"wall_us_per_chain": round(eager_s * 1e6, 1),
+                   "dispatches_per_step": n_ops},
+        "fused": {"wall_us_per_chain": round(fused_s * 1e6, 1),
+                  "segments_per_step": segs,
+                  "ops_fused_per_step": fused_ops},
+        "speedup": round(eager_s / fused_s, 2),
+        "steady_state_compile_misses": misses,
+        "segment_cache_entries": recorder.cache_info()[0],
+    }
+
+
 def _telemetry_summary():
     """The diagnosis sub-dict attached to the BENCH json: recompile count,
     collective bytes, io wait — the numbers that explain the throughput
@@ -1186,6 +1272,11 @@ def _telemetry_summary():
         "jit_cache_misses": c.get("dispatch.jit_cache_misses", 0),
         "jit_cache_hits": c.get("dispatch.jit_cache_hits", 0),
         "eager_op_calls": c.get("dispatch.op_calls", 0),
+        "engine_segments_flushed": c.get("dispatch.segments_flushed", 0),
+        "engine_ops_fused": c.get("dispatch.ops_fused", 0),
+        "engine_segment_compile_misses":
+            c.get("dispatch.segment_compile_miss", 0),
+        "engine_segment_cache_hits": c.get("dispatch.segment_cache_hits", 0),
         "backend_compiles": c.get("jax.compile_events", 0),
         "backend_compile_s": round(c.get("jax.compile_seconds", 0.0), 2),
         "collective_ops_per_step": g.get("trainer.collective_ops", 0),
@@ -1226,7 +1317,8 @@ def main():
     sel = [s.strip() for s in
            os.environ.get("BENCH_CONFIGS",
                           "headline,infer,fp32,amp,bert,ssd,int8,io,e2e,"
-                          "eager,optimizer,serving,resilience").split(",")]
+                          "eager,engine,optimizer,serving,resilience"
+                          ).split(",")]
     extra = {}
 
     # telemetry rides along for diagnosis (counters only — the configs
@@ -1310,6 +1402,11 @@ def main():
             extra["eager_dispatch"] = bench_eager_dispatch()
         except Exception as e:           # pragma: no cover
             extra["eager_dispatch"] = {"error": repr(e)}
+    if "engine" in sel:
+        try:
+            extra["engine_bulk"] = bench_engine_bulk()
+        except Exception as e:           # pragma: no cover
+            extra["engine_bulk"] = {"error": repr(e)}
     if "optimizer" in sel:
         try:
             extra["optimizer_update"] = bench_optimizer_update()

@@ -20,8 +20,12 @@
 #                train loop under MXNET_FAULTS-injected checkpoint-write
 #                crashes and one forced NaN step — exact loss parity with
 #                a fault-free run, bitwise-identical crash/resume
+#   engine     - lazy-dispatch bulking smoke: test_engine_bulk.py (fused
+#                vs eager parity + fallback matrix), then a telemetry
+#                parity pass under MXNET_ENGINE_BULK=16 (fused segments
+#                recorded, zero steady-state segment compile misses)
 # Usage: ci/run.sh [stage ...]   (default: unit gate telemetry optimizer
-#                                 serving resilience)
+#                                 serving resilience engine)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -259,8 +263,51 @@ print("resilience smoke ok: 20 steps, 2 injected save crashes absorbed,",
 PY
 }
 
+stage_engine() {
+  JAX_PLATFORMS=cpu python -m pytest tests/test_engine_bulk.py -q
+  JAX_PLATFORMS=cpu MXNET_ENGINE_BULK=16 MXNET_TELEMETRY=1 python - <<'PY'
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import engine, telemetry
+from mxnet_tpu.engine import recorder
+
+assert engine.bulk_size() == 16, "MXNET_ENGINE_BULK=16 must arm the thread"
+
+# parity: a mixed eager chain under env-armed bulking matches pure numpy
+x = mx.nd.array(np.linspace(-2, 2, 64, dtype="float32").reshape(8, 8))
+y = ((x * 2.0 + 1.0).relu() - 0.5) / 4.0
+z = (y + y.transpose()).sum()
+ref = np.linspace(-2, 2, 64, dtype="float32").reshape(8, 8)
+ref_y = (np.maximum(ref * 2.0 + 1.0, 0.0) - 0.5) / 4.0
+np.testing.assert_allclose(z.asnumpy(), (ref_y + ref_y.T).sum(), rtol=1e-6)
+
+# steady state: repeat the chain; segments replay from cache, zero misses
+def chain():
+    y = x
+    for _ in range(32):
+        y = y * 1.0001 + 0.001
+    return y
+chain().wait_to_read()                       # compile the segment once
+c0 = telemetry.snapshot()["counters"]
+for _ in range(10):
+    chain().wait_to_read()
+c1 = telemetry.snapshot()["counters"]
+misses = (c1.get("dispatch.segment_compile_miss", 0)
+          - c0.get("dispatch.segment_compile_miss", 0))
+segs = (c1.get("dispatch.segments_flushed", 0)
+        - c0.get("dispatch.segments_flushed", 0))
+fused = c1.get("dispatch.ops_fused", 0) - c0.get("dispatch.ops_fused", 0)
+assert misses == 0, f"steady-state segment compile misses: {misses}"
+assert segs == 40 and fused == 640, (segs, fused)   # 64 ops -> 4 segments
+print("engine smoke ok: 64-op chain -> 4 fused segments/step,",
+      f"{misses} steady-state compile misses,",
+      f"{recorder.cache_info()[0]} cached programs")
+PY
+}
+
 stages=("$@")
-[ $# -eq 0 ] && stages=(unit gate telemetry optimizer serving resilience)
+[ $# -eq 0 ] && stages=(unit gate telemetry optimizer serving resilience
+                        engine)
 for s in "${stages[@]}"; do
   echo "=== ci stage: $s ==="
   "stage_$s"

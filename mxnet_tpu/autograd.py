@@ -43,6 +43,14 @@ def is_training():
 
 def set_recording(flag):
     prev = _st().recording
+    if flag and not prev:
+        # Entering a record scope is a lazy-engine segment boundary: the
+        # tape stores concrete raw inputs per op, so anything still pending
+        # from an enclosing ``engine.bulk`` scope must materialize first —
+        # gradients are then identical with or without bulking.
+        from .engine import recorder as _eng_rec
+        if _eng_rec.ever_bulked:
+            _eng_rec.flush()
     _state.recording = bool(flag)
     return prev
 

@@ -1,0 +1,84 @@
+"""Engine control surface (reference ``python/mxnet/engine.py`` —
+``bulk``/``set_bulk_size`` batch engine ops to amortize dispatch).
+
+TPU-native: ``bulk`` is now a REAL lazy-dispatch scope, not an observable
+no-op.  With a positive bulk size (``engine.bulk(N)`` scope,
+``engine.set_bulk_size(N)``, or ``MXNET_ENGINE_BULK=N`` in the environment)
+eager NDArray ops stop executing one jitted call at a time: each capturable
+op is appended to a per-thread segment recorder and its result carries a
+pending handle; the segment flushes as ONE fused, donated ``jax.jit``
+program when the scope exits, the segment reaches the bulk size, or any
+materialization forces it (see ``engine/recorder.py`` for the recorder and
+the full fallback matrix, ``docs/engine.md`` for the design).
+
+Off by default: with bulk size 0 (the default on every thread) the eager
+dispatch path is byte-identical to the pre-recorder build.  State is
+per-thread — serving workers and io decode threads never inherit or clobber
+the main thread's scope; each new thread starts from the env default.
+
+The ``engine.bulk`` telemetry span reports the requested size, the eager
+ops dispatched inside the scope, and the segments/fused-op counts the
+recorder produced.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..telemetry import bus as _tel
+from . import recorder
+from .recorder import LazyData, flush  # noqa: F401  (re-exported surface)
+
+__all__ = ["set_bulk_size", "bulk", "bulk_size", "flush", "LazyData"]
+
+
+def set_bulk_size(size):
+    """Reference ``engine.py:set_bulk_size``; returns the previous value.
+
+    Per-thread: only the calling thread's dispatch policy changes.  Any
+    pending segment is flushed first — a recorded segment never straddles
+    a policy change."""
+    size = max(int(size), 0)
+    st = recorder._tls
+    prev = st.bulk_size
+    recorder.flush()
+    st.bulk_size = size
+    if size > 0:
+        recorder.ever_bulked = True
+    if _tel.enabled:
+        _tel.count("engine.set_bulk_size_calls")
+        _tel.gauge("engine.bulk_size", size)
+    return prev
+
+
+def bulk_size():
+    """The calling thread's current bulk size (0 = lazy dispatch off)."""
+    return recorder._tls.bulk_size
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Reference ``engine.py:bulk`` scope — ops inside dispatch lazily in
+    fused segments of up to ``size`` ops; everything is flushed by scope
+    exit, so code after the scope always sees materialized values."""
+    prev = set_bulk_size(size)
+    sp = _tel.span("engine.bulk", size=int(size))
+    # Either endpoint of the op-counter delta can be unavailable when
+    # telemetry is toggled mid-scope (entry disabled/exit enabled or vice
+    # versa) — report ops_in_scope only when BOTH ends were observed, and
+    # clamp at 0 (a mid-scope reset() makes the exit total smaller).
+    ops0 = _tel.counter_value("dispatch.op_calls") if _tel.enabled else None
+    segs0, fused0 = recorder.thread_stats()
+    try:
+        with sp:
+            yield
+            recorder.flush()
+            ops1 = (_tel.counter_value("dispatch.op_calls")
+                    if _tel.enabled else None)
+            if ops0 is not None and ops1 is not None:
+                sp.set(ops_in_scope=max(int(ops1) - int(ops0), 0))
+            segs1, fused1 = recorder.thread_stats()
+            sp.set(segments=segs1 - segs0, fused_ops=fused1 - fused0)
+    finally:
+        recorder.flush()     # exception path: nothing stays pending
+        _tel.count("engine.bulk_scopes")
+        set_bulk_size(prev)

@@ -30,8 +30,11 @@ import jax.numpy as jnp
 from .. import autograd as _ag
 from ..base import np_dtype, bfloat16  # noqa: F401
 from ..context import Context, current_context, context_from_jax_device
+from ..engine import recorder as _eng
 from ..ops import registry as _reg
 from ..telemetry import bus as _tel
+
+_LazyData = _eng.LazyData
 
 
 def _to_jax_device(ctx):
@@ -70,7 +73,7 @@ class NDArray:
     @property
     def context(self):
         try:
-            devs = list(self._data.devices())
+            devs = list(self._materialize().devices())
         except jax.errors.ConcretizationTypeError:
             # traced value (inside jit/scan): placement is the compiler's,
             # report the ambient default context
@@ -95,20 +98,30 @@ class NDArray:
     @property
     def data(self):
         """The underlying jax.Array (TPU-native escape hatch)."""
-        return self._data
+        return self._materialize()
 
     # ------------------------------------------------------------- sync/query
+    def _materialize(self):
+        """Concrete backing array: flush the owning lazy segment (if the
+        handle is pending) and rebind.  The single forcing point every
+        sync/escape path funnels through."""
+        d = self._data
+        if type(d) is _LazyData:
+            d = d.force()
+            self._data = d
+        return d
+
     def wait_to_read(self):
         """Reference ``NDArray::WaitToRead`` (``ndarray.h:372``)."""
-        jax.block_until_ready(self._data)
+        jax.block_until_ready(self._materialize())
         return self
 
     def wait_to_write(self):
-        jax.block_until_ready(self._data)
+        jax.block_until_ready(self._materialize())
         return self
 
     def asnumpy(self):
-        return _np.asarray(self._data)
+        return _np.asarray(self._materialize())
 
     def asscalar(self):
         if self.size != 1:
@@ -156,13 +169,14 @@ class NDArray:
         """Copy into ``other`` (NDArray or Context) — reference
         ``ndarray.h`` CopyTo; cross-device copies are ``device_put``."""
         if isinstance(other, Context):
-            return NDArray(jax.device_put(self._data, _to_jax_device(other)))
+            return NDArray(jax.device_put(self._materialize(),
+                                          _to_jax_device(other)))
         if isinstance(other, NDArray):
-            dat = self._data
+            dat = self._materialize()
             converted = dat.dtype != other._data.dtype
             if converted:
                 dat = dat.astype(other._data.dtype)
-            target = list(other._data.devices())[0]
+            target = list(other._materialize().devices())[0]
             if not converted and target in dat.devices():
                 # same-device device_put would ALIAS the source buffer
                 # (reference CopyFromTo always copies): a genuine copy keeps
@@ -177,7 +191,8 @@ class NDArray:
     def as_in_context(self, ctx):
         if ctx == self.context:
             return self
-        return NDArray(jax.device_put(self._data, _to_jax_device(ctx)))
+        return NDArray(jax.device_put(self._materialize(),
+                                      _to_jax_device(ctx)))
 
     as_in_ctx = as_in_context
 
@@ -204,7 +219,7 @@ class NDArray:
         cls = {"csr": CSRNDArray, "row_sparse": RowSparseNDArray}.get(stype)
         if cls is None:
             raise ValueError(f"unknown storage type {stype!r}")
-        return cls(self._data)
+        return cls(self._materialize())
 
     # --------------------------------------------------------------- autograd
     def attach_grad(self, grad_req="write", stype=None):
@@ -247,7 +262,7 @@ class NDArray:
         key = _index_key(key, self.shape)
         if _ag.is_recording() and self._ag_node is not None:
             return invoke_fn(lambda x: x[key], [self], op_name="_slice")
-        return _wrap(self._data[key])
+        return _wrap(self._materialize()[key])
 
     def __setitem__(self, key, value):
         key = _index_key(key, self.shape)
@@ -265,8 +280,8 @@ class NDArray:
             self._invalidate_views()
             return
         if isinstance(value, NDArray):
-            value = value._data
-        self._data = self._data.at[key].set(value)
+            value = value._materialize()
+        self._data = self._materialize().at[key].set(value)
         self._invalidate_views()
 
     def slice(self, begin, end, step=None):
@@ -722,25 +737,42 @@ def _log_operands(nd_inputs, nd_outs):
         _OPERAND_LOG["made"].extend(nd_outs)
 
 
-def invoke(op, nd_inputs, attrs, out=None):
+def invoke(op, nd_inputs, attrs, out=None, bulk=True):
     nd_inputs = [x if isinstance(x, NDArray) else _as_nd(x) for x in nd_inputs]
     if any(isinstance(v, NDArray) for v in attrs.values()):
         # optional tensor parameters passed by keyword (e.g.
         # ``SequenceLast(x, sequence_length=sl)``) route through attrs —
         # kernels take raw arrays, so unwrap (reference ops declare these
         # as optional inputs, not params)
-        attrs = {k: (v._data if isinstance(v, NDArray) else v)
+        attrs = {k: (v._materialize() if isinstance(v, NDArray) else v)
                  for k, v in attrs.items()}
     raw = [x._data for x in nd_inputs]
-    if _AMP_HOOK is not None:
-        raw = _AMP_HOOK(op, raw)
-    result = _call_op(op, raw, attrs)
-    single = not isinstance(result, (tuple, list))
-    outs = [result] if single else list(result)
-    nd_outs = [_wrap(r) for r in outs]
-    _log_operands(nd_inputs, nd_outs)
-    if _ag.is_recording():
-        _ag.record_op(op.fn, attrs, nd_inputs, raw, nd_outs, out_tuple=not single)
+    nd_outs = None
+    if _eng.ever_bulked:
+        # Lazy bulking (reference engine op bulking, src/engine/): record
+        # instead of execute.  Capture only on the plain imperative path —
+        # autograd recording, AMP rewrites, operand probes and writeback
+        # ops (bulk=False) all need concrete values NOW.
+        if (bulk and _eng._tls.bulk_size > 0 and _AMP_HOOK is None
+                and _OPERAND_LOG is None and not _ag.is_recording()):
+            rec = _eng.try_record(op, nd_inputs, raw, attrs)
+            if rec is not None:
+                nd_outs, single = rec
+        if nd_outs is None and any(type(r) is _LazyData for r in raw):
+            # eager dispatch of an op consuming pending values: force them
+            # (flushes the owning segments) before calling the kernel
+            raw = [r.force() if type(r) is _LazyData else r for r in raw]
+    if nd_outs is None:
+        if _AMP_HOOK is not None:
+            raw = _AMP_HOOK(op, raw)
+        result = _call_op(op, raw, attrs)
+        single = not isinstance(result, (tuple, list))
+        outs = [result] if single else list(result)
+        nd_outs = [_wrap(r) for r in outs]
+        _log_operands(nd_inputs, nd_outs)
+        if _ag.is_recording():
+            _ag.record_op(op.fn, attrs, nd_inputs, raw, nd_outs,
+                          out_tuple=not single)
     if out is not None:
         if isinstance(out, NDArray):
             out._data = nd_outs[0]._data
@@ -761,7 +793,7 @@ def invoke_fn(fn, nd_inputs, attrs=None, op_name=None):
         _tel.count("dispatch.fn_calls", op=op_name or getattr(
             fn, "__name__", "<fn>"))
     nd_inputs = [x if isinstance(x, NDArray) else _as_nd(x) for x in nd_inputs]
-    raw = [x._data for x in nd_inputs]
+    raw = [x._materialize() for x in nd_inputs]
     result = fn(*raw, **attrs)
     single = not isinstance(result, (tuple, list))
     outs = [result] if single else list(result)
@@ -826,11 +858,11 @@ def full(shape, val, ctx=None, dtype=None):
 
 
 def zeros_like(other, **kwargs):
-    return NDArray(jnp.zeros_like(other._data))
+    return NDArray(jnp.zeros_like(other._materialize()))
 
 
 def ones_like(other, **kwargs):
-    return NDArray(jnp.ones_like(other._data))
+    return NDArray(jnp.ones_like(other._materialize()))
 
 
 def arange(start, stop=None, step=1.0, repeat=1, infer_range=False,
@@ -856,11 +888,13 @@ def stack(*arrays, axis=0):
 
 
 def moveaxis(tensor, source, destination):
-    return _wrap(jnp.moveaxis(tensor._data, source, destination))
+    return _wrap(jnp.moveaxis(tensor._materialize(), source, destination))
 
 
 def waitall():
-    """Reference ``mx.nd.waitall`` ≙ ``Engine::WaitForAll``."""
+    """Reference ``mx.nd.waitall`` ≙ ``Engine::WaitForAll`` — flushes the
+    calling thread's pending lazy segment, then drains jax effects."""
+    _eng.flush()
     try:
         jax.effects_barrier()
     except Exception:

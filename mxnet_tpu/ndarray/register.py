@@ -154,8 +154,14 @@ def make_op_func(op):
         raw_in = list(nd_inputs)
         if stochastic:
             raw_in = [_wrap(_rnd.next_key())] + raw_in
+        # writeback ops (optimizer in-place updates, BatchNorm aux-state
+        # moving averages) rebind input buffers from the op's outputs right
+        # here — they need concrete results NOW, so the lazy-bulking
+        # recorder must not capture them (engine/recorder.py fallback
+        # matrix)
         result = invoke(op, raw_in, attrs,
-                        out=None if (writeback or is_bn) else out)
+                        out=None if (writeback or is_bn) else out,
+                        bulk=not (writeback or is_bn))
         if is_bn:
             from ..base import parse_bool
             outs = result if isinstance(result, list) else [result]

@@ -94,6 +94,12 @@ class _Rule:
     within float tolerance (asserted by tests/test_optimizer_aggregate.py).
     """
 
+    #: True when ``extras`` replays a host-side recurrence whose snapshots
+    #: depend on the ORDER members are processed in (Nadam's m_schedule).
+    #: Such a rule only aggregates when every member lands in one group
+    #: with no fallbacks — any split would permute the per-param order.
+    order_sensitive = False
+
     def signature(self, opt):
         return (_has_clip(opt),)
 
@@ -109,7 +115,16 @@ class _Rule:
         per-param path folds the correction into lr, e.g. Adam)."""
         return opt._get_lrs(indices)
 
-    def step(self, w, g, state, lr, wd, hyper, sig):
+    def extras(self, opt, indices):
+        """Optional per-member traced scalars beyond lr/wd (a tuple of
+        floats per member, fixed arity per rule) — how Nadam's
+        host-side momentum schedule rides into the jitted group without
+        recompiling.  This hook may mutate optimizer bookkeeping exactly
+        like the per-param ``update`` would (member order = list order).
+        None means the rule needs no extras."""
+        return None
+
+    def step(self, w, g, state, lr, wd, hyper, sig, extra=()):
         raise NotImplementedError
 
 
@@ -126,7 +141,7 @@ class _SGDRule(_Rule):
         has_mom, _ = sig
         return 1 if has_mom else 0
 
-    def step(self, w, g, state, lr, wd, hyper, sig):
+    def step(self, w, g, state, lr, wd, hyper, sig, extra=()):
         has_mom, has_clip = sig
         g = _clip(g * hyper["rescale_grad"], hyper, has_clip) + wd * w
         if has_mom:
@@ -149,7 +164,7 @@ class _NAGRule(_Rule):
         has_mom, _ = sig
         return 1 if has_mom else 0
 
-    def step(self, w, g, state, lr, wd, hyper, sig):
+    def step(self, w, g, state, lr, wd, hyper, sig, extra=()):
         has_mom, has_clip = sig
         g = _clip(g * hyper["rescale_grad"], hyper, has_clip) + wd * w
         if has_mom:
@@ -174,7 +189,7 @@ class _SignumRule(_Rule):
         has_mom, _ = sig
         return 1 if has_mom else 0
 
-    def step(self, w, g, state, lr, wd, hyper, sig):
+    def step(self, w, g, state, lr, wd, hyper, sig, extra=()):
         has_mom, has_clip = sig
         g = _clip(g * hyper["rescale_grad"], hyper, has_clip)
         if has_mom:
@@ -206,7 +221,7 @@ class _AdamRule(_Rule):
                        / (1. - opt.beta1 ** t))
         return out
 
-    def step(self, w, g, state, lr, wd, hyper, sig):
+    def step(self, w, g, state, lr, wd, hyper, sig, extra=()):
         (has_clip,) = sig
         mean, var = state
         b1, b2 = hyper["beta1"], hyper["beta2"]
@@ -233,7 +248,7 @@ class _RMSPropRule(_Rule):
         centered, _, _ = sig
         return 3 if centered else 1
 
-    def step(self, w, g, state, lr, wd, hyper, sig):
+    def step(self, w, g, state, lr, wd, hyper, sig, extra=()):
         centered, has_clip, has_cw = sig
         gr = _clip(g * hyper["rescale_grad"], hyper, has_clip) + wd * w
         g1 = hyper["gamma1"]
@@ -257,6 +272,82 @@ class _RMSPropRule(_Rule):
         return new_w, (new_n,)
 
 
+class _AdamaxRule(_Rule):
+    def hyper(self, opt):
+        h = super().hyper(opt)
+        h.update(beta1=float(opt.beta1), beta2=float(opt.beta2))
+        return h
+
+    def state_arity(self, sig):
+        return 2
+
+    def lrs(self, opt, indices):
+        # per-param path folds the infinity-norm bias correction into lr
+        # with the per-index step count t (optimizer.py Adamax.update)
+        out = []
+        for lr, i in zip(opt._get_lrs(indices), indices):
+            t = opt._index_update_count[i]
+            out.append(lr / (1. - opt.beta1 ** t))
+        return out
+
+    def step(self, w, g, state, lr, wd, hyper, sig, extra=()):
+        (has_clip,) = sig
+        m, u = state
+        b1 = hyper["beta1"]
+        # per-param order (_begin_update): rescale, clip, THEN wd
+        g = _clip(g * hyper["rescale_grad"], hyper, has_clip) + wd * w
+        new_m = b1 * m + (1. - b1) * g
+        new_u = jnp.maximum(hyper["beta2"] * u, jnp.abs(g))
+        return w - lr * new_m / new_u, (new_m, new_u)
+
+
+class _NadamRule(_Rule):
+    order_sensitive = True
+
+    def hyper(self, opt):
+        h = super().hyper(opt)
+        h.update(beta1=float(opt.beta1), beta2=float(opt.beta2),
+                 epsilon=float(opt.epsilon))
+        return h
+
+    def state_arity(self, sig):
+        return 2
+
+    def extras(self, opt, indices):
+        """Per-member momentum-schedule scalars.  The per-param path
+        multiplies ``opt.m_schedule`` once per parameter per update —
+        replicate that recurrence (including the mutation) host-side, in
+        member order, and hand each member its own snapshot as traced
+        arguments so the schedule never recompiles the group."""
+        out = []
+        b1, sd = opt.beta1, opt.schedule_decay
+        for i in indices:
+            t = opt._index_update_count[i]
+            momentum_t = b1 * (1. - 0.5 * (0.96 ** (t * sd)))
+            momentum_t_1 = b1 * (1. - 0.5 * (0.96 ** ((t + 1) * sd)))
+            opt.m_schedule = opt.m_schedule * momentum_t
+            out.append((momentum_t, momentum_t_1, opt.m_schedule,
+                        opt.m_schedule * momentum_t_1,
+                        1. - opt.beta2 ** t))
+        return out
+
+    def step(self, w, g, state, lr, wd, hyper, sig, extra=()):
+        (has_clip,) = sig
+        m, v = state
+        mom_t, mom_t_1, m_sched, m_sched_next, v_corr = extra
+        b1, b2 = hyper["beta1"], hyper["beta2"]
+        # per-param order (Nadam.update): rescale + wd, THEN clip
+        g = _clip(g * hyper["rescale_grad"] + wd * w, hyper, has_clip)
+        new_m = b1 * m + (1. - b1) * g
+        new_v = b2 * v + (1. - b2) * g * g
+        g_prime = g / (1. - m_sched)
+        m_prime = new_m / (1. - m_sched_next)
+        v_prime = new_v / v_corr
+        m_bar = (1. - mom_t) * g_prime + mom_t_1 * m_prime
+        return w - lr * m_bar / (jnp.sqrt(v_prime) + hyper["epsilon"]), \
+            (new_m, new_v)
+
+
 class _AdaGradRule(_Rule):
     def hyper(self, opt):
         h = super().hyper(opt)
@@ -266,7 +357,7 @@ class _AdaGradRule(_Rule):
     def state_arity(self, sig):
         return 1
 
-    def step(self, w, g, state, lr, wd, hyper, sig):
+    def step(self, w, g, state, lr, wd, hyper, sig, extra=()):
         (has_clip,) = sig
         (history,) = state
         g = _clip(g * hyper["rescale_grad"], hyper, has_clip)
@@ -279,13 +370,16 @@ def _rules():
     """Exact-class rule table, built lazily to dodge the import cycle with
     optimizer.py.  Exact ``type()`` match only: a subclass may override
     ``update`` and must keep the per-parameter path."""
-    from .optimizer import SGD, NAG, Adam, AdaGrad, RMSProp, Signum
+    from .optimizer import (SGD, NAG, Adam, AdaGrad, Adamax, Nadam, RMSProp,
+                            Signum)
     return {SGD: ("sgd", _SGDRule()),
             NAG: ("nag", _NAGRule()),
             Signum: ("signum", _SignumRule()),
             Adam: ("adam", _AdamRule()),
             RMSProp: ("rmsprop", _RMSPropRule()),
-            AdaGrad: ("adagrad", _AdaGradRule())}
+            AdaGrad: ("adagrad", _AdaGradRule()),
+            Adamax: ("adamax", _AdamaxRule()),
+            Nadam: ("nadam", _NadamRule())}
 
 
 _RULES = None
@@ -320,17 +414,20 @@ def _build_group_fn(rule, sig, mp):
     matching the reference engine's in-place write-dependency model.  Grads
     are NOT donated (callers may inspect or re-reduce them)."""
 
-    def group_update(weights, grads, states, lrs, wds, hyper):
+    def group_update(weights, grads, states, lrs, wds, extras, hyper):
         new_ws, new_ss = [], []
-        for w, g, s, lr, wd in zip(weights, grads, states, lrs, wds):
+        for w, g, s, lr, wd, ex in zip(weights, grads, states, lrs, wds,
+                                       extras):
             if mp:
                 master, inner = s[0], tuple(s[1:])
                 new_master, new_inner = rule.step(
-                    master, g.astype(jnp.float32), inner, lr, wd, hyper, sig)
+                    master, g.astype(jnp.float32), inner, lr, wd, hyper,
+                    sig, ex)
                 new_ws.append(new_master.astype(w.dtype))
                 new_ss.append([new_master] + list(new_inner))
             else:
-                new_w, new_s = rule.step(w, g, tuple(s), lr, wd, hyper, sig)
+                new_w, new_s = rule.step(w, g, tuple(s), lr, wd, hyper,
+                                         sig, ex)
                 new_ws.append(new_w)
                 new_ss.append(list(new_s))
         return new_ws, new_ss
@@ -417,6 +514,17 @@ def update_multi(opt, indices, weights, grads, states):
             groups.setdefault(key, []).append((pos, leaves))
     else:
         fallback = list(range(len(weights)))
+
+    if (groups and rule_entry[1].order_sensitive
+            and (fallback or len(groups) > 1)):
+        # Nadam's m_schedule snapshots depend on processing ORDER: the
+        # per-param reference walks members in caller index order, which
+        # multiple groups (e.g. mixed fp32 + fp16-mp params) or
+        # interleaved fallbacks would permute.  A single group keeps
+        # ascending position order across its chunks; anything else must
+        # take the per-param path wholesale to replicate exactly.
+        fallback = list(range(len(weights)))
+        groups = {}
 
     tel_on = _tel.enabled
     n_dispatch = 0
@@ -540,6 +648,9 @@ def _run_group(opt, name, rule, sig, mp, chunk, indices, weights, grads,
     opt._update_count(idxs)
     lrs = [float(lr) for lr in rule.lrs(opt, idxs)]
     wds = [float(wd) for wd in opt._get_wds(idxs)]
+    extras = rule.extras(opt, idxs)
+    if extras is None:
+        extras = [()] * len(idxs)
     hyper = rule.hyper(opt)
 
     w_data = [w._data for w in ws]
@@ -558,7 +669,7 @@ def _run_group(opt, name, rule, sig, mp, chunk, indices, weights, grads,
                          shapes=repr([m[0] for m in cache_key[3]]))
 
     with _tel.span("optimizer.update_group", opt=name, n=len(ws), mp=mp):
-        new_w, new_s = fn(w_data, g_data, s_data, lrs, wds, hyper)
+        new_w, new_s = fn(w_data, g_data, s_data, lrs, wds, extras, hyper)
 
     # rebind in place: same NDArray handles, fresh (donated) buffers —
     # the frontend analog of the engine writing through WriteTo vars

@@ -1,0 +1,406 @@
+"""Lazy eager dispatch with fused multi-op jit segments (ISSUE 5 tentpole):
+parity fused-vs-eager, flush on every sync point, the fallback matrix,
+autograd-unchanged-gradients, per-thread bulk state, zero steady-state
+segment compile misses, and the engine.bulk telemetry span."""
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, nd, telemetry
+from mxnet_tpu.engine import recorder
+
+
+@pytest.fixture(autouse=True)
+def _clean_engine():
+    telemetry.disable()
+    telemetry.reset()
+    engine.set_bulk_size(0)
+    yield
+    engine.set_bulk_size(0)
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _rand(shape, seed=0):
+    return np.random.RandomState(seed).rand(*shape).astype(np.float32)
+
+
+# --------------------------------------------------------------------- parity
+def _unary_chain(x):
+    """Op chain with no mul→add adjacency: XLA cannot FMA-contract it, so
+    fused and per-op programs are bit-identical (see docs/engine.md on
+    float contraction)."""
+    y = x.tanh()
+    y = y.relu()
+    y = y.exp()
+    y = y.sigmoid()
+    y = -y
+    y = y.abs()
+    y = y.sqrt()
+    return y
+
+
+def test_bitwise_parity_unary_chain():
+    x = nd.array(_rand((16, 16)))
+    ref = _unary_chain(x).asnumpy()
+    with engine.bulk(4):
+        out = _unary_chain(x)
+    assert np.array_equal(ref, out.asnumpy())
+
+
+def test_bitwise_parity_binary_and_reduction():
+    a = nd.array(_rand((8, 12), 1))
+    b = nd.array(_rand((8, 12), 2))
+
+    def f():
+        y = a + b
+        y = y - 0.5
+        y = nd.maximum(y, a)
+        s = y.sum(axis=1)
+        return s + 1.0
+
+    ref = f().asnumpy()
+    with engine.bulk(16):
+        out = f()
+    assert np.array_equal(ref, out.asnumpy())
+
+
+def test_mul_add_chain_matches_within_contraction_tolerance():
+    """A mul feeding an add inside ONE fused program may be contracted to
+    an FMA by XLA (documented in docs/engine.md) — values agree to float32
+    resolution, not necessarily bitwise."""
+    x = nd.array(_rand((32, 32), 3))
+
+    def f():
+        y = x
+        for _ in range(8):
+            y = y * 1.0001
+            y = y + 0.001
+        return y
+
+    ref = f().asnumpy()
+    with engine.bulk(16):
+        out = f()
+    np.testing.assert_allclose(ref, out.asnumpy(), rtol=2e-6, atol=1e-7)
+
+
+def test_multi_output_op_inside_bulk():
+    x = nd.array(_rand((6, 4), 4))
+    ref = nd.topk(x, k=2, ret_typ="both")
+    ref = [r.asnumpy() for r in ref]
+    with engine.bulk(8):
+        out = nd.topk(x, k=2, ret_typ="both")
+    for r, o in zip(ref, out):
+        assert np.array_equal(r, o.asnumpy())
+
+
+# ------------------------------------------------------------- sync points
+def _pending(x):
+    return type(x._data) is recorder.LazyData
+
+
+def test_flush_on_every_sync_point():
+    x = nd.array(_rand((4, 4)))
+    syncs = [
+        ("asnumpy", lambda y: y.asnumpy()),
+        ("item", lambda y: y.sum().item()),
+        ("wait_to_read", lambda y: y.wait_to_read()),
+        ("bool", lambda y: bool(y.sum() > 0)),
+        ("getitem", lambda y: y[0]),
+        ("repr", lambda y: repr(y)),
+        ("int", lambda y: int(y.sum())),
+        ("dlpack", lambda y: y.to_dlpack_for_read()),
+        ("waitall", lambda y: nd.waitall()),
+    ]
+    for name, sync in syncs:
+        with engine.bulk(64):
+            y = x * 2.0
+            y = y + 1.0
+            assert _pending(y), name
+            sync(y)
+            assert not _pending(y), f"{name} must force the flush"
+            np.testing.assert_allclose(
+                y.asnumpy(), x.asnumpy() * 2.0 + 1.0, rtol=1e-6)
+
+
+def test_scope_exit_flushes():
+    x = nd.array(_rand((4, 4)))
+    with engine.bulk(64):
+        y = x * 3.0
+        assert _pending(y)
+    assert not _pending(y)
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() * 3.0, rtol=1e-6)
+
+
+def test_setitem_on_pending_and_mutated_input_snapshot():
+    """In-place writes interleaved with pending ops: a recorded op sees the
+    input VALUE at record time (immutable snapshot), like the reference
+    engine's read-dependency on the pushed version."""
+    x = nd.array(np.ones((4,), np.float32))
+    with engine.bulk(64):
+        y = x * 2.0              # records x's current buffer
+        x[:] = 0.0               # rebinds x after the snapshot
+        z = y + 1.0
+    np.testing.assert_allclose(y.asnumpy(), 2.0)
+    np.testing.assert_allclose(z.asnumpy(), 3.0)
+    np.testing.assert_allclose(x.asnumpy(), 0.0)
+
+
+def test_inplace_arithmetic_inside_bulk():
+    x = nd.array(np.ones((8,), np.float32))
+    with engine.bulk(64):
+        x += 1.0
+        x *= 3.0
+        x -= 2.0
+    np.testing.assert_allclose(x.asnumpy(), 4.0)
+
+
+# ---------------------------------------------------------- fallback matrix
+def test_optimizer_update_op_falls_back():
+    """In-place optimizer update ops (register.py writeback) execute
+    eagerly — their input rebinding needs concrete outputs now."""
+    w = nd.array(np.ones((4,), np.float32))
+    g = nd.array(np.full((4,), 0.5, np.float32))
+    with engine.bulk(64):
+        y = w * 1.0              # pending op feeding the update
+        nd.sgd_update(w, g, lr=0.1, out=w)
+        assert not _pending(w)
+    np.testing.assert_allclose(w.asnumpy(), 1.0 - 0.05, rtol=1e-6)
+    np.testing.assert_allclose(y.asnumpy(), 1.0)
+
+
+def test_sparse_operand_falls_back():
+    from mxnet_tpu.ndarray import sparse as sp
+    rs = sp.row_sparse_array(
+        (np.ones((2, 3), np.float32), np.array([0, 2])), shape=(4, 3))
+    with engine.bulk(64):
+        d = rs.tostype("default")
+        y = d * 2.0
+    np.testing.assert_allclose(y.asnumpy()[0], 2.0)
+    np.testing.assert_allclose(y.asnumpy()[1], 0.0)
+
+
+def test_array_valued_attr_falls_back():
+    """Ops routing tensors through attrs (unhashable) are uncapturable."""
+    x = nd.array(_rand((3, 4, 5)))
+    sl = nd.array(np.array([2, 3, 1], np.float32))
+    ref = nd.SequenceLast(x.swapaxes(0, 1), sequence_length=sl,
+                          use_sequence_length=True).asnumpy()
+    with engine.bulk(64):
+        out = nd.SequenceLast(x.swapaxes(0, 1), sequence_length=sl,
+                              use_sequence_length=True)
+    np.testing.assert_allclose(ref, out.asnumpy(), rtol=1e-6)
+
+
+def test_cross_device_inputs():
+    import jax
+    devs = jax.devices("cpu")
+    if len(devs) < 2:
+        pytest.skip("needs the virtual multi-device CPU mesh")
+    a = nd.NDArray(jax.device_put(_rand((4,), 5), devs[0]))
+    b = nd.NDArray(jax.device_put(_rand((4,), 6), devs[1]))
+    ref = (a + b.as_in_context(a.context)).asnumpy()
+    with engine.bulk(64):
+        out = a + b.as_in_context(a.context)
+    np.testing.assert_allclose(ref, out.asnumpy(), rtol=1e-6)
+
+
+def test_stochastic_op_inside_bulk_uses_key_stream():
+    mx.random.seed(7)
+    ref = nd.random_normal(shape=(5,)).asnumpy()
+    mx.random.seed(7)
+    with engine.bulk(64):
+        out = nd.random_normal(shape=(5,))
+    np.testing.assert_allclose(ref, out.asnumpy(), rtol=1e-6)
+
+
+def test_batchnorm_writeback_is_eager():
+    x = nd.array(_rand((4, 3), 8))
+    gamma, beta = nd.ones((3,)), nd.zeros((3,))
+    mmean, mvar = nd.zeros((3,)), nd.ones((3,))
+    with mx.autograd.train_mode():
+        with engine.bulk(64):
+            out = nd.BatchNorm(x, gamma, beta, mmean, mvar)
+            assert not _pending(out)
+    assert not np.allclose(mmean.asnumpy(), 0.0)   # aux state updated
+
+
+# ------------------------------------------------------------------ autograd
+def test_autograd_grads_identical_inside_bulk():
+    w_np = _rand((3, 4), 9)
+
+    def run(bulked):
+        w = nd.array(w_np)
+        w.attach_grad()
+        if bulked:
+            with engine.bulk(32):
+                pre = w * 1.5            # pending before the tape starts
+                with mx.autograd.record():
+                    loss = ((w * 2.0 + 1.0) ** 2).sum()
+                loss.backward()
+        else:
+            with mx.autograd.record():
+                loss = ((w * 2.0 + 1.0) ** 2).sum()
+            loss.backward()
+        return w.grad.asnumpy(), float(loss.asnumpy())
+
+    g_ref, l_ref = run(False)
+    g_bulk, l_bulk = run(True)
+    assert np.array_equal(g_ref, g_bulk)
+    assert l_ref == l_bulk
+
+
+def test_record_entry_flushes_pending_segment():
+    x = nd.array(_rand((4,), 10))
+    with engine.bulk(64):
+        y = x * 2.0
+        assert _pending(y)
+        with mx.autograd.record():
+            assert not _pending(y)       # record boundary forced the flush
+
+
+# ------------------------------------------------------- per-thread state
+def test_bulk_state_is_per_thread():
+    engine.set_bulk_size(16)
+    seen = {}
+
+    def worker():
+        seen["initial"] = engine.bulk_size()
+        engine.set_bulk_size(99)
+        seen["after_set"] = engine.bulk_size()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert seen["initial"] == 0          # env default, not main's 16
+    assert seen["after_set"] == 99
+    assert engine.bulk_size() == 16      # worker didn't clobber main
+    engine.set_bulk_size(0)
+
+
+def test_cross_thread_consumption_forces_flush():
+    x = nd.array(_rand((4,), 11))
+    segs0, fused0 = recorder.thread_stats()
+    with engine.bulk(64):
+        y = x * 2.0
+        assert _pending(y)
+        result = {}
+
+        def consumer():
+            result["val"] = y.asnumpy()
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        t.join()
+        # the consumer-forced flush must clear the OWNER's pending pointer
+        # (else the flushed segment pins its buffers until the owner
+        # records again) and attribute the stats to the owner thread
+        assert recorder._tls.segment is None
+        segs1, fused1 = recorder.thread_stats()
+        assert (segs1 - segs0, fused1 - fused0) == (1, 1)
+    np.testing.assert_allclose(result["val"], x.asnumpy() * 2.0, rtol=1e-6)
+
+
+# ----------------------------------------------------- caching + telemetry
+def test_zero_steady_state_compile_misses():
+    telemetry.enable()
+    x = nd.array(_rand((8, 8), 12))
+
+    def loop():
+        with engine.bulk(8):
+            y = x
+            for _ in range(8):
+                y = y * 1.01
+                y = y + 0.1
+        y.wait_to_read()
+
+    loop()                       # warmup compiles the segment signatures
+    m0 = telemetry.counter_value("dispatch.segment_compile_miss")
+    h0 = telemetry.counter_value("dispatch.segment_cache_hits")
+    for _ in range(5):
+        loop()
+    assert telemetry.counter_value("dispatch.segment_compile_miss") == m0
+    assert telemetry.counter_value("dispatch.segment_cache_hits") > h0
+
+
+def test_bulk_span_reports_segments_and_fused_ops():
+    telemetry.enable()
+    x = nd.array(_rand((4, 4), 13))
+    with engine.bulk(4):
+        y = x
+        for _ in range(4):
+            y = y * 2.0
+            y = y + 1.0
+    y.wait_to_read()
+    spans = [e for e in telemetry.bus.events() if e[1] == "engine.bulk"]
+    attrs = spans[-1][6]
+    assert attrs["size"] == 4
+    assert attrs["ops_in_scope"] == 8
+    assert attrs["segments"] == 2
+    assert attrs["fused_ops"] == 8
+
+
+def test_bulk_span_survives_mid_scope_telemetry_toggle():
+    """ISSUE 5 satellite: toggling telemetry inside the scope must not
+    raise or report garbage ops_in_scope."""
+    x = nd.array(_rand((4,), 14))
+    # off at entry, on at exit
+    with engine.bulk(4):
+        y = x * 2.0
+        telemetry.enable()
+    spans = [e for e in telemetry.bus.events() if e[1] == "engine.bulk"]
+    if spans:                       # span was a noop (created while off)
+        assert "ops_in_scope" not in (spans[-1][6] or {})
+    # on at entry, reset mid-scope (exit counter < entry counter)
+    telemetry.reset()
+    telemetry.enable()
+    nd.waitall()
+    _ = (x * 2.0).asnumpy()         # put some ops on the counter
+    with engine.bulk(4):
+        y = x * 2.0
+        telemetry.reset()
+    spans = [e for e in telemetry.bus.events() if e[1] == "engine.bulk"]
+    attrs = spans[-1][6]
+    assert attrs.get("ops_in_scope", 0) >= 0
+    # on at entry, off at exit: span still closes without raising
+    telemetry.reset()
+    telemetry.enable()
+    with engine.bulk(4):
+        y = x * 2.0
+        telemetry.disable()
+    y.wait_to_read()
+
+
+def test_env_default_applies_to_new_threads(monkeypatch):
+    monkeypatch.setattr(recorder, "_ENV_DEFAULT", 8)
+    seen = {}
+
+    def worker():
+        seen["size"] = engine.bulk_size()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert seen["size"] == 8
+
+
+def test_set_bulk_size_returns_previous_and_flushes():
+    prev = engine.set_bulk_size(32)
+    assert prev == 0
+    x = nd.array(_rand((4,), 15))
+    y = x * 2.0
+    assert _pending(y)
+    assert engine.set_bulk_size(0) == 32     # flushes the pending segment
+    assert not _pending(y)
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() * 2.0, rtol=1e-6)
+
+
+def test_disabled_path_records_nothing():
+    telemetry.enable()
+    x = nd.array(_rand((4,), 16))
+    (x * 2.0).wait_to_read()
+    snap = telemetry.snapshot()["counters"]
+    assert snap.get("dispatch.ops_recorded", 0) == 0
+    assert snap.get("dispatch.segments_flushed", 0) == 0

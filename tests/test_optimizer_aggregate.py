@@ -51,6 +51,11 @@ def _run_steps(updater, w_np, g_np, steps=3, dtype="float32"):
     ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
     ("signum", {"learning_rate": 0.1, "momentum": 0.9}),
     ("adagrad", {"learning_rate": 0.1, "wd": 0.01}),
+    ("adamax", {"learning_rate": 0.002, "wd": 0.01}),
+    ("adamax", {"learning_rate": 0.002, "clip_gradient": 0.1}),
+    ("nadam", {"learning_rate": 0.001, "wd": 0.01}),
+    ("nadam", {"learning_rate": 0.001, "clip_gradient": 0.1,
+               "schedule_decay": 0.01}),
 ])
 def test_aggregated_matches_per_param(name, kwargs):
     np.random.seed(0)
@@ -70,6 +75,85 @@ def test_aggregated_matches_per_param(name, kwargs):
         for s1, s2 in zip(l1, l2):
             np.testing.assert_allclose(s1.asnumpy(), s2.asnumpy(),
                                        rtol=1e-5, atol=1e-6)
+
+
+def test_nadam_m_schedule_tracks_per_param():
+    """Nadam's host-side momentum schedule is mutated once per parameter
+    per update on the per-param path; the aggregated extras hook must
+    replicate the recurrence exactly (ISSUE 5 satellite)."""
+    np.random.seed(3)
+    w_np = [np.random.rand(*s).astype(np.float32) for s in SHAPES]
+    g_np = [(np.random.rand(*s).astype(np.float32) - 0.5) for s in SHAPES]
+    o1 = opt.create("nadam", learning_rate=0.001)
+    o1.aggregate_num = 1
+    o2 = opt.create("nadam", learning_rate=0.001)
+    u1, u2 = opt.get_updater(o1), opt.get_updater(o2)
+    ws1 = _run_steps(u1, w_np, g_np, steps=4)
+    ws2 = _run_steps(u2, w_np, g_np, steps=4)
+    np.testing.assert_allclose(o1.m_schedule, o2.m_schedule, rtol=1e-12)
+    for a, b in zip(ws1, ws2):
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_nadam_mixed_precision_takes_per_param_path():
+    """Nadam's m_schedule snapshots are processing-ORDER-sensitive: mixed
+    fp16(mp)+fp32 params split into two groups, which would permute the
+    per-param index order (members 1 and 2 would swap schedule prefixes).
+    The order_sensitive guard must route the whole update per-param, so
+    results match the reference exactly."""
+    np.random.seed(5)
+    shapes = [(4, 3), (7,), (2, 3, 2), (5, 5)]
+    dtypes = ["float32", "float16", "float32", "float16"]
+    w_np = [np.random.rand(*s).astype(d) for s, d in zip(shapes, dtypes)]
+    g_np = [(np.random.rand(*s).astype(d) - np.asarray(0.5, d))
+            for s, d in zip(shapes, dtypes)]
+
+    def run(agg):
+        o = opt.create("nadam", learning_rate=0.001, multi_precision=True)
+        o.aggregate_num = 64 if agg else 1
+        u = opt.get_updater(o)
+        ws = [nd.array(w.copy(), dtype=w.dtype) for w in w_np]
+        idx = list(range(len(ws)))
+        for _ in range(3):
+            gs = [nd.array(g.copy(), dtype=g.dtype) for g in g_np]
+            u(idx, gs, ws)
+        return o, ws
+
+    telemetry.enable()
+    o1, ws1 = run(False)
+    o2, ws2 = run(True)
+    assert o1.m_schedule == o2.m_schedule
+    for a, b in zip(ws1, ws2):
+        assert np.array_equal(a.asnumpy(), b.asnumpy())
+    # the guard shows up in telemetry: every member counted as fallback
+    assert telemetry.counter_value("optimizer.fallback_params") \
+        >= len(shapes)
+
+
+def test_adamax_nadam_zero_steady_state_misses():
+    """Both new rules ride the compiled-group cache: step 1 compiles,
+    later steps (and lr changes) add zero compile misses."""
+    for name in ("adamax", "nadam"):
+        aggregate.clear_cache()   # group sigs may be warm from other tests
+        telemetry.reset()
+        telemetry.enable()
+        o = opt.create(name)
+        ws = [nd.array(np.ones(s, np.float32)) for s in SHAPES]
+        gs = [nd.array(np.ones(s, np.float32)) for s in SHAPES]
+        u = opt.get_updater(o)
+        idx = list(range(len(ws)))
+        u(idx, gs, ws)
+        misses = telemetry.counter_value("optimizer.compile_misses")
+        assert misses >= 1, name
+        for _ in range(3):
+            u(idx, gs, ws)
+        o.set_learning_rate(0.5)
+        u(idx, gs, ws)
+        assert telemetry.counter_value("optimizer.compile_misses") \
+            == misses, name
+        assert telemetry.counter_value("optimizer.fallback_params") == 0, \
+            name
 
 
 def test_multi_precision_fp16_master_path():
