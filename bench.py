@@ -554,31 +554,45 @@ def _bench_input_pipeline_impl(_os, jax, mx, recordio, tmpdir, n_img, hw,
     batch = 32
     threads = _os.cpu_count() or 8
 
-    def epoch_rate(n_threads):
+    def epoch_rate(n_threads, procs=0, reps=1):
+        """Median img/s over ``reps`` timed epochs (one warm epoch first) —
+        medians because this host's scheduler throttling puts ~35% noise on
+        single-epoch timings."""
         it = mx.io.ImageRecordIter(
             path_imgrec=rec_path, data_shape=(3, hw, hw), batch_size=batch,
-            rand_mirror=True, preprocess_threads=n_threads)
-        for b in it:           # warm epoch (thread spin-up, file cache)
-            pass
-        it.reset()
-        t0 = time.perf_counter()
-        n, last = 0, None
-        for b in it:
-            last = b.data[0]
-            n += batch
-        return n / (time.perf_counter() - t0), n, last
+            rand_mirror=True, preprocess_threads=n_threads,
+            preprocess_processes=procs)
+        try:
+            for b in it:       # warm epoch (worker spin-up, file cache)
+                pass
+            rates = []
+            n = last = None
+            for _ in range(reps):
+                it.reset()
+                t0 = time.perf_counter()
+                n = 0
+                for b in it:
+                    last = b.data[0]
+                    n += batch
+                rates.append(n / (time.perf_counter() - t0))
+        finally:
+            it.close()
+        return float(np.median(rates)), n, last
 
     from mxnet_tpu import _native
-    # thread-scaling curve only where it can mean anything: with a single
-    # core every extra thread just adds contention (the r4 "sweep" showed
-    # exactly that regression and nothing else — dropped per VERDICT r4)
+    # decode-scaling data, not prose (ISSUE 6 satellite): a real process-
+    # count sweep 1 → min(4, cores) — each point is the median of 3 full
+    # multi-process pipeline epochs — plus the thread sweep for the
+    # in-process comparison
+    proc_sweep = {}
+    for p in range(1, min(4, max(threads, 1)) + 1):
+        proc_sweep[p], _, _ = epoch_rate(1, procs=p, reps=3)
     sweep = {}
     rate = n = last = None
-    if threads > 2:
-        for t in sorted({1, 2, threads}):
-            sweep[t], tn, tl = epoch_rate(t)
-            if t == threads:
-                rate, n, last = sweep[t], tn, tl
+    for t in sorted({1, 2, threads}):
+        sweep[t], tn, tl = epoch_rate(t)
+        if t == threads:
+            rate, n, last = sweep[t], tn, tl
     if rate is None:
         rate, n, last = epoch_rate(threads)
     # the cv2 Python reference path, for the native-vs-fallback ratio
@@ -609,11 +623,13 @@ def _bench_input_pipeline_impl(_os, jax, mx, recordio, tmpdir, n_img, hw,
             "decode_threads": threads,
             "per_image_ms": round(host_dt / n * 1e3, 3),
             "includes": "read+jpeg_decode+augment+batch (host)",
-            "thread_sweep_img_per_sec": ({str(k): round(v, 1)
-                                          for k, v in sweep.items()}
-                                         if sweep else
-                                         "n/a (cores<=2: sweep would only "
-                                         "measure contention)"),
+            "thread_sweep_img_per_sec": {str(k): round(v, 1)
+                                         for k, v in sweep.items()},
+            "process_sweep_img_per_sec": {str(k): round(v, 1)
+                                          for k, v in proc_sweep.items()},
+            "process_sweep_note": "preprocess_processes=1..min(4,cores), "
+                                  "full pipeline epoch per point (shm ring "
+                                  "+ native decode in worker processes)",
             "cv2_fallback_img_per_sec": round(cv2_rate, 2)
             if cv2_rate else None,
             "native_vs_cv2": round(rate / cv2_rate, 2) if cv2_rate
@@ -643,7 +659,11 @@ def bench_e2e_train_with_io():
     from __graft_entry__ import _resnet
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    n_img, hw, batch = 768, 224, 32
+    # BENCH_E2E_IMGS / BENCH_E2E_EPOCHS shrink the config for smoke runs
+    # on slow hosts (defaults are the measured-record shape)
+    n_img = int(os.environ.get("BENCH_E2E_IMGS", "768"))
+    hw, batch = 224, 32
+    e2e_epochs = int(os.environ.get("BENCH_E2E_EPOCHS", "3"))
     peak = _bf16_peak()
     rng = np.random.RandomState(0)
     tmpdir = tempfile.mkdtemp(prefix="e2ebench_")
@@ -679,14 +699,15 @@ def bench_e2e_train_with_io():
         flops = _cost_flops(compiled) or _RESNET50_TRAIN_FLOPS * batch
 
         # synthetic (device-resident) step rate for the IO-exposure split
+        synth_steps = int(os.environ.get("BENCH_E2E_SYNTH_STEPS", "20"))
         for _ in range(3):
             state, loss = compiled(state, x0, y0, key, t)
         float(np.asarray(loss))
         t0 = time.perf_counter()
-        for _ in range(20):
+        for _ in range(synth_steps):
             state, loss = compiled(state, x0, y0, key, t)
         float(np.asarray(loss))
-        synth_step = (time.perf_counter() - t0) / 20
+        synth_step = (time.perf_counter() - t0) / synth_steps
 
         for b in it:                     # warm epoch: decoder spin-up
             pass
@@ -723,12 +744,12 @@ def bench_e2e_train_with_io():
             float(np.asarray(loss))      # drain the dispatch queue
             return state, n
 
-        def timed(state, source, epochs=3):
-            state, n = run_epoch(state, source)       # warm
+        def timed(state, source, epochs=e2e_epochs, run=run_epoch):
+            state, n = run(state, source)             # warm
             rs = []
             for _ in range(epochs):
                 t0 = time.perf_counter()
-                state, n = run_epoch(state, source)
+                state, n = run(state, source)
                 rs.append(n / (time.perf_counter() - t0))
             return state, n, float(np.median(rs))
 
@@ -747,7 +768,77 @@ def bench_e2e_train_with_io():
         state, n, serial_rate = timed(state, _SerialSource())
         pit = DevicePrefetchIter(it, stage_batch, depth=2)
         state, n, overlap_rate = timed(state, pit)
-        rate = max(serial_rate, overlap_rate)
+
+        # --- multiprocess pipeline mode (ISSUE 6 tentpole): worker
+        # PROCESSES decode into a shared-memory ring, batches stage as
+        # uint8 canvases straight from the slots, and crop/flip/normalize/
+        # f32-widen run as the jitted device prologue — the host cost per
+        # image is decode only.
+        cores = _os.cpu_count() or 1
+        mp_procs = max(1, min(2, cores))
+        it_mp = mx.io.ImageRecordIter(
+            path_imgrec=rec_path, data_shape=(3, hw, hw), batch_size=batch,
+            rand_mirror=True, device_augment=True,
+            preprocess_processes=mp_procs)
+        aug = it_mp.augmenter
+
+        def stage_mp(b):
+            return (jax.device_put(b.data[0]._data, batch_sh),
+                    jax.device_put(b.label[0]._data.astype("float32"),
+                                   batch_sh),
+                    b.augment_flip)
+
+        def run_epoch_mp(state, source):
+            n = 0
+            loss = None
+            for x, y, flips in source:
+                state, loss = compiled(state, aug(x, flips), y, key, t)
+                n += batch
+            float(np.asarray(loss))
+            return state, n
+
+        class _SerialMP:
+            def __iter__(self):
+                it_mp.reset()
+                return (stage_mp(b) for b in it_mp)
+
+        state, n_mp, mp_serial = timed(state, _SerialMP(), run=run_epoch_mp)
+        aug_misses_after_warm = aug.compile_misses
+        pit_mp = DevicePrefetchIter(it_mp, stage_mp, depth=2)
+        state, n_mp, mp_overlap = timed(state, pit_mp, run=run_epoch_mp)
+        aug_steady_misses = aug.compile_misses - aug_misses_after_warm
+
+        # host decode-only rate (no staging, no step): what the workers
+        # cost per image now that augmentation is on device — plus the
+        # process-count scaling curve the acceptance criteria read.
+        # Median of 3 epochs: this host's scheduler throttling puts ~35%
+        # noise on single-epoch timings.
+        def mp_decode_rate(procs, iterator=None):
+            it_p = iterator or mx.io.ImageRecordIter(
+                path_imgrec=rec_path, data_shape=(3, hw, hw),
+                batch_size=batch, rand_mirror=True, device_augment=True,
+                preprocess_processes=procs)
+            try:
+                nd_ = sum(batch for _ in it_p)        # warm epoch
+                rates = []
+                for _ in range(3):
+                    it_p.reset()
+                    t0 = time.perf_counter()
+                    nd_ = sum(batch for _ in it_p)
+                    rates.append(nd_ / (time.perf_counter() - t0))
+                return float(np.median(rates))
+            finally:
+                if iterator is None:
+                    it_p.close()
+
+        decode_sweep = {}
+        for p in range(1, min(4, cores) + 1):
+            decode_sweep[p] = mp_decode_rate(
+                p, iterator=it_mp if p == mp_procs else None)
+        it_mp.close()
+
+        mp_rate = max(mp_serial, mp_overlap)
+        rate = max(serial_rate, overlap_rate, mp_rate)
         step_ms = batch / rate * 1e3
         stage_ms = batch / stage_rate * 1e3
         synth_ms = synth_step * 1e3
@@ -757,11 +848,30 @@ def bench_e2e_train_with_io():
         # (prefetch thread) overlap too, so measured exposure can beat it
         exposed_ms = max(0.0, step_ms - synth_ms)
         ideal_ms = max(0.0, stage_ms - synth_ms)
+        pipeline = "multiprocess" if mp_rate >= max(serial_rate,
+                                                    overlap_rate) else \
+            ("overlapped" if overlap_rate >= serial_rate else "serial")
         return {"items_per_sec": round(rate, 2),
-                "pipeline": "overlapped" if overlap_rate >= serial_rate
-                            else "serial",
+                "pipeline": pipeline,
                 "serial_img_per_sec": round(serial_rate, 2),
                 "overlapped_img_per_sec": round(overlap_rate, 2),
+                "multiprocess": {
+                    "serial_img_per_sec": round(mp_serial, 2),
+                    "overlapped_img_per_sec": round(mp_overlap, 2),
+                    "decode_procs": mp_procs,
+                    "decode_sweep_img_per_sec": {
+                        str(k): round(v, 1) for k, v in
+                        decode_sweep.items()},
+                    "host_per_image_ms": round(
+                        1e3 / decode_sweep[mp_procs], 3),
+                    "host_per_image_includes": "record read + jpeg decode "
+                        "to uint8 canvas (shm ring); augmentation now on "
+                        "device, EXCLUDED from host cost",
+                    "augment": "jitted device prologue (crop/flip/"
+                               "normalize/f32-widen), engine-capturable",
+                    "augment_steady_state_compile_misses":
+                        int(aug_steady_misses),
+                },
                 "staging_dtype": "uint8 (4x fewer bytes; f32 widen "
                                  "on device)",
                 "overlap": "double-buffered device_put "
@@ -770,7 +880,7 @@ def bench_e2e_train_with_io():
                          "tunnel; on direct-attached TPU the pipeline "
                          "feeds at min(decode, step) rate",
                 "images_per_epoch": n,
-                "epochs_timed": 3,
+                "epochs_timed": e2e_epochs,
                 "stage_only_img_per_sec": round(stage_rate, 2),
                 "synthetic_step_ms": round(synth_ms, 3),
                 "synthetic_img_per_sec": round(batch / synth_step, 2),

@@ -24,8 +24,13 @@
 #                vs eager parity + fallback matrix), then a telemetry
 #                parity pass under MXNET_ENGINE_BULK=16 (fused segments
 #                recorded, zero steady-state segment compile misses)
+#   io         - multi-process input pipeline smoke: test_io_pipeline.py,
+#                then a short shm-ring pipeline run (nonzero
+#                io.record_batches, zero steady-state augment compile
+#                misses) and a clean-teardown sweep of /dev/shm — both on
+#                a healthy run and under an injected worker crash
 # Usage: ci/run.sh [stage ...]   (default: unit gate telemetry optimizer
-#                                 serving resilience engine)
+#                                 serving resilience engine io)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -305,9 +310,72 @@ print("engine smoke ok: 64-op chain -> 4 fused segments/step,",
 PY
 }
 
+stage_io() {
+  JAX_PLATFORMS=cpu python -m pytest tests/test_io_pipeline.py -q
+  JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 python - <<'PY'
+import os
+import tempfile
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import recordio, telemetry
+from mxnet_tpu.resilience import faults
+
+
+def shm_leaks():
+    if not os.path.isdir("/dev/shm"):
+        return []
+    return [f for f in os.listdir("/dev/shm") if f.startswith("mxio")]
+
+
+tmp = tempfile.mkdtemp(prefix="ci_io_")
+rec_path = os.path.join(tmp, "d.rec")
+rng = np.random.RandomState(0)
+rec = recordio.MXRecordIO(rec_path, "w")
+img = (rng.rand(64, 64, 3) * 255).astype("uint8")
+for i in range(96):
+    img[i % 64, :, :] = (i * 37) % 255
+    rec.write(recordio.pack_img(recordio.IRHeader(0, float(i % 10), i, 0),
+                                img, quality=85))
+rec.close()
+
+# healthy multi-process run: device-augment prologue, 2 epochs
+it = mx.io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 48, 48),
+                           batch_size=16, rand_mirror=True, shuffle=True,
+                           device_augment=True, preprocess_processes=2)
+aug = it.augmenter
+for _epoch in range(2):
+    for b in it:
+        aug(b.data[0].asnumpy(), b.augment_flip, b.augment_crop)
+    it.reset()
+c = telemetry.snapshot()["counters"]
+assert c.get("io.record_batches", 0) >= 12, c
+assert c.get("io.staging_bytes", 0) > 0, c
+assert aug.compile_misses == 1, \
+    f"steady-state augment compile misses: {aug.compile_misses - 1}"
+it.close()
+assert not shm_leaks(), shm_leaks()
+
+# injected worker crash (io.shm_slot hard-kills the worker): the consumer
+# must raise within the bounded wait and the shm ring must still unlink
+with faults.scope("io.shm_slot:fail:1"):
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path,
+                               data_shape=(3, 48, 48), batch_size=16,
+                               preprocess_processes=2, pipeline_timeout=20)
+    try:
+        list(it)
+        raise AssertionError("injected worker crash must raise")
+    except RuntimeError as e:
+        assert "died" in str(e), e
+    it.close()
+assert not shm_leaks(), shm_leaks()
+print("io smoke ok:", int(c["io.record_batches"]), "batches,",
+      "0 steady-state augment misses, shm clean (healthy + crashed run)")
+PY
+}
+
 stages=("$@")
 [ $# -eq 0 ] && stages=(unit gate telemetry optimizer serving resilience
-                        engine)
+                        engine io)
 for s in "${stages[@]}"; do
   echo "=== ci stage: $s ==="
   "stage_$s"

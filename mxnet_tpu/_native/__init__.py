@@ -113,6 +113,14 @@ def load():
                     ctypes.POINTER(ctypes.c_float),
                     ctypes.POINTER(ctypes.c_float), ctypes.c_float,
                     ctypes.c_int, ctypes.POINTER(ctypes.c_float)]
+            if hasattr(lib, "jpg_decode_batch_u8"):
+                lib.jpg_decode_batch_u8.restype = ctypes.c_int64
+                lib.jpg_decode_batch_u8.argtypes = [
+                    ctypes.POINTER(ctypes.c_uint8),
+                    ctypes.POINTER(ctypes.c_uint64),
+                    ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+                    ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                    ctypes.POINTER(ctypes.c_uint8)]
             _lib = lib
         except Exception:
             _lib = None
@@ -194,21 +202,10 @@ def decode_available():
     return lib is not None and hasattr(lib, "jpg_decode_batch")
 
 
-def decode_batch(payloads, out_hw, resize=-1, crop_xy=None, mirror=None,
-                 mean=(0.0, 0.0, 0.0), std=(1.0, 1.0, 1.0), scale=1.0,
-                 n_threads=4):
-    """Decode+augment a batch of JPEG byte strings into float32 CHW RGB
-    (the reference's in-iterator OMP decode, iter_image_recordio_2.cc).
-
-    ``crop_xy``: (n, 2) fractions in [0, 1) for random crops, or None for
-    center crop.  Returns (n, 3, H, W) float32, or None when the native
-    decode path is unavailable.
-    """
-    lib = load()
-    if lib is None or not hasattr(lib, "jpg_decode_batch"):
-        return None
+def _pack_blob(payloads):
+    """Concatenate byte payloads into one contiguous (blob, offsets,
+    lengths) triple for the batched C entry points."""
     n = len(payloads)
-    h, w = int(out_hw[0]), int(out_hw[1])
     lengths = np.asarray([len(p) for p in payloads], dtype=np.uint64)
     offsets = np.zeros(n, dtype=np.uint64)
     np.cumsum(lengths[:-1], out=offsets[1:])
@@ -216,6 +213,28 @@ def decode_batch(payloads, out_hw, resize=-1, crop_xy=None, mirror=None,
     for i, p in enumerate(payloads):
         blob[int(offsets[i]):int(offsets[i]) + len(p)] = \
             np.frombuffer(p, dtype=np.uint8)
+    return blob, offsets, lengths
+
+
+def decode_batch(payloads, out_hw, resize=-1, crop_xy=None, mirror=None,
+                 mean=(0.0, 0.0, 0.0), std=(1.0, 1.0, 1.0), scale=1.0,
+                 n_threads=4, out=None):
+    """Decode+augment a batch of JPEG byte strings into float32 CHW RGB
+    (the reference's in-iterator OMP decode, iter_image_recordio_2.cc).
+
+    ``crop_xy``: (n, 2) fractions in [0, 1) for random crops, or None for
+    center crop.  ``out``: optional preallocated contiguous float32
+    (n, 3, H, W) destination (e.g. a shared-memory ring-slot view) — the
+    decoder writes every pixel straight into it, no intermediate batch
+    array.  Returns the output array, or None when the native decode path
+    is unavailable.
+    """
+    lib = load()
+    if lib is None or not hasattr(lib, "jpg_decode_batch"):
+        return None
+    n = len(payloads)
+    h, w = int(out_hw[0]), int(out_hw[1])
+    blob, offsets, lengths = _pack_blob(payloads)
     if crop_xy is None:
         crops = np.full((n, 2), -1.0, dtype=np.float32)
     else:
@@ -224,7 +243,15 @@ def decode_batch(payloads, out_hw, resize=-1, crop_xy=None, mirror=None,
         np.ascontiguousarray(mirror, dtype=np.uint8)
     mean = np.ascontiguousarray(mean, dtype=np.float32)
     std = np.ascontiguousarray(std, dtype=np.float32)
-    out = np.empty((n, 3, h, w), dtype=np.float32)
+    if out is None:
+        out = np.empty((n, 3, h, w), dtype=np.float32)
+    elif out.dtype != np.float32 or out.shape != (n, 3, h, w) \
+            or not out.flags["C_CONTIGUOUS"]:
+        # explicit raise, not assert: this guards a native write into the
+        # caller's buffer (python -O must not strip it)
+        raise ValueError(
+            f"decode_batch out buffer must be contiguous float32 "
+            f"{(n, 3, h, w)}, got {out.dtype} {out.shape}")
     rc = lib.jpg_decode_batch(
         blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
@@ -238,4 +265,42 @@ def decode_batch(payloads, out_hw, resize=-1, crop_xy=None, mirror=None,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
     if rc < 0:
         raise IOError(f"native jpeg decode failed on image {-rc - 1}")
+    return out
+
+
+def decode_canvas_available():
+    """True when the native library carries the uint8 canvas decoder."""
+    lib = load()
+    return lib is not None and hasattr(lib, "jpg_decode_batch_u8")
+
+
+def decode_batch_u8(payloads, out_hw, n_threads=1, out=None):
+    """Decode a batch of JPEGs to a fixed uint8 CHW canvas (whole-image
+    bilinear resize, no augmentation — that runs as the device prologue).
+
+    ``out``: optional preallocated contiguous uint8 (n, 3, H, W) buffer
+    (a shared-memory ring-slot view); allocated when absent.  Returns the
+    output array, or None when the native canvas decoder is unavailable.
+    """
+    lib = load()
+    if lib is None or not hasattr(lib, "jpg_decode_batch_u8"):
+        return None
+    n = len(payloads)
+    h, w = int(out_hw[0]), int(out_hw[1])
+    blob, offsets, lengths = _pack_blob(payloads)
+    if out is None:
+        out = np.empty((n, 3, h, w), dtype=np.uint8)
+    elif out.dtype != np.uint8 or out.shape != (n, 3, h, w) \
+            or not out.flags["C_CONTIGUOUS"]:
+        raise ValueError(
+            f"decode_batch_u8 out buffer must be contiguous uint8 "
+            f"{(n, 3, h, w)}, got {out.dtype} {out.shape}")
+    rc = lib.jpg_decode_batch_u8(
+        blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        n, h, w, int(n_threads),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if rc < 0:
+        raise IOError(f"native jpeg canvas decode failed on image {-rc - 1}")
     return out

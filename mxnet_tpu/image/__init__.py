@@ -12,3 +12,4 @@ from .detection import (  # noqa: F401
     DetHorizontalFlipAug, DetRandomCropAug, DetBorrowAug,
     CreateDetAugmenter, ImageDetIter,
 )
+from .device_augment import DeviceAugmenter  # noqa: F401

@@ -1,0 +1,96 @@
+"""Device-side augmentation prologue (the ``mxnet_tpu/io`` pipeline's
+crop/flip/normalize/f32-widen, moved off the host).
+
+The multi-process decode pool ships fixed uint8 canvases; this class owns
+the jitted prologue that turns a staged canvas batch into the training
+input — one fused XLA program per (batch shape, dtype), compiled once and
+replayed (``io.augment_compile_miss`` telemetry must stay zero steady-state,
+the same contract as every other compiled cache in this codebase).
+
+Two call paths share the exact same op (``ops/image_ops.py:image_augment``):
+
+- concrete ``jax``/numpy arrays → an internally cached ``jax.jit`` of the op;
+- :class:`~mxnet_tpu.ndarray.NDArray` inputs → ``nd.image_augment``, which
+  the engine segment recorder can capture — inside ``engine.bulk`` the
+  prologue fuses into the surrounding segment instead of dispatching alone.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..telemetry import bus as _tel
+
+__all__ = ["DeviceAugmenter"]
+
+
+def _rgb3(v, default):
+    a = np.asarray(v if v is not None else default, dtype=np.float32)
+    if a.ndim == 0:
+        a = np.full(3, float(a), dtype=np.float32)
+    assert a.shape == (3,), f"want 3 per-channel values, got {a.shape}"
+    return a
+
+
+class DeviceAugmenter:
+    """Jitted crop/flip/normalize/widen prologue for staged uint8 batches.
+
+    ``out_hw``: the (H, W) crop target (the iterator's ``data_shape`` spatial
+    dims).  ``flips``/``crops`` are the per-batch arrays the iterator
+    attaches as ``batch.augment_flip``/``batch.augment_crop``; both are
+    traced inputs, so fresh randomness never recompiles.
+    """
+
+    def __init__(self, out_hw, mean=None, std=None, scale=1.0,
+                 rand_crop=False, rand_mirror=False):
+        self.out_hw = (int(out_hw[0]), int(out_hw[1]))
+        self.mean = _rgb3(mean, 0.0)
+        self.std = _rgb3(std, 1.0)
+        self.scale = float(scale)
+        self.rand_crop = bool(rand_crop)
+        self.rand_mirror = bool(rand_mirror)
+        self._jitted = {}            # (shape, dtype) -> compiled program
+        self.compile_misses = 0
+
+    def _attrs(self):
+        return dict(out_h=self.out_hw[0], out_w=self.out_hw[1],
+                    mean_r=float(self.mean[0]), mean_g=float(self.mean[1]),
+                    mean_b=float(self.mean[2]), std_r=float(self.std[0]),
+                    std_g=float(self.std[1]), std_b=float(self.std[2]),
+                    scale=self.scale, rand_crop=self.rand_crop)
+
+    def _coerce_aug(self, n, flips, crops):
+        if flips is None:
+            flips = np.zeros(n, dtype=bool)
+        if crops is None:
+            crops = np.zeros((n, 2), dtype=np.float32)
+        return flips, np.asarray(crops, dtype=np.float32)
+
+    def __call__(self, data, flips=None, crops=None):
+        """Augment one staged batch.  NDArray in → NDArray out (engine-
+        capturable dispatch); jax/numpy in → jax array out (cached jit)."""
+        from ..ndarray import NDArray
+
+        if isinstance(data, NDArray):
+            from .. import nd
+            flips, crops = self._coerce_aug(data.shape[0], flips, crops)
+            return nd.image_augment(data, nd.array(np.asarray(flips, "uint8")),
+                                    nd.array(crops), **self._attrs())
+
+        import jax
+        from ..ops.image_ops import image_augment
+
+        flips, crops = self._coerce_aug(data.shape[0], flips, crops)
+        key = (tuple(data.shape), str(getattr(data, "dtype", "uint8")))
+        fn = self._jitted.get(key)
+        if fn is None:
+            attrs = self._attrs()
+            fn = jax.jit(lambda d, f, c: image_augment(d, f, c, **attrs))
+            self._jitted[key] = fn
+            self.compile_misses += 1
+            if _tel.enabled:
+                _tel.count("io.augment_compile_miss")
+                _tel.instant("io.augment_compile", shape=repr(key[0]),
+                             dtype=key[1])
+        if _tel.enabled:
+            _tel.count("io.augment_batches")
+        return fn(data, np.asarray(flips, dtype=np.uint8), crops)

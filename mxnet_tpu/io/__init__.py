@@ -14,3 +14,6 @@ from .io import (  # noqa: F401
 )
 from .iterators import (CSVIter, ImageDetRecordIter,  # noqa: F401
                         ImageRecordIter, LibSVMIter, MNISTIter)
+from .pipeline import (BatchDecodeError, DecodeSpec,  # noqa: F401
+                       ProcessDecodePool, RecordShardSampler)
+from .shm_ring import ShmRing  # noqa: F401

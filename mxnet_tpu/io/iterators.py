@@ -210,6 +210,24 @@ class ImageRecordIter(DataIter):
     decode+augment (GIL released in cv2) → numpy batch.  Core augmenters from
     ``src/io/image_aug_default.cc``: resize (shorter edge), center/random
     crop, random mirror, mean/std normalization, scale.
+
+    ``preprocess_processes=N`` (N>0) swaps the in-process decode pool for N
+    fork-started worker *processes* that assemble batches directly into a
+    shared-memory ring (``io/pipeline.py``) — same record order, same RNG
+    stream, bitwise-identical batches; ``preprocess_processes=0`` (the
+    default) is the unchanged thread path.  Batch data is copied out of
+    the ring once per batch by default; ``zero_copy_batches=True`` hands
+    out the slot view itself (for direct-attach accelerators), making the
+    host data stable only until the *following* ``next()``/``reset()``
+    call — the reference iterator's buffer-reuse contract.
+
+    ``device_augment=True`` moves crop/flip/normalize/f32-widen off the
+    host: workers decode to a fixed uint8 canvas, batches carry
+    ``augment_flip``/``augment_crop`` arrays, and :attr:`augmenter` is the
+    jitted device prologue to apply them (fusible with ``engine.bulk``
+    segments).  ``shard=RecordShardSampler(...)`` (or
+    ``RecordShardSampler.from_mesh(mesh)``) overrides
+    ``num_parts``/``part_index`` for mesh-keyed multi-host input.
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size=1,
@@ -217,10 +235,16 @@ class ImageRecordIter(DataIter):
                  resize=-1, rand_crop=False, rand_mirror=False,
                  mean_img=None, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
-                 preprocess_threads=4, seed=0, part_index=0, num_parts=1,
+                 preprocess_threads=4, preprocess_processes=0,
+                 device_augment=False, shard=None, ring_slots=None,
+                 worker_respawn=False, pipeline_timeout=None,
+                 zero_copy_batches=False,
+                 seed=0, part_index=0, num_parts=1,
                  label_width=1, dtype="float32", **kwargs):
         super().__init__(int(batch_size))
         from .. import recordio
+        if shard is not None:
+            num_parts, part_index = shard.num_parts, shard.part_index
         self._data_shape = _maybe_parse_shape(data_shape)
         assert len(self._data_shape) == 3, "data_shape must be (C, H, W)"
         self._resize = int(resize)
@@ -235,6 +259,9 @@ class ImageRecordIter(DataIter):
         self._shuffle = bool(shuffle)
         self._round_batch = bool(round_batch)
         self._threads = int(preprocess_threads)
+        self._device_augment = bool(device_augment)
+        self._zero_copy = bool(zero_copy_batches)
+        self._augmenter = None
 
         self._path_imgrec = path_imgrec
         if path_imgidx and os.path.isfile(path_imgidx):
@@ -276,15 +303,60 @@ class ImageRecordIter(DataIter):
             per = (len(self._keys) + num_parts - 1) // num_parts
             self._keys = self._keys[part_index * per:(part_index + 1) * per]
             self._offsets = self._offsets[part_index * per:(part_index + 1) * per]
+            if self._lengths is not None:
+                self._lengths = \
+                    self._lengths[part_index * per:(part_index + 1) * per]
         self.num_data = len(self._keys)
         assert self.num_data > 0, "empty record file"
         self._order = np.arange(self.num_data)
-        from concurrent.futures import ThreadPoolExecutor
-        self._pool = ThreadPoolExecutor(max_workers=self._threads)
+
+        # one decode recipe for the thread path AND the worker processes —
+        # shared code is what makes preprocess_processes>0 bitwise-identical
+        from . import pipeline as _pl
+        if self._indexed:
+            spec_offsets = [self._rec.idx[k] for k in self._keys]
+            spec_lengths = None
+        else:
+            spec_offsets = self._offsets
+            spec_lengths = getattr(self, "_lengths", None)
+        self._spec = _pl.DecodeSpec(
+            path_imgrec, self._data_shape, spec_offsets, spec_lengths,
+            resize=self._resize, rand_crop=self._rand_crop,
+            mean=self._mean, std=self._std, scale=self._scale,
+            dtype=self._dtype, batch_size=self.batch_size,
+            device_augment=self._device_augment,
+            label_width=self._label_width)
+        if self._device_augment and self._rand_crop:
+            ch, cw = self._spec.canvas_hw
+            _c, h, w = self._data_shape
+            if (ch, cw) == (h, w):
+                raise ValueError(
+                    "device_augment with rand_crop needs a crop margin: "
+                    f"the decode canvas {ch}x{cw} equals the crop target, "
+                    "so the device prologue would silently skip cropping — "
+                    "set resize larger than the data_shape spatial dims")
+
+        self._procs = int(preprocess_processes)
+        self._held_slot = None
+        self._meta = {}
+        self._epoch_rng_state = None    # rng snapshot at epoch start (mp)
+        self._mp_consumed = 0           # completed next() calls this epoch
+        if self._procs > 0:
+            self._pipeline = _pl.ProcessDecodePool(
+                self._spec, self._procs, ring_slots=ring_slots,
+                respawn=worker_respawn, timeout=pipeline_timeout)
+            self._pool = None
+        else:
+            self._pipeline = None
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(max_workers=self._threads)
         self.reset()
 
     @property
     def provide_data(self):
+        if self._device_augment:
+            # uint8 canvas out; crop/flip/normalize/widen happen on device
+            return [DataDesc("data", self._spec.slot_shape, np.dtype(np.uint8))]
         return [DataDesc("data", (self.batch_size,) + self._data_shape,
                          np.dtype(self._dtype))]
 
@@ -294,10 +366,82 @@ class ImageRecordIter(DataIter):
             else (self.batch_size, self._label_width)
         return [DataDesc("softmax_label", shp, np.float32)]
 
+    @property
+    def augmenter(self):
+        """The jitted device-side augmentation prologue matching this
+        iterator's config (``device_augment=True`` only): call it on the
+        staged uint8 batch with the batch's ``augment_flip``/
+        ``augment_crop`` arrays."""
+        if not self._device_augment:
+            return None
+        if self._augmenter is None:
+            from ..image import DeviceAugmenter
+            c, h, w = self._data_shape
+            self._augmenter = DeviceAugmenter(
+                (h, w), mean=self._mean, std=self._std, scale=self._scale,
+                rand_crop=self._rand_crop, rand_mirror=self._rand_mirror)
+        return self._augmenter
+
+    def _epoch_batches(self):
+        """Batches one epoch yields — the exact ``iter_next`` count."""
+        n, b = self.num_data, self.batch_size
+        return (n + b - 1) // b if self._round_batch else n // b
+
+    def _sel_for(self, seq):
+        """Record selection (and pad) of epoch batch ``seq`` — the same
+        arithmetic ``next()`` uses on the thread path."""
+        start = seq * self.batch_size
+        end = start + self.batch_size
+        if end <= self.num_data:
+            return self._order[start:end]
+        pad = end - self.num_data
+        return np.concatenate([self._order[start:], self._order[:pad]])
+
+    def _task_gen(self):
+        """Per-batch decode tasks in seq order.  Flip/crop randomness is
+        drawn HERE, in dispatch (== seq) order, so the RNG stream is draw-
+        for-draw identical to the thread path's lazy per-``next()`` draws."""
+        for seq in range(self._epoch_batches()):
+            sel = self._sel_for(seq)
+            flips = self._rng.rand(len(sel)) < 0.5 if self._rand_mirror \
+                else np.zeros(len(sel), dtype=bool)
+            crops = self._rng.rand(len(sel), 2)
+            self._meta[seq] = (sel, flips, crops)
+            yield sel, flips, crops
+
     def reset(self):
+        if self._pipeline is not None:
+            # abort BEFORE touching the rng: releasing the held slot pumps
+            # the dispatcher, and the old epoch's generator must not draw
+            # post-rewind randomness
+            self._pipeline.abort_epoch()
+            if self._held_slot is not None:
+                self._pipeline.release(self._held_slot)
+                self._held_slot = None
+            if self._epoch_rng_state is not None:
+                # The pool draws flip/crop randomness eagerly at DISPATCH
+                # time, ahead of consumption; the thread path draws lazily
+                # per completed next().  Rewind to the epoch-start snapshot
+                # and replay only the consumed batches' draws, so the rng
+                # stream entering this reset is exactly where the thread
+                # path's would be — resets before or mid-epoch stay
+                # bitwise-deterministic.
+                self._rng.set_state(self._epoch_rng_state)
+                for _ in range(self._mp_consumed):
+                    if self._rand_mirror:
+                        self._rng.rand(self.batch_size)
+                    self._rng.rand(self.batch_size, 2)
         if self._shuffle:
             self._rng.shuffle(self._order)
         self._cursor = -self.batch_size
+        if self._pipeline is not None:
+            self._meta = {}
+            self._epoch_rng_state = self._rng.get_state()
+            self._mp_consumed = 0
+            if self._pipeline.workers_alive:
+                self._pipeline.clear_error()
+            self._pipeline.start_epoch(self._task_gen(),
+                                       self._epoch_batches())
 
     def iter_next(self):
         self._cursor += self.batch_size
@@ -324,38 +468,7 @@ class ImageRecordIter(DataIter):
         return [self._read_raw(i) for i in sel]
 
     def _decode_one(self, raw, mirror_flip, crop_xy):
-        import cv2
-        from .. import recordio
-        header, img = recordio.unpack_img(raw, iscolor=1)
-        c, h, w = self._data_shape
-        if self._resize > 0:
-            ih, iw = img.shape[:2]
-            if ih < iw:
-                nh, nw = self._resize, int(iw * self._resize / ih)
-            else:
-                nh, nw = int(ih * self._resize / iw), self._resize
-            img = cv2.resize(img, (nw, nh), interpolation=cv2.INTER_LINEAR)
-        ih, iw = img.shape[:2]
-        if ih < h or iw < w:
-            img = cv2.resize(img, (max(w, iw), max(h, ih)),
-                             interpolation=cv2.INTER_LINEAR)
-            ih, iw = img.shape[:2]
-        if self._rand_crop:
-            y0 = int(crop_xy[0] * (ih - h + 1))
-            x0 = int(crop_xy[1] * (iw - w + 1))
-        else:
-            y0, x0 = (ih - h) // 2, (iw - w) // 2
-        img = img[y0:y0 + h, x0:x0 + w]
-        if mirror_flip:
-            img = img[:, ::-1]
-        img = img[:, :, ::-1].astype(np.float32)  # BGR → RGB
-        img = (img - self._mean) / self._std * self._scale
-        label = header.label
-        if not np.isscalar(label) and getattr(label, "size", 1) > 1:
-            label = np.asarray(label, dtype=np.float32)
-        else:
-            label = np.float32(label)
-        return np.transpose(img, (2, 0, 1)), label
+        return self._spec.decode_one(raw, mirror_flip, crop_xy)
 
     def _decode_batch_native(self, raws, flips, crops):
         """Whole-batch decode+augment in one native call (the reference's
@@ -363,36 +476,75 @@ class ImageRecordIter(DataIter):
         libjpeg decode → shorter-edge resize → crop → mirror → normalize on
         a C++ thread pool, float32 CHW out.  Returns None when the payload
         set is not all-JPEG (native path handles only JPEG, like the
-        reference's libjpeg-turbo fast path)."""
-        from .. import _native, recordio
-        headers, payloads = [], []
-        for raw in raws:
-            header, payload = recordio.unpack(raw)
-            if not payload[:3] == b"\xff\xd8\xff":
-                return None
-            headers.append(header)
-            payloads.append(payload)
-        c, h, w = self._data_shape
+        reference's libjpeg-turbo fast path); shared with the worker
+        processes via :class:`mxnet_tpu.io.pipeline.DecodeSpec`."""
+        return self._spec.decode_batch_native(raws, flips, crops,
+                                              self._threads)
+
+    def _next_multiprocess(self):
+        """The ``preprocess_processes>0`` path: pull the next in-order slot
+        from the decode pool and wrap it (one batch-level copy by default,
+        the aliasing view itself under ``zero_copy_batches=True``).  The
+        previous batch's slot is recycled here — zero-copy views of it go
+        stale, per the class contract."""
+        from .pipeline import BatchDecodeError
+        if not self.iter_next():
+            raise StopIteration
+        if self._held_slot is not None:
+            self._pipeline.release(self._held_slot)
+            self._held_slot = None
         try:
-            data = _native.decode_batch(
-                payloads, (h, w), resize=self._resize,
-                crop_xy=crops if self._rand_crop else None,
-                mirror=flips.astype(np.uint8),
-                mean=self._mean, std=self._std, scale=self._scale,
-                n_threads=self._threads)
-        except IOError:
-            # e.g. CMYK/YCCK JPEGs libjpeg won't convert — cv2 handles them
-            return None
-        labels = []
-        for header in headers:
-            label = header.label
-            if not np.isscalar(label) and getattr(label, "size", 1) > 1:
-                labels.append(np.asarray(label, dtype=np.float32))
-            else:
-                labels.append(np.float32(label))
-        return data, np.stack(labels)
+            seq, view, labels, slot = self._pipeline.next_batch()
+        except BatchDecodeError as e:
+            # per-batch error, thread-path contract: account the batch
+            # (its rng draws happened; the cursor already advanced) and let
+            # the caller decide whether to continue with the next one
+            self._mp_consumed += 1
+            self._meta.pop(e.seq, None)
+            raise
+        self._held_slot = slot
+        self._mp_consumed += 1
+        sel, flips, crops = self._meta.pop(seq)
+        pad = self.getpad()
+        if _tel.enabled:
+            _tel.count("io.record_batches")
+            _tel.count("io.staging_bytes", view.nbytes + labels.nbytes)
+        # jax.device_put zero-copy-ALIASES page-aligned host buffers on the
+        # CPU backend: a wrapped slot view would keep pointing into shared
+        # memory after the slot recycles.  Default: one batch-level memcpy
+        # out of the ring (still no per-image copies, no pickling).
+        # ``zero_copy_batches=True`` hands out the aliasing view itself —
+        # for direct-attach accelerators where device_put is a real
+        # host->HBM copy; the data then obeys the slot-lifetime contract
+        # (stable only until the following next()/reset()).
+        data_arr = view if self._zero_copy else np.array(view)
+        batch = DataBatch(data=[nd.array(data_arr)],
+                          label=[nd.array(labels)],
+                          pad=pad, index=sel.copy())
+        if self._device_augment:
+            batch.augment_flip = flips
+            batch.augment_crop = crops
+        return batch
+
+    def close(self):
+        """Tear down decode resources (worker processes, shm ring, thread
+        pool).  Idempotent; also runs from ``__del__`` and atexit."""
+        pl = getattr(self, "_pipeline", None)
+        if pl is not None:
+            pl.close()
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def next(self):
+        if self._pipeline is not None:
+            return self._next_multiprocess()
         if not self.iter_next():
             raise StopIteration
         start, end = self._cursor, self._cursor + self.batch_size
@@ -407,6 +559,20 @@ class ImageRecordIter(DataIter):
         flips = self._rng.rand(len(sel)) < 0.5 if self._rand_mirror \
             else np.zeros(len(sel), dtype=bool)
         crops = self._rng.rand(len(sel), 2)
+        if self._device_augment:
+            # in-process canvas decode (decode-only; augmentation is the
+            # device prologue) — the procs=0 twin of the worker path
+            out = np.empty(self._spec.slot_shape, dtype=np.uint8)
+            with _tel.span("io.decode_batch", decoder="canvas", n=len(sel)):
+                labels = self._spec.decode_canvas(raws, self._threads, out)
+            if _tel.enabled:
+                _tel.count("io.record_batches")
+            batch = DataBatch(data=[nd.array(out)],
+                              label=[nd.array(labels)], pad=pad,
+                              index=sel.copy())
+            batch.augment_flip = flips
+            batch.augment_crop = crops
+            return batch
         from .. import _native
         native = None
         # decode waits exported per caller (ROADMAP io.* item): the caller

@@ -67,6 +67,53 @@ def resize(data, size=None, keep_ratio=False, interp=1):
     return out.astype(data.dtype) if jnp.issubdtype(data.dtype, jnp.integer) else out
 
 
+@register("_image_augment", aliases=("image_augment",))
+def image_augment(data, flip, crop_xy, out_h=None, out_w=None,
+                  mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                  std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
+                  rand_crop=False):
+    """Device-side training-augmentation prologue: per-image crop → mirror →
+    normalize → f32-widen over a uint8 CHW canvas batch, in ONE fused XLA
+    program (reference ``image_aug_default.cc``, moved off the host — the
+    ``mxnet_tpu/io`` multi-process pipeline leaves workers doing only
+    read+decode).
+
+    ``data``: (N, 3, H, W) uint8 (or float) canvas batch; ``flip``: (N,)
+    bool/uint8 mirror flags; ``crop_xy``: (N, 2) float crop-offset fractions
+    in [0, 1) (ignored for ``rand_crop=False`` — center crop, the host
+    path's exact integer arithmetic).  Crop offsets and flip flags are
+    *traced* array inputs, so every batch replays one compiled program; the
+    op has hashable scalar attrs only, making it capturable by the engine
+    segment recorder (fuses with ``engine.bulk`` chains and the train-step
+    prologue).
+    """
+    oh, ow = parse_int(out_h), parse_int(out_w)
+    ih, iw = data.shape[-2], data.shape[-1]
+    x = data.astype(jnp.float32)
+    flip = flip.astype(jnp.bool_).reshape(-1)
+    if (oh, ow) != (ih, iw):
+        if parse_bool(rand_crop):
+            # host parity: y0 = int(cy * (ih - oh + 1)), cy in [0, 1)
+            y0 = jnp.floor(crop_xy[:, 0] * (ih - oh + 1)).astype(jnp.int32)
+            x0 = jnp.floor(crop_xy[:, 1] * (iw - ow + 1)).astype(jnp.int32)
+        else:
+            n = x.shape[0]
+            y0 = jnp.full((n,), (ih - oh) // 2, jnp.int32)
+            x0 = jnp.full((n,), (iw - ow) // 2, jnp.int32)
+
+        def crop_one(img, yy, xx):
+            return jax.lax.dynamic_slice(img, (0, yy, xx), (3, oh, ow))
+
+        x = jax.vmap(crop_one)(x, y0, x0)
+    x = jnp.where(flip[:, None, None, None], x[..., ::-1], x)
+    mean = jnp.asarray([parse_float(mean_r, 0.0), parse_float(mean_g, 0.0),
+                        parse_float(mean_b, 0.0)], jnp.float32)
+    std = jnp.asarray([parse_float(std_r, 1.0), parse_float(std_g, 1.0),
+                       parse_float(std_b, 1.0)], jnp.float32)
+    return (x - mean[:, None, None]) / std[:, None, None] \
+        * parse_float(scale, 1.0)
+
+
 @register("_image_crop", aliases=("image_crop",))
 def crop(data, x=0, y=0, width=1, height=1):
     xx, yy = parse_int(x, 0), parse_int(y, 0)

@@ -348,6 +348,74 @@ class _NadamRule(_Rule):
             (new_m, new_v)
 
 
+class _FTMLRule(_Rule):
+    def hyper(self, opt):
+        h = super().hyper(opt)
+        h.update(beta1=float(opt.beta1), beta2=float(opt.beta2),
+                 epsilon=float(opt.epsilon))
+        return h
+
+    def state_arity(self, sig):
+        return 3                      # (d, v, z)
+
+    def extras(self, opt, indices):
+        """Per-member bias-correction scalars: the per-param op bakes the
+        step count ``t`` into its attrs (one recompile per step!); here
+        ``((1 - b1**t)/lr, 1 - b2**t)`` ride as traced arguments instead,
+        so advancing t never recompiles the group.  The divisions happen
+        host-side in float64 — exactly where the per-param op computes its
+        python-float constants — so the f32 roundings match."""
+        out = []
+        b1, b2 = opt.beta1, opt.beta2
+        lrs = opt._get_lrs(indices)
+        for lr, i in zip(lrs, indices):
+            t = opt._index_update_count[i]
+            out.append(((1. - b1 ** t) / lr, 1. - b2 ** t))
+        return out
+
+    def step(self, w, g, state, lr, wd, hyper, sig, extra=()):
+        (has_clip,) = sig
+        d, v, z = state
+        b1, b2 = hyper["beta1"], hyper["beta2"]
+        b1_corr_over_lr, b2_corr = extra
+        # per-param order (_apply_wd in ops/optimizer_ops.py ftml_update):
+        # rescale, clip, THEN + wd*w
+        g = _clip(g * hyper["rescale_grad"], hyper, has_clip) + wd * w
+        new_v = b2 * v + (1. - b2) * jnp.square(g)
+        d_t = b1_corr_over_lr * (jnp.sqrt(new_v / b2_corr)
+                                 + hyper["epsilon"])
+        sigma_t = d_t - b1 * d
+        new_z = b1 * z + (1. - b1) * g - sigma_t * w
+        return -new_z / d_t, (d_t, new_v, new_z)
+
+
+class _FtrlRule(_Rule):
+    def hyper(self, opt):
+        h = super().hyper(opt)
+        h.update(lamda1=float(opt.lamda1), beta=float(opt.beta))
+        return h
+
+    def state_arity(self, sig):
+        return 2                      # (z, n)
+
+    def step(self, w, g, state, lr, wd, hyper, sig, extra=()):
+        (has_clip,) = sig
+        z, n = state
+        # per-param order (ftrl_update): rescale, clip — NO wd on the grad
+        # (wd enters the proximal denominator below)
+        g = _clip(g * hyper["rescale_grad"], hyper, has_clip)
+        new_n = n + jnp.square(g)
+        sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+        new_z = z + g - sigma * w
+        l1 = hyper["lamda1"]
+        new_w = jnp.where(
+            jnp.abs(new_z) > l1,
+            -(new_z - jnp.sign(new_z) * l1)
+            / ((hyper["beta"] + jnp.sqrt(new_n)) / lr + wd),
+            jnp.zeros_like(w))
+        return new_w, (new_z, new_n)
+
+
 class _AdaGradRule(_Rule):
     def hyper(self, opt):
         h = super().hyper(opt)
@@ -370,8 +438,8 @@ def _rules():
     """Exact-class rule table, built lazily to dodge the import cycle with
     optimizer.py.  Exact ``type()`` match only: a subclass may override
     ``update`` and must keep the per-parameter path."""
-    from .optimizer import (SGD, NAG, Adam, AdaGrad, Adamax, Nadam, RMSProp,
-                            Signum)
+    from .optimizer import (FTML, SGD, NAG, Adam, AdaGrad, Adamax, Ftrl,
+                            Nadam, RMSProp, Signum)
     return {SGD: ("sgd", _SGDRule()),
             NAG: ("nag", _NAGRule()),
             Signum: ("signum", _SignumRule()),
@@ -379,7 +447,9 @@ def _rules():
             RMSProp: ("rmsprop", _RMSPropRule()),
             AdaGrad: ("adagrad", _AdaGradRule()),
             Adamax: ("adamax", _AdamaxRule()),
-            Nadam: ("nadam", _NadamRule())}
+            Nadam: ("nadam", _NadamRule()),
+            FTML: ("ftml", _FTMLRule()),
+            Ftrl: ("ftrl", _FtrlRule())}
 
 
 _RULES = None

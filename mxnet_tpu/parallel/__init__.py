@@ -16,7 +16,7 @@ ICI.  Axes:
 """
 from .mesh import make_mesh, device_mesh, current_mesh  # noqa: F401
 from .sharding import (  # noqa: F401
-    PartitionRule, infer_param_specs, named_sharding,
+    PartitionRule, infer_param_specs, named_sharding, data_shard_info,
 )
 from .optim import FunctionalOptimizer  # noqa: F401
 from .trainer import SPMDTrainer, make_train_step  # noqa: F401

@@ -12,7 +12,29 @@ from __future__ import annotations
 
 import re
 
-__all__ = ["PartitionRule", "infer_param_specs", "named_sharding"]
+__all__ = ["PartitionRule", "infer_param_specs", "named_sharding",
+           "data_shard_info"]
+
+
+def data_shard_info(mesh=None, axis="dp"):
+    """``(num_parts, part_index)`` for sharded record readers keyed off the
+    mesh's data axis (``io.RecordShardSampler.from_mesh``).
+
+    Input sharding is per *process*: every host feeding the data axis reads
+    a distinct contiguous shard of the record file, and the in-host split
+    across local devices happens at batch staging (``NamedSharding`` over
+    the axis).  Without a mesh — or when the mesh doesn't carry ``axis`` —
+    the shard is per JAX process, which degenerates to ``(1, 0)`` on a
+    single host.
+    """
+    import jax
+    import numpy as np
+
+    if mesh is None or axis not in getattr(mesh, "axis_names", ()):
+        return jax.process_count(), jax.process_index()
+    procs = sorted({d.process_index for d in np.ravel(mesh.devices)})
+    me = jax.process_index()
+    return len(procs), procs.index(me) if me in procs else 0
 
 
 class PartitionRule:

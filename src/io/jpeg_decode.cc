@@ -187,9 +187,76 @@ bool DecodeOne(const DecodeArgs& a, int i, std::vector<uint8_t>* rgb,
   return true;
 }
 
+// Decode image i straight to a fixed uint8 CHW canvas (whole-image bilinear
+// resize, no crop/mirror/normalize — those run as the device-side
+// augmentation prologue).  Returns false on corrupt input.
+bool DecodeOneU8(const uint8_t* blob, const uint64_t* offsets,
+                 const uint64_t* lengths, int i, int out_h, int out_w,
+                 uint8_t* out, std::vector<uint8_t>* rgb,
+                 std::vector<uint8_t>* tmp) {
+  int h = 0, w = 0;
+  if (!DecodeJpeg(blob + offsets[i], lengths[i], rgb, &h, &w)) {
+    return false;
+  }
+  if (h != out_h || w != out_w) {
+    tmp->resize(static_cast<size_t>(out_h) * out_w * 3);
+    ResizeBilinear(rgb->data(), h, w, tmp->data(), out_h, out_w);
+    rgb->swap(*tmp);
+  }
+  uint8_t* dst = out + static_cast<size_t>(i) * 3 * out_h * out_w;
+  const size_t plane = static_cast<size_t>(out_h) * out_w;
+  const uint8_t* src = rgb->data();
+  for (int y = 0; y < out_h; ++y) {
+    for (int x = 0; x < out_w; ++x) {
+      const size_t px = static_cast<size_t>(y) * out_w + x;
+      dst[0 * plane + px] = src[px * 3 + 0];
+      dst[1 * plane + px] = src[px * 3 + 1];
+      dst[2 * plane + px] = src[px * 3 + 2];
+    }
+  }
+  return true;
+}
+
 }  // namespace
 
 extern "C" {
+
+// Decode a batch of JPEG payloads to fixed-canvas uint8 CHW RGB (the
+// shared-memory ring-slot layout of the multi-process pipeline; augmentation
+// happens later, on device).  Returns 0 on success, -(1+i) on bad payload i.
+int64_t jpg_decode_batch_u8(const uint8_t* blob, const uint64_t* offsets,
+                            const uint64_t* lengths, int n, int out_h,
+                            int out_w, int n_threads, uint8_t* out) {
+  std::atomic<int> next{0};
+  std::atomic<int64_t> fail{0};
+  auto worker = [&]() {
+    std::vector<uint8_t> rgb, tmp;
+    int i;
+    while ((i = next.fetch_add(1)) < n) {
+      bool ok = false;
+      try {
+        ok = DecodeOneU8(blob, offsets, lengths, i, out_h, out_w, out,
+                         &rgb, &tmp);
+      } catch (...) {
+        ok = false;
+      }
+      if (!ok) {
+        int64_t expected = 0;
+        fail.compare_exchange_strong(expected, -(1 + int64_t(i)));
+      }
+    }
+  };
+  const int nt = std::max(1, std::min(n_threads, n));
+  if (nt == 1) {
+    worker();
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(nt);
+    for (int t = 0; t < nt; ++t) threads.emplace_back(worker);
+    for (auto& t : threads) t.join();
+  }
+  return fail.load();
+}
 
 // Decode+augment a batch of JPEG payloads into float32 CHW RGB.
 // Returns 0 on success, -(1+i) if payload i failed to decode.

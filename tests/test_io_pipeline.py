@@ -1,0 +1,421 @@
+"""Multi-process input pipeline (ISSUE 6 tentpole): shared-memory ring
+decode, bitwise determinism vs the thread path, worker-death handling,
+sharded readers, and the device-side augmentation prologue."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio, telemetry
+from mxnet_tpu.image import DeviceAugmenter
+from mxnet_tpu.io import RecordShardSampler, ShmRing
+from mxnet_tpu.resilience import InjectedFault, faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_bus():
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+N_IMG, HW = 96, 64
+
+
+@pytest.fixture(scope="module")
+def rec_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("iopipe") / "data.rec")
+    rng = np.random.RandomState(0)
+    rec = recordio.MXRecordIO(path, "w")
+    img = (rng.rand(HW, HW, 3) * 255).astype("uint8")
+    for i in range(N_IMG):
+        img[i % HW, :, :] = (i * 37) % 255
+        header = recordio.IRHeader(0, float(i % 10), i, 0)
+        rec.write(recordio.pack_img(header, img, quality=85))
+    rec.close()
+    return path
+
+
+def _epoch(it, with_aug=False):
+    out = []
+    for b in it:
+        row = [b.data[0].asnumpy().copy(), b.label[0].asnumpy().copy(),
+               b.pad]
+        if with_aug:
+            row += [b.augment_flip.copy(), b.augment_crop.copy()]
+        out.append(row)
+    return out
+
+
+def _no_shm_leaks():
+    if not os.path.isdir("/dev/shm"):
+        return True
+    return not [f for f in os.listdir("/dev/shm") if f.startswith("mxio")]
+
+
+# ------------------------------------------------------------------ shm ring
+def test_shm_ring_lifecycle():
+    ring = ShmRing(3, 1024)
+    slots = [ring.acquire() for _ in range(3)]
+    assert ring.acquire() is None and ring.in_flight == 3
+    v = ring.view(slots[0], (256,), np.uint32)
+    v[:] = 7
+    assert ring.view(slots[0], (256,), np.uint32)[100] == 7
+    for s in slots:
+        ring.release(s)
+    assert ring.in_flight == 0
+    ring.destroy()
+    ring.destroy()          # idempotent
+    assert _no_shm_leaks()
+
+
+# -------------------------------------------------------------- determinism
+def test_multiprocess_bitwise_matches_thread_path(rec_path):
+    """Fixed shuffle seed → multi-process epochs are bitwise-identical to
+    the single-process thread path, across two epochs (ISSUE 6 satellite)."""
+    kw = dict(path_imgrec=rec_path, data_shape=(3, 48, 48), batch_size=16,
+              shuffle=True, rand_mirror=True, rand_crop=True, seed=11)
+    it_thread = mx.io.ImageRecordIter(**kw)
+    it_mp = mx.io.ImageRecordIter(preprocess_processes=2, **kw)
+    try:
+        for _epoch_i in range(2):
+            a = _epoch(it_thread)
+            b = _epoch(it_mp)
+            assert len(a) == len(b) == (N_IMG + 15) // 16
+            for (da, la, pa), (db, lb, pb) in zip(a, b):
+                assert pa == pb
+                np.testing.assert_array_equal(la, lb)
+                np.testing.assert_array_equal(da, db)
+            it_thread.reset()
+            it_mp.reset()
+    finally:
+        it_thread.close()
+        it_mp.close()
+    assert _no_shm_leaks()
+
+
+@pytest.mark.parametrize("pattern", ["reset_before_use", "mid_epoch"])
+def test_multiprocess_rng_parity_across_resets(rec_path, pattern):
+    """The pool pre-draws flip/crop randomness at dispatch time; a reset
+    before or mid-epoch must rewind to where the thread path's lazy draws
+    would be (regression: DevicePrefetchIter resets the iterator before
+    first use, which used to skip a ring's worth of draws)."""
+    kw = dict(path_imgrec=rec_path, data_shape=(3, 48, 48), batch_size=16,
+              shuffle=True, rand_mirror=True, rand_crop=True, seed=23)
+
+    def run(procs):
+        it = mx.io.ImageRecordIter(preprocess_processes=procs, **kw)
+        try:
+            if pattern == "reset_before_use":
+                it.reset()
+            else:
+                for _ in range(2):       # consume part of the epoch...
+                    next(it)
+                it.reset()               # ...then abandon it
+            return [b.data[0].asnumpy().copy() for b in it]
+        finally:
+            it.close()
+
+    for a, b in zip(run(0), run(2)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_processes_zero_is_the_thread_path(rec_path):
+    """``preprocess_processes=0`` must not even construct pipeline state —
+    the pre-PR dispatch path, byte for byte."""
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 48, 48),
+                               batch_size=16)
+    try:
+        assert it._pipeline is None and it._pool is not None
+        batch = next(it)
+        assert batch.data[0].shape == (16, 3, 48, 48)
+    finally:
+        it.close()
+
+
+def test_multiprocess_telemetry_counters(rec_path):
+    telemetry.enable()
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 48, 48),
+                               batch_size=16, preprocess_processes=2)
+    try:
+        n = sum(1 for _ in it)
+        c = telemetry.snapshot()["counters"]
+        assert c["io.record_batches"] == n
+        assert c["io.staging_bytes"] > 0
+        assert "io.proc_decode_wait_ms" in c
+        assert "io.proc_decode_ms" in c
+        gauges = telemetry.snapshot()["gauges"]
+        assert any(k.startswith("io.shm_ring_occupancy") for k in gauges)
+    finally:
+        it.close()
+
+
+# ------------------------------------------------------------- worker death
+def test_worker_death_raises_bounded_not_hangs(rec_path):
+    """A killed decode worker surfaces as a sticky RuntimeError within the
+    bounded wait — the training loop must never hang (ISSUE 6 satellite)."""
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 48, 48),
+                               batch_size=8, preprocess_processes=2,
+                               pipeline_timeout=15)
+    try:
+        next(it)
+        os.kill(it._pipeline._procs[0].pid, 9)
+        t0 = time.time()
+        with pytest.raises(RuntimeError, match="died"):
+            for _ in range(40):
+                next(it)
+        assert time.time() - t0 < 10.0, "death detection must be bounded"
+        with pytest.raises(RuntimeError):
+            next(it)        # sticky: keeps raising, never misreports EOF
+    finally:
+        it.close()
+    assert _no_shm_leaks()
+
+
+def test_worker_respawn_completes_epoch(rec_path):
+    """``worker_respawn=True`` re-forks a dead worker (RetryPolicy backoff),
+    requeues its lost batch, and the epoch completes with every batch."""
+    telemetry.enable()
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 48, 48),
+                               batch_size=8, preprocess_processes=2,
+                               worker_respawn=True, pipeline_timeout=30)
+    try:
+        seen = 0
+        for i, _b in enumerate(it):
+            if i == 1:
+                os.kill(it._pipeline._procs[1].pid, 9)
+            seen += 1
+        assert seen == N_IMG // 8
+        assert telemetry.counter_value("io.worker_respawns") >= 1
+        it.reset()
+        assert sum(1 for _ in it) == seen      # next epoch is healthy too
+    finally:
+        it.close()
+    assert _no_shm_leaks()
+
+
+def test_injected_worker_crash_fault_site(rec_path):
+    """``io.shm_slot`` faults hard-kill the worker process (os._exit) — the
+    parent's death path and shm teardown run against a real crash."""
+    with faults.scope("io.shm_slot:fail:1"):
+        it = mx.io.ImageRecordIter(
+            path_imgrec=rec_path, data_shape=(3, 48, 48), batch_size=8,
+            preprocess_processes=2, pipeline_timeout=15)
+        try:
+            with pytest.raises(RuntimeError, match="died"):
+                for _ in it:
+                    pass
+        finally:
+            it.close()
+    assert _no_shm_leaks()
+
+
+def test_injected_spawn_fault(rec_path):
+    """``io.worker_spawn`` faults fire in the parent at process start."""
+    with faults.scope("io.worker_spawn:fail:1"):
+        with pytest.raises(InjectedFault):
+            mx.io.ImageRecordIter(
+                path_imgrec=rec_path, data_shape=(3, 48, 48), batch_size=8,
+                preprocess_processes=2)
+    assert _no_shm_leaks()
+
+
+def test_decode_error_is_per_batch_not_sticky(rec_path, tmp_path):
+    """A corrupt record raises once for ITS batch (with the worker
+    traceback) and the pipeline keeps serving later batches — the thread
+    path's contract, where the pool survives a bad record."""
+    from mxnet_tpu.io import BatchDecodeError
+    bad = str(tmp_path / "bad.rec")
+    with open(rec_path, "rb") as f:
+        blob = bytearray(f.read())
+    blob[40:160] = b"\x5a" * 120        # stomp the first image's payload
+    with open(bad, "wb") as f:
+        f.write(bytes(blob))
+    it = mx.io.ImageRecordIter(path_imgrec=bad, data_shape=(3, 48, 48),
+                               batch_size=8, preprocess_processes=2,
+                               pipeline_timeout=15)
+    try:
+        with pytest.raises(BatchDecodeError, match="worker"):
+            next(it)                     # batch 0 carries the bad record
+        rest = sum(1 for _ in it)        # the remaining batches still flow
+        assert rest == N_IMG // 8 - 1
+        it.reset()                       # and the next epoch works too
+        with pytest.raises(BatchDecodeError):
+            next(it)
+        assert sum(1 for _ in it) == N_IMG // 8 - 1
+    finally:
+        it.close()
+    assert _no_shm_leaks()
+
+
+def test_device_augment_rand_crop_needs_margin(rec_path):
+    """rand_crop with a canvas equal to the crop target would silently
+    skip cropping on device — construction must refuse instead."""
+    with pytest.raises(ValueError, match="crop margin"):
+        mx.io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 48, 48),
+                              batch_size=8, rand_crop=True,
+                              device_augment=True)
+
+
+# ---------------------------------------------------------- sharded readers
+def test_record_shard_sampler_partitions():
+    parts = [RecordShardSampler(3, i).shard(10) for i in range(3)]
+    covered = sorted(sum((list(range(10))[s] for s in parts), []))
+    assert covered == list(range(10))
+    with pytest.raises(ValueError):
+        RecordShardSampler(2, 2)
+
+
+def test_record_shard_sampler_from_mesh():
+    from mxnet_tpu.parallel import data_shard_info, make_mesh
+    mesh = make_mesh(n_devices=1, dp=1)
+    assert data_shard_info(mesh, axis="dp") == (1, 0)
+    assert data_shard_info(None) == (1, 0)       # single-process fallback
+    s = RecordShardSampler.from_mesh(mesh)
+    assert (s.num_parts, s.part_index) == (1, 0)
+
+
+def test_shard_overrides_parts(rec_path):
+    """``shard=`` routes through the same contiguous (num_parts, part_index)
+    split as the reference kParts handling — both pipeline modes."""
+    full = mx.io.ImageRecordIter(path_imgrec=rec_path,
+                                 data_shape=(3, 48, 48), batch_size=8)
+    labels = [l for b in full for l in b.label[0].asnumpy()]
+    full.close()
+    for procs in (0, 2):
+        got = []
+        for part in range(2):
+            it = mx.io.ImageRecordIter(
+                path_imgrec=rec_path, data_shape=(3, 48, 48), batch_size=8,
+                shard=RecordShardSampler(2, part),
+                preprocess_processes=procs)
+            assert it.num_data == N_IMG // 2
+            got.extend(l for b in it for l in b.label[0].asnumpy())
+            it.close()
+        assert got == labels
+    assert _no_shm_leaks()
+
+
+# ------------------------------------------------- device augment prologue
+def test_device_augment_matches_host_augment(rec_path):
+    """uint8 canvas + jitted prologue == the host-augmented batch (crop,
+    mirror, normalize, widen), with ZERO steady-state compile misses."""
+    telemetry.enable()
+    kw = dict(path_imgrec=rec_path, data_shape=(3, 48, 48), batch_size=16,
+              rand_mirror=True, rand_crop=True, resize=56, seed=5,
+              mean_r=10., mean_g=20., mean_b=30.,
+              std_r=2., std_g=3., std_b=4., scale=0.5)
+    it_dev = mx.io.ImageRecordIter(device_augment=True,
+                                   preprocess_processes=2, **kw)
+    it_host = mx.io.ImageRecordIter(**kw)
+    aug = it_dev.augmenter
+    try:
+        n = 0
+        for bd, bh in zip(it_dev, it_host):
+            assert bd.data[0].dtype == np.uint8
+            x = aug(bd.data[0].asnumpy(), bd.augment_flip, bd.augment_crop)
+            np.testing.assert_allclose(np.asarray(x),
+                                       bh.data[0].asnumpy(),
+                                       rtol=1e-5, atol=1e-4)
+            n += 1
+        assert n == N_IMG // 16
+        assert aug.compile_misses == 1
+        assert telemetry.counter_value("io.augment_compile_miss") == 1
+        # second epoch: zero new misses (the steady-state contract)
+        it_dev.reset()
+        for bd in it_dev:
+            aug(bd.data[0].asnumpy(), bd.augment_flip, bd.augment_crop)
+        assert aug.compile_misses == 1
+    finally:
+        it_dev.close()
+        it_host.close()
+    assert _no_shm_leaks()
+
+
+def test_device_augment_thread_path_matches_mp(rec_path):
+    """``device_augment=True`` with procs=0 (in-process canvas decode)
+    produces the same uint8 canvases as the worker path."""
+    kw = dict(path_imgrec=rec_path, data_shape=(3, 48, 48), batch_size=16,
+              device_augment=True, rand_mirror=True, seed=2)
+    a = mx.io.ImageRecordIter(**kw)
+    b = mx.io.ImageRecordIter(preprocess_processes=2, **kw)
+    try:
+        for ba, bb in zip(a, b):
+            np.testing.assert_array_equal(ba.data[0].asnumpy(),
+                                          bb.data[0].asnumpy())
+            np.testing.assert_array_equal(ba.augment_flip, bb.augment_flip)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_augment_prologue_fuses_into_engine_segments():
+    """The prologue dispatches as a capturable op: under ``engine.bulk`` it
+    lands in a fused segment with downstream eager ops (PR 5 integration)."""
+    from mxnet_tpu import engine
+    telemetry.enable()
+    aug = DeviceAugmenter((8, 8), rand_mirror=True)
+    x8 = mx.nd.array(np.random.RandomState(0).randint(
+        0, 255, (2, 3, 10, 10)).astype("uint8"))
+    flips = np.array([1, 0])
+    crops = np.zeros((2, 2), "float32")
+    ref = aug(x8, flips, crops).asnumpy() * 2.0
+    c0 = telemetry.counter_value("dispatch.ops_fused") or 0
+    with engine.bulk(8):
+        y = aug(x8, flips, crops) * 2.0
+        from mxnet_tpu.engine.recorder import LazyData
+        assert type(y._data) is LazyData      # captured, not dispatched
+        out = y.asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    assert (telemetry.counter_value("dispatch.ops_fused") or 0) >= c0 + 2
+
+
+def test_staged_batches_survive_slot_recycling(rec_path):
+    """Regression: the CPU backend's device_put zero-copy-aliases
+    page-aligned host buffers, so handing out raw slot views would let a
+    recycled slot corrupt batches the consumer still references.  The
+    default (copying) mode must keep every staged batch intact even when
+    read long after its slot was rewritten."""
+    kw = dict(path_imgrec=rec_path, data_shape=(3, 48, 48), batch_size=16)
+    ref_it = mx.io.ImageRecordIter(**kw)
+    ref = [b.data[0].asnumpy().copy() for b in ref_it]
+    ref_it.close()
+    it = mx.io.ImageRecordIter(preprocess_processes=2, **kw)
+    try:
+        staged = [b.data[0] for b in it]      # hold EVERY batch's NDArray
+        assert len(staged) == len(ref)
+        for got, want in zip(staged, ref):    # read after full epoch
+            np.testing.assert_array_equal(got.asnumpy(), want)
+    finally:
+        it.close()
+
+
+def test_device_prefetch_over_multiprocess_iterator(rec_path):
+    """The zero-copy staging chain end-to-end: shm slot view →
+    ``DevicePrefetchIter`` double-buffered device_put → device prologue."""
+    import jax
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 48, 48),
+                               batch_size=16, device_augment=True,
+                               rand_mirror=True, preprocess_processes=2)
+    aug = it.augmenter
+
+    def stage(b):
+        return (jax.device_put(b.data[0]._data),
+                jax.device_put(b.label[0]._data),
+                b.augment_flip, b.augment_crop)
+
+    pit = mx.io.DevicePrefetchIter(it, stage, depth=2)
+    try:
+        n = 0
+        for x, y, flips, crops in pit:
+            out = aug(x, flips, crops)
+            assert out.shape == (16, 3, 48, 48)
+            n += 1
+        assert n == N_IMG // 16
+    finally:
+        it.close()
+    assert _no_shm_leaks()

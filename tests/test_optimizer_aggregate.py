@@ -56,6 +56,10 @@ def _run_steps(updater, w_np, g_np, steps=3, dtype="float32"):
     ("nadam", {"learning_rate": 0.001, "wd": 0.01}),
     ("nadam", {"learning_rate": 0.001, "clip_gradient": 0.1,
                "schedule_decay": 0.01}),
+    ("ftml", {"learning_rate": 0.01, "wd": 0.01}),
+    ("ftml", {"learning_rate": 0.01, "clip_gradient": 0.1, "beta1": 0.7}),
+    ("ftrl", {"learning_rate": 0.1, "wd": 0.01, "lamda1": 0.02}),
+    ("ftrl", {"learning_rate": 0.1, "clip_gradient": 0.1, "beta": 0.5}),
 ])
 def test_aggregated_matches_per_param(name, kwargs):
     np.random.seed(0)
@@ -64,9 +68,14 @@ def test_aggregated_matches_per_param(name, kwargs):
     u1, u2 = _updater_pair(name, **kwargs)
     ws1 = _run_steps(u1, w_np, g_np)
     ws2 = _run_steps(u2, w_np, g_np)
+    # FTML's z update (b1*z + (1-b1)*g - sigma*w) cancels catastrophically,
+    # amplifying the ulp-level rounding drift between the per-param op's
+    # baked f64 python constants and the group's traced f32 scalars; every
+    # other rule sits inside the tight tolerance
+    rtol, atol = (2e-4, 1e-5) if name == "ftml" else (1e-5, 1e-6)
     for a, b in zip(ws1, ws2):
         np.testing.assert_allclose(a.asnumpy(), b.asnumpy(),
-                                   rtol=1e-5, atol=1e-6)
+                                   rtol=rtol, atol=atol)
     # optimizer state (momentum/mean/var/...) matches too
     for i in u1.states:
         l1 = aggregate._state_leaves(u1.states[i])
@@ -74,7 +83,7 @@ def test_aggregated_matches_per_param(name, kwargs):
         assert len(l1) == len(l2)
         for s1, s2 in zip(l1, l2):
             np.testing.assert_allclose(s1.asnumpy(), s2.asnumpy(),
-                                       rtol=1e-5, atol=1e-6)
+                                       rtol=rtol, atol=atol)
 
 
 def test_nadam_m_schedule_tracks_per_param():
@@ -131,10 +140,31 @@ def test_nadam_mixed_precision_takes_per_param_path():
         >= len(shapes)
 
 
+def test_ftml_t_rides_in_extras_not_recompiles():
+    """FTML's per-param op bakes the step count t into its attrs (one jit
+    entry per t value); the aggregated rule must hand the bias corrections
+    over as traced extras, so 5 steps + an lr change compile exactly once
+    (ISSUE 6 satellite)."""
+    aggregate.clear_cache()
+    telemetry.reset()
+    telemetry.enable()
+    o = opt.create("ftml", learning_rate=0.01)
+    ws = [nd.array(np.ones(s, np.float32)) for s in SHAPES]
+    gs = [nd.array(np.full(s, 0.1, np.float32)) for s in SHAPES]
+    u = opt.get_updater(o)
+    idx = list(range(len(ws)))
+    for step in range(5):
+        if step == 3:
+            o.set_learning_rate(0.005)
+        u(idx, gs, ws)
+    assert telemetry.counter_value("optimizer.compile_misses") == 1
+    assert telemetry.counter_value("optimizer.fallback_params") == 0
+
+
 def test_adamax_nadam_zero_steady_state_misses():
     """Both new rules ride the compiled-group cache: step 1 compiles,
     later steps (and lr changes) add zero compile misses."""
-    for name in ("adamax", "nadam"):
+    for name in ("adamax", "nadam", "ftml", "ftrl"):
         aggregate.clear_cache()   # group sigs may be warm from other tests
         telemetry.reset()
         telemetry.enable()
