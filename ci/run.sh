@@ -29,8 +29,16 @@
 #                io.record_batches, zero steady-state augment compile
 #                misses) and a clean-teardown sweep of /dev/shm — both on
 #                a healthy run and under an injected worker crash
+#   analyze    - static-analysis gate + runtime sanitizer smoke: the
+#                jax-free tools/analyze.py pass over mxnet_tpu/ must report
+#                zero findings outside ci/analysis_baseline.txt, then
+#                test_analysis.py and an MXNET_SANITIZE=donation,slots
+#                smoke: a planted use-after-donate and a post-release
+#                shm-slot read must both raise with their sites named
+#                while a clean aggregated train step passes with zero
+#                violations
 # Usage: ci/run.sh [stage ...]   (default: unit gate telemetry optimizer
-#                                 serving resilience engine io)
+#                                 serving resilience engine io analyze)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -373,9 +381,50 @@ print("io smoke ok:", int(c["io.record_batches"]), "batches,",
 PY
 }
 
+stage_analyze() {
+  # static gate first: pure-ast, no jax import (the launcher asserts it)
+  python tools/analyze.py --root mxnet_tpu \
+    --baseline ci/analysis_baseline.txt -q
+  JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q
+  JAX_PLATFORMS=cpu MXNET_SANITIZE=donation,slots python - <<'PY'
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.analysis import sanitizer as san
+from mxnet_tpu.optimizer import aggregate
+
+assert san.active and san.donation and san.slots, \
+    "MXNET_SANITIZE=donation,slots must arm both modes at import"
+
+opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+opt.aggregate_num = 16
+ws = [mx.nd.array(np.random.rand(16, 16).astype("float32"))
+      for _ in range(8)]
+gs = [mx.nd.array(np.random.rand(16, 16).astype("float32"))
+      for _ in range(8)]
+ss = [opt.create_state_multi_precision(i, w) for i, w in enumerate(ws)]
+stale = ws[0].detach()
+
+# clean steps under the sanitizer: zero violations, handles readable
+for _ in range(3):
+    aggregate.update_multi(opt, list(range(8)), ws, gs, ss)
+    _ = [w.asnumpy() for w in ws]
+assert san.stats()["violations"] == 0, san.stats()
+
+# planted use-after-donate: must raise and name the aggregated group
+try:
+    stale.asnumpy()
+    raise AssertionError("use-after-donate must raise under the sanitizer")
+except san.DonatedBufferError as e:
+    assert "optimizer.aggregate group 'sgd'" in str(e), e
+assert san.stats()["poisoned"] > 0 and san.stats()["violations"] == 1
+print("analyze smoke ok:", san.stats()["poisoned"], "poisoned buffers,",
+      "1 planted violation caught, clean steps zero findings")
+PY
+}
+
 stages=("$@")
 [ $# -eq 0 ] && stages=(unit gate telemetry optimizer serving resilience
-                        engine io)
+                        engine io analyze)
 for s in "${stages[@]}"; do
   echo "=== ci stage: $s ==="
   "stage_$s"

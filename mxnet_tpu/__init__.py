@@ -65,6 +65,7 @@ from . import contrib  # noqa: F401
 from . import models  # noqa: F401
 from . import serving  # noqa: F401
 from . import resilience  # noqa: F401
+from . import analysis  # noqa: F401
 
 from .ndarray import op_namespaces as _ns
 

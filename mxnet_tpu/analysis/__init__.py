@@ -1,0 +1,39 @@
+"""Static analysis + runtime sanitizer for the framework's own invariants
+(ISSUE 8).
+
+The reference engine enforced its correctness contracts mechanically
+(write-dependency vars, WaitToRead fences); the TPU-native rebuild's
+equivalents — donated jit calls, segment capture, shm-slot lifetimes,
+cross-thread state — are Python conventions.  This package enforces them:
+
+- :mod:`.core` + the four checkers (:mod:`.donation`, :mod:`.capture`,
+  :mod:`.recompile`, :mod:`.locks`) — pure-``ast`` static passes with
+  stable fingerprints gated against ``ci/analysis_baseline.txt``.
+  Run standalone (no jax import): ``python tools/analyze.py``; or inside
+  the framework: ``python -m mxnet_tpu.analysis``.
+- :mod:`.sanitizer` — the opt-in runtime half
+  (``MXNET_SANITIZE=donation,slots``): poisons buffers handed to donated
+  jit calls so any later read raises *with the donation site named*, and
+  enforces the ``zero_copy_batches=True`` shm-slot lifetime contract
+  (reads of a recycled slot raise instead of returning corrupt pixels).
+
+See docs/analysis.md for the checker catalog, the baseline workflow and
+the sanitizer mode matrix.
+"""
+from . import capture  # noqa: F401
+from . import core  # noqa: F401
+from . import donation  # noqa: F401
+from . import locks  # noqa: F401
+from . import recompile  # noqa: F401
+from . import sanitizer  # noqa: F401
+from .cli import main  # noqa: F401
+from .core import CHECKERS, Finding, load_baseline, run_checkers  # noqa: F401
+from .sanitizer import (  # noqa: F401
+    DonatedBufferError,
+    SanitizerError,
+    StaleSlotError,
+)
+
+__all__ = ["core", "donation", "capture", "recompile", "locks", "sanitizer",
+           "main", "run_checkers", "load_baseline", "CHECKERS", "Finding",
+           "SanitizerError", "DonatedBufferError", "StaleSlotError"]

@@ -1,0 +1,262 @@
+"""Checker (b): capture safety inside traced code.
+
+Two hazard families the engine/bulking work (PR 5/6) made load-bearing:
+
+1. **Tracer escapes** — a jit-traced body storing a traced value somewhere
+   that outlives the trace (``self`` attributes, module globals, closure
+   mutations).  The stored object is a ``jax.core.Tracer``; touching it
+   after the trace finishes raises ``UnexpectedTracerError`` — usually far
+   from the escape site.
+2. **Materialization inside traced/bulk-capturable code** — ``asnumpy()``/
+   ``item()``/``float()``/``bool()``/``np.asarray`` force a device sync.
+   Inside a jitted body they fail on tracers; inside an op registered for
+   engine bulking (``bulk=True``, the default for ``ops/`` kernels) they
+   would force the recorder's segment to flush mid-capture, silently
+   destroying the fusion win.
+
+What counts as a traced body:
+
+- functions decorated with ``jit``/``jax.jit``/``partial(jax.jit, ...)``;
+- local functions that are *passed to* ``jax.jit(...)`` anywhere in the
+  same module;
+- every module-level function in ``mxnet_tpu/ops/`` decorated with
+  ``@register(...)`` — those run under the per-op jit cache AND inside
+  fused engine segments.  For registered ops, parameters without defaults
+  are array inputs by repo convention (``ndarray/register.py``), so
+  ``float(x)``/``bool(x)``/``if x:`` on those parameters is also flagged.
+
+Rules: ``tracer-escape-self``, ``tracer-escape-global``,
+``tracer-escape-closure``, ``materialize-in-jit``, ``materialize-in-op``,
+``bool-coerce-in-op``.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, call_name, dotted_name, scope_functions, unparse
+
+CHECKER = "capture"
+
+_MATERIALIZERS = ("asnumpy", "asscalar", "item", "tolist",
+                  "block_until_ready", "copy_to_host_async")
+# NOTE: no "update" — ``optimizer.update(...)``-style pure APIs share the
+# name with dict.update and would drown the signal
+_MUTATORS = ("append", "extend", "add", "setdefault", "insert",
+             "appendleft")
+
+
+def _is_jit_decorator(dec):
+    """``@jit`` / ``@jax.jit`` / ``@partial(jax.jit, ...)`` /
+    ``@functools.partial(jit, ...)``."""
+    if isinstance(dec, ast.Call):
+        name = call_name(dec)
+        if name in ("jit", "pjit"):
+            return True
+        if name == "partial" and dec.args:
+            inner = dec.args[0]
+            return dotted_name(inner) in ("jit", "jax.jit", "pjit",
+                                          "jax.pjit")
+        return False
+    return dotted_name(dec) in ("jit", "jax.jit", "pjit", "jax.pjit")
+
+
+def _is_register_decorator(dec):
+    if isinstance(dec, ast.Call):
+        return call_name(dec) == "register"
+    return False
+
+
+def _jitted_names(tree):
+    """Names of local functions passed to jax.jit(...) in this module."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and call_name(node) in ("jit", "pjit"):
+            if node.args and isinstance(node.args[0], ast.Name):
+                out.add(node.args[0].id)
+    return out
+
+
+def _local_bindings(fn):
+    """Names bound inside ``fn`` (params, assignments, loop targets, withs,
+    comprehensions) — everything NOT in here that gets mutated is a closure
+    or global escape candidate."""
+    names = {a.arg for a in fn.args.args + fn.args.posonlyargs
+             + fn.args.kwonlyargs}
+    if fn.args.vararg:
+        names.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        names.add(fn.args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.comprehension):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+    return names
+
+
+def _array_params(fn):
+    """Repo convention for registered ops: parameters without defaults are
+    the array inputs."""
+    args = fn.args.args + fn.args.posonlyargs
+    n_defaults = len(fn.args.defaults)
+    tail = args[len(args) - n_defaults:] if n_defaults else []
+    defaulted = {a.arg for a in tail}
+    return [a.arg for a in args
+            if a.arg not in defaulted and a.arg not in ("self", "cls")]
+
+
+def _check_traced_body(mod, qualname, fn, add, kind, array_params=()):
+    """Shared body scan for jitted functions and registered ops.
+    ``kind`` is "jit" or "op"."""
+    local = _local_bindings(fn)
+    globals_declared = set()
+    nonlocals_declared = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            globals_declared.update(node.names)
+        elif isinstance(node, ast.Nonlocal):
+            nonlocals_declared.update(node.names)
+
+    for node in ast.walk(fn):
+        # --- stores that outlive the trace
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                base = tgt
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                if isinstance(base, ast.Attribute):
+                    root = base
+                    while isinstance(root.value, ast.Attribute):
+                        root = root.value
+                    if isinstance(root.value, ast.Name) and \
+                            root.value.id in ("self", "cls"):
+                        add(Finding(
+                            CHECKER, "tracer-escape-self", mod.path,
+                            qualname, unparse(tgt), tgt.lineno,
+                            f"traced body stores to {unparse(tgt)}: a "
+                            f"tracer escapes the jit scope via the "
+                            f"instance"))
+                elif isinstance(base, ast.Name):
+                    if base.id in globals_declared:
+                        add(Finding(
+                            CHECKER, "tracer-escape-global", mod.path,
+                            qualname, base.id, tgt.lineno,
+                            f"traced body assigns module global "
+                            f"{base.id!r}: a tracer escapes the jit "
+                            f"scope"))
+                    elif base.id in nonlocals_declared:
+                        add(Finding(
+                            CHECKER, "tracer-escape-closure", mod.path,
+                            qualname, base.id, tgt.lineno,
+                            f"traced body assigns nonlocal {base.id!r}: "
+                            f"a tracer escapes into the enclosing scope"))
+                    elif isinstance(node, (ast.AugAssign,)) and \
+                            base.id not in local:
+                        add(Finding(
+                            CHECKER, "tracer-escape-closure", mod.path,
+                            qualname, base.id, tgt.lineno,
+                            f"traced body augments free variable "
+                            f"{base.id!r} from the enclosing scope"))
+        # --- closure-mutating calls: outer.append(x), outer[k] = ... above
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id not in local:
+            add(Finding(
+                CHECKER, "tracer-escape-closure", mod.path, qualname,
+                f"{node.func.value.id}.{node.func.attr}", node.lineno,
+                f"traced body mutates free variable "
+                f"{node.func.value.id!r} via .{node.func.attr}(): traced "
+                f"values escape into host state"))
+        # --- materialization calls
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MATERIALIZERS:
+                add(Finding(
+                    CHECKER, f"materialize-in-{kind}", mod.path, qualname,
+                    unparse(node.func), node.lineno,
+                    f"{unparse(node.func)}() forces a host sync inside a "
+                    + ("jitted body (fails on tracers)" if kind == "jit"
+                       else "bulk-capturable op (forces a mid-segment "
+                            "flush)")))
+            name = call_name(node)
+            if name in ("asarray", "array") and \
+                    dotted_name(node.func) in ("np.asarray", "np.array",
+                                               "numpy.asarray",
+                                               "numpy.array",
+                                               "_np.asarray", "_np.array"):
+                if node.args and isinstance(node.args[0], ast.Name) and \
+                        (kind == "jit" or node.args[0].id in array_params):
+                    add(Finding(
+                        CHECKER, f"materialize-in-{kind}", mod.path,
+                        qualname, unparse(node.func), node.lineno,
+                        f"{unparse(node.func)}() materializes "
+                        f"{node.args[0].id!r} to host numpy inside a "
+                        f"traced body"))
+            if kind == "op" and name in ("float", "int", "bool") and \
+                    isinstance(node.func, ast.Name) and \
+                    len(node.args) == 1 and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id in array_params:
+                add(Finding(
+                    CHECKER, "materialize-in-op", mod.path, qualname,
+                    f"{name}({node.args[0].id})", node.lineno,
+                    f"{name}() on array input {node.args[0].id!r} "
+                    f"concretizes the value — fails under jit and "
+                    f"breaks segment capture"))
+        # --- boolean coercion of array inputs in op bodies
+        if kind == "op" and isinstance(node, (ast.If, ast.While)):
+            test = node.test
+            cands = [test] + (test.values if isinstance(test, ast.BoolOp)
+                              else [])
+            for c in cands:
+                if isinstance(c, ast.Name) and c.id in array_params:
+                    add(Finding(
+                        CHECKER, "bool-coerce-in-op", mod.path, qualname,
+                        c.id, node.lineno,
+                        f"`if {c.id}:` coerces array input {c.id!r} to "
+                        f"bool — fails on tracers; compare explicitly or "
+                        f"branch on an attr"))
+
+
+def check(mod):
+    findings = []
+    seen = set()
+
+    def add(f):
+        key = (f.fingerprint, f.line)
+        if key not in seen:
+            seen.add(key)
+            findings.append(f)
+
+    jitted = _jitted_names(mod.tree)
+    # jax.jit(name) matching is by bare name; exclude class methods from
+    # that match (a method is passed as self.foo, never a bare Name — a
+    # same-named method elsewhere in the module is a different function)
+    method_names = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    method_names.add(id(sub))
+    is_ops_module = "/ops/" in mod.path.replace("\\", "/") and \
+        not mod.path.endswith("registry.py")
+    for qualname, fn in scope_functions(mod.tree):
+        decorated_jit = any(_is_jit_decorator(d) for d in fn.decorator_list)
+        registered = any(_is_register_decorator(d)
+                         for d in fn.decorator_list)
+        if decorated_jit or (fn.name in jitted
+                             and id(fn) not in method_names):
+            _check_traced_body(mod, qualname, fn, add, "jit")
+        elif registered and is_ops_module:
+            _check_traced_body(mod, qualname, fn, add, "op",
+                               array_params=_array_params(fn))
+    return findings

@@ -1,0 +1,137 @@
+"""Command-line front end for the static analyzers.
+
+Two entry points run the same code:
+
+- ``python tools/analyze.py ...`` — standalone, imports NOTHING outside
+  this package (no jax, no mxnet_tpu): the CI gating path.
+- ``python -m mxnet_tpu.analysis ...`` — inside the framework (package
+  import pulls in jax); emits ``analysis.*`` telemetry when the bus is on.
+
+Exit status: 0 when every finding is baselined (or ``--write-baseline``),
+1 on new findings or malformed baseline, 2 on usage errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from . import core
+
+
+def _telemetry():
+    """The telemetry bus when running inside the framework, else None —
+    the standalone launcher must not import mxnet_tpu."""
+    try:
+        bus = sys.modules.get("mxnet_tpu.telemetry.bus")
+        return bus if bus is not None and bus.enabled else None
+    except Exception:
+        return None
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="mxnet_tpu.analysis",
+        description="Framework-aware static analysis for mxnet_tpu "
+                    "(donation / capture / recompile / lock checkers)")
+    p.add_argument("--root", default="mxnet_tpu",
+                   help="file or directory to analyze (default: mxnet_tpu)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file of fingerprints to suppress "
+                        "(ci/analysis_baseline.txt in CI)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings to --baseline (with "
+                        "TODO justifications) instead of failing")
+    p.add_argument("--checkers", default=None,
+                   help="comma list from: %s (default: all)"
+                        % ",".join(core.CHECKERS))
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="only print the summary line and new findings")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    checkers = None
+    if args.checkers:
+        checkers = [c.strip() for c in args.checkers.split(",") if c.strip()]
+        unknown = set(checkers) - set(core.CHECKERS)
+        if unknown:
+            print(f"unknown checkers: {sorted(unknown)} "
+                  f"(have {list(core.CHECKERS)})", file=sys.stderr)
+            return 2
+
+    t0 = time.perf_counter()
+    findings = core.run_checkers(args.root, checkers=checkers)
+    t1 = time.perf_counter()
+    elapsed_ms = (t1 - t0) * 1e3
+
+    baseline, malformed = core.load_baseline(args.baseline)
+    new = [f for f in findings if f.fingerprint not in baseline]
+    suppressed = [f for f in findings if f.fingerprint in baseline]
+    stale = sorted(set(baseline) - {f.fingerprint for f in findings})
+
+    tel = _telemetry()
+    if tel is not None:
+        tel.record_span("analysis.run", t0, t1, root=args.root)
+        per_checker = {}
+        for f in findings:
+            per_checker[f.checker] = per_checker.get(f.checker, 0) + 1
+        for checker, n in per_checker.items():
+            tel.count("analysis.findings", n, checker=checker)
+        tel.count("analysis.new_findings", len(new))
+        tel.count("analysis.baselined_findings", len(suppressed))
+
+    if args.write_baseline:
+        if not args.baseline:
+            print("--write-baseline requires --baseline", file=sys.stderr)
+            return 2
+        lines = ["# mxnet_tpu.analysis baseline — one suppressed finding "
+                 "per line:",
+                 "#   <fingerprint>  <checker/rule>  <path:scope>  "
+                 "<symbol>  # <justification>",
+                 "# Regenerate candidates: python tools/analyze.py "
+                 "--baseline <file> --write-baseline", ""]
+        for f in findings:
+            just = baseline.get(f.fingerprint, "TODO: justify")
+            lines.append(core.format_baseline_line(f, just))
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            fh.write("\n".join(lines) + "\n")
+        print(f"wrote {len(findings)} fingerprints to {args.baseline}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [{
+                "fingerprint": f.fingerprint, "checker": f.checker,
+                "rule": f.rule, "path": f.path, "line": f.line,
+                "scope": f.scope, "symbol": f.symbol,
+                "message": f.message,
+                "baselined": f.fingerprint in baseline,
+            } for f in findings],
+            "new": len(new), "baselined": len(suppressed),
+            "stale_baseline": stale,
+            "malformed_baseline": malformed,
+            "elapsed_ms": round(elapsed_ms, 1),
+        }, indent=2))
+    else:
+        shown = new if args.quiet else findings
+        for f in shown:
+            mark = "NEW " if f.fingerprint not in baseline else "base"
+            print(f"{mark} [{f.fingerprint}] {f.checker}/{f.rule} "
+                  f"{f.location()} ({f.scope})\n     {f.message}")
+        for fp in stale:
+            print(f"stale baseline entry {fp}: no longer reported — "
+                  f"remove it ({baseline[fp]})")
+        for n, why in malformed:
+            print(f"malformed baseline line {n}: {why}", file=sys.stderr)
+        print(f"analysis: {len(findings)} findings "
+              f"({len(new)} new, {len(suppressed)} baselined, "
+              f"{len(stale)} stale baseline entries) in "
+              f"{elapsed_ms:.0f}ms")
+
+    if malformed:
+        return 1
+    return 1 if new else 0

@@ -1,0 +1,256 @@
+"""Shared machinery for the framework-aware static analyzers.
+
+The reference MXNet enforced its engine invariants (write-dependency
+ordering, no reads of a var after a write op consumed it) *mechanically*,
+inside ``ThreadedEngine::Push`` — application code could not silently break
+them.  The TPU-native rebuild moved those invariants into Python conventions
+(donated jit calls, per-thread segment recorders, shm-slot lifetimes), so
+this package restores the mechanical enforcement at the source level: pure
+``ast`` passes over ``mxnet_tpu/`` that run WITHOUT importing jax (or the
+framework itself — see ``tools/analyze.py`` for the import-free launcher).
+
+Design points:
+
+- **Findings carry stable fingerprints**: a hash of (checker, file, scope,
+  rule, symbol) — deliberately *not* the line number, so unrelated edits
+  above a finding do not churn the baseline.  CI gates on fingerprints that
+  are not in the checked-in baseline (``ci/analysis_baseline.txt``), so only
+  *new* findings fail the build.
+- **Checkers are heuristic by contract**: each one over-approximates (it
+  would rather flag a safe idiom than miss a use-after-donate); the baseline
+  file is where the residual false positives live, one justification per
+  line.  Fixing real bugs is always preferred to baselining them.
+- Everything here is stdlib-only on purpose.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+
+__all__ = ["Finding", "SourceModule", "load_tree", "load_baseline",
+           "format_baseline_line", "run_checkers", "CHECKERS",
+           "unparse", "with_lock_hint"]
+
+
+class Finding:
+    """One checker hit.
+
+    ``scope`` is the dotted qualname of the enclosing class/function
+    (module-level code uses ``<module>``); ``rule`` is the short machine
+    name of the sub-check; ``symbol`` is the name/attribute involved.
+    The fingerprint hashes everything EXCEPT ``line``/``message`` so
+    baselines survive reformatting and comment churn.
+    """
+
+    __slots__ = ("checker", "rule", "path", "scope", "symbol", "line",
+                 "message")
+
+    def __init__(self, checker, rule, path, scope, symbol, line, message):
+        self.checker = checker
+        self.rule = rule
+        self.path = path.replace(os.sep, "/")
+        self.scope = scope
+        self.symbol = symbol
+        self.line = int(line)
+        self.message = message
+
+    @property
+    def fingerprint(self):
+        key = "|".join((self.checker, self.rule, self.path, self.scope,
+                        self.symbol))
+        return hashlib.sha1(key.encode()).hexdigest()[:12]
+
+    def location(self):
+        return f"{self.path}:{self.line}"
+
+    def __repr__(self):
+        return (f"[{self.fingerprint}] {self.checker}/{self.rule} "
+                f"{self.location()} ({self.scope}) {self.symbol}: "
+                f"{self.message}")
+
+    def __eq__(self, other):
+        return isinstance(other, Finding) and \
+            self.fingerprint == other.fingerprint and self.line == other.line
+
+    def __hash__(self):
+        return hash((self.fingerprint, self.line))
+
+
+class SourceModule:
+    """A parsed source file handed to every checker."""
+
+    __slots__ = ("path", "source", "tree")
+
+    def __init__(self, path, source, tree):
+        self.path = path
+        self.source = source
+        self.tree = tree
+
+
+def load_tree(root, rel_to=None):
+    """Parse every ``*.py`` under ``root`` (a file or directory) into
+    :class:`SourceModule` objects.  Files that fail to parse become a
+    ``parse-error`` finding rather than an exception, so one broken file
+    cannot hide findings in the rest of the tree.
+
+    Paths (and therefore fingerprints) are made relative to ``rel_to``,
+    defaulting to the CWD when ``root`` lies inside it — so
+    ``--root mxnet_tpu/io/pipeline.py`` run from the repo root produces
+    the same ``mxnet_tpu/io/pipeline.py`` fingerprints as a whole-tree
+    pass, and sub-tree runs stay baseline-compatible."""
+    if rel_to is None:
+        cwd = os.getcwd()
+        absroot = os.path.abspath(root)
+        if absroot == cwd or absroot.startswith(cwd + os.sep):
+            rel_to = cwd
+        else:
+            rel_to = os.path.dirname(absroot)
+    paths = []
+    if os.path.isfile(root):
+        paths.append(root)
+    else:
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__",))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    paths.append(os.path.join(dirpath, fn))
+    modules, errors = [], []
+    for p in paths:
+        rel = os.path.relpath(p, rel_to)
+        try:
+            with open(p, "r", encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=p)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append(Finding("core", "parse-error", rel, "<module>",
+                                  os.path.basename(p), getattr(e, "lineno", 0)
+                                  or 0, f"cannot parse: {e}"))
+            continue
+        modules.append(SourceModule(rel, src, tree))
+    return modules, errors
+
+
+# --------------------------------------------------------------- AST helpers
+def unparse(node):
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return f"<{type(node).__name__}>"
+
+
+def dotted_name(node):
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call):
+    """Rightmost name of a Call's callee: ``jax.jit`` -> ``jit``."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def scope_functions(tree):
+    """Yield (qualname, FunctionDef) for every function in a module,
+    including methods and nested defs."""
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                yield q, child
+                yield from walk(child, q)
+            elif isinstance(child, ast.ClassDef):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                yield from walk(child, q)
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")
+
+
+_LOCK_HINTS = ("lock", "cond", "mutex", "guard")
+
+
+def with_lock_hint(expr_src):
+    low = expr_src.lower()
+    return any(h in low for h in _LOCK_HINTS)
+
+
+# ------------------------------------------------------------------ baseline
+def load_baseline(path):
+    """Baseline file -> {fingerprint: justification}.
+
+    Line grammar (one suppressed finding per line)::
+
+        <fingerprint>  <anything describing it>  # <justification>
+
+    Lines starting with ``#`` and blank lines are ignored.  A fingerprint
+    without a ``#`` justification is a baseline-format error (the whole
+    point is that every suppression is argued for).
+    """
+    entries = {}
+    malformed = []
+    if not path or not os.path.exists(path):
+        return entries, malformed
+    with open(path, "r", encoding="utf-8") as f:
+        for n, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            fp = line.split()[0]
+            if "#" not in line:
+                malformed.append((n, "missing '# justification'"))
+                continue
+            just = line.split("#", 1)[1].strip()
+            if not just:
+                malformed.append((n, "empty justification"))
+                continue
+            entries[fp] = just
+    return entries, malformed
+
+
+def format_baseline_line(finding, justification="TODO: justify"):
+    return (f"{finding.fingerprint}  {finding.checker}/{finding.rule}  "
+            f"{finding.path}:{finding.scope}  {finding.symbol}  "
+            f"# {justification}")
+
+
+# -------------------------------------------------------------------- runner
+def _checker_table():
+    from . import capture, donation, locks, recompile
+    return {
+        "donation": donation.check,
+        "capture": capture.check,
+        "recompile": recompile.check,
+        "locks": locks.check,
+    }
+
+
+CHECKERS = ("donation", "capture", "recompile", "locks")
+
+
+def run_checkers(root, checkers=None, rel_to=None):
+    """Run the selected checkers over ``root``; returns a sorted list of
+    findings (parse errors included as findings)."""
+    table = _checker_table()
+    names = checkers or CHECKERS
+    modules, findings = load_tree(root, rel_to=rel_to)
+    for mod in modules:
+        for name in names:
+            findings.extend(table[name](mod))
+    findings.sort(key=lambda f: (f.path, f.line, f.checker, f.rule,
+                                 f.symbol))
+    return findings

@@ -1,0 +1,302 @@
+"""Checker (a): use-after-donate.
+
+``jax.jit(..., donate_argnums=...)`` hands the argument's buffer to XLA for
+in-place reuse; any later read of that Python name sees a deleted (TPU) or
+silently-aliased (CPU zero-copy) buffer.  The reference engine made this
+impossible — a write op's var could not be read until the write completed —
+so every donated call site in this rebuild (aggregated optimizer groups,
+engine segment flushes, ``make_train_step(donate=True)``) is a place where
+review used to be the only guard.
+
+What the pass tracks, per module:
+
+1. **Donating callables.**  A name is donating when it is (ever) assigned
+   from ``jax.jit(.., donate_argnums=..)``, from a call to a local function
+   whose return value is such a jit, or read back out of a dict that a
+   donating callable was stored into (the compiled-fn cache idiom:
+   ``_compiled[key] = fn`` / ``fn = _compiled.get(key)``).
+2. **Donated positions.**  Literal ints / tuples of ints (including the
+   ``(0,) if donate else ()`` conditional idiom — the union of both arms).
+   A non-literal ``donate_argnums`` is treated conservatively as "every
+   positional argument".
+3. **Use after donate.**  Within the scope that makes the donating call,
+   any later ``Load`` of a name (or ``self.attr`` chain) that was passed at
+   a donated position is flagged, unless the name is rebound first.
+   Statement order is approximated by line number, so a read that is
+   *textually* later but runs earlier (loop back-edges) can be a false
+   positive — that is what the baseline is for.
+
+Rules: ``use-after-donate``, ``donate-unknown-argnums`` (informational
+downgrade is NOT done — unknown positions widen rule 3 instead).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, call_name, dotted_name, unparse
+
+CHECKER = "donation"
+
+ALL_POSITIONS = -1   # sentinel: donate_argnums not statically resolvable
+
+
+def _literal_positions(node):
+    """donate_argnums value -> frozenset of positions, or ALL_POSITIONS."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return frozenset((node.value,))
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.add(elt.value)
+            else:
+                return ALL_POSITIONS
+        return frozenset(out)
+    if isinstance(node, ast.IfExp):
+        a = _literal_positions(node.body)
+        b = _literal_positions(node.orelse)
+        if a is ALL_POSITIONS or b is ALL_POSITIONS:
+            return ALL_POSITIONS
+        return (a or frozenset()) | (b or frozenset())
+    return ALL_POSITIONS
+
+
+def _jit_donation(call):
+    """If ``call`` is a ``jax.jit``/``jit``/``pjit`` call with donation,
+    return its positions (frozenset or ALL_POSITIONS); else None."""
+    if call_name(call) not in ("jit", "pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            pos = _literal_positions(kw.value)
+            if pos == frozenset():
+                return None          # donate_argnums=() — explicit opt-out
+            return pos if pos is not None else ALL_POSITIONS
+    return None
+
+
+def _union(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if a is ALL_POSITIONS or b is ALL_POSITIONS:
+        return ALL_POSITIONS
+    return a | b
+
+
+class _ModuleFacts(ast.NodeVisitor):
+    """First pass: which names/functions/dicts are donating, module-wide."""
+
+    def __init__(self):
+        self.factories = {}     # function name -> positions (returns a jit)
+        self.names = {}         # assigned name -> positions
+        self.dicts = {}         # dict name -> positions (stores a donating fn)
+
+    # functions whose return value is a donated jit
+    def visit_FunctionDef(self, node):
+        for stmt in ast.walk(node):
+            if isinstance(stmt, ast.Return) and isinstance(stmt.value,
+                                                           ast.Call):
+                pos = _jit_donation(stmt.value)
+                if pos is not None:
+                    self.factories[node.name] = _union(
+                        self.factories.get(node.name), pos)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _assign_targets(stmt):
+    if isinstance(stmt, ast.Assign):
+        return stmt.targets
+    if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        return [stmt.target]
+    return []
+
+
+def _resolve_facts(tree):
+    """Fixed-point over assignments: name/dict donation facts."""
+    facts = _ModuleFacts()
+    facts.visit(tree)
+    assigns = [s for s in ast.walk(tree)
+               if isinstance(s, (ast.Assign, ast.AnnAssign))]
+    for _ in range(3):                      # small fixed point
+        changed = False
+        for stmt in assigns:
+            value = stmt.value
+            if value is None:
+                continue
+            pos = _value_donation(value, facts)
+            if pos is None:
+                continue
+            for tgt in _assign_targets(stmt):
+                if isinstance(tgt, ast.Name):
+                    new = _union(facts.names.get(tgt.id), pos)
+                    if new != facts.names.get(tgt.id):
+                        facts.names[tgt.id] = new
+                        changed = True
+                elif isinstance(tgt, ast.Subscript):
+                    base = dotted_name(tgt.value)
+                    if base:
+                        new = _union(facts.dicts.get(base), pos)
+                        if new != facts.dicts.get(base):
+                            facts.dicts[base] = new
+                            changed = True
+        if not changed:
+            break
+    return facts
+
+
+def _value_donation(value, facts):
+    """Donated positions of the callable produced by ``value``, or None."""
+    if not isinstance(value, ast.Call):
+        return None
+    direct = _jit_donation(value)
+    if direct is not None:
+        return direct
+    name = call_name(value)
+    if name in facts.factories:
+        return facts.factories[name]
+    # fn = _compiled.get(key)  /  fn = _compiled[key]
+    if name == "get" and isinstance(value.func, ast.Attribute):
+        base = dotted_name(value.func.value)
+        if base in facts.dicts:
+            return facts.dicts[base]
+    return None
+
+
+def _donating_call(call, facts):
+    """Donated positions if ``call`` invokes a donating callable."""
+    direct = _jit_donation(call)
+    if direct is not None:
+        # jax.jit(f, donate_argnums=..)(args...) is rare; the jit() call
+        # itself does not consume buffers
+        return None
+    f = call.func
+    if isinstance(f, ast.Name):
+        return facts.names.get(f.id)
+    if isinstance(f, ast.Subscript):
+        base = dotted_name(f.value)
+        return facts.dicts.get(base)
+    return None
+
+
+def _donated_exprs(call, positions):
+    """(symbol, display) pairs for the argument expressions donated by this
+    call.  Name args track by name; ``*name`` donates the list itself."""
+    out = []
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            if isinstance(arg.value, ast.Name):
+                out.append(arg.value.id)
+            continue
+        if positions is not ALL_POSITIONS and i not in positions:
+            continue
+        sym = dotted_name(arg) if isinstance(arg, (ast.Name, ast.Attribute)) \
+            else None
+        if sym is not None:
+            out.append(sym)
+    return out
+
+
+class _ScopeCheck:
+    """Second pass, per function scope: order donations / stores / loads by
+    line and flag loads after a donation without an intervening store."""
+
+    def __init__(self, mod, facts, qualname, fn, add):
+        self.mod = mod
+        self.facts = facts
+        self.qualname = qualname
+        self.fn = fn
+        self.add = add
+
+    def _own_nodes(self):
+        """Walk this function's body, excluding nested def/class bodies
+        (they are checked as their own scopes) but keeping their loads —
+        a closure reading a donated name is still a use-after-donate."""
+        stack = list(ast.iter_child_nodes(self.fn))
+        while stack:
+            node = stack.pop()
+            nested = isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef, ast.Lambda,
+                                       ast.ClassDef))
+            yield node, nested
+            if not nested:
+                stack.extend(ast.iter_child_nodes(node))
+            else:
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.Name, ast.Attribute)):
+                        yield sub, True
+
+    def run(self):
+        donations = []   # (end_line, symbol, call_src)
+        stores = []      # (line, symbol)
+        loads = []       # (line, symbol)
+        poison_lines = set()   # sanitizer.poison(...) call spans: the
+        #                        instrumentation that REPORTS a donation
+        #                        reads the shells on purpose
+        for node, nested in self._own_nodes():
+            if isinstance(node, ast.Call) and not nested:
+                callee = dotted_name(node.func) or ""
+                if callee.endswith("poison"):
+                    poison_lines.update(range(
+                        node.lineno,
+                        getattr(node, "end_lineno", node.lineno) + 1))
+                pos = _donating_call(node, self.facts)
+                if pos is not None:
+                    end = getattr(node, "end_lineno", node.lineno)
+                    for sym in _donated_exprs(node, pos):
+                        donations.append((end, sym, unparse(node.func)))
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                sym = dotted_name(node)
+                if sym is None:
+                    continue
+                ctx = getattr(node, "ctx", None)
+                if isinstance(ctx, ast.Store):
+                    stores.append((node.lineno, sym))
+                elif isinstance(ctx, ast.Load):
+                    loads.append((node.lineno, sym))
+        if not donations:
+            return
+        # a store to `x.attr` rebinds `x.attr`; a store to `x` rebinds
+        # every `x.*` chain too
+        for line, sym, callee in donations:
+            for lline, lsym in loads:
+                if lsym != sym or lline <= line or lline in poison_lines:
+                    continue
+                # sline == line covers `w = fn(w, ...)` — the donating
+                # statement itself rebinds the name
+                rebound = any(
+                    line <= sline <= lline and
+                    (ssym == sym or sym.startswith(ssym + "."))
+                    for sline, ssym in stores)
+                if rebound:
+                    continue
+                self.add(Finding(
+                    CHECKER, "use-after-donate", self.mod.path,
+                    self.qualname, sym, lline,
+                    f"{sym!r} is read after being donated to {callee}() "
+                    f"at line {line}; the buffer may be deleted or "
+                    f"aliased in place"))
+                break   # one finding per (donation, symbol)
+
+
+def check(mod):
+    """Entry point: list of findings for one :class:`SourceModule`."""
+    from .core import scope_functions
+    facts = _resolve_facts(mod.tree)
+    findings = []
+    seen = set()
+
+    def add(f):
+        key = (f.fingerprint, f.line)
+        if key not in seen:
+            seen.add(key)
+            findings.append(f)
+
+    for qualname, fn in scope_functions(mod.tree):
+        _ScopeCheck(mod, facts, qualname, fn, add).run()
+    return findings

@@ -1,0 +1,247 @@
+"""Checker (d): lock discipline across thread boundaries.
+
+The rebuild runs real worker threads — the serving ``Batcher`` worker, io
+prefetch/decode pools, the telemetry sampler, the engine's cross-thread
+segment forcing — and the reference's implicit protection (the engine
+serialized all mutation through its dependency queue) is gone.  The hazard:
+an instance attribute or module global mutated from BOTH a worker-thread
+entry point and main-thread methods with no shared lock and no
+``threading.local`` — a data race that tier-1 only catches when the
+interleaving happens to bite.
+
+Heuristics:
+
+- **Worker entry points**: functions passed as ``target=`` to
+  ``Thread``/``Process``, first argument of ``.submit(...)``, or methods
+  whose name contains ``worker`` — plus, transitively, same-class methods
+  they call via ``self.``.
+- **Mutations**: ``self.X = ...`` / ``self.X += ...`` / ``self.X[k] = ...``
+  inside methods, and module-global assignment (``global X`` declared).
+- **Protection**: the mutation sits under a ``with`` whose context
+  expression mentions a lock (``lock``/``cond``/``mutex``/``guard``), the
+  attribute is backed by ``threading.local()``, or every non-worker
+  mutation happens in ``__init__``/``__del__``/``close``-style lifecycle
+  methods (construct-before-start and teardown are handshake points, not
+  races).
+
+Rule: ``unlocked-shared-mutation``.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, call_name, unparse, with_lock_hint
+
+CHECKER = "locks"
+
+_LIFECYCLE = {"__init__", "__new__", "__del__", "__enter__", "__exit__",
+              "close", "shutdown", "destroy", "start", "reset", "stop"}
+
+
+class _Mutation:
+    __slots__ = ("attr", "method", "line", "locked")
+
+    def __init__(self, attr, method, line, locked):
+        self.attr = attr
+        self.method = method
+        self.line = line
+        self.locked = locked
+
+
+def _with_contexts(fn):
+    """{id(stmt) -> [with-expr sources]} for every node under a With."""
+    covered = {}
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            srcs = [unparse(item.context_expr) for item in node.items]
+            for sub in ast.walk(node):
+                covered.setdefault(id(sub), []).extend(srcs)
+    return covered
+
+
+def _method_mutations(fn):
+    """[_Mutation] of ``self.X`` targets in one method body, plus the set
+    of same-class methods it calls (``self.foo(...)``)."""
+    covered = _with_contexts(fn)
+    muts, calls = [], set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                base = tgt
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                if isinstance(base, ast.Attribute) and \
+                        isinstance(base.value, ast.Name) and \
+                        base.value.id == "self":
+                    locked = any(with_lock_hint(s)
+                                 for s in covered.get(id(node), ()))
+                    muts.append(_Mutation(base.attr, fn.name, tgt.lineno,
+                                          locked))
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "self":
+            calls.add(node.func.attr)
+    return muts, calls
+
+
+def _worker_seeds(cls):
+    """Method names that start a thread/process or look like worker
+    bodies."""
+    seeds = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in ("Thread", "Process", "Timer"):
+                for kw in node.keywords:
+                    if kw.arg == "target" and \
+                            isinstance(kw.value, ast.Attribute) and \
+                            isinstance(kw.value.value, ast.Name) and \
+                            kw.value.value.id == "self":
+                        seeds.add(kw.value.attr)
+            elif name in ("submit", "apply_async") and node.args and \
+                    isinstance(node.args[0], ast.Attribute) and \
+                    isinstance(node.args[0].value, ast.Name) and \
+                    node.args[0].value.id == "self":
+                seeds.add(node.args[0].attr)
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                "worker" in node.name.lower():
+            seeds.add(node.name)
+    return seeds
+
+
+def _threading_local_attrs(cls):
+    """Attrs assigned from ``threading.local()`` anywhere in the class."""
+    out = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if call_name(node.value) == "local":
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute):
+                        out.add(tgt.attr)
+    return out
+
+
+def _module_global_pass(mod, add):
+    """Module globals mutated (``global X`` declared) from both a
+    module-level worker-target function and a non-worker function."""
+    funcs = {n.name: n for n in mod.tree.body
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    worker = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and \
+                call_name(node) in ("Thread", "Process", "Timer"):
+            for kw in node.keywords:
+                if kw.arg == "target" and isinstance(kw.value, ast.Name) \
+                        and kw.value.id in funcs:
+                    worker.add(kw.value.id)
+    worker |= {n for n in funcs if "worker" in n.lower()}
+    if not worker:
+        return
+    by_global = {}
+    for name, fn in funcs.items():
+        declared = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                declared.update(node.names)
+        if not declared:
+            continue
+        covered = _with_contexts(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Name) and tgt.id in declared:
+                        locked = any(with_lock_hint(s)
+                                     for s in covered.get(id(node), ()))
+                        side = "worker" if name in worker else "main"
+                        by_global.setdefault(tgt.id, {"worker": [],
+                                                      "main": []})[
+                            side].append(_Mutation(tgt.id, name,
+                                                   tgt.lineno, locked))
+    for gname, sides in sorted(by_global.items()):
+        if not sides["worker"] or not sides["main"]:
+            continue
+        w_un = [m for m in sides["worker"] if not m.locked]
+        m_un = [m for m in sides["main"] if not m.locked]
+        if not (w_un or m_un):
+            continue
+        wm = (w_un or sides["worker"])[0]
+        mm = (m_un or sides["main"])[0]
+        unlocked = wm if w_un else mm
+        add(Finding(
+            CHECKER, "unlocked-shared-mutation", mod.path, "<module>",
+            gname, unlocked.line,
+            f"module global {gname!r} is mutated from worker-side "
+            f"{wm.method}():{wm.line} and main-side {mm.method}():"
+            f"{mm.line} with at least one side unlocked"))
+
+
+def check(mod):
+    findings = []
+    seen = set()
+
+    def add(f):
+        key = (f.fingerprint, f.line)
+        if key not in seen:
+            seen.add(key)
+            findings.append(f)
+
+    _module_global_pass(mod, add)
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        if not methods:
+            continue
+        per_method = {name: _method_mutations(fn)
+                      for name, fn in methods.items()}
+        # transitive closure of worker-reachable methods within the class
+        worker = set(s for s in _worker_seeds(cls) if s in methods)
+        frontier = set(worker)
+        while frontier:
+            nxt = set()
+            for m in frontier:
+                for callee in per_method[m][1]:
+                    if callee in methods and callee not in worker:
+                        worker.add(callee)
+                        nxt.add(callee)
+            frontier = nxt
+        if not worker:
+            continue
+        tls_attrs = _threading_local_attrs(cls)
+        # attr -> mutations from worker side / main side
+        by_attr = {}
+        for name, (muts, _calls) in per_method.items():
+            for m in muts:
+                side = "worker" if name in worker else "main"
+                by_attr.setdefault(m.attr, {"worker": [], "main": []})[
+                    side].append(m)
+        for attr, sides in sorted(by_attr.items()):
+            if attr in tls_attrs or "local" in attr:
+                continue
+            w_un = [m for m in sides["worker"] if not m.locked]
+            main_live = [m for m in sides["main"]
+                         if m.method not in _LIFECYCLE]
+            m_un = [m for m in main_live if not m.locked]
+            if not sides["worker"] or not main_live:
+                continue
+            if not (w_un or m_un):
+                continue        # both sides always under a lock
+            wm = w_un[0] if w_un else sides["worker"][0]
+            mm = m_un[0] if m_un else main_live[0]
+            unlocked = wm if w_un else mm
+            add(Finding(
+                CHECKER, "unlocked-shared-mutation", mod.path,
+                f"{cls.name}", f"self.{attr}", unlocked.line,
+                f"self.{attr} is mutated from worker-side "
+                f"{wm.method}():{wm.line} and main-side "
+                f"{mm.method}():{mm.line} with at least one side "
+                f"unlocked — guard both with one lock or make it "
+                f"thread-local"))
+    return findings

@@ -1,0 +1,161 @@
+"""Checker (c): recompile hazards.
+
+The exact bug class PRs 2/5/6 each fixed by hand: a jit cache keyed on a
+value that changes every step compiles every step.  Three patterns:
+
+- ``jit-in-loop`` — a direct ``jax.jit(...)`` call inside a ``for``/
+  ``while`` body.  Every iteration builds a fresh jitted callable; unless
+  it is memoized OUTSIDE the loop the trace/compile cost repeats per
+  iteration (the per-param FTML op baked its step count ``t`` into the
+  closure this way — one recompile per step).
+- ``per-step-attr`` — an ``invoke_op``/``invoke``/``invoke_fn`` call whose
+  attrs-dict literal contains a value derived from per-step Python state:
+  an enclosing loop variable, ``len(...)`` of anything, or an attribute
+  whose name smells like a counter (``step``/``count``/``iter``/
+  ``epoch``/``_t``).  Op attrs key the eager per-op jit cache
+  (``ndarray.py _EAGER_JIT``), so a churning attr is a compile per call.
+- ``unstable-cache-key`` — a subscript or ``.get``/``.setdefault`` on a
+  name that looks like a compile cache (``*cache*``/``*compiled*``/
+  ``*_jit*``) whose key expression embeds an f-string formatting a float
+  (a ``:.3f``-style format spec or a ``float()``/``round()``/
+  ``time.time()`` call) or a ``len(...)`` of a growing container.  Float
+  round-trips and container lengths are the classic silently-unbounded
+  cache keys.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, call_name, dotted_name, scope_functions, unparse
+
+CHECKER = "recompile"
+
+_COUNTERISH = re.compile(r"(step|count|iter|epoch|^t$|_t$|tick|seq)",
+                         re.IGNORECASE)
+_CACHEISH = re.compile(r"(cache|compiled|_jit)", re.IGNORECASE)
+_INVOKERS = ("invoke_op", "invoke", "invoke_fn")
+
+
+def _loop_vars(fn):
+    """{name: loop_lineno} for every for-target in ``fn``."""
+    out = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.For):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    out.setdefault(sub.id, node.lineno)
+    return out
+
+
+def _in_loop(fn):
+    """Set of (id of node) for all nodes lexically inside a loop body."""
+    inside = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.While)):
+            for sub in ast.walk(node):
+                if sub is not node:
+                    inside.add(id(sub))
+    return inside
+
+
+def _attr_hazard(value, loop_vars):
+    """Why an attrs value churns per step, or None."""
+    for node in ast.walk(value):
+        if isinstance(node, ast.Name) and node.id in loop_vars:
+            return f"derives from loop variable {node.id!r}"
+        if isinstance(node, ast.Call) and call_name(node) == "len":
+            return f"derives from len({unparse(node.args[0]) if node.args else ''})"
+        if isinstance(node, ast.Attribute) and _COUNTERISH.search(node.attr):
+            return f"derives from counter-like attribute .{node.attr}"
+    return None
+
+
+def _fstring_float_hazard(key_expr):
+    """Why a cache-key expression is unstable, or None."""
+    for node in ast.walk(key_expr):
+        if isinstance(node, ast.FormattedValue):
+            spec = node.format_spec
+            if spec is not None and "f" in (unparse(spec) or ""):
+                return "f-string formats a float into the cache key"
+            if isinstance(node.value, ast.Call):
+                inner = call_name(node.value)
+                if inner in ("float", "round", "time", "perf_counter"):
+                    return (f"f-string embeds {inner}() output in the "
+                            f"cache key")
+        if isinstance(node, ast.Call) and call_name(node) == "len":
+            return "cache key embeds len() of a container"
+    return None
+
+
+def check(mod):
+    findings = []
+    seen = set()
+
+    def add(f):
+        key = (f.fingerprint, f.line)
+        if key not in seen:
+            seen.add(key)
+            findings.append(f)
+
+    for qualname, fn in scope_functions(mod.tree):
+        loop_vars = _loop_vars(fn)
+        in_loop = _in_loop(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            # --- jit built inside a loop body
+            if name in ("jit", "pjit") and \
+                    dotted_name(node.func) in ("jit", "jax.jit", "pjit",
+                                               "jax.pjit") and \
+                    id(node) in in_loop:
+                add(Finding(
+                    CHECKER, "jit-in-loop", mod.path, qualname,
+                    unparse(node.func), node.lineno,
+                    "jax.jit(...) called inside a loop body: a fresh "
+                    "trace/compile per iteration — memoize the jitted "
+                    "callable outside the loop"))
+            # --- per-step state in op attrs
+            if name in _INVOKERS:
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    if not isinstance(arg, ast.Dict):
+                        continue
+                    for k, v in zip(arg.keys, arg.values):
+                        if v is None:
+                            continue
+                        why = _attr_hazard(v, loop_vars)
+                        if why:
+                            kname = unparse(k) if k is not None else "**"
+                            add(Finding(
+                                CHECKER, "per-step-attr", mod.path,
+                                qualname, f"attr {kname}", v.lineno,
+                                f"op attr {kname} {why}: attrs key the "
+                                f"per-op jit cache, so this recompiles "
+                                f"every call"))
+            # --- float/len-keyed compile caches via .get/.setdefault
+            if name in ("get", "setdefault", "pop") and \
+                    isinstance(node.func, ast.Attribute):
+                base = dotted_name(node.func.value)
+                if base and _CACHEISH.search(base) and node.args:
+                    why = _fstring_float_hazard(node.args[0])
+                    if why:
+                        add(Finding(
+                            CHECKER, "unstable-cache-key", mod.path,
+                            qualname, base, node.lineno,
+                            f"{base}.{name}(...): {why} — unbounded "
+                            f"compile-cache growth / per-step misses"))
+        # --- float/len-keyed compile caches via subscript
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Subscript):
+                base = dotted_name(node.value)
+                if base and _CACHEISH.search(base):
+                    why = _fstring_float_hazard(node.slice)
+                    if why:
+                        add(Finding(
+                            CHECKER, "unstable-cache-key", mod.path,
+                            qualname, base, node.lineno,
+                            f"{base}[...]: {why} — unbounded compile-"
+                            f"cache growth / per-step misses"))
+    return findings

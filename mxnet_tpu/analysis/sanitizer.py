@@ -1,0 +1,253 @@
+"""Runtime sanitizer — the dynamic half of the analysis suite.
+
+``MXNET_SANITIZE=donation,slots`` (or :func:`enable` / :class:`scope`)
+arms two opt-in modes that turn silent corruption into loud, attributed
+errors:
+
+- **donation** — every donated jit call site (aggregated optimizer groups,
+  engine segment flushes, ``SPMDTrainer`` steps) *poisons* the buffers it
+  donated, recording the site.  Any later read of a poisoned buffer through
+  the NDArray read funnel (``_materialize``/op dispatch) raises
+  :class:`DonatedBufferError` naming the donation site — instead of the
+  backend-dependent behavior (deleted-buffer error on TPU, silent aliasing
+  on CPU zero-copy).
+- **slots** — ``zero_copy_batches=True`` batches alias shared-memory ring
+  slots whose contents are only stable until the slot recycles.  The
+  iterator registers each staged buffer with its slot *generation*; the
+  ring bumps the generation on ``release``.  A read through a stale-
+  generation buffer raises :class:`StaleSlotError` naming the slot and
+  registration site — instead of returning another batch's pixels.
+
+Cost discipline (same as ``telemetry.bus.enabled`` / ``faults.active``):
+instrumented sites guard on the module attributes ``donation`` / ``slots``
+/ ``active`` — one attribute read when idle.  When armed, a check is one
+dict probe per buffer.  The registries hold strong references to the
+poisoned *shells* (the buffer's device memory is already donated/recycled;
+the Python object is tiny) so ``id()`` keys can never be reused while an
+entry lives; both registries are bounded LRUs.
+
+Telemetry (bus enabled): ``analysis.sanitizer_poisoned`` /
+``analysis.sanitizer_slot_views`` counters and an
+``analysis.sanitizer_violation`` instant+counter per raise.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+from ..telemetry import bus as _tel
+
+__all__ = ["SanitizerError", "DonatedBufferError", "StaleSlotError",
+           "enable", "disable", "configure", "scope", "modes", "active",
+           "donation", "slots", "poison", "register_slot_view",
+           "check_buffer", "stats", "reset"]
+
+MODES = ("donation", "slots")
+
+# Fast-path flags: hooks do ``if sanitizer.active: sanitizer.check_buffer(b)``
+# and sites do ``if sanitizer.donation: sanitizer.poison(...)``.  Mutated
+# only under _lock, read without it (single attribute load).
+active = False
+donation = False
+slots = False
+
+_lock = threading.Lock()
+_POISON_CAP = 8192
+_SLOT_CAP = 1024
+_poisoned = OrderedDict()     # id(buf) -> (site, shell)
+_slot_views = OrderedDict()   # id(buf) -> (ring, slot_id, generation,
+#                                           site, shell)
+_violations = 0
+
+
+class SanitizerError(RuntimeError):
+    """Base class for sanitizer-detected contract violations."""
+
+
+class DonatedBufferError(SanitizerError):
+    """A buffer was read after being donated to a jit call."""
+
+    def __init__(self, site):
+        super().__init__(
+            f"use-after-donate: this buffer was donated at {site} — its "
+            f"device memory has been reused in place.  Rebind the handle "
+            f"before the donated call, or keep the value with an explicit "
+            f"copy() (MXNET_SANITIZE=donation)")
+        self.site = site
+
+
+class StaleSlotError(SanitizerError):
+    """A zero-copy shm-slot view was read after the slot recycled."""
+
+    def __init__(self, site, slot_id):
+        super().__init__(
+            f"stale shm-slot read: slot {slot_id} (staged at {site}) was "
+            f"released back to the ring and may hold another batch's "
+            f"data.  Consume zero_copy_batches=True data before the next "
+            f"next()/reset(), or drop zero_copy_batches "
+            f"(MXNET_SANITIZE=slots)")
+        self.site = site
+        self.slot_id = slot_id
+
+
+def _refresh_locked(new_modes):
+    global active, donation, slots
+    donation = "donation" in new_modes
+    slots = "slots" in new_modes
+    active = bool(new_modes)
+
+
+def _parse(spec):
+    if not spec:
+        return frozenset()
+    norm = spec.strip().lower()
+    if norm in ("1", "all", "true", "on", "yes"):
+        return frozenset(MODES)
+    if norm in ("0", "false", "off", "none", "no"):
+        # conventional disable spellings must not crash `import mxnet_tpu`
+        # (this parse runs at import when MXNET_SANITIZE is set)
+        return frozenset()
+    out = set()
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if item not in MODES:
+            raise ValueError(
+                f"unknown MXNET_SANITIZE mode {item!r} (have {MODES})")
+        out.add(item)
+    return frozenset(out)
+
+
+def modes():
+    """Currently armed mode names (frozenset)."""
+    return frozenset(m for m, on in (("donation", donation),
+                                     ("slots", slots)) if on)
+
+
+def enable(*names):
+    """Arm the given modes (default: all).  Additive."""
+    new = frozenset(names) if names else frozenset(MODES)
+    bad = new - set(MODES)
+    if bad:
+        raise ValueError(f"unknown sanitizer modes {sorted(bad)}")
+    with _lock:
+        _refresh_locked(modes() | new)
+
+
+def disable(*names):
+    """Disarm the given modes (default: all).  Registries are kept —
+    re-enabling resumes enforcement of already-poisoned buffers."""
+    drop = frozenset(names) if names else frozenset(MODES)
+    with _lock:
+        _refresh_locked(modes() - drop)
+
+
+def configure(spec):
+    """Replace the armed modes from an ``MXNET_SANITIZE`` spec string."""
+    new = _parse(spec)
+    with _lock:
+        _refresh_locked(new)
+
+
+def reset():
+    """Drop every registry entry (test isolation)."""
+    global _violations
+    with _lock:
+        _poisoned.clear()
+        _slot_views.clear()
+        _violations = 0
+
+
+class scope:
+    """Context manager for tests: arm a spec on enter, restore the previous
+    modes on exit.  Registry entries persist deliberately — a buffer
+    donated inside the scope is still donated after it; call
+    :func:`reset` for full test isolation."""
+
+    def __init__(self, spec):
+        self._spec = spec
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = modes()
+        configure(self._spec)
+        return self
+
+    def __exit__(self, *exc):
+        with _lock:
+            _refresh_locked(self._saved)
+        return False
+
+
+def stats():
+    """Registry sizes + violation count (test/debug surface)."""
+    with _lock:
+        return {"poisoned": len(_poisoned), "slot_views": len(_slot_views),
+                "violations": _violations}
+
+
+# ----------------------------------------------------------------- registry
+def poison(buffers, site):
+    """Record ``buffers`` (jax arrays) as donated at ``site``.  Call sites
+    guard on ``sanitizer.donation`` so the idle cost is one attribute
+    read."""
+    if not donation:
+        return
+    n = 0
+    with _lock:
+        for b in buffers:
+            if b is None:
+                continue
+            _poisoned[id(b)] = (site, b)
+            n += 1
+        while len(_poisoned) > _POISON_CAP:
+            _poisoned.popitem(last=False)
+    if n and _tel.enabled:
+        _tel.count("analysis.sanitizer_poisoned", n)
+
+
+def register_slot_view(buf, ring, slot_id, site):
+    """Track a zero-copy staged buffer against its slot's current
+    generation; reads after the ring bumps the generation raise."""
+    if not slots or buf is None:
+        return
+    with _lock:
+        _slot_views[id(buf)] = (ring, int(slot_id),
+                                ring.generation(slot_id), site, buf)
+        while len(_slot_views) > _SLOT_CAP:
+            _slot_views.popitem(last=False)
+    if _tel.enabled:
+        _tel.count("analysis.sanitizer_slot_views")
+
+
+def _violation(err):
+    global _violations
+    with _lock:
+        _violations += 1
+    if _tel.enabled:
+        _tel.count("analysis.sanitizer_violations",
+                   kind=type(err).__name__)
+        _tel.instant("analysis.sanitizer_violation",
+                     kind=type(err).__name__, site=err.site)
+    raise err
+
+
+def check_buffer(buf):
+    """The read-path hook (``NDArray._materialize`` / op dispatch).
+    Callers guard on ``sanitizer.active``; a hit raises, a miss is one or
+    two dict probes."""
+    rec = _poisoned.get(id(buf))
+    if rec is not None and rec[1] is buf:
+        _violation(DonatedBufferError(rec[0]))
+    rec = _slot_views.get(id(buf))
+    if rec is not None and rec[4] is buf:
+        ring, slot_id, gen, site, _shell = rec
+        if ring.generation(slot_id) != gen:
+            _violation(StaleSlotError(site, slot_id))
+
+
+_env_spec = os.environ.get("MXNET_SANITIZE", "")
+if _env_spec:
+    configure(_env_spec)

@@ -49,6 +49,7 @@ import numpy as _np
 
 import jax
 
+from ..analysis import sanitizer as _san
 from ..telemetry import bus as _tel
 
 __all__ = ["LazyData", "Segment", "try_record", "flush", "thread_stats",
@@ -503,6 +504,13 @@ def _execute(seg, st):
     with _tel.span("engine.segment_flush", ops=len(nodes),
                    consts=len(consts)):
         outs = fn(*consts)
+    if _san.donation and donate:
+        # _donatable proved these consts unreachable from any NDArray at
+        # flush time; poisoning still guards the window where a new alias
+        # is minted from a stale raw reference (e.g. C-level caches)
+        _san.poison([consts[i] for i in donate],
+                    f"engine segment flush ({len(nodes)} ops, "
+                    f"{len(donate)} donated consts)")
     out_refs = seg.out_refs
     for i, val in zip(live, outs):
         lz = slots[i]

@@ -20,6 +20,7 @@ import time
 import numpy as np
 
 from .. import ndarray as nd
+from ..analysis import sanitizer as _san
 from ..base import parse_tuple
 from ..resilience import faults as _faults
 from ..telemetry import bus as _tel
@@ -521,6 +522,20 @@ class ImageRecordIter(DataIter):
         batch = DataBatch(data=[nd.array(data_arr)],
                           label=[nd.array(labels)],
                           pad=pad, index=sel.copy())
+        if self._zero_copy and _san.slots:
+            # MXNET_SANITIZE=slots: the staged arrays may alias the ring
+            # slot (CPU device_put zero-copies page-aligned buffers) —
+            # register them against the slot's current generation so a
+            # read after the slot recycles raises instead of returning
+            # another batch's pixels.  Enforced uniformly (even where
+            # device_put copies): the documented contract is "stable only
+            # until the following next()/reset()" on every backend.
+            # data only: labels are copied out of the slot by
+            # ProcessDecodePool.next_batch and never alias shared memory
+            ring = self._pipeline.ring
+            site = (f"ImageRecordIter zero_copy_batches slot {slot} "
+                    f"(epoch batch {seq})")
+            _san.register_slot_view(batch.data[0]._data, ring, slot, site)
         if self._device_augment:
             batch.augment_flip = flips
             batch.augment_crop = crops

@@ -67,6 +67,9 @@ class ShmRing:
             self.destroy()
             raise
         self._free = list(range(self.n_slots))
+        # per-slot recycle generation: bumped on every release so the
+        # MXNET_SANITIZE=slots mode can prove a zero-copy view stale
+        self._gen = [0] * self.n_slots
         self._destroyed = False
         self._owner_pid = os.getpid()
         global _atexit_registered
@@ -84,8 +87,18 @@ class ShmRing:
         return self._free.pop()
 
     def release(self, slot_id):
-        """Return a slot to the free list (consumer is done with its view)."""
+        """Return a slot to the free list (consumer is done with its view).
+
+        Bumps the slot's generation FIRST: any zero-copy view registered
+        with the sanitizer against the old generation is stale from this
+        point on — exactly the moment another worker may start writing."""
+        self._gen[slot_id] += 1
         self._free.append(slot_id)
+
+    def generation(self, slot_id):
+        """Recycle count of a slot (the ``MXNET_SANITIZE=slots`` epoch a
+        zero-copy view is registered against)."""
+        return self._gen[slot_id]
 
     @property
     def in_flight(self):

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import autograd as _ag
+from ..analysis import sanitizer as _san
 from ..base import np_dtype, bfloat16  # noqa: F401
 from ..context import Context, current_context, context_from_jax_device
 from ..engine import recorder as _eng
@@ -109,6 +110,12 @@ class NDArray:
         if type(d) is _LazyData:
             d = d.force()
             self._data = d
+        if _san.active:
+            # MXNET_SANITIZE read fence: raises (naming the site) when the
+            # buffer was donated to a jit call or aliases a recycled
+            # shm-ring slot — one module-attr read when the sanitizer is
+            # off
+            _san.check_buffer(d)
         return d
 
     def wait_to_read(self):
@@ -747,6 +754,12 @@ def invoke(op, nd_inputs, attrs, out=None, bulk=True):
         attrs = {k: (v._materialize() if isinstance(v, NDArray) else v)
                  for k, v in attrs.items()}
     raw = [x._data for x in nd_inputs]
+    if _san.active:
+        # sanitizer read fence on the dispatch path: operands enter kernels
+        # (or segment capture) here without going through _materialize
+        for r in raw:
+            if type(r) is not _LazyData:
+                _san.check_buffer(r)
     nd_outs = None
     if _eng.ever_bulked:
         # Lazy bulking (reference engine op bulking, src/engine/): record

@@ -37,7 +37,9 @@ import jax
 import jax.numpy as jnp
 import numpy
 
+from ..analysis import sanitizer as _san
 from ..ndarray import NDArray
+from ..resilience import faults as _faults
 from ..telemetry import bus as _tel
 
 __all__ = ["update_multi", "functional_update", "registered_rules",
@@ -596,6 +598,11 @@ def update_multi(opt, indices, weights, grads, states):
         fallback = list(range(len(weights)))
         groups = {}
 
+    if _faults.active:
+        # resilience drill site: fails BEFORE any group mutates, so an
+        # injected fault never leaves a half-applied step behind
+        _faults.check("optimizer.apply")
+
     tel_on = _tel.enabled
     n_dispatch = 0
     for key, members in groups.items():
@@ -699,6 +706,8 @@ def functional_update(fopt, params, grads, state, lr):
         _tel.count("optimizer.update_calls")
         _tel.count("optimizer.aggregated_params", len(names))
         _tel.gauge("optimizer.update_groups", 1)
+    if _faults.active:
+        _faults.check("optimizer.apply")
     with _tel.span("optimizer.update_group", opt=fopt.name, n=len(names),
                    mp=False):
         return fn(params, grads, state, lr)
@@ -740,6 +749,15 @@ def _run_group(opt, name, rule, sig, mp, chunk, indices, weights, grads,
 
     with _tel.span("optimizer.update_group", opt=name, n=len(ws), mp=mp):
         new_w, new_s = fn(w_data, g_data, s_data, lrs, wds, extras, hyper)
+
+    if _san.donation:
+        # the group call donated weights (arg 0) and state (arg 2): poison
+        # the pre-call buffers so any alias that dodged the rebind below
+        # raises with this site named instead of reading reused memory
+        site = (f"optimizer.aggregate group {name!r} "
+                f"(update_multi, {len(ws)} params, donated weights+state)")
+        _san.poison(w_data, site)
+        _san.poison([leaf for leaves in s_data for leaf in leaves], site)
 
     # rebind in place: same NDArray handles, fresh (donated) buffers —
     # the frontend analog of the engine writing through WriteTo vars

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..resilience import faults as _faults
+
 __all__ = ["gpipe", "gpipe_interleaved", "pipeline_stage_loop",
            "pipeline_train_1f1b"]
 
@@ -106,6 +108,10 @@ def gpipe(stage_fn, stacked_params, x, mesh, n_microbatches, pp_axis="pp"):
     from .mesh import shard_map_fn
     shard_map = shard_map_fn()
 
+    if _faults.active:
+        # resilience drill site: fails before the schedule dispatches, so
+        # an injected fault never strands a half-run pipeline tick
+        _faults.check("pipeline.schedule")
     b = x.shape[0]
     assert b % n_microbatches == 0, \
         f"batch {b} not divisible by n_microbatches {n_microbatches}"
@@ -248,6 +254,8 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, y, mesh,
     from .mesh import shard_map_fn
     shard_map = shard_map_fn()
 
+    if _faults.active:
+        _faults.check("pipeline.schedule")
     S = mesh.shape[pp_axis]
     b = x.shape[0]
     assert b % n_microbatches == 0, \
@@ -343,6 +351,9 @@ def gpipe_interleaved(stage_fn, stacked_params, x, mesh, n_microbatches,
     from jax.sharding import PartitionSpec as P
     from .mesh import shard_map_fn
     shard_map = shard_map_fn()
+
+    if _faults.active:
+        _faults.check("pipeline.schedule")
 
     S = mesh.shape[pp_axis]
     V = n_chunks

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from .. import autograd
 from .. import ndarray as nd_mod
 from .. import random as _rnd
+from ..analysis import sanitizer as _san
 from ..ndarray import NDArray
 from ..telemetry import bus as _tel
 from ..telemetry import jax_hooks as _tel_jax
@@ -235,6 +236,7 @@ class SPMDTrainer:
         with self._sp_scope():
             self._step_fn, self._state = make_train_step(
                 net, loss_fn, optimizer, mesh, dp_axis=dp_axis, **kw)
+        self._donate = bool(kw.get("donate", True))
         self._t = 0
         items = sorted(net.collect_params().items())
         self._trainable = [p for _, p in items if p.grad_req != "null"]
@@ -272,10 +274,19 @@ class SPMDTrainer:
             self._record_telemetry(data, label, key)
         # the scope matters while jax traces the step (first call / retrace):
         # attention layers consult it to route through ring attention
+        old_leaves = None
+        if _san.donation and self._donate:
+            # the jitted step donates arg 0 (the whole train state): snap
+            # the pre-call leaves so they can be poisoned with this site
+            old_leaves = _jax.tree_util.tree_leaves(self._state)
         with self._sp_scope(), \
                 _tel.span("trainer.step", t=self._t):
             self._state, loss = self._step_fn(self._state, data, label, key,
                                               jnp.uint32(self._t))
+        if old_leaves is not None:
+            _san.poison(old_leaves,
+                        f"SPMDTrainer.step t={self._t} (donated train "
+                        f"state)")
         _tel.count("trainer.steps")
         self._t += 1
         return NDArray(loss)

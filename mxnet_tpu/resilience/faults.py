@@ -46,6 +46,15 @@ Known sites (see docs/resilience.md for the full table):
                        transport hop of a push / per-key pull copy
 ``serving.batch``      batcher worker, inside the per-batch try (an
                        injected fault fails that batch's futures)
+``optimizer.apply``    aggregated optimizer apply path (``update_multi`` /
+                       ``functional_update``), before any group mutates —
+                       an injected fault never leaves a half-applied step
+``pipeline.schedule``  SPMD pipeline schedule entries (``gpipe``,
+                       ``pipeline_train_1f1b``, ``gpipe_interleaved``),
+                       before the schedule dispatches
+``io.worker_spawn`` / ``io.shm_slot``
+                       decode-pool worker spawn (parent) / shm-slot fill
+                       (worker, hard-kills via ``os._exit``)
 =====================  =====================================================
 """
 from __future__ import annotations

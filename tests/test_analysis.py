@@ -1,0 +1,588 @@
+"""mxnet_tpu.analysis: static checkers + runtime sanitizer (ISSUE 8).
+
+Static side: each checker has a seeded true-positive proving it fires, a
+negative showing the matching safe idiom stays quiet, fingerprint
+stability, the baseline workflow, and a whole-tree gate against the
+checked-in baseline.  Runtime side: planted use-after-donate (aggregated
+optimizer group) and post-release shm-slot reads must raise with the
+originating site named, and the clean paths must pass under
+``MXNET_SANITIZE`` with zero findings.
+"""
+import ast
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis
+from mxnet_tpu.analysis import core, sanitizer as san
+from mxnet_tpu.optimizer import aggregate
+from mxnet_tpu.resilience import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "mxnet_tpu")
+BASELINE = os.path.join(REPO, "ci", "analysis_baseline.txt")
+
+
+def run_checker(src, checker, path="mxnet_tpu/fake.py"):
+    src = textwrap.dedent(src)
+    mod = core.SourceModule(path, src, ast.parse(src))
+    return core._checker_table()[checker](mod)
+
+
+def rules(findings):
+    return {f.rule for f in findings}
+
+
+# ------------------------------------------------------------------ donation
+class TestDonationChecker:
+    def test_direct_jit_donation_fires(self):
+        fs = run_checker("""
+            import jax
+            def step(w, g):
+                fn = jax.jit(lambda a, b: a - b, donate_argnums=(0,))
+                out = fn(w, g)
+                return out + w.sum()
+            """, "donation")
+        assert rules(fs) == {"use-after-donate"}
+        assert fs[0].symbol == "w"
+        assert "donated" in fs[0].message
+
+    def test_rebind_suppresses(self):
+        fs = run_checker("""
+            import jax
+            def step(w, g):
+                fn = jax.jit(lambda a, b: a - b, donate_argnums=(0,))
+                w = fn(w, g)
+                return w.sum()
+            """, "donation")
+        assert fs == []
+
+    def test_nondonating_position_ok(self):
+        fs = run_checker("""
+            import jax
+            def step(w, g):
+                fn = jax.jit(lambda a, b: a - b, donate_argnums=(0,))
+                out = fn(w, g)
+                return out + g.sum()     # g (arg 1) was NOT donated
+            """, "donation")
+        assert fs == []
+
+    def test_factory_and_cache_laundering(self):
+        # the optimizer/aggregate.py idiom: a factory returns the donated
+        # jit, a dict caches it, the call site reads it back with .get
+        fs = run_checker("""
+            import jax
+            _compiled = {}
+            def build():
+                return jax.jit(lambda a: a * 2, donate_argnums=(0,))
+            def apply(key, w):
+                fn = _compiled.get(key)
+                if fn is None:
+                    fn = build()
+                    _compiled[key] = fn
+                out = fn(w)
+                return w.sum()
+            """, "donation")
+        assert rules(fs) == {"use-after-donate"}
+        assert fs[0].scope == "apply" and fs[0].symbol == "w"
+
+    def test_conditional_argnums_and_star_args(self):
+        fs = run_checker("""
+            import jax
+            def go(consts, flag):
+                fn = jax.jit(lambda *a: a[0],
+                             donate_argnums=(0,) if flag else ())
+                outs = fn(*consts)
+                return len(consts)
+            """, "donation")
+        assert rules(fs) == {"use-after-donate"}
+        assert fs[0].symbol == "consts"
+
+
+# ------------------------------------------------------------------- capture
+class TestCaptureChecker:
+    def test_tracer_escape_and_materialize_in_jit(self):
+        fs = run_checker("""
+            import jax
+            class M:
+                def f(self, x):
+                    def body(a):
+                        self.saved = a
+                        return a.asnumpy()
+                    return jax.jit(body)(x)
+            """, "capture")
+        assert rules(fs) == {"tracer-escape-self", "materialize-in-jit"}
+
+    def test_closure_mutation_fires(self):
+        fs = run_checker("""
+            import jax
+            def outer(xs):
+                leaked = []
+                @jax.jit
+                def body(a):
+                    leaked.append(a)
+                    return a
+                return [body(x) for x in xs], leaked
+            """, "capture")
+        assert "tracer-escape-closure" in rules(fs)
+
+    def test_method_name_collision_does_not_fire(self):
+        # jax.jit(step) must not taint an unrelated METHOD named `step`
+        fs = run_checker("""
+            import jax
+            def make():
+                def step(s, x):
+                    return s + x
+                return jax.jit(step)
+            class Trainer:
+                def step(self, x):
+                    self._t += 1
+                    return x
+            """, "capture")
+        assert fs == []
+
+    def test_registered_op_materialization(self):
+        fs = run_checker("""
+            from .registry import register
+            @register("bad_op")
+            def bad_op(x, axis=None):
+                if x:
+                    return float(x)
+                return x.asnumpy()
+            """, "capture", path="mxnet_tpu/ops/fake_ops.py")
+        assert rules(fs) == {"bool-coerce-in-op", "materialize-in-op"}
+
+    def test_registered_op_attr_branch_ok(self):
+        fs = run_checker("""
+            from .registry import register
+            @register("good_op")
+            def good_op(x, axis=None, keepdims=False):
+                if keepdims:
+                    return x * 2
+                return x
+            """, "capture", path="mxnet_tpu/ops/fake_ops.py")
+        assert fs == []
+
+
+# ----------------------------------------------------------------- recompile
+class TestRecompileChecker:
+    def test_jit_in_loop_and_per_step_attr(self):
+        fs = run_checker("""
+            import jax
+            def train(xs):
+                for i, x in enumerate(xs):
+                    f = jax.jit(lambda a: a * 2)
+                    invoke_op("scale", [x], {"t": i})
+            """, "recompile")
+        assert rules(fs) == {"jit-in-loop", "per-step-attr"}
+
+    def test_counterish_attr_fires(self):
+        fs = run_checker("""
+            def step(self, x):
+                return invoke_op("foo", [x], {"n": self._step_count})
+            """, "recompile")
+        assert rules(fs) == {"per-step-attr"}
+
+    def test_float_cache_key(self):
+        fs = run_checker("""
+            def lookup(self, loss):
+                return self._compiled.get(f"k{float(loss)}")
+            """, "recompile")
+        assert rules(fs) == {"unstable-cache-key"}
+
+    def test_jit_outside_loop_ok(self):
+        fs = run_checker("""
+            import jax
+            def train(xs):
+                f = jax.jit(lambda a: a * 2)
+                for x in xs:
+                    f(x)
+            """, "recompile")
+        assert fs == []
+
+
+# --------------------------------------------------------------------- locks
+class TestLocksChecker:
+    def test_unlocked_shared_attr_fires(self):
+        fs = run_checker("""
+            import threading
+            class B:
+                def start(self):
+                    self._th = threading.Thread(target=self._worker_loop)
+                def _worker_loop(self):
+                    self.count += 1
+                def poll(self):
+                    self.count = 0
+            """, "locks")
+        assert rules(fs) == {"unlocked-shared-mutation"}
+        assert fs[0].symbol == "self.count"
+
+    def test_locked_both_sides_ok(self):
+        fs = run_checker("""
+            import threading
+            class B:
+                def start(self):
+                    self._th = threading.Thread(target=self._worker_loop)
+                def _worker_loop(self):
+                    with self._lock:
+                        self.count += 1
+                def poll(self):
+                    with self._lock:
+                        self.count = 0
+            """, "locks")
+        assert fs == []
+
+    def test_init_only_main_mutation_ok(self):
+        # construct-before-start is a handshake, not a race
+        fs = run_checker("""
+            import threading
+            class B:
+                def __init__(self):
+                    self.count = 0
+                    self._th = threading.Thread(target=self._worker_loop)
+                def _worker_loop(self):
+                    self.count += 1
+            """, "locks")
+        assert fs == []
+
+    def test_module_global_fires(self):
+        fs = run_checker("""
+            import threading
+            total = 0
+            def worker_body():
+                global total
+                total += 1
+            def drain():
+                global total
+                total = 0
+            threading.Thread(target=worker_body)
+            """, "locks")
+        assert rules(fs) == {"unlocked-shared-mutation"}
+        assert fs[0].scope == "<module>"
+
+    def test_transitive_worker_reach(self):
+        fs = run_checker("""
+            import threading
+            class B:
+                def start(self):
+                    self._th = threading.Thread(target=self._worker_loop)
+                def _worker_loop(self):
+                    self._bump()
+                def _bump(self):
+                    self.count += 1
+                def poll(self):
+                    self.count = 0
+            """, "locks")
+        assert rules(fs) == {"unlocked-shared-mutation"}
+
+
+# ------------------------------------------------- fingerprints and baseline
+class TestBaseline:
+    SRC = """
+        import jax
+        def step(w, g):
+            fn = jax.jit(lambda a, b: a - b, donate_argnums=(0,))
+            out = fn(w, g)
+            return out + w.sum()
+        """
+
+    def test_fingerprint_stable_across_line_shifts(self):
+        a = run_checker(self.SRC, "donation")
+        b = run_checker("# shifted\n# down\n\n" + textwrap.dedent(self.SRC),
+                        "donation")
+        assert a[0].fingerprint == b[0].fingerprint
+        assert a[0].line != b[0].line
+
+    def test_fingerprint_distinguishes_scope_and_symbol(self):
+        two = run_checker(self.SRC.replace("def step", "def other"),
+                          "donation")
+        assert two[0].fingerprint != \
+            run_checker(self.SRC, "donation")[0].fingerprint
+
+    def test_baseline_roundtrip_and_malformed(self, tmp_path):
+        f = run_checker(self.SRC, "donation")[0]
+        p = tmp_path / "base.txt"
+        p.write_text(core.format_baseline_line(f, "intentional: test") +
+                     "\n" + "deadbeef0000  no justification here\n")
+        entries, malformed = core.load_baseline(str(p))
+        assert entries[f.fingerprint] == "intentional: test"
+        assert len(malformed) == 1
+
+    def test_missing_baseline_is_empty(self):
+        entries, malformed = core.load_baseline("/nonexistent/file")
+        assert entries == {} and malformed == []
+
+    def test_parse_error_becomes_finding(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def broken(:\n")
+        mods, errs = core.load_tree(str(bad))
+        assert mods == [] and errs[0].rule == "parse-error"
+
+
+class TestWholeTree:
+    def test_subtree_fingerprints_match_whole_tree(self):
+        # a --root scoped to one file must produce the same repo-relative
+        # paths (and so fingerprints) as the whole-tree pass, or sub-tree
+        # runs would break against the shared baseline
+        old = os.getcwd()
+        os.chdir(REPO)
+        try:
+            sub = core.run_checkers("mxnet_tpu/io/pipeline.py")
+        finally:
+            os.chdir(old)
+        whole = [f for f in core.run_checkers(PKG, rel_to=REPO)
+                 if f.path == "mxnet_tpu/io/pipeline.py"]
+        assert {f.fingerprint for f in sub} == \
+            {f.fingerprint for f in whole}
+        assert all(f.path == "mxnet_tpu/io/pipeline.py" for f in sub)
+
+    def test_tree_gates_clean_against_baseline(self):
+        findings = core.run_checkers(PKG, rel_to=REPO)
+        entries, malformed = core.load_baseline(BASELINE)
+        assert not malformed, malformed
+        new = [f for f in findings if f.fingerprint not in entries]
+        assert not new, "\n".join(map(repr, new))
+
+    @pytest.mark.slow
+    def test_standalone_launcher_imports_no_jax(self):
+        # tools/analyze.py asserts "jax" not in sys.modules itself
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "analyze.py"),
+             "--root", PKG, "--baseline", BASELINE, "-q"],
+            capture_output=True, text=True, cwd=REPO, timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_cli_json_format(self, capsys):
+        src = textwrap.dedent(self.__class__.SRC_BAD)
+        with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                         delete=False) as f:
+            f.write(src)
+        try:
+            rc = analysis.main(["--root", f.name, "--format", "json"])
+        finally:
+            os.unlink(f.name)
+        out = capsys.readouterr().out
+        import json
+        doc = json.loads(out)
+        assert rc == 1 and doc["new"] >= 1
+
+    SRC_BAD = """
+        import jax
+        def step(w, g):
+            fn = jax.jit(lambda a, b: a - b, donate_argnums=(0,))
+            out = fn(w, g)
+            return out + w.sum()
+        """
+
+
+# ----------------------------------------------------------------- sanitizer
+def _agg_setup(n=4):
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    opt.aggregate_num = 16
+    ws = [mx.nd.array(np.random.rand(8, 8).astype("float32"))
+          for _ in range(n)]
+    gs = [mx.nd.array(np.random.rand(8, 8).astype("float32"))
+          for _ in range(n)]
+    ss = [opt.create_state_multi_precision(i, w) for i, w in enumerate(ws)]
+    return opt, ws, gs, ss
+
+
+@pytest.fixture(autouse=True)
+def _clean_sanitizer():
+    yield
+    san.disable()
+    san.reset()
+
+
+class TestSanitizerDonation:
+    def test_planted_use_after_donate_names_site(self):
+        opt, ws, gs, ss = _agg_setup()
+        stale = ws[0].detach()            # aliases the pre-update buffer
+        with san.scope("donation"):
+            aggregate.update_multi(opt, list(range(len(ws))), ws, gs, ss)
+            with pytest.raises(san.DonatedBufferError) as ei:
+                stale.asnumpy()
+        assert "optimizer.aggregate group 'sgd'" in str(ei.value)
+        assert san.stats()["violations"] == 1
+
+    def test_state_alias_flagged_too(self):
+        opt, ws, gs, ss = _agg_setup()
+        # momentum slot handle: rebound in place, but a detached alias of
+        # the OLD buffer must be flagged
+        mom = ss[0] if isinstance(ss[0], mx.nd.NDArray) else ss[0][0]
+        stale_state = mom.detach()
+        with san.scope("donation"):
+            aggregate.update_multi(opt, list(range(len(ws))), ws, gs, ss)
+            with pytest.raises(san.DonatedBufferError):
+                stale_state.asnumpy()
+
+    def test_clean_aggregated_steps_zero_findings(self):
+        opt, ws, gs, ss = _agg_setup()
+        with san.scope("donation"):
+            for _ in range(3):
+                aggregate.update_multi(opt, list(range(len(ws))), ws, gs,
+                                       ss)
+                _ = [w.asnumpy() for w in ws]     # rebound handles: fine
+        assert san.stats()["violations"] == 0
+        assert san.stats()["poisoned"] > 0
+
+    def test_engine_bulk_clean_under_sanitize(self):
+        from mxnet_tpu import engine
+        with san.scope("donation"):
+            with engine.bulk(16):
+                x = mx.nd.array(np.linspace(-1, 1, 64,
+                                            dtype="float32").reshape(8, 8))
+                y = x
+                for _ in range(12):
+                    y = y * 1.01 + 0.5
+            ref = np.linspace(-1, 1, 64, dtype="float32").reshape(8, 8)
+            for _ in range(12):
+                ref = ref * 1.01 + 0.5
+            np.testing.assert_allclose(y.asnumpy(), ref, rtol=2e-5)
+        assert san.stats()["violations"] == 0
+
+    def test_spmd_trainer_step_poisons_donated_state(self):
+        from mxnet_tpu.parallel import (FunctionalOptimizer, SPMDTrainer,
+                                        make_mesh)
+        net = mx.gluon.nn.Dense(4, in_units=8)
+        net.initialize()
+        tr = SPMDTrainer(net, mx.gluon.loss.L2Loss(),
+                         FunctionalOptimizer("sgd", 1e-2),
+                         make_mesh(n_devices=1, dp=1))
+        x = np.random.rand(4, 8).astype("float32")
+        y = np.random.rand(4, 4).astype("float32")
+        with san.scope("donation"):
+            loss = tr.step(x, y)
+            assert np.isfinite(float(loss.asnumpy()))
+            assert san.stats()["poisoned"] > 0
+        assert san.stats()["violations"] == 0
+
+
+def _write_rec(tmp, n=64):
+    from mxnet_tpu import recordio
+    rec_path = os.path.join(tmp, "d.rec")
+    rng = np.random.RandomState(0)
+    rec = recordio.MXRecordIO(rec_path, "w")
+    img = (rng.rand(64, 64, 3) * 255).astype("uint8")
+    for i in range(n):
+        rec.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i % 10), i, 0), img, quality=85))
+    rec.close()
+    return rec_path
+
+
+class TestSanitizerSlots:
+    def test_post_release_slot_read_names_site(self, tmp_path):
+        rec_path = _write_rec(str(tmp_path))
+        with san.scope("slots"):
+            it = mx.io.ImageRecordIter(
+                path_imgrec=rec_path, data_shape=(3, 48, 48),
+                batch_size=16, preprocess_processes=2,
+                zero_copy_batches=True)
+            try:
+                b1 = next(it)
+                _ = b1.data[0].asnumpy()          # fresh: fine
+                b2 = next(it)                     # recycles b1's slot
+                _ = b2.data[0].asnumpy()
+                with pytest.raises(san.StaleSlotError) as ei:
+                    b1.data[0].asnumpy()
+            finally:
+                it.close()
+        assert "zero_copy_batches slot" in str(ei.value)
+        assert san.stats()["violations"] == 1
+
+    def test_clean_epoch_zero_findings(self, tmp_path):
+        rec_path = _write_rec(str(tmp_path))
+        with san.scope("slots"):
+            it = mx.io.ImageRecordIter(
+                path_imgrec=rec_path, data_shape=(3, 48, 48),
+                batch_size=16, preprocess_processes=2,
+                zero_copy_batches=True)
+            try:
+                total = 0.0
+                for b in it:                      # consume before next()
+                    total += float(b.data[0].asnumpy().sum())
+            finally:
+                it.close()
+            assert total > 0
+        assert san.stats()["violations"] == 0
+        assert san.stats()["slot_views"] > 0
+
+    def test_copy_mode_not_tracked(self, tmp_path):
+        # default (copying) batches never register slot views
+        rec_path = _write_rec(str(tmp_path), n=32)
+        with san.scope("slots"):
+            it = mx.io.ImageRecordIter(
+                path_imgrec=rec_path, data_shape=(3, 48, 48),
+                batch_size=16, preprocess_processes=2)
+            try:
+                b1 = next(it)
+                next(it)
+                _ = b1.data[0].asnumpy()          # copied: always valid
+            finally:
+                it.close()
+        assert san.stats()["slot_views"] == 0
+        assert san.stats()["violations"] == 0
+
+
+class TestSanitizerConfig:
+    def test_env_grammar(self):
+        assert san._parse("donation,slots") == {"donation", "slots"}
+        assert san._parse("1") == set(san.MODES)
+        assert san._parse("") == frozenset()
+        # conventional disable spellings parse to "nothing armed", they
+        # must never crash `import mxnet_tpu`
+        for spec in ("0", "false", "off", "none", "OFF"):
+            assert san._parse(spec) == frozenset()
+        with pytest.raises(ValueError):
+            san._parse("bogus")
+
+    def test_scope_restores(self):
+        assert not san.active
+        with san.scope("donation"):
+            assert san.active and san.donation and not san.slots
+            with san.scope("slots"):
+                assert san.slots and not san.donation
+            assert san.donation
+        assert not san.active
+
+    def test_enable_disable_additive(self):
+        san.enable("donation")
+        san.enable("slots")
+        assert san.modes() == {"donation", "slots"}
+        san.disable("donation")
+        assert san.modes() == {"slots"}
+        san.disable()
+        assert not san.active
+
+
+# --------------------------------------------------------------- fault sites
+class TestFaultSites:
+    def test_optimizer_apply_site(self):
+        opt, ws, gs, ss = _agg_setup(n=1)
+        before = ws[0].asnumpy().copy()
+        with faults.scope("optimizer.apply:fail:1"):
+            with pytest.raises(faults.InjectedFault):
+                aggregate.update_multi(opt, [0], ws, gs, ss)
+            # fails BEFORE any mutation: weights untouched
+            np.testing.assert_array_equal(ws[0].asnumpy(), before)
+            aggregate.update_multi(opt, [0], ws, gs, ss)   # next call passes
+        assert not np.array_equal(ws[0].asnumpy(), before)
+
+    def test_pipeline_schedule_site(self):
+        import jax.numpy as jnp
+        from mxnet_tpu.parallel import make_mesh, pipeline as pl
+        mesh = make_mesh(n_devices=8, pp=8)
+        params = jnp.ones((8, 4))
+        x = jnp.ones((16, 4))
+        with faults.scope("pipeline.schedule:fail:1"):
+            with pytest.raises(faults.InjectedFault):
+                pl.gpipe(lambda p, xx: xx * p.sum(), params, x, mesh, 4)
+            out = pl.gpipe(lambda p, xx: xx * p.sum(), params, x, mesh, 4)
+        assert out.shape == x.shape

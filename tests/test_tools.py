@@ -43,6 +43,30 @@ def test_flakiness_checker_runs_target(tmp_path):
     assert "0/2 trials failed" in out.stdout
 
 
+def test_flakiness_checker_uses_tier1_invocation():
+    sys.path.insert(0, TOOLS)
+    try:
+        import flakiness_checker as fc
+    finally:
+        sys.path.pop(0)
+    # trials run the tier-1 pytest flags (not the legacy nose runner)
+    cmd = fc.tier1_command("tests/")
+    assert "pytest" in " ".join(cmd)
+    assert "not slow" in cmd
+    assert "--continue-on-collection-errors" in cmd
+    cmd_all = fc.tier1_command("tests/", include_slow=True)
+    assert "not slow" not in cmd_all
+    assert "--continue-on-collection-errors" in cmd_all
+    # the interpreter's own "-m pytest" must survive the filter strip
+    assert cmd_all[1:3] == ["-m", "pytest"]
+    # an explicitly named test is never deselected by the marker filter
+    assert "not slow" not in fc.tier1_command("tests/t.py::test_x")
+    # no target = the whole tier-1 suite; dotted reference spelling maps
+    assert fc.parse_args([]).test == "tests/"
+    assert fc.parse_args(["test_operator.test_abs"]).test == \
+        "test_operator.py::test_abs"
+
+
 def test_bandwidth_measure_reduces_correctly():
     sys.path.insert(0, os.path.join(TOOLS, "bandwidth"))
     try:

@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Standalone launcher for the ``mxnet_tpu.analysis`` static checkers.
+
+``python -m mxnet_tpu.analysis`` imports the whole framework (and jax) just
+to parse source files; CI wants the static pass cheap and runnable on boxes
+without an accelerator stack.  This launcher mounts ``mxnet_tpu/analysis``
+as a synthetic top-level package (``_mx_analysis``) so the checker modules
+import each other normally while ``mxnet_tpu/__init__.py`` — and therefore
+jax — never runs.  A loaded ``jax`` module in ``sys.modules`` afterwards is
+a bug (asserted by tests/test_analysis.py).
+
+Usage matches the in-framework CLI::
+
+    python tools/analyze.py --root mxnet_tpu --baseline ci/analysis_baseline.txt
+    python tools/analyze.py --root some/file.py --checkers donation,locks
+"""
+import importlib
+import importlib.util
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG_DIR = os.path.join(_REPO, "mxnet_tpu", "analysis")
+_PKG = "_mx_analysis"
+
+
+def load_analysis():
+    """Import the analysis modules under a synthetic package name, without
+    executing ``mxnet_tpu/__init__`` (returns the cli module)."""
+    if _PKG not in sys.modules:
+        pkg = types.ModuleType(_PKG)
+        pkg.__path__ = [_PKG_DIR]
+        pkg.__package__ = _PKG
+        sys.modules[_PKG] = pkg
+    return importlib.import_module(f"{_PKG}.cli")
+
+
+if __name__ == "__main__":
+    cli = load_analysis()
+    rc = cli.main()
+    assert "jax" not in sys.modules, \
+        "the static pass must not import jax (tools/analyze.py contract)"
+    sys.exit(rc)

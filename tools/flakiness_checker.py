@@ -1,8 +1,26 @@
 #!/usr/bin/env python
-"""Re-run a test many times under different seeds to expose flakiness
-(reference ``tools/flakiness_checker.py`` — same CLI shape, pytest-based:
-the reference drives nosetests with ``MXNET_TEST_SEED`` per trial; here each
-trial runs ``pytest <path>::<test>`` with a fresh ``MXNET_TEST_SEED``)."""
+"""Re-run tests many times under different seeds to expose flakiness.
+
+Reference ``tools/flakiness_checker.py`` drove the legacy nose runner
+(``nosetests --verbose -s``) with ``MXNET_TEST_SEED`` per trial; this port
+drives the repo's tier-1 pytest suite instead: every trial runs with the
+tier-1 invocation flags (``-m 'not slow' --continue-on-collection-errors
+-p no:cacheprovider``, ``JAX_PLATFORMS=cpu`` — see ROADMAP.md "Tier-1
+verify") so a flake found here reproduces exactly what CI runs.
+
+Usage::
+
+    # one test, 10 seeds (reference CLI shape; dotted spelling accepted;
+    # an explicit ::test id always runs, even if marked slow)
+    python tools/flakiness_checker.py tests/test_operator.py::test_abs
+    python tools/flakiness_checker.py test_operator.test_abs
+
+    # the whole tier-1 suite, 3 trials
+    python tools/flakiness_checker.py --num-trials 3
+
+    # a whole file including its slow tests
+    python tools/flakiness_checker.py --all tests/test_moe.py
+"""
 import argparse
 import os
 import random
@@ -10,6 +28,26 @@ import subprocess
 import sys
 
 DEFAULT_NUM_TRIALS = 10
+
+#: The tier-1 pytest invocation (ROADMAP.md) minus the timeout wrapper —
+#: per-trial flags so flakes found here reproduce under CI's exact runner.
+TIER1_ARGS = ["-q", "-m", "not slow", "--continue-on-collection-errors",
+              "-p", "no:cacheprovider"]
+
+
+def tier1_command(test_path, include_slow=False):
+    # an explicitly named test must always run: keeping the tier-1 marker
+    # filter would silently DESELECT a slow test (pytest exit 5, every
+    # trial a bogus FAIL)
+    if "::" in test_path:
+        include_slow = True
+    args = [sys.executable, "-m", "pytest"] + list(TIER1_ARGS)
+    if include_slow:
+        # drop the marker filter, keep the rest of the tier-1 flags (search
+        # past "python -m pytest" — ITS -m must survive)
+        i = args.index("-m", 3)
+        del args[i:i + 2]
+    return args + [test_path]
 
 
 def run_test_trials(args):
@@ -22,14 +60,17 @@ def run_test_trials(args):
         if os.path.exists(candidate.split("::")[0]):
             test_path = candidate
     new_env = os.environ.copy()
+    # tier-1 runs on the CPU backend with the virtual 8-device mesh
+    # (conftest.py forces the mesh; the platform must not claim a chip)
+    new_env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = tier1_command(test_path, include_slow=args.all)
+    print("trial command:", " ".join(cmd))
     failures = 0
     for i in range(args.num_trials):
         seed = args.seed if args.seed is not None else \
             random.randint(0, 2 ** 31 - 1)
         new_env["MXNET_TEST_SEED"] = str(seed)
-        code = subprocess.call(
-            [sys.executable, "-m", "pytest", "-q", test_path],
-            env=new_env)
+        code = subprocess.call(cmd, env=new_env)
         status = "PASS" if code == 0 else "FAIL"
         print(f"trial {i + 1}/{args.num_trials} seed={seed}: {status}")
         if code != 0:
@@ -41,14 +82,18 @@ def run_test_trials(args):
 def parse_args(argv=None):
     parser = argparse.ArgumentParser(description="Check test for flakiness")
     parser.add_argument(
-        "test",
+        "test", nargs="?", default="tests/",
         help="file name and test name, e.g. tests/test_operator.py::test_abs "
-             "(reference spelling test_operator.test_abs also accepted)")
+             "(reference spelling test_operator.test_abs also accepted); "
+             "default: the whole tier-1 suite")
     parser.add_argument("-n", "--num-trials", metavar="N", type=int,
                         default=DEFAULT_NUM_TRIALS,
                         help="number of test trials")
     parser.add_argument("-s", "--seed", type=int, default=None,
                         help="fixed seed instead of a fresh one per trial")
+    parser.add_argument("--all", action="store_true",
+                        help="include tests marked slow (tier-1 excludes "
+                             "them)")
     args = parser.parse_args(argv)
     # reference dotted spelling (test_module.test_name) — only when the
     # argument is not already a path / pytest id
