@@ -16,10 +16,17 @@
 #                plus a short serving bench sanity check (>=3x batched
 #                throughput, zero steady-state compile misses, deadline
 #                rejection on a full queue)
-#   resilience - fault-tolerance smoke: test_resilience.py plus a 20-step
-#                train loop under MXNET_FAULTS-injected checkpoint-write
-#                crashes and one forced NaN step — exact loss parity with
-#                a fault-free run, bitwise-identical crash/resume
+#   resilience - fault-tolerance smoke: test_resilience.py +
+#                test_pod_checkpoint.py (sharded co-writer saves, async,
+#                elastic resume), plus a 20-step train loop under
+#                MXNET_FAULTS-injected checkpoint-write crashes and one
+#                forced NaN step — exact loss parity with a fault-free
+#                run, bitwise-identical crash/resume; then a preemption
+#                smoke (SIGTERM a 20-step training subprocess mid-run,
+#                assert a committed final checkpoint and bitwise resume
+#                parity with an uninterrupted run) and an async-save
+#                smoke (the step-path cost of save(sync=False) must shed
+#                >=80% of the sync serialize+IO bill)
 #   engine     - lazy-dispatch bulking smoke: test_engine_bulk.py (fused
 #                vs eager parity + fallback matrix), then a telemetry
 #                parity pass under MXNET_ENGINE_BULK=16 (fused segments
@@ -273,6 +280,58 @@ for seed in (7, 11):
 assert probes[0] == probes[1], probes
 print("resilience smoke ok: 20 steps, 2 injected save crashes absorbed,",
       f"1 NaN step skipped, exact loss parity, resume at step {latest}")
+PY
+  JAX_PLATFORMS=cpu python -m pytest tests/test_pod_checkpoint.py -q
+  # preemption smoke: SIGTERM a 20-step training subprocess mid-run; it
+  # must exit 0 with a committed final checkpoint, and the resumed run's
+  # losses must be bitwise-identical to an uninterrupted 20-step run
+  JAX_PLATFORMS=cpu python - <<'PY'
+import os, re, signal, subprocess, sys, tempfile
+sys.path.insert(0, "tests")
+import pod_ckpt_worker as worker
+
+d = tempfile.mkdtemp(prefix="ci_preempt_")
+env = dict(os.environ, PYTHONPATH=os.getcwd())
+p = subprocess.Popen(
+    [sys.executable, "tests/pod_ckpt_worker.py", "--mode", "train-preempt",
+     "--dir", d, "--steps", "20", "--save-every", "5",
+     "--step-delay", "0.15"],
+    stdout=subprocess.PIPE, text=True, bufsize=1, env=env)
+lines = []
+for line in p.stdout:
+    lines.append(line.strip())
+    if line.startswith("STEP 7 "):          # mid-run, off the save cadence
+        p.send_signal(signal.SIGTERM)
+rc = p.wait(timeout=300)
+assert rc == 0, (rc, lines[-5:])
+pre = next(ln for ln in lines if ln.startswith("PREEMPTED"))
+k = int(re.search(r"step=(\d+)", pre).group(1))
+assert f"ckpt={k}" in pre, pre
+child = [float(ln.split()[2]) for ln in lines if ln.startswith("STEP")]
+assert len(child) == k, (len(child), k)
+
+from mxnet_tpu.parallel import SPMDCheckpointManager
+assert SPMDCheckpointManager(d).latest_step() == k
+
+from mxnet_tpu.resilience import ResilientTrainer
+ref = worker.reference_losses(20)
+rt = ResilientTrainer(worker.build_trainer(0), d, save_every=100)
+assert rt.resumed_from == k, (rt.resumed_from, k)
+resumed = [float(rt.step(x, y).asnumpy())
+           for x, y in worker.make_batches(20)[k:]]
+assert child + resumed == ref, "preempted+resumed must match uninterrupted"
+print(f"preemption smoke ok: SIGTERM at step {k}, clean exit 0,",
+      "final checkpoint committed, bitwise-identical resume")
+PY
+  # async-save smoke: the step path must shed >=80% of the serialize+IO
+  # time a synchronous save bills to it
+  JAX_PLATFORMS=cpu BENCH_RESILIENCE_ROUNDS=6 python - <<'PY'
+import bench
+r = bench.bench_resilience()
+assert r["async_offload_pct"] >= 80.0, r
+print("async-save smoke ok:", r["save_ms_p50"], "ms sync ->",
+      r["async_save_call_ms_p50"], "ms on the step path",
+      f"({r['async_offload_pct']}% offloaded)")
 PY
 }
 

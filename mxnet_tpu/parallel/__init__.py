@@ -26,7 +26,7 @@ from .ring_attention import (  # noqa: F401
 )
 from .checkpoint import (  # noqa: F401
     save_spmd_checkpoint, load_spmd_checkpoint, SPMDCheckpointManager,
-    CheckpointCorrupted,
+    CheckpointCorrupted, CommitBarrierTimeout,
 )
 from .pipeline import (gpipe, gpipe_interleaved,  # noqa: F401
                        pipeline_stage_loop, pipeline_train_1f1b)

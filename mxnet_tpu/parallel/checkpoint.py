@@ -30,8 +30,33 @@ contract needs byte-level control:
   saves all failed mid-write still has a resume point.
 - **Injection + retry.**  The write/read paths are threaded with fault
   sites (``checkpoint.write`` / ``checkpoint.manifest`` /
-  ``checkpoint.commit`` / ``checkpoint.read``) and optionally wrapped in a
-  :class:`~mxnet_tpu.resilience.retry.RetryPolicy`.
+  ``checkpoint.commit`` / ``checkpoint.read`` / ``ckpt.shard_write`` /
+  ``ckpt.commit_barrier`` / ``ckpt.async_serialize``) and optionally
+  wrapped in a :class:`~mxnet_tpu.resilience.retry.RetryPolicy`.
+
+Pod scale (ISSUE 9): the manager survives the three ways real pods die —
+
+- **Host loss mid-save** — with ``host_count > 1`` each process writes only
+  its addressable shards (``jax.Array.addressable_shards``, replica 0) to
+  ``shard-<host>-<n>.bin`` files with per-shard crc32, then a per-host
+  completion marker ``host-<h>.json``; host 0 commits ``manifest.json``
+  only after **every** host marker exists (the two-phase commit).  A
+  crashed co-writer leaves a recoverable partial — the step never becomes
+  a resume candidate, the previous complete checkpoint stays newest.
+- **Preemption** — ``save(..., sync=False)`` snapshots the state with
+  donation-safe device-side copies and serializes + fsyncs on a background
+  thread (``wait_for_save()`` joins it; at most one save is in flight), so
+  save cost leaves the step path and a SIGTERM between cadence points only
+  costs one final synchronous save (``resilience.PreemptionHandler``).
+- **Topology change on resume** — ``restore()`` reassembles every leaf on
+  host from its shards and re-places it with the *current* trainer's
+  sharding, so a checkpoint taken on 8 chips resumes on 4
+  (elastic resume).  Caveat: restore gathers full arrays per host; on a
+  real pod whose model state exceeds one host's RAM a per-host
+  ``make_array_from_single_device_arrays`` path would be needed.
+
+The single-host (``host_count == 1``) format and semantics are the PR 4
+ones, bitwise-unchanged.
 """
 from __future__ import annotations
 
@@ -39,6 +64,7 @@ import json
 import os
 import pickle
 import shutil
+import threading
 import time
 import zlib
 
@@ -47,7 +73,8 @@ from ..resilience import faults as _faults
 from ..telemetry import bus as _tel
 
 __all__ = ["save_spmd_checkpoint", "load_spmd_checkpoint",
-           "SPMDCheckpointManager", "CheckpointCorrupted"]
+           "SPMDCheckpointManager", "CheckpointCorrupted",
+           "CommitBarrierTimeout"]
 
 
 def _checkpointer():
@@ -65,7 +92,7 @@ def _tree_bytes(tree):
     return total
 
 
-def _build_tree(trainer, step=None):
+def _build_tree(trainer, step=None, block=True):
     """Trainer state as the checkpoint pytree, with pending device compute
     drained (counted as serialize time, not IO)."""
     import jax
@@ -74,9 +101,24 @@ def _build_tree(trainer, step=None):
             "opt_state": {k: list(v) for k, v in opt_state.items()},
             "aux": list(aux),
             "step": trainer._t if step is None else step}
-    jax.block_until_ready([leaf for leaf in jax.tree_util.tree_leaves(tree)
-                           if hasattr(leaf, "block_until_ready")])
+    if block:
+        jax.block_until_ready(
+            [leaf for leaf in jax.tree_util.tree_leaves(tree)
+             if hasattr(leaf, "block_until_ready")])
     return tree
+
+
+def _snapshot_tree(trainer):
+    """Donation-safe snapshot for async saves: every device leaf becomes a
+    fresh device-side copy (``jnp.copy`` preserves the sharding), enqueued
+    *before* any later step can donate the originals — the runtime orders
+    the copy ahead of the donation, so the background serializer never
+    reads a donated buffer.  No host sync happens on the calling thread."""
+    import jax
+    import jax.numpy as jnp
+    tree = _build_tree(trainer, block=False)
+    return jax.tree_util.tree_map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, tree)
 
 
 def save_spmd_checkpoint(path, trainer, step=None):
@@ -128,9 +170,57 @@ class CheckpointCorrupted(IOError):
     """A committed checkpoint failed manifest/checksum verification."""
 
 
+class CommitBarrierTimeout(TimeoutError):
+    """Host 0 gave up waiting for co-writer completion markers.
+
+    The step directory stays uncommitted (no ``manifest.json``), so the
+    previous complete checkpoint remains the resume point.  A
+    ``TimeoutError`` (hence ``OSError``): the default retry filter covers
+    it, but retrying a barrier whose co-writer is *dead* just multiplies
+    the timeout — pass ``RetryPolicy(nonretryable=(CommitBarrierTimeout,))``
+    when wrapping a whole sharded save."""
+
+
 _MANIFEST = "manifest.json"
 _PAYLOAD = "state.bin"
+_META = "meta.bin"
 _FORMAT = 1
+_FORMAT_SHARDED = 2
+
+
+def _marker_name(host):
+    return f"host-{int(host)}.json"
+
+
+def _np_dtype(name):
+    """dtype-by-name, covering the ml_dtypes extension types (bfloat16,
+    float8_*) that ``np.dtype(str)`` does not resolve."""
+    import numpy as np
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _sim_host_of(device, host_count):
+    """Simulated-host assignment: global device ids striped round-robin
+    across hosts.  Deterministic across co-writer processes that share one
+    device enumeration (the multi-process simulation contract); striped —
+    not contiguous blocks — so every co-writer owns replica-0 shards even
+    when the sharded axis is the mesh's innermost one."""
+    return int(device.id) % int(host_count)
+
+
+def _index_to_json(index, shape):
+    """``shard.index`` (tuple of slices) -> [[start, stop], ...] with the
+    ``None`` endpoints resolved against the global shape."""
+    out = []
+    for k, s in enumerate(index):
+        start = 0 if s.start is None else int(s.start)
+        stop = int(shape[k]) if s.stop is None else int(s.stop)
+        out.append([start, stop])
+    return out
 
 
 class SPMDCheckpointManager:
@@ -138,7 +228,8 @@ class SPMDCheckpointManager:
     latest) — the ``do_checkpoint``-per-epoch role for SPMD jobs, with the
     crash-safety contract described in the module docstring.
 
-    On-disk layout (one directory per committed step)::
+    On-disk layout (one directory per committed step).  Single host
+    (format 1, the PR 4 layout, bitwise-unchanged)::
 
         directory/
           step_0000000005/
@@ -146,10 +237,31 @@ class SPMDCheckpointManager:
             manifest.json    # {"files": {"state.bin": {crc32, size}}, ...}
           .tmp-step_...      # in-flight write (crash leftover until GC)
 
+    Sharded (format 2, ``host_count > 1``) — each host writes only its
+    addressable shards; process 0 commits the manifest only after every
+    host's completion marker exists::
+
+        directory/
+          step_0000000005/
+            shard-0-0.bin    # host 0's replica-0 shard payloads
+            shard-1-0.bin    # host 1's
+            meta.bin         # host 0: tree scalars, global shapes, extra
+            host-0.json      # per-host marker: shard entries + file crc32s
+            host-1.json
+            manifest.json    # host 0, LAST — the commit point
+
     A step directory is **complete** iff its manifest parses and every
     listed file exists at its recorded size; only complete steps are resume
-    candidates.  ``restore`` additionally verifies crc32 checksums and
-    falls back to the next-older complete step on mismatch.
+    candidates.  ``restore`` additionally verifies crc32 checksums (whole
+    files and, for sharded steps, each shard entry) and falls back to the
+    next-older complete step on mismatch.  Re-saving a step that is
+    already complete is a no-op; a *partial* sharded step (crashed
+    previous attempt) is re-saved by **continuing** the shard-file
+    sequence (payload files are never rewritten in place) with atomic
+    marker/manifest replacement, so a commit racing a co-writer's re-save
+    can only ever reference durable bytes — sound because a step's state
+    is a pure function of the step number within one run (the same
+    assumption behind the idempotent re-save).
 
     Parameters
     ----------
@@ -160,20 +272,48 @@ class SPMDCheckpointManager:
     retry : resilience.RetryPolicy, optional
         Wraps the write and read IO (site ``checkpoint.save`` /
         ``checkpoint.read``); transient failures — including injected ones
-        — are retried with backoff before surfacing.
+        — are retried with backoff before surfacing.  The sharded commit
+        barrier is deliberately *outside* the retry.
+    host_index / host_count : int, optional
+        Simulated-host identity for multi-process tests on one box
+        (overridable via ``MXNET_CKPT_HOST=h/H``).  Default: the real
+        ``jax.process_index()`` / ``jax.process_count()``.
+    barrier_timeout_s : float
+        How long host 0 waits for co-writer markers before abandoning the
+        commit with :class:`CommitBarrierTimeout`.
+    shard_file_bytes : int
+        Roll to a new ``shard-<h>-<n>.bin`` file when the current one
+        would exceed this (streaming writes stay bounded).
     """
 
     # another process's in-flight tmp commit younger than this is presumed
     # live; older ones are crash leftovers and fair game for _gc
     _TMP_GRACE_S = 3600.0
 
-    def __init__(self, directory, max_to_keep=3, retry=None):
+    def __init__(self, directory, max_to_keep=3, retry=None,
+                 host_index=None, host_count=None, barrier_timeout_s=120.0,
+                 shard_file_bytes=1 << 30):
         if int(max_to_keep) < 1:
             raise ValueError(f"max_to_keep must be >= 1, got {max_to_keep}")
+        if host_index is not None and host_count is None:
+            raise ValueError(
+                "host_index without host_count: the save would silently "
+                "take the single-host path — pass both (or neither, for "
+                "the real jax process topology)")
         self._dir = os.path.abspath(directory)
         self._keep = int(max_to_keep)
         self._retry = retry
         self._tmp_seq = 0
+        self._host_index = host_index
+        self._host_count = host_count
+        self._barrier_timeout = float(barrier_timeout_s)
+        self._shard_file_bytes = int(shard_file_bytes)
+        # async-save state: _async_thread/_async_err are shared with the
+        # background serializer thread — every access goes through
+        # _async_lock
+        self._async_lock = threading.Lock()
+        self._async_thread = None
+        self._async_err = None
         self.restored_extra = None
         os.makedirs(self._dir, exist_ok=True)
 
@@ -185,8 +325,30 @@ class SPMDCheckpointManager:
     def _step_dir(self, step):
         return os.path.join(self._dir, f"step_{int(step):010d}")
 
+    def _hosts(self):
+        """(host_index, host_count, simulated) — ctor args, then the
+        ``MXNET_CKPT_HOST=h/H`` env override, then the real jax process
+        topology.  Resolved per call so tests can flip the env var."""
+        if self._host_count is not None:
+            h = 0 if self._host_index is None else int(self._host_index)
+            return h, int(self._host_count), True
+        env = os.environ.get("MXNET_CKPT_HOST")
+        if env:
+            h, sep, cnt = env.partition("/")
+            if not sep or not h.strip().isdigit() or \
+                    not cnt.strip().isdigit():
+                raise ValueError(
+                    f"MXNET_CKPT_HOST={env!r}: want 'h/H' (e.g. '0/2' = "
+                    f"host 0 of 2)")
+            return int(h), int(cnt), True
+        import jax
+        return jax.process_index(), jax.process_count(), False
+
     def _manifest_of(self, step):
-        """Parsed manifest if the step directory is complete, else None."""
+        """Parsed manifest if the step directory is complete, else None.
+        For sharded (format 2) steps the manifest lists every shard file,
+        host marker and the meta blob — the whole step dir is validated as
+        one unit."""
         d = self._step_dir(step)
         try:
             with open(os.path.join(d, _MANIFEST)) as f:
@@ -224,35 +386,106 @@ class SPMDCheckpointManager:
         return complete[-1] if complete else None
 
     # -------------------------------------------------------------- save
-    def save(self, step, trainer, extra=None):
-        """Atomically commit the trainer's full state as step ``step``.
+    def save(self, step, trainer, extra=None, sync=True):
+        """Commit the trainer's full state as step ``step``.
 
         ``extra`` is an optional picklable dict stored alongside the state
         tree (``ResilientTrainer`` keeps the RNG stream there); it comes
-        back via ``restored_extra`` after :meth:`restore`."""
+        back via ``restored_extra`` after :meth:`restore`.
+
+        With ``sync=False`` the call snapshots the state with donation-safe
+        device-side copies and returns immediately; serialization and the
+        fsync'd write run on a background thread (at most one in flight —
+        a second async save first joins the previous).  Failures surface
+        on the next :meth:`wait_for_save`."""
         step = int(step)
-        with _tel.span("checkpoint.save", kind="spmd_managed",
-                       step=step) as sp:
+        if not sync:
+            return self._save_async(step, trainer, extra)
+        self._join_async()     # serialize directory access with an inflight
+        return self._save_tree(step, lambda: _build_tree(trainer), extra)
+
+    def wait_for_save(self):
+        """Block until the inflight async save (if any) lands; re-raise its
+        failure exactly once.  Returns True."""
+        self._join_async()
+        with self._async_lock:
+            err, self._async_err = self._async_err, None
+        if err is not None:
+            raise err
+        return True
+
+    @property
+    def async_inflight(self):
+        """True while a background save is running."""
+        with self._async_lock:
+            t = self._async_thread
+        return t is not None and t.is_alive()
+
+    def _join_async(self):
+        """Join any inflight async save, keeping its error for
+        :meth:`wait_for_save` to surface."""
+        with self._async_lock:
+            t = self._async_thread
+        if t is not None:
+            t.join()
+            with self._async_lock:
+                if self._async_thread is t:
+                    self._async_thread = None
+
+    def _save_async(self, step, trainer, extra):
+        self._join_async()     # at-most-one-inflight
+        with _tel.span("checkpoint.async_enqueue", step=step):
+            snap = _snapshot_tree(trainer)
+
+        def _run():
+            try:
+                if _faults.active:
+                    _faults.check("ckpt.async_serialize")
+                self._save_tree(step, lambda: snap, extra,
+                                kind="spmd_async")
+            except BaseException as e:   # surfaced via wait_for_save
+                with self._async_lock:
+                    self._async_err = e
+                if _tel.enabled:
+                    _tel.instant("checkpoint.async_save_failed", step=step,
+                                 error=repr(e))
+            finally:
+                _tel.gauge("checkpoint.async_inflight", 0)
+
+        t = threading.Thread(target=_run, name="ckpt-async-save",
+                             daemon=True)
+        _tel.gauge("checkpoint.async_inflight", 1)
+        with self._async_lock:
+            # publish + start under one lock hold: a concurrent
+            # _join_async can never observe (and try to join) a thread
+            # that has not been started yet
+            self._async_thread = t
+            t.start()
+
+    def _save_tree(self, step, tree_thunk, extra, kind="spmd_managed"):
+        h, host_count, sim = self._hosts()
+        if host_count > 1:
+            return self._save_sharded(step, tree_thunk, extra,
+                                      h, host_count, sim, kind)
+        with _tel.span("checkpoint.save", kind=kind, step=step) as sp:
             with _tel.span("checkpoint.serialize"):
                 import jax
                 import numpy as np
 
                 def _to_host(x):
-                    # this manager gathers the whole state to one host;
-                    # a multi-process mesh leaf is not fully addressable
-                    # and np.asarray would raise a cryptic RuntimeError
-                    # deep in jax — fail with the actual limitation
+                    # single-host mode gathers the whole state here; a
+                    # non-fully-addressable leaf means this is really a
+                    # multi-process mesh — the sharded writer handles it
                     if getattr(x, "is_fully_addressable", True) is False:
-                        raise NotImplementedError(
-                            "SPMDCheckpointManager gathers state to one "
-                            "host; multi-host (non-fully-addressable) "
-                            "arrays are not yet supported — see ROADMAP "
-                            "(cross-host checkpointing)")
+                        raise ValueError(
+                            "non-fully-addressable array in a single-host "
+                            "save: construct SPMDCheckpointManager with "
+                            "host_count > 1 (or run under jax.distributed) "
+                            "so each host writes its own shards")
                     return np.asarray(x)
 
-                tree = _build_tree(trainer)
-                host_tree = jax.tree_util.tree_map(_to_host, tree)
-                blob = pickle.dumps({"tree": host_tree, "extra": extra},
+                tree = jax.tree_util.tree_map(_to_host, tree_thunk())
+                blob = pickle.dumps({"tree": tree, "extra": extra},
                                     protocol=pickle.HIGHEST_PROTOCOL)
             sp.set(bytes_written=len(blob))
             with _tel.span("checkpoint.io", bytes=len(blob)):
@@ -287,8 +520,7 @@ class SPMDCheckpointManager:
             manifest = {"format": _FORMAT, "step": step,
                         "files": {_PAYLOAD: {"size": len(blob),
                                              "crc32": zlib.crc32(blob)}}}
-            _durable.fsync_write(os.path.join(tmp, _MANIFEST),
-                                 json.dumps(manifest, indent=1).encode())
+            _durable.fsync_write_json(os.path.join(tmp, _MANIFEST), manifest)
             if _faults.active:
                 _faults.check("checkpoint.commit")
             # directory fsyncs: the files' entries live in the tmp dir's
@@ -305,16 +537,249 @@ class SPMDCheckpointManager:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
 
+    # ----------------------------------------------------- sharded save
+    def _save_sharded(self, step, tree_thunk, extra, host, host_count, sim,
+                      kind):
+        """Per-shard streaming save: this host's replica-0 shards +
+        completion marker; host 0 additionally waits for every marker and
+        commits the manifest (the two-phase commit point)."""
+        import jax
+
+        if self._manifest_of(step) is not None:
+            return            # idempotent re-save of a committed step
+        d = self._step_dir(step)
+        with _tel.span("checkpoint.save", kind=kind, step=step, host=host,
+                       host_count=host_count, sharded=True) as sp:
+            with _tel.span("checkpoint.serialize"):
+                leaves = jax.tree_util.tree_flatten(tree_thunk())[0]
+                plan, scalars, global_meta = [], {}, {}
+                for i, leaf in enumerate(leaves):
+                    if not isinstance(leaf, jax.Array):
+                        scalars[i] = leaf
+                        continue
+                    global_meta[i] = {"shape": list(leaf.shape),
+                                      "dtype": str(leaf.dtype)}
+                    for shd in leaf.addressable_shards:
+                        if shd.replica_id != 0:
+                            continue     # exactly one host owns replica 0
+                        if sim and _sim_host_of(shd.device,
+                                                host_count) != host:
+                            continue
+                        plan.append((i, shd, leaf.shape))
+                meta_blob = None
+                if host == 0:
+                    meta_blob = pickle.dumps(
+                        {"format": _FORMAT_SHARDED, "step": step,
+                         "nleaves": len(leaves), "scalars": scalars,
+                         "global": global_meta, "extra": extra},
+                        protocol=pickle.HIGHEST_PROTOCOL)
+            with _tel.span("checkpoint.io") as iosp:
+                if self._retry is not None:
+                    nbytes = self._retry.call(
+                        self._write_host_files, d, step, host, host_count,
+                        plan, meta_blob, site="checkpoint.save")
+                else:
+                    nbytes = self._write_host_files(d, step, host,
+                                                    host_count, plan,
+                                                    meta_blob)
+                iosp.set(bytes=nbytes)
+                if host == 0:
+                    # the barrier is NOT retried: a dead co-writer would
+                    # just multiply the timeout (CommitBarrierTimeout docs)
+                    markers = self._wait_markers(d, step, host_count)
+                    if self._retry is not None:
+                        self._retry.call(self._commit_sharded, d, step,
+                                         host_count, markers,
+                                         site="checkpoint.save")
+                    else:
+                        self._commit_sharded(d, step, host_count, markers)
+            sp.set(bytes_written=nbytes)
+            if host == 0:
+                self._gc()
+            _tel.count("checkpoint.saves")
+            _tel.count("checkpoint.bytes_written", nbytes)
+            _tel.count("checkpoint.shard_bytes", nbytes)
+
+    def _write_host_files(self, d, step, host, host_count, plan, meta_blob):
+        """Phase 1 for one host, streaming: shard payloads one shard at a
+        time (host RAM holds one shard, not the state; rolling whole-file
+        crc32), then the meta blob, then the completion marker.
+
+        Two invariants make a re-save of a *partial* step (crashed
+        previous attempt) safe against a commit racing it:
+
+        - payload files are **never rewritten in place** — the file
+          sequence continues past any ``shard-<h>-<n>.bin`` already on
+          disk, so a manifest committed against a previous attempt's
+          (durable, byte-identical) marker can never end up referencing
+          bytes being truncated underneath it;
+        - the marker (and the manifest) is **replaced atomically**, so a
+          reader sees the old complete marker or the new complete marker,
+          never a torn one.
+
+        Every byte is fsynced before the marker appears, so a marker's
+        existence implies its files are durable."""
+        import numpy as np
+        import re
+
+        os.makedirs(d, exist_ok=True)
+        marker_path = os.path.join(d, _marker_name(host))
+        prev = self._read_marker(d, host)
+        if prev is not None:
+            # a previous attempt already completed this host's phase 1:
+            # its files are durable (the marker is written last) and the
+            # step's content is deterministic, so there is nothing to
+            # redo — and replacing the marker could invalidate a manifest
+            # host 0 is committing against right now
+            return sum(e["size"] for e in prev["shards"])
+        pat = re.compile(rf"shard-{host}-(\d+)\.bin$")
+        try:
+            taken = [int(m.group(1)) for n in os.listdir(d)
+                     for m in [pat.match(n)] if m]
+        except OSError:
+            taken = []
+        entries, file_meta = [], {}
+        state = {"f": None, "name": None, "offset": 0, "crc": 0,
+                 "seq": max(taken, default=-1) + 1}
+
+        def _roll():
+            _close()
+            state["name"] = f"shard-{host}-{state['seq']}.bin"
+            state["seq"] += 1
+            state["f"] = open(os.path.join(d, state["name"]), "wb")
+            state["offset"] = state["crc"] = 0
+
+        def _close():
+            f = state["f"]
+            if f is None:
+                return
+            f.flush()
+            os.fsync(f.fileno())
+            f.close()
+            state["f"] = None
+            file_meta[state["name"]] = {"size": state["offset"],
+                                        "crc32": state["crc"]}
+
+        try:
+            for i, shd, shape in plan:
+                a = np.ascontiguousarray(np.asarray(shd.data))
+                raw = a.tobytes()
+                if state["f"] is None or (
+                        state["offset"] and
+                        state["offset"] + len(raw) > self._shard_file_bytes):
+                    _roll()
+                if _faults.active:
+                    # a fail here = host death mid-stream: truncated shard
+                    # file, no marker, step never commits
+                    _faults.check("ckpt.shard_write")
+                state["f"].write(raw)
+                entries.append({
+                    "leaf": i, "file": state["name"],
+                    "offset": state["offset"], "size": len(raw),
+                    "crc32": zlib.crc32(raw), "dtype": str(a.dtype),
+                    "shape": list(a.shape),
+                    "index": _index_to_json(shd.index, shape)})
+                state["crc"] = zlib.crc32(raw, state["crc"])
+                state["offset"] += len(raw)
+            _close()
+        except BaseException:
+            if state["f"] is not None:
+                state["f"].close()
+            raise
+        if meta_blob is not None:
+            # meta is host 0's and deterministic per step — atomic replace
+            # keeps a previous attempt's durable copy intact for readers
+            _durable.replace_file_atomic(os.path.join(d, _META), meta_blob,
+                                         site="ckpt.shard_write")
+            file_meta[_META] = {"size": len(meta_blob),
+                                "crc32": zlib.crc32(meta_blob)}
+        if _faults.active:
+            # payload durable, completion not — the same window the
+            # single-host checkpoint.manifest site drills
+            _faults.check("checkpoint.manifest")
+        marker = {"format": _FORMAT_SHARDED, "step": step, "host": host,
+                  "host_count": host_count, "files": file_meta,
+                  "shards": entries}
+        _durable.replace_file_atomic_json(marker_path, marker)
+        _durable.fsync_dir(d)
+        return sum(e["size"] for e in entries)
+
+    def _read_marker(self, d, host):
+        """Parsed + size-validated host marker, or None while incomplete."""
+        try:
+            with open(os.path.join(d, _marker_name(host))) as f:
+                marker = json.load(f)
+            for name, meta in marker["files"].items():
+                if os.path.getsize(os.path.join(d, name)) != meta["size"]:
+                    return None
+            return marker
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def _wait_markers(self, d, step, host_count):
+        """Host 0's commit barrier: poll until every host's completion
+        marker validates, or give up after ``barrier_timeout_s``."""
+        if _faults.active:
+            _faults.check("ckpt.commit_barrier")
+        deadline = time.monotonic() + self._barrier_timeout
+        markers = {}          # validated markers cannot regress (written
+        while True:           # last, after their files are fsynced)
+            missing = []
+            for h in range(host_count):
+                if h in markers:
+                    continue
+                m = self._read_marker(d, h)
+                if m is None:
+                    missing.append(h)
+                else:
+                    markers[h] = m
+            if not missing:
+                return markers
+            if time.monotonic() >= deadline:
+                raise CommitBarrierTimeout(
+                    f"step {step}: no completion marker from host(s) "
+                    f"{missing} after {self._barrier_timeout:g}s — co-writer "
+                    f"crashed mid-save?  The partial step dir stays "
+                    f"uncommitted; the previous complete checkpoint remains "
+                    f"the resume point")
+            time.sleep(0.02)
+
+    def _commit_sharded(self, d, step, host_count, markers):
+        """Phase 2 (host 0 only): the manifest lists every host's files —
+        its appearance is the atomic commit point for the whole step."""
+        all_files = {}
+        for h, marker in markers.items():
+            all_files.update(marker["files"])
+            with open(os.path.join(d, _marker_name(h)), "rb") as f:
+                raw = f.read()
+            all_files[_marker_name(h)] = {"size": len(raw),
+                                          "crc32": zlib.crc32(raw)}
+        if _faults.active:
+            _faults.check("checkpoint.commit")
+        manifest = {"format": _FORMAT_SHARDED, "step": step,
+                    "host_count": host_count, "files": all_files}
+        _durable.replace_file_atomic_json(os.path.join(d, _MANIFEST),
+                                          manifest)
+        _durable.fsync_dir(d)
+        _durable.fsync_dir(self._dir)
+
     def _gc(self):
         """Drop all but the newest ``max_to_keep`` complete checkpoints,
         plus any incomplete/tmp leftovers older than the newest complete
-        one.  The newest complete checkpoint is structurally exempt."""
+        one.  The newest complete checkpoint is structurally exempt, and so
+        is any sharded step whose manifest commit is still in flight
+        (shard files / host markers present, no manifest, recent mtime) —
+        co-writers may still be converging on it."""
         complete = self.complete_steps()
         doomed = complete[:-self._keep]
         newest = complete[-1] if complete else None
         for s in self.all_steps():
-            if s in doomed or (newest is not None and s < newest
-                               and s not in complete):
+            if s in doomed:
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)
+            elif (newest is not None and s < newest and s not in complete
+                    and not self._sharded_in_flight(s)):
+                # an incomplete step dir is one unit — shards, markers and
+                # all go together
                 shutil.rmtree(self._step_dir(s), ignore_errors=True)
         try:
             for name in os.listdir(self._dir):
@@ -336,15 +801,42 @@ class SPMDCheckpointManager:
         except OSError:
             pass
 
+    def _sharded_in_flight(self, step):
+        """A step dir that looks like a sharded write still converging:
+        shard files or host markers but no manifest, touched recently.  A
+        crashed co-writer's leftovers age out of this grace and get GCd."""
+        d = self._step_dir(step)
+        if os.path.exists(os.path.join(d, _MANIFEST)):
+            return False
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return False
+        if not any(n.startswith(("shard-", "host-")) for n in names):
+            return False
+        try:
+            age = time.time() - os.path.getmtime(d)
+        except OSError:
+            return False
+        return age < self._TMP_GRACE_S
+
     # ------------------------------------------------------------ restore
     def _read_verified(self, step):
-        """Read + checksum-verify one complete step's payload."""
+        """Read + checksum-verify one complete step.
+
+        Format 1 returns the payload ``bytes``; format 2 returns
+        ``(meta, markers, filedata, nbytes)`` for :meth:`_assemble_sharded`
+        (file reads + whole-file crc32 here, assembly in the deserialize
+        span)."""
         manifest = self._manifest_of(step)
         if manifest is None:
             raise CheckpointCorrupted(f"step {step}: no complete manifest")
         if _faults.active:
             _faults.check("checkpoint.read")
-        path = os.path.join(self._step_dir(step), _PAYLOAD)
+        d = self._step_dir(step)
+        if manifest.get("format") == _FORMAT_SHARDED:
+            return self._read_sharded(d, step, manifest)
+        path = os.path.join(d, _PAYLOAD)
         with open(path, "rb") as f:
             blob = f.read()
         meta = manifest["files"][_PAYLOAD]
@@ -354,12 +846,91 @@ class SPMDCheckpointManager:
                 f"(crc {zlib.crc32(blob)} != manifest {meta['crc32']})")
         return blob
 
+    def _read_sharded(self, d, step, manifest):
+        """Read every host's marker + shard files, verifying each against
+        the manifest's size + crc32."""
+        def _read(name):
+            path = os.path.join(d, name)
+            with open(path, "rb") as f:
+                raw = f.read()
+            want = manifest["files"].get(name)
+            if want is None or len(raw) != want["size"] or \
+                    zlib.crc32(raw) != want["crc32"]:
+                raise CheckpointCorrupted(
+                    f"step {step}: checksum mismatch in {path}")
+            return raw
+
+        meta = pickle.loads(_read(_META))
+        markers, filedata, nbytes = [], {}, 0
+        for h in range(int(manifest["host_count"])):
+            markers.append(json.loads(_read(_marker_name(h)).decode()))
+        for marker in markers:
+            for entry in marker["shards"]:
+                name = entry["file"]
+                if name not in filedata:
+                    filedata[name] = _read(name)
+                    nbytes += len(filedata[name])
+        return meta, markers, filedata, nbytes
+
+    @staticmethod
+    def _assemble_sharded(step, meta, markers, filedata):
+        """Reassemble host-side global arrays from shard entries (per-shard
+        crc32 verified), deduping replicated indices and demanding full
+        coverage of every leaf."""
+        import numpy as np
+        leaves = [None] * int(meta["nleaves"])
+        for i, val in meta["scalars"].items():
+            leaves[i] = val
+        for i, gm in meta["global"].items():
+            dtype = _np_dtype(gm["dtype"])
+            shape = tuple(gm["shape"])
+            arr = np.empty(shape, dtype=dtype)
+            covered, seen = 0, set()
+            for marker in markers:
+                for entry in marker["shards"]:
+                    if entry["leaf"] != i:
+                        continue
+                    key = tuple(tuple(p) for p in entry["index"])
+                    if key in seen:
+                        continue
+                    raw = filedata[entry["file"]][
+                        entry["offset"]:entry["offset"] + entry["size"]]
+                    if len(raw) != entry["size"]:
+                        raise CheckpointCorrupted(
+                            f"step {step}: shard out of file bounds "
+                            f"(leaf {i}, file {entry['file']} @ "
+                            f"{entry['offset']})")
+                    # no per-entry crc re-check: _read_sharded already
+                    # crc32-verified every containing file whole, and the
+                    # entries tile those files — the per-shard crc32 in
+                    # the marker is for partial-read tooling
+                    part = np.frombuffer(
+                        raw, dtype=_np_dtype(entry["dtype"])).reshape(
+                            entry["shape"])
+                    if key:
+                        arr[tuple(slice(a, b) for a, b in key)] = part
+                    else:
+                        arr[...] = part.reshape(shape)
+                    seen.add(key)
+                    covered += part.size
+            if covered != arr.size:
+                raise CheckpointCorrupted(
+                    f"step {step}: shards cover {covered} of {arr.size} "
+                    f"elements of leaf {i} — a host's shards are missing")
+            leaves[i] = arr
+        return leaves, meta.get("extra")
+
     def restore(self, trainer, step=None):
         """Restore the newest complete checkpoint (or ``step``) into
         ``trainer``, verifying checksums; a corrupt candidate falls back to
         the next-older complete step with a ``resilience.checkpoint_fallback``
         event.  Raises ``FileNotFoundError`` when nothing restorable exists.
-        """
+
+        Elastic: the target trainer's mesh/device count may differ from
+        the writer's — every leaf is reassembled on host and re-placed
+        with the *current* sharding (``_adopt``), so an 8-chip checkpoint
+        resumes on 4."""
+        self._join_async()   # never read the directory under an inflight
         complete = self.complete_steps()
         if step is not None:
             candidates = [int(step)] + [s for s in reversed(complete)
@@ -376,11 +947,24 @@ class SPMDCheckpointManager:
                 try:
                     with _tel.span("checkpoint.io"):
                         if self._retry is not None:
-                            blob = self._retry.call(self._read_verified,
-                                                    cand,
-                                                    site="checkpoint.read")
+                            payload = self._retry.call(self._read_verified,
+                                                       cand,
+                                                       site="checkpoint.read")
                         else:
-                            blob = self._read_verified(cand)
+                            payload = self._read_verified(cand)
+                    with _tel.span("checkpoint.deserialize"):
+                        if isinstance(payload, bytes):
+                            nbytes = len(payload)
+                            data = pickle.loads(payload)
+                            host_tree, extra = data["tree"], \
+                                data.get("extra")
+                        else:
+                            meta, markers, filedata, nbytes = payload
+                            leaves, extra = self._assemble_sharded(
+                                cand, meta, markers, filedata)
+                            host_tree = self._unflatten_like(trainer, leaves)
+                        self._adopt(trainer, host_tree)
+                        self.restored_extra = extra
                 except (CheckpointCorrupted, OSError) as e:
                     last_err = e
                     sp.set(corrupt=True)
@@ -388,27 +972,38 @@ class SPMDCheckpointManager:
                     _tel.instant("resilience.checkpoint_fallback",
                                  step=cand, error=repr(e))
                     continue
-                with _tel.span("checkpoint.deserialize"):
-                    payload = pickle.loads(blob)
-                    self._adopt(trainer, payload["tree"])
-                    self.restored_extra = payload.get("extra")
-                sp.set(bytes_read=len(blob))
+                sp.set(bytes_read=nbytes)
                 _tel.count("checkpoint.restores")
-                _tel.count("checkpoint.bytes_read", len(blob))
+                _tel.count("checkpoint.bytes_read", nbytes)
                 return trainer
         raise CheckpointCorrupted(
             f"every checkpoint candidate under {self._dir} failed "
             f"verification; last error: {last_err!r}")
 
+    @staticmethod
+    def _template(trainer):
+        params, opt_state, aux = trainer._state
+        return {"params": params,
+                "opt_state": {k: list(v) for k, v in opt_state.items()},
+                "aux": list(aux),
+                "step": 0}
+
+    def _unflatten_like(self, trainer, leaves):
+        """Flat sharded-restore leaves -> the trainer's tree structure."""
+        import jax
+        treedef = jax.tree_util.tree_structure(self._template(trainer))
+        if treedef.num_leaves != len(leaves):
+            raise ValueError(
+                f"sharded checkpoint has {len(leaves)} leaves but the "
+                f"trainer's state tree has {treedef.num_leaves} — wrong "
+                f"model/optimizer for this checkpoint?")
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
     def _adopt(self, trainer, host_tree):
         """Put the host-side tree back onto the trainer's shardings (the
         resharding hop: device placement comes from the CURRENT mesh)."""
         import jax
-        params, opt_state, aux = trainer._state
-        template = {"params": params,
-                    "opt_state": {k: list(v) for k, v in opt_state.items()},
-                    "aux": list(aux),
-                    "step": 0}
+        template = self._template(trainer)
         restored = jax.tree_util.tree_map(
             lambda h, t: jax.device_put(h, t.sharding)
             if hasattr(t, "sharding") else h, host_tree, template)

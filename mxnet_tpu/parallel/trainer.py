@@ -237,6 +237,7 @@ class SPMDTrainer:
             self._step_fn, self._state = make_train_step(
                 net, loss_fn, optimizer, mesh, dp_axis=dp_axis, **kw)
         self._donate = bool(kw.get("donate", True))
+        self._preempt = None
         self._t = 0
         items = sorted(net.collect_params().items())
         self._trainable = [p for _, p in items if p.grad_req != "null"]
@@ -249,9 +250,25 @@ class SPMDTrainer:
         from .sp_context import sequence_parallel_scope
         return sequence_parallel_scope(*self._sp)
 
+    def install_preemption(self, handler, manager, extra=None):
+        """Preemption-safe training without the ResilientTrainer wrapper:
+        a triggered ``handler`` (SIGTERM/SIGINT, or ``.trigger()``) makes
+        the next :meth:`step` call do one final synchronous durable save
+        through ``manager`` and raise ``TrainingPreempted`` (clean exit
+        code 0) instead of dispatching.  One attribute check per step when
+        installed, zero when not."""
+        self._preempt = (handler, manager, extra)
+        return handler
+
     def step(self, data, label):
         import jax as _jax
         from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        if self._preempt is not None:
+            handler, manager, extra = self._preempt
+            if handler.triggered:
+                from ..resilience import preempt as _pre
+                _pre.save_and_exit(manager, self, extra=extra)
 
         def _raw(x):
             if isinstance(x, NDArray):

@@ -17,6 +17,9 @@ everything in-process, so resilience is a *library* concern:
 - :mod:`.resume` — :class:`ResilientTrainer`: checkpoint-every-N wrapper
   over ``SPMDTrainer`` that auto-resumes (step + RNG + optimizer state)
   on construction, turning a process crash into an idempotent re-run.
+- :mod:`.preempt` — :class:`PreemptionHandler`: SIGTERM/SIGINT → finish
+  the in-flight step → one final durable save → clean exit
+  (:class:`TrainingPreempted`, a ``SystemExit`` with code 0).
 
 Everything is opt-in and zero-overhead when idle: injection sites guard on
 one module attribute, and no retry wrapping touches the hot step path
@@ -26,12 +29,15 @@ from . import durable  # noqa: F401
 from . import faults  # noqa: F401
 from . import retry  # noqa: F401
 from . import guard  # noqa: F401
+from . import preempt  # noqa: F401
 from .faults import InjectedFault  # noqa: F401
 from .guard import StepGuard  # noqa: F401
+from .preempt import PreemptionHandler, TrainingPreempted  # noqa: F401
 from .retry import RetryPolicy  # noqa: F401
 
-__all__ = ["durable", "faults", "retry", "guard", "resume", "InjectedFault",
-           "RetryPolicy", "StepGuard", "ResilientTrainer"]
+__all__ = ["durable", "faults", "retry", "guard", "preempt", "resume",
+           "InjectedFault", "PreemptionHandler", "RetryPolicy", "StepGuard",
+           "TrainingPreempted", "ResilientTrainer"]
 
 
 def __getattr__(name):

@@ -6,11 +6,13 @@ directory-entry durability) so that `parallel/checkpoint.py` and
 """
 from __future__ import annotations
 
+import json
 import os
 
 from . import faults as _faults
 
-__all__ = ["fsync_write", "fsync_dir", "replace_file_atomic"]
+__all__ = ["fsync_write", "fsync_write_json", "fsync_dir",
+           "replace_file_atomic", "replace_file_atomic_json"]
 
 
 def fsync_write(path, data, site="checkpoint.write"):
@@ -25,6 +27,25 @@ def fsync_write(path, data, site="checkpoint.write"):
         f.write(data[half:])
         f.flush()
         os.fsync(f.fileno())
+
+
+def _encode_json(obj):
+    """THE json byte format for manifests/markers — one encoder, so
+    recorded sizes/crc32s cannot drift between writers."""
+    return json.dumps(obj, indent=1).encode()
+
+
+def fsync_write_json(path, obj, site="checkpoint.write"):
+    """Durably write a JSON document (plain write + fsync — for fresh
+    files in a private directory, e.g. a tmp-dir commit)."""
+    fsync_write(path, _encode_json(obj), site=site)
+
+
+def replace_file_atomic_json(path, obj, site="checkpoint.write"):
+    """Atomically replace a JSON document — a reader sees the old complete
+    document or the new one, never a torn write (the shared host-marker /
+    sharded-manifest idiom)."""
+    replace_file_atomic(path, _encode_json(obj), site=site)
 
 
 def fsync_dir(path):

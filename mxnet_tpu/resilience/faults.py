@@ -40,6 +40,16 @@ Known sites (see docs/resilience.md for the full table):
                        never a corrupt committed checkpoint
 ``checkpoint.manifest``/``checkpoint.commit``/``checkpoint.read``
                        manifest write / pre-rename / restore read
+``ckpt.shard_write``   mid-shard-file-write in a sharded (multi-host) save
+                       — a ``fail`` leaves a truncated shard file and no
+                       host marker, so the step never commits
+``ckpt.commit_barrier``
+                       host 0's wait for co-writer completion markers,
+                       before the manifest commit
+``ckpt.async_serialize``
+                       background thread of ``save(..., sync=False)``,
+                       before serialization — the failure surfaces on the
+                       next ``wait_for_save()``
 ``io.decode``          ImageRecordIter batch decode
 ``io.prefetch``        PrefetchingIter / DevicePrefetchIter worker body
 ``kvstore.push`` / ``kvstore.pull``

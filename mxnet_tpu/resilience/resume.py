@@ -36,6 +36,7 @@ from __future__ import annotations
 from .. import random as _rnd
 from ..parallel.checkpoint import SPMDCheckpointManager
 from ..telemetry import bus as _tel
+from . import preempt as _preempt
 from .guard import StepGuard
 
 __all__ = ["ResilientTrainer"]
@@ -63,17 +64,39 @@ class ResilientTrainer:
     save_rng : bool
         Capture/restore the ``mx.random`` stream with each checkpoint
         (bitwise-identical randomness across a crash/resume boundary).
+    async_save : bool
+        Cadence checkpoints run as ``save(..., sync=False)``: the step
+        path only pays a donation-safe device-side snapshot; serialization
+        and the fsync'd write happen on a background thread.  A failed
+        async save is absorbed and counted when it is next observed (the
+        following cadence point, or :meth:`wait_for_save`).
+    preemption : bool or PreemptionHandler
+        ``True`` installs a fresh :class:`~.preempt.PreemptionHandler`
+        (SIGTERM/SIGINT); or pass your own.  On a triggered handler the
+        next :meth:`step` call judges the pending loss, makes one final
+        *synchronous* durable save, and raises
+        :class:`~.preempt.TrainingPreempted` (clean exit code 0).
+    host_index / host_count : int, optional
+        Forwarded to the checkpoint manager (simulated-host sharded
+        writes; default = the real jax process topology).
     """
 
     def __init__(self, trainer, directory, save_every=100, max_to_keep=3,
-                 guard=None, retry=None, save_rng=True):
+                 guard=None, retry=None, save_rng=True, async_save=False,
+                 preemption=None, host_index=None, host_count=None):
         if int(save_every) < 1:
             raise ValueError(f"save_every must be >= 1, got {save_every}")
         self._trainer = trainer
         self._save_every = int(save_every)
         self._save_rng = bool(save_rng)
+        self._async = bool(async_save)
+        self._own_preempt = preemption is True   # we installed -> we uninstall
+        self._preempt = _preempt.PreemptionHandler() if preemption is True \
+            else (preemption or None)     # False/None -> no handler
         self._mgr = SPMDCheckpointManager(directory, max_to_keep=max_to_keep,
-                                          retry=retry)
+                                          retry=retry,
+                                          host_index=host_index,
+                                          host_count=host_count)
         self._guard = guard if guard is not None else StepGuard()
         self._pending = None       # last step's loss, not yet judged
         self.checkpoint_failures = 0
@@ -101,6 +124,10 @@ class ResilientTrainer:
         return self._guard
 
     @property
+    def preemption(self):
+        return self._preempt
+
+    @property
     def step_count(self):
         return self._trainer._t
 
@@ -115,8 +142,20 @@ class ResilientTrainer:
         checkpoint after a clean step, rollback after ``max_consecutive``
         bad steps), then dispatches this step and returns its loss
         NDArray immediately — no host sync on the hot path (non-finite on
-        a skipped step once materialized)."""
+        a skipped step once materialized).
+
+        A triggered preemption handler exits here instead of dispatching:
+        the in-flight step was judged by the flush above, one final
+        synchronous save commits, and ``TrainingPreempted`` (exit code 0)
+        propagates."""
         self.flush()
+        if self._preempt is not None and self._preempt.triggered:
+            # drain an inflight async save through OUR accounting first
+            # (checkpoint_failures + the absorbed-failure policy), so the
+            # shared final-save helper finds nothing to absorb silently
+            self.wait_for_save()
+            _preempt.save_and_exit(self._mgr, self._trainer,
+                                   extra=self._extra())
         loss = self._trainer.step(data, label)
         self._pending = loss
         return loss
@@ -135,30 +174,66 @@ class ResilientTrainer:
             self._save()
 
     # ------------------------------------------------------------ lifecycle
-    def save_now(self):
-        """Flush the pending judgment, then checkpoint the current state.
-        A save that fails even after the manager's retries is absorbed
-        (training goes on; the next cadence point tries again) and
-        counted."""
-        self.flush()
-        return self._save()
+    def close(self):
+        """End-of-training hook: join an inflight async checkpoint
+        (failure absorbed + counted) and, if this trainer installed its
+        own ``PreemptionHandler`` (``preemption=True``), uninstall it —
+        otherwise the process would silently swallow the first
+        SIGTERM/Ctrl-C *after* training, when no ``step()`` will ever
+        check the flag again.  A caller-provided handler is left alone."""
+        self.wait_for_save()
+        if self._own_preempt and self._preempt is not None:
+            self._preempt.uninstall()
 
-    def _save(self):
+    def save_now(self, sync=None):
+        """Flush the pending judgment, then checkpoint the current state
+        (``sync=None`` follows the configured ``async_save`` mode).  A save
+        that fails even after the manager's retries is absorbed (training
+        goes on; the next cadence point tries again) and counted."""
+        self.flush()
+        return self._save(sync=sync)
+
+    def wait_for_save(self):
+        """Join an inflight async checkpoint; a failure is absorbed and
+        counted (the absorbed-save-failure policy).  Returns True iff the
+        pending save — if any — landed cleanly."""
         try:
-            self._mgr.save(self._trainer._t, self._trainer,
-                           extra=self._extra())
+            self._mgr.wait_for_save()
             return True
         except Exception as e:
-            self.checkpoint_failures += 1
-            _tel.count("resilience.checkpoint_failed")
-            _tel.instant("resilience.checkpoint_failed",
-                         step=self._trainer._t, error=repr(e))
+            self._count_failure(e)
             return False
+
+    def _save(self, sync=None):
+        if sync is None:
+            sync = not self._async
+        # surface the PREVIOUS async save's fate before starting the next
+        # one (unconditional: a one-off save_now(sync=False) on a sync-mode
+        # trainer must still have its failure absorbed AND counted, not
+        # silently dropped by the manager's join)
+        self.wait_for_save()
+        try:
+            self._mgr.save(self._trainer._t, self._trainer,
+                           extra=self._extra(), sync=sync)
+            return True
+        except Exception as e:
+            self._count_failure(e)
+            return False
+
+    def _count_failure(self, e):
+        self.checkpoint_failures += 1
+        _tel.count("resilience.checkpoint_failed")
+        _tel.instant("resilience.checkpoint_failed",
+                     step=self._trainer._t, error=repr(e))
 
     def rollback(self):
         """Rewind to the newest complete checkpoint (after persistent NaN
         steps).  Raises if no checkpoint exists — with nothing to rewind
         to, continuing silently would train on poisoned state."""
+        # join an inflight async save FIRST: the newest checkpoint may be
+        # moments from committing, and aborting the run instead of using
+        # it would be wrong
+        self.wait_for_save()
         if self._mgr.latest_step() is None:
             raise RuntimeError(
                 "StepGuard demanded a rollback but no complete checkpoint "
@@ -176,6 +251,7 @@ class ResilientTrainer:
         return {"rng": _rnd.get_state()} if self._save_rng else None
 
     def _restore(self):
+        self.wait_for_save()   # never restore under an inflight async save
         self._mgr.restore(self._trainer)
         extra = self._mgr.restored_extra or {}
         if self._save_rng and extra.get("rng") is not None:

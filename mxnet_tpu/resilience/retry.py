@@ -42,6 +42,12 @@ class RetryPolicy:
     retryable : tuple of exception types
         Only these are retried.  Default ``(OSError, TimeoutError)`` —
         which covers ``IOError`` and therefore ``InjectedFault``.
+    nonretryable : tuple of exception types
+        Checked *before* ``retryable``: a match propagates immediately
+        even if it also matches the retryable filter.  For exceptions
+        where retrying is worse than failing — e.g. a checkpoint
+        ``CommitBarrierTimeout`` (a dead co-writer makes every retry wait
+        the full barrier timeout again).
     seed : int or None
         Seeds the jitter stream (deterministic backoff in tests).
     sleep : callable
@@ -50,8 +56,8 @@ class RetryPolicy:
 
     def __init__(self, max_attempts=3, base_delay_ms=50.0, max_delay_ms=2000.0,
                  multiplier=2.0, jitter=0.5,
-                 retryable=(OSError, TimeoutError), seed=None,
-                 sleep=time.sleep):
+                 retryable=(OSError, TimeoutError), nonretryable=(),
+                 seed=None, sleep=time.sleep):
         if int(max_attempts) < 1:
             raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
         self.max_attempts = int(max_attempts)
@@ -60,6 +66,7 @@ class RetryPolicy:
         self.multiplier = float(multiplier)
         self.jitter = float(jitter)
         self.retryable = tuple(retryable)
+        self.nonretryable = tuple(nonretryable)
         self._rng = _random.Random(seed)
         self._sleep = sleep
 
@@ -81,6 +88,8 @@ class RetryPolicy:
             try:
                 return fn(*args, **kwargs)
             except self.retryable as e:
+                if self.nonretryable and isinstance(e, self.nonretryable):
+                    raise
                 if attempt >= self.max_attempts:
                     if _tel.enabled:
                         _tel.count("resilience.give_up", site=site)

@@ -1,0 +1,144 @@
+"""Subprocess entry for pod-scale checkpoint drills (test_pod_checkpoint.py
+and the ci resilience stage).
+
+Modes
+-----
+``shard-save``     build the deterministic trainer, run ``--steps`` steps,
+                   then save through an ``SPMDCheckpointManager`` acting as
+                   simulated host ``--host h/H`` of a co-writer group (all
+                   workers share ``--dir``).  ``--die-at SITE`` arms a
+                   one-shot fault at SITE and hard-kills the process
+                   (``os._exit(9)``) when it trips — a co-writer host dying
+                   mid-save, not an exception a retry could absorb.
+``train-preempt``  run a ``ResilientTrainer`` loop with a
+                   ``PreemptionHandler`` installed, printing one
+                   ``STEP <i> <loss>`` line per step (full float precision,
+                   for bitwise parity checks) — the parent SIGTERMs this
+                   process mid-run and asserts a clean exit + committed
+                   final checkpoint.
+
+The trainer/batch builders are imported by the parent test for its
+uninterrupted reference runs, so both sides are bitwise-comparable by
+construction.
+"""
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+N_CLASSES = 4
+BATCH = 16
+FEATS = 8
+
+
+def build_trainer(seed=0, n_devices=None, dp=4, tp=2):
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import FunctionalOptimizer, SPMDTrainer, make_mesh
+
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = mx.gluon.nn.HybridSequential(prefix="pod_")
+    with net.name_scope():
+        net.add(mx.gluon.nn.Dense(16, activation="relu", in_units=FEATS),
+                mx.gluon.nn.Dense(N_CLASSES, in_units=16))
+    net.initialize()
+    mesh = make_mesh(n_devices=n_devices, dp=dp, tp=tp)
+    return SPMDTrainer(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                       FunctionalOptimizer("adam", 1e-2), mesh,
+                       nan_guard=True)
+
+
+def make_batches(n, seed=42):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(BATCH, FEATS).astype("float32"),
+             rng.randint(0, N_CLASSES, BATCH).astype("float32"))
+            for _ in range(n)]
+
+
+def reference_losses(n, seed=0):
+    """Uninterrupted n-step run — the parity baseline."""
+    tr = build_trainer(seed)
+    return [float(tr.step(x, y).asnumpy()) for x, y in make_batches(n)]
+
+
+def _mode_shard_save(args):
+    from mxnet_tpu.parallel import SPMDCheckpointManager
+    from mxnet_tpu.resilience import InjectedFault, faults
+
+    host, _, host_count = args.host.partition("/")
+    tr = build_trainer(args.seed)
+    for x, y in make_batches(args.steps):
+        tr.step(x, y)
+    if args.die_at:
+        faults.inject(args.die_at, "fail:1")
+    mgr = SPMDCheckpointManager(args.dir, host_index=int(host),
+                                host_count=int(host_count),
+                                barrier_timeout_s=args.barrier_timeout)
+    try:
+        mgr.save(tr._t, tr, extra={"host": int(host)})
+    except InjectedFault:
+        # the drill: a host dying mid-save is a kill, not an exception a
+        # retry could absorb
+        print(f"DYING host={host} site={args.die_at}", flush=True)
+        os._exit(9)
+    print(f"SAVED step={tr._t} host={host}", flush=True)
+
+
+def _mode_train_preempt(args):
+    import time
+
+    from mxnet_tpu.resilience import ResilientTrainer, TrainingPreempted
+
+    rt = ResilientTrainer(build_trainer(args.seed), args.dir,
+                          save_every=args.save_every, preemption=True,
+                          async_save=args.async_save)
+    try:
+        for i, (x, y) in enumerate(make_batches(args.steps)):
+            loss = float(rt.step(x, y).asnumpy())
+            print(f"STEP {i} {loss!r}", flush=True)
+            if args.step_delay:
+                # widen the signal window: without this, post-compile steps
+                # are sub-ms and a parent SIGTERMing "mid-run" can lose the
+                # race to a completed run
+                time.sleep(args.step_delay)
+        rt.flush()
+        print(f"DONE step={rt.step_count}", flush=True)
+    except TrainingPreempted as e:
+        print(f"PREEMPTED step={e.step} ckpt={e.checkpoint_step}",
+              flush=True)
+        raise      # SystemExit(0): the clean exit the scheduler expects
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", required=True,
+                    choices=["shard-save", "train-preempt"])
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--steps", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--host", default="0/1",
+                    help="simulated host identity h/H (shard-save)")
+    ap.add_argument("--barrier-timeout", type=float, default=60.0)
+    ap.add_argument("--die-at", default=None,
+                    help="fault site that hard-kills this worker (fail:1)")
+    ap.add_argument("--save-every", type=int, default=5)
+    ap.add_argument("--async-save", action="store_true")
+    ap.add_argument("--step-delay", type=float, default=0.0,
+                    help="sleep per step (train-preempt: widens the "
+                         "parent's SIGTERM window)")
+    args = ap.parse_args(argv)
+    if args.mode == "shard-save":
+        _mode_shard_save(args)
+    else:
+        _mode_train_preempt(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

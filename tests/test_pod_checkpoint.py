@@ -1,0 +1,518 @@
+"""Pod-scale checkpointing (ISSUE 9): per-shard streaming saves with a
+two-phase manifest commit, async serialization, preemption-safe training,
+and elastic resharded resume.
+
+Multi-host paths run on this CPU box as *simulated* hosts: co-writer
+managers share one directory, each claiming a round-robin stripe of the 8
+virtual devices by id (``host_index``/``host_count`` — host 0 owns devices
+0/2/4/6, host 1 owns 1/3/5/7), driven either from threads (fast unit
+coverage) or real subprocesses (`pod_ckpt_worker.py`, the acceptance
+drills — including a hard-killed co-writer).
+"""
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import (
+    CommitBarrierTimeout, SPMDCheckpointManager,
+)
+from mxnet_tpu.resilience import (
+    InjectedFault, PreemptionHandler, ResilientTrainer, RetryPolicy,
+    TrainingPreempted, faults,
+)
+
+import pod_ckpt_worker as worker
+
+_WORKER = os.path.join(os.path.dirname(__file__), "pod_ckpt_worker.py")
+
+
+def _state_leaves(trainer):
+    import jax
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(trainer._state)]
+
+
+def _assert_state_equal(tr_a, tr_b):
+    a, b = _state_leaves(tr_a), _state_leaves(tr_b)
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    assert tr_a._t == tr_b._t
+
+
+def _sharded_save(directory, trainer, step, host_count=2, extra=None,
+                  barrier_timeout=30.0, retry=None):
+    """Drive a co-writer group from threads: one manager per simulated
+    host, all sharing ``directory``.  Raises the first host's error."""
+    mgrs = [SPMDCheckpointManager(directory, host_index=h,
+                                  host_count=host_count,
+                                  barrier_timeout_s=barrier_timeout,
+                                  retry=retry)
+            for h in range(host_count)]
+    errs = {}
+
+    def run(h):
+        try:
+            mgrs[h].save(step, trainer, extra=extra)
+        except BaseException as e:
+            errs[h] = e
+
+    threads = [threading.Thread(target=run, args=(h,))
+               for h in range(host_count)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errs:
+        raise errs[min(errs)]
+    return mgrs[0]
+
+
+# --------------------------------------------------------------- sharded
+def test_sharded_layout_roundtrip_and_continue(tmp_path):
+    batches = worker.make_batches(5)
+    tr = worker.build_trainer(0)
+    for x, y in batches[:3]:
+        tr.step(x, y)
+    rng_state = mx.random.get_state()
+    _sharded_save(str(tmp_path), tr, 3, extra={"note": "pod"})
+
+    d = str(tmp_path / ("step_%010d" % 3))
+    names = sorted(os.listdir(d))
+    assert "manifest.json" in names and "meta.bin" in names
+    assert "host-0.json" in names and "host-1.json" in names
+    assert any(n.startswith("shard-0-") for n in names)
+    assert any(n.startswith("shard-1-") for n in names)
+    import json
+    with open(os.path.join(d, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["format"] == 2 and manifest["host_count"] == 2
+    # every on-disk artifact is accounted for in the manifest
+    assert sorted(manifest["files"]) == [n for n in names
+                                         if n != "manifest.json"]
+
+    # each host wrote only its shards: entries are disjoint, union covers
+    markers = []
+    for h in (0, 1):
+        with open(os.path.join(d, f"host-{h}.json")) as f:
+            markers.append(json.load(f))
+    keys = [{(e["leaf"], tuple(tuple(p) for p in e["index"]))
+             for e in m["shards"]} for m in markers]
+    assert keys[0] and keys[1] and not (keys[0] & keys[1])
+
+    # restore resumes bitwise-identically on the same topology
+    tr2 = worker.build_trainer(seed=1)
+    mgr = SPMDCheckpointManager(str(tmp_path))
+    mgr.restore(tr2)
+    assert mgr.restored_extra == {"note": "pod"}
+    _assert_state_equal(tr, tr2)
+    after = [float(tr.step(x, y).asnumpy()) for x, y in batches[3:]]
+    mx.random.set_state(rng_state)
+    resumed = [float(tr2.step(x, y).asnumpy()) for x, y in batches[3:]]
+    assert resumed == after
+
+
+def test_sharded_bitwise_parity_vs_single_host_format(tmp_path):
+    tr = worker.build_trainer(0)
+    for x, y in worker.make_batches(2):
+        tr.step(x, y)
+    single = SPMDCheckpointManager(str(tmp_path / "v1"))
+    single.save(2, tr, extra={"fmt": 1})
+    _sharded_save(str(tmp_path / "v2"), tr, 2, extra={"fmt": 1})
+
+    tr_v1 = worker.build_trainer(seed=3)
+    tr_v2 = worker.build_trainer(seed=4)
+    single.restore(tr_v1)
+    SPMDCheckpointManager(str(tmp_path / "v2")).restore(tr_v2)
+    _assert_state_equal(tr_v1, tr_v2)
+    assert single.restored_extra == {"fmt": 1}
+
+
+def test_cowriter_missing_leaves_previous_restorable(tmp_path):
+    """Host 0 alone (co-writer never shows up): the barrier times out, the
+    step never commits, the previous checkpoint stays the resume point."""
+    tr = worker.build_trainer(0)
+    tr.step(*worker.make_batches(1)[0])
+    _sharded_save(str(tmp_path), tr, 1)
+    expect = _state_leaves(tr)
+
+    tr.step(*worker.make_batches(2)[1])
+    solo = SPMDCheckpointManager(str(tmp_path), host_index=0, host_count=2,
+                                 barrier_timeout_s=0.3)
+    with pytest.raises(CommitBarrierTimeout):
+        solo.save(2, tr)
+    assert isinstance(CommitBarrierTimeout("x"), OSError)  # retry-filterable
+    # host 0's partial is on disk, but the step is not a resume candidate
+    d = str(tmp_path / ("step_%010d" % 2))
+    assert os.path.exists(os.path.join(d, "host-0.json"))
+    assert not os.path.exists(os.path.join(d, "manifest.json"))
+    assert solo.complete_steps() == [1]
+    tr3 = worker.build_trainer(seed=2)
+    SPMDCheckpointManager(str(tmp_path)).restore(tr3)
+    for x, y in zip(_state_leaves(tr3), expect):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_fault_site_shard_write_never_commits(tmp_path):
+    tr = worker.build_trainer(0)
+    tr.step(*worker.make_batches(1)[0])
+    _sharded_save(str(tmp_path), tr, 1)
+    tr.step(*worker.make_batches(2)[1])
+    with faults.scope("ckpt.shard_write:fail:2"):
+        with pytest.raises((InjectedFault, CommitBarrierTimeout)):
+            _sharded_save(str(tmp_path), tr, 2, barrier_timeout=1.0)
+    mgr = SPMDCheckpointManager(str(tmp_path))
+    assert mgr.complete_steps() == [1]
+    mgr.restore(worker.build_trainer(seed=5))   # previous still restores
+
+
+def test_fault_site_commit_barrier(tmp_path):
+    tr = worker.build_trainer(0)
+    tr.step(*worker.make_batches(1)[0])
+    with faults.scope("ckpt.commit_barrier:fail:1"):
+        with pytest.raises(InjectedFault):
+            _sharded_save(str(tmp_path), tr, 1)
+    assert SPMDCheckpointManager(str(tmp_path)).complete_steps() == []
+
+
+def test_sharded_corrupt_shard_falls_back(tmp_path):
+    batches = worker.make_batches(2)
+    tr = worker.build_trainer(0)
+    tr.step(*batches[0])
+    _sharded_save(str(tmp_path), tr, 1)
+    step1 = _state_leaves(tr)
+    tr.step(*batches[1])
+    _sharded_save(str(tmp_path), tr, 2)
+
+    victim = str(tmp_path / ("step_%010d" % 2) / "shard-1-0.bin")
+    blob = bytearray(open(victim, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(victim, "wb").write(bytes(blob))
+
+    tr2 = worker.build_trainer(seed=1)
+    SPMDCheckpointManager(str(tmp_path)).restore(tr2)
+    assert tr2._t == 1                       # fell back to step 1
+    for x, y in zip(_state_leaves(tr2), step1):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_partial_resave_never_invalidates_committed_bytes(tmp_path):
+    """Crashed attempt -> restart re-saves the same step: a co-writer
+    whose phase 1 already completed must leave its durable files AND
+    marker untouched (a manifest may be committing against them), and the
+    step must still commit and restore exactly."""
+    tr = worker.build_trainer(0)
+    tr.step(*worker.make_batches(1)[0])
+    # attempt 1: host 1 finishes its phase, "host 0 dies" before writing
+    m1 = SPMDCheckpointManager(str(tmp_path), host_index=1, host_count=2,
+                               barrier_timeout_s=30)
+    m1.save(1, tr)
+    d = str(tmp_path / ("step_%010d" % 1))
+    before = {n: open(os.path.join(d, n), "rb").read()
+              for n in os.listdir(d)}
+    assert "host-1.json" in before and "manifest.json" not in before
+
+    # attempt 2 (the restarted run): both hosts re-save the step
+    mgr = _sharded_save(str(tmp_path), tr, 1)
+    assert mgr.complete_steps() == [1]
+    for n, blob in before.items():   # attempt 1's bytes are untouched
+        assert open(os.path.join(d, n), "rb").read() == blob, n
+
+    tr2 = worker.build_trainer(seed=6)
+    SPMDCheckpointManager(str(tmp_path)).restore(tr2)
+    _assert_state_equal(tr, tr2)
+
+
+def test_retry_policy_covers_sharded_write_faults(tmp_path):
+    """A transient injected shard-write fault is retried away; the barrier
+    timeout is excluded via ``nonretryable``."""
+    tr = worker.build_trainer(0)
+    tr.step(*worker.make_batches(1)[0])
+    policy = RetryPolicy(max_attempts=3, base_delay_ms=1.0, jitter=0.0,
+                         nonretryable=(CommitBarrierTimeout,), seed=0)
+    with faults.scope("ckpt.shard_write:fail:1"):
+        mgr = _sharded_save(str(tmp_path), tr, 1, retry=policy)
+    assert mgr.complete_steps() == [1]
+
+
+# ----------------------------------------------------------------- async
+def test_async_save_parity_after_donating_steps(tmp_path):
+    batches = worker.make_batches(6)
+    tr = worker.build_trainer(0)
+    for x, y in batches[:3]:
+        tr.step(x, y)
+    expect = _state_leaves(tr)               # host snapshot before async
+    mgr = SPMDCheckpointManager(str(tmp_path))
+    mgr.save(3, tr, extra={"async": True}, sync=False)
+    for x, y in batches[3:]:                 # donates the live state
+        tr.step(x, y)
+    mgr.wait_for_save()
+    assert not mgr.async_inflight
+    assert mgr.latest_step() == 3
+
+    tr2 = worker.build_trainer(seed=1)
+    mgr.restore(tr2)
+    assert mgr.restored_extra == {"async": True}
+    for x, y in zip(_state_leaves(tr2), expect):
+        np.testing.assert_array_equal(x, y)
+
+    # at-most-one-inflight: back-to-back async saves all land
+    mgr.save(4, tr, sync=False)
+    mgr.save(5, tr, sync=False)
+    mgr.wait_for_save()
+    assert set(mgr.complete_steps()) >= {3, 4, 5}
+
+
+def test_async_save_donation_sanitizer_clean(tmp_path):
+    from mxnet_tpu.analysis import sanitizer as san
+
+    batches = worker.make_batches(5)
+    before = san.stats()["violations"]
+    with san.scope("donation"):
+        tr = worker.build_trainer(0)
+        for x, y in batches[:2]:
+            tr.step(x, y)
+        mgr = SPMDCheckpointManager(str(tmp_path))
+        mgr.save(2, tr, sync=False)
+        for x, y in batches[2:]:
+            tr.step(x, y)
+        mgr.wait_for_save()
+        assert san.stats()["violations"] == before
+    assert mgr.latest_step() == 2
+
+
+def test_fault_site_async_serialize_surfaces_on_wait(tmp_path):
+    tr = worker.build_trainer(0)
+    tr.step(*worker.make_batches(1)[0])
+    mgr = SPMDCheckpointManager(str(tmp_path))
+    with faults.scope("ckpt.async_serialize:fail:1"):
+        mgr.save(1, tr, sync=False)
+        with pytest.raises(InjectedFault):
+            mgr.wait_for_save()
+    assert mgr.latest_step() is None
+    mgr.wait_for_save()                      # error is surfaced only once
+    mgr.save(1, tr)                          # and a clean sync save works
+    assert mgr.latest_step() == 1
+
+
+def test_resilient_trainer_async_cadence_and_absorbed_failure(tmp_path):
+    batches = worker.make_batches(12)
+    rt = ResilientTrainer(worker.build_trainer(0), str(tmp_path),
+                          save_every=5, async_save=True)
+    with faults.scope("ckpt.async_serialize:fail:1"):
+        for x, y in batches:
+            rt.step(x, y)
+        rt.flush()
+    assert rt.wait_for_save()
+    # the first cadence save (step 5) died in the background and was
+    # absorbed; the next one landed
+    assert rt.checkpoint_failures == 1
+    assert rt.manager.latest_step() == 10
+
+
+# ------------------------------------------------------------ preemption
+def test_preemption_trigger_resilient_trainer_bitwise_resume(tmp_path):
+    n = 8
+    ref = worker.reference_losses(n)
+
+    handler = PreemptionHandler(install=False)   # no real signal handlers
+    rt = ResilientTrainer(worker.build_trainer(0), str(tmp_path),
+                          save_every=100, preemption=handler)
+    batches = worker.make_batches(n)
+    first = [float(rt.step(x, y).asnumpy()) for x, y in batches[:5]]
+    assert first == ref[:5]
+    handler.trigger()
+    with pytest.raises(TrainingPreempted) as ei:
+        rt.step(*batches[5])
+    assert ei.value.code == 0                # clean exit for the scheduler
+    assert ei.value.step == 5 and ei.value.checkpoint_step == 5
+    assert rt.manager.latest_step() == 5
+
+    rt2 = ResilientTrainer(worker.build_trainer(9), str(tmp_path),
+                           save_every=100)
+    assert rt2.resumed_from == 5
+    resumed = [float(rt2.step(x, y).asnumpy()) for x, y in batches[5:]]
+    assert resumed == ref[5:]                # bitwise-identical resume
+
+    # preemption=False means OFF, not a broken handler
+    off = ResilientTrainer(worker.build_trainer(9),
+                           str(tmp_path / "off"), preemption=False)
+    assert off.preemption is None
+    off.step(*batches[0])
+    off.close()                              # no-op without a handler
+
+    # preemption=True: the trainer owns the handler, close() restores
+    # the pre-existing signal disposition after training
+    before_h = signal.getsignal(signal.SIGTERM)
+    own = ResilientTrainer(worker.build_trainer(9),
+                           str(tmp_path / "own"), preemption=True)
+    assert signal.getsignal(signal.SIGTERM) != before_h
+    own.close()
+    assert signal.getsignal(signal.SIGTERM) == before_h
+
+
+def test_preemption_real_sigterm(tmp_path):
+    handler = PreemptionHandler(signals=(signal.SIGTERM,))
+    try:
+        rt = ResilientTrainer(worker.build_trainer(0), str(tmp_path),
+                              save_every=100, preemption=handler)
+        batches = worker.make_batches(3)
+        rt.step(*batches[0])                 # the step in flight finishes
+        os.kill(os.getpid(), signal.SIGTERM)
+        with pytest.raises(TrainingPreempted):
+            rt.step(*batches[1])             # the next boundary exits
+        assert handler.signum == signal.SIGTERM
+        assert rt.manager.latest_step() == 1
+    finally:
+        handler.uninstall()
+
+
+def test_spmd_trainer_install_preemption(tmp_path):
+    tr = worker.build_trainer(0)
+    batches = worker.make_batches(2)
+    tr.step(*batches[0])
+    handler = PreemptionHandler(install=False)
+    mgr = SPMDCheckpointManager(str(tmp_path))
+    tr.install_preemption(handler, mgr)
+    tr.step(*batches[1])
+    handler.trigger()
+    with pytest.raises(TrainingPreempted) as ei:
+        tr.step(*batches[0])
+    assert ei.value.code == 0
+    assert mgr.latest_step() == 2
+    tr2 = worker.build_trainer(seed=1)
+    mgr.restore(tr2)
+    _assert_state_equal(tr, tr2)
+
+
+# --------------------------------------------------------------- elastic
+def test_elastic_resume_sharded_4_to_2_devices(tmp_path):
+    """A checkpoint written by a dp=4×tp=2 co-writer pair resumes on a
+    dp=2×tp=1 mesh: bitwise-identical state, matching losses."""
+    batches = worker.make_batches(5)
+    tr = worker.build_trainer(0)             # 8 devices: dp=4 tp=2
+    for x, y in batches[:3]:
+        tr.step(x, y)
+    rng_state = mx.random.get_state()
+    _sharded_save(str(tmp_path), tr, 3)
+    saved = _state_leaves(tr)
+    after = [float(tr.step(x, y).asnumpy()) for x, y in batches[3:]]
+
+    small = worker.build_trainer(seed=1, n_devices=2, dp=2, tp=1)
+    SPMDCheckpointManager(str(tmp_path)).restore(small)
+    assert small._t == 3
+    for x, y in zip(_state_leaves(small), saved):
+        np.testing.assert_array_equal(x, y)  # exact state on fewer devices
+    mx.random.set_state(rng_state)
+    resumed = [float(small.step(x, y).asnumpy()) for x, y in batches[3:]]
+    np.testing.assert_allclose(resumed, after, rtol=1e-6, atol=1e-7)
+
+
+def test_elastic_resume_single_host_format_2_to_8_devices(tmp_path):
+    """Format-1 checkpoints reshard too (scale UP: 2 -> 8 devices)."""
+    batches = worker.make_batches(3)
+    small = worker.build_trainer(0, n_devices=2, dp=2, tp=1)
+    for x, y in batches:
+        small.step(x, y)
+    mgr = SPMDCheckpointManager(str(tmp_path))
+    mgr.save(3, small)
+    big = worker.build_trainer(seed=1)       # 8 devices
+    mgr.restore(big)
+    assert big._t == 3
+    for x, y in zip(_state_leaves(big), _state_leaves(small)):
+        np.testing.assert_array_equal(x, y)
+
+
+# -------------------------------------------------------------- gc rules
+def test_gc_sharded_step_is_one_unit_and_inflight_protected(tmp_path):
+    tr = worker.build_trainer(0)
+    tr.step(*worker.make_batches(1)[0])
+    mgr = SPMDCheckpointManager(str(tmp_path), max_to_keep=3)
+    mgr.save(5, tr)
+    mgr.save(10, tr)
+
+    # a sharded write still converging at step 7 (shards + marker, no
+    # manifest, fresh mtime): a save's GC must leave it alone
+    inflight = str(tmp_path / ("step_%010d" % 7))
+    os.makedirs(inflight)
+    open(os.path.join(inflight, "shard-1-0.bin"), "wb").write(b"x" * 64)
+    open(os.path.join(inflight, "host-1.json"), "w").write("{}")
+    mgr.save(11, tr)
+    assert os.path.isdir(inflight), "in-flight sharded commit was collected"
+
+    # once clearly stale (a crashed co-writer's leftovers) the whole step
+    # dir — shards, markers and all — goes as one unit
+    old = 1.0
+    os.utime(inflight, (old, old))
+    mgr.save(12, tr)
+    assert not os.path.isdir(inflight)
+
+    # format-1-style incomplete litter (no shard files) keeps the PR 4
+    # behavior: collected as soon as it is older than the newest complete
+    stale = str(tmp_path / ("step_%010d" % 8))
+    os.makedirs(stale)
+    open(os.path.join(stale, "state.bin"), "wb").write(b"junk")
+    mgr.save(13, tr)
+    assert not os.path.isdir(stale)
+
+
+# ------------------------------------------------- subprocess acceptance
+def _spawn(args):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(_WORKER)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, _WORKER] + args,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=root, env=env)
+
+
+def test_two_process_mesh_sharded_save(tmp_path):
+    """The acceptance drill: a simulated 2-process mesh completes a
+    sharded save where each process writes only its shards."""
+    d = str(tmp_path)
+    procs = [_spawn(["--mode", "shard-save", "--dir", d, "--steps", "2",
+                     "--host", f"{h}/2", "--barrier-timeout", "120"])
+             for h in (1, 0)]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    assert all("SAVED step=2" in o for o in outs), outs
+
+    ref = worker.build_trainer(0)
+    for x, y in worker.make_batches(2):
+        ref.step(x, y)
+    tr = worker.build_trainer(seed=1)
+    SPMDCheckpointManager(d).restore(tr)
+    _assert_state_equal(ref, tr)
+
+
+def test_cowriter_hard_killed_between_shard_write_and_commit(tmp_path):
+    """A co-writer host hard-dies (os._exit) mid-save: the step never
+    commits and the previous checkpoint restores cleanly."""
+    d = str(tmp_path)
+    base = worker.build_trainer(0)
+    base.step(*worker.make_batches(1)[0])
+    SPMDCheckpointManager(d).save(1, base)
+    expect = _state_leaves(base)
+
+    killer = _spawn(["--mode", "shard-save", "--dir", d, "--steps", "2",
+                     "--host", "1/2", "--die-at", "ckpt.shard_write"])
+    committer = _spawn(["--mode", "shard-save", "--dir", d, "--steps", "2",
+                        "--host", "0/2", "--barrier-timeout", "10"])
+    k_out = killer.communicate(timeout=300)[0]
+    c_out = committer.communicate(timeout=300)[0]
+    assert killer.returncode == 9 and "DYING" in k_out, k_out
+    assert committer.returncode != 0, c_out
+    assert "CommitBarrierTimeout" in c_out, c_out
+
+    mgr = SPMDCheckpointManager(d)
+    assert mgr.complete_steps() == [1]
+    tr = worker.build_trainer(seed=2)
+    mgr.restore(tr)
+    for x, y in zip(_state_leaves(tr), expect):
+        np.testing.assert_array_equal(x, y)

@@ -162,6 +162,34 @@ def test_retry_only_retries_matching_exceptions():
     assert len(calls) == 1
 
 
+def test_retry_nonretryable_propagates_immediately():
+    """``nonretryable`` wins over ``retryable`` — e.g. a sharded-save
+    ``CommitBarrierTimeout`` (an OSError) where retrying a barrier whose
+    co-writer is dead just multiplies the timeout."""
+    from mxnet_tpu.parallel import CommitBarrierTimeout
+
+    policy = RetryPolicy(max_attempts=5, base_delay_ms=1,
+                         nonretryable=(CommitBarrierTimeout,),
+                         sleep=lambda s: None)
+    calls = []
+
+    def barrier():
+        calls.append(1)
+        raise CommitBarrierTimeout("co-writer never showed")
+
+    with pytest.raises(CommitBarrierTimeout):
+        policy.call(barrier)
+    assert len(calls) == 1                      # an OSError, yet no retry
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 4:
+            raise IOError("transient")
+        return "ok"
+
+    assert policy.call(flaky) == "ok"           # plain OSError still retried
+
+
 def test_retry_backoff_is_seeded_and_bounded():
     slept = []
     policy = RetryPolicy(max_attempts=4, base_delay_ms=10, max_delay_ms=25,
