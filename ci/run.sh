@@ -37,13 +37,19 @@
 #                misses) and a clean-teardown sweep of /dev/shm — both on
 #                a healthy run and under an injected worker crash
 #   analyze    - static-analysis gate + runtime sanitizer smoke: the
-#                jax-free tools/analyze.py pass over mxnet_tpu/ must report
-#                zero findings outside ci/analysis_baseline.txt, then
-#                test_analysis.py and an MXNET_SANITIZE=donation,slots
-#                smoke: a planted use-after-donate and a post-release
-#                shm-slot read must both raise with their sites named
-#                while a clean aggregated train step passes with zero
-#                violations
+#                jax-free tools/analyze.py pass over mxnet_tpu/ (all six
+#                checkers incl. the SPMD collectives/barriers divergence
+#                family) must report zero findings outside
+#                ci/analysis_baseline.txt, then test_analysis.py,
+#                test_divergence.py, an MXNET_SANITIZE=donation,slots
+#                smoke (planted use-after-donate + post-release shm-slot
+#                read must raise with sites named, clean steps zero
+#                violations) and a two-simulated-host
+#                MXNET_SANITIZE=collectives drill: one clean 2-host SPMD
+#                run + sharded commit with zero violations, one planted
+#                divergence that must raise CollectiveDivergenceError
+#                naming both hosts' next-op fingerprints (bounded by the
+#                watchdog, never a hang)
 # Usage: ci/run.sh [stage ...]   (default: unit gate telemetry optimizer
 #                                 serving resilience engine io analyze)
 set -euo pipefail
@@ -444,7 +450,11 @@ stage_analyze() {
   # static gate first: pure-ast, no jax import (the launcher asserts it)
   python tools/analyze.py --root mxnet_tpu \
     --baseline ci/analysis_baseline.txt -q
-  JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q
+  # TestTwoHostDrill is deselected here: the dedicated drill below runs
+  # the identical 2-subprocess scenarios with CI-visible assertions, and
+  # each drill pair costs two full jax startups
+  JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py \
+    tests/test_divergence.py -q -k "not TwoHostDrill"
   JAX_PLATFORMS=cpu MXNET_SANITIZE=donation,slots python - <<'PY'
 import numpy as np
 import mxnet_tpu as mx
@@ -478,6 +488,46 @@ except san.DonatedBufferError as e:
 assert san.stats()["poisoned"] > 0 and san.stats()["violations"] == 1
 print("analyze smoke ok:", san.stats()["poisoned"], "poisoned buffers,",
       "1 planted violation caught, clean steps zero findings")
+PY
+  # two-simulated-host collective-sanitizer drill (MXNET_CKPT_HOST harness,
+  # streams shared via MXNET_SANITIZE_DIR): a clean 2-host SPMD run +
+  # sharded checkpoint commit must report zero violations, and a planted
+  # divergence (host 1 issues a pipeline schedule where host 0 issues a
+  # train step) must raise CollectiveDivergenceError naming BOTH hosts'
+  # next-op fingerprints — bounded by the watchdog, never a hang
+  JAX_PLATFORMS=cpu python - <<'PY'
+import os, subprocess, sys, tempfile
+
+env = dict(os.environ, PYTHONPATH=os.getcwd())
+env.pop("MXNET_SANITIZE", None)
+env.pop("MXNET_CKPT_HOST", None)
+
+def drill(extra1=()):
+    d = tempfile.mkdtemp(prefix="ci_divergence_")
+    procs = [subprocess.Popen(
+        [sys.executable, "tests/divergence_worker.py", "--dir", d,
+         "--host", f"{h}/2", "--steps", "3", "--timeout", "60",
+         *(extra1 if h == 1 else ())],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for h in (0, 1)]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    return [p.returncode for p in procs], outs, d
+
+rcs, outs, d = drill()
+assert rcs == [0, 0], (rcs, outs)
+assert all("violations=0" in o for o in outs), outs
+from mxnet_tpu.parallel import SPMDCheckpointManager
+assert SPMDCheckpointManager(d).latest_step() == 3, "clean drill must commit"
+
+rcs, outs, d = drill(extra1=("--diverge-at", "2"))
+assert rcs == [3, 3], (rcs, outs)       # both raise, neither hangs
+for o in outs:
+    assert "trainer.step" in o and "pipeline.gpipe" in o, o
+    assert "host 0" in o and "host 1" in o, o
+assert SPMDCheckpointManager(d).latest_step() is None, \
+    "diverged step must never commit"
+print("divergence drill ok: clean 2-host commit, planted divergence",
+      "raised on both hosts with both fingerprints named")
 PY
 }
 

@@ -1,27 +1,35 @@
 """Static analysis + runtime sanitizer for the framework's own invariants
-(ISSUE 8).
+(ISSUE 8, extended with the SPMD divergence family in ISSUE 10).
 
 The reference engine enforced its correctness contracts mechanically
-(write-dependency vars, WaitToRead fences); the TPU-native rebuild's
-equivalents — donated jit calls, segment capture, shm-slot lifetimes,
-cross-thread state — are Python conventions.  This package enforces them:
+(write-dependency vars, WaitToRead fences, KVStore-serialized collective
+order); the TPU-native rebuild's equivalents — donated jit calls, segment
+capture, shm-slot lifetimes, cross-thread state, cross-host collective
+order — are Python conventions.  This package enforces them:
 
-- :mod:`.core` + the four checkers (:mod:`.donation`, :mod:`.capture`,
-  :mod:`.recompile`, :mod:`.locks`) — pure-``ast`` static passes with
-  stable fingerprints gated against ``ci/analysis_baseline.txt``.
-  Run standalone (no jax import): ``python tools/analyze.py``; or inside
-  the framework: ``python -m mxnet_tpu.analysis``.
+- :mod:`.core` + the six checkers (:mod:`.donation`, :mod:`.capture`,
+  :mod:`.recompile`, :mod:`.locks`, :mod:`.collectives`, :mod:`.barriers`)
+  — pure-``ast`` static passes with stable fingerprints gated against
+  ``ci/analysis_baseline.txt``.  Run standalone (no jax import):
+  ``python tools/analyze.py``; or inside the framework:
+  ``python -m mxnet_tpu.analysis``.
 - :mod:`.sanitizer` — the opt-in runtime half
-  (``MXNET_SANITIZE=donation,slots``): poisons buffers handed to donated
-  jit calls so any later read raises *with the donation site named*, and
-  enforces the ``zero_copy_batches=True`` shm-slot lifetime contract
-  (reads of a recycled slot raise instead of returning corrupt pixels).
+  (``MXNET_SANITIZE=donation,slots,collectives``): poisons buffers handed
+  to donated jit calls so any later read raises *with the donation site
+  named*, enforces the ``zero_copy_batches=True`` shm-slot lifetime
+  contract, and (:mod:`.divergence`) cross-checks per-host collective
+  fingerprint streams so a multi-controller order mismatch raises
+  :class:`CollectiveDivergenceError` naming both hosts' next ops instead
+  of hanging the pod.
 
 See docs/analysis.md for the checker catalog, the baseline workflow and
 the sanitizer mode matrix.
 """
+from . import barriers  # noqa: F401
 from . import capture  # noqa: F401
+from . import collectives  # noqa: F401
 from . import core  # noqa: F401
+from . import divergence  # noqa: F401
 from . import donation  # noqa: F401
 from . import locks  # noqa: F401
 from . import recompile  # noqa: F401
@@ -29,11 +37,15 @@ from . import sanitizer  # noqa: F401
 from .cli import main  # noqa: F401
 from .core import CHECKERS, Finding, load_baseline, run_checkers  # noqa: F401
 from .sanitizer import (  # noqa: F401
+    CollectiveDivergenceError,
+    CollectiveStallTimeout,
     DonatedBufferError,
     SanitizerError,
     StaleSlotError,
 )
 
-__all__ = ["core", "donation", "capture", "recompile", "locks", "sanitizer",
+__all__ = ["core", "donation", "capture", "recompile", "locks",
+           "collectives", "barriers", "sanitizer", "divergence",
            "main", "run_checkers", "load_baseline", "CHECKERS", "Finding",
-           "SanitizerError", "DonatedBufferError", "StaleSlotError"]
+           "SanitizerError", "DonatedBufferError", "StaleSlotError",
+           "CollectiveDivergenceError", "CollectiveStallTimeout"]

@@ -1,0 +1,225 @@
+"""Checker (f): two-phase commit pairing and exit windows.
+
+The PR 9 sharded checkpoint protocol is a two-phase commit whose safety is
+purely ordering: every host streams its shard payloads (fsynced), writes a
+completion **marker** last, and host 0 commits the **manifest** only after
+the marker **barrier** (``_wait_markers``) validates every host.  Nothing
+mechanical enforces that order — a refactor that commits before the
+barrier, or writes the marker before the payload bytes are durable,
+silently turns "a crashed co-writer leaves a recoverable partial" into "a
+crashed co-writer corrupts a committed checkpoint".  Likewise the
+preemption path: ``PreemptionHandler`` exits are only safe at collective
+boundaries — an exit between a collective and the next one this host owes
+its peers strands every other host in the pairing collective forever.
+
+Two rules:
+
+- ``commit-before-barrier`` — within one function, a manifest-commit
+  primitive (a call whose name matches ``*commit*``/``*manifest*``, or an
+  atomic-replace of a path mentioning ``manifest``) executes lexically
+  before the marker barrier (a call matching ``*wait*marker*`` /
+  ``*marker*wait*`` / ``*barrier*``), or with marker/shard **writes** in
+  scope and no barrier at all.  Functions that never touch phase-1
+  primitives (plain single-host commits) are exempt — the rule targets the
+  sharded protocol, where the barrier is what makes phase 2 sound.
+- ``exit-between-collectives`` — an exit-class statement (``sys.exit``/
+  ``os._exit``/``raise SystemExit``/``TrainingPreempted``/
+  ``save_and_exit``) lexically between two collective calls in one scope,
+  or inside a loop whose body also issues a collective (the back-edge
+  makes "after" every collective also "before" the next).  The safe idiom
+  — consult ``handler.triggered`` and exit **before** the scope's first
+  collective (the step-boundary check) — is not flagged.
+
+Collective detection shares :mod:`.collectives`' transitive closure, so an
+exit between two calls to an in-module wrapper that psums still fires.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, call_name, dotted_name, scope_functions, unparse
+from .collectives import (_collective_calls_in, _collective_functions,
+                          _is_collective_call, _own_walk)
+
+CHECKER = "barriers"
+
+_BARRIER_RE = re.compile(r"(wait.*marker|marker.*wait|barrier)",
+                         re.IGNORECASE)
+# a call NAME is a commit when it says so (commit) or writes a manifest;
+# read-ish manifest names (_manifest_of, read_manifest) are not commits
+_COMMIT_NAME_RE = re.compile(r"commit", re.IGNORECASE)
+_MANIFEST_WRITE_RE = re.compile(
+    r"((write|save|replace|publish).*manifest|manifest.*(write|save|"
+    r"replace|publish))", re.IGNORECASE)
+_PHASE1_RE = re.compile(r"(marker|shard|host)", re.IGNORECASE)
+_EXIT_CALLS = frozenset(("exit", "_exit", "save_and_exit"))
+_EXIT_EXCS = frozenset(("SystemExit", "TrainingPreempted"))
+
+
+def _calls_by_line(fn):
+    out = []
+    for node in _own_walk(fn):      # nested defs are their own scopes
+        if isinstance(node, ast.Call):
+            out.append(node)
+    out.sort(key=lambda c: (c.lineno, c.col_offset))
+    return out
+
+
+def _name_of(call):
+    return call_name(call) or ""
+
+
+# ------------------------------------------------------ commit-order rule
+def _resolved_name(c):
+    """Callee name, seeing through retry/policy wrapping: the protocol
+    call in ``self._retry.call(self._commit_sharded, ...)`` is
+    ``_commit_sharded`` — classifying by the literal name ``call`` would
+    make every retry-wrapped commit/phase-1 write invisible and exempt
+    the whole function from the two-phase-order rule."""
+    name = _name_of(c)
+    if name in ("call", "wrap") and c.args:
+        target = c.args[0]
+        if isinstance(target, ast.Attribute):
+            return target.attr
+        if isinstance(target, ast.Name):
+            return target.id
+    return name
+
+
+def _commit_pass(mod, qualname, fn, add):
+    barriers, commits, phase1 = [], [], []
+    for c in _calls_by_line(fn):
+        name = _resolved_name(c)
+        if _BARRIER_RE.search(name):
+            barriers.append(c)
+            continue
+        if _COMMIT_NAME_RE.search(name) or _MANIFEST_WRITE_RE.search(name):
+            commits.append(c)
+            continue
+        # a durable-write primitive classifies by its path argument:
+        # manifest path = commit, marker/shard path = phase 1
+        if name in ("replace_file_atomic", "replace_file_atomic_json",
+                    "fsync_write", "fsync_write_json"):
+            arg_src = " ".join(unparse(a) for a in c.args[:1]).lower()
+            if "manifest" in arg_src:
+                commits.append(c)
+            elif _PHASE1_RE.search(arg_src):
+                phase1.append(c)
+            continue
+        # delegated phase-1 writers: write_host_files / write_marker / ...
+        if "write" in name.lower() and _PHASE1_RE.search(name):
+            phase1.append(c)
+    if not commits:
+        return
+    if not phase1:
+        return                       # single-host commit: no barrier needed
+    first_barrier = min((b.lineno for b in barriers), default=None)
+    for c in commits:
+        after_phase1 = any(p.lineno <= c.lineno for p in phase1)
+        if not after_phase1:
+            continue
+        if first_barrier is None:
+            add(Finding(
+                CHECKER, "commit-before-barrier", mod.path, qualname,
+                _name_of(c), c.lineno,
+                f"{_name_of(c)}() commits the manifest with shard/marker "
+                f"writes in scope but no marker barrier: a crashed "
+                f"co-writer's partial step can be committed as complete "
+                f"— wait for every host's completion marker first"))
+        elif c.lineno < first_barrier:
+            add(Finding(
+                CHECKER, "commit-before-barrier", mod.path, qualname,
+                _name_of(c), c.lineno,
+                f"{_name_of(c)}() commits the manifest at line {c.lineno}, "
+                f"before the marker barrier at line {first_barrier}: the "
+                f"commit point must come after every co-writer's marker "
+                f"validates (two-phase commit order)"))
+
+
+# ------------------------------------------------- exit-in-window rule
+def _exit_nodes(fn):
+    """(line, description) of exit-class statements in ``fn``."""
+    out = []
+    for node in _own_walk(fn):
+        if isinstance(node, ast.Call) and _name_of(node) in _EXIT_CALLS:
+            # exit/_exit only count with a bare name or a sys/os receiver:
+            # `stack.exit()` / `pool.exit()` lookalikes are not process
+            # exits; save_and_exit counts from any receiver (it raises
+            # TrainingPreempted by contract)
+            f = node.func
+            if _name_of(node) in ("exit", "_exit"):
+                recv = dotted_name(f.value) \
+                    if isinstance(f, ast.Attribute) else None
+                if not (isinstance(f, ast.Name) or recv in ("sys", "os")):
+                    continue
+            out.append((node.lineno, unparse(node.func)))
+        elif isinstance(node, ast.Raise) and node.exc is not None:
+            exc = node.exc
+            name = None
+            if isinstance(exc, ast.Call):
+                name = _name_of(exc)
+            else:
+                name = dotted_name(exc)
+            if name and name.split(".")[-1] in _EXIT_EXCS:
+                out.append((node.lineno, f"raise {name}"))
+    return out
+
+
+def _exit_pass(mod, qualname, fn, issuing, add):
+    exits = _exit_nodes(fn)
+    if not exits:
+        return
+    coll_lines = sorted(c.lineno for c in _own_walk(fn)
+                        if _is_collective_call(c, issuing))
+    # loops whose body has both an exit and a collective: back-edge hazard
+    loop_hits = set()
+    for node in _own_walk(fn):
+        if isinstance(node, (ast.For, ast.While)):
+            body_calls = _collective_calls_in(list(node.body), issuing)
+            if not body_calls:
+                continue
+            for line, desc in exits:
+                if node.lineno <= line <= getattr(node, "end_lineno", line):
+                    loop_hits.add((line, desc,
+                                   min(c.lineno for c in body_calls)))
+    for line, desc, cline in sorted(loop_hits):
+        add(Finding(
+            CHECKER, "exit-between-collectives", mod.path, qualname,
+            desc, line,
+            f"{desc} inside a loop that issues a collective (line "
+            f"{cline}): the loop back-edge means this host can exit "
+            f"after a collective its peers will pair with another — "
+            f"exit only at the loop boundary, before the first "
+            f"collective of an iteration"))
+    for line, desc in exits:
+        before = [c for c in coll_lines if c < line]
+        after = [c for c in coll_lines if c > line]
+        if before and after and (line, desc) not in {(l, d) for l, d, _ in
+                                                     loop_hits}:
+            add(Finding(
+                CHECKER, "exit-between-collectives", mod.path, qualname,
+                desc, line,
+                f"{desc} between collective calls (lines {before[-1]} and "
+                f"{after[0]}): peers that already entered the next "
+                f"collective wait forever for this host — move the exit "
+                f"check before the scope's first collective (the "
+                f"step-boundary idiom) or after its last"))
+
+
+# --------------------------------------------------------------------- main
+def check(mod):
+    findings = []
+    seen = set()
+
+    def add(f):
+        key = (f.fingerprint, f.line)
+        if key not in seen:
+            seen.add(key)
+            findings.append(f)
+
+    issuing = _collective_functions(mod.tree)
+    for qualname, fn in scope_functions(mod.tree):
+        _commit_pass(mod, qualname, fn, add)
+        _exit_pass(mod, qualname, fn, issuing, add)
+    return findings

@@ -34,7 +34,8 @@ def build_parser():
     p = argparse.ArgumentParser(
         prog="mxnet_tpu.analysis",
         description="Framework-aware static analysis for mxnet_tpu "
-                    "(donation / capture / recompile / lock checkers)")
+                    "(donation / capture / recompile / locks / "
+                    "collectives / barriers checkers)")
     p.add_argument("--root", default="mxnet_tpu",
                    help="file or directory to analyze (default: mxnet_tpu)")
     p.add_argument("--baseline", default=None,
@@ -46,7 +47,12 @@ def build_parser():
     p.add_argument("--checkers", default=None,
                    help="comma list from: %s (default: all)"
                         % ",".join(core.CHECKERS))
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "github"),
+                   default="text",
+                   help="text (byte-stable, the baseline-workflow default), "
+                        "json (machine-readable full report), or github "
+                        "(::error workflow annotations linking findings to "
+                        "file:line in the PR view)")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="only print the summary line and new findings")
     return p
@@ -102,7 +108,29 @@ def main(argv=None):
         print(f"wrote {len(findings)} fingerprints to {args.baseline}")
         return 0
 
-    if args.format == "json":
+    if args.format == "github":
+        # GitHub Actions workflow annotations: one ::error per NEW finding
+        # (the PR view links them to file:line), ::warning for baseline
+        # hygiene.  %0A encodes newlines per the annotation grammar.
+        def _esc(msg):
+            return msg.replace("%", "%25").replace("\r", "%0D") \
+                      .replace("\n", "%0A")
+        for f in new:
+            print(f"::error file={f.path},line={f.line},"
+                  f"title={f.checker}/{f.rule}::"
+                  f"{_esc(f.message)} [{f.fingerprint}]")
+        for fp in stale:
+            print(f"::warning file={args.baseline or 'baseline'},"
+                  f"title=stale baseline entry::"
+                  f"{fp} is no longer reported — remove it "
+                  f"({_esc(baseline[fp])})")
+        for n, why in malformed:
+            print(f"::error file={args.baseline},line={n},"
+                  f"title=malformed baseline::{_esc(why)}")
+        print(f"analysis: {len(findings)} findings "
+              f"({len(new)} new, {len(suppressed)} baselined, "
+              f"{len(stale)} stale baseline entries)")
+    elif args.format == "json":
         print(json.dumps({
             "findings": [{
                 "fingerprint": f.fingerprint, "checker": f.checker,

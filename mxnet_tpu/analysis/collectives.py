@@ -1,0 +1,433 @@
+"""Checker (e): SPMD collective-order divergence.
+
+Under real ``jax.distributed`` multi-controller execution every host runs
+the same Python program; the collectives it issues (``psum``,
+``all_gather``, ``ppermute``, ``all_to_all``, the kvstore dist hop, the
+``multihost_utils`` barriers) pair up *by program order*.  The reference
+engine made that order mechanical — dependency tracking serialized pushes,
+the KVStore serialized reduction — but the jax_graft port made it a
+convention, and the failure mode of breaking it is not a crash: hosts that
+disagree on which collective comes next deadlock the whole pod.
+
+Three ways program order goes host-divergent, three rules:
+
+- ``divergent-collective`` — a collective (or multihost barrier) issued
+  inside a branch whose test depends on a **host-divergent value**:
+  ``jax.process_index()`` (directly, or through a name/tuple assigned from
+  it or from an in-module function that reads it), wall-clock time
+  (``time()``/``monotonic()``/``perf_counter()``), environment reads
+  (``os.environ``/``getenv``), or filesystem state (``exists``/``listdir``/
+  ``getsize``/``getmtime``/``stat``/``glob``/``isfile``/``isdir``).  Hosts
+  evaluate such a test differently, take different arms, and issue
+  different collective sequences.  A branch where BOTH arms issue the
+  identical collective call sequence is not flagged (same ops either way).
+  ``jax.process_count()`` is deliberately NOT a divergent source: it is
+  uniform across hosts by definition, so the ``num_workers > 1``
+  degenerate-single-process idiom stays quiet.
+- ``unordered-collective-order`` — a loop over a ``set`` (literal,
+  ``set(...)``, or set-comprehension) or over ``.keys()``/``.values()``/
+  ``.items()`` of a dict whose body issues a collective or a kvstore
+  ``push``/``pull``/``pushpull``/``row_sparse_pull``/``init``.  Set order
+  is arbitrary; dict insertion order is only as deterministic as the code
+  that built the dict, and across hosts that is a convention, not a
+  guarantee — two hosts iterating the "same" dict in different orders
+  mispair every collective in the loop.  The safe idiom, ``sorted(...)``,
+  is not flagged.
+- ``retry-over-collective`` — a ``RetryPolicy``-style ``.call(fn, ...)``/
+  ``.wrap(fn)`` (receiver name containing ``retry``/``policy``) or a
+  ``faults.inject``/``faults.scope`` arming, whose target function
+  (transitively, within the module) issues a collective.  The PR 4 rule —
+  one worker re-entering a collective while its peers have advanced
+  mispairs the collective order across the mesh — was until now enforced
+  only by a comment in ``kvstore.py``.
+
+Collective detection is transitive within a module: a function whose body
+calls ``psum`` (etc.), or calls another in-module function that does, is
+collective-issuing; calls to it count as collective calls for all three
+rules.  Like every checker here, these over-approximate: a divergent
+branch may be provably host-uniform at runtime, a dict may be built in
+sorted order — the baseline is where such residue lives, with an argument.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, call_name, dotted_name, scope_functions, unparse
+
+CHECKER = "collectives"
+
+# jax.lax collectives + the multihost barrier surface.  axis_index is not a
+# collective (no peer participation), process_allgather/sync_global_devices
+# are (every process must call them).
+COLLECTIVE_CALLS = frozenset((
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "psum_scatter", "pbroadcast",
+    "process_allgather", "sync_global_devices", "broadcast_one_to_all",
+))
+
+# kvstore surface whose ORDER is the cross-host contract: these calls
+# inside an unordered loop mispair pushes between hosts even when the
+# underlying transport is not a lax collective on this backend.
+KVSTORE_ORDERED = frozenset((
+    "push", "pull", "pushpull", "row_sparse_pull", "init",
+))
+
+_DIVERGENT_CALLS = frozenset((
+    # host identity
+    "process_index", "getpid", "gethostname",
+    # wall clock
+    "time", "monotonic", "perf_counter", "time_ns", "monotonic_ns",
+    # environment
+    "getenv",
+    # filesystem state
+    "exists", "isfile", "isdir", "listdir", "getsize", "getmtime", "stat",
+    "glob", "iglob", "scandir",
+))
+
+_DIVERGENT_ATTRS = frozenset(("environ",))
+
+
+# ------------------------------------------------------- collective closure
+def _collective_functions(tree):
+    """Names of in-module functions/methods that (transitively) issue a
+    collective call.  Resolution is by bare name — ``self.foo()`` and
+    ``foo()`` both count — which over-approximates across classes in one
+    module, matching the checker contract."""
+    funcs = {}
+    for qualname, fn in scope_functions(tree):
+        funcs.setdefault(fn.name, []).append(fn)
+
+    def _direct(fn):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    call_name(node) in COLLECTIVE_CALLS:
+                return True
+        return False
+
+    issuing = {name for name, fns in funcs.items()
+               if any(_direct(f) for f in fns)}
+    changed = True
+    while changed:
+        changed = False
+        for name, fns in funcs.items():
+            if name in issuing:
+                continue
+            for fn in fns:
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call) and \
+                            call_name(node) in issuing:
+                        issuing.add(name)
+                        changed = True
+                        break
+                if name in issuing:
+                    break
+    return issuing
+
+
+def _is_collective_call(node, issuing):
+    return isinstance(node, ast.Call) and (
+        call_name(node) in COLLECTIVE_CALLS or call_name(node) in issuing)
+
+
+def _own_walk(fn):
+    """Walk ``fn``'s body excluding nested def/class/lambda bodies — those
+    are yielded by ``scope_functions`` and checked as their own scopes, so
+    walking into them here would double-report every finding under two
+    fingerprints."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _collective_calls_in(node, issuing, stop=None):
+    """Collective-call nodes under ``node`` (excluding subtree ``stop``)."""
+    out = []
+    stack = [node] if not isinstance(node, list) else list(node)
+    while stack:
+        n = stack.pop()
+        if stop is not None and n is stop:
+            continue
+        if _is_collective_call(n, issuing):
+            out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+# -------------------------------------------------------- divergent sources
+def _divergent_reader_functions(tree):
+    """In-module functions whose body reads a divergent source — calling
+    them taints the assigned names (``h, n, sim = self._hosts()``)."""
+    out = set()
+    for _q, fn in scope_functions(tree):
+        for node in ast.walk(fn):
+            if _divergent_expr(node, (), recurse=False):
+                out.add(fn.name)
+                break
+    return out
+
+
+def _divergent_expr(node, tainted, recurse=True, readers=frozenset()):
+    """True when ``node`` (an expression tree) contains a host-divergent
+    source or a name tainted by one."""
+    nodes = ast.walk(node) if recurse else (node,)
+    for n in nodes:
+        if isinstance(n, ast.Call):
+            name = call_name(n)
+            if name in _DIVERGENT_CALLS or name in readers:
+                return True
+        if isinstance(n, ast.Attribute) and n.attr in _DIVERGENT_ATTRS:
+            return True
+        if isinstance(n, ast.Name) and n.id in tainted:
+            return True
+    return False
+
+
+def _tainted_names(fn, readers):
+    """Names assigned (directly or by tuple-unpack) from a divergent
+    expression anywhere in ``fn`` — flow-insensitive on purpose."""
+    tainted = set()
+    for _ in range(3):                      # small fixed point
+        changed = False
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign)):
+                continue
+            value = node.value
+            if value is None or not _divergent_expr(value, tainted,
+                                                    readers=readers):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                for sub in ast.walk(tgt):
+                    if isinstance(sub, ast.Name) and sub.id not in tainted:
+                        tainted.add(sub.id)
+                        changed = True
+        if not changed:
+            break
+    return tainted
+
+
+def _call_seq(nodes, issuing):
+    """Comparable collective-call sequence, in line order.  Each entry is
+    the callee name plus every argument EXCEPT the first positional (the
+    data operand): per-host operand values legitimately differ, but the
+    op kind, axis and other arguments are the pairing contract — two arms
+    psum-ing over different axes must NOT compare as symmetric."""
+    calls = _collective_calls_in(list(nodes), issuing)
+    calls.sort(key=lambda c: (c.lineno, c.col_offset))
+
+    def _sig(c):
+        rest = [unparse(a) for a in c.args[1:]]
+        rest += [f"{k.arg}={unparse(k.value)}" for k in c.keywords]
+        return f"{call_name(c)}({','.join(rest)})"
+
+    return tuple(_sig(c) for c in calls)
+
+
+# --------------------------------------------------------------- rule 1 + 2
+def _branch_pass(mod, qualname, fn, issuing, readers, add):
+    tainted = _tainted_names(fn, readers)
+    for node in _own_walk(fn):
+        if isinstance(node, (ast.If, ast.While)):
+            test_div = _divergent_expr(node.test, tainted, readers=readers)
+            if not test_div:
+                continue
+            body_seq = _call_seq(node.body, issuing)
+            else_seq = _call_seq(node.orelse, issuing)
+            if body_seq == else_seq:
+                continue                   # symmetric: same ops either way
+            calls = _collective_calls_in(list(node.body), issuing) or \
+                _collective_calls_in(list(node.orelse), issuing)
+            first = min(calls, key=lambda c: (c.lineno, c.col_offset))
+            add(Finding(
+                CHECKER, "divergent-collective", mod.path, qualname,
+                unparse(first.func), first.lineno,
+                f"collective {unparse(first.func)}() is issued under a "
+                f"branch on host-divergent state "
+                f"({unparse(node.test)}): hosts taking different arms "
+                f"issue different collective sequences and deadlock the "
+                f"pod — hoist the collective out of the branch or make "
+                f"the condition host-uniform"))
+        elif isinstance(node, ast.IfExp):
+            if not _divergent_expr(node.test, tainted, readers=readers):
+                continue
+            for arm in (node.body, node.orelse):
+                for c in _collective_calls_in(arm, issuing):
+                    add(Finding(
+                        CHECKER, "divergent-collective", mod.path, qualname,
+                        unparse(c.func), c.lineno,
+                        f"collective {unparse(c.func)}() in a conditional "
+                        f"expression on host-divergent state "
+                        f"({unparse(node.test)})"))
+
+
+def _unordered_iter_reason(it, set_names, dict_names):
+    """Why iterating ``it`` has host-unstable order, or None."""
+    if isinstance(it, ast.Call):
+        name = call_name(it)
+        if name == "sorted":
+            return None
+        if name == "set" or name == "frozenset":
+            return "set(...) iteration order is arbitrary"
+        if name in ("keys", "values", "items") and \
+                isinstance(it.func, ast.Attribute):
+            base = dotted_name(it.func.value) or unparse(it.func.value)
+            return (f"{base}.{name}() iterates in dict insertion order — "
+                    f"a per-host convention, not a cross-host guarantee")
+    if isinstance(it, ast.SetComp):
+        return "set-comprehension iteration order is arbitrary"
+    if isinstance(it, ast.Set):
+        return "set-literal iteration order is arbitrary"
+    if isinstance(it, ast.Name):
+        if it.id in set_names:
+            return f"{it.id!r} is a set — iteration order is arbitrary"
+        if it.id in dict_names:
+            return (f"{it.id!r} is a dict — insertion order is a per-host "
+                    f"convention, not a cross-host guarantee")
+    return None
+
+
+def _container_names(fn):
+    """(set-typed names, dict-typed names) assigned in ``fn``."""
+    sets, dicts = set(), set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            v = node.value
+            is_set = isinstance(v, (ast.Set, ast.SetComp)) or \
+                (isinstance(v, ast.Call) and call_name(v) in ("set",
+                                                              "frozenset"))
+            is_dict = isinstance(v, (ast.Dict, ast.DictComp)) or \
+                (isinstance(v, ast.Call) and call_name(v) == "dict")
+            if not (is_set or is_dict):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    (sets if is_set else dicts).add(tgt.id)
+    return sets, dicts
+
+
+def _order_pass(mod, qualname, fn, issuing, add):
+    set_names, dict_names = _container_names(fn)
+    for node in _own_walk(fn):
+        if not isinstance(node, ast.For):
+            continue
+        reason = _unordered_iter_reason(node.iter, set_names, dict_names)
+        if reason is None:
+            continue
+        ordered_calls = []
+        for sub in ast.walk(node):
+            if _is_collective_call(sub, issuing):
+                ordered_calls.append(sub)
+            elif isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in KVSTORE_ORDERED and \
+                    _looks_like_store(sub.func.value):
+                ordered_calls.append(sub)
+        if not ordered_calls:
+            continue
+        c = min(ordered_calls, key=lambda x: (x.lineno, x.col_offset))
+        add(Finding(
+            CHECKER, "unordered-collective-order", mod.path, qualname,
+            unparse(c.func), node.lineno,
+            f"{unparse(c.func)}() runs inside a loop over "
+            f"{unparse(node.iter)}: {reason}, so hosts can issue these "
+            f"order-sensitive calls in different orders — iterate "
+            f"sorted(...) instead"))
+
+
+def _looks_like_store(receiver):
+    """``kv.push`` / ``self._kvstore.push`` / ``store.pull`` — the receiver
+    name must look like a kvstore, or plain ``.update``-style dict methods
+    would drown the signal."""
+    name = (dotted_name(receiver) or "").lower()
+    return "kv" in name or "store" in name
+
+
+# ------------------------------------------------------------------- rule 3
+_RETRYISH = ("retry", "policy")
+
+
+def _retry_pass(mod, qualname, fn, issuing, add):
+    for node in _own_walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name in ("call", "wrap") and isinstance(node.func, ast.Attribute):
+            recv = (dotted_name(node.func.value) or "").lower()
+            if not any(h in recv for h in _RETRYISH):
+                continue
+            if not node.args:
+                continue
+            target = node.args[0]
+            tname = None
+            if isinstance(target, ast.Attribute):
+                tname = target.attr
+            elif isinstance(target, ast.Name):
+                tname = target.id
+            if tname in issuing or tname in COLLECTIVE_CALLS:
+                add(Finding(
+                    CHECKER, "retry-over-collective", mod.path, qualname,
+                    tname, node.lineno,
+                    f"{dotted_name(node.func.value)}.{name}({tname}, ...) "
+                    f"retries a function that issues a collective: one "
+                    f"host re-entering the collective while its peers "
+                    f"have advanced mispairs the collective order across "
+                    f"the mesh (deadlock, or values summed against the "
+                    f"wrong peer op) — keep the collective hop outside "
+                    f"any unilateral retry"))
+        elif name in ("inject", "scope") and \
+                isinstance(node.func, ast.Attribute) and \
+                "fault" in (dotted_name(node.func.value) or "").lower():
+            # faults.inject("site", ...) / with faults.scope("site"): a
+            # fault armed at a site whose check() call sits between a
+            # collective's peers is the same unilateral-failure hazard;
+            # statically we can only see scopes whose WITH body issues a
+            # collective directly.
+            parent = _with_parent(fn, node)
+            if parent is None:
+                continue
+            calls = _collective_calls_in(list(parent.body), issuing)
+            if calls:
+                c = calls[0]
+                add(Finding(
+                    CHECKER, "retry-over-collective", mod.path, qualname,
+                    unparse(c.func), c.lineno,
+                    f"collective {unparse(c.func)}() inside a "
+                    f"fault-injection scope ({unparse(node)}): an "
+                    f"injected failure fires on one host only, unpairing "
+                    f"the collective across the mesh — arm the site "
+                    f"before the collective hop, not around it"))
+
+
+def _with_parent(fn, call):
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.context_expr is call:
+                    return node
+    return None
+
+
+# --------------------------------------------------------------------- main
+def check(mod):
+    findings = []
+    seen = set()
+
+    def add(f):
+        key = (f.fingerprint, f.line)
+        if key not in seen:
+            seen.add(key)
+            findings.append(f)
+
+    issuing = _collective_functions(mod.tree)
+    readers = _divergent_reader_functions(mod.tree)
+    for qualname, fn in scope_functions(mod.tree):
+        _branch_pass(mod, qualname, fn, issuing, readers, add)
+        _order_pass(mod, qualname, fn, issuing, add)
+        _retry_pass(mod, qualname, fn, issuing, add)
+    return findings

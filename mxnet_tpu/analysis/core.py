@@ -230,16 +230,19 @@ def format_baseline_line(finding, justification="TODO: justify"):
 
 # -------------------------------------------------------------------- runner
 def _checker_table():
-    from . import capture, donation, locks, recompile
+    from . import barriers, capture, collectives, donation, locks, recompile
     return {
         "donation": donation.check,
         "capture": capture.check,
         "recompile": recompile.check,
         "locks": locks.check,
+        "collectives": collectives.check,
+        "barriers": barriers.check,
     }
 
 
-CHECKERS = ("donation", "capture", "recompile", "locks")
+CHECKERS = ("donation", "capture", "recompile", "locks", "collectives",
+            "barriers")
 
 
 def run_checkers(root, checkers=None, rel_to=None):

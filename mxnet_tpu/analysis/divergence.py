@@ -1,0 +1,431 @@
+"""Runtime collective sanitizer — ``MXNET_SANITIZE=collectives``.
+
+In a multi-controller run the collectives every host issues must pair up
+by program order; when hosts disagree the pod does not crash, it *hangs* —
+the worst possible failure mode at 6000 chips.  This module turns that
+hang into a loud, attributed error: every collective call site records a
+**fingerprint** (sequence number, op kind, axis, global shape/dtype) into
+a per-host stream, streams are cross-checked at sync points, and the first
+disagreement raises :class:`CollectiveDivergenceError` naming BOTH hosts'
+next-op fingerprints.  A watchdog (:func:`sync`) bounds the wait on peers
+that never arrive and dumps every host's position instead of stalling.
+
+Host topology and stream sharing reuse the PR 9 simulated-host harness:
+identity comes from ``MXNET_CKPT_HOST=h/H`` (or the real
+``jax.process_index()``/``process_count()``), and co-writer subprocesses
+share streams through append-only files under ``MXNET_SANITIZE_DIR``
+(one ``collectives-<h>.log`` per host, fsync-free appends — the sanitizer
+is a debugging tool, not a durability layer).  With no directory or a
+single host the stream stays in-memory only: recording still works (tests
+and stats read it), cross-checking is a no-op.
+
+Instrumented call sites (all guarded on ``sanitizer.collectives`` — one
+module-attribute read when idle):
+
+- ``parallel/trainer.py``  — ``SPMDTrainer.step`` (the jitted step's psum)
+- ``parallel/pipeline.py`` — ``gpipe`` / ``pipeline_train_1f1b`` /
+  ``gpipe_interleaved`` (ppermute schedules)
+- ``parallel/moe.py``      — ``moe_layer`` (all_to_all dispatch+combine)
+- ``kvstore.py``           — the dist push allreduce hop and ``barrier()``
+- ``parallel/checkpoint.py`` — the sharded commit barrier: every host
+  records the barrier, host 0's marker poll cross-checks each round (a
+  divergence raises instead of timing out) and a
+  ``CommitBarrierTimeout`` carries the position dump.
+
+Wire format: one line per collective, ``<fp> @ <site>`` where
+``fp = seq|kind|axis=..|shape=..|dtype=..``.  Only the fp is compared —
+sites name the Python call site for the human reading the error.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from ..telemetry import bus as _tel
+from .sanitizer import (CollectiveDivergenceError, CollectiveStallTimeout,
+                        _violation)
+
+__all__ = ["record", "check", "sync", "positions", "positions_dump",
+           "configure", "reset", "stream", "total_recorded",
+           "unverified_count", "host_identity"]
+
+_lock = threading.RLock()
+_STREAM_CAP = 65536
+
+
+class _State:
+    def __init__(self):
+        self.seq = 0
+        self.stream = []        # "<fp> @ <site>" lines, in seq order
+        self.truncated = 0      # lines dropped off the front by the cap
+        self.directory = None   # shared stream dir (None = in-memory only)
+        self.host = None        # resolved lazily
+        self.host_count = None
+        self.file = None
+        self.peers = {}         # host -> _PeerCursor (incremental reads)
+        self.unverified = 0     # lines consumed without evidence to
+        #                         compare (recorded pre-arming; counted,
+        #                         never silently treated as verified)
+
+
+class _PeerCursor:
+    """Incremental view of one peer's stream file: ``off`` is the byte
+    offset past the last COMPLETE line consumed, ``seen`` the total lines
+    consumed, ``pending`` the lines read but not yet compared (our own
+    stream was shorter at the time).  Verified prefixes never re-read —
+    a 60s barrier poll costs O(new lines), not O(stream) per tick."""
+
+    __slots__ = ("off", "seen", "pending")
+
+    def __init__(self):
+        self.off = 0
+        self.seen = 0
+        self.pending = []
+
+
+_state = _State()
+
+#: watchdog bound for :func:`sync` when the caller passes none
+DEFAULT_TIMEOUT_S = float(os.environ.get("MXNET_SANITIZE_WATCHDOG_S", "60"))
+
+
+def configure(directory=None, host=None, host_count=None):
+    """Pin the stream directory / host identity (tests, harnesses).
+    ``None`` leaves the lazy env/jax resolution in place."""
+    with _lock:
+        if directory is not None:
+            _state.directory = str(directory)
+            _close_file_locked()
+            # peer cursors hold byte offsets into the OLD directory's
+            # files — carrying them over would silently skip the new
+            # streams' prefixes
+            _state.peers = {}
+        if host is not None:
+            _state.host = int(host)
+        if host_count is not None:
+            _state.host_count = int(host_count)
+
+
+def reset():
+    """Drop the stream and re-resolve identity from the environment
+    (test isolation; called by ``sanitizer.reset()``)."""
+    with _lock:
+        _close_file_locked()
+        _state.seq = 0
+        _state.stream = []
+        _state.truncated = 0
+        _state.directory = None
+        _state.host = None
+        _state.host_count = None
+        _state.peers = {}
+        _state.unverified = 0
+
+
+def unverified_count():
+    """Lines consumed without comparable evidence on either side (see
+    ``_State.unverified``)."""
+    with _lock:
+        return _state.unverified
+
+
+def _close_file_locked():
+    if _state.file is not None:
+        try:
+            _state.file.close()
+        except OSError:
+            pass
+        _state.file = None
+
+
+def host_identity():
+    """(host, host_count) — each component independently: the configure()
+    pin if set, else ``MXNET_CKPT_HOST=h/H`` (the PR 9 simulated-host
+    harness), else the real jax process topology, else (0, 1)."""
+    with _lock:
+        pin_h, pin_c = _state.host, _state.host_count
+    if pin_h is not None and pin_c is not None:
+        return pin_h, pin_c
+    h = c = None
+    env = os.environ.get("MXNET_CKPT_HOST")
+    if env:
+        eh, sep, cnt = env.partition("/")
+        if sep and eh.strip().isdigit() and cnt.strip().isdigit():
+            h, c = int(eh), int(cnt)
+    if h is None:
+        try:
+            import jax
+            h, c = jax.process_index(), jax.process_count()
+        except Exception:
+            h, c = 0, 1
+    return (pin_h if pin_h is not None else h,
+            pin_c if pin_c is not None else c)
+
+
+def _directory():
+    with _lock:
+        if _state.directory is not None:
+            return _state.directory
+    return os.environ.get("MXNET_SANITIZE_DIR") or None
+
+
+def _stream_path(d, host):
+    return os.path.join(d, f"collectives-{int(host)}.log")
+
+
+def _ensure_file_locked():
+    if _state.file is not None:
+        return _state.file
+    d = _directory()
+    if not d:
+        return None
+    host, host_count = host_identity()
+    if host_count <= 1:
+        return None
+    os.makedirs(d, exist_ok=True)
+    _state.file = open(_stream_path(d, host), "a", encoding="utf-8")
+    return _state.file
+
+
+def _fmt(val):
+    if val is None:
+        return "-"
+    if isinstance(val, (tuple, list)):
+        return "x".join(str(v) for v in val)
+    return str(val)
+
+
+def record(kind, axis=None, shape=None, dtype=None, detail=None, site=""):
+    """Append one collective fingerprint to this host's stream.  Call
+    sites guard on ``sanitizer.collectives`` so the idle cost is one
+    attribute read; armed cost is one string format + (multi-host) one
+    buffered file append."""
+    with _lock:
+        seq = _state.seq
+        _state.seq += 1
+        fp = (f"{seq}|{kind}|axis={_fmt(axis)}|shape={_fmt(shape)}|"
+              f"dtype={_fmt(dtype)}")
+        if detail is not None:
+            fp += f"|{detail}"
+        line = f"{fp} @ {site}" if site else fp
+        _state.stream.append(line)
+        if len(_state.stream) > _STREAM_CAP:
+            # the on-disk stream keeps the full history; in-memory keeps
+            # the tail (cross-checks past the cap read the peer's file
+            # against our file, not our memory)
+            del _state.stream[0]
+            _state.truncated += 1
+        f = _ensure_file_locked()
+        if f is not None:
+            f.write(line + "\n")
+            f.flush()
+    if _tel.enabled:
+        _tel.count("analysis.sanitizer_collectives", kind=kind)
+    return seq
+
+
+def stream():
+    """This host's in-memory stream (copy; the tail past ``_STREAM_CAP``
+    for very long runs — :func:`total_recorded` has the full count)."""
+    with _lock:
+        return list(_state.stream)
+
+
+def total_recorded():
+    """Total collectives recorded by this process (uncapped)."""
+    with _lock:
+        return _state.seq
+
+
+def _fp_of(line):
+    return line.split(" @ ", 1)[0]
+
+
+def _site_of(line):
+    parts = line.split(" @ ", 1)
+    return parts[1] if len(parts) > 1 else "?"
+
+
+def _read_stream(path):
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return [ln.rstrip("\n") for ln in f if ln.strip()]
+    except OSError:
+        return None
+
+
+def _read_new_lines(path, off):
+    """Complete lines past byte ``off`` -> (lines, new_off); a torn tail
+    line (peer mid-append) is left for the next read.  (None, off) when
+    the file does not exist yet."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(off)
+            chunk = f.read()
+    except OSError:
+        return None, off
+    nl = chunk.rfind(b"\n")
+    if nl < 0:
+        return [], off
+    lines = [ln for ln in chunk[:nl + 1].decode("utf-8").splitlines()
+             if ln.strip()]
+    return lines, off + nl + 1
+
+
+def _own_fp_locked(i):
+    """Own stream line ``i`` from memory, or None once the cap dropped it
+    (only reachable when a peer lags by > _STREAM_CAP lines — those
+    prefixes were already verified when they were the tail)."""
+    idx = i - _state.truncated
+    if 0 <= idx < len(_state.stream):
+        return _state.stream[idx]
+    return None
+
+
+def positions():
+    """{host: (n_recorded, last_line_or_None)} across every host whose
+    stream file exists (plus this host's in-memory view).  Diagnostic
+    path: reads peers' files whole."""
+    host, host_count = host_identity()
+    with _lock:
+        n, last = _state.seq, (_state.stream[-1] if _state.stream else None)
+    out = {host: (n, last)}
+    d = _directory()
+    if d and host_count > 1:
+        for h in range(host_count):
+            if h == host:
+                continue
+            lines = _read_stream(_stream_path(d, h))
+            if lines is None:
+                out[h] = (0, None)
+            else:
+                out[h] = (len(lines), lines[-1] if lines else None)
+    return out
+
+
+def positions_dump():
+    try:
+        pos = positions()
+    except Exception as e:            # diagnosis must not mask the raise
+        return f"  <position dump failed: {e!r}>"
+    return "\n".join(
+        f"  host {h}: {n} collectives, last: {last or '<none>'}"
+        for h, (n, last) in sorted(pos.items()))
+
+
+def check(point=""):
+    """Non-blocking cross-check: compare this host's stream against every
+    peer stream on disk; the first index where fingerprints disagree
+    raises :class:`CollectiveDivergenceError` naming both hosts' ops.
+    Returns {host: lines seen} (no-op single-host or without a shared
+    directory).
+
+    Incremental: each peer file is read only past the cursor of the last
+    check, and already-verified prefixes are never re-compared — the
+    checkpoint barrier's 20ms poll costs O(new lines) per tick, and the
+    own side never touches disk (the in-memory stream is authoritative
+    for this process)."""
+    host, host_count = host_identity()
+    with _lock:
+        my_len = _state.seq
+    lengths = {host: my_len}
+    d = _directory()
+    if not d or host_count <= 1:
+        return lengths
+    own_disk = None      # lazy own-file fallback for cap-truncated lines
+    own_base = 0         # seq number of own_disk[0] — the file starts at
+    #                      whatever seq the stream directory was armed at,
+    #                      so absolute index i lives at own_disk[i - base]
+    for h in range(host_count):
+        if h == host:
+            continue
+        with _lock:
+            cur = _state.peers.setdefault(h, _PeerCursor())
+            new, cur.off = _read_new_lines(_stream_path(d, h), cur.off)
+            if new is None:
+                if cur.seen == 0:
+                    continue          # peer not started yet
+                new = []
+            cur.pending.extend(new)
+            cur.seen += len(new)
+            lengths[h] = cur.seen
+            # compare the pending tail against our own lines by absolute
+            # index; stop where our own stream ends (peer is ahead)
+            base = cur.seen - len(cur.pending)
+            n_cmp = 0
+            mismatch = None
+            for j, theirs in enumerate(cur.pending):
+                i = base + j
+                if i >= my_len:
+                    break
+                mine = _own_fp_locked(i)
+                if mine is None:
+                    # the in-memory cap dropped this own line (a peer
+                    # lagging by > _STREAM_CAP): the on-disk own stream
+                    # has it UNLESS it predates the directory being armed
+                    if own_disk is None:
+                        own_disk = _read_stream(
+                            _stream_path(d, host)) or []
+                        try:
+                            own_base = int(own_disk[0].split("|", 1)[0])
+                        except (IndexError, ValueError):
+                            own_base = 0
+                    k = i - own_base
+                    mine = own_disk[k] if 0 <= k < len(own_disk) else None
+                    if mine is None:
+                        # evidence gone from memory AND disk (recorded
+                        # before the stream dir was armed): count it
+                        # rather than pretend it was verified
+                        _state.unverified += 1
+                        if _tel.enabled:
+                            _tel.count(
+                                "analysis.sanitizer_collective_unverified")
+                n_cmp = j + 1
+                if mine is not None and _fp_of(mine) != _fp_of(theirs):
+                    mismatch = (i, mine, theirs)
+                    # keep the diverging line pending: a caller that
+                    # catches the error and re-checks must see the SAME
+                    # first divergence, not a shifted one
+                    n_cmp = j
+                    break
+            del cur.pending[:n_cmp]
+        if mismatch is not None:
+            i, mine, theirs = mismatch
+            err = CollectiveDivergenceError(
+                host_a=host, fp_a=_fp_of(mine), site_a=_site_of(mine),
+                host_b=h, fp_b=_fp_of(theirs), site_b=_site_of(theirs),
+                index=i, point=point)
+            _violation(err)           # counts + raises
+    if _tel.enabled:
+        _tel.count("analysis.sanitizer_collective_checks")
+    return lengths
+
+
+def sync(point="", timeout_s=None, poll_s=0.02):
+    """Barrier-style cross-check with a watchdog: wait until every peer's
+    stream has reached this host's length (verifying prefixes each poll),
+    or raise :class:`CollectiveStallTimeout` with every host's position —
+    a bounded, attributed answer to "the pod is hung".  No-op when
+    single-host or no shared directory."""
+    import time
+    host, host_count = host_identity()
+    with _lock:
+        my_len = _state.seq
+    d = _directory()
+    if not d or host_count <= 1:
+        return {host: my_len}
+    timeout_s = DEFAULT_TIMEOUT_S if timeout_s is None else float(timeout_s)
+    deadline = time.monotonic() + timeout_s
+    while True:
+        lengths = check(point)        # raises on any prefix divergence
+        behind = [h for h in range(host_count)
+                  if lengths.get(h, 0) < my_len]
+        if not behind:
+            if _tel.enabled:
+                _tel.count("analysis.sanitizer_collective_syncs")
+            return lengths
+        if time.monotonic() >= deadline:
+            err = CollectiveStallTimeout(
+                point=point, waited_s=timeout_s, behind=behind,
+                dump=positions_dump())
+            _violation(err)
+        time.sleep(poll_s)

@@ -13,8 +13,11 @@ Heuristics:
 
 - **Worker entry points**: functions passed as ``target=`` to
   ``Thread``/``Process``, first argument of ``.submit(...)``, or methods
-  whose name contains ``worker`` — plus, transitively, same-class methods
-  they call via ``self.``.
+  whose name contains ``worker`` AND are never invoked as a plain
+  ``self.name(...)`` call in the class (a thread entry point is spawned,
+  not called — a worker-named helper the consumer thread calls runs on
+  the caller's thread) — plus, transitively, same-class methods they call
+  via ``self.``.
 - **Mutations**: ``self.X = ...`` / ``self.X += ...`` / ``self.X[k] = ...``
   inside methods, and module-global assignment (``global X`` declared).
 - **Protection**: the mutation sits under a ``with`` whose context
@@ -89,8 +92,18 @@ def _method_mutations(fn):
 
 def _worker_seeds(cls):
     """Method names that start a thread/process or look like worker
-    bodies."""
+    bodies.
+
+    The ``worker``-in-the-name heuristic only seeds methods that are
+    never invoked as plain ``self.name(...)`` calls inside the class: a
+    thread entry point is *spawned* (``target=``/``submit``), not called
+    — a worker-named helper that some consumer-thread method calls
+    (``ProcessDecodePool._check_workers``, called only from
+    ``next_batch``) runs on the caller's thread and must not be seeded.
+    Methods passed as ``target=``/``submit`` seed unconditionally, called
+    directly or not."""
     seeds = set()
+    called = set()
     for node in ast.walk(cls):
         if isinstance(node, ast.Call):
             name = call_name(node)
@@ -106,9 +119,13 @@ def _worker_seeds(cls):
                     isinstance(node.args[0].value, ast.Name) and \
                     node.args[0].value.id == "self":
                 seeds.add(node.args[0].attr)
+            if isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self":
+                called.add(node.func.attr)
     for node in cls.body:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
-                "worker" in node.name.lower():
+                "worker" in node.name.lower() and node.name not in called:
             seeds.add(node.name)
     return seeds
 

@@ -1,8 +1,8 @@
 """Runtime sanitizer — the dynamic half of the analysis suite.
 
-``MXNET_SANITIZE=donation,slots`` (or :func:`enable` / :class:`scope`)
-arms two opt-in modes that turn silent corruption into loud, attributed
-errors:
+``MXNET_SANITIZE=donation,slots,collectives`` (or :func:`enable` /
+:class:`scope`) arms opt-in modes that turn silent corruption into loud,
+attributed errors:
 
 - **donation** — every donated jit call site (aggregated optimizer groups,
   engine segment flushes, ``SPMDTrainer`` steps) *poisons* the buffers it
@@ -17,6 +17,14 @@ errors:
   ring bumps the generation on ``release``.  A read through a stale-
   generation buffer raises :class:`StaleSlotError` naming the slot and
   registration site — instead of returning another batch's pixels.
+- **collectives** — every collective call site (SPMD steps, pipeline/moe
+  schedules, the kvstore dist hop, the checkpoint commit barrier) records
+  a per-host fingerprint stream; streams are cross-checked at sync points
+  (see :mod:`.divergence`) and a mismatch raises
+  :class:`CollectiveDivergenceError` naming both hosts' next-op
+  fingerprints — instead of the multi-controller pod hanging.  A watchdog
+  (:func:`.divergence.sync`) bounds waits on stalled peers with a
+  position dump (:class:`CollectiveStallTimeout`).
 
 Cost discipline (same as ``telemetry.bus.enabled`` / ``faults.active``):
 instrumented sites guard on the module attributes ``donation`` / ``slots``
@@ -39,11 +47,12 @@ from collections import OrderedDict
 from ..telemetry import bus as _tel
 
 __all__ = ["SanitizerError", "DonatedBufferError", "StaleSlotError",
+           "CollectiveDivergenceError", "CollectiveStallTimeout",
            "enable", "disable", "configure", "scope", "modes", "active",
-           "donation", "slots", "poison", "register_slot_view",
-           "check_buffer", "stats", "reset"]
+           "donation", "slots", "collectives", "poison",
+           "register_slot_view", "check_buffer", "stats", "reset"]
 
-MODES = ("donation", "slots")
+MODES = ("donation", "slots", "collectives")
 
 # Fast-path flags: hooks do ``if sanitizer.active: sanitizer.check_buffer(b)``
 # and sites do ``if sanitizer.donation: sanitizer.poison(...)``.  Mutated
@@ -51,6 +60,7 @@ MODES = ("donation", "slots")
 active = False
 donation = False
 slots = False
+collectives = False
 
 _lock = threading.Lock()
 _POISON_CAP = 8192
@@ -91,10 +101,55 @@ class StaleSlotError(SanitizerError):
         self.slot_id = slot_id
 
 
+class CollectiveDivergenceError(SanitizerError):
+    """Two hosts disagree on which collective comes next.
+
+    On real hardware this is a silent pod-wide hang; under
+    ``MXNET_SANITIZE=collectives`` the stream cross-check raises instead,
+    naming BOTH hosts' next-op fingerprints at the first diverging
+    sequence number."""
+
+    def __init__(self, host_a, fp_a, site_a, host_b, fp_b, site_b, index,
+                 point=""):
+        at = f" at sync point {point!r}" if point else ""
+        super().__init__(
+            f"SPMD collective divergence{at}: hosts {host_a} and {host_b} "
+            f"disagree on collective #{index} —\n"
+            f"  host {host_a} issued: {fp_a} @ {site_a}\n"
+            f"  host {host_b} issued: {fp_b} @ {site_b}\n"
+            f"on real hardware this mispairing deadlocks the pod; find "
+            f"the host-divergent branch/order upstream of the first "
+            f"differing op (MXNET_SANITIZE=collectives)")
+        self.host_a, self.fp_a, self.site_a = host_a, fp_a, site_a
+        self.host_b, self.fp_b, self.site_b = host_b, fp_b, site_b
+        self.index = index
+        self.point = point
+        self.site = point or site_a
+
+
+class CollectiveStallTimeout(SanitizerError, TimeoutError):
+    """The watchdog gave up waiting for peers to reach a sync point.
+
+    The streams agree as far as they go — a peer simply stopped issuing
+    collectives (crashed, or deadlocked elsewhere).  The message dumps
+    every host's position so the stalled host is named instead of the
+    whole pod hanging."""
+
+    def __init__(self, point, waited_s, behind, dump):
+        super().__init__(
+            f"collective sync point {point!r}: host(s) {behind} did not "
+            f"catch up within {waited_s:g}s — every host's position:\n"
+            f"{dump}\n(MXNET_SANITIZE=collectives watchdog)")
+        self.point = point
+        self.behind = list(behind)
+        self.site = point
+
+
 def _refresh_locked(new_modes):
-    global active, donation, slots
+    global active, donation, slots, collectives
     donation = "donation" in new_modes
     slots = "slots" in new_modes
+    collectives = "collectives" in new_modes
     active = bool(new_modes)
 
 
@@ -123,7 +178,8 @@ def _parse(spec):
 def modes():
     """Currently armed mode names (frozenset)."""
     return frozenset(m for m, on in (("donation", donation),
-                                     ("slots", slots)) if on)
+                                     ("slots", slots),
+                                     ("collectives", collectives)) if on)
 
 
 def enable(*names):
@@ -158,6 +214,8 @@ def reset():
         _poisoned.clear()
         _slot_views.clear()
         _violations = 0
+    from . import divergence
+    divergence.reset()
 
 
 class scope:
@@ -183,9 +241,11 @@ class scope:
 
 def stats():
     """Registry sizes + violation count (test/debug surface)."""
+    from . import divergence
+    n_coll = divergence.total_recorded()
     with _lock:
         return {"poisoned": len(_poisoned), "slot_views": len(_slot_views),
-                "violations": _violations}
+                "collectives": n_coll, "violations": _violations}
 
 
 # ----------------------------------------------------------------- registry

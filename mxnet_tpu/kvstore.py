@@ -29,6 +29,8 @@ import pickle
 
 import numpy as np
 
+from .analysis import divergence as _div
+from .analysis import sanitizer as _san
 from .ndarray import NDArray
 from . import optimizer as opt
 from .resilience import faults as _faults
@@ -119,15 +121,32 @@ class KVStore:
         self._retry = policy
 
     def _transport_push(self, merged):
-        """The cross-worker hop of a push — the only transiently-failing
-        part (local reduction is device compute).  Fault site
-        ``kvstore.push`` lives here so injected failures exercise the
-        retry path exactly where a real transport error would land."""
+        """The single-process transport hop of a push (fault site
+        ``kvstore.push``) — structurally collective-free, so wrapping it
+        in a ``set_retry_policy`` retry is always safe.  The cross-worker
+        allreduce lives in :meth:`_dist_push_hop`, outside any retry; the
+        ``collectives/retry-over-collective`` static checker enforces the
+        split (it used to be a call-site guard plus a comment)."""
         if _faults.active:
             _faults.check("kvstore.push")
-        if "dist" in self._type and self.num_workers > 1:
-            merged = self._global_allreduce(merged)
         return merged
+
+    def _dist_push_hop(self, key, merged):
+        """The cross-worker hop of a dist push: one global allreduce.
+        Never retried unilaterally — one worker re-entering the collective
+        while the others have advanced to their next one mispairs the
+        collective order across the mesh (deadlock, or gradients summed
+        against the wrong key); a dist transport error fails the step and
+        all workers restart it together.  The ``kvstore.push`` fault site
+        fires BEFORE the collective, so an injected fault drills the
+        fail-the-step path without unpairing a collective in flight."""
+        if _faults.active:
+            _faults.check("kvstore.push")
+        if _san.collectives:
+            _div.record("kvstore.allreduce", shape=tuple(merged.shape),
+                        dtype=merged.dtype, detail=f"key={key}",
+                        site="KVStore.push dist hop")
+        return self._global_allreduce(merged)
 
     def _transport_pull(self, stored, out):
         """One stored->out copy of a pull (fault site ``kvstore.pull``)."""
@@ -242,17 +261,12 @@ class KVStore:
                 # advances the per-key error-feedback residual, so a retry
                 # re-entering it would double-count the residual
                 merged = self._compress(k, merged)
-            if self._retry is not None and not (
-                    "dist" in self._type and self.num_workers > 1):
+            if "dist" in self._type and self.num_workers > 1:
+                merged = self._dist_push_hop(k, merged)
+            elif self._retry is not None:
                 merged = self._retry.call(self._transport_push, merged,
                                           site="kvstore.push")
             else:
-                # a cross-worker allreduce is never retried unilaterally:
-                # one worker re-entering the collective while the others
-                # have advanced to their next one mispairs the collective
-                # order across the mesh (deadlock, or gradients summed
-                # against the wrong key).  A dist transport error fails
-                # the step; all workers restart it together.
                 merged = self._transport_push(merged)
             stored = self._store[k]
             if self._updater is not None:
@@ -405,7 +419,16 @@ class KVStore:
             self._updater.set_states(f.read())
 
     def barrier(self):
-        """Global barrier (ps ``Postoffice`` barrier → JAX sync)."""
+        """Global barrier (ps ``Postoffice`` barrier → JAX sync).
+
+        Under ``MXNET_SANITIZE=collectives`` this is also a sanitizer
+        sync point: the per-host fingerprint streams are cross-checked
+        (and, under the simulated-host harness, waited on with the
+        watchdog) before the device barrier — a divergence raises here,
+        attributed, instead of hanging inside ``sync_global_devices``."""
+        if _san.collectives:
+            _div.record("kvstore.barrier", site="KVStore.barrier")
+            _div.sync("kvstore.barrier")
         if "dist" in self._type and self.num_workers > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")

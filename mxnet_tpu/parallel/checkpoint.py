@@ -68,6 +68,8 @@ import threading
 import time
 import zlib
 
+from ..analysis import divergence as _div
+from ..analysis import sanitizer as _san
 from ..resilience import durable as _durable
 from ..resilience import faults as _faults
 from ..telemetry import bus as _tel
@@ -548,6 +550,15 @@ class SPMDCheckpointManager:
         if self._manifest_of(step) is not None:
             return            # idempotent re-save of a committed step
         d = self._step_dir(step)
+        if _san.collectives:
+            # the commit barrier is a sync point every host passes through
+            # in program order: fingerprint it so a host that arrives here
+            # with a different collective history is named at the poll
+            # below instead of timing the barrier out
+            _div.record("ckpt.commit_barrier", shape=(step,),
+                        detail=f"hosts={host_count}",
+                        site=f"SPMDCheckpointManager._save_sharded "
+                             f"host={host}")
         with _tel.span("checkpoint.save", kind=kind, step=step, host=host,
                        host_count=host_count, sharded=True) as sp:
             with _tel.span("checkpoint.serialize"):
@@ -593,6 +604,11 @@ class SPMDCheckpointManager:
                                          site="checkpoint.save")
                     else:
                         self._commit_sharded(d, step, host_count, markers)
+                elif _san.collectives:
+                    # co-writers: one non-blocking stream cross-check after
+                    # phase 1 — a divergence raises on this host too, not
+                    # only on the polling host 0
+                    _div.check("ckpt.commit_barrier")
             sp.set(bytes_written=nbytes)
             if host == 0:
                 self._gc()
@@ -724,6 +740,11 @@ class SPMDCheckpointManager:
         deadline = time.monotonic() + self._barrier_timeout
         markers = {}          # validated markers cannot regress (written
         while True:           # last, after their files are fsynced)
+            if _san.collectives:
+                # a co-writer whose collective stream diverged will never
+                # write its marker: raise the attributed divergence here
+                # instead of waiting out the barrier timeout
+                _div.check("ckpt.commit_barrier")
             missing = []
             for h in range(host_count):
                 if h in markers:
@@ -736,19 +757,28 @@ class SPMDCheckpointManager:
             if not missing:
                 return markers
             if time.monotonic() >= deadline:
+                dump = ""
+                if _san.collectives:
+                    dump = ("\ncollective positions per host "
+                            "(MXNET_SANITIZE=collectives):\n"
+                            + _div.positions_dump())
                 raise CommitBarrierTimeout(
                     f"step {step}: no completion marker from host(s) "
                     f"{missing} after {self._barrier_timeout:g}s — co-writer "
                     f"crashed mid-save?  The partial step dir stays "
                     f"uncommitted; the previous complete checkpoint remains "
-                    f"the resume point")
+                    f"the resume point" + dump)
             time.sleep(0.02)
 
     def _commit_sharded(self, d, step, host_count, markers):
         """Phase 2 (host 0 only): the manifest lists every host's files —
         its appearance is the atomic commit point for the whole step."""
         all_files = {}
-        for h, marker in markers.items():
+        # host order, not poll-arrival order: the manifest's file dict (and
+        # so its bytes) must not depend on which co-writer's marker host 0
+        # happened to see first (the collectives/unordered-order rule's
+        # hazard class, here surfacing as nondeterministic manifests)
+        for h, marker in sorted(markers.items()):
             all_files.update(marker["files"])
             with open(os.path.join(d, _marker_name(h)), "rb") as f:
                 raw = f.read()

@@ -16,6 +16,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..analysis import divergence as _div
+from ..analysis import sanitizer as _san
+
 __all__ = ["moe_layer", "switch_moe_local"]
 
 
@@ -63,6 +66,10 @@ def moe_layer(expert_fn, gate_w, expert_params, x, mesh, ep_axis="ep",
     from .mesh import shard_map_fn
     shard_map = shard_map_fn()
 
+    if _san.collectives:
+        _div.record("moe.all_to_all", axis=ep_axis, shape=tuple(x.shape),
+                    dtype=getattr(x, "dtype", None),
+                    site="parallel.moe.moe_layer")
     E = mesh.shape[ep_axis]
     assert gate_w.shape[-1] == E, \
         f"gate width {gate_w.shape[-1]} != ep axis size {E} (one expert " \

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..analysis import divergence as _div
+from ..analysis import sanitizer as _san
 from ..resilience import faults as _faults
 
 __all__ = ["gpipe", "gpipe_interleaved", "pipeline_stage_loop",
@@ -112,6 +114,11 @@ def gpipe(stage_fn, stacked_params, x, mesh, n_microbatches, pp_axis="pp"):
         # resilience drill site: fails before the schedule dispatches, so
         # an injected fault never strands a half-run pipeline tick
         _faults.check("pipeline.schedule")
+    if _san.collectives:
+        _div.record("pipeline.gpipe", axis=pp_axis, shape=tuple(x.shape),
+                    dtype=getattr(x, "dtype", None),
+                    detail=f"n_micro={n_microbatches}",
+                    site="parallel.pipeline.gpipe")
     b = x.shape[0]
     assert b % n_microbatches == 0, \
         f"batch {b} not divisible by n_microbatches {n_microbatches}"
@@ -256,6 +263,11 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stacked_params, x, y, mesh,
 
     if _faults.active:
         _faults.check("pipeline.schedule")
+    if _san.collectives:
+        _div.record("pipeline.1f1b", axis=pp_axis, shape=tuple(x.shape),
+                    dtype=getattr(x, "dtype", None),
+                    detail=f"n_micro={n_microbatches}",
+                    site="parallel.pipeline.pipeline_train_1f1b")
     S = mesh.shape[pp_axis]
     b = x.shape[0]
     assert b % n_microbatches == 0, \
@@ -354,6 +366,11 @@ def gpipe_interleaved(stage_fn, stacked_params, x, mesh, n_microbatches,
 
     if _faults.active:
         _faults.check("pipeline.schedule")
+    if _san.collectives:
+        _div.record("pipeline.interleaved", axis=pp_axis,
+                    shape=tuple(x.shape), dtype=getattr(x, "dtype", None),
+                    detail=f"n_micro={n_microbatches} v={n_chunks}",
+                    site="parallel.pipeline.gpipe_interleaved")
 
     S = mesh.shape[pp_axis]
     V = n_chunks

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from .. import autograd
 from .. import ndarray as nd_mod
 from .. import random as _rnd
+from ..analysis import divergence as _div
 from ..analysis import sanitizer as _san
 from ..ndarray import NDArray
 from ..telemetry import bus as _tel
@@ -289,6 +290,17 @@ class SPMDTrainer:
         key = _rnd.next_key()
         if _tel.enabled and self._t == 0:
             self._record_telemetry(data, label, key)
+        if _san.collectives:
+            # the jitted step is one collective program (grad psum + any
+            # sharding collectives): fingerprint it so hosts that disagree
+            # on step order/shape are caught at the next sync point
+            d0 = data[0] if isinstance(data, tuple) else data
+            _div.record(
+                "trainer.step",
+                axis=",".join(str(a) for a in self._mesh.axis_names),
+                shape=tuple(getattr(d0, "shape", ())),
+                dtype=getattr(d0, "dtype", None),
+                site=f"SPMDTrainer.step t={self._t}")
         # the scope matters while jax traces the step (first call / retrace):
         # attention layers consult it to route through ring attention
         old_leaves = None

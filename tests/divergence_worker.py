@@ -1,0 +1,126 @@
+"""Subprocess entry for the two-simulated-host collective-sanitizer drills
+(tests/test_divergence.py and the ci analyze stage).
+
+Each invocation is one simulated host (``--host h/H``, the PR 9 harness
+identity) running under ``MXNET_SANITIZE=collectives`` with the fingerprint
+streams shared through ``--dir``.  The script runs ``--steps`` SPMD train
+steps, then a sharded checkpoint save (whose commit barrier is the
+cross-check sync point), then a final explicit sanitizer sync.
+
+``--diverge-at N`` makes THIS host issue a different collective at step N —
+a pipeline schedule instead of the train step, the planted SPMD bug (think:
+a host-conditional branch reaching a different collective).  The clean
+hosts then raise :class:`CollectiveDivergenceError` at their next sync
+point instead of hanging in the barrier; the divergent host raises at its
+own post-save check.  Exit codes: 0 = clean run completed, 3 =
+CollectiveDivergenceError (stdout carries the message for the parent to
+inspect), 4 = CollectiveStallTimeout.
+
+``--stall-at N`` makes this host stop issuing collectives after step N
+(a simulated deadlock elsewhere): its peers' watchdog must dump every
+host's position and raise instead of waiting forever.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+BATCH = 16
+FEATS = 8
+N_CLASSES = 4
+
+
+def build_trainer(seed=0):
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import (FunctionalOptimizer, SPMDTrainer,
+                                    make_mesh)
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = mx.gluon.nn.HybridSequential(prefix="div_")
+    with net.name_scope():
+        net.add(mx.gluon.nn.Dense(16, activation="relu", in_units=FEATS),
+                mx.gluon.nn.Dense(N_CLASSES, in_units=16))
+    net.initialize()
+    return SPMDTrainer(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                       FunctionalOptimizer("sgd", 1e-2),
+                       make_mesh(n_devices=4, dp=2, tp=2), nan_guard=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", required=True,
+                    help="shared dir: fingerprint streams + checkpoint")
+    ap.add_argument("--host", required=True, help="h/H simulated identity")
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--diverge-at", type=int, default=None)
+    ap.add_argument("--stall-at", type=int, default=None)
+    ap.add_argument("--timeout", type=float, default=20.0,
+                    help="watchdog + commit-barrier bound")
+    args = ap.parse_args(argv)
+
+    os.environ["MXNET_SANITIZE"] = "collectives"
+    os.environ["MXNET_CKPT_HOST"] = args.host
+    os.environ["MXNET_SANITIZE_DIR"] = args.dir
+
+    import numpy as np
+    import jax.numpy as jnp
+    from mxnet_tpu.analysis import divergence as div
+    from mxnet_tpu.analysis import sanitizer as san
+    from mxnet_tpu.parallel import (CommitBarrierTimeout,
+                                    SPMDCheckpointManager, pipeline)
+
+    assert san.collectives, "MXNET_SANITIZE=collectives must arm at import"
+    host, _, host_count = args.host.partition("/")
+    host, host_count = int(host), int(host_count)
+
+    tr = build_trainer()
+    rng = np.random.RandomState(7)
+    batches = [(rng.randn(BATCH, FEATS).astype("float32"),
+                rng.randint(0, N_CLASSES, BATCH).astype("float32"))
+               for _ in range(args.steps)]
+    try:
+        for i, (x, y) in enumerate(batches):
+            if args.stall_at is not None and i >= args.stall_at:
+                print(f"STALLED host={host} at step {i}", flush=True)
+                return 0        # stops issuing collectives; peers' watchdog
+            if args.diverge_at is not None and i == args.diverge_at:
+                # the planted SPMD bug: this host issues a DIFFERENT
+                # collective at the same sequence position
+                from mxnet_tpu.parallel import make_mesh
+                mesh = make_mesh(n_devices=8, pp=8)
+                pipeline.gpipe(lambda p, xx: xx * p.sum(),
+                               jnp.ones((8, 4)), jnp.ones((16, 4)), mesh, 4)
+                print(f"DIVERGED host={host} at step {i}", flush=True)
+            else:
+                tr.step(x, y)
+        mgr = SPMDCheckpointManager(args.dir, host_index=host,
+                                    host_count=host_count,
+                                    barrier_timeout_s=args.timeout)
+        mgr.save(tr._t, tr)
+        div.sync("post-save", timeout_s=args.timeout)
+    except san.CollectiveDivergenceError as e:
+        print(f"DIVERGENCE host={host}: {e}", flush=True)
+        return 3
+    except san.CollectiveStallTimeout as e:
+        print(f"STALL-TIMEOUT host={host}: {e}", flush=True)
+        return 4
+    except CommitBarrierTimeout as e:
+        # a stalled peer surfaces as the (bounded) commit-barrier timeout,
+        # whose message now carries the per-host collective position dump
+        print(f"STALL-TIMEOUT host={host}: {e}", flush=True)
+        return 4
+    print(f"CLEAN host={host} collectives={san.stats()['collectives']} "
+          f"violations={san.stats()['violations']}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -282,6 +282,358 @@ class TestLocksChecker:
         assert rules(fs) == {"unlocked-shared-mutation"}
 
 
+# --------------------------------------------------------------- collectives
+class TestCollectivesChecker:
+    def test_divergent_branch_fires(self):
+        fs = run_checker("""
+            import jax
+            from jax import lax
+            def step(x):
+                if jax.process_index() == 0:
+                    return lax.psum(x, "dp")
+                return x
+            """, "collectives")
+        assert rules(fs) == {"divergent-collective"}
+        assert fs[0].symbol == "lax.psum"
+
+    def test_taint_flows_through_reader_and_unpack(self):
+        # the checkpoint _hosts() idiom: identity read in a helper, tuple-
+        # unpacked at the call site, branched on later
+        fs = run_checker("""
+            import jax
+            from jax import lax
+            def _hosts():
+                return jax.process_index(), 2
+            def save(x):
+                h, n = _hosts()
+                if h == 0:
+                    x = lax.all_gather(x, "dp")
+                return x
+            """, "collectives")
+        assert rules(fs) == {"divergent-collective"}
+        assert fs[0].scope == "save"
+
+    def test_process_count_branch_is_uniform(self):
+        # the num_workers > 1 degenerate-path idiom: process_count() is the
+        # same value on every host, so the branch cannot diverge
+        fs = run_checker("""
+            import jax
+            from jax import lax
+            def push(x):
+                if jax.process_count() > 1:
+                    x = lax.psum(x, "dp")
+                return x
+            """, "collectives")
+        assert fs == []
+
+    def test_symmetric_branches_quiet(self):
+        # both arms issue the identical collective sequence: same ops on
+        # every host regardless of the divergent test (operand values may
+        # differ — psum pairs by op+axis, not by value)
+        fs = run_checker("""
+            import time
+            from jax import lax
+            def f(x):
+                if time.time() > 5:
+                    y = lax.psum(x, "dp")
+                else:
+                    y = lax.psum(x * 2, "dp")
+                return y
+            """, "collectives")
+        assert fs == []
+
+    def test_same_op_different_axis_fires(self):
+        # NOT symmetric: psum over different axes pairs against different
+        # peer groups — hosts taking different arms deadlock
+        fs = run_checker("""
+            import jax
+            from jax import lax
+            def f(x):
+                if jax.process_index() == 0:
+                    y = lax.psum(x, "dp")
+                else:
+                    y = lax.psum(x, "tp")
+                return y
+            """, "collectives")
+        assert rules(fs) == {"divergent-collective"}
+
+    def test_nested_def_reports_once_in_inner_scope(self):
+        # scope_functions yields nested defs as their own scopes; the
+        # outer scope's walk must not double-report the inner's finding
+        # under a second fingerprint
+        fs = run_checker("""
+            import jax
+            from jax import lax
+            def outer(xs):
+                def inner(x):
+                    if jax.process_index() == 0:
+                        return lax.psum(x, "dp")
+                    return x
+                return [inner(x) for x in xs]
+            """, "collectives")
+        assert len(fs) == 1
+        assert fs[0].scope == "outer.inner"
+
+    def test_env_and_filesystem_divergent(self):
+        fs = run_checker("""
+            import os
+            from jax import lax
+            def f(x, path):
+                if os.environ.get("ROLE") == "leader":
+                    x = lax.psum(x, "dp")
+                if os.path.exists(path):
+                    x = lax.all_gather(x, "dp")
+                return x
+            """, "collectives")
+        assert len(fs) == 2
+        assert rules(fs) == {"divergent-collective"}
+
+    def test_unordered_iteration_fires_sorted_quiet(self):
+        fs = run_checker("""
+            def sync(kv, grads):
+                for k, g in grads.items():
+                    kv.push(k, g)
+            def sync_ok(kv, grads):
+                for k, g in sorted(grads.items()):
+                    kv.push(k, g)
+            """, "collectives")
+        assert rules(fs) == {"unordered-collective-order"}
+        assert [f.scope for f in fs] == ["sync"]
+
+    def test_set_iteration_over_collective_fires(self):
+        fs = run_checker("""
+            from jax import lax
+            def reduce_all(xs):
+                done = set(xs)
+                for k in done:
+                    lax.psum(k, "dp")
+            """, "collectives")
+        assert rules(fs) == {"unordered-collective-order"}
+
+    def test_retry_over_collective_fires_transitively(self):
+        # the kvstore bug class this PR fixed: the retried hop reaches a
+        # collective two calls deep
+        fs = run_checker("""
+            from jax import lax
+            class KV:
+                def _hop(self, x):
+                    return self._allreduce(x)
+                def _allreduce(self, x):
+                    return lax.psum(x, "dp")
+                def push(self, x):
+                    return self._retry.call(self._hop, x)
+                def pull(self, x):
+                    return self._retry.call(self._copy, x)
+                def _copy(self, x):
+                    return x
+            """, "collectives")
+        assert rules(fs) == {"retry-over-collective"}
+        assert [(f.scope, f.symbol) for f in fs] == [("KV.push", "_hop")]
+
+    def test_fault_scope_wrapping_collective_fires(self):
+        fs = run_checker("""
+            from jax import lax
+            def drill(x, faults):
+                with faults.scope("kvstore.push:fail:1"):
+                    return lax.psum(x, "dp")
+            """, "collectives")
+        assert rules(fs) == {"retry-over-collective"}
+
+    def test_fingerprint_stable_across_line_shifts(self):
+        src = """
+            import jax
+            from jax import lax
+            def step(x):
+                if jax.process_index() == 0:
+                    return lax.psum(x, "dp")
+                return x
+            """
+        a = run_checker(src, "collectives")
+        b = run_checker("# pad\n# pad\n\n" + textwrap.dedent(src),
+                        "collectives")
+        assert a[0].fingerprint == b[0].fingerprint
+        assert a[0].line != b[0].line
+
+
+# ------------------------------------------------------------------ barriers
+class TestBarriersChecker:
+    def test_commit_before_barrier_fires(self):
+        fs = run_checker("""
+            def save_sharded(self, d, step):
+                self._write_host_files(d, step)
+                self._commit_sharded(d, step)
+                markers = self._wait_markers(d, step)
+            """, "barriers")
+        assert rules(fs) == {"commit-before-barrier"}
+
+    def test_commit_without_barrier_fires(self):
+        fs = run_checker("""
+            def save_sharded(self, d, step):
+                self._write_host_files(d, step)
+                self._commit_sharded(d, step)
+            """, "barriers")
+        assert rules(fs) == {"commit-before-barrier"}
+
+    def test_retry_wrapped_commit_before_barrier_fires(self):
+        # the in-tree pattern: commit goes through RetryPolicy.call —
+        # classification must see through the wrapper or a reordered
+        # retry-wrapped commit is invisible to the rule
+        fs = run_checker("""
+            def save_sharded(self, d, step):
+                self._retry.call(self._write_host_files, d, step)
+                self._retry.call(self._commit_sharded, d, step)
+                markers = self._wait_markers(d, step)
+            """, "barriers")
+        assert rules(fs) == {"commit-before-barrier"}
+
+    def test_retry_wrapped_proper_order_quiet(self):
+        fs = run_checker("""
+            def save_sharded(self, d, step):
+                self._retry.call(self._write_host_files, d, step)
+                markers = self._wait_markers(d, step)
+                self._retry.call(self._commit_sharded, d, step, markers)
+            """, "barriers")
+        assert fs == []
+
+    def test_proper_two_phase_order_quiet(self):
+        fs = run_checker("""
+            def save_sharded(self, d, step):
+                self._write_host_files(d, step)
+                markers = self._wait_markers(d, step)
+                self._commit_sharded(d, step, markers)
+            """, "barriers")
+        assert fs == []
+
+    def test_single_host_commit_exempt(self):
+        # no phase-1 shard/marker writes in scope: a plain single-host
+        # commit needs no barrier
+        fs = run_checker("""
+            def save(self, step, blob):
+                self._commit_step(step, blob)
+            """, "barriers")
+        assert fs == []
+
+    def test_exit_between_collectives_fires(self):
+        fs = run_checker("""
+            import sys
+            from jax import lax
+            def bad(self, x):
+                y = lax.psum(x, "dp")
+                if self.handler.triggered:
+                    sys.exit(0)
+                return lax.all_gather(y, "dp")
+            """, "barriers")
+        assert rules(fs) == {"exit-between-collectives"}
+
+    def test_exit_in_collective_loop_fires(self):
+        fs = run_checker("""
+            from jax import lax
+            def bad_loop(self, xs):
+                for x in xs:
+                    y = lax.psum(x, "dp")
+                    if self.handler.triggered:
+                        raise TrainingPreempted()
+            """, "barriers")
+        assert rules(fs) == {"exit-between-collectives"}
+        assert "back-edge" in fs[0].message
+
+    def test_nonprocess_exit_receiver_quiet(self):
+        # `.exit()` on anything but sys/os (ExitStack, pools, custom
+        # scopes) is not a process exit
+        fs = run_checker("""
+            from jax import lax
+            def f(self, x, stack):
+                y = lax.psum(x, "dp")
+                stack.exit()
+                return lax.all_gather(y, "dp")
+            """, "barriers")
+        assert fs == []
+
+    def test_bare_exit_between_collectives_fires(self):
+        fs = run_checker("""
+            from jax import lax
+            def f(self, x):
+                y = lax.psum(x, "dp")
+                if self.done:
+                    exit(1)
+                return lax.all_gather(y, "dp")
+            """, "barriers")
+        assert rules(fs) == {"exit-between-collectives"}
+
+    def test_exit_at_step_boundary_quiet(self):
+        # the SPMDTrainer.step idiom: consult the flag BEFORE the scope's
+        # first collective
+        fs = run_checker("""
+            from jax import lax
+            def step(self, x):
+                if self.handler.triggered:
+                    raise TrainingPreempted()
+                y = lax.psum(x, "dp")
+                return lax.all_gather(y, "dp")
+            """, "barriers")
+        assert fs == []
+
+    def test_fingerprint_stable_across_line_shifts(self):
+        src = """
+            def save_sharded(self, d, step):
+                self._write_host_files(d, step)
+                self._commit_sharded(d, step)
+            """
+        a = run_checker(src, "barriers")
+        b = run_checker("# pad\n\n" + textwrap.dedent(src), "barriers")
+        assert a[0].fingerprint == b[0].fingerprint
+        assert a[0].line != b[0].line
+
+
+# ------------------------------------------- locks worker-name refinement
+class TestLocksWorkerNameRefinement:
+    def test_consumer_called_worker_named_method_not_seeded(self):
+        # the ProcessDecodePool._check_workers false positive this PR
+        # killed from the baseline: a worker-NAMED method only ever
+        # invoked as self.name() runs on the caller's thread
+        fs = run_checker("""
+            import threading
+            class Pool:
+                def start(self):
+                    self._th = threading.Thread(target=self._loop)
+                def _loop(self):
+                    pass
+                def _check_workers(self):
+                    self._sticky = RuntimeError("dead")
+                def next_batch(self):
+                    self._check_workers()
+                    self._sticky = None
+            """, "locks")
+        assert fs == []
+
+    def test_never_called_worker_named_method_still_seeds(self):
+        fs = run_checker("""
+            import threading
+            class Pool:
+                def decode_worker(self):
+                    self.count += 1
+                def poll(self):
+                    self.count = 0
+            """, "locks")
+        assert rules(fs) == {"unlocked-shared-mutation"}
+
+    def test_spawned_and_called_method_still_seeds(self):
+        # target= detection wins over the called-via-self exemption
+        fs = run_checker("""
+            import threading
+            class Pool:
+                def start(self):
+                    self._th = threading.Thread(target=self._worker_loop)
+                def kick(self):
+                    self._worker_loop()
+                def _worker_loop(self):
+                    self.count += 1
+                def poll(self):
+                    self.count = 0
+            """, "locks")
+        assert rules(fs) == {"unlocked-shared-mutation"}
+
+
 # ------------------------------------------------- fingerprints and baseline
 class TestBaseline:
     SRC = """
@@ -379,6 +731,38 @@ class TestWholeTree:
             out = fn(w, g)
             return out + w.sum()
         """
+
+    def test_cli_github_format(self, capsys):
+        src = textwrap.dedent(self.SRC_BAD)
+        with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                         delete=False) as f:
+            f.write(src)
+        try:
+            rc = analysis.main(["--root", f.name, "--format", "github"])
+        finally:
+            os.unlink(f.name)
+        out = capsys.readouterr().out
+        assert rc == 1
+        ann = [ln for ln in out.splitlines() if ln.startswith("::error")]
+        assert len(ann) == 1
+        assert "file=" in ann[0] and "line=" in ann[0]
+        assert "title=donation/use-after-donate" in ann[0]
+
+    def test_cli_text_format_byte_stable_fields(self, capsys):
+        # the text format is what the baseline workflow diffs: one NEW/base
+        # mark, fingerprint, checker/rule, location per finding
+        src = textwrap.dedent(self.SRC_BAD)
+        with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                         delete=False) as f:
+            f.write(src)
+        try:
+            rc = analysis.main(["--root", f.name])
+        finally:
+            os.unlink(f.name)
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert out.splitlines()[0].startswith("NEW  [")
+        assert out.splitlines()[-1].startswith("analysis: 1 findings")
 
 
 # ----------------------------------------------------------------- sanitizer

@@ -1,0 +1,400 @@
+"""Runtime collective sanitizer (ISSUE 10): per-host fingerprint streams,
+cross-checks, the watchdog, and the two-simulated-host drills.
+
+The unit half fakes a peer by writing its stream file directly; the drill
+half spawns two real subprocesses under ``MXNET_SANITIZE=collectives`` +
+``MXNET_CKPT_HOST`` (the PR 9 harness) and asserts a planted divergence
+raises :class:`CollectiveDivergenceError` naming BOTH hosts' next-op
+fingerprints instead of hanging in the commit barrier.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.analysis import divergence as div
+from mxnet_tpu.analysis import sanitizer as san
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "divergence_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_sanitizer():
+    yield
+    san.disable()
+    san.reset()          # also resets the divergence stream/identity
+
+
+def _peer_log(d, host):
+    return os.path.join(d, f"collectives-{host}.log")
+
+
+# ------------------------------------------------------------------ recording
+class TestRecording:
+    def test_fingerprint_fields_and_seq(self):
+        with san.scope("collectives"):
+            s0 = div.record("trainer.step", axis="dp", shape=(16, 8),
+                            dtype="float32", site="here")
+            s1 = div.record("kvstore.barrier")
+        assert (s0, s1) == (0, 1)
+        lines = div.stream()
+        assert lines[0] == \
+            "0|trainer.step|axis=dp|shape=16x8|dtype=float32 @ here"
+        assert lines[1] == "1|kvstore.barrier|axis=-|shape=-|dtype=-"
+
+    def test_detail_rides_in_fingerprint(self):
+        div.record("kvstore.allreduce", shape=(4,), dtype="float32",
+                   detail="key=w0")
+        assert "|key=w0" in div.stream()[0]
+
+    def test_sites_are_not_compared(self, tmp_path):
+        # same op issued from differently-named call sites must NOT be a
+        # divergence: the fp (before " @ ") is the contract, the site is
+        # for the human reading the error
+        d = str(tmp_path)
+        div.configure(directory=d, host=0, host_count=2)
+        div.record("trainer.step", axis="dp", shape=(4,), dtype="f32",
+                   site="host0 spelling")
+        with open(_peer_log(d, 1), "w") as f:
+            f.write("0|trainer.step|axis=dp|shape=4|dtype=f32 "
+                    "@ host1 spelling\n")
+        assert div.check("t") == {0: 1, 1: 1}
+
+    def test_idle_sites_record_nothing(self):
+        # sanitizer not armed: the SPMDTrainer hook must not record
+        from mxnet_tpu.parallel import (FunctionalOptimizer, SPMDTrainer,
+                                        make_mesh)
+        net = mx.gluon.nn.Dense(4, in_units=8)
+        net.initialize()
+        tr = SPMDTrainer(net, mx.gluon.loss.L2Loss(),
+                         FunctionalOptimizer("sgd", 1e-2),
+                         make_mesh(n_devices=1, dp=1))
+        tr.step(np.random.rand(4, 8).astype("float32"),
+                np.random.rand(4, 4).astype("float32"))
+        assert div.stream() == []
+
+    def test_clean_spmd_steps_zero_violations(self):
+        from mxnet_tpu.parallel import (FunctionalOptimizer, SPMDTrainer,
+                                        make_mesh)
+        net = mx.gluon.nn.Dense(4, in_units=8)
+        net.initialize()
+        tr = SPMDTrainer(net, mx.gluon.loss.L2Loss(),
+                         FunctionalOptimizer("sgd", 1e-2),
+                         make_mesh(n_devices=1, dp=1))
+        x = np.random.rand(4, 8).astype("float32")
+        y = np.random.rand(4, 4).astype("float32")
+        with san.scope("collectives"):
+            for _ in range(3):
+                tr.step(x, y)
+        assert san.stats()["collectives"] == 3
+        assert san.stats()["violations"] == 0
+        fps = [ln.split(" @ ")[0].split("|", 1)[1] for ln in div.stream()]
+        assert len(set(fps)) == 1, "same step must fingerprint identically"
+
+    def test_pipeline_and_moe_sites_record(self):
+        import jax.numpy as jnp
+        from mxnet_tpu.parallel import (device_mesh, make_mesh, moe_layer,
+                                        pipeline)
+        with san.scope("collectives"):
+            mesh = make_mesh(n_devices=8, pp=8)
+            pipeline.gpipe(lambda p, xx: xx * p.sum(), jnp.ones((8, 4)),
+                           jnp.ones((16, 4)), mesh, 4)
+            mesh_ep = device_mesh({"dp": 2, "ep": 4})
+            moe_layer(lambda p, t: t @ p, jnp.ones((6, 4)),
+                      jnp.ones((4, 6, 6)), jnp.ones((16, 6)), mesh_ep,
+                      capacity_factor=8.0)
+        kinds = [ln.split("|")[1] for ln in div.stream()]
+        assert kinds == ["pipeline.gpipe", "moe.all_to_all"]
+
+    def test_kvstore_barrier_records(self):
+        kv = mx.kv.create("local")
+        with san.scope("collectives"):
+            kv.barrier()
+        assert [ln.split("|")[1] for ln in div.stream()] == \
+            ["kvstore.barrier"]
+
+
+# ---------------------------------------------------------------- cross-check
+class TestCrossCheck:
+    def test_single_host_check_is_noop(self):
+        div.record("trainer.step")
+        assert div.check("t") == {0: 1}
+
+    def test_peer_mismatch_raises_naming_both(self, tmp_path):
+        d = str(tmp_path)
+        div.configure(directory=d, host=0, host_count=2)
+        div.record("trainer.step", axis="dp", shape=(16, 8), dtype="f32",
+                   site="SPMDTrainer.step t=0")
+        div.record("kvstore.barrier", site="KVStore.barrier")
+        with open(_peer_log(d, 1), "w") as f:
+            f.write("0|trainer.step|axis=dp|shape=16x8|dtype=f32 @ s\n"
+                    "1|moe.all_to_all|axis=ep|shape=16x8|dtype=f32 @ m\n")
+        with pytest.raises(san.CollectiveDivergenceError) as ei:
+            div.check("drill")
+        msg = str(ei.value)
+        assert "1|kvstore.barrier|axis=-|shape=-|dtype=-" in msg
+        assert "1|moe.all_to_all|axis=ep|shape=16x8|dtype=f32" in msg
+        assert "host 0" in msg and "host 1" in msg
+        assert ei.value.index == 1
+        assert san.stats()["violations"] == 1
+
+    def test_shorter_peer_prefix_is_clean(self, tmp_path):
+        d = str(tmp_path)
+        div.configure(directory=d, host=0, host_count=2)
+        div.record("trainer.step", shape=(4,))
+        div.record("trainer.step", shape=(4,))
+        with open(_peer_log(d, 1), "w") as f:
+            f.write("0|trainer.step|axis=-|shape=4|dtype=-\n")
+        assert div.check("t")[1] == 1      # behind, but not divergent
+
+    def test_sync_waits_for_peer(self, tmp_path):
+        d = str(tmp_path)
+        div.configure(directory=d, host=0, host_count=2)
+        div.record("trainer.step", shape=(4,))
+        import threading
+
+        def _late_peer():
+            with open(_peer_log(d, 1), "w") as f:
+                f.write("0|trainer.step|axis=-|shape=4|dtype=-\n")
+        t = threading.Timer(0.2, _late_peer)
+        t.start()
+        try:
+            lengths = div.sync("t", timeout_s=10)
+        finally:
+            t.join()
+        assert lengths == {0: 1, 1: 1}
+
+    def test_sync_stall_dumps_every_position(self, tmp_path):
+        d = str(tmp_path)
+        div.configure(directory=d, host=0, host_count=2)
+        div.record("trainer.step", axis="dp", shape=(4,), dtype="f32",
+                   site="s")
+        with pytest.raises(san.CollectiveStallTimeout) as ei:
+            div.sync("stall-drill", timeout_s=0.3)
+        msg = str(ei.value)
+        assert "host 0: 1 collectives" in msg
+        assert "host 1: 0 collectives" in msg
+        assert ei.value.behind == [1]
+
+    def test_commit_barrier_raises_divergence_not_timeout(self, tmp_path):
+        # the checkpoint wiring: host 0's marker poll cross-checks the
+        # streams, so a diverged co-writer surfaces as the attributed
+        # error, not as CommitBarrierTimeout
+        from mxnet_tpu.parallel import (FunctionalOptimizer,
+                                        SPMDCheckpointManager, SPMDTrainer,
+                                        make_mesh)
+        net = mx.gluon.nn.Dense(4, in_units=8)
+        net.initialize()
+        tr = SPMDTrainer(net, mx.gluon.loss.L2Loss(),
+                         FunctionalOptimizer("sgd", 1e-2),
+                         make_mesh(n_devices=1, dp=1))
+        tr.step(np.random.rand(4, 8).astype("float32"),
+                np.random.rand(4, 4).astype("float32"))
+        d = str(tmp_path)
+        ckpt = os.path.join(d, "ckpt")
+        with san.scope("collectives"):
+            div.configure(directory=d, host=0, host_count=2)
+            div.record("trainer.step", axis="dp", shape=(4, 8),
+                       dtype="float32", site="t=0")
+            with open(_peer_log(d, 1), "w") as f:
+                f.write("0|pipeline.gpipe|axis=pp|shape=16x4|dtype=f32 "
+                        "@ planted\n")
+            mgr = SPMDCheckpointManager(ckpt, host_index=0, host_count=2,
+                                        barrier_timeout_s=30.0)
+            with pytest.raises(san.CollectiveDivergenceError) as ei:
+                mgr.save(1, tr)
+        assert "pipeline.gpipe" in str(ei.value)
+
+
+# -------------------------------------------------------------- config/env
+class TestConfig:
+    def test_env_mode_spelling(self):
+        assert san._parse("collectives") == {"collectives"}
+        assert san._parse("donation,collectives") == \
+            {"donation", "collectives"}
+
+    def test_host_identity_env(self, monkeypatch):
+        monkeypatch.setenv("MXNET_CKPT_HOST", "1/4")
+        assert div.host_identity() == (1, 4)
+        monkeypatch.delenv("MXNET_CKPT_HOST")
+        div.configure(host=2, host_count=3)
+        assert div.host_identity() == (2, 3)
+
+    def test_no_directory_stays_in_memory(self):
+        div.configure(host=0, host_count=2)
+        div.record("trainer.step")
+        assert div.check("t") == {0: 1}    # no files, no peers to read
+
+    def test_host_pin_without_count_is_honored(self, monkeypatch):
+        # configure(host=) alone must pin the host component while the
+        # count still resolves from the env/jax fallback chain
+        monkeypatch.setenv("MXNET_CKPT_HOST", "0/4")
+        div.configure(host=2)
+        assert div.host_identity() == (2, 4)
+
+    def test_single_host_past_stream_cap_never_raises(self, monkeypatch):
+        # a single-process run longer than the in-memory cap must keep
+        # sync()/check() as no-ops, not error out
+        monkeypatch.setattr(div, "_STREAM_CAP", 8)
+        for _ in range(20):
+            div.record("trainer.step", shape=(4,))
+        assert div.total_recorded() == 20
+        assert len(div.stream()) == 8
+        assert div.check("t") == {0: 20}
+        assert div.sync("t", timeout_s=1) == {0: 20}
+
+    def test_incremental_cursor_catches_late_divergence(self, tmp_path):
+        # verified prefixes are consumed incrementally; a mismatch
+        # appended AFTER several clean checks must still raise at the
+        # right absolute index
+        d = str(tmp_path)
+        div.configure(directory=d, host=0, host_count=2)
+        div.record("trainer.step", shape=(4,))
+        with open(_peer_log(d, 1), "a") as f:
+            f.write("0|trainer.step|axis=-|shape=4|dtype=-\n")
+        assert div.check("t") == {0: 1, 1: 1}
+        assert div.check("t") == {0: 1, 1: 1}     # idempotent re-check
+        div.record("trainer.step", shape=(4,))
+        with open(_peer_log(d, 1), "a") as f:
+            f.write("1|moe.all_to_all|axis=ep|shape=4|dtype=-\n")
+        with pytest.raises(san.CollectiveDivergenceError) as ei:
+            div.check("t")
+        assert ei.value.index == 1
+
+    def test_caught_divergence_reraises_same_index(self, tmp_path):
+        # a caller that absorbs the error (e.g. an absorbed-save-failure
+        # path) and re-checks must see the SAME first divergence, not a
+        # shifted one — the diverging line stays pending
+        d = str(tmp_path)
+        div.configure(directory=d, host=0, host_count=2)
+        div.record("trainer.step", shape=(4,))
+        div.record("kvstore.barrier")
+        with open(_peer_log(d, 1), "w") as f:
+            f.write("0|trainer.step|axis=-|shape=4|dtype=-\n"
+                    "1|moe.all_to_all|axis=ep|shape=4|dtype=-\n")
+        for _ in range(2):
+            with pytest.raises(san.CollectiveDivergenceError) as ei:
+                div.check("t")
+            assert ei.value.index == 1
+            assert "moe.all_to_all" in str(ei.value)
+
+    def test_configure_new_directory_resets_cursors(self, tmp_path):
+        # byte offsets from a previous drill's directory must not be
+        # applied to a new one (they would skip the new stream's prefix)
+        d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+        os.makedirs(d1), os.makedirs(d2)
+        div.configure(directory=d1, host=0, host_count=2)
+        div.record("trainer.step", shape=(4,))
+        with open(_peer_log(d1, 1), "w") as f:
+            f.write("0|trainer.step|axis=-|shape=4|dtype=-\n")
+        assert div.check("t")[1] == 1
+        div.configure(directory=d2)
+        with open(_peer_log(d2, 1), "w") as f:
+            f.write("0|pipeline.gpipe|axis=pp|shape=4|dtype=-\n")
+        with pytest.raises(san.CollectiveDivergenceError) as ei:
+            div.check("t")
+        assert ei.value.index == 0
+
+    def test_cap_truncated_own_prefix_still_compared(self, tmp_path,
+                                                     monkeypatch):
+        # own lines scrolled off the in-memory cap are backed by the
+        # on-disk own stream — a divergence in that prefix must not be
+        # silently consumed
+        monkeypatch.setattr(div, "_STREAM_CAP", 4)
+        d = str(tmp_path)
+        div.configure(directory=d, host=0, host_count=2)
+        for _ in range(10):
+            div.record("trainer.step", shape=(4,))
+        assert len(div.stream()) == 4         # memory holds only the tail
+        with open(_peer_log(d, 1), "w") as f:
+            f.write("0|trainer.step|axis=-|shape=4|dtype=-\n"
+                    "1|moe.all_to_all|axis=ep|shape=4|dtype=-\n")
+        with pytest.raises(san.CollectiveDivergenceError) as ei:
+            div.check("t")
+        assert ei.value.index == 1            # deep inside the dropped prefix
+
+    def test_own_disk_fallback_aligns_by_base_seq(self, tmp_path,
+                                                  monkeypatch):
+        # the own stream file starts at whatever seq the directory was
+        # armed at: pre-arming records live nowhere durable, so their
+        # indices are counted unverified (never a bogus divergence), and
+        # post-arming indices must align by the file's base seq
+        monkeypatch.setattr(div, "_STREAM_CAP", 2)
+        d = str(tmp_path)
+        div.configure(host=0, host_count=2)      # no directory yet
+        div.record("trainer.step", shape=(1,))   # seq 0: memory-only
+        div.record("trainer.step", shape=(2,))   # seq 1: memory-only
+        div.configure(directory=d)
+        for n in range(3, 7):
+            div.record("trainer.step", shape=(n,))   # seqs 2..5 on disk
+        assert len(div.stream()) == 2            # memory kept only a tail
+        # peer agrees on everything it can prove, diverges at seq 3 —
+        # which memory dropped but the own file still has, base-aligned
+        with open(_peer_log(d, 1), "w") as f:
+            f.write("0|trainer.step|axis=-|shape=1|dtype=-\n"
+                    "1|trainer.step|axis=-|shape=2|dtype=-\n"
+                    "2|trainer.step|axis=-|shape=3|dtype=-\n"
+                    "3|moe.all_to_all|axis=ep|shape=4|dtype=-\n")
+        with pytest.raises(san.CollectiveDivergenceError) as ei:
+            div.check("t")
+        assert ei.value.index == 3
+        # seqs 0-1 had no durable evidence: counted, not silently passed
+        assert div.unverified_count() == 2
+
+    def test_torn_tail_line_not_compared(self, tmp_path):
+        # a peer caught mid-append (no trailing newline) must be re-read
+        # on the next check, never compared half-written
+        d = str(tmp_path)
+        div.configure(directory=d, host=0, host_count=2)
+        div.record("trainer.step", shape=(4,))
+        with open(_peer_log(d, 1), "w") as f:
+            f.write("0|trainer.step|axis=-|sha")       # torn
+        assert div.check("t").get(1, 0) == 0
+        with open(_peer_log(d, 1), "w") as f:
+            f.write("0|trainer.step|axis=-|shape=4|dtype=-\n")
+        assert div.check("t")[1] == 1
+
+
+# ------------------------------------------------------------------- drills
+def _spawn(dirpath, host, extra=()):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.pop("MXNET_SANITIZE", None)
+    env.pop("MXNET_CKPT_HOST", None)
+    return subprocess.Popen(
+        [sys.executable, WORKER, "--dir", dirpath, "--host", host,
+         "--steps", "3", "--timeout", "60", *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+
+
+class TestTwoHostDrill:
+    def test_clean_run_zero_violations(self, tmp_path):
+        d = str(tmp_path)
+        procs = [_spawn(d, "0/2"), _spawn(d, "1/2")]
+        outs = [p.communicate(timeout=300)[0] for p in procs]
+        assert [p.returncode for p in procs] == [0, 0], outs
+        assert all("violations=0" in o for o in outs), outs
+        # the sharded save committed: the drill is a real 2-host step +
+        # checkpoint, not just a stream echo
+        from mxnet_tpu.parallel import SPMDCheckpointManager
+        assert SPMDCheckpointManager(d).latest_step() == 3
+
+    def test_planted_divergence_raises_both_hosts_named(self, tmp_path):
+        d = str(tmp_path)
+        procs = [_spawn(d, "0/2"),
+                 _spawn(d, "1/2", extra=("--diverge-at", "2"))]
+        outs = [p.communicate(timeout=300)[0] for p in procs]
+        # no hang: both processes exit with the divergence code, and the
+        # error names BOTH hosts' next-op fingerprints
+        assert [p.returncode for p in procs] == [3, 3], outs
+        for o in outs:
+            assert "CollectiveDivergenceError" in o or "DIVERGENCE" in o, o
+            assert "trainer.step" in o and "pipeline.gpipe" in o, o
+            assert "host 0" in o and "host 1" in o, o
+        # nothing committed for the diverged step
+        from mxnet_tpu.parallel import SPMDCheckpointManager
+        assert SPMDCheckpointManager(d).latest_step() is None
