@@ -41,6 +41,11 @@ BASELINE.md).  All other configs are nested under ``"extra"``:
   vs per-request baseline — 64 concurrent single-item requests, p50/p99
   latency + throughput + padding-waste ratio + steady-state compile
   misses (must be 0)
+- ``decode``: generative decode serving (``mxnet_tpu.serving.decode``) —
+  tokens/sec and time-to-first-token at mixed prompt lengths, continuous
+  vs static batching over the same warmed runtime and paged KV cache,
+  per-mode KV peak occupancy, steady-state ``decode.compile_miss`` (must
+  be 0) and cross-mode token-stream parity (must be identical)
 - ``resilience``: durable-checkpoint save/restore latency, the step-path
   cost of an async save vs the sync serialize+IO bill (the >=80% offload
   contract), recovery time after a mid-save kill (restore + first step of
@@ -52,7 +57,7 @@ BASELINE.md).  All other configs are nested under ``"extra"``:
   (must be 0)
 
 Select a subset with
-BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,int8,io,e2e,eager,engine,optimizer,serving,resilience.
+BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,int8,io,e2e,eager,engine,optimizer,serving,decode,resilience.
 The full json carries a ``telemetry`` sub-dict (recompile count,
 collective bytes, io wait ms — disable with BENCH_TELEMETRY=0) so each
 BENCH record carries its own diagnosis.
@@ -1140,6 +1145,111 @@ def bench_serving():
     }
 
 
+def bench_decode():
+    """Generative decode serving (``mxnet_tpu.serving.decode``): tokens/sec
+    and time-to-first-token at mixed prompt lengths, **continuous vs
+    static batching** over the SAME warmed runtime and KV cache.
+
+    Static batching submits gang-sized waves and waits for the whole gang
+    before the next wave — the batch shrinks as its stragglers finish and
+    admits nobody, so the device runs under-occupied exactly when prompt
+    lengths and token budgets are mixed.  Continuous batching submits the
+    same request set up front; arrivals join the running batch at step
+    boundaries and finished sequences free their KV slots immediately.
+    Same model, same compiled programs, same per-request token streams
+    (the row-stable bitwise contract) — the speedup is pure scheduling.
+    Also reports KV-cache peak occupancy per mode and steady-state
+    ``decode.compile_miss`` (must be 0)."""
+    import time as _time
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving.decode import DecodeSession, get_decode_model
+
+    n_requests = int(os.environ.get("BENCH_DECODE_REQUESTS", "32"))
+    model_name = os.environ.get("BENCH_DECODE_MODEL", "decode_small")
+    gang = 8
+    net = get_decode_model(model_name, vocab_size=512, max_length=64)
+    net.initialize()
+
+    was_on = telemetry.is_enabled()
+    telemetry.enable()
+    sess = DecodeSession(net, batch_buckets=(1, 2, 4, gang),
+                         seq_buckets=(16, 32), page_size=8,
+                         queue_depth=4 * n_requests)
+    rng = np.random.RandomState(0)
+    reqs = [dict(prompt=list(rng.randint(1, 512, 3 + (i * 7) % 28)),
+                 max_new_tokens=8 + (i * 5) % 17,
+                 temperature=0.8 * (i % 2), seed=i)
+            for i in range(n_requests)]
+
+    def continuous_round():
+        t0 = _time.perf_counter()
+        futs = [sess.submit(**r) for r in reqs]
+        res = [f.result(timeout=600) for f in futs]
+        return _time.perf_counter() - t0, res
+
+    def static_round():
+        t0 = _time.perf_counter()
+        res = []
+        for g in range(0, n_requests, gang):
+            futs = [sess.submit(**r) for r in reqs[g:g + gang]]
+            res.extend(f.result(timeout=600) for f in futs)
+        return _time.perf_counter() - t0, res
+
+    def summarize(wall, res):
+        toks = sum(len(r.token_ids) for r in res)
+        ttfts = sorted(r.ttft_ms for r in res)
+        return {
+            "tokens_per_sec": round(toks / wall, 1),
+            "wall_s": round(wall, 3),
+            "tokens": toks,
+            "ttft_ms_p50": round(ttfts[len(ttfts) // 2], 1),
+            "ttft_ms_p99": round(
+                ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))], 1),
+            "kv_peak_pages": sess.cache.peak_pages,
+            "kv_peak_occupancy": round(
+                sess.cache.peak_pages / sess.cache.usable_pages, 3),
+        }
+
+    try:
+        continuous_round()                             # warm every bucket
+        misses0 = telemetry.counter_value("decode.compile_miss")
+        joins0 = telemetry.counter_value("decode.joins")
+        sess.cache.reset_peak()
+        wall_c, res_c = continuous_round()
+        cont = summarize(wall_c, res_c)
+        joins = telemetry.counter_value("decode.joins") - joins0
+        sess.cache.reset_peak()
+        wall_s, res_s = static_round()
+        stat = summarize(wall_s, res_s)
+        misses = telemetry.counter_value("decode.compile_miss") - misses0
+        # the scheduling modes must hand back identical token streams —
+        # the bitwise contract is what makes this comparison honest
+        parity = all(a.token_ids == b.token_ids
+                     for a, b in zip(res_c, res_s))
+    finally:
+        sess.close(drain=False)
+        if not was_on:
+            telemetry.disable()
+    return {
+        "n_requests": n_requests,
+        "model": model_name,
+        "gang_size": gang,
+        "prompt_lens": "3..30 mixed",
+        "max_new_tokens": "8..24 mixed",
+        "batch_buckets": list(sess.runtime.batch_buckets),
+        "seq_buckets": list(sess.runtime.seq_buckets),
+        "page_size": sess.cache.page_size,
+        "continuous": cont,
+        "static": stat,
+        "speedup_continuous_vs_static": round(
+            cont["tokens_per_sec"] / stat["tokens_per_sec"], 2),
+        "joins_mid_flight": joins,
+        "steady_state_compile_misses": misses,
+        "token_streams_identical_across_modes": parity,
+        "kv_pages_leaked": sess.cache.pages_in_use,
+    }
+
+
 def bench_resilience():
     """Fault-tolerance latency numbers (``mxnet_tpu.resilience``): what a
     durable checkpoint costs on cadence (atomic tmp+rename commit with a
@@ -1438,6 +1548,15 @@ def _telemetry_summary():
         "serving_queue_wait_ms": round(
             c.get("serving.queue_wait_ms", 0.0), 1),
         "serving_worker_restarts": c.get("serving.worker_restart", 0),
+        "decode_tokens": c.get("decode.tokens", 0),
+        "decode_steps": c.get("decode.steps", 0),
+        "decode_prefills": c.get("decode.prefills", 0),
+        "decode_joins": c.get("decode.joins", 0),
+        "decode_evictions": c.get("decode.evictions", 0),
+        "decode_compile_misses": c.get("decode.compile_miss", 0),
+        "decode_ttft_ms": round(c.get("decode.ttft_ms", 0.0), 1),
+        "decode_rejections": c.get("decode.rejections", 0),
+        "decode_kv_occupancy": g.get("decode.kv_occupancy", 0),
         "resilience_faults_injected": c.get("resilience.fault_injected", 0),
         "resilience_retries": c.get("resilience.retry", 0),
         "resilience_give_ups": c.get("resilience.give_up", 0),
@@ -1454,7 +1573,7 @@ def main():
     sel = [s.strip() for s in
            os.environ.get("BENCH_CONFIGS",
                           "headline,infer,fp32,amp,bert,ssd,int8,io,e2e,"
-                          "eager,engine,optimizer,serving,resilience"
+                          "eager,engine,optimizer,serving,decode,resilience"
                           ).split(",")]
     extra = {}
 
@@ -1554,6 +1673,11 @@ def main():
             extra["serving_dynamic_batching"] = bench_serving()
         except Exception as e:           # pragma: no cover
             extra["serving_dynamic_batching"] = {"error": repr(e)}
+    if "decode" in sel:
+        try:
+            extra["decode_serving"] = bench_decode()
+        except Exception as e:           # pragma: no cover
+            extra["decode_serving"] = {"error": repr(e)}
     if "resilience" in sel:
         try:
             extra["resilience"] = bench_resilience()

@@ -16,6 +16,13 @@
 #                plus a short serving bench sanity check (>=3x batched
 #                throughput, zero steady-state compile misses, deadline
 #                rejection on a full queue)
+#   decode     - generative decode serving smoke: test_decode.py, then a
+#                continuous-batching drill — 32 concurrent generate()
+#                calls with staggered arrivals and mixed prompt lengths
+#                under MXNET_SANITIZE=donation,slots must finish with
+#                zero steady-state decode.compile_miss, zero leaked KV
+#                slots/pages after drain, >=1 mid-flight join, and zero
+#                sanitizer violations
 #   resilience - fault-tolerance smoke: test_resilience.py +
 #                test_pod_checkpoint.py (sharded co-writer saves, async,
 #                elastic resume), plus a 20-step train loop under
@@ -51,7 +58,8 @@
 #                naming both hosts' next-op fingerprints (bounded by the
 #                watchdog, never a hang)
 # Usage: ci/run.sh [stage ...]   (default: unit gate telemetry optimizer
-#                                 serving resilience engine io analyze)
+#                                 serving decode resilience engine io
+#                                 analyze)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -223,6 +231,61 @@ print("serving bench ok:", r["per_request"]["req_per_sec"], "->",
       f"({r['speedup_vs_per_request']}x),",
       f"p99 {r['batched']['latency_ms_p99']}ms,",
       f"padding waste {r['padding_waste_ratio']:.1%}")
+PY
+}
+
+stage_decode() {
+  JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py -q
+  JAX_PLATFORMS=cpu MXNET_SANITIZE=donation,slots MXNET_TELEMETRY=1 \
+      python - <<'PY'
+import threading
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.analysis import sanitizer
+from mxnet_tpu.serving.decode import DecodeSession, get_decode_model
+
+assert sanitizer.donation and sanitizer.slots, \
+    "MXNET_SANITIZE env spec must arm the sanitizer at import"
+assert telemetry.is_enabled()
+
+net = get_decode_model("decode_tiny", vocab_size=256, max_length=64)
+net.initialize()
+sess = DecodeSession(net, batch_buckets=(1, 2, 4, 8), seq_buckets=(16, 32),
+                     page_size=8, queue_depth=256)
+telemetry.reset()          # miss accounting starts after warmup
+
+rng = np.random.RandomState(0)
+reqs = [dict(prompt=list(rng.randint(1, 256, 3 + (i * 7) % 28)),
+             max_new_tokens=6 + (i * 5) % 12,
+             temperature=0.8 * (i % 2), seed=i) for i in range(32)]
+futs = []
+
+def feed():
+    for i, r in enumerate(reqs):
+        futs.append(sess.submit(**r))
+        time.sleep(0.002 * (i % 3))       # staggered arrivals
+
+t = threading.Thread(target=feed)
+t.start()
+t.join()
+res = [f.result(timeout=300) for f in futs]
+sess.close(drain=True)
+
+snap = telemetry.snapshot()["counters"]
+assert all(len(r.token_ids) >= 1 for r in res)
+assert not snap.get("decode.compile_miss"), \
+    f"steady-state decode recompiles: {snap.get('decode.compile_miss')}"
+assert snap.get("decode.joins", 0) >= 1, "no mid-flight joins — not continuous"
+assert sess.cache.pages_in_use == 0, "leaked KV pages after drain"
+assert sess.cache.slots_in_use == 0, "leaked KV slots after drain"
+assert sanitizer.stats()["violations"] == 0, sanitizer.stats()
+print("decode smoke ok:", len(res), "generate() calls,",
+      snap["decode.tokens"], "tokens,", snap["decode.steps"], "steps,",
+      snap.get("decode.joins"), "joins, 0 misses, 0 leaks, sanitizer clean")
 PY
 }
 
@@ -532,8 +595,8 @@ PY
 }
 
 stages=("$@")
-[ $# -eq 0 ] && stages=(unit gate telemetry optimizer serving resilience
-                        engine io analyze)
+[ $# -eq 0 ] && stages=(unit gate telemetry optimizer serving decode
+                        resilience engine io analyze)
 for s in "${stages[@]}"; do
   echo "=== ci stage: $s ==="
   "stage_$s"

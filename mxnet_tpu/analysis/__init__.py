@@ -41,6 +41,7 @@ from .sanitizer import (  # noqa: F401
     CollectiveStallTimeout,
     DonatedBufferError,
     SanitizerError,
+    StaleKVSlotError,
     StaleSlotError,
 )
 
@@ -48,4 +49,5 @@ __all__ = ["core", "donation", "capture", "recompile", "locks",
            "collectives", "barriers", "sanitizer", "divergence",
            "main", "run_checkers", "load_baseline", "CHECKERS", "Finding",
            "SanitizerError", "DonatedBufferError", "StaleSlotError",
+           "StaleKVSlotError",
            "CollectiveDivergenceError", "CollectiveStallTimeout"]

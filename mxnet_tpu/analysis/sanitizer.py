@@ -17,6 +17,13 @@ attributed errors:
   ring bumps the generation on ``release``.  A read through a stale-
   generation buffer raises :class:`StaleSlotError` naming the slot and
   registration site — instead of returning another batch's pixels.
+  The same discipline covers the serving-decode **paged KV cache**: a
+  sequence's :class:`~mxnet_tpu.serving.decode.KVSlot` is registered at
+  allocation (:func:`register_kv_slot`) and every decode-step read checks
+  the handle's generation stamp (:func:`check_kv_slot`) — a step driven
+  through a freed slot raises :class:`StaleKVSlotError` naming the slot
+  and its allocation site, instead of silently attending over another
+  request's context.
 - **collectives** — every collective call site (SPMD steps, pipeline/moe
   schedules, the kvstore dist hop, the checkpoint commit barrier) records
   a per-host fingerprint stream; streams are cross-checked at sync points
@@ -47,10 +54,12 @@ from collections import OrderedDict
 from ..telemetry import bus as _tel
 
 __all__ = ["SanitizerError", "DonatedBufferError", "StaleSlotError",
-           "CollectiveDivergenceError", "CollectiveStallTimeout",
+           "StaleKVSlotError", "CollectiveDivergenceError",
+           "CollectiveStallTimeout",
            "enable", "disable", "configure", "scope", "modes", "active",
            "donation", "slots", "collectives", "poison",
-           "register_slot_view", "check_buffer", "stats", "reset"]
+           "register_slot_view", "register_kv_slot", "check_kv_slot",
+           "check_buffer", "stats", "reset"]
 
 MODES = ("donation", "slots", "collectives")
 
@@ -65,9 +74,11 @@ collectives = False
 _lock = threading.Lock()
 _POISON_CAP = 8192
 _SLOT_CAP = 1024
+_KV_CAP = 4096
 _poisoned = OrderedDict()     # id(buf) -> (site, shell)
 _slot_views = OrderedDict()   # id(buf) -> (ring, slot_id, generation,
 #                                           site, shell)
+_kv_slots = OrderedDict()     # (id(cache), slot_id) -> site
 _violations = 0
 
 
@@ -96,6 +107,23 @@ class StaleSlotError(SanitizerError):
             f"released back to the ring and may hold another batch's "
             f"data.  Consume zero_copy_batches=True data before the next "
             f"next()/reset(), or drop zero_copy_batches "
+            f"(MXNET_SANITIZE=slots)")
+        self.site = site
+        self.slot_id = slot_id
+
+
+class StaleKVSlotError(StaleSlotError):
+    """A decode step read a paged-KV slot after it was freed."""
+
+    def __init__(self, site, slot_id):
+        # bypass StaleSlotError.__init__ (shm-ring wording); keep its type
+        # so existing "slots-family violation" handlers catch both
+        SanitizerError.__init__(
+            self,
+            f"stale KV-slot read: slot {slot_id} (allocated at {site}) was "
+            f"freed back to the paged KV cache and its pages may hold "
+            f"another sequence's context.  Stop stepping a sequence after "
+            f"freeing its slot — evict at the step boundary that frees it "
             f"(MXNET_SANITIZE=slots)")
         self.site = site
         self.slot_id = slot_id
@@ -213,6 +241,7 @@ def reset():
     with _lock:
         _poisoned.clear()
         _slot_views.clear()
+        _kv_slots.clear()
         _violations = 0
     from . import divergence
     divergence.reset()
@@ -245,7 +274,8 @@ def stats():
     n_coll = divergence.total_recorded()
     with _lock:
         return {"poisoned": len(_poisoned), "slot_views": len(_slot_views),
-                "collectives": n_coll, "violations": _violations}
+                "kv_slots": len(_kv_slots), "collectives": n_coll,
+                "violations": _violations}
 
 
 # ----------------------------------------------------------------- registry
@@ -280,6 +310,39 @@ def register_slot_view(buf, ring, slot_id, site):
             _slot_views.popitem(last=False)
     if _tel.enabled:
         _tel.count("analysis.sanitizer_slot_views")
+
+
+def register_kv_slot(cache, slot_id, site):
+    """Record a paged-KV slot allocation so a post-free read can name its
+    site.  Unlike :func:`register_slot_view` (which tracks *buffers*), the
+    stale check here compares a :class:`KVSlot` handle's generation stamp
+    against the cache — see :func:`check_kv_slot`.  Only the site label is
+    kept: holding the cache itself would pin its device-resident page
+    pools long after the owning session closed.  (If the cache dies and a
+    new one reuses its ``id()``, the worst case is a stale site label on
+    a slot the new cache never re-registered — cosmetic, and registration
+    at alloc overwrites.)"""
+    if not slots:
+        return
+    with _lock:
+        _kv_slots[(id(cache), int(slot_id))] = site
+        while len(_kv_slots) > _KV_CAP:
+            _kv_slots.popitem(last=False)
+    if _tel.enabled:
+        _tel.count("analysis.sanitizer_kv_slots")
+
+
+def check_kv_slot(cache, slot_id, generation):
+    """Read fence for the decode step: raise :class:`StaleKVSlotError`
+    when ``cache``'s slot has recycled past ``generation`` (the handle's
+    stamp).  Callers guard on ``sanitizer.slots``."""
+    if not slots:
+        return
+    if cache.generation(slot_id) != generation:
+        with _lock:
+            site = _kv_slots.get((id(cache), int(slot_id)),
+                                 "<unregistered>")
+        _violation(StaleKVSlotError(site, slot_id))
 
 
 def _violation(err):

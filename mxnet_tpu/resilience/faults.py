@@ -56,6 +56,13 @@ Known sites (see docs/resilience.md for the full table):
                        transport hop of a push / per-key pull copy
 ``serving.batch``      batcher worker, inside the per-batch try (an
                        injected fault fails that batch's futures)
+``decode.kv_alloc``    paged-KV-cache slot allocation at decode admission
+                       — a ``fail`` sheds that request and keeps the
+                       scheduler serving (the KV-exhaustion drill)
+``decode.step``        decode-scheduler step boundary, before the fused
+                       step program dispatches — a ``fail`` crashes the
+                       in-flight decode batch (futures carry the fault,
+                       slots are freed, the worker survives)
 ``optimizer.apply``    aggregated optimizer apply path (``update_multi`` /
                        ``functional_update``), before any group mutates —
                        an injected fault never leaves a half-applied step

@@ -16,6 +16,9 @@ this package gives *inference* the same discipline under organic traffic:
 - :class:`ModelRegistry` (``registry.py``) — multi-model map with atomic
   hot-swap: new traffic routes to the new weights instantly, the old
   batcher drains.
+- :mod:`.decode` — the generative workload family: continuous-batching
+  autoregressive decode over a paged, slot-generation KV cache
+  (``DecodeSession.generate()``; see ``serving/decode/__init__.py``).
 
 Observability rides on :mod:`mxnet_tpu.telemetry` (``serving.*`` events:
 queue-wait/run spans, batch-size and padding-waste counters, compile
@@ -32,9 +35,10 @@ Minimal use::
     fut = srv.submit(image, deadline_ms=100)     # from any thread
     probs = fut.result()
 """
+from . import decode  # noqa: F401
 from .batcher import Batcher, RequestRejected  # noqa: F401
 from .registry import ModelRegistry  # noqa: F401
 from .runtime import ModelRuntime, default_buckets  # noqa: F401
 
 __all__ = ["ModelRuntime", "Batcher", "ModelRegistry", "RequestRejected",
-           "default_buckets"]
+           "default_buckets", "decode"]

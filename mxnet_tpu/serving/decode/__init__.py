@@ -1,0 +1,58 @@
+"""``mxnet_tpu.serving.decode`` — autoregressive decode runtime with
+continuous batching and a paged, slot-generation KV cache.
+
+One-shot serving (:class:`~mxnet_tpu.serving.ModelRuntime` +
+``Batcher``) answers a request with one compiled forward; generative
+decode answers with a *loop* whose per-step shapes must never leave the
+compiled bucket set.  This package applies the framework's whole-graph
+discipline (PAPER.md design point #2) to that loop:
+
+- :class:`CausalLM` (``model.py``) — decoder-only transformer whose
+  prefill and per-token step are built from ONE set of pure layer
+  functions, written row-stable so a request's tokens are bitwise
+  independent of batch composition.
+- :class:`PagedKVCache` (``kv_cache.py``) — device-resident page pools
+  with a trash page for padding, generation-stamped slots (the ShmRing
+  discipline: a post-free read raises ``StaleKVSlotError`` under
+  ``MXNET_SANITIZE=slots``), and optional ``NamedSharding`` over the
+  heads axis so the cache scales with the mesh.
+- :class:`DecodeRuntime` (``runtime.py``) — the 2-D *(batch x seqlen)*
+  prefill grid warmed through ``HybridBlock.compile_grid`` plus ONE
+  fused donated step program per batch bucket; ``decode.compile_miss``
+  must stay zero in steady state across arbitrary join/evict patterns.
+- :class:`DecodeScheduler` / :class:`DecodeSession` (``scheduler.py``) —
+  continuous batching: requests join the running batch at step
+  boundaries, finished sequences free their KV slots immediately, and
+  the serving backpressure/deadline/circuit-breaker machinery carries
+  over with KV exhaustion as a new shed condition.
+
+Minimal use::
+
+    import mxnet_tpu as mx
+
+    net = mx.serving.decode.get_decode_model("decode_small")
+    net.initialize()
+    sess = mx.serving.decode.DecodeSession(net, page_size=16)
+    fut = sess.submit([5, 9, 2], max_new_tokens=32, temperature=0.8,
+                      seed=7, deadline_ms=5000)
+    print(fut.result().token_ids)
+    sess.close()
+"""
+from .kv_cache import (  # noqa: F401
+    KVCacheExhausted,
+    KVSlot,
+    PagedKVCache,
+    pages_needed,
+)
+from .model import CausalLM, get_decode_model, rowdot  # noqa: F401
+from .runtime import DecodeRuntime, seq_bucket_ladder  # noqa: F401
+from .scheduler import (  # noqa: F401
+    DecodeScheduler,
+    DecodeSession,
+    GenerationResult,
+)
+
+__all__ = ["CausalLM", "get_decode_model", "rowdot",
+           "PagedKVCache", "KVSlot", "KVCacheExhausted", "pages_needed",
+           "DecodeRuntime", "seq_bucket_ladder",
+           "DecodeScheduler", "DecodeSession", "GenerationResult"]

@@ -1,0 +1,242 @@
+"""Device-resident paged KV cache with generation-stamped slots.
+
+The decode batch's attention state lives on device as two page-pool arrays
+per cache — ``k_pages`` / ``v_pages`` of shape ``(layers, num_pages,
+page_size, heads, head_dim)``.  A sequence owns a *slot* (its identity in
+the allocator) and a fixed-length page table (``max_pages_per_seq``
+entries, padded with the reserved trash page 0) mapping logical token
+positions to physical pages.  Page 0 is never allocated: padded batch rows
+and padded prompt positions scatter their K/V there, so one compiled
+program per batch bucket serves every batch composition.
+
+**Slot-generation discipline** (the ShmRing pattern from the input
+pipeline, generalized): every slot carries a recycle generation, bumped on
+:meth:`free` — exactly the moment the pages may be handed to another
+sequence.  A :class:`KVSlot` handle snapshots the generation at
+allocation; under ``MXNET_SANITIZE=slots`` each decode-step read checks
+the handle against the cache and a post-free read raises
+:class:`~mxnet_tpu.analysis.sanitizer.StaleKVSlotError` naming the slot
+and its allocation site — instead of silently attending over another
+request's context.
+
+Sharding: pass ``mesh`` (+ ``kv_axis``) and the page pools are created
+under a ``NamedSharding`` over the heads axis, so the cache scales with
+the mesh without changing any scheduler/runtime code (the SNIPPETS.md [1]
+GSPMD pattern).  Allocation state is host-side and tiny either way.
+
+Fault site ``decode.kv_alloc`` fires inside :meth:`alloc` — KV exhaustion
+under load is injectable like every other subsystem failure
+(``MXNET_FAULTS=decode.kv_alloc:fail``).
+"""
+from __future__ import annotations
+
+import threading
+
+from ...analysis import sanitizer as _san
+from ...resilience import faults as _faults
+from ...telemetry import bus as _tel
+
+__all__ = ["PagedKVCache", "KVSlot", "KVCacheExhausted", "pages_needed"]
+
+TRASH_PAGE = 0
+
+
+def pages_needed(prompt_len, max_new_tokens, page_size):
+    """Pages a request reserves at admission.  Written positions are the
+    prompt (``0..n-1``) plus every generated token that is fed back
+    (``n..n+max_new-2`` — the last sampled token is returned, never
+    re-encoded), so the reservation covers ``n + max_new - 1`` positions."""
+    written = int(prompt_len) + max(int(max_new_tokens) - 1, 0)
+    return -(-max(written, 1) // int(page_size))
+
+
+class KVCacheExhausted(RuntimeError):
+    """Not enough free pages (or slots) to admit a sequence right now.
+
+    The scheduler treats this as backpressure — the request waits for
+    evictions — unless the request could never fit, in which case it is
+    shed with ``reason="kv_exhausted"``."""
+
+    def __init__(self, need, free, what="pages"):
+        super().__init__(
+            f"KV cache exhausted: need {need} {what}, {free} free")
+        self.need = need
+        self.free = free
+
+
+class KVSlot:
+    """A sequence's handle on its cache residency: slot id, generation
+    stamp, and the fixed-length page table (padded with the trash page)."""
+
+    __slots__ = ("slot_id", "generation", "pages", "page_table")
+
+    def __init__(self, slot_id, generation, pages, max_pages):
+        self.slot_id = slot_id
+        self.generation = generation
+        self.pages = tuple(pages)
+        table = list(self.pages) + [TRASH_PAGE] * (max_pages - len(pages))
+        self.page_table = table
+
+    def __repr__(self):
+        return (f"KVSlot(id={self.slot_id}, gen={self.generation}, "
+                f"pages={len(self.pages)})")
+
+
+class PagedKVCache:
+    """Fixed page pool + slot allocator for one decode runtime.
+
+    Parameters
+    ----------
+    num_layers, num_heads, head_dim : int
+        K/V geometry (must match the model).
+    page_size : int
+        Tokens per page.
+    num_pages : int
+        Total pages *including* the reserved trash page 0; usable
+        capacity is ``num_pages - 1``.
+    max_pages_per_seq : int
+        Page-table length — fixes the decode step's gathered context at
+        ``max_pages_per_seq * page_size`` tokens (the model's effective
+        context window; constant shape = one program per batch bucket).
+    max_slots : int
+        Concurrent-sequence bound (the scheduler's max batch bucket).
+    dtype : str
+    mesh : jax Mesh, optional
+        When given, page pools are sharded ``NamedSharding(mesh,
+        P(None, None, None, kv_axis, None))`` — heads over the model axis.
+    """
+
+    def __init__(self, num_layers, num_heads, head_dim, page_size=16,
+                 num_pages=64, max_pages_per_seq=8, max_slots=16,
+                 dtype="float32", mesh=None, kv_axis="model"):
+        import jax.numpy as jnp
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is trash)")
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self.max_slots = int(max_slots)
+        self.context_length = self.max_pages_per_seq * self.page_size
+        self.dtype = str(dtype)
+        shape = (self.num_layers, self.num_pages, self.page_size,
+                 self.num_heads, self.head_dim)
+        k = jnp.zeros(shape, self.dtype)
+        v = jnp.zeros(shape, self.dtype)
+        if mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            sharding = NamedSharding(
+                mesh, PartitionSpec(None, None, None, kv_axis, None))
+            k = jax.device_put(k, sharding)
+            v = jax.device_put(v, sharding)
+        self.mesh = mesh          # the runtime replicates params over it
+        self.k_pages = k
+        self.v_pages = v
+        self._lock = threading.Lock()
+        self._free_pages = list(range(1, self.num_pages))  # 0 = trash
+        self._free_slots = list(range(self.max_slots))
+        self._gen = [0] * self.max_slots
+        self._live = {}          # slot_id -> KVSlot
+        self.peak_pages = 0
+
+    # ------------------------------------------------------------ allocator
+    @property
+    def usable_pages(self):
+        return self.num_pages - 1
+
+    @property
+    def pages_in_use(self):
+        with self._lock:
+            return self.usable_pages - len(self._free_pages)
+
+    @property
+    def slots_in_use(self):
+        with self._lock:
+            return self.max_slots - len(self._free_slots)
+
+    def fits_ever(self, n_pages):
+        """Could a reservation of ``n_pages`` EVER be satisfied (empty
+        cache)?  False means the request must be shed, not queued."""
+        return n_pages <= self.usable_pages
+
+    def alloc(self, n_pages, site="decode.kv_alloc"):
+        """Reserve ``n_pages`` + a slot; returns a generation-stamped
+        :class:`KVSlot`.  Raises :class:`KVCacheExhausted` when the pool
+        can't satisfy the reservation *right now* (injectable:
+        ``MXNET_FAULTS=decode.kv_alloc:fail``)."""
+        if _faults.active:
+            _faults.check("decode.kv_alloc")
+        n_pages = int(n_pages)
+        if n_pages > self.max_pages_per_seq:
+            raise ValueError(
+                f"{n_pages} pages exceed max_pages_per_seq="
+                f"{self.max_pages_per_seq} (context "
+                f"{self.context_length} tokens)")
+        with self._lock:
+            if not self._free_slots:
+                raise KVCacheExhausted(1, 0, what="slots")
+            if n_pages > len(self._free_pages):
+                raise KVCacheExhausted(n_pages, len(self._free_pages))
+            slot_id = self._free_slots.pop()
+            pages = [self._free_pages.pop() for _ in range(n_pages)]
+            slot = KVSlot(slot_id, self._gen[slot_id], pages,
+                          self.max_pages_per_seq)
+            self._live[slot_id] = slot
+            in_use = self.usable_pages - len(self._free_pages)
+            self.peak_pages = max(self.peak_pages, in_use)
+        if _san.slots:
+            _san.register_kv_slot(self, slot_id, site)
+        self._gauge(in_use)
+        return slot
+
+    def free(self, slot):
+        """Return a slot's pages to the pool.  Bumps the slot generation
+        FIRST — any handle stamped with the old generation is stale from
+        this point on (a later read raises under ``MXNET_SANITIZE=slots``).
+        Double-frees raise instead of corrupting the free list."""
+        with self._lock:
+            live = self._live.get(slot.slot_id)
+            if live is not slot or self._gen[slot.slot_id] != slot.generation:
+                raise ValueError(
+                    f"double/foreign free of {slot!r} (current generation "
+                    f"{self._gen[slot.slot_id]})")
+            self._gen[slot.slot_id] += 1
+            del self._live[slot.slot_id]
+            self._free_pages.extend(slot.pages)
+            self._free_slots.append(slot.slot_id)
+            in_use = self.usable_pages - len(self._free_pages)
+        self._gauge(in_use)
+
+    def generation(self, slot_id):
+        """Current recycle generation of a slot (the sanitizer's stale
+        check compares a handle's stamp against this)."""
+        with self._lock:
+            return self._gen[slot_id]
+
+    def check_slot(self, slot):
+        """``MXNET_SANITIZE=slots`` read fence for the decode step: raises
+        ``StaleKVSlotError`` when ``slot`` was freed (callers guard on
+        ``sanitizer.slots`` — idle cost is one attribute read)."""
+        _san.check_kv_slot(self, slot.slot_id, slot.generation)
+
+    def _gauge(self, in_use):
+        if _tel.enabled:
+            _tel.gauge("decode.kv_occupancy",
+                       round(in_use / max(self.usable_pages, 1), 4))
+            _tel.gauge("decode.kv_pages", in_use)
+
+    def reset_peak(self):
+        """Restart the ``peak_pages`` high-water mark (bench phases)."""
+        with self._lock:
+            self.peak_pages = self.usable_pages - len(self._free_pages)
+
+    def stats(self):
+        with self._lock:
+            in_use = self.usable_pages - len(self._free_pages)
+            return {"pages_in_use": in_use, "usable_pages": self.usable_pages,
+                    "slots_in_use": self.max_slots - len(self._free_slots),
+                    "max_slots": self.max_slots,
+                    "peak_pages": self.peak_pages}

@@ -1,0 +1,271 @@
+"""Causal-LM decode model — one math implementation for prefill AND step.
+
+The decode runtime has two compiled surfaces that MUST agree numerically:
+the prefill (whole padded prompt, emits per-layer K/V for the cache) and
+the per-token decode step (reads K/V back through the paged cache).  Both
+are built here from the same pure-jax layer functions; :class:`CausalLM`
+is a ``HybridBlock`` whose ``hybrid_forward`` delegates to the shared
+prefill function via ``ndarray.invoke_fn`` — so the prefill rides the
+CachedOp path (``HybridBlock.compile_for`` / ``compile_grid`` warm the 2-D
+batch x seqlen ladder) while the fused decode step is a raw donated jit
+built from the very same per-layer math.
+
+**The row-stable contract.**  Continuous batching promises per-request
+outputs bitwise-identical to a solo run of the same request — otherwise a
+request's result depends on who it happened to share a batch with, and
+"replay this request" stops being a debugging tool.  XLA does NOT give
+that for free: a plain ``(B, U) @ (U, V)`` matmul tiles differently per
+batch size, so row 0 of a batch-8 product differs in final bits from the
+batch-1 product.  Every contraction here therefore goes through
+:func:`rowdot` (broadcast-multiply + reduce over the contraction axis:
+per-row reduction order is independent of the batch dimension), and
+attention contracts through batch-dimension ``einsum``s (``dot_general``
+batch dims — per-row by construction).  Trading MXU-shaped matmuls for
+row stability costs FLOP efficiency; on a real TPU deployment where
+cross-batch bit-identity can be relaxed, swap :func:`rowdot` for a plain
+``@`` and the parity tests for tolerance checks — everything else holds.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ...gluon.block import HybridBlock
+from ...ndarray import NDArray, invoke_fn
+
+__all__ = ["CausalLM", "get_decode_model", "rowdot"]
+
+
+def rowdot(x, w):
+    """Bitwise row-stable contraction ``x (..., U) . w (U, V) -> (..., V)``.
+
+    Broadcast-multiply + reduce keeps each output row's accumulation order
+    independent of every *other* leading-dim index — the property a plain
+    matmul loses to tiling (see module docstring)."""
+    return (x[..., :, None] * w).sum(axis=-2)
+
+
+def _ln(x, g, b, eps=1e-5):
+    import jax
+    m = x.mean(-1, keepdims=True)
+    v = ((x - m) ** 2).mean(-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + eps) * g + b
+
+
+def _gelu(x):
+    import jax.numpy as jnp
+    return 0.5 * x * (1.0 + jnp.tanh(
+        0.7978845608028654 * (x + 0.044715 * x ** 3)))
+
+
+class CausalLM(HybridBlock):
+    """Decoder-only transformer (pre-LN, learned positions, tied embedding).
+
+    ``forward(tokens, lengths)`` — tokens ``(B, S)`` int32 padded to the
+    seq bucket, lengths ``(B,)`` int32 — returns
+    ``(last_logits (B, vocab), kv (2, layers, B, S, heads, head_dim))``:
+    the next-token logits at each row's last valid position plus every
+    layer's K/V for the paged-cache commit.  Only the causal mask is
+    needed in prefill: padded *keys* can only influence padded *queries*,
+    and the K/V of padded positions is routed to the cache's trash page by
+    the commit program.
+
+    The decode hot path never touches this class' forward directly — the
+    runtime compiles :meth:`prefill_fn` through the CachedOp ladder and
+    builds its fused step program from :meth:`step_math`.
+    """
+
+    def __init__(self, vocab_size=512, units=128, num_layers=2, num_heads=4,
+                 max_length=128, hidden_size=None, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise ValueError(f"units={units} not divisible by "
+                             f"num_heads={num_heads}")
+        self.vocab_size = int(vocab_size)
+        self.units = int(units)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = self.units // self.num_heads
+        self.max_length = int(max_length)
+        self.hidden_size = int(hidden_size or 4 * units)
+        u, hid = self.units, self.hidden_size
+        get = self.params.get
+        self.embed = get("embed", shape=(self.vocab_size, u), init="normal")
+        self.pos_embed = get("pos_embed", shape=(self.max_length, u),
+                             init="normal")
+        self.lnf_g = get("lnf_g", shape=(u,), init="ones")
+        self.lnf_b = get("lnf_b", shape=(u,), init="zeros")
+        for i in range(self.num_layers):
+            setattr(self, f"l{i}_ln1_g", get(f"l{i}_ln1_g", shape=(u,),
+                                             init="ones"))
+            setattr(self, f"l{i}_ln1_b", get(f"l{i}_ln1_b", shape=(u,),
+                                             init="zeros"))
+            setattr(self, f"l{i}_wqkv", get(f"l{i}_wqkv", shape=(u, 3 * u),
+                                            init="normal"))
+            setattr(self, f"l{i}_bqkv", get(f"l{i}_bqkv", shape=(3 * u,),
+                                            init="zeros"))
+            setattr(self, f"l{i}_wo", get(f"l{i}_wo", shape=(u, u),
+                                          init="normal"))
+            setattr(self, f"l{i}_bo", get(f"l{i}_bo", shape=(u,),
+                                          init="zeros"))
+            setattr(self, f"l{i}_ln2_g", get(f"l{i}_ln2_g", shape=(u,),
+                                             init="ones"))
+            setattr(self, f"l{i}_ln2_b", get(f"l{i}_ln2_b", shape=(u,),
+                                             init="zeros"))
+            setattr(self, f"l{i}_w1", get(f"l{i}_w1", shape=(u, hid),
+                                          init="normal"))
+            setattr(self, f"l{i}_b1", get(f"l{i}_b1", shape=(hid,),
+                                          init="zeros"))
+            setattr(self, f"l{i}_w2", get(f"l{i}_w2", shape=(hid, u),
+                                          init="normal"))
+            setattr(self, f"l{i}_b2", get(f"l{i}_b2", shape=(u,),
+                                          init="zeros"))
+        self._param_order = sorted(self._reg_params)
+        self._scale = 1.0 / math.sqrt(self.head_dim)
+
+    # ------------------------------------------------------------ pure math
+    def _params_dict(self, leaves):
+        return dict(zip(self._param_order, leaves))
+
+    def param_leaves(self):
+        """Concrete jax arrays in ``_param_order`` — the argument list the
+        raw step/commit programs take (the CachedOp path passes them through
+        the block machinery instead)."""
+        return [self._reg_params[n].data()._data for n in self._param_order]
+
+    def _layer(self, p, i, h, attend):
+        """One pre-LN transformer layer.  ``attend(q, k, v)`` supplies the
+        attention context — the ONLY piece that differs between prefill
+        (dense causal) and decode step (paged-cache gather), so everything
+        else is provably shared math."""
+        import jax.numpy as jnp
+        a = _ln(h, p[f"l{i}_ln1_g"], p[f"l{i}_ln1_b"])
+        qkv = rowdot(a, p[f"l{i}_wqkv"]) + p[f"l{i}_bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        ctx = attend(q * self._scale, k, v)
+        h = h + rowdot(ctx, p[f"l{i}_wo"]) + p[f"l{i}_bo"]
+        m = _ln(h, p[f"l{i}_ln2_g"], p[f"l{i}_ln2_b"])
+        return h + rowdot(_gelu(rowdot(m, p[f"l{i}_w1"]) + p[f"l{i}_b1"]),
+                          p[f"l{i}_w2"]) + p[f"l{i}_b2"]
+
+    def prefill_math(self, p, tokens, lengths):
+        """Pure prefill: ``(last_logits, kv)`` — see class docstring."""
+        import jax
+        import jax.numpy as jnp
+        B, S = tokens.shape
+        H, D = self.num_heads, self.head_dim
+        h = p["embed"][tokens] + p["pos_embed"][:S][None]
+        causal = jnp.tril(jnp.ones((S, S), bool))
+        ks, vs = [], []
+
+        def attend(q, k, v):
+            q = q.reshape(B, S, H, D)
+            k = k.reshape(B, S, H, D)
+            v = v.reshape(B, S, H, D)
+            ks.append(k)
+            vs.append(v)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+            s = jnp.where(causal[None, None], s, -1e30)
+            pr = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bhqk,bkhd->bqhd", pr, v).reshape(B, S, -1)
+
+        for i in range(self.num_layers):
+            h = self._layer(p, i, h, attend)
+        hf = _ln(h, p["lnf_g"], p["lnf_b"])
+        last = hf[jnp.arange(B), lengths - 1]
+        logits = rowdot(last, p["embed"].T)
+        return logits, jnp.stack([jnp.stack(ks), jnp.stack(vs)])
+
+    def step_math(self, p, tokens, positions, tables, k_pages, v_pages,
+                  page_size):
+        """Pure fused decode step for one token per row.
+
+        Writes each row's new K/V into its page (``tables`` routes padded
+        rows to trash page 0), gathers the row's whole paged context
+        (fixed length ``max_pages * page_size`` — constant shape is what
+        keeps one compiled program per batch bucket AND makes the math
+        identical regardless of physical page placement), and returns the
+        next-token logits.  Also returns the updated page arrays."""
+        import jax
+        import jax.numpy as jnp
+        B = tokens.shape[0]
+        H, D = self.num_heads, self.head_dim
+        lctx = tables.shape[1] * page_size
+        h = p["embed"][tokens] + p["pos_embed"][positions]
+        wp = jnp.take_along_axis(tables, (positions // page_size)[:, None],
+                                 axis=1)[:, 0]
+        woff = positions % page_size
+        mask = jnp.arange(lctx)[None, :] <= positions[:, None]
+        state = {"k": k_pages, "v": v_pages, "i": 0}
+
+        def attend(q, k, v):
+            i = state["i"]
+            q = q.reshape(B, H, D)
+            k = k.reshape(B, H, D)
+            v = v.reshape(B, H, D)
+            state["k"] = state["k"].at[i, wp, woff].set(k)
+            state["v"] = state["v"].at[i, wp, woff].set(v)
+            kg = state["k"][i][tables].reshape(B, lctx, H, D)
+            vg = state["v"][i][tables].reshape(B, lctx, H, D)
+            s = jnp.einsum("bhd,blhd->bhl", q, kg)
+            s = jnp.where(mask[:, None], s, -1e30)
+            pr = jax.nn.softmax(s, axis=-1)
+            state["i"] = i + 1
+            return jnp.einsum("bhl,blhd->bhd", pr, vg).reshape(B, -1)
+
+        for i in range(self.num_layers):
+            h = self._layer(p, i, h, attend)
+        hf = _ln(h, p["lnf_g"], p["lnf_b"])
+        logits = rowdot(hf, p["embed"].T)
+        return logits, state["k"], state["v"]
+
+    def sample_math(self, logits, keys, steps, temps):
+        """Per-row next-token choice on a deterministic per-request key
+        stream: greedy at ``temp == 0``, Gumbel-max temperature sampling
+        otherwise.  ``keys (B, 2) uint32`` are request base keys and
+        ``steps (B,) int32`` the per-request token index — folding inside
+        the program keeps the stream a pure function of (request seed,
+        token index), independent of batch composition or scheduling."""
+        import jax
+        import jax.numpy as jnp
+        greedy = jnp.argmax(logits, -1).astype("int32")
+        folded = jax.vmap(jax.random.fold_in)(keys, steps)
+        u = jax.vmap(lambda kk: jax.random.uniform(
+            kk, (logits.shape[-1],), minval=1e-7, maxval=1.0))(folded)
+        g = -jnp.log(-jnp.log(u))
+        t = jnp.where(temps > 0, temps, 1.0)[:, None]
+        sampled = jnp.argmax(logits / t + g, -1).astype("int32")
+        return jnp.where(temps > 0, sampled, greedy)
+
+    # ------------------------------------------------------- gluon frontend
+    def hybrid_forward(self, F, tokens, lengths, **params):
+        if not isinstance(tokens, NDArray) and not hasattr(tokens, "_data"):
+            raise NotImplementedError(
+                "CausalLM has no symbolic frontend (export is not "
+                "supported); the decode runtime compiles it through "
+                "compile_grid / the CachedOp path instead")
+        leaves = [params[n] for n in self._param_order]
+
+        def pure(tok, ln_, *leaf_vals):
+            return self.prefill_math(self._params_dict(leaf_vals),
+                                     tok, ln_)
+
+        return tuple(invoke_fn(pure, [tokens, lengths] + leaves,
+                               op_name="causal_lm_prefill"))
+
+
+_DECODE_CONFIGS = {
+    "decode_tiny": dict(units=64, num_layers=2, num_heads=2),
+    "decode_small": dict(units=128, num_layers=2, num_heads=4),
+    "decode_base": dict(units=256, num_layers=4, num_heads=8),
+}
+
+
+def get_decode_model(model_name="decode_small", vocab_size=512,
+                     max_length=128, **kwargs):
+    """Named :class:`CausalLM` configs (the decode analog of
+    ``models.get_bert_model``)."""
+    cfg = dict(_DECODE_CONFIGS[model_name])
+    cfg.update(kwargs)
+    return CausalLM(vocab_size=vocab_size, max_length=max_length, **cfg)

@@ -1,0 +1,321 @@
+"""DecodeRuntime — the compiled-shape side of generative serving.
+
+One-shot serving needs one ladder (batch buckets); autoregressive decode
+needs two compiled surfaces with different shape disciplines:
+
+- **Prefill** pads each prompt group to ``(batch_bucket, seq_bucket)`` — a
+  2-D grid warmed at load through the CachedOp path
+  (``HybridBlock.compile_grid``), paired with a *commit* program per grid
+  point that scatters the emitted K/V into cache pages and samples the
+  first token.
+- **The decode step** is ONE fused donated program per *batch bucket*:
+  write new K/V into pages, gather the fixed-length paged context, attend,
+  sample.  Sequence length never appears in its shape — the page table
+  indirection keeps every step of every request inside the same handful of
+  executables, which is what makes ``decode.compile_miss == 0`` steady
+  state possible across arbitrary join/evict patterns.
+
+The page pools are donated to both the commit and step programs
+(functionally updated in place); under ``MXNET_SANITIZE=donation`` the
+pre-call arrays are poisoned at sites ``decode.prefill_commit`` /
+``decode.step`` exactly like the aggregated-optimizer and engine-segment
+donation sites.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import autograd
+from ... import ndarray as nd
+from ...analysis import sanitizer as _san
+from ...gluon.block import io_signature
+from ...telemetry import bus as _tel
+from ..runtime import default_buckets
+from .kv_cache import PagedKVCache
+
+__all__ = ["DecodeRuntime", "seq_bucket_ladder"]
+
+
+def seq_bucket_ladder(max_seqlen, min_bucket=8):
+    """Power-of-two sequence-length ladder capped at ``max_seqlen`` (the
+    cap itself is always a bucket) — the second axis of the prefill grid."""
+    max_seqlen = int(max_seqlen)
+    if max_seqlen < 1:
+        raise ValueError(f"max_seqlen must be >= 1, got {max_seqlen}")
+    ladder, b = [], max(int(min_bucket), 1)
+    while b < max_seqlen:
+        ladder.append(b)
+        b *= 2
+    ladder.append(max_seqlen)
+    return tuple(sorted(set(ladder)))
+
+
+class DecodeRuntime:
+    """A :class:`~mxnet_tpu.serving.decode.model.CausalLM` plus a
+    :class:`PagedKVCache`, compiled into the 2-D prefill grid and
+    per-batch-bucket step programs described in the module docstring.
+
+    Parameters
+    ----------
+    block : CausalLM
+        Initialized decode model (hybridized in place if needed).
+    cache : PagedKVCache, optional
+        Built from the model geometry when omitted (``page_size`` /
+        ``num_pages`` / ``max_slots`` forwarded).
+    batch_buckets : sequence of int
+        Decode-batch ladder; the cap is the max concurrent batch.
+    seq_buckets : sequence of int, optional
+        Prompt-length ladder; defaults to :func:`seq_bucket_ladder` over
+        the cache's context length.  Prompts longer than the cap are
+        rejected at submit.
+    warm : bool
+        Compile the full grid + step ladder now (default).  Serving cold
+        shapes later is counted as ``decode.compile_miss``.
+    """
+
+    def __init__(self, block, cache=None, batch_buckets=(1, 2, 4, 8),
+                 seq_buckets=None, page_size=16, num_pages=None,
+                 max_slots=None, mesh=None, name=None, warm=True):
+        if not getattr(block, "_active", False):
+            block.hybridize()
+        self._block = block
+        self.name = name or getattr(block, "name", "decode")
+        self.batch_buckets = tuple(sorted(set(
+            int(b) for b in batch_buckets)))
+        if self.batch_buckets[0] < 1:
+            raise ValueError(f"batch buckets {self.batch_buckets} must "
+                             f"be >= 1")
+        self.max_batch = self.batch_buckets[-1]
+        if cache is None:
+            # floor, not ceil: the derived context (max_pages * page_size)
+            # must never exceed the model's position table
+            max_pages = block.max_length // int(page_size)
+            if max_pages < 1:
+                raise ValueError(
+                    f"page_size={page_size} exceeds the model's "
+                    f"max_length={block.max_length} — no whole page fits "
+                    f"the position table")
+            cache = PagedKVCache(
+                block.num_layers, block.num_heads, block.head_dim,
+                page_size=page_size,
+                num_pages=(num_pages if num_pages is not None
+                           else max_pages * 2 * self.max_batch + 1),
+                max_pages_per_seq=max_pages,
+                max_slots=(max_slots if max_slots is not None
+                           else 2 * self.max_batch),
+                mesh=mesh)
+        if cache.context_length > block.max_length:
+            raise ValueError(
+                f"cache context {cache.context_length} exceeds the model's "
+                f"position table ({block.max_length})")
+        if cache.max_slots < self.max_batch:
+            raise ValueError(
+                f"cache max_slots={cache.max_slots} < largest batch "
+                f"bucket {self.max_batch}")
+        self.cache = cache
+        self.seq_buckets = tuple(sorted(set(
+            int(s) for s in (seq_buckets if seq_buckets is not None
+                             else seq_bucket_ladder(cache.context_length)))))
+        if self.seq_buckets[-1] > cache.context_length:
+            raise ValueError(
+                f"seq buckets {self.seq_buckets} exceed the cache context "
+                f"({cache.context_length} tokens)")
+        self.max_prompt_len = self.seq_buckets[-1]
+        self._params = block.param_leaves()
+        # sharded cache: the page pools live distributed over the mesh,
+        # while the block's params (and the CachedOp prefill outputs) are
+        # committed to one device — jit refuses mixed committed placements.
+        # Replicate the params once and each prefill's K/V at the commit
+        # boundary; everything downstream is then mesh-consistent.
+        self._replicate = None
+        if getattr(cache, "mesh", None) is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            rep = NamedSharding(cache.mesh, PartitionSpec())
+            self._params = [jax.device_put(p, rep) for p in self._params]
+            self._replicate = lambda x: jax.device_put(x, rep)
+        self._step_fns = {}       # batch_bucket -> donated jit
+        self._commit_fns = {}     # (batch_bucket, seq_bucket) -> donated jit
+        self._prefill_sigs = set()
+        self._warmed = False
+        if warm:
+            self.warm()
+
+    @property
+    def block(self):
+        return self._block
+
+    # -------------------------------------------------------------- ladders
+    def batch_bucket_for(self, n):
+        for b in self.batch_buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"batch of {n} exceeds bucket cap {self.max_batch}")
+
+    def seq_bucket_for(self, n):
+        for s in self.seq_buckets:
+            if s >= n:
+                return s
+        raise ValueError(
+            f"prompt of {n} tokens exceeds the largest seq bucket "
+            f"{self.max_prompt_len}")
+
+    # --------------------------------------------------------------- warmup
+    def warm(self):
+        """AOT-compile the whole 2-D prefill/commit grid and every step
+        bucket before taking traffic.
+
+        The prefill block rides ``HybridBlock.compile_grid``; the commit
+        and step programs are then *driven* once per bucket with all-trash
+        page tables — every row scatters into the reserved trash page, so
+        warming executes the real donated programs without touching a
+        single allocated page.  (Building the ``jax.jit`` objects alone
+        would defer XLA compilation to the first mid-traffic call.)"""
+        grid = [(b, s) for b in self.batch_buckets for s in self.seq_buckets]
+        with _tel.span("decode.warmup", model=self.name,
+                       grid=len(grid), steps=len(self.batch_buckets)):
+            def make_example(b, s):
+                return [nd.array(np.zeros((b, s), "int32")),
+                        nd.array(np.ones((b,), "int32"))]
+
+            with autograd.pause(train_mode=False):
+                self._prefill_sigs.update(
+                    self._block.compile_grid(make_example, grid).values())
+            np_ = self.cache.max_pages_per_seq
+            for b, s in grid:
+                self.prefill(np.zeros((b, s), "int32"),
+                             np.ones((b,), "int32"),
+                             np.zeros((b, np_), "int32"),
+                             np.zeros((b, 2), "uint32"),
+                             np.zeros((b,), "float32"))
+            for b in self.batch_buckets:
+                self.step(np.zeros((b,), "int32"), np.zeros((b,), "int32"),
+                          np.zeros((b, np_), "int32"),
+                          np.zeros((b, 2), "uint32"),
+                          np.zeros((b,), "int32"), np.zeros((b,), "float32"))
+        self._warmed = True
+        if _tel.enabled:
+            _tel.count("decode.warmup_compiles",
+                       2 * len(grid) + len(self.batch_buckets),
+                       model=self.name)
+
+    def _miss(self, kind, key):
+        if _tel.enabled:
+            _tel.count("decode.compile_miss", model=self.name, kind=kind)
+            _tel.instant("decode.compile_miss", model=self.name, kind=kind,
+                         bucket=str(key))
+
+    # ------------------------------------------------------- program builds
+    def _step_fn(self, bucket):
+        fn = self._step_fns.get(bucket)
+        if fn is None:
+            if self._warmed:
+                self._miss("step", bucket)
+            fn = self._build_step()
+            self._step_fns[bucket] = fn
+        return fn
+
+    def _commit_fn(self, bucket_b, bucket_s):
+        key = (bucket_b, bucket_s)
+        fn = self._commit_fns.get(key)
+        if fn is None:
+            if self._warmed:
+                self._miss("prefill_commit", key)
+            fn = self._build_commit()
+            self._commit_fns[key] = fn
+        return fn
+
+    def _build_step(self):
+        import jax
+        block, page_size = self._block, self.cache.page_size
+
+        def step(params, tokens, positions, tables, keys, steps, temps,
+                 k_pages, v_pages):
+            p = block._params_dict(params)
+            logits, k_pages, v_pages = block.step_math(
+                p, tokens, positions, tables, k_pages, v_pages, page_size)
+            nxt = block.sample_math(logits, keys, steps, temps)
+            return nxt, k_pages, v_pages
+
+        return jax.jit(step, donate_argnums=(7, 8))
+
+    def _build_commit(self):
+        import jax
+        import jax.numpy as jnp
+        block, page_size = self._block, self.cache.page_size
+
+        def commit(params, kv, logits, lengths, tables, keys, steps, temps,
+                   k_pages, v_pages):
+            B, S = kv.shape[2], kv.shape[3]
+            j = jnp.arange(S)[None, :]
+            valid = j < lengths[:, None]
+            dest_page = jnp.where(
+                valid, jnp.take_along_axis(tables, j // page_size, axis=1),
+                0)
+            dest_off = jnp.broadcast_to(j % page_size, (B, S))
+            k_pages = k_pages.at[:, dest_page, dest_off].set(kv[0])
+            v_pages = v_pages.at[:, dest_page, dest_off].set(kv[1])
+            first = block.sample_math(logits, keys, steps, temps)
+            return first, k_pages, v_pages
+
+        return jax.jit(commit, donate_argnums=(8, 9))
+
+    # ------------------------------------------------------------ execution
+    def prefill(self, tokens, lengths, tables, keys, temps):
+        """Prefill + commit one padded prompt group.
+
+        ``tokens (B, S)`` / ``lengths (B,)`` padded to a grid bucket
+        (padded rows: length 1, all-trash table).  Returns the sampled
+        first token per row (host int32 array).  The page pools are
+        functionally updated in place (donated)."""
+        b, s = tokens.shape
+        tok_nd = nd.array(tokens)
+        len_nd = nd.array(lengths)
+        sig = io_signature([tok_nd, len_nd])
+        if sig not in self._prefill_sigs:
+            if sig in self._block.compiled_signatures(training=False):
+                self._prefill_sigs.add(sig)
+            elif self._warmed:
+                self._miss("prefill", (b, s))
+        with _tel.span("decode.prefill", model=self.name, batch=b, seq=s):
+            with autograd.pause(train_mode=False):
+                logits, kv = self._block(tok_nd, len_nd)
+            self._prefill_sigs.add(sig)
+            commit = self._commit_fn(b, s)
+            cache = self.cache
+            kp, vp = cache.k_pages, cache.v_pages
+            kv_raw, logits_raw = kv.data, logits.data
+            if self._replicate is not None:
+                kv_raw = self._replicate(kv_raw)
+                logits_raw = self._replicate(logits_raw)
+            first, new_k, new_v = commit(
+                self._params, kv_raw, logits_raw,
+                lengths.astype("int32"), tables.astype("int32"),
+                keys.astype("uint32"), np.zeros((b,), "int32"),
+                temps.astype("float32"), kp, vp)
+            if _san.donation:
+                # the commit donated the page pools: poison the pre-call
+                # arrays so any stray alias raises naming this site
+                _san.poison([kp, vp], "decode.prefill_commit")
+            cache.k_pages, cache.v_pages = new_k, new_v
+        return np.asarray(first)
+
+    def step(self, tokens, positions, tables, keys, steps, temps):
+        """One decode step for a batch padded to a batch bucket (padded
+        rows: token 0, position 0, all-trash table).  Returns the sampled
+        next token per row (host int32 array)."""
+        b = tokens.shape[0]
+        fn = self._step_fn(b)
+        with _tel.span("decode.step", model=self.name, batch=b):
+            cache = self.cache
+            kp, vp = cache.k_pages, cache.v_pages
+            nxt, new_k, new_v = fn(
+                self._params, tokens.astype("int32"),
+                positions.astype("int32"), tables.astype("int32"),
+                keys.astype("uint32"), steps.astype("int32"),
+                temps.astype("float32"), kp, vp)
+            if _san.donation:
+                # the step donated the page pools (see prefill above)
+                _san.poison([kp, vp], "decode.step")
+            cache.k_pages, cache.v_pages = new_k, new_v
+        return np.asarray(nxt)

@@ -1,0 +1,623 @@
+"""Continuous-batching decode scheduler + the ``generate()`` front-end.
+
+Static batching runs a gang of requests start-to-finish: the batch drains
+as its slowest member finishes and new arrivals wait for the whole gang.
+**Continuous batching** admits requests into the *running* decode batch at
+step boundaries and evicts finished sequences immediately, freeing their
+KV pages for the next arrival — the device never idles while work is
+queued, which is where the tokens/sec win at mixed prompt lengths comes
+from (``bench.py decode`` measures both modes on the same machinery).
+
+The request plane carries over the PR 3 ``Batcher`` contract wholesale —
+bounded queue with backpressure, per-request deadlines with load shedding,
+circuit breaker after consecutive batch failures — plus one new shed
+condition: **KV-cache exhaustion**.  A request whose page reservation can
+*never* fit is rejected immediately (``reason="kv_exhausted"``); one that
+merely can't fit *right now* waits for evictions (its deadline still
+applies).  Admission reserves the full ``prompt + max_new_tokens`` page
+budget, so an admitted sequence can always run to completion — mid-flight
+eviction-for-space never happens.
+
+Determinism: a request's token stream is a pure function of (prompt, seed,
+temperature) — per-request PRNG keys fold the *request-local* token index,
+and the runtime's row-stable math keeps every step bitwise-independent of
+batch composition — so the same request returns bitwise-identical tokens
+solo or inside any continuous batch (tested, and the property that makes
+"replay this request" a debugging tool).
+
+Fault sites: ``decode.step`` fires inside the per-step try (an injected
+fault fails that step's active requests and frees their slots — the
+mid-decode crash drill), ``decode.kv_alloc`` inside the cache allocator.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+
+import numpy as np
+
+from ...analysis import sanitizer as _san
+from ...resilience import faults as _faults
+from ...telemetry import bus as _tel
+from ..batcher import RequestRejected
+from .kv_cache import KVCacheExhausted, pages_needed
+from .runtime import DecodeRuntime
+
+__all__ = ["DecodeScheduler", "DecodeSession", "GenerationResult"]
+
+
+class GenerationResult:
+    """One finished request: generated ``token_ids`` (prompt excluded),
+    ``finish_reason`` (``"eos"`` / ``"length"``), time-to-first-token and
+    end-to-end latency in ms."""
+
+    __slots__ = ("token_ids", "finish_reason", "ttft_ms", "latency_ms",
+                 "prompt_len")
+
+    def __init__(self, token_ids, finish_reason, ttft_ms, latency_ms,
+                 prompt_len):
+        self.token_ids = list(token_ids)
+        self.finish_reason = finish_reason
+        self.ttft_ms = ttft_ms
+        self.latency_ms = latency_ms
+        self.prompt_len = prompt_len
+
+    def __repr__(self):
+        return (f"GenerationResult({len(self.token_ids)} tokens, "
+                f"{self.finish_reason!r}, ttft={self.ttft_ms:.1f}ms)")
+
+
+class _Request:
+    __slots__ = ("prompt", "max_new", "temp", "key", "eos_id", "deadline",
+                 "future", "t_submit", "n_pages", "slot", "tokens",
+                 "position", "step_idx", "cur", "ttft_ms")
+
+    def __init__(self, prompt, max_new, temp, key, eos_id, deadline,
+                 t_submit, n_pages):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.temp = temp
+        self.key = key                    # (2,) uint32 request base key
+        self.eos_id = eos_id
+        self.deadline = deadline
+        self.future = Future()
+        self.t_submit = t_submit
+        self.n_pages = n_pages
+        self.slot = None                  # KVSlot once admitted
+        self.tokens = []                  # generated ids
+        self.position = len(prompt)       # next write position
+        self.step_idx = 0                 # per-request sampling step
+        self.cur = 0                      # last sampled token (step input)
+        self.ttft_ms = None
+
+
+class DecodeScheduler:
+    """Worker thread running the continuous decode loop for one
+    :class:`DecodeRuntime` (see module docstring for the contract).
+
+    Parameters
+    ----------
+    runtime : DecodeRuntime
+    queue_depth : int
+        Bound on *queued* (not yet admitted) requests; beyond it
+        ``submit()`` blocks (backpressure) or sheds on deadline expiry.
+    start : bool
+        Start the worker now (default); ``start=False`` lets tests
+        enqueue deterministically.
+    breaker_threshold / breaker_cooldown_ms
+        Circuit breaker on consecutive prefill/step failures (None
+        disables) — same semantics as ``serving.Batcher``.
+    """
+
+    def __init__(self, runtime, queue_depth=256, start=True,
+                 breaker_threshold=8, breaker_cooldown_ms=1000.0):
+        if not isinstance(runtime, DecodeRuntime):
+            raise TypeError(f"need a DecodeRuntime, got {type(runtime)}")
+        self._runtime = runtime
+        self._cache = runtime.cache
+        if int(queue_depth) < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        self.queue_depth = int(queue_depth)
+        self._queue = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._closed = False
+        self._drain = True
+        self._started = False
+        self._worker = None
+        self._active = []                 # worker-thread-owned
+        self.steps_failed = 0
+        self.worker_restarts = 0
+        if breaker_threshold is not None and int(breaker_threshold) < 1:
+            raise ValueError(
+                f"breaker_threshold must be >= 1 or None, "
+                f"got {breaker_threshold}")
+        self._breaker_threshold = None if breaker_threshold is None \
+            else int(breaker_threshold)
+        self._breaker_cooldown = float(breaker_cooldown_ms) / 1e3
+        self._consecutive_failures = 0
+        self._breaker_open_until = 0.0
+        if start:
+            self.start()
+
+    # --------------------------------------------------------------- client
+    def submit(self, prompt, max_new_tokens=16, temperature=0.0, seed=0,
+               eos_id=None, deadline_ms=None):
+        """Enqueue one generation request; returns a Future resolving to a
+        :class:`GenerationResult`.
+
+        Malformed requests (empty prompt, out-of-range ids, a prompt +
+        budget that overflows the context window) raise synchronously.  A
+        reservation larger than the whole KV cache is shed immediately
+        with ``reason="kv_exhausted"`` — it could never be admitted."""
+        t_submit = time.perf_counter()
+        rt = self._runtime
+        prompt = np.asarray(prompt, "int32").reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if prompt.size > rt.max_prompt_len:
+            raise ValueError(
+                f"prompt of {prompt.size} tokens exceeds the largest seq "
+                f"bucket ({rt.max_prompt_len})")
+        vocab = rt.block.vocab_size
+        if prompt.min() < 0 or prompt.max() >= vocab:
+            raise ValueError(f"prompt ids outside [0, {vocab})")
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        ctx = self._cache.context_length
+        if prompt.size + max_new > ctx:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new}) "
+                f"exceeds the context window ({ctx})")
+        n_pages = pages_needed(prompt.size, max_new, self._cache.page_size)
+        # request base key: any deterministic uint32 pair works (the step
+        # program folds the per-request token index into it); derived in
+        # numpy so submit() never touches the jax dispatch path
+        seed = int(seed) & 0xffffffffffffffff
+        key = np.array([seed >> 32, seed & 0xffffffff], "uint32")
+        deadline = (t_submit + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        req = _Request(prompt, max_new, float(temperature), key,
+                       eos_id, deadline, t_submit, n_pages)
+        with self._lock:
+            if self._closed:
+                self._reject(req, "shutdown", "scheduler is closed")
+                raise req.future.exception()
+            if self._breaker_open_until and \
+                    time.perf_counter() < self._breaker_open_until:
+                self._reject(
+                    req, "unhealthy",
+                    f"circuit breaker open after "
+                    f"{self._consecutive_failures} consecutive failures")
+                raise req.future.exception()
+            if not self._cache.fits_ever(n_pages):
+                self._reject(
+                    req, "kv_exhausted",
+                    f"reservation of {n_pages} pages can never fit "
+                    f"({self._cache.usable_pages} usable)")
+                raise req.future.exception()
+            if self._started:
+                self._respawn_worker_locked()
+            while len(self._queue) >= self.queue_depth:
+                remaining = None if deadline is None \
+                    else deadline - time.perf_counter()
+                if remaining is not None and remaining <= 0:
+                    self._reject(req, "deadline",
+                                 "queue stayed full past the deadline")
+                    raise req.future.exception()
+                self._not_full.wait(timeout=remaining)
+                if self._closed:
+                    self._reject(req, "shutdown", "scheduler is closed")
+                    raise req.future.exception()
+            self._queue.append(req)
+            if _tel.enabled:
+                _tel.count("decode.requests", model=self._runtime.name)
+                _tel.gauge("decode.queue_depth", len(self._queue),
+                           model=self._runtime.name)
+            self._not_empty.notify()
+        return req.future
+
+    def generate(self, prompt, timeout=None, **kwargs):
+        """Synchronous convenience: ``submit(...).result()``."""
+        return self.submit(prompt, **kwargs).result(timeout)
+
+    def pending(self):
+        with self._lock:
+            return len(self._queue)
+
+    def active(self):
+        """Sequences currently in the decode batch (approximate — read
+        without joining the step boundary)."""
+        return len(self._active)
+
+    @property
+    def healthy(self):
+        if self._closed:
+            return False
+        if self._breaker_open_until and \
+                time.perf_counter() < self._breaker_open_until:
+            return False
+        return True
+
+    def _reject(self, req, reason, detail):
+        if _tel.enabled:
+            _tel.count("decode.rejections", model=self._runtime.name,
+                       reason=reason)
+            _tel.instant("decode.rejection", model=self._runtime.name,
+                         reason=reason)
+        try:
+            req.future.set_exception(RequestRejected(reason, detail))
+        except InvalidStateError:
+            pass       # client cancel() won the race; nobody is waiting
+
+    # --------------------------------------------------------------- worker
+    def start(self):
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("scheduler is closed")
+            self._started = True
+            self._respawn_worker_locked()
+
+    def _respawn_worker_locked(self):
+        if self._worker is None or not self._worker.is_alive():
+            if self._worker is not None:
+                self.worker_restarts += 1
+                if _tel.enabled:
+                    _tel.count("decode.worker_restart",
+                               model=self._runtime.name)
+            self._worker = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"decode-scheduler-{self._runtime.name}")
+            self._worker.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                while not self._queue and not self._active:
+                    if self._closed:
+                        return
+                    self._not_empty.wait()
+                if self._closed and not self._drain:
+                    self._abort_locked()
+                    break
+            self._boundary()
+            with self._lock:
+                if self._closed and not self._active and \
+                        (not self._drain or not self._queue):
+                    self._shed_queue_locked("shutdown")
+                    break
+        with self._lock:
+            self._not_full.notify_all()
+
+    def _boundary(self):
+        """One step boundary — admit under the lock, then prefill the
+        joins and step the batch outside it.  The ONE body both the live
+        worker and ``close()``'s inline settle run, so the two paths can
+        never diverge."""
+        with self._lock:
+            joining = self._admit_locked()
+            self._not_full.notify_all()
+            if _tel.enabled:
+                _tel.gauge("decode.queue_depth", len(self._queue),
+                           model=self._runtime.name)
+        try:
+            if joining:
+                self._prefill(joining)
+            if self._active:
+                self._step()
+        except BaseException as e:
+            self._fail_active(e, joining)
+
+    def _abort_locked(self):
+        """Non-drain shutdown: shed the queue, fail the active batch,
+        free every slot."""
+        self._shed_queue_locked("shutdown")
+        for req in self._active:
+            self._evict(req, "shutdown")
+            if not req.future.done():
+                req.future.set_exception(
+                    RequestRejected("shutdown", "scheduler closed"))
+        self._active = []
+
+    def _shed_queue_locked(self, reason):
+        while self._queue:
+            self._reject(self._queue.popleft(), reason,
+                         "scheduler closed without drain")
+
+    def _admit_locked(self):
+        """Move queued requests into the batch at this step boundary:
+        shed expired deadlines, then admit in arrival order while a KV
+        reservation and a batch-bucket row are available.  Called under
+        the lock; cache alloc/free only ever happens on this worker
+        thread."""
+        # deadline shedding sweeps the whole queue: a request behind a
+        # too-big head must not rot past its deadline unobserved
+        alive = deque()
+        now = time.perf_counter()
+        for req in self._queue:
+            if req.future.cancelled():
+                pass    # never entered the batch, held no slot: not an
+                #         eviction — the request simply vanishes
+            elif req.deadline is not None and now > req.deadline:
+                self._reject(req, "deadline",
+                             "expired waiting for admission")
+            else:
+                alive.append(req)
+        self._queue = alive
+        joining = []
+        was_running = bool(self._active)
+        while self._queue and \
+                len(self._active) + len(joining) < self._runtime.max_batch:
+            req = self._queue[0]
+            try:
+                req.slot = self._cache.alloc(req.n_pages)
+            except KVCacheExhausted:
+                break        # wait for evictions; deadline still applies
+            except Exception as e:
+                # injected decode.kv_alloc fault (or a real allocator
+                # error): fail THIS request, keep the scheduler alive
+                self._queue.popleft()
+                self._evict(req, "failed")
+                try:
+                    req.future.set_exception(e)
+                except InvalidStateError:
+                    pass      # client cancel() won the race
+                continue
+            self._queue.popleft()
+            # claim the future BEFORE it enters the batch: once RUNNING, a
+            # client cancel() can no longer race _finish's set_result (the
+            # Batcher discipline); a cancel that won the race releases the
+            # just-reserved slot here
+            if not req.future.set_running_or_notify_cancel():
+                self._evict(req, "cancelled")
+                continue
+            joining.append(req)
+        if joining and _tel.enabled and was_running:
+            _tel.count("decode.joins", len(joining),
+                       model=self._runtime.name)
+        return joining
+
+    # ------------------------------------------------------------ decode ops
+    def _prefill(self, joining):
+        """Prefill admitted requests grouped by seq bucket, each group
+        padded to a (batch, seq) grid point."""
+        rt = self._runtime
+        groups = {}
+        for req in joining:
+            groups.setdefault(rt.seq_bucket_for(req.prompt.size),
+                              []).append(req)
+        for s, reqs in sorted(groups.items()):
+            for i in range(0, len(reqs), rt.max_batch):
+                self._prefill_group(reqs[i:i + rt.max_batch], s)
+
+    def _prefill_group(self, reqs, s):
+        rt, cache = self._runtime, self._cache
+        b = rt.batch_bucket_for(len(reqs))
+        tokens = np.zeros((b, s), "int32")
+        lengths = np.ones((b,), "int32")
+        tables = np.zeros((b, cache.max_pages_per_seq), "int32")
+        keys = np.zeros((b, 2), "uint32")
+        temps = np.zeros((b,), "float32")
+        for r, req in enumerate(reqs):
+            tokens[r, :req.prompt.size] = req.prompt
+            lengths[r] = req.prompt.size
+            tables[r] = req.slot.page_table
+            keys[r] = req.key
+            temps[r] = req.temp
+        first = rt.prefill(tokens, lengths, tables, keys, temps)
+        now = time.perf_counter()
+        done = []
+        for r, req in enumerate(reqs):
+            req.ttft_ms = (now - req.t_submit) * 1e3
+            if _tel.enabled:
+                _tel.count("decode.ttft_ms", round(req.ttft_ms, 3),
+                           model=rt.name)
+                _tel.record_span("decode.ttft", req.t_submit, now,
+                                 model=rt.name)
+            req.cur = int(first[r])
+            req.tokens.append(req.cur)
+            req.step_idx = 1
+            if self._is_finished(req):
+                done.append(req)
+            else:
+                self._active.append(req)
+        if _tel.enabled:
+            _tel.count("decode.tokens", len(reqs), model=rt.name)
+            _tel.count("decode.prefills", len(reqs), model=rt.name)
+        for req in done:
+            self._finish(req)
+        self._consecutive_failures = 0
+
+    def _step(self):
+        """One decode step over the active batch, padded to a batch
+        bucket.  Injectable mid-decode crash: ``decode.step``."""
+        rt, cache = self._runtime, self._cache
+        if _faults.active:
+            _faults.check("decode.step")
+        if _san.slots:
+            for req in self._active:
+                cache.check_slot(req.slot)
+        n = len(self._active)
+        b = rt.batch_bucket_for(n)
+        tokens = np.zeros((b,), "int32")
+        positions = np.zeros((b,), "int32")
+        tables = np.zeros((b, cache.max_pages_per_seq), "int32")
+        keys = np.zeros((b, 2), "uint32")
+        steps = np.zeros((b,), "int32")
+        temps = np.zeros((b,), "float32")
+        for r, req in enumerate(self._active):
+            tokens[r] = req.cur
+            positions[r] = req.position
+            tables[r] = req.slot.page_table
+            keys[r] = req.key
+            steps[r] = req.step_idx
+            temps[r] = req.temp
+        nxt = rt.step(tokens, positions, tables, keys, steps, temps)
+        if _tel.enabled:
+            _tel.count("decode.steps", model=rt.name)
+            _tel.count("decode.tokens", n, model=rt.name)
+        still = []
+        for r, req in enumerate(self._active):
+            req.cur = int(nxt[r])
+            req.tokens.append(req.cur)
+            req.position += 1
+            req.step_idx += 1
+            if self._is_finished(req):
+                self._finish(req)
+            else:
+                still.append(req)
+        self._active = still
+        self._consecutive_failures = 0
+
+    @staticmethod
+    def _is_finished(req):
+        if req.eos_id is not None and req.cur == req.eos_id:
+            return True
+        return len(req.tokens) >= req.max_new
+
+    def _finish(self, req):
+        reason = "eos" if (req.eos_id is not None
+                           and req.cur == req.eos_id) else "length"
+        self._evict(req, reason)
+        latency = (time.perf_counter() - req.t_submit) * 1e3
+        req.future.set_result(GenerationResult(
+            req.tokens, reason, req.ttft_ms, latency, req.prompt.size))
+
+    def _evict(self, req, reason):
+        """Free a sequence's KV slot the moment it leaves the batch —
+        continuous batching's whole point is that the next arrival can
+        take these pages at the very next boundary."""
+        if req.slot is not None:
+            self._cache.free(req.slot)
+            req.slot = None
+        self._count_eviction(reason)
+
+    def _count_eviction(self, reason):
+        if _tel.enabled:
+            _tel.count("decode.evictions", model=self._runtime.name,
+                       reason=reason)
+
+    def _fail_active(self, exc, joining=()):
+        """A prefill/step crash fails the requests that were in flight —
+        their slots are freed, the worker survives, the breaker advances
+        (consecutive failures open it).  ``joining`` covers requests
+        admitted this boundary whose prefill never completed (they are
+        not in the active list yet)."""
+        self.steps_failed += 1
+        if _tel.enabled:
+            _tel.count("decode.step_failures", model=self._runtime.name)
+            _tel.instant("decode.step_failure", model=self._runtime.name,
+                         error=repr(exc))
+        in_active = set(map(id, self._active))
+        for req in joining:
+            if id(req) not in in_active and not req.future.done():
+                self._evict(req, "failed")
+                req.future.set_exception(exc)
+        for req in self._active:
+            self._evict(req, "failed")
+            if not req.future.done():
+                req.future.set_exception(exc)
+        self._active = []
+        if self._breaker_threshold is None:
+            return
+        self._consecutive_failures += 1
+        if self._consecutive_failures >= self._breaker_threshold:
+            self._breaker_open_until = \
+                time.perf_counter() + self._breaker_cooldown
+            if _tel.enabled:
+                _tel.count("decode.breaker_open", model=self._runtime.name)
+
+    # ------------------------------------------------------------- shutdown
+    def close(self, drain=True, timeout=60.0):
+        """Stop the scheduler.  ``drain=True`` (default) finishes every
+        queued and active request first; ``drain=False`` rejects the
+        queue (``reason="shutdown"``) and fails active requests."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._drain = bool(drain)
+            worker = self._worker
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+        if worker is not None and worker.is_alive():
+            worker.join(timeout=timeout)
+        if worker is not None and worker.is_alive():
+            return      # hung worker: don't race it from this thread
+        # no live worker (never started / crashed): settle inline
+        if drain:
+            while True:
+                with self._lock:
+                    if not self._queue and not self._active:
+                        break
+                self._boundary()
+        else:
+            with self._lock:
+                self._abort_locked()
+
+    def __del__(self):
+        try:
+            self.close(drain=False, timeout=1.0)
+        except Exception:
+            pass
+
+
+class DecodeSession:
+    """The one-stop ``generate()`` front-end: builds the
+    :class:`~mxnet_tpu.serving.decode.runtime.DecodeRuntime` (2-D prefill
+    grid + step programs, warmed) and the continuous-batching
+    :class:`DecodeScheduler` around an initialized
+    :class:`~mxnet_tpu.serving.decode.model.CausalLM`::
+
+        net = mx.serving.decode.get_decode_model("decode_small")
+        net.initialize()
+        sess = mx.serving.decode.DecodeSession(net, page_size=16)
+        out = sess.generate([5, 9, 2], max_new_tokens=32, temperature=0.8,
+                            seed=7)
+        out.token_ids, out.finish_reason, out.ttft_ms
+        sess.close()
+
+    ``submit()`` returns a Future for concurrent clients; requests join
+    the running decode batch at step boundaries."""
+
+    def __init__(self, block, batch_buckets=(1, 2, 4, 8), seq_buckets=None,
+                 page_size=16, num_pages=None, max_slots=None, mesh=None,
+                 queue_depth=256, warm=True, start=True, **scheduler_kwargs):
+        self.runtime = DecodeRuntime(
+            block, batch_buckets=batch_buckets, seq_buckets=seq_buckets,
+            page_size=page_size, num_pages=num_pages, max_slots=max_slots,
+            mesh=mesh, warm=warm)
+        self.cache = self.runtime.cache
+        self.scheduler = DecodeScheduler(
+            self.runtime, queue_depth=queue_depth, start=start,
+            **scheduler_kwargs)
+
+    def submit(self, prompt, **kwargs):
+        return self.scheduler.submit(prompt, **kwargs)
+
+    def generate(self, prompt, timeout=None, **kwargs):
+        return self.scheduler.generate(prompt, timeout=timeout, **kwargs)
+
+    @property
+    def healthy(self):
+        return self.scheduler.healthy
+
+    def stats(self):
+        s = self.cache.stats()
+        s["pending"] = self.scheduler.pending()
+        s["active"] = self.scheduler.active()
+        return s
+
+    def close(self, drain=True, timeout=60.0):
+        self.scheduler.close(drain=drain, timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close(drain=False)
+        return False

@@ -119,13 +119,14 @@ class ModelRuntime:
 
         After this, any micro-batch padded to a bucket replays a compiled
         executable — zero steady-state XLA recompiles."""
+        def make_example(b):
+            return [nd.array(np.zeros((b,) + shp, dt))
+                    for shp, dt in zip(self._item_shapes, self._dtypes)]
+
         with _tel.span("serving.warmup", model=self.name,
                        buckets=len(self.buckets)):
-            for b in self.buckets:
-                examples = [nd.array(np.zeros((b,) + shp, dt))
-                            for shp, dt in zip(self._item_shapes,
-                                               self._dtypes)]
-                self._compiled_sigs.add(self._block.compile_for(*examples))
+            self._compiled_sigs.update(
+                self._block.compile_grid(make_example, self.buckets).values())
         if _tel.enabled:
             _tel.count("serving.warmup_compiles", len(self.buckets),
                        model=self.name)

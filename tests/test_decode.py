@@ -1,0 +1,468 @@
+"""mxnet_tpu.serving.decode: paged KV cache, 2-D prefill ladder, continuous
+batching (ISSUE 11 tentpole + satellites).
+
+The heart of the file is the no-recompile / bitwise-parity contract test:
+a mixed-prompt-length workload with requests joining and finishing across
+step boundaries must (a) take zero steady-state ``decode.compile_miss``
+and (b) hand every request tokens bitwise-identical to running it solo.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.analysis import StaleKVSlotError, StaleSlotError, sanitizer
+from mxnet_tpu.resilience import faults
+from mxnet_tpu.resilience.faults import InjectedFault
+from mxnet_tpu.serving import RequestRejected
+from mxnet_tpu.serving.decode import (DecodeRuntime, DecodeScheduler,
+                                      GenerationResult, KVCacheExhausted,
+                                      PagedKVCache, get_decode_model,
+                                      pages_needed, seq_bucket_ladder)
+
+VOCAB = 61
+
+
+@pytest.fixture(autouse=True)
+def _clean_bus():
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+@pytest.fixture(scope="module")
+def runtime():
+    """One warmed runtime for the whole module (compiles are the cost)."""
+    net = get_decode_model("decode_tiny", vocab_size=VOCAB, max_length=32,
+                           units=32, num_heads=2)
+    net.initialize()
+    rt = DecodeRuntime(net, batch_buckets=(1, 2, 4), seq_buckets=(8, 16),
+                       page_size=8)
+    yield rt
+
+
+@pytest.fixture(scope="module")
+def tight_runtime():
+    """Tiny KV pool (3 usable pages) for exhaustion-path tests."""
+    net = get_decode_model("decode_tiny", vocab_size=VOCAB, max_length=32,
+                           units=32, num_heads=2)
+    net.initialize()
+    cache = PagedKVCache(net.num_layers, net.num_heads, net.head_dim,
+                         page_size=4, num_pages=4, max_pages_per_seq=4,
+                         max_slots=2)
+    rt = DecodeRuntime(net, cache=cache, batch_buckets=(1, 2),
+                       seq_buckets=(8,))
+    yield rt
+
+
+@pytest.fixture
+def sched(runtime):
+    s = DecodeScheduler(runtime)
+    yield s
+    s.close(drain=False, timeout=10.0)
+    assert runtime.cache.pages_in_use == 0, "leaked KV pages"
+    assert runtime.cache.slots_in_use == 0, "leaked KV slots"
+
+
+def _prompt(i, lo=1, hi=14):
+    rng = np.random.RandomState(1000 + i)
+    return list(rng.randint(1, VOCAB, lo + (i * 3) % (hi - lo + 1)))
+
+
+# ------------------------------------------------------------- page math
+def test_pages_needed():
+    # written positions = prompt + max_new - 1 (last token never re-encoded)
+    assert pages_needed(3, 1, 8) == 1
+    assert pages_needed(8, 1, 8) == 1
+    assert pages_needed(8, 2, 8) == 2
+    assert pages_needed(9, 8, 8) == 2
+    assert pages_needed(1, 16, 8) == 2
+
+
+def test_seq_bucket_ladder():
+    assert seq_bucket_ladder(64) == (8, 16, 32, 64)
+    assert seq_bucket_ladder(48) == (8, 16, 32, 48)
+    assert seq_bucket_ladder(8) == (8,)
+    assert seq_bucket_ladder(4) == (4,)
+    with pytest.raises(ValueError):
+        seq_bucket_ladder(0)
+
+
+# ------------------------------------------------------------- KV cache
+def test_kv_cache_alloc_free_generations():
+    c = PagedKVCache(2, 2, 16, page_size=4, num_pages=9, max_pages_per_seq=4,
+                     max_slots=3)
+    assert c.usable_pages == 8 and c.context_length == 16
+    a = c.alloc(3)
+    b = c.alloc(4)
+    assert c.pages_in_use == 7 and c.slots_in_use == 2
+    assert 0 not in a.pages and 0 not in b.pages          # trash reserved
+    assert not (set(a.pages) & set(b.pages))
+    assert len(a.page_table) == 4 and a.page_table[3] == 0  # trash-padded
+    with pytest.raises(KVCacheExhausted):
+        c.alloc(2)                                         # 1 page free
+    gen = c.generation(a.slot_id)
+    c.free(a)
+    assert c.generation(a.slot_id) == gen + 1              # bumped on free
+    with pytest.raises(ValueError):
+        c.free(a)                                          # double free
+    c.free(b)
+    assert c.pages_in_use == 0 and c.slots_in_use == 0
+    with pytest.raises(ValueError):
+        c.alloc(5)                                         # > max_pages_per_seq
+    with pytest.raises(ValueError):
+        PagedKVCache(2, 2, 16, num_pages=1)                # no room for trash
+
+
+def test_kv_cache_slot_exhaustion():
+    c = PagedKVCache(2, 2, 16, page_size=4, num_pages=9, max_pages_per_seq=2,
+                     max_slots=1)
+    a = c.alloc(1)
+    with pytest.raises(KVCacheExhausted):
+        c.alloc(1)                                         # slots, not pages
+    c.free(a)
+    c.alloc(1)
+
+
+def test_kv_alloc_fault_injectable():
+    c = PagedKVCache(2, 2, 16, page_size=4, num_pages=9, max_pages_per_seq=2)
+    with faults.scope("decode.kv_alloc:fail"):
+        with pytest.raises(InjectedFault):
+            c.alloc(1)
+    c.free(c.alloc(1))                                     # healthy after
+
+
+def test_stale_kv_slot_sanitizer():
+    c = PagedKVCache(2, 2, 16, page_size=4, num_pages=9, max_pages_per_seq=2)
+    with sanitizer.scope("slots"):
+        slot = c.alloc(1)
+        c.check_slot(slot)                                 # live: fine
+        c.free(slot)
+        with pytest.raises(StaleKVSlotError) as ei:
+            c.check_slot(slot)
+        assert "decode.kv_alloc" in str(ei.value)          # site named
+        assert isinstance(ei.value, StaleSlotError)        # slots family
+    sanitizer.reset()
+    # sanitizer off: the check is a no-op (one attribute read)
+    slot = c.alloc(1)
+    c.free(slot)
+    c.check_slot(slot)
+
+
+# ----------------------------------------------------------- runtime/ladder
+def test_runtime_ladders_and_validation(runtime):
+    assert runtime.batch_bucket_for(3) == 4
+    assert runtime.seq_bucket_for(9) == 16
+    with pytest.raises(ValueError):
+        runtime.batch_bucket_for(5)
+    with pytest.raises(ValueError):
+        runtime.seq_bucket_for(17)
+    net = runtime.block
+    # cache context must fit the model's position table
+    big = PagedKVCache(net.num_layers, net.num_heads, net.head_dim,
+                       page_size=8, num_pages=17, max_pages_per_seq=8)
+    with pytest.raises(ValueError):
+        DecodeRuntime(net, cache=big, warm=False)
+    small = PagedKVCache(net.num_layers, net.num_heads, net.head_dim,
+                         page_size=8, num_pages=9, max_pages_per_seq=4,
+                         max_slots=2)
+    with pytest.raises(ValueError):                        # slots < max batch
+        DecodeRuntime(net, cache=small, batch_buckets=(1, 4), warm=False)
+
+
+def test_model_validation():
+    with pytest.raises(ValueError):
+        get_decode_model("decode_tiny", units=30, num_heads=4)
+
+
+def test_default_cache_geometry_non_multiple_max_length():
+    """Default geometry floors max_length/page_size: the derived context
+    never exceeds the model's position table."""
+    net = get_decode_model("decode_tiny", vocab_size=VOCAB, max_length=20,
+                           units=32, num_heads=2)
+    net.initialize()
+    rt = DecodeRuntime(net, batch_buckets=(1,), seq_buckets=(8,),
+                       page_size=8, warm=False)
+    assert rt.cache.context_length == 16                   # 20 // 8 pages
+    with pytest.raises(ValueError):
+        DecodeRuntime(net, batch_buckets=(1,), page_size=32, warm=False)
+
+
+# ------------------------------------------------------------- submit plane
+def test_submit_validation(sched):
+    with pytest.raises(ValueError):
+        sched.submit([])                                   # empty
+    with pytest.raises(ValueError):
+        sched.submit(list(range(1, 18)))                   # > max seq bucket
+    with pytest.raises(ValueError):
+        sched.submit([VOCAB + 3])                          # id out of range
+    with pytest.raises(ValueError):
+        sched.submit([1], max_new_tokens=0)
+    with pytest.raises(ValueError):
+        sched.submit([1] * 16, max_new_tokens=32)          # context overflow
+
+
+def test_kv_never_fits_shed(tight_runtime):
+    s = DecodeScheduler(tight_runtime)
+    try:
+        # 4 pages needed, 3 usable: could never be admitted
+        with pytest.raises(RequestRejected) as ei:
+            s.submit([1] * 8, max_new_tokens=8)
+        assert ei.value.reason == "kv_exhausted"
+    finally:
+        s.close(drain=False, timeout=10.0)
+
+
+def test_kv_exhaustion_waits_then_completes(tight_runtime):
+    s = DecodeScheduler(tight_runtime)
+    try:
+        # each needs 2 of the 3 usable pages: the second waits for the
+        # first eviction, then completes — and nothing leaks
+        f1 = s.submit(_prompt(1, 4, 4), max_new_tokens=5, seed=1)
+        f2 = s.submit(_prompt(2, 4, 4), max_new_tokens=5, seed=2)
+        assert len(f1.result(60).token_ids) == 5
+        assert len(f2.result(60).token_ids) == 5
+    finally:
+        s.close(drain=True, timeout=30.0)
+    assert tight_runtime.cache.pages_in_use == 0
+
+
+# ------------------------------------------------------------ generation
+def test_generate_deterministic(sched):
+    r1 = sched.generate([5, 9, 2], max_new_tokens=6, seed=7, timeout=60)
+    r2 = sched.generate([5, 9, 2], max_new_tokens=6, seed=7, timeout=60)
+    assert isinstance(r1, GenerationResult)
+    assert r1.token_ids == r2.token_ids
+    assert r1.finish_reason == "length" and len(r1.token_ids) == 6
+    assert r1.prompt_len == 3 and r1.ttft_ms is not None
+    t1 = sched.generate([5, 9, 2], max_new_tokens=8, temperature=0.9,
+                        seed=11, timeout=60)
+    t2 = sched.generate([5, 9, 2], max_new_tokens=8, temperature=0.9,
+                        seed=11, timeout=60)
+    assert t1.token_ids == t2.token_ids                    # same seed
+    streams = [sched.generate([5, 9, 2], max_new_tokens=8, temperature=0.9,
+                              seed=s, timeout=60).token_ids
+               for s in (21, 22, 23)]
+    assert len({tuple(s) for s in streams}) > 1            # seeds matter
+
+
+def test_eos_stops_early(sched):
+    ref = sched.generate([3, 1, 4, 1, 5], max_new_tokens=6, seed=0,
+                         timeout=60).token_ids
+    eos = ref[-1]
+    idx = ref.index(eos)
+    out = sched.generate([3, 1, 4, 1, 5], max_new_tokens=6, seed=0,
+                         eos_id=eos, timeout=60)
+    assert out.finish_reason == "eos"
+    assert out.token_ids == ref[:idx + 1]
+
+
+def test_cancelled_request_evicted(sched):
+    # cancel while still queued behind a full batch: slot is never held
+    blockers = [sched.submit(_prompt(i, 6, 6), max_new_tokens=16, seed=i)
+                for i in range(4)]
+    victim = sched.submit([1, 2], max_new_tokens=16)
+    victim.cancel()
+    [b.result(60) for b in blockers]
+    assert victim.cancelled()
+
+
+# ------------------------------------- THE no-recompile / parity contract
+def test_continuous_batching_bitwise_parity_and_zero_misses(runtime):
+    reqs = [dict(prompt=_prompt(i), max_new_tokens=3 + i % 6,
+                 temperature=0.7 * (i % 3 == 0), seed=100 + i)
+            for i in range(12)]
+    s = DecodeScheduler(runtime)
+    try:
+        # solo reference: one request at a time (batch bucket 1)
+        solo = [s.generate(timeout=120, **r).token_ids for r in reqs]
+        # continuous: staggered arrivals join the running batch
+        telemetry.enable()
+        telemetry.reset()
+        futs = []
+
+        def feed():
+            for i, r in enumerate(reqs):
+                futs.append(s.submit(**r))
+                time.sleep(0.002 * (i % 4))
+
+        t = threading.Thread(target=feed)
+        t.start()
+        t.join()
+        cont = [f.result(120).token_ids for f in futs]
+        snap = telemetry.snapshot()["counters"]
+        telemetry.disable()
+    finally:
+        s.close(drain=False, timeout=10.0)
+    for i, (a, b) in enumerate(zip(solo, cont)):
+        assert a == b, f"request {i} diverged: solo={a} continuous={b}"
+    assert not snap.get("decode.compile_miss"), snap
+    assert snap.get("decode.joins", 0) >= 1          # genuinely continuous
+    assert snap["decode.evictions"] == len(reqs)
+    assert runtime.cache.pages_in_use == 0, "leaked KV pages"
+    assert runtime.cache.slots_in_use == 0, "leaked KV slots"
+
+
+def test_sanitizer_clean_continuous_run(runtime):
+    s = DecodeScheduler(runtime)
+    try:
+        with sanitizer.scope("donation,slots"):
+            futs = [s.submit(_prompt(i), max_new_tokens=4, seed=i)
+                    for i in range(6)]
+            [f.result(60) for f in futs]
+            assert sanitizer.stats()["violations"] == 0
+    finally:
+        sanitizer.reset()
+        s.close(drain=False, timeout=10.0)
+
+
+def test_mesh_sharded_kv_cache_parity():
+    """NamedSharding over the heads axis: the cache scales with the mesh
+    without changing scheduler code, and decode output is unchanged."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding
+    from mxnet_tpu.serving.decode import DecodeSession
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices")
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("data", "model"))
+    net = get_decode_model("decode_tiny", vocab_size=VOCAB, max_length=32,
+                           units=32, num_heads=4)
+    net.initialize()
+    sess = DecodeSession(net, batch_buckets=(1, 2), seq_buckets=(8,),
+                         page_size=8, mesh=mesh)
+    try:
+        assert isinstance(sess.cache.k_pages.sharding, NamedSharding)
+        assert "model" in str(sess.cache.k_pages.sharding.spec)
+        sharded = sess.generate([5, 9, 2], max_new_tokens=5, seed=7,
+                                timeout=120).token_ids
+    finally:
+        sess.close(drain=False)
+    plain = DecodeSession(net, batch_buckets=(1, 2), seq_buckets=(8,),
+                          page_size=8)
+    try:
+        assert plain.generate([5, 9, 2], max_new_tokens=5, seed=7,
+                              timeout=120).token_ids == sharded
+    finally:
+        plain.close(drain=False)
+
+
+# --------------------------------------------------------- shed/backpressure
+def test_deadline_shed_while_waiting(sched):
+    # 4 long sequences fill every batch row; a deadlined request behind
+    # them expires at the next admission sweep instead of hanging
+    blockers = [sched.submit(_prompt(i, 6, 6), max_new_tokens=20, seed=i)
+                for i in range(4)]
+    while sched.active() < 4 and not all(b.done() for b in blockers):
+        time.sleep(0.001)
+    late = sched.submit([1, 2, 3], max_new_tokens=4, deadline_ms=2)
+    with pytest.raises(RequestRejected) as ei:
+        late.result(60)
+    assert ei.value.reason == "deadline"
+    [b.result(120) for b in blockers]
+
+
+def test_queue_backpressure_deadline(runtime):
+    s = DecodeScheduler(runtime, queue_depth=1, start=False)
+    try:
+        s.submit([1, 2], max_new_tokens=2)
+        with pytest.raises(RequestRejected) as ei:
+            s.submit([3, 4], max_new_tokens=2, deadline_ms=30)
+        assert ei.value.reason == "deadline"
+    finally:
+        s.close(drain=True, timeout=30.0)
+
+
+def test_close_drain_false_rejects(runtime):
+    s = DecodeScheduler(runtime, start=False)
+    f = s.submit([1, 2, 3], max_new_tokens=4)
+    s.close(drain=False)
+    with pytest.raises(RequestRejected) as ei:
+        f.result(5)
+    assert ei.value.reason == "shutdown"
+    with pytest.raises(RequestRejected):
+        s.submit([1], max_new_tokens=1)
+    assert runtime.cache.pages_in_use == 0
+
+
+def test_close_drain_true_completes(runtime):
+    s = DecodeScheduler(runtime, start=False)
+    futs = [s.submit(_prompt(i), max_new_tokens=3, seed=i) for i in range(5)]
+    s.close(drain=True, timeout=60.0)
+    for f in futs:
+        assert len(f.result(0).token_ids) == 3
+    assert runtime.cache.pages_in_use == 0
+
+
+# ------------------------------------------------------------ fault drills
+def test_step_fault_fails_batch_and_recovers(runtime):
+    s = DecodeScheduler(runtime, breaker_threshold=None)
+    try:
+        with faults.scope("decode.step:fail"):
+            f = s.submit([1, 2, 3], max_new_tokens=4, seed=0)
+            with pytest.raises(InjectedFault):
+                f.result(60)
+        assert runtime.cache.pages_in_use == 0             # slot freed
+        ok = s.generate([1, 2, 3], max_new_tokens=4, seed=0, timeout=60)
+        assert len(ok.token_ids) == 4                      # worker survived
+        assert s.steps_failed == 1
+    finally:
+        s.close(drain=False, timeout=10.0)
+
+
+def test_kv_alloc_fault_sheds_request_only(runtime):
+    s = DecodeScheduler(runtime)
+    try:
+        with faults.scope("decode.kv_alloc:fail"):
+            f = s.submit([1, 2], max_new_tokens=3, seed=0)
+            with pytest.raises(InjectedFault):
+                f.result(60)
+        ok = s.generate([1, 2], max_new_tokens=3, seed=0, timeout=60)
+        assert len(ok.token_ids) == 3
+    finally:
+        s.close(drain=False, timeout=10.0)
+
+
+def test_circuit_breaker_opens_and_probes(runtime):
+    s = DecodeScheduler(runtime, breaker_threshold=1,
+                        breaker_cooldown_ms=150.0)
+    try:
+        with faults.scope("decode.step:fail"):
+            f = s.submit([1, 2, 3], max_new_tokens=4)
+            with pytest.raises(InjectedFault):
+                f.result(60)
+        assert not s.healthy
+        with pytest.raises(RequestRejected) as ei:
+            s.submit([1], max_new_tokens=2)
+        assert ei.value.reason == "unhealthy"
+        time.sleep(0.2)                                    # cooldown expires
+        assert s.healthy
+        assert len(s.generate([1, 2, 3], max_new_tokens=3,
+                              timeout=60).token_ids) == 3
+    finally:
+        s.close(drain=False, timeout=10.0)
+
+
+# ------------------------------------------------------------- telemetry
+def test_decode_telemetry_counters(runtime):
+    telemetry.enable()
+    s = DecodeScheduler(runtime)
+    try:
+        futs = [s.submit(_prompt(i), max_new_tokens=4, seed=i)
+                for i in range(5)]
+        [f.result(60) for f in futs]
+    finally:
+        s.close(drain=False, timeout=10.0)
+    snap = telemetry.snapshot()
+    c = snap["counters"]
+    assert c["decode.requests"] == 5
+    assert c["decode.prefills"] == 5
+    assert c["decode.tokens"] == 20
+    assert c["decode.evictions"] == 5
+    assert c["decode.ttft_ms"] > 0
+    assert c.get("decode.compile_miss") in (None, 0)
+    assert "decode.kv_occupancy" in snap["gauges"]
