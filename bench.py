@@ -1212,11 +1212,21 @@ def bench_decode():
 
     try:
         continuous_round()                             # warm every bucket
+        telemetry.reset()      # steady state only: counters + histograms
         misses0 = telemetry.counter_value("decode.compile_miss")
         joins0 = telemetry.counter_value("decode.joins")
         sess.cache.reset_peak()
         wall_c, res_c = continuous_round()
         cont = summarize(wall_c, res_c)
+        # distribution view straight from the telemetry histograms (the
+        # same numbers /metrics exports) — per-step latency has no
+        # per-result field, so the histogram is the only honest source
+        hists = telemetry.snapshot()["histograms"]
+        for key, row in hists.items():
+            if key in ("decode.step_ms", "decode.ttft_ms"):
+                cont[key.replace(".", "_") + "_hist"] = {
+                    "p50": row["p50"], "p90": row["p90"],
+                    "p99": row["p99"], "count": row["count"]}
         joins = telemetry.counter_value("decode.joins") - joins0
         sess.cache.reset_peak()
         wall_s, res_s = static_round()

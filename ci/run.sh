@@ -57,9 +57,19 @@
 #                divergence that must raise CollectiveDivergenceError
 #                naming both hosts' next-op fingerprints (bounded by the
 #                watchdog, never a hang)
+#   trace      - observability smoke: test_trace.py (trace contexts,
+#                flight recorder, histograms, HTTP endpoint), then a
+#                traced decode drill (one request lane carries
+#                submit -> queue wait -> prefill -> rides -> eviction,
+#                /metrics and /healthz answer on an ephemeral port) and
+#                a two-simulated-host drill: the clean run must merge
+#                both hosts' trace streams into ONE valid chrome trace
+#                with two process lanes and leave NO flight dump, the
+#                planted-divergence run must leave a post-mortem flight
+#                dump per host naming each host's last framework events
 # Usage: ci/run.sh [stage ...]   (default: unit gate telemetry optimizer
 #                                 serving decode resilience engine io
-#                                 analyze)
+#                                 analyze trace)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -594,9 +604,127 @@ print("divergence drill ok: clean 2-host commit, planted divergence",
 PY
 }
 
+stage_trace() {
+  # TestTwoHostDrill is deselected here: the dedicated drill below runs
+  # the identical 2-subprocess scenarios with CI-visible assertions
+  JAX_PLATFORMS=cpu python -m pytest tests/test_trace.py -q \
+    -k "not TwoHostDrill"
+  # traced decode drill: one request's lane must carry the full journey,
+  # and the live endpoint must answer on an ephemeral port
+  JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 python - <<'PY'
+import json
+import urllib.request
+
+import numpy as np
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.serving.decode import (DecodeRuntime, DecodeScheduler,
+                                      get_decode_model)
+from mxnet_tpu.telemetry import bus, flight, http, trace
+
+assert telemetry.is_enabled() and flight.enabled
+
+net = get_decode_model("decode_tiny", vocab_size=61, max_length=32,
+                       units=32, num_heads=2)
+net.initialize()
+sched = DecodeScheduler(DecodeRuntime(net, batch_buckets=(1, 2),
+                                      seq_buckets=(8,), page_size=8))
+rng = np.random.RandomState(0)
+futs = [sched.submit(list(rng.randint(1, 61, 3 + i)), max_new_tokens=4)
+        for i in range(3)]
+res = [f.result(timeout=300) for f in futs]
+sched.close(drain=True)
+assert all(len(r.token_ids) >= 1 for r in res)
+
+roots = [e for e in bus.events() if e[0] == "I" and e[1] == "decode.submit"]
+assert len(roots) == 3, len(roots)
+lane = (roots[0][6] or {})["trace_id"]
+names = [e[1] for e in bus.events() if e[5] == lane]
+for hop in ("decode.queue_wait", "decode.prefill", "decode.ride_step",
+            "decode.evict"):
+    assert hop in names, (hop, names)
+hist = telemetry.snapshot()["histograms"]
+assert hist["decode.ttft_ms"]["count"] == 3, hist
+assert hist["decode.step_ms"]["count"] >= 1, hist
+assert any(e[1] == "decode.step" for e in flight.events()), \
+    "flight recorder must hold the decode beats by default"
+
+port = http.start_server(0)
+with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                            timeout=10) as r:
+    body = r.read().decode()
+assert r.status == 200 and 'mxnet_decode_ttft_ms_bucket{le="+Inf"} 3' in body
+with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                            timeout=10) as r:
+    hz = json.loads(r.read().decode())
+assert r.status == 200 and hz["ok"] is True, hz
+doc = trace.chrome_trace()
+assert doc["traceEvents"], "chrome trace must not be empty"
+http.stop_server()
+p50 = hist["decode.step_ms"]["p50"]
+print(f"trace decode drill ok: 3 request lanes, step p50 {p50}ms,",
+      f"/metrics + /healthz on :{port},",
+      len(flight.events()), "flight events")
+PY
+  # two-simulated-host drill (trace streams + flight dumps via env): the
+  # clean run merges into ONE valid chrome trace with two host lanes and
+  # leaves no flight dump; the planted divergence leaves one per host
+  JAX_PLATFORMS=cpu python - <<'PY'
+import json, os, subprocess, sys, tempfile
+
+env = dict(os.environ, PYTHONPATH=os.getcwd())
+for k in ("MXNET_SANITIZE", "MXNET_CKPT_HOST", "MXNET_TELEMETRY",
+          "MXNET_TRACE_DIR", "MXNET_FLIGHT_DIR"):
+    env.pop(k, None)
+
+def drill(extra1=()):
+    d = tempfile.mkdtemp(prefix="ci_trace_")
+    procs = [subprocess.Popen(
+        [sys.executable, "tests/trace_host_worker.py", "--dir", d,
+         "--host", f"{h}/2", "--steps", "3", "--timeout", "60",
+         *(extra1 if h == 1 else ())],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for h in (0, 1)]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    return [p.returncode for p in procs], outs, d
+
+def flight_dumps(d):
+    return sorted(f for f in os.listdir(d) if f.startswith("flight-"))
+
+rcs, outs, d = drill()
+assert rcs == [0, 0], (rcs, outs)
+from mxnet_tpu.telemetry import trace
+merged = os.path.join(d, "merged.json")
+trace.chrome_trace(path=merged, directory=d)
+with open(merged) as f:
+    doc = json.load(f)                        # valid JSON or this raises
+steps = [e for e in doc["traceEvents"]
+         if e.get("ph") == "X" and e["name"] == "trainer.step"]
+lanes = {e["pid"] for e in steps}
+assert lanes == {0, 1}, (lanes, outs)
+assert all("trace_id" in e["args"] for e in steps)
+assert flight_dumps(d) == [], "clean run must leave no flight dump"
+
+rcs, outs, d = drill(extra1=("--diverge-at", "2"))
+assert rcs == [3, 3], (rcs, outs)
+hosts = set()
+for name in flight_dumps(d):
+    with open(os.path.join(d, name)) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "CollectiveDivergenceError", dump["reason"]
+    hosts.add(dump["host"])
+    ev_names = [e["name"] for e in dump["events"]]
+    assert "trainer.step" in ev_names and "collective" in ev_names, ev_names
+assert hosts == {0, 1}, (hosts, outs)
+print("trace drill ok: clean 2-host run merged into one timeline",
+      f"({len(steps)} step spans on {len(lanes)} host lanes, 0 dumps),",
+      "planted divergence left a flight post-mortem per host")
+PY
+}
+
 stages=("$@")
 [ $# -eq 0 ] && stages=(unit gate telemetry optimizer serving decode
-                        resilience engine io analyze)
+                        resilience engine io analyze trace)
 for s in "${stages[@]}"; do
   echo "=== ci stage: $s ==="
   "stage_$s"

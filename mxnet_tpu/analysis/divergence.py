@@ -42,6 +42,7 @@ import os
 import threading
 
 from ..telemetry import bus as _tel
+from ..telemetry import flight as _flight
 from .sanitizer import (CollectiveDivergenceError, CollectiveStallTimeout,
                         _violation)
 
@@ -218,6 +219,11 @@ def record(kind, axis=None, shape=None, dtype=None, detail=None, site=""):
         if f is not None:
             f.write(line + "\n")
             f.flush()
+    if _flight.enabled:
+        # the flight ring keeps the recent fingerprints too, so a
+        # post-mortem on ANY fault shows what this host was sending even
+        # when the peer comparison never got to run
+        _flight.record("collective", detail=line)
     if _tel.enabled:
         _tel.count("analysis.sanitizer_collectives", kind=kind)
     return seq

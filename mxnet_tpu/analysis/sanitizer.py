@@ -354,6 +354,15 @@ def _violation(err):
                    kind=type(err).__name__)
         _tel.instant("analysis.sanitizer_violation",
                      kind=type(err).__name__, site=err.site)
+    # every sanitizer error funnels through here, which makes this the one
+    # place the flight recorder's post-mortem fires: the dump names the
+    # last N framework events before the violation, per host.  Lazy import
+    # (cold path — we are about to raise) keeps telemetry/analysis
+    # import-order free of cycles.
+    from ..telemetry import flight as _flight
+    _flight.record("sanitizer.violation",
+                   detail=f"{type(err).__name__} @ {err.site}")
+    _flight.postmortem(type(err).__name__, error=err)
     raise err
 
 

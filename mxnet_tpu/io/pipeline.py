@@ -38,6 +38,7 @@ import numpy as np
 
 from ..resilience import faults as _faults
 from ..telemetry import bus as _tel
+from ..telemetry import trace as _trace
 from .shm_ring import ShmRing
 
 __all__ = ["BatchDecodeError", "DecodeSpec", "ProcessDecodePool",
@@ -160,12 +161,18 @@ class DecodeSpec:
             n *= int(d)
         return n * self.slot_dtype.itemsize
 
+    def trace_offset(self):
+        """Byte offset of the slot's trace tail: two float64 perf_counter
+        stamps (decode start/end) the worker writes and the consumer turns
+        into a worker-lane span.  8-byte aligned past the label block."""
+        off = self.data_nbytes() + self.batch_size * self.label_width * 4
+        return (off + 7) & ~7
+
     def slot_nbytes(self):
-        # pixels + the label block: labels ride in shared memory too, so
-        # result messages stay tiny (single atomic pipe write) and nothing
-        # crosses processes pickled
-        return self.data_nbytes() + \
-            self.batch_size * self.label_width * 4
+        # pixels + the label block + the 16-byte trace tail: labels and
+        # timing ride in shared memory too, so result messages stay tiny
+        # (single atomic pipe write) and nothing crosses processes pickled
+        return self.trace_offset() + 16
 
     # ---------------------------------------------------------- record access
     def reopen(self):
@@ -379,8 +386,15 @@ def _worker_main(wid, spec, ring, task_q, conn, n_threads):
                                  offset=spec.data_nbytes())
             lab_view[:] = np.asarray(labels, np.float32).reshape(
                 spec.label_shape)
-            conn.send(("ok", epoch, seq, slot,
-                       (time.perf_counter() - t0) * 1e3))
+            # trace tail: perf_counter is CLOCK_MONOTONIC, shared with the
+            # (fork-)parent, so these two stamps let the consumer emit this
+            # decode as a span on the worker's lane of the merged trace
+            t1 = time.perf_counter()
+            tail = ring.view(slot, (2,), np.float64,
+                             offset=spec.trace_offset())
+            tail[0] = t0
+            tail[1] = t1
+            conn.send(("ok", epoch, seq, slot, (t1 - t0) * 1e3))
         except _faults.InjectedFault:
             os._exit(17)
         except BaseException:
@@ -645,9 +659,26 @@ class ProcessDecodePool:
         slot, decode_ms = entry
         self._consumed += 1
         if _tel.enabled:
-            _tel.count("io.proc_decode_wait_ms",
-                       (time.perf_counter() - t0) * 1e3)
+            now = time.perf_counter()
+            _tel.count("io.proc_decode_wait_ms", (now - t0) * 1e3)
             _tel.count("io.proc_decode_ms", decode_ms)
+            # one trace per consumed batch: the consumer's wait-for-batch
+            # span, with the worker process's decode (read from the slot's
+            # trace tail) parented under it on a synthetic worker lane —
+            # the cross-process hop renders as one linked chain
+            ctx = _trace.start("io.batch", seq=seq)
+            blink = _trace.child(ctx)
+            _tel.record_span("io.proc_batch_wait", t0, now, trace=blink,
+                             seq=seq, decode_ms=round(decode_ms, 3))
+            tail = self.ring.view(slot, (2,), np.float64,
+                                  offset=self._spec.trace_offset())
+            w0, w1 = float(tail[0]), float(tail[1])
+            if w1 >= w0 > 0.0:
+                wid = seq % self._n
+                _tel.record_span(
+                    "io.worker_decode", w0, w1, tid=0xD0000 + wid,
+                    trace=(ctx.trace_id, _tel.new_id(), blink[1]),
+                    seq=seq, worker=wid)
         self.ring.gauge_occupancy()
         view = self.ring.view(slot, self._spec.slot_shape,
                               self._spec.slot_dtype)

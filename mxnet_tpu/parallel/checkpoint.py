@@ -73,6 +73,8 @@ from ..analysis import sanitizer as _san
 from ..resilience import durable as _durable
 from ..resilience import faults as _faults
 from ..telemetry import bus as _tel
+from ..telemetry import flight as _flight
+from ..telemetry import trace as _trace
 
 __all__ = ["save_spmd_checkpoint", "load_spmd_checkpoint",
            "SPMDCheckpointManager", "CheckpointCorrupted",
@@ -403,6 +405,7 @@ class SPMDCheckpointManager:
         step = int(step)
         if not sync:
             return self._save_async(step, trainer, extra)
+        _flight.record("checkpoint.save", value=step)
         self._join_async()     # serialize directory access with an inflight
         return self._save_tree(step, lambda: _build_tree(trainer), extra)
 
@@ -436,6 +439,11 @@ class SPMDCheckpointManager:
 
     def _save_async(self, step, trainer, extra):
         self._join_async()     # at-most-one-inflight
+        _flight.record("checkpoint.async_save", value=step)
+        # capture the enqueuing step's trace context: the background
+        # serializer's spans activate it on their thread, so the async
+        # write shows up linked under the step that triggered it
+        ctx = _trace.current()
         with _tel.span("checkpoint.async_enqueue", step=step):
             snap = _snapshot_tree(trainer)
 
@@ -443,8 +451,9 @@ class SPMDCheckpointManager:
             try:
                 if _faults.active:
                     _faults.check("ckpt.async_serialize")
-                self._save_tree(step, lambda: snap, extra,
-                                kind="spmd_async")
+                with _trace.use(ctx):
+                    self._save_tree(step, lambda: snap, extra,
+                                    kind="spmd_async")
             except BaseException as e:   # surfaced via wait_for_save
                 with self._async_lock:
                     self._async_err = e

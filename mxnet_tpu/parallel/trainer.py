@@ -21,7 +21,9 @@ from ..analysis import divergence as _div
 from ..analysis import sanitizer as _san
 from ..ndarray import NDArray
 from ..telemetry import bus as _tel
+from ..telemetry import flight as _flight
 from ..telemetry import jax_hooks as _tel_jax
+from ..telemetry import trace as _trace
 from .optim import FunctionalOptimizer
 from .sharding import infer_param_specs, named_sharding
 
@@ -301,6 +303,7 @@ class SPMDTrainer:
                 shape=tuple(getattr(d0, "shape", ())),
                 dtype=getattr(d0, "dtype", None),
                 site=f"SPMDTrainer.step t={self._t}")
+        _flight.record("trainer.step", value=self._t)
         # the scope matters while jax traces the step (first call / retrace):
         # attention layers consult it to route through ring attention
         old_leaves = None
@@ -308,7 +311,13 @@ class SPMDTrainer:
             # the jitted step donates arg 0 (the whole train state): snap
             # the pre-call leaves so they can be poisoned with this site
             old_leaves = _jax.tree_util.tree_leaves(self._state)
-        with self._sp_scope(), \
+        # step-scoped trace root — unless the caller (ResilientTrainer,
+        # a serving layer) already activated one on this thread, in which
+        # case the step span nests under it
+        ctx = None
+        if _tel.enabled and _tel.trace_current() is None:
+            ctx = _trace.start()
+        with _trace.use(ctx), self._sp_scope(), \
                 _tel.span("trainer.step", t=self._t):
             self._state, loss = self._step_fn(self._state, data, label, key,
                                               jnp.uint32(self._t))

@@ -207,7 +207,7 @@ def _telemetry_section():
     "what did the framework do" (spans + counters)."""
     from . import telemetry
     snap = telemetry.snapshot()
-    if not (snap["spans"] or snap["counters"]):
+    if not (snap["spans"] or snap["counters"] or snap.get("histograms")):
         return []
     lines = ["", "Framework events (telemetry)"]
     if snap["spans"]:
@@ -221,6 +221,15 @@ def _telemetry_section():
         for name, value in sorted(snap["counters"].items()):
             val = round(value, 3) if isinstance(value, float) else value
             lines.append("%-50s %12s" % (name[:50], val))
+    if snap.get("histograms"):
+        # latency distributions straight from the histogram buckets — no
+        # span mining needed to answer "what was p99 TTFT?"
+        lines.append("%-38s %8s %9s %9s %9s %9s" %
+                     ("Histogram", "Count", "p50", "p90", "p99", "Max"))
+        for name, row in sorted(snap["histograms"].items()):
+            lines.append("%-38s %8d %9.3f %9.3f %9.3f %9.3f" %
+                         (name[:38], row["count"], row["p50"], row["p90"],
+                          row["p99"], row["max"]))
     return lines
 
 

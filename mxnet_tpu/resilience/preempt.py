@@ -36,6 +36,7 @@ import threading
 import time
 
 from ..telemetry import bus as _tel
+from ..telemetry import flight as _flight
 
 __all__ = ["PreemptionHandler", "TrainingPreempted", "save_and_exit"]
 
@@ -102,6 +103,9 @@ class PreemptionHandler:
             raise SystemExit(128 + int(signum))
         self.signum = int(signum)
         self._event.set()
+        # flight.record is async-signal-tolerable: no locks, no allocation
+        # beyond slot stores — the dump itself waits for save_and_exit
+        _flight.record("resilience.preempt_signal", value=int(signum))
         if _tel.enabled:
             _tel.count("resilience.preempt_signals")
             _tel.instant("resilience.preempt_signal", signum=int(signum))
@@ -141,5 +145,8 @@ def save_and_exit(manager, trainer, step=None, extra=None):
     ms = round((time.perf_counter() - t0) * 1e3, 3)
     _tel.count("checkpoint.preempt_save_ms", ms)
     _tel.instant("resilience.preempted", step=step, save_ms=ms)
+    # the checkpoint is durable; before exiting, leave the black box —
+    # what this host was doing in its final seconds, per host
+    _flight.postmortem("preemption")
     raise TrainingPreempted(step=step,
                             checkpoint_step=manager.latest_step())

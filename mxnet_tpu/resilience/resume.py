@@ -36,6 +36,8 @@ from __future__ import annotations
 from .. import random as _rnd
 from ..parallel.checkpoint import SPMDCheckpointManager
 from ..telemetry import bus as _tel
+from ..telemetry import flight as _flight
+from ..telemetry import trace as _trace
 from . import preempt as _preempt
 from .guard import StepGuard
 
@@ -156,7 +158,12 @@ class ResilientTrainer:
             self.wait_for_save()
             _preempt.save_and_exit(self._mgr, self._trainer,
                                    extra=self._extra())
-        loss = self._trainer.step(data, label)
+        # step-scoped trace root: the inner SPMDTrainer/checkpoint spans
+        # dispatched during this call all nest under one step context
+        ctx = _trace.start("resilience.step", step=self._trainer._t) \
+            if _tel.enabled else None
+        with _trace.use(ctx):
+            loss = self._trainer.step(data, label)
         self._pending = loss
         return loss
 
@@ -222,6 +229,8 @@ class ResilientTrainer:
 
     def _count_failure(self, e):
         self.checkpoint_failures += 1
+        _flight.record("resilience.checkpoint_failed", detail=repr(e),
+                       value=self._trainer._t)
         _tel.count("resilience.checkpoint_failed")
         _tel.instant("resilience.checkpoint_failed",
                      step=self._trainer._t, error=repr(e))
@@ -240,6 +249,11 @@ class ResilientTrainer:
                 f"exists under {self._mgr.directory}")
         self._pending = None       # a loss from poisoned state: never judge
         from_step = self._trainer._t
+        # the rollback IS the post-mortem moment for nan escalation: dump
+        # the flight ring before rewinding so the record shows what the
+        # host was doing while the loss went non-finite
+        _flight.record("resilience.rollback", value=from_step)
+        _flight.postmortem("nan_rollback")
         self._restore()
         self._guard.reset()
         self.rollbacks += 1

@@ -40,6 +40,9 @@ from concurrent.futures import Future
 
 from ..resilience import faults as _faults
 from ..telemetry import bus as _tel
+from ..telemetry import flight as _flight
+from ..telemetry import http as _http
+from ..telemetry import trace as _trace
 
 __all__ = ["Batcher", "RequestRejected"]
 
@@ -61,12 +64,18 @@ class RequestRejected(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("rows", "future", "deadline", "t_submit", "t_enqueue")
+    __slots__ = ("rows", "future", "deadline", "t_submit", "t_enqueue",
+                 "ctx")
 
-    def __init__(self, rows, deadline, t_submit):
+    def __init__(self, rows, deadline, t_submit, ctx=None):
         self.rows = rows
         self.future = Future()
         self.deadline = deadline
+        # ctx: the request's trace context (minted at submit, None when
+        # telemetry is off) — the batcher worker stamps the queue-wait and
+        # batch-run spans with it so the request's journey across the
+        # thread handoff stays one linked lane in the merged trace.
+        self.ctx = ctx
         # t_submit: when the client entered submit() — queue-wait telemetry
         # must include time spent blocked on backpressure, or the metric
         # reads near-zero in exactly the overload regime it exists for.
@@ -135,6 +144,8 @@ class Batcher:
         self._breaker_cooldown = float(breaker_cooldown_ms) / 1e3
         self._consecutive_failures = 0
         self._breaker_open_until = 0.0
+        # readiness surface: /healthz flips the moment the breaker opens
+        _http.register_health(f"batcher:{runtime.name}", self)
         if start:
             self.start()
 
@@ -178,7 +189,11 @@ class Batcher:
                 if self._closed:
                     self._count_rejection("shutdown")
                     raise RequestRejected("shutdown", "batcher is closed")
-            req = _Request(rows, deadline, t_submit)
+            ctx = None
+            if _tel.enabled:
+                ctx = _trace.start("serving.submit",
+                                   model=self._runtime.name)
+            req = _Request(rows, deadline, t_submit, ctx)
             self._queue.append(req)
             if _tel.enabled:
                 _tel.count("serving.requests", model=self._runtime.name)
@@ -281,18 +296,34 @@ class Batcher:
         tel_on = _tel.enabled
         if tel_on:
             for req in live:
+                wait_ms = (now - req.t_submit) * 1e3
                 _tel.record_span("serving.queue_wait", req.t_submit, now,
-                                 model=self._runtime.name)
-                _tel.count("serving.queue_wait_ms",
-                           (now - req.t_submit) * 1e3,
+                                 model=self._runtime.name, trace=req.ctx)
+                _tel.count("serving.queue_wait_ms", wait_ms,
                            model=self._runtime.name)
+                _tel.observe("serving.queue_wait_ms", wait_ms)
+        _flight.record("serving.batch", detail=self._runtime.name,
+                       value=len(live))
         try:
             if _faults.active:
                 _faults.check("serving.batch")
             with _tel.span("serving.run", model=self._runtime.name,
                            batch=len(live),
                            bucket=self._runtime.bucket_for(len(live))):
+                if tel_on:
+                    t_run = time.perf_counter()
                 outs = self._runtime.run_batch([r.rows for r in live])
+            if tel_on:
+                # each rider's lane shows the batch run it was served in,
+                # linked to its own submit root (the shared span above is
+                # the worker-thread view; these are the request views)
+                t_done = time.perf_counter()
+                for req in live:
+                    if req.ctx is not None:
+                        _tel.record_span("serving.ride", t_run, t_done,
+                                         model=self._runtime.name,
+                                         batch=len(live),
+                                         trace=req.ctx)
         except BaseException as e:
             # a model crash fails THIS batch's futures; the worker survives
             self.batches_failed += 1
@@ -301,6 +332,8 @@ class Batcher:
                            model=self._runtime.name)
                 _tel.instant("serving.batch_failure",
                              model=self._runtime.name, error=repr(e))
+            _flight.record("serving.batch_failure",
+                           detail=f"{self._runtime.name}: {e!r}")
             self._record_batch_failure()
             for req in live:
                 req.future.set_exception(e)
@@ -321,6 +354,9 @@ class Batcher:
         if self._consecutive_failures >= self._breaker_threshold:
             self._breaker_open_until = \
                 time.perf_counter() + self._breaker_cooldown
+            _flight.record("serving.breaker_open",
+                           detail=self._runtime.name,
+                           value=self._consecutive_failures)
             if _tel.enabled:
                 _tel.count("serving.breaker_open",
                            model=self._runtime.name)
@@ -349,6 +385,7 @@ class Batcher:
         already queued before returning — the hot-swap path, so in-flight
         requests complete against the old weights; ``drain=False`` rejects
         the queue with ``reason="shutdown"``."""
+        _http.unregister_health(f"batcher:{self._runtime.name}", self)
         with self._lock:
             if self._closed:
                 return

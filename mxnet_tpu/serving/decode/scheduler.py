@@ -41,6 +41,9 @@ import numpy as np
 from ...analysis import sanitizer as _san
 from ...resilience import faults as _faults
 from ...telemetry import bus as _tel
+from ...telemetry import flight as _flight
+from ...telemetry import http as _http
+from ...telemetry import trace as _trace
 from ..batcher import RequestRejected
 from .kv_cache import KVCacheExhausted, pages_needed
 from .runtime import DecodeRuntime
@@ -72,7 +75,7 @@ class GenerationResult:
 class _Request:
     __slots__ = ("prompt", "max_new", "temp", "key", "eos_id", "deadline",
                  "future", "t_submit", "n_pages", "slot", "tokens",
-                 "position", "step_idx", "cur", "ttft_ms")
+                 "position", "step_idx", "cur", "ttft_ms", "ctx", "lane")
 
     def __init__(self, prompt, max_new, temp, key, eos_id, deadline,
                  t_submit, n_pages):
@@ -91,6 +94,12 @@ class _Request:
         self.step_idx = 0                 # per-request sampling step
         self.cur = 0                      # last sampled token (step input)
         self.ttft_ms = None
+        # ctx: trace context minted at submit (None with telemetry off).
+        # lane: the request's own chrome-trace thread lane (the trace id)
+        # — queue wait, prefill, every ride and the eviction land there,
+        # so one request reads as one horizontal track in Perfetto.
+        self.ctx = None
+        self.lane = None
 
 
 class DecodeScheduler:
@@ -140,6 +149,8 @@ class DecodeScheduler:
         self._breaker_cooldown = float(breaker_cooldown_ms) / 1e3
         self._consecutive_failures = 0
         self._breaker_open_until = 0.0
+        # readiness surface: /healthz flips the moment the breaker opens
+        _http.register_health(f"decode:{runtime.name}", self)
         if start:
             self.start()
 
@@ -183,6 +194,13 @@ class DecodeScheduler:
                     if deadline_ms is not None else None)
         req = _Request(prompt, max_new, float(temperature), key,
                        eos_id, deadline, t_submit, n_pages)
+        if _tel.enabled:
+            # trace root: the request's id; its lane carries every hop
+            # from here to eviction (admission, prefill, each ride)
+            req.ctx = _trace.start("decode.submit", model=rt.name,
+                                   prompt_len=int(prompt.size),
+                                   max_new=max_new)
+            req.lane = req.ctx.trace_id
         with self._lock:
             if self._closed:
                 self._reject(req, "shutdown", "scheduler is closed")
@@ -408,6 +426,8 @@ class DecodeScheduler:
             tables[r] = req.slot.page_table
             keys[r] = req.key
             temps[r] = req.temp
+        _flight.record("decode.prefill", detail=rt.name, value=len(reqs))
+        t_pre = time.perf_counter()
         first = rt.prefill(tokens, lengths, tables, keys, temps)
         now = time.perf_counter()
         done = []
@@ -418,6 +438,17 @@ class DecodeScheduler:
                            model=rt.name)
                 _tel.record_span("decode.ttft", req.t_submit, now,
                                  model=rt.name)
+                _tel.observe("decode.ttft_ms", req.ttft_ms)
+                if req.ctx is not None:
+                    # the request's own lane: time queued, then the
+                    # prefill bucket it rode — both linked to its root
+                    _tel.record_span("decode.queue_wait", req.t_submit,
+                                     t_pre, tid=req.lane, trace=req.ctx,
+                                     model=rt.name)
+                    _tel.record_span("decode.prefill", t_pre, now,
+                                     tid=req.lane, trace=req.ctx,
+                                     model=rt.name, seq_bucket=int(s),
+                                     batch_bucket=int(b))
             req.cur = int(first[r])
             req.tokens.append(req.cur)
             req.step_idx = 1
@@ -456,10 +487,21 @@ class DecodeScheduler:
             keys[r] = req.key
             steps[r] = req.step_idx
             temps[r] = req.temp
+        _flight.record("decode.step", detail=rt.name, value=n)
+        t0 = time.perf_counter()
         nxt = rt.step(tokens, positions, tables, keys, steps, temps)
+        t1 = time.perf_counter()
         if _tel.enabled:
             _tel.count("decode.steps", model=rt.name)
             _tel.count("decode.tokens", n, model=rt.name)
+            _tel.observe("decode.step_ms", (t1 - t0) * 1e3)
+            for req in self._active:
+                if req.ctx is not None:
+                    # every step the request rode, on its own lane —
+                    # "which steps served me" is visible per request
+                    _tel.record_span("decode.ride_step", t0, t1,
+                                     tid=req.lane, trace=req.ctx,
+                                     model=rt.name, batch=n)
         still = []
         for r, req in enumerate(self._active):
             req.cur = int(nxt[r])
@@ -494,12 +536,15 @@ class DecodeScheduler:
         if req.slot is not None:
             self._cache.free(req.slot)
             req.slot = None
-        self._count_eviction(reason)
-
-    def _count_eviction(self, reason):
+        _flight.record("decode.evict", detail=reason)
         if _tel.enabled:
             _tel.count("decode.evictions", model=self._runtime.name,
                        reason=reason)
+            if req.ctx is not None:
+                # the lane's terminal mark, linked to the submit root —
+                # the end of the request's journey in the merged trace
+                _tel.instant("decode.evict", tid=req.lane, trace=req.ctx,
+                             model=self._runtime.name, reason=reason)
 
     def _fail_active(self, exc, joining=()):
         """A prefill/step crash fails the requests that were in flight —
@@ -508,6 +553,8 @@ class DecodeScheduler:
         admitted this boundary whose prefill never completed (they are
         not in the active list yet)."""
         self.steps_failed += 1
+        _flight.record("decode.step_failure",
+                       detail=f"{self._runtime.name}: {exc!r}")
         if _tel.enabled:
             _tel.count("decode.step_failures", model=self._runtime.name)
             _tel.instant("decode.step_failure", model=self._runtime.name,
@@ -528,6 +575,9 @@ class DecodeScheduler:
         if self._consecutive_failures >= self._breaker_threshold:
             self._breaker_open_until = \
                 time.perf_counter() + self._breaker_cooldown
+            _flight.record("decode.breaker_open",
+                           detail=self._runtime.name,
+                           value=self._consecutive_failures)
             if _tel.enabled:
                 _tel.count("decode.breaker_open", model=self._runtime.name)
 
@@ -536,6 +586,7 @@ class DecodeScheduler:
         """Stop the scheduler.  ``drain=True`` (default) finishes every
         queued and active request first; ``drain=False`` rejects the
         queue (``reason="shutdown"``) and fails active requests."""
+        _http.unregister_health(f"decode:{self._runtime.name}", self)
         with self._lock:
             if self._closed:
                 return

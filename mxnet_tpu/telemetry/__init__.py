@@ -40,14 +40,32 @@ Instrumented subsystems (event-name prefix = subsystem):
 - ``engine.*``    — ``engine.bulk`` scopes (reference bulking intent)
 - ``jax.*``       — backend compilations via ``jax.monitoring``
 
-Everything is off by default; when disabled each site costs one module
-attribute read (<2% on the eager microbench, see ``bench.py`` config
-``eager``).
+Three observability layers ride on the bus (PR 15):
+
+- ``telemetry.trace`` — request/step-scoped trace contexts propagated
+  across threads and (simulated-)host processes; ``chrome_trace()`` is
+  the merged multi-lane timeline with parent→child flow links.
+- ``telemetry.flight`` — always-on fixed-size flight recorder, dumped to
+  a post-mortem file when a sanitizer violation / nan rollback / SIGTERM
+  preemption fires.
+- ``telemetry.http`` — opt-in ``/metrics`` + ``/healthz`` + ``/trace``
+  endpoint (``MXNET_METRICS_PORT`` or ``start_server()``).
+
+Everything is off by default (flight recording excepted — it exists for
+the crash nobody armed telemetry for); when disabled each site costs one
+module attribute read (<2% on the eager microbench, see ``bench.py``
+config ``eager``).
 """
 from . import bus  # noqa: F401
 from . import exporters  # noqa: F401
+from . import flight  # noqa: F401
 from . import jax_hooks  # noqa: F401
 from . import sampler  # noqa: F401
+
+# trace imports bus+exporters and lazily touches analysis.divergence;
+# keep it after the core modules so import order stays cycle-free.
+from . import trace  # noqa: F401
+from . import http  # noqa: F401
 from .bus import (  # noqa: F401
     count,
     counter_sample,
@@ -55,8 +73,11 @@ from .bus import (  # noqa: F401
     disable,
     enable,
     gauge,
+    histogram_quantile,
+    histograms,
     instant,
     is_enabled,
+    observe,
     record_span,
     reset,
     snapshot,
@@ -64,19 +85,30 @@ from .bus import (  # noqa: F401
     span_aggregates,
 )
 from .exporters import dump_metrics, dump_trace, trace_events  # noqa: F401
+from .http import (  # noqa: F401
+    register_health,
+    server_port,
+    start_server,
+    stop_server,
+    unregister_health,
+)
 from .jax_hooks import collective_stats, record_collectives  # noqa: F401
 from .sampler import (  # noqa: F401
     sampler_running,
     start_counter_sampler,
     stop_counter_sampler,
 )
+from .trace import TraceContext, chrome_trace  # noqa: F401
 
 __all__ = [
     "enable", "disable", "is_enabled", "reset", "snapshot",
     "span", "count", "gauge", "instant", "counter_sample", "counter_value",
-    "record_span",
+    "record_span", "observe", "histogram_quantile", "histograms",
     "span_aggregates", "dump_trace", "dump_metrics", "trace_events",
+    "TraceContext", "chrome_trace",
+    "start_server", "stop_server", "server_port",
+    "register_health", "unregister_health",
     "collective_stats", "record_collectives",
     "start_counter_sampler", "stop_counter_sampler", "sampler_running",
-    "bus", "exporters", "jax_hooks", "sampler",
+    "bus", "exporters", "flight", "trace", "http", "jax_hooks", "sampler",
 ]

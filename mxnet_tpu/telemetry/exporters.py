@@ -3,7 +3,10 @@
 The chrome exporter mirrors the reference profiler's output contract
 (``src/profiler/profiler.cc EmitEvents`` writes a chrome trace the user
 opens in chrome://tracing or perfetto); the Prometheus dump gives scrapers
-and tests a flat text form of the counters/gauges.
+and tests a flat text form of the counters/gauges/histograms.  The merged
+multi-host/flow-linked export lives in :func:`.trace.chrome_trace` (it
+needs the per-host stream state); this module owns the dumb per-event
+translation both exporters share.
 """
 from __future__ import annotations
 
@@ -12,36 +15,44 @@ import re
 
 from . import bus
 
-__all__ = ["trace_events", "dump_trace", "dump_metrics"]
+__all__ = ["trace_events", "event_dict", "dump_trace", "dump_metrics"]
 
 _PROCESS_NAME = "mxnet_tpu"
 
 
+def event_dict(ev):
+    """ONE bus event tuple → its chrome trace-event dict (ts/dur in us).
+    Shared by the ring exporter below and the per-host stream writer in
+    :mod:`.trace`, so the two serializations can never drift."""
+    kind, name, cat, ts, dur, tid, attrs, pid = ev
+    out = {"name": name, "cat": cat, "ts": round(ts, 3), "pid": pid,
+           "tid": tid}
+    if kind == "X":
+        out["ph"] = "X"
+        out["dur"] = round(dur, 3)
+    elif kind == "I":
+        out["ph"] = "i"
+        out["s"] = "t"       # thread-scoped instant
+    elif kind == "C":
+        out["ph"] = "C"
+    if attrs:
+        out["args"] = {k: v for k, v in attrs.items()}
+    return out
+
+
 def trace_events():
     """The ring's events as chrome trace-event dicts (ts/dur in us)."""
-    out = []
-    for kind, name, cat, ts, dur, tid, attrs in bus.events():
-        ev = {"name": name, "cat": cat, "ts": round(ts, 3), "pid": 1,
-              "tid": tid}
-        if kind == "X":
-            ev["ph"] = "X"
-            ev["dur"] = round(dur, 3)
-        elif kind == "I":
-            ev["ph"] = "i"
-            ev["s"] = "t"       # thread-scoped instant
-        elif kind == "C":
-            ev["ph"] = "C"
-        if attrs:
-            ev["args"] = {k: v for k, v in attrs.items()}
-        out.append(ev)
-    return out
+    return [event_dict(ev) for ev in bus.events()]
 
 
 def dump_trace(path=None):
     """Write (or return) a chrome://tracing-loadable JSON object with every
     span/instant/counter-sample currently in the ring, plus one metadata
-    event naming the process.  ``path=None`` returns the dict."""
-    events = [{"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+    event naming the process.  ``path=None`` returns the dict.
+
+    Single-process export; :func:`.trace.chrome_trace` is the merged
+    multi-host form with flow links between parent and child spans."""
+    events = [{"name": "process_name", "ph": "M", "pid": bus.pid, "tid": 0,
                "args": {"name": _PROCESS_NAME}}]
     events.extend(trace_events())
     doc = {"traceEvents": events, "displayTimeUnit": "ms"}
@@ -58,11 +69,18 @@ def _prom_name(name):
     return "mxnet_" + _METRIC_OK.sub("_", name)
 
 
+def _fmt_le(le):
+    if le == "+Inf":
+        return "+Inf"
+    return repr(float(le))
+
+
 def dump_metrics():
-    """Prometheus-style text exposition of counters and gauges.
+    """Prometheus-style text exposition of counters, gauges and histograms.
 
     Counter totals come first, then per-label breakdowns, then gauges;
-    span aggregates export as ``_calls`` / ``_total_ms`` pairs."""
+    span aggregates export as ``_calls`` / ``_total_ms`` pairs; histograms
+    as cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count``."""
     snap = bus.snapshot()
     lines = []
     for name in sorted(snap["counters"]):
@@ -85,4 +103,11 @@ def dump_metrics():
         lines.append(f"{metric}_calls {row['calls']}")
         lines.append(f"# TYPE {metric}_total_ms counter")
         lines.append(f"{metric}_total_ms {row['total_ms']}")
+    for name, row in sorted(bus.histograms().items()):
+        metric = _prom_name(name)
+        lines.append(f"# TYPE {metric} histogram")
+        for le, cum in row["buckets"]:
+            lines.append(f'{metric}_bucket{{le="{_fmt_le(le)}"}} {cum}')
+        lines.append(f"{metric}_sum {round(row['sum'], 6)}")
+        lines.append(f"{metric}_count {row['count']}")
     return "\n".join(lines) + ("\n" if lines else "")

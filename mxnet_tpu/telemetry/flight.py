@@ -1,0 +1,196 @@
+"""Always-on flight recorder: the last N things each host did, for free.
+
+PR 10's sanitizer can name the diverging collective, but by the time a
+``CollectiveDivergenceError`` fires the question is "what was this host
+*doing* for the last five seconds?" — and the telemetry bus is off by
+default, so usually nothing recorded it.  The flight recorder is the
+always-on complement: a fixed-size ring of tiny event records cheap enough
+to leave ON in production (one module-attr guard, preallocated slot lists,
+no allocation and no lock on the hot path — the slot index advance and the
+five in-place stores are each GIL-atomic; a torn slot during a concurrent
+dump reads as a slightly stale row, which is exactly the fidelity a
+post-mortem needs).
+
+Recording sites are the *coarse* framework beats — trainer steps, decode
+boundaries, batch dispatches, checkpoint saves, collective fingerprints,
+evictions, breaker trips — not per-op events, so a 4096-slot ring holds
+minutes of history at production rates.
+
+:func:`postmortem` is the crash hook: the sanitizer's ``_violation``
+funnel, the nan-guard rollback, and SIGTERM preemption call it with a
+reason, and it writes ring contents + active telemetry spans + counter/
+gauge snapshot + the collective fingerprint positions to a JSON file —
+per host, so a pod-wide post-mortem is one file per host naming each
+host's last N events.  It never raises: a failed dump must not mask the
+error that triggered it.
+
+Env knobs: ``MXNET_FLIGHT=0`` disables recording entirely;
+``MXNET_FLIGHT_CAPACITY`` resizes the ring; ``MXNET_FLIGHT_DIR`` arms
+automatic dump files (without it, :func:`postmortem` records the event in
+telemetry but writes nothing — tests and libraries stay file-clean by
+default).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from . import bus
+
+__all__ = ["enabled", "record", "events", "dump", "postmortem",
+           "configure", "reset", "last_dump_path", "DEFAULT_CAPACITY"]
+
+DEFAULT_CAPACITY = 4096
+
+# Module-global fast path, same contract as bus.enabled — but default ON:
+# the whole point is having history when nobody expected the crash.
+enabled = os.environ.get("MXNET_FLIGHT", "1") not in ("0", "", "false")
+
+_capacity = int(os.environ.get("MXNET_FLIGHT_CAPACITY", DEFAULT_CAPACITY))
+# Preallocated ring: _ring[i] = [t_monotonic, name, detail, value, tid].
+# Slots are reused in place — record() never allocates beyond the int index.
+_ring = [[0.0, None, None, None, 0] for _ in range(_capacity)]
+_idx = 0            # next slot to write (monotonic, wraps via modulo)
+_dump_lock = threading.Lock()
+_dump_count = 0
+_last_dump = None
+
+
+def configure(capacity=None, on=None):
+    """Resize the ring / toggle recording (tests; production uses env)."""
+    global _ring, _idx, _capacity, enabled
+    if capacity is not None:
+        _capacity = max(int(capacity), 8)
+        _ring = [[0.0, None, None, None, 0] for _ in range(_capacity)]
+        _idx = 0
+    if on is not None:
+        enabled = bool(on)
+
+
+def reset():
+    """Clear recorded events (capacity keeps)."""
+    global _idx
+    for slot in _ring:
+        slot[1] = None
+    _idx = 0
+
+
+def record(name, detail=None, value=None):
+    """Drop one event into the ring.  Hot-path safe: no locks, no
+    allocation — five in-place stores into a preallocated slot.  Callers
+    guard with ``if flight.enabled:`` only when building ``detail`` costs
+    something; the call itself is cheap enough to make unconditionally."""
+    global _idx
+    if not enabled:
+        return
+    i = _idx
+    _idx = i + 1
+    slot = _ring[i % _capacity]
+    slot[0] = time.monotonic()
+    slot[1] = name
+    slot[2] = detail
+    slot[3] = value
+    slot[4] = threading.get_ident()
+
+
+def events():
+    """Ring contents oldest→newest as ``(t, name, detail, value, tid)``
+    tuples (empty slots skipped)."""
+    i, cap = _idx, _capacity
+    out = []
+    start = max(i - cap, 0)
+    for j in range(start, i):
+        t, name, detail, value, tid = _ring[j % cap]
+        if name is not None:
+            out.append((t, name, detail, value, tid))
+    return out
+
+
+def last_dump_path():
+    """Path of the most recent :func:`dump` file (None if none yet)."""
+    return _last_dump
+
+
+def _host_identity():
+    # env first so dumps name the right host even while analysis is
+    # mid-import (divergence imports telemetry; see trace._host_identity)
+    env = os.environ.get("MXNET_CKPT_HOST")
+    if env:
+        h, sep, c = env.partition("/")
+        if sep and h.strip().isdigit() and c.strip().isdigit():
+            return int(h), int(c)
+    try:
+        from ..analysis import divergence
+        return divergence.host_identity()
+    except Exception:
+        return 0, 1
+
+
+def _auto_path(host):
+    d = os.environ.get("MXNET_FLIGHT_DIR")
+    if not d:
+        return None
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(
+        d, f"flight-{host}-{os.getpid()}-{_dump_count}.json")
+
+
+def dump(reason, path=None, error=None):
+    """Write the post-mortem file: ring events, live telemetry spans,
+    counter/gauge/histogram snapshot, and the collective fingerprint
+    positions (what PR 10 recorded each host sending).  Returns the path,
+    or None when no ``path`` was given and ``MXNET_FLIGHT_DIR`` is unset.
+
+    Prefer :func:`postmortem` from error paths — it never raises."""
+    global _dump_count, _last_dump
+    host, host_count = _host_identity()
+    with _dump_lock:
+        if path is None:
+            path = _auto_path(host)
+        if path is None:
+            return None
+        _dump_count += 1
+        now = time.monotonic()
+        doc = {
+            "reason": reason,
+            "error": repr(error) if error is not None else None,
+            "host": host,
+            "host_count": host_count,
+            "ospid": os.getpid(),
+            "wall_time": time.time(),
+            "events": [
+                {"age_s": round(now - t, 6), "name": name,
+                 "detail": detail, "value": value, "tid": tid}
+                for t, name, detail, value, tid in events()],
+            "active_spans": [
+                {"name": name, "open_for_s": round(
+                    time.perf_counter() - t0, 6), "tid": tid}
+                for name, t0, tid in bus.open_spans()],
+            "telemetry": bus.snapshot(),
+        }
+        try:
+            from ..analysis import divergence
+            doc["collective_positions"] = divergence.positions()
+        except Exception:
+            doc["collective_positions"] = None
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, default=repr)
+        _last_dump = path
+    return path
+
+
+def postmortem(reason, error=None, path=None):
+    """The error-path entry point: best-effort :func:`dump` that NEVER
+    raises (the fault that triggered it must surface, not an OSError from
+    a full disk).  Also marks the moment in the ring and the telemetry
+    bus so a later dump shows this one fired."""
+    try:
+        record("flight.postmortem", detail=reason)
+        if bus.enabled:
+            bus.count("flight.postmortems")
+            bus.instant("flight.postmortem", reason=reason)
+        return dump(reason, path=path, error=error)
+    except Exception:
+        return None

@@ -1,0 +1,172 @@
+"""Live observability endpoint: /metrics, /healthz, /trace over stdlib http.
+
+The serving-front-door roadmap item needs a readiness surface a load
+balancer / Prometheus scraper / engineer-with-curl can hit without
+touching the Python process.  This is it, deliberately tiny: a
+``ThreadingHTTPServer`` on localhost (opt-in via ``MXNET_METRICS_PORT``
+or :func:`start_server`), three routes:
+
+- ``GET /metrics`` — Prometheus text exposition
+  (:func:`..exporters.dump_metrics`): every counter, gauge, span
+  aggregate and histogram the bus holds.
+- ``GET /healthz`` — 200 when every registered health probe says healthy,
+  503 otherwise.  ``Batcher`` and ``DecodeScheduler`` auto-register
+  their circuit-breaker state on construction (weakly — a dropped
+  component never pins or poisons the endpoint), so the route flips the
+  moment a breaker opens.
+- ``GET /trace`` — the current merged chrome trace
+  (:func:`..trace.chrome_trace`), loadable straight into Perfetto.
+
+The server thread is a daemon AND registered with atexit for a bounded
+join, so interpreter exit never hangs on an open socket.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import weakref
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import exporters
+
+__all__ = ["start_server", "stop_server", "server_port",
+           "register_health", "unregister_health", "health"]
+
+# ------------------------------------------------------- health probe registry
+_health_lock = threading.Lock()
+_health = {}        # name -> weakref to an object with .healthy
+
+
+def register_health(name, obj):
+    """Register ``obj`` (anything exposing ``.healthy`` — property or
+    nullary method) under ``name``.  Weakly referenced: a collected
+    component silently drops out instead of failing health forever."""
+    with _health_lock:
+        _health[name] = weakref.ref(obj)
+
+
+def unregister_health(name, obj=None):
+    """Remove a probe.  With ``obj`` given, remove only if the entry still
+    points at it — so ``registry.swap()`` patterns where a new component
+    registered under the same name don't get torn down by the old one's
+    close()."""
+    with _health_lock:
+        ref = _health.get(name)
+        if ref is None:
+            return
+        if obj is None or ref() is obj or ref() is None:
+            del _health[name]
+
+
+def health():
+    """``(ok, {name: bool})`` across live probes.  A probe that raises
+    counts as unhealthy; a dead weakref is dropped."""
+    with _health_lock:
+        items = list(_health.items())
+    report, ok = {}, True
+    for name, ref in items:
+        obj = ref()
+        if obj is None:
+            with _health_lock:
+                if _health.get(name) is ref:
+                    del _health[name]
+            continue
+        try:
+            h = obj.healthy
+            if callable(h):
+                h = h()
+            h = bool(h)
+        except Exception:
+            h = False
+        report[name] = h
+        ok = ok and h
+    return ok, report
+
+
+# ----------------------------------------------------------------- the server
+_server_lock = threading.Lock()
+_server = None
+_thread = None
+
+
+class _Handler(BaseHTTPRequestHandler):
+
+    def _send(self, code, body, ctype="text/plain; charset=utf-8"):
+        data = body.encode() if isinstance(body, str) else body
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                self._send(200, exporters.dump_metrics())
+            elif path == "/healthz":
+                ok, report = health()
+                body = json.dumps({"ok": ok, "components": report}) + "\n"
+                self._send(200 if ok else 503, body, "application/json")
+            elif path == "/trace":
+                from . import trace
+                self._send(200, json.dumps(trace.chrome_trace()),
+                           "application/json")
+            else:
+                self._send(404, "not found\n")
+        except Exception as e:     # noqa: BLE001 — a scrape must not kill us
+            try:
+                self._send(500, f"error: {e!r}\n")
+            except OSError:
+                pass
+
+    def log_message(self, *args):  # noqa: D102 — silence per-request stderr
+        pass
+
+
+def start_server(port=0, host="127.0.0.1"):
+    """Start the endpoint (idempotent); returns the bound port.  ``port=0``
+    binds an ephemeral port — the return value is how tests find it."""
+    global _server, _thread
+    with _server_lock:
+        if _server is not None:
+            return _server.server_address[1]
+        _server = ThreadingHTTPServer((host, int(port)), _Handler)
+        _server.daemon_threads = True
+        _thread = threading.Thread(target=_server.serve_forever,
+                                   kwargs={"poll_interval": 0.2},
+                                   name="telemetry-http", daemon=True)
+        _thread.start()
+        return _server.server_address[1]
+
+
+def stop_server(timeout=5.0):
+    """Shut the endpoint down with a bounded join (also runs at atexit, so
+    interpreter teardown never hangs on the serve loop)."""
+    global _server, _thread
+    with _server_lock:
+        srv, thr = _server, _thread
+        _server = _thread = None
+    if srv is None:
+        return
+    try:
+        srv.shutdown()
+        srv.server_close()
+    except OSError:
+        pass
+    if thr is not None and thr.is_alive():
+        thr.join(timeout=timeout)
+
+
+def server_port():
+    """The bound port, or None when the server is down."""
+    with _server_lock:
+        return _server.server_address[1] if _server is not None else None
+
+
+atexit.register(stop_server)
+
+if os.environ.get("MXNET_METRICS_PORT"):
+    start_server(int(os.environ["MXNET_METRICS_PORT"]))

@@ -21,6 +21,7 @@ configuration: calling it again restarts the thread with the new settings.
 """
 from __future__ import annotations
 
+import atexit
 import threading
 
 from . import bus
@@ -79,3 +80,10 @@ def stop_counter_sampler():
 def sampler_running():
     with _lock:
         return _thread is not None and _thread.is_alive()
+
+
+# The thread is a daemon, but relying on daemon-kill at interpreter exit
+# can race module teardown (the sampler tick touching a half-collected
+# bus prints spurious warnings).  A bounded atexit join ends it cleanly;
+# the 5 s join cap inside _stop_unlocked keeps exit from ever hanging.
+atexit.register(stop_counter_sampler)

@@ -1,0 +1,325 @@
+"""Request/step-scoped trace contexts + the merged multi-host chrome trace.
+
+PR 1's bus records single-thread spans; the latency that decides serving
+and pod behavior lives *between* threads and processes — a decode request
+crosses the client thread (submit), the scheduler worker (prefill, every
+step it rides, eviction), and possibly another host entirely.  This module
+adds the propagation layer:
+
+- :func:`start` mints a ``TraceContext`` — a ``(trace_id, span_id)`` pair —
+  at the request/step entry points (``Batcher.submit``, ``DecodeScheduler``
+  admission, ``ResilientTrainer.step``).
+- :class:`use` activates a context on the current thread: every
+  ``bus.span`` entered under it mints a child span id and stamps
+  ``trace_id``/``span_id``/``parent_id`` into its event attrs, so nesting
+  falls out of the existing instrumentation unchanged.
+- :func:`child` mints an explicit child link for spans recorded *on behalf
+  of* a context from another thread (``bus.record_span(..., trace=...)``)
+  — the decode scheduler emitting a request's per-step ride on the
+  request's own lane, the io consumer emitting a worker process's decode
+  span.
+- **Process boundaries** mirror the divergence sanitizer's stream-file
+  scheme: :func:`configure` (or ``MXNET_TRACE_DIR`` at import) points
+  ``bus.stream`` at an append-only per-host JSONL file
+  (``trace-<host>.jsonl``), host identity resolved exactly like
+  ``analysis.divergence`` (configure pin → ``MXNET_CKPT_HOST`` → jax
+  process topology).  In simulated-host mode the host index becomes the
+  chrome ``pid`` lane, so a merged pod trace renders one process group
+  per host.
+- :func:`chrome_trace` merges the local ring with every peer host's
+  stream file into ONE timeline: per-host ``pid`` lanes, clock-rebased
+  timestamps (``perf_counter`` is CLOCK_MONOTONIC — shared across
+  processes on a machine — so a recorded epoch per stream aligns them
+  exactly), and chrome flow events (``ph:"s"``/``"f"``) drawn from the
+  ``parent_id`` links so Perfetto renders a request's journey
+  submit → queue wait → prefill → every ride → eviction as one arrow
+  chain.
+
+Everything here is telemetry-gated: with the bus disabled, minting sites
+cost one attribute read and no context is ever created.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+
+from . import bus
+from . import exporters
+
+__all__ = ["TraceContext", "start", "current", "use", "child",
+           "configure", "disarm", "trace_dir", "chrome_trace"]
+
+
+class TraceContext:
+    """A ``(trace_id, span_id)`` pair naming one request/step and the span
+    inside it that new children should hang off.  Immutable; pass it
+    across threads freely (activation is per-thread via :class:`use`)."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id, span_id):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __repr__(self):
+        return (f"TraceContext(trace_id={self.trace_id:#x}, "
+                f"span_id={self.span_id:#x})")
+
+
+def start(name=None, **attrs):
+    """Mint a fresh root context (the trace_id doubles as the root span
+    id).  With a ``name`` and the bus enabled, an instant marks the birth
+    in the trace — the request's lane starts with it."""
+    tid = bus.new_id()
+    ctx = TraceContext(tid, tid)
+    if name is not None and bus.enabled:
+        bus.instant(name, trace=(tid, tid, 0), **attrs)
+    return ctx
+
+
+def current():
+    """The context active on THIS thread (innermost), or None."""
+    top = bus.trace_current()
+    return TraceContext(top[0], top[1]) if top is not None else None
+
+
+def child(ctx):
+    """An explicit ``(trace_id, span_id, parent_id)`` link minting a fresh
+    child of ``ctx`` — for ``bus.record_span(..., trace=child(ctx))`` when
+    the span is recorded on another thread on the context's behalf."""
+    return (ctx.trace_id, bus.new_id(), ctx.span_id)
+
+
+class use:
+    """Activate ``ctx`` on this thread for the ``with`` body (None is a
+    no-op, so call sites don't need to branch on telemetry being off)::
+
+        ctx = trace.start() if bus.enabled else None
+        with trace.use(ctx):
+            ...  # every span entered here nests under ctx
+    """
+
+    __slots__ = ("_ctx", "_pushed")
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+        self._pushed = False
+
+    def __enter__(self):
+        if self._ctx is not None:
+            bus._trace_stack().append((self._ctx.trace_id,
+                                       self._ctx.span_id))
+            self._pushed = True
+        return self._ctx
+
+    def __exit__(self, *exc):
+        if self._pushed:
+            stack = getattr(bus._tls, "trace", None)
+            if stack:
+                stack.pop()
+            self._pushed = False
+        return False
+
+
+# ------------------------------------------------------- per-host streaming
+_lock = threading.Lock()
+_armed = {"dir": None, "host": None, "host_count": None, "path": None,
+          "file": None}
+
+
+def _host_identity():
+    # env first (the simulated-host harness always sets MXNET_CKPT_HOST),
+    # THEN analysis.divergence — the env path must work even while
+    # analysis is mid-import (divergence itself imports telemetry, so the
+    # import-time arm below can run before divergence's body finishes)
+    env = os.environ.get("MXNET_CKPT_HOST")
+    if env:
+        h, sep, c = env.partition("/")
+        if sep and h.strip().isdigit() and c.strip().isdigit():
+            return int(h), int(c)
+    try:
+        from ..analysis import divergence
+        return divergence.host_identity()
+    except Exception:
+        return 0, 1
+
+
+def _stream_path(d, host):
+    return os.path.join(d, f"trace-{int(host)}.jsonl")
+
+
+def trace_dir():
+    """The armed stream directory, or the ``MXNET_TRACE_DIR`` env value."""
+    with _lock:
+        if _armed["dir"] is not None:
+            return _armed["dir"]
+    return os.environ.get("MXNET_TRACE_DIR") or None
+
+
+def configure(directory, host=None, host_count=None):
+    """Arm per-host event streaming into ``directory`` (the
+    ``MXNET_SANITIZE_DIR`` scheme: one append-only file per host, merged
+    later by :func:`chrome_trace`).
+
+    ``host``/``host_count`` pin the identity; default resolution matches
+    ``analysis.divergence.host_identity`` (``MXNET_CKPT_HOST=h/H``, then
+    the real jax topology).  In multi-host mode the host index becomes
+    ``bus.pid`` — the chrome process lane — and is folded into the span-id
+    seed so two hosts can never mint colliding ids."""
+    if host is None or host_count is None:
+        rh, rc = _host_identity()
+        host = rh if host is None else int(host)
+        host_count = rc if host_count is None else int(host_count)
+    else:
+        host, host_count = int(host), int(host_count)
+    os.makedirs(directory, exist_ok=True)
+    path = _stream_path(directory, host)
+    with _lock:
+        _close_locked()
+        _armed.update(dir=str(directory), host=host, host_count=host_count,
+                      path=path)
+        _armed["file"] = f = open(path, "a", encoding="utf-8")
+        # clock-sync header: perf_counter is CLOCK_MONOTONIC (shared across
+        # processes on a machine), so recording each stream's epoch lets
+        # the merger rebase every lane onto one exact time axis
+        f.write(json.dumps({"__mxnet_trace__": 1, "host": host,
+                            "host_count": host_count,
+                            "epoch_s": bus._epoch}) + "\n")
+        f.flush()
+    if host_count > 1:
+        bus.pid = host
+        with bus._id_lock:
+            bus._id_seed = (((host + 1) & 0xff) << 48) | \
+                (os.getpid() & 0xfffff) << 28
+    bus.stream = _write_event
+
+
+def _close_locked():
+    if _armed["file"] is not None:
+        try:
+            _armed["file"].close()
+        except OSError:
+            pass
+        _armed["file"] = None
+
+
+def disarm():
+    """Stop streaming and restore the default process lane (tests)."""
+    bus.stream = None
+    bus.pid = 1
+    with _lock:
+        _close_locked()
+        _armed.update(dir=None, host=None, host_count=None, path=None)
+
+
+def _write_event(ev):
+    with _lock:
+        f = _armed["file"]
+        if f is None:
+            return
+        f.write(json.dumps(exporters.event_dict(ev)) + "\n")
+        f.flush()
+
+
+# ---------------------------------------------------------------- the merge
+def _read_stream(path):
+    """(epoch_s, events) from one host stream file — tolerant of a torn
+    final line (the writer may have died mid-append)."""
+    epoch, events = None, []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if "__mxnet_trace__" in obj:
+                    epoch = float(obj.get("epoch_s") or 0.0)
+                else:
+                    events.append(obj)
+    except OSError:
+        return None, []
+    return epoch, events
+
+
+def chrome_trace(path=None, directory=None):
+    """ONE merged chrome://tracing/Perfetto timeline: the local ring plus
+    every peer host's stream file under ``directory`` (default: the armed
+    / ``MXNET_TRACE_DIR`` directory), with
+
+    - per-host ``pid`` lanes (``process_name`` metadata per host),
+    - timestamps rebased onto a common clock via each stream's recorded
+      ``perf_counter`` epoch,
+    - chrome flow events (``ph:"s"``/``"f"``) linking every span that
+      carries a ``parent_id`` to its parent span's lane — the arrows that
+      make a request's cross-thread/cross-host journey one chain.
+
+    ``path=None`` returns the dict; else writes JSON and returns the dict.
+    Works both inside a host process (its own stream file is skipped — the
+    ring already holds those events) and in a driver process that only
+    merges files."""
+    directory = directory if directory is not None else trace_dir()
+    with _lock:
+        own = _armed["path"]
+    sources = [(bus._epoch, exporters.trace_events())]
+    if directory and os.path.isdir(directory):
+        for fp in sorted(glob.glob(os.path.join(directory,
+                                                "trace-*.jsonl"))):
+            if own is not None and os.path.abspath(fp) == \
+                    os.path.abspath(own):
+                continue
+            epoch, evs = _read_stream(fp)
+            if evs:
+                sources.append((epoch if epoch is not None
+                                else bus._epoch, evs))
+    base = min(ep for ep, _ in sources)
+    merged = []
+    for ep, evs in sources:
+        shift = (ep - base) * 1e6
+        if shift:
+            evs = [dict(e, ts=round(e.get("ts", 0) + shift, 3))
+                   for e in evs]
+        merged.extend(evs)
+    # lane metadata: one process_name per distinct pid lane
+    pids = sorted({e.get("pid", 1) for e in merged} | {bus.pid})
+    meta = [{"name": "process_name", "ph": "M", "pid": p, "tid": 0,
+             "args": {"name": f"host {p}" if len(pids) > 1
+                      else "mxnet_tpu"}}
+            for p in pids]
+    # flow links: span_id -> lane of the parent; one s/f pair per child
+    by_span = {}
+    for e in merged:
+        args = e.get("args")
+        if args and "span_id" in args:
+            by_span[args["span_id"]] = e
+    flows = []
+    for e in merged:
+        args = e.get("args")
+        if not args:
+            continue
+        parent = by_span.get(args.get("parent_id"))
+        if parent is None:
+            continue
+        fid = args.get("span_id", bus.new_id())
+        flows.append({"name": "link", "cat": "trace", "ph": "s",
+                      "id": fid, "pid": parent["pid"],
+                      "tid": parent["tid"], "ts": parent["ts"]})
+        flows.append({"name": "link", "cat": "trace", "ph": "f",
+                      "bp": "e", "id": fid, "pid": e["pid"],
+                      "tid": e["tid"], "ts": e["ts"]})
+    doc = {"traceEvents": meta + merged + flows, "displayTimeUnit": "ms"}
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc
+
+
+if os.environ.get("MXNET_TRACE_DIR"):
+    # arm at import, exactly like MXNET_SANITIZE_DIR arms the fingerprint
+    # streams — worker processes opt in purely through the environment
+    configure(os.environ["MXNET_TRACE_DIR"])

@@ -1,0 +1,124 @@
+"""Subprocess entry for the two-simulated-host *tracing* drills
+(tests/test_trace.py and the ci trace stage).
+
+Each invocation is one simulated host (``--host h/H``) running with the
+full observability stack armed through the environment alone —
+``MXNET_TELEMETRY=1`` (bus), ``MXNET_TRACE_DIR`` (per-host event stream
+for the merged chrome trace), ``MXNET_FLIGHT_DIR`` (post-mortem dumps),
+and ``MXNET_SANITIZE=collectives`` + ``MXNET_SANITIZE_DIR`` (the PR 10
+cross-check whose violation funnel triggers the flight dump).
+
+The script runs ``--steps`` SPMD train steps (each minting a step trace
+context that streams to ``trace-<h>.jsonl``), then a sharded checkpoint
+save and a final sanitizer sync.  A clean run exits 0 and must leave NO
+flight dump; ``--diverge-at N`` plants the PR 10 divergence (this host
+issues a pipeline schedule where its peer issues a train step), which
+must exit 3 AND leave a ``flight-<h>-*.json`` post-mortem naming this
+host's last ring events.  Exit 4 = stall timeout.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+BATCH = 16
+FEATS = 8
+N_CLASSES = 4
+
+
+def build_trainer(seed=0):
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import (FunctionalOptimizer, SPMDTrainer,
+                                    make_mesh)
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = mx.gluon.nn.HybridSequential(prefix="trc_")
+    with net.name_scope():
+        net.add(mx.gluon.nn.Dense(16, activation="relu", in_units=FEATS),
+                mx.gluon.nn.Dense(N_CLASSES, in_units=16))
+    net.initialize()
+    return SPMDTrainer(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                       FunctionalOptimizer("sgd", 1e-2),
+                       make_mesh(n_devices=4, dp=2, tp=2), nan_guard=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", required=True,
+                    help="shared dir: trace streams + flight dumps + "
+                         "fingerprint streams + checkpoint")
+    ap.add_argument("--host", required=True, help="h/H simulated identity")
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--diverge-at", type=int, default=None)
+    ap.add_argument("--timeout", type=float, default=20.0)
+    args = ap.parse_args(argv)
+
+    # the whole stack arms from env, BEFORE any mxnet_tpu numerics import —
+    # exactly how a production launcher would opt a pod in
+    os.environ["MXNET_TELEMETRY"] = "1"
+    os.environ["MXNET_TRACE_DIR"] = args.dir
+    os.environ["MXNET_FLIGHT_DIR"] = args.dir
+    os.environ["MXNET_SANITIZE"] = "collectives"
+    os.environ["MXNET_CKPT_HOST"] = args.host
+    os.environ["MXNET_SANITIZE_DIR"] = args.dir
+
+    import numpy as np
+    import jax.numpy as jnp
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.analysis import divergence as div
+    from mxnet_tpu.analysis import sanitizer as san
+    from mxnet_tpu.parallel import (CommitBarrierTimeout,
+                                    SPMDCheckpointManager, pipeline)
+    from mxnet_tpu.telemetry import flight, trace
+
+    assert telemetry.is_enabled(), "MXNET_TELEMETRY=1 must arm the bus"
+    assert trace.trace_dir() == args.dir, "MXNET_TRACE_DIR must arm streaming"
+    assert flight.enabled, "flight recorder is on by default"
+    host, _, host_count = args.host.partition("/")
+    host, host_count = int(host), int(host_count)
+
+    tr = build_trainer()
+    rng = np.random.RandomState(7)
+    batches = [(rng.randn(BATCH, FEATS).astype("float32"),
+                rng.randint(0, N_CLASSES, BATCH).astype("float32"))
+               for _ in range(args.steps)]
+    try:
+        for i, (x, y) in enumerate(batches):
+            if args.diverge_at is not None and i == args.diverge_at:
+                from mxnet_tpu.parallel import make_mesh
+                mesh = make_mesh(n_devices=8, pp=8)
+                pipeline.gpipe(lambda p, xx: xx * p.sum(),
+                               jnp.ones((8, 4)), jnp.ones((16, 4)), mesh, 4)
+                print(f"DIVERGED host={host} at step {i}", flush=True)
+            else:
+                tr.step(x, y)
+        mgr = SPMDCheckpointManager(args.dir, host_index=host,
+                                    host_count=host_count,
+                                    barrier_timeout_s=args.timeout)
+        mgr.save(tr._t, tr)
+        div.sync("post-save", timeout_s=args.timeout)
+    except san.CollectiveDivergenceError as e:
+        # sanitizer._violation already wrote the flight dump before raising
+        print(f"DIVERGENCE host={host}: {e}", flush=True)
+        print(f"FLIGHT-DUMP host={host}: {flight.last_dump_path()}",
+              flush=True)
+        return 3
+    except (san.CollectiveStallTimeout, CommitBarrierTimeout) as e:
+        print(f"STALL-TIMEOUT host={host}: {e}", flush=True)
+        return 4
+    print(f"CLEAN host={host} steps={tr._t} "
+          f"events={telemetry.snapshot()['n_events']} "
+          f"violations={san.stats()['violations']}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
