@@ -1159,7 +1159,14 @@ def bench_decode():
     Same model, same compiled programs, same per-request token streams
     (the row-stable bitwise contract) — the speedup is pure scheduling.
     Also reports KV-cache peak occupancy per mode and steady-state
-    ``decode.compile_miss`` (must be 0)."""
+    ``decode.compile_miss`` (must be 0).
+
+    Two ISSUE-17 probes ride along: a **prefix-hit TTFT** comparison
+    (same system prompt resubmitted after publish — admission is a
+    page-table update plus a cached-logits first token, no prefill at
+    all) and a **kv_dtype sweep** (fp32 vs int8 pools at the SAME pool
+    byte budget: tokens/sec, peak occupancy, and how many concurrent
+    sessions the pool can admit)."""
     import time as _time
     from mxnet_tpu import telemetry
     from mxnet_tpu.serving.decode import DecodeSession, get_decode_model
@@ -1236,6 +1243,96 @@ def bench_decode():
         # the bitwise contract is what makes this comparison honest
         parity = all(a.token_ids == b.token_ids
                      for a, b in zip(res_c, res_s))
+
+        # ---- prefix-hit TTFT vs cold TTFT ---------------------------
+        # publish one 24-token system prompt, then alternate unique
+        # cold prompts with resubmits of the shared one; the hit path
+        # skips prefill entirely so its TTFT is the honest win of the
+        # shared-prefix cache.
+        sess.cache.drop_prefix_cache()
+        hits0 = telemetry.counter_value("decode.prefix_hits")
+        sysp = list(rng.randint(1, 512, 24))
+        sess.generate(sysp, max_new_tokens=4, temperature=0.0,
+                      seed=1000, timeout=600)
+        cold_ttfts, hit_ttfts = [], []
+        for i in range(8):
+            r = sess.generate(list(rng.randint(1, 512, 24)),
+                              max_new_tokens=4, temperature=0.0,
+                              seed=2000 + i, timeout=600)
+            cold_ttfts.append(r.ttft_ms)
+            r = sess.generate(sysp, max_new_tokens=4, temperature=0.0,
+                              seed=3000 + i, timeout=600)
+            hit_ttfts.append(r.ttft_ms)
+        cold_ttfts.sort()
+        hit_ttfts.sort()
+        prefix_ttft = {
+            "hit_ttft_ms_p50": round(hit_ttfts[len(hit_ttfts) // 2], 2),
+            "cold_ttft_ms_p50": round(cold_ttfts[len(cold_ttfts) // 2], 2),
+            "speedup": round(cold_ttfts[len(cold_ttfts) // 2]
+                             / max(hit_ttfts[len(hit_ttfts) // 2], 1e-9), 1),
+            "prefix_hits": int(
+                telemetry.counter_value("decode.prefix_hits") - hits0),
+        }
+
+        # ---- kv_dtype sweep at a fixed pool byte budget -------------
+        # budget = the fp32 pool; int8 buys ~3x the pages (values in
+        # int8 + two f32 sidecars per row), so at equal bytes it must
+        # admit >= 2x the concurrent sessions.
+        from mxnet_tpu.serving.decode import PagedKVCache, pages_needed
+        geom = sess.cache
+        budget = geom.page_bytes * 64
+        probe = PagedKVCache(
+            geom.num_layers, geom.num_heads, geom.head_dim,
+            page_size=geom.page_size, num_pages=2, max_pages_per_seq=1,
+            max_slots=1, kv_dtype="int8")
+        page_bytes = {"float32": geom.page_bytes, "int8": probe.page_bytes}
+        del probe
+        sweep_len, sweep_new = 24, 8
+        sweep = {"pool_bytes": budget}
+        for kvd in ("float32", "int8"):
+            n_pages = max(2, budget // page_bytes[kvd])
+            # max_slots deliberately high: the POOL must be the binding
+            # admission constraint, that's what the sweep measures
+            s = DecodeSession(net, batch_buckets=(1, 2, 4),
+                              seq_buckets=(16, 32), page_size=8,
+                              num_pages=n_pages, max_slots=64,
+                              kv_dtype=kvd, queue_depth=64)
+            try:
+                srng = np.random.RandomState(7)
+                sysps = [list(srng.randint(1, 512, 16)) for _ in range(4)]
+                sreqs = [dict(prompt=sysps[i % 4]
+                              + list(srng.randint(1, 512, 1 + i % 3)),
+                              max_new_tokens=sweep_new,
+                              temperature=0.8 * (i % 2), seed=i)
+                         for i in range(16)]
+                [f.result(timeout=600)
+                 for f in [s.submit(**r) for r in sreqs]]   # warm
+                s.cache.reset_peak()
+                t0 = _time.perf_counter()
+                res = [f.result(timeout=600)
+                       for f in [s.submit(**r) for r in sreqs]]
+                wall = _time.perf_counter() - t0
+                st = s.stats()
+                per_req = pages_needed(sweep_len, sweep_new,
+                                       s.cache.page_size)
+                sweep[kvd] = {
+                    "num_pages": int(n_pages),
+                    "kv_bytes_per_token": st["kv_bytes_per_token"],
+                    "tokens_per_sec": round(
+                        sum(len(r.token_ids) for r in res) / wall, 1),
+                    "kv_peak_pages": s.cache.peak_pages,
+                    "kv_peak_occupancy": round(
+                        s.cache.peak_pages / s.cache.usable_pages, 3),
+                    "prefix_hit_rate": st["prefix_hit_rate"],
+                    "max_admissible_sessions": int(
+                        min(s.cache.max_slots,
+                            s.cache.usable_pages // per_req)),
+                }
+            finally:
+                s.close(drain=False)
+        sweep["int8_admission_gain"] = round(
+            sweep["int8"]["max_admissible_sessions"]
+            / max(sweep["float32"]["max_admissible_sessions"], 1), 2)
     finally:
         sess.close(drain=False)
         if not was_on:
@@ -1257,6 +1354,8 @@ def bench_decode():
         "steady_state_compile_misses": misses,
         "token_streams_identical_across_modes": parity,
         "kv_pages_leaked": sess.cache.pages_in_use,
+        "prefix_ttft": prefix_ttft,
+        "kv_dtype_sweep": sweep,
     }
 
 
@@ -1567,6 +1666,12 @@ def _telemetry_summary():
         "decode_ttft_ms": round(c.get("decode.ttft_ms", 0.0), 1),
         "decode_rejections": c.get("decode.rejections", 0),
         "decode_kv_occupancy": g.get("decode.kv_occupancy", 0),
+        "decode_kv_bytes_per_token": g.get("decode.kv_bytes_per_token", 0),
+        "decode_prefix_hits": c.get("decode.prefix_hits", 0),
+        "decode_prefix_misses": c.get("decode.prefix_misses", 0),
+        "decode_prefix_hit_rate": g.get("decode.prefix_hit_rate", 0.0),
+        "decode_prefill_skips": c.get("decode.prefill_skips", 0),
+        "decode_kv_cow_copies": c.get("decode.kv_cow_copies", 0),
         "resilience_faults_injected": c.get("resilience.fault_injected", 0),
         "resilience_retries": c.get("resilience.retry", 0),
         "resilience_give_ups": c.get("resilience.give_up", 0),

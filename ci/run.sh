@@ -264,38 +264,59 @@ assert telemetry.is_enabled()
 
 net = get_decode_model("decode_tiny", vocab_size=256, max_length=64)
 net.initialize()
-sess = DecodeSession(net, batch_buckets=(1, 2, 4, 8), seq_buckets=(16, 32),
-                     page_size=8, queue_depth=256)
-telemetry.reset()          # miss accounting starts after warmup
 
+# 32 clients sharing 4 system prompts (16 tokens = 2 full pages each) +
+# short unique suffixes — the shared-prefix drill: most admissions must
+# ride the prefix index, and every stream must be bitwise identical to a
+# prefix_sharing=False run of the same requests (fp32 determinism bar)
 rng = np.random.RandomState(0)
-reqs = [dict(prompt=list(rng.randint(1, 256, 3 + (i * 7) % 28)),
+system = [list(rng.randint(1, 256, 16)) for _ in range(4)]
+reqs = [dict(prompt=system[i % 4] + list(rng.randint(1, 256, i % 3)),
              max_new_tokens=6 + (i * 5) % 12,
              temperature=0.8 * (i % 2), seed=i) for i in range(32)]
-futs = []
 
-def feed():
-    for i, r in enumerate(reqs):
-        futs.append(sess.submit(**r))
-        time.sleep(0.002 * (i % 3))       # staggered arrivals
+def drill(prefix_sharing):
+    sess = DecodeSession(net, batch_buckets=(1, 2, 4, 8),
+                         seq_buckets=(16, 32), page_size=8,
+                         queue_depth=256, prefix_sharing=prefix_sharing)
+    telemetry.reset()          # miss accounting starts after warmup
+    futs = []
 
-t = threading.Thread(target=feed)
-t.start()
-t.join()
-res = [f.result(timeout=300) for f in futs]
-sess.close(drain=True)
+    def feed():
+        for i, r in enumerate(reqs):
+            futs.append(sess.submit(**r))
+            time.sleep(0.002 * (i % 3))       # staggered arrivals
 
-snap = telemetry.snapshot()["counters"]
-assert all(len(r.token_ids) >= 1 for r in res)
-assert not snap.get("decode.compile_miss"), \
-    f"steady-state decode recompiles: {snap.get('decode.compile_miss')}"
-assert snap.get("decode.joins", 0) >= 1, "no mid-flight joins — not continuous"
-assert sess.cache.pages_in_use == 0, "leaked KV pages after drain"
-assert sess.cache.slots_in_use == 0, "leaked KV slots after drain"
+    t = threading.Thread(target=feed)
+    t.start()
+    t.join()
+    res = [f.result(timeout=300) for f in futs]
+    sess.close(drain=True)
+    snap = telemetry.snapshot()["counters"]
+    stats = sess.stats()
+    assert all(len(r.token_ids) >= 1 for r in res)
+    assert not snap.get("decode.compile_miss"), \
+        f"steady-state decode recompiles: {snap.get('decode.compile_miss')}"
+    assert snap.get("decode.joins", 0) >= 1, \
+        "no mid-flight joins — not continuous"
+    assert sess.cache.pages_in_use == 0, "leaked KV pages after drain"
+    assert sess.cache.slots_in_use == 0, "leaked KV slots after drain"
+    sess.cache.drop_prefix_cache()
+    assert sess.cache.stats()["prefix_cached_pages"] == 0
+    return [r.token_ids for r in res], snap, stats
+
+shared, snap, stats = drill(prefix_sharing=True)
+assert stats["prefix_hit_rate"] > 0.5, \
+    f"4 hot system prompts must mostly hit: {stats}"
+cold, _, cold_stats = drill(prefix_sharing=False)
+assert cold_stats["prefix_hits"] == 0
+assert shared == cold, "shared-prefix streams diverged from cold prefill"
 assert sanitizer.stats()["violations"] == 0, sanitizer.stats()
-print("decode smoke ok:", len(res), "generate() calls,",
+print("decode smoke ok:", len(shared), "generate() calls,",
       snap["decode.tokens"], "tokens,", snap["decode.steps"], "steps,",
-      snap.get("decode.joins"), "joins, 0 misses, 0 leaks, sanitizer clean")
+      snap.get("decode.joins"), "joins,",
+      f"prefix_hit_rate {stats['prefix_hit_rate']},",
+      "bitwise shared==cold, 0 misses, 0 leaks, sanitizer clean")
 PY
 }
 

@@ -23,7 +23,12 @@ attributed errors:
   the handle's generation stamp (:func:`check_kv_slot`) — a step driven
   through a freed slot raises :class:`StaleKVSlotError` naming the slot
   and its allocation site, instead of silently attending over another
-  request's context.
+  request's context.  With prefix sharing, pages are *refcounted*: a
+  page's generation bumps only when its LAST holder (live slot or
+  prefix-index pin) releases it, so freeing one session of a shared
+  prefix never trips the survivors — :func:`check_kv_pages` compares the
+  handle's per-page generation stamps and raises only on a genuinely
+  recycled page (last-free poisons; an earlier co-holder free is clean).
 - **collectives** — every collective call site (SPMD steps, pipeline/moe
   schedules, the kvstore dist hop, the checkpoint commit barrier) records
   a per-host fingerprint stream; streams are cross-checked at sync points
@@ -59,7 +64,7 @@ __all__ = ["SanitizerError", "DonatedBufferError", "StaleSlotError",
            "enable", "disable", "configure", "scope", "modes", "active",
            "donation", "slots", "collectives", "poison",
            "register_slot_view", "register_kv_slot", "check_kv_slot",
-           "check_buffer", "stats", "reset"]
+           "check_kv_pages", "check_buffer", "stats", "reset"]
 
 MODES = ("donation", "slots", "collectives")
 
@@ -113,20 +118,29 @@ class StaleSlotError(SanitizerError):
 
 
 class StaleKVSlotError(StaleSlotError):
-    """A decode step read a paged-KV slot after it was freed."""
+    """A decode step read a paged-KV slot after it was freed — or one of
+    the slot's refcounted pages after its last holder released it."""
 
-    def __init__(self, site, slot_id):
+    def __init__(self, site, slot_id, page=None):
         # bypass StaleSlotError.__init__ (shm-ring wording); keep its type
         # so existing "slots-family violation" handlers catch both
-        SanitizerError.__init__(
-            self,
-            f"stale KV-slot read: slot {slot_id} (allocated at {site}) was "
-            f"freed back to the paged KV cache and its pages may hold "
-            f"another sequence's context.  Stop stepping a sequence after "
-            f"freeing its slot — evict at the step boundary that frees it "
-            f"(MXNET_SANITIZE=slots)")
+        if page is None:
+            msg = (f"stale KV-slot read: slot {slot_id} (allocated at "
+                   f"{site}) was freed back to the paged KV cache and its "
+                   f"pages may hold another sequence's context.  Stop "
+                   f"stepping a sequence after freeing its slot — evict at "
+                   f"the step boundary that frees it (MXNET_SANITIZE=slots)")
+        else:
+            msg = (f"stale KV-page read: page {page} held by slot "
+                   f"{slot_id} (allocated at {site}) recycled — its LAST "
+                   f"holder (slot or prefix-index pin) released it and it "
+                   f"may hold another sequence's context.  A co-holder "
+                   f"freeing a shared prefix is fine; this page's refcount "
+                   f"reached zero (MXNET_SANITIZE=slots)")
+        SanitizerError.__init__(self, msg)
         self.site = site
         self.slot_id = slot_id
+        self.page = page
 
 
 class CollectiveDivergenceError(SanitizerError):
@@ -343,6 +357,24 @@ def check_kv_slot(cache, slot_id, generation):
             site = _kv_slots.get((id(cache), int(slot_id)),
                                  "<unregistered>")
         _violation(StaleKVSlotError(site, slot_id))
+
+
+def check_kv_pages(cache, slot):
+    """Page-level read fence for refcounted (shared-prefix) caches: raise
+    :class:`StaleKVSlotError` naming the page when any page a live
+    :class:`KVSlot` handle references has recycled past the handle's
+    stamp.  A shared page survives any number of co-holder frees — its
+    generation bumps only on last-free — so this distinguishes "my
+    neighbor left" (clean) from "my page was reassigned" (violation).
+    Callers guard on ``sanitizer.slots``."""
+    if not slots:
+        return
+    for page, gen in zip(slot.pages, slot.page_gens):
+        if cache.page_generation(page) != gen:
+            with _lock:
+                site = _kv_slots.get((id(cache), int(slot.slot_id)),
+                                     "<unregistered>")
+            _violation(StaleKVSlotError(site, slot.slot_id, page=page))
 
 
 def _violation(err):

@@ -14,8 +14,12 @@ discipline (PAPER.md design point #2) to that loop:
 - :class:`PagedKVCache` (``kv_cache.py``) — device-resident page pools
   with a trash page for padding, generation-stamped slots (the ShmRing
   discipline: a post-free read raises ``StaleKVSlotError`` under
-  ``MXNET_SANITIZE=slots``), and optional ``NamedSharding`` over the
-  heads axis so the cache scales with the mesh.
+  ``MXNET_SANITIZE=slots``), refcounted **shared-prefix pages**
+  (content-hashed at prefill commit, acquired by page-table update on a
+  hit, copy-on-write on divergence), optional **int8 pools**
+  (``kv_dtype="int8"``: per-row scale/mid sidecars, dequant fused into
+  the step program), and optional ``NamedSharding`` over the heads axis
+  so the cache scales with the mesh.
 - :class:`DecodeRuntime` (``runtime.py``) — the 2-D *(batch x seqlen)*
   prefill grid warmed through ``HybridBlock.compile_grid`` plus ONE
   fused donated step program per batch bucket; ``decode.compile_miss``
@@ -44,7 +48,13 @@ from .kv_cache import (  # noqa: F401
     PagedKVCache,
     pages_needed,
 )
-from .model import CausalLM, get_decode_model, rowdot  # noqa: F401
+from .model import (  # noqa: F401
+    CausalLM,
+    get_decode_model,
+    kv_dequantize,
+    kv_quantize_rows,
+    rowdot,
+)
 from .runtime import DecodeRuntime, seq_bucket_ladder  # noqa: F401
 from .scheduler import (  # noqa: F401
     DecodeScheduler,
@@ -53,6 +63,7 @@ from .scheduler import (  # noqa: F401
 )
 
 __all__ = ["CausalLM", "get_decode_model", "rowdot",
+           "kv_quantize_rows", "kv_dequantize",
            "PagedKVCache", "KVSlot", "KVCacheExhausted", "pages_needed",
            "DecodeRuntime", "seq_bucket_ladder",
            "DecodeScheduler", "DecodeSession", "GenerationResult"]

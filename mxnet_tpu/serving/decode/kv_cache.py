@@ -1,6 +1,7 @@
-"""Device-resident paged KV cache with generation-stamped slots.
+"""Device-resident paged KV cache with generation-stamped slots,
+refcounted shared-prefix pages, and optional int8-quantized pools.
 
-The decode batch's attention state lives on device as two page-pool arrays
+The decode batch's attention state lives on device as page-pool arrays
 per cache — ``k_pages`` / ``v_pages`` of shape ``(layers, num_pages,
 page_size, heads, head_dim)``.  A sequence owns a *slot* (its identity in
 the allocator) and a fixed-length page table (``max_pages_per_seq``
@@ -19,6 +20,35 @@ the handle against the cache and a post-free read raises
 and its allocation site — instead of silently attending over another
 request's context.
 
+**Prefix sharing** (``prefix_sharing=True``, the default): pages are
+*refcounted*, and at prefill-commit time the scheduler publishes each
+fully-written prompt page under a position-chained content hash
+(:meth:`publish`).  A later :meth:`alloc` carrying the prompt tokens
+matches the longest published page chain and *acquires* those pages
+(refcount bump — a page-table update) instead of allocating + refilling
+them; when the entire prompt matches a published entry the cached
+last-position logits ride along and admission skips the prefill program
+completely.  Shared pages are read-only by construction — generated
+tokens land in pages past the shared prefix — and the one genuinely
+written boundary page (a prompt's partial tail) is **copied on write**:
+the index keeps a private immutable copy and every acquirer gets its own
+(:meth:`ensure_writable` is the runtime guard).  Page generations are
+stamped alongside slot generations so the slots sanitizer can tell
+"my co-holder freed" (fine — refcount still > 0) from "the page really
+recycled" (raises).  Published pages are pinned by the index and
+reclaimed LRU-first under allocation pressure, so a hot prefix survives
+across sessions without ever causing a spurious ``KVCacheExhausted``.
+
+**Quantized pools** (``kv_dtype="int8"``): K/V pages are stored int8
+with per-page-row affine scale/zero-point arrays (one ``(scale, zero)``
+pair per written token row per layer, shape ``(layers, num_pages,
+page_size)``), quantized at commit/step write and dequantized inside the
+fused per-bucket step program — KV HBM drops ~4x so the same pool bytes
+admit ~4x the pages.  Quantization is elementwise-deterministic, so the
+shared-vs-cold bitwise contract holds in int8 exactly as in fp32; what
+int8 relaxes is fidelity *versus the fp32 pools* (documented in
+``docs/serving.md``).
+
 Sharding: pass ``mesh`` (+ ``kv_axis``) and the page pools are created
 under a ``NamedSharding`` over the heads axis, so the cache scales with
 the mesh without changing any scheduler/runtime code (the SNIPPETS.md [1]
@@ -30,7 +60,11 @@ under load is injectable like every other subsystem failure
 """
 from __future__ import annotations
 
+import hashlib
 import threading
+from collections import OrderedDict
+
+import numpy as np
 
 from ...analysis import sanitizer as _san
 from ...resilience import faults as _faults
@@ -55,31 +89,73 @@ class KVCacheExhausted(RuntimeError):
 
     The scheduler treats this as backpressure — the request waits for
     evictions — unless the request could never fit, in which case it is
-    shed with ``reason="kv_exhausted"``."""
+    shed with ``reason="kv_exhausted"``.  ``reclaimable`` counts pages
+    pinned only by the shared-prefix index at raise time (already-reclaimed
+    pages are in ``free``): a persistently non-zero value under shedding
+    means the pool is sized for the prefix cache, not the live load."""
 
-    def __init__(self, need, free, what="pages"):
-        super().__init__(
-            f"KV cache exhausted: need {need} {what}, {free} free")
+    def __init__(self, need, free, what="pages", reclaimable=0):
+        msg = f"KV cache exhausted: need {need} {what}, {free} free"
+        if what == "pages":
+            msg += (f", {reclaimable} reclaimable from the shared-prefix "
+                    f"cache")
+        super().__init__(msg)
         self.need = need
         self.free = free
+        self.reclaimable = reclaimable
 
 
 class KVSlot:
     """A sequence's handle on its cache residency: slot id, generation
-    stamp, and the fixed-length page table (padded with the trash page)."""
+    stamp, and the fixed-length page table (padded with the trash page).
 
-    __slots__ = ("slot_id", "generation", "pages", "page_table")
+    With prefix sharing the first ``shared_pages`` entries are refcounted
+    pages acquired from the prefix index (read-only for this sequence);
+    ``page_gens`` stamps each held page's recycle generation (checked by
+    the slots sanitizer), and a full-prompt hit carries ``prefix_logits``
+    — the cached last-position logits that let admission skip prefill."""
 
-    def __init__(self, slot_id, generation, pages, max_pages):
+    __slots__ = ("slot_id", "generation", "pages", "page_table",
+                 "shared_pages", "page_gens", "prefix_logits")
+
+    def __init__(self, slot_id, generation, pages, max_pages,
+                 shared_pages=0, page_gens=None):
         self.slot_id = slot_id
         self.generation = generation
-        self.pages = tuple(pages)
-        table = list(self.pages) + [TRASH_PAGE] * (max_pages - len(pages))
-        self.page_table = table
+        self.pages = list(pages)
+        self.page_table = list(self.pages) + \
+            [TRASH_PAGE] * (max_pages - len(self.pages))
+        self.shared_pages = int(shared_pages)
+        self.page_gens = list(page_gens) if page_gens is not None \
+            else [0] * len(self.pages)
+        self.prefix_logits = None
+
+    def write_table(self):
+        """The commit-program scatter table: shared prefix pages are
+        masked to the trash page (their content is already committed and
+        read-only), so a partial-hit prefill stores only its own pages."""
+        table = list(self.page_table)
+        for i in range(self.shared_pages):
+            table[i] = TRASH_PAGE
+        return table
 
     def __repr__(self):
         return (f"KVSlot(id={self.slot_id}, gen={self.generation}, "
-                f"pages={len(self.pages)})")
+                f"pages={len(self.pages)}, shared={self.shared_pages})")
+
+
+class _FullEntry:
+    """One published full prompt: the canonical chain pages, an optional
+    index-owned immutable copy of the partial tail page, the cached
+    last-position logits, and the prompt length."""
+
+    __slots__ = ("pages", "tail", "logits", "prompt_len")
+
+    def __init__(self, pages, tail, logits, prompt_len):
+        self.pages = tuple(pages)
+        self.tail = tail
+        self.logits = logits
+        self.prompt_len = prompt_len
 
 
 class PagedKVCache:
@@ -101,6 +177,17 @@ class PagedKVCache:
     max_slots : int
         Concurrent-sequence bound (the scheduler's max batch bucket).
     dtype : str
+        Compute dtype of the K/V values (fp32 pools store this directly).
+    kv_dtype : str
+        ``"float32"``/``"fp32"`` (default) or ``"int8"`` — the *storage*
+        dtype of the pools.  int8 adds per-page-row scale/zero arrays and
+        the runtime fuses dequant into the step program.
+    prefix_sharing : bool
+        Refcount + content-hash prompt pages across sequences (default
+        on).  Off, :meth:`alloc` ignores ``prompt`` and behaves exactly
+        like the unshared allocator.
+    prefix_entries : int
+        LRU cap on published full-prompt entries.
     mesh : jax Mesh, optional
         When given, page pools are sharded ``NamedSharding(mesh,
         P(None, None, None, kv_axis, None))`` — heads over the model axis.
@@ -108,7 +195,8 @@ class PagedKVCache:
 
     def __init__(self, num_layers, num_heads, head_dim, page_size=16,
                  num_pages=64, max_pages_per_seq=8, max_slots=16,
-                 dtype="float32", mesh=None, kv_axis="model"):
+                 dtype="float32", kv_dtype=None, prefix_sharing=True,
+                 prefix_entries=256, mesh=None, kv_axis="model"):
         import jax.numpy as jnp
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is trash)")
@@ -121,10 +209,24 @@ class PagedKVCache:
         self.max_slots = int(max_slots)
         self.context_length = self.max_pages_per_seq * self.page_size
         self.dtype = str(dtype)
+        kv_dtype = self.dtype if kv_dtype is None else str(kv_dtype)
+        kv_dtype = {"fp32": "float32", "float": "float32"}.get(
+            kv_dtype, kv_dtype)
+        if kv_dtype not in ("float32", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'float32' or 'int8', got {kv_dtype!r}")
+        self.kv_dtype = kv_dtype
+        self.quantized = kv_dtype == "int8"
+        self.prefix_sharing = bool(prefix_sharing)
+        self._prefix_entry_cap = int(prefix_entries)
         shape = (self.num_layers, self.num_pages, self.page_size,
                  self.num_heads, self.head_dim)
-        k = jnp.zeros(shape, self.dtype)
-        v = jnp.zeros(shape, self.dtype)
+        pool_dtype = "int8" if self.quantized else self.dtype
+        k = jnp.zeros(shape, pool_dtype)
+        v = jnp.zeros(shape, pool_dtype)
+        qshape = shape[:3]
+        quant = (tuple(jnp.zeros(qshape, "float32") for _ in range(4))
+                 if self.quantized else ())
         if mesh is not None:
             import jax
             from jax.sharding import NamedSharding, PartitionSpec
@@ -132,25 +234,71 @@ class PagedKVCache:
                 mesh, PartitionSpec(None, None, None, kv_axis, None))
             k = jax.device_put(k, sharding)
             v = jax.device_put(v, sharding)
+            rep = NamedSharding(mesh, PartitionSpec())
+            quant = tuple(jax.device_put(q, rep) for q in quant)
         self.mesh = mesh          # the runtime replicates params over it
         self.k_pages = k
         self.v_pages = v
+        # (k_scale, k_zero, v_scale, v_zero) — empty tuple in fp32 mode
+        self._quant = quant
+        self._copy_fn = None
         self._lock = threading.Lock()
         self._free_pages = list(range(1, self.num_pages))  # 0 = trash
         self._free_slots = list(range(self.max_slots))
         self._gen = [0] * self.max_slots
         self._live = {}          # slot_id -> KVSlot
+        # --- refcounted shared-prefix state -------------------------------
+        self._slot_refs = [0] * self.num_pages   # live-slot holders
+        self._pin_refs = [0] * self.num_pages    # prefix-index holders
+        self._page_gen = [0] * self.num_pages    # bumped on recycle
+        self._prefix_pages = OrderedDict()       # chain hash -> page (LRU)
+        self._page_hash = {}                     # page -> chain hash
+        self._full_index = OrderedDict()         # prompt hash -> _FullEntry
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.cow_copies = 0
         self.peak_pages = 0
 
-    # ------------------------------------------------------------ allocator
+    # ------------------------------------------------------------ geometry
     @property
     def usable_pages(self):
         return self.num_pages - 1
 
     @property
+    def kv_bytes_per_token(self):
+        """Device bytes one token position costs across K+V pools (all
+        layers), including the int8 scale/zero sidecars."""
+        row = self.num_heads * self.head_dim
+        if self.quantized:
+            per_layer = 2 * (row + 2 * 4)    # int8 values + scale/zero f32
+        else:
+            per_layer = 2 * row * np.dtype(self.dtype).itemsize
+        return self.num_layers * per_layer
+
+    @property
+    def page_bytes(self):
+        """Device bytes one page costs (K+V, all layers, sidecars)."""
+        return self.kv_bytes_per_token * self.page_size
+
+    @property
+    def pools(self):
+        """Every device pool array the commit/step programs thread
+        through (and donate): ``(k, v)`` in fp32, ``(k, v, k_scale,
+        k_zero, v_scale, v_zero)`` in int8."""
+        return (self.k_pages, self.v_pages) + self._quant
+
+    def set_pools(self, arrays):
+        arrays = tuple(arrays)
+        self.k_pages, self.v_pages = arrays[0], arrays[1]
+        self._quant = arrays[2:]
+
+    # ------------------------------------------------------------ occupancy
+    @property
     def pages_in_use(self):
+        """Pages held by live slots (prefix-cache pins are reported
+        separately — see :meth:`stats` ``prefix_cached_pages``)."""
         with self._lock:
-            return self.usable_pages - len(self._free_pages)
+            return sum(1 for r in self._slot_refs if r > 0)
 
     @property
     def slots_in_use(self):
@@ -159,14 +307,54 @@ class PagedKVCache:
 
     def fits_ever(self, n_pages):
         """Could a reservation of ``n_pages`` EVER be satisfied (empty
-        cache)?  False means the request must be shed, not queued."""
+        cache)?  False means the request must be shed, not queued.
+        Index-pinned pages are reclaimable, so they never shrink this."""
         return n_pages <= self.usable_pages
 
-    def alloc(self, n_pages, site="decode.kv_alloc"):
+    def reclaimable_pages(self):
+        """Pages held only by the shared-prefix index (no live slot) —
+        what allocation pressure can reclaim right now."""
+        with self._lock:
+            return self._reclaimable_locked()
+
+    def _reclaimable_locked(self):
+        return sum(1 for p in range(1, self.num_pages)
+                   if self._pin_refs[p] > 0 and self._slot_refs[p] == 0)
+
+    # ------------------------------------------------------------- hashing
+    def _page_hashes(self, prompt):
+        """Position-chained content hashes of the prompt's *full* pages:
+        ``h_i = H(h_{i-1} || tokens_of_page_i)`` — equal hashes mean equal
+        tokens at equal positions, which (row-stable math) means bitwise
+        equal committed K/V."""
+        ps = self.page_size
+        out, h = [], b"kv-chain-0"
+        for i in range(len(prompt) // ps):
+            h = hashlib.sha1(
+                h + prompt[i * ps:(i + 1) * ps].tobytes()).digest()
+            out.append(h)
+        return out
+
+    @staticmethod
+    def _full_hash(prompt):
+        return hashlib.sha1(
+            b"kv-full" + np.int64(prompt.size).tobytes()
+            + prompt.tobytes()).digest()
+
+    # ------------------------------------------------------------ allocator
+    def alloc(self, n_pages, prompt=None, site="decode.kv_alloc"):
         """Reserve ``n_pages`` + a slot; returns a generation-stamped
-        :class:`KVSlot`.  Raises :class:`KVCacheExhausted` when the pool
-        can't satisfy the reservation *right now* (injectable:
-        ``MXNET_FAULTS=decode.kv_alloc:fail``)."""
+        :class:`KVSlot`.
+
+        With ``prompt`` (int32 token array) and prefix sharing on, the
+        published page chains are consulted first: matched pages are
+        acquired by refcount instead of allocated, and a full-prompt match
+        additionally hands back cached last-position logits
+        (``slot.prefix_logits``) plus a private copy of the prompt's
+        partial tail page — admission without a prefill.  Raises
+        :class:`KVCacheExhausted` when the pool can't satisfy the
+        reservation *right now*, after reclaiming LRU index-pinned pages
+        (injectable: ``MXNET_FAULTS=decode.kv_alloc:fail``)."""
         if _faults.active:
             _faults.check("decode.kv_alloc")
         n_pages = int(n_pages)
@@ -175,28 +363,89 @@ class PagedKVCache:
                 f"{n_pages} pages exceed max_pages_per_seq="
                 f"{self.max_pages_per_seq} (context "
                 f"{self.context_length} tokens)")
+        use_prefix = (self.prefix_sharing and prompt is not None)
+        if use_prefix:
+            prompt = np.ascontiguousarray(np.asarray(prompt, "int32"))
+        tail_copy = None           # (src_page, dst_page) pending device copy
         with self._lock:
             if not self._free_slots:
                 raise KVCacheExhausted(1, 0, what="slots")
-            if n_pages > len(self._free_pages):
-                raise KVCacheExhausted(n_pages, len(self._free_pages))
+            shared, entry = [], None
+            if use_prefix:
+                entry = self._full_index.get(self._full_hash(prompt))
+                if entry is not None:
+                    self._full_index.move_to_end(self._full_hash(prompt))
+                    shared = list(entry.pages)
+                else:
+                    for h in self._page_hashes(prompt):
+                        p = self._prefix_pages.get(h)
+                        if p is None:
+                            break
+                        self._prefix_pages.move_to_end(h)
+                        shared.append(p)
+            n_fresh = n_pages - len(shared)
+            if entry is not None and entry.tail is not None:
+                n_fresh = max(n_fresh, 1)   # room for the private tail copy
+            if n_fresh > len(self._free_pages):
+                self._reclaim_locked(n_fresh)
+            if n_fresh > len(self._free_pages):
+                # not a hit/miss lookup: the scheduler retries this alloc
+                # at every boundary until pages free up, and counting each
+                # retry would skew prefix_hit_rate
+                raise KVCacheExhausted(
+                    n_pages, len(self._free_pages),
+                    reclaimable=self._reclaimable_locked())
             slot_id = self._free_slots.pop()
-            pages = [self._free_pages.pop() for _ in range(n_pages)]
+            fresh = [self._free_pages.pop() for _ in range(n_fresh)]
+            pages = list(shared) + fresh
+            if entry is not None and entry.tail is not None:
+                # the entry's tail page is the index's immutable copy —
+                # give this sequence its own (copy-on-write at admission:
+                # its first generated token writes into this page)
+                tail_copy = (entry.tail, fresh[0])
+            for p in shared:
+                self._slot_refs[p] += 1
+            for p in fresh:
+                self._slot_refs[p] += 1
             slot = KVSlot(slot_id, self._gen[slot_id], pages,
-                          self.max_pages_per_seq)
+                          self.max_pages_per_seq,
+                          shared_pages=len(shared),
+                          page_gens=[self._page_gen[p] for p in pages])
+            if entry is not None:
+                slot.prefix_logits = entry.logits
             self._live[slot_id] = slot
-            in_use = self.usable_pages - len(self._free_pages)
+            if use_prefix:
+                self._count_lookup_locked(bool(shared))
+            in_use = self.num_pages - 1 - len(self._free_pages)
             self.peak_pages = max(self.peak_pages, in_use)
+        if tail_copy is not None:
+            self._copy_page(*tail_copy)
         if _san.slots:
             _san.register_kv_slot(self, slot_id, site)
         self._gauge(in_use)
         return slot
 
+    def _count_lookup_locked(self, hit):
+        if hit:
+            self.prefix_hits += 1
+        else:
+            self.prefix_misses += 1
+        if _tel.enabled:
+            _tel.count("decode.prefix_hits" if hit
+                       else "decode.prefix_misses")
+            _tel.gauge("decode.prefix_hit_rate", round(
+                self.prefix_hits
+                / (self.prefix_hits + self.prefix_misses), 4))
+
     def free(self, slot):
-        """Return a slot's pages to the pool.  Bumps the slot generation
-        FIRST — any handle stamped with the old generation is stale from
-        this point on (a later read raises under ``MXNET_SANITIZE=slots``).
-        Double-frees raise instead of corrupting the free list."""
+        """Drop a slot's references.  Bumps the slot generation FIRST —
+        any handle stamped with the old generation is stale from this
+        point on (a later read raises under ``MXNET_SANITIZE=slots``).
+        A page returns to the pool — and its page generation bumps — only
+        when its LAST holder (slot or prefix-index pin) lets go, so
+        freeing one session of a shared prefix never invalidates the
+        survivors.  Double-frees raise instead of corrupting the
+        refcounts."""
         with self._lock:
             live = self._live.get(slot.slot_id)
             if live is not slot or self._gen[slot.slot_id] != slot.generation:
@@ -205,38 +454,245 @@ class PagedKVCache:
                     f"{self._gen[slot.slot_id]})")
             self._gen[slot.slot_id] += 1
             del self._live[slot.slot_id]
-            self._free_pages.extend(slot.pages)
+            for p in slot.pages:
+                self._slot_refs[p] -= 1
+                if self._slot_refs[p] == 0 and self._pin_refs[p] == 0:
+                    self._release_locked(p)
             self._free_slots.append(slot.slot_id)
-            in_use = self.usable_pages - len(self._free_pages)
+            in_use = self.num_pages - 1 - len(self._free_pages)
         self._gauge(in_use)
 
+    def _release_locked(self, page):
+        """A page's last holder let go: recycle it (generation bump =
+        the slots sanitizer's page-level poison)."""
+        self._free_pages.append(page)
+        self._page_gen[page] += 1
+
+    # ------------------------------------------------------- prefix index
+    def publish(self, slot, prompt, logits_row=None):
+        """Publish a freshly committed prompt's pages for sharing.
+
+        Every fully-written prompt page not already in the index is
+        pinned under its chain hash; with ``logits_row`` (the prompt's
+        last-position logits) a full-prompt entry is added so an exact
+        repeat skips prefill entirely.  A partial tail page is *copied*
+        into an index-owned page first (the live sequence keeps writing
+        its own tail — the index copy stays immutable), skipped silently
+        when no free page is available."""
+        if not self.prefix_sharing:
+            return
+        prompt = np.ascontiguousarray(np.asarray(prompt, "int32"))
+        tail_copy = None
+        with self._lock:
+            hashes = self._page_hashes(prompt)
+            chain = []
+            for i, h in enumerate(hashes):
+                p = self._prefix_pages.get(h)
+                if p is None:
+                    p = slot.page_table[i]
+                    if p == TRASH_PAGE:
+                        return           # foreign slot shape; nothing to do
+                    self._prefix_pages[h] = p
+                    self._page_hash[p] = h
+                    self._pin_refs[p] += 1
+                chain.append(p)
+            fh = self._full_hash(prompt)
+            if logits_row is None or fh in self._full_index:
+                self._gauge_prefix_locked()
+                return
+            tail = None
+            if prompt.size % self.page_size:
+                if not self._free_pages:
+                    self._reclaim_locked(1)
+                if not self._free_pages:
+                    self._gauge_prefix_locked()
+                    return               # no room for the tail copy: skip
+                tail = self._free_pages.pop()
+                tail_copy = (slot.page_table[len(hashes)], tail)
+            entry = _FullEntry(chain, tail,
+                               np.array(logits_row, "float32", copy=True),
+                               prompt.size)
+            self._full_index[fh] = entry
+            for p in entry.pages:
+                self._pin_refs[p] += 1
+            if tail is not None:
+                self._pin_refs[tail] += 1
+            while len(self._full_index) > self._prefix_entry_cap:
+                h, e = next(iter(self._full_index.items()))
+                self._drop_full_locked(h)
+            self._gauge_prefix_locked()
+        if tail_copy is not None:
+            self._copy_page(*tail_copy)
+
+    def _drop_full_locked(self, fh):
+        entry = self._full_index.pop(fh)
+        for p in entry.pages:
+            self._unpin_locked(p)
+        if entry.tail is not None:
+            self._unpin_locked(entry.tail)
+
+    def _unpublish_page_locked(self, h):
+        page = self._prefix_pages.pop(h)
+        del self._page_hash[page]
+        # a broken chain invalidates every full entry that rides it
+        for fh in [fh for fh, e in self._full_index.items()
+                   if page in e.pages]:
+            self._drop_full_locked(fh)
+        self._unpin_locked(page)
+
+    def _unpin_locked(self, page):
+        self._pin_refs[page] -= 1
+        if self._pin_refs[page] == 0 and self._slot_refs[page] == 0:
+            self._release_locked(page)
+
+    def _reclaim_locked(self, need_free):
+        """Evict LRU index state until ``need_free`` pages are free (or
+        nothing reclaimable remains): full entries first (their private
+        tail copies are pure cache), then whole published chains."""
+        while len(self._free_pages) < need_free and self._full_index:
+            h = next(iter(self._full_index))
+            self._drop_full_locked(h)
+        for h in list(self._prefix_pages):
+            if len(self._free_pages) >= need_free:
+                break
+            if self._slot_refs[self._prefix_pages[h]] == 0:
+                self._unpublish_page_locked(h)
+
+    def drop_prefix_cache(self):
+        """Unpublish everything: every index-only page returns to the
+        pool (live slots keep theirs until freed).  The bench/ops
+        "drop caches" lever, and how tests separate a leak from a pin."""
+        with self._lock:
+            for fh in list(self._full_index):
+                self._drop_full_locked(fh)
+            for h in list(self._prefix_pages):
+                self._unpublish_page_locked(h)
+            in_use = self.num_pages - 1 - len(self._free_pages)
+            self._gauge_prefix_locked()
+        self._gauge(in_use)
+
+    def _gauge_prefix_locked(self):
+        if _tel.enabled:
+            _tel.gauge("decode.kv_cached_pages",
+                       sum(1 for p in range(1, self.num_pages)
+                           if self._pin_refs[p] > 0))
+
+    # ------------------------------------------------------- copy-on-write
+    def ensure_writable(self, slot, page_idx):
+        """Guarantee the slot exclusively owns the page it is about to
+        write (``page_idx`` in its table): a shared or index-pinned page
+        is replaced by a private copy first — THE copy-on-write trigger.
+        By construction admission already privatized every write-path
+        page, so this is a cheap per-step guard (two refcount reads)."""
+        if not self.prefix_sharing or page_idx >= len(slot.pages):
+            return
+        page = slot.pages[page_idx]
+        with self._lock:
+            if self._slot_refs[page] <= 1 and self._pin_refs[page] == 0:
+                return
+            if not self._free_pages:
+                self._reclaim_locked(1)
+            if not self._free_pages:
+                raise KVCacheExhausted(
+                    1, 0, reclaimable=self._reclaimable_locked())
+            fresh = self._free_pages.pop()
+            self._slot_refs[fresh] += 1
+            self._slot_refs[page] -= 1
+            if self._slot_refs[page] == 0 and self._pin_refs[page] == 0:
+                self._release_locked(page)
+            slot.pages[page_idx] = fresh
+            slot.page_table[page_idx] = fresh
+            slot.page_gens[page_idx] = self._page_gen[fresh]
+            if page_idx < slot.shared_pages:
+                slot.shared_pages = page_idx
+        self._copy_page(page, fresh)
+
+    def _copy_page(self, src, dst):
+        """One jitted donated program copies page ``src`` onto ``dst``
+        across every pool (values + int8 sidecars) — physical page ids
+        are traced scalars, so every CoW event replays one executable."""
+        import jax
+        if self._copy_fn is None:
+            n = len(self.pools)
+
+            def copy(src_, dst_, *pools):
+                return tuple(p.at[:, dst_].set(p[:, src_]) for p in pools)
+
+            self._copy_fn = jax.jit(
+                copy, donate_argnums=tuple(range(2, 2 + n)))
+        pools = self.pools
+        new = self._copy_fn(np.int32(src), np.int32(dst), *pools)
+        if _san.donation:
+            _san.poison(list(pools), "decode.kv_cow")
+        self.set_pools(new)
+        self.cow_copies += 1
+        if _tel.enabled:
+            _tel.count("decode.kv_cow_copies")
+
+    def warm_programs(self):
+        """Compile the CoW copy program before traffic (trash -> trash:
+        no allocated page is touched) — the same eager-warming discipline
+        as the runtime's commit/step programs."""
+        self._copy_page(TRASH_PAGE, TRASH_PAGE)
+        self.cow_copies -= 1         # warming is not a CoW event
+
+    # ------------------------------------------------------------ sanitizer
     def generation(self, slot_id):
         """Current recycle generation of a slot (the sanitizer's stale
         check compares a handle's stamp against this)."""
         with self._lock:
             return self._gen[slot_id]
 
+    def page_generation(self, page):
+        """Current recycle generation of a physical page — bumped only
+        when the page's last holder (slot or index pin) releases it."""
+        with self._lock:
+            return self._page_gen[page]
+
     def check_slot(self, slot):
         """``MXNET_SANITIZE=slots`` read fence for the decode step: raises
-        ``StaleKVSlotError`` when ``slot`` was freed (callers guard on
-        ``sanitizer.slots`` — idle cost is one attribute read)."""
+        ``StaleKVSlotError`` when ``slot`` was freed, or when any page it
+        references recycled out from under it (refcount discipline: a
+        co-holder freeing is fine; the LAST free poisons).  Callers guard
+        on ``sanitizer.slots`` — idle cost is one attribute read."""
         _san.check_kv_slot(self, slot.slot_id, slot.generation)
+        _san.check_kv_pages(self, slot)
 
     def _gauge(self, in_use):
         if _tel.enabled:
             _tel.gauge("decode.kv_occupancy",
                        round(in_use / max(self.usable_pages, 1), 4))
             _tel.gauge("decode.kv_pages", in_use)
+            _tel.gauge("decode.kv_bytes_per_token", self.kv_bytes_per_token)
 
     def reset_peak(self):
         """Restart the ``peak_pages`` high-water mark (bench phases)."""
         with self._lock:
-            self.peak_pages = self.usable_pages - len(self._free_pages)
+            self.peak_pages = self.num_pages - 1 - len(self._free_pages)
 
     def stats(self):
         with self._lock:
-            in_use = self.usable_pages - len(self._free_pages)
-            return {"pages_in_use": in_use, "usable_pages": self.usable_pages,
-                    "slots_in_use": self.max_slots - len(self._free_slots),
-                    "max_slots": self.max_slots,
-                    "peak_pages": self.peak_pages}
+            slot_pages = sum(1 for r in self._slot_refs if r > 0)
+            pinned = sum(1 for p in range(1, self.num_pages)
+                         if self._pin_refs[p] > 0)
+            lookups = self.prefix_hits + self.prefix_misses
+            return {
+                "pages_in_use": slot_pages,
+                "usable_pages": self.usable_pages,
+                "slots_in_use": self.max_slots - len(self._free_slots),
+                "max_slots": self.max_slots,
+                "peak_pages": self.peak_pages,
+                "kv_dtype": self.kv_dtype,
+                "kv_bytes_per_token": self.kv_bytes_per_token,
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "prefix_hit_rate": round(self.prefix_hits / lookups, 4)
+                if lookups else 0.0,
+                "prefix_cached_pages": pinned,
+                "reclaimable_pages": self._reclaimable_locked(),
+                "shared_pages": sum(
+                    1 for p in range(1, self.num_pages)
+                    if self._slot_refs[p] > 1
+                    or (self._slot_refs[p] and self._pin_refs[p])),
+                "cow_copies": self.cow_copies,
+            }

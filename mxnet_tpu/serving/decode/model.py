@@ -34,7 +34,8 @@ import numpy as np
 from ...gluon.block import HybridBlock
 from ...ndarray import NDArray, invoke_fn
 
-__all__ = ["CausalLM", "get_decode_model", "rowdot"]
+__all__ = ["CausalLM", "get_decode_model", "rowdot", "kv_quantize_rows",
+           "kv_dequantize"]
 
 
 def rowdot(x, w):
@@ -57,6 +58,36 @@ def _gelu(x):
     import jax.numpy as jnp
     return 0.5 * x * (1.0 + jnp.tanh(
         0.7978845608028654 * (x + 0.044715 * x ** 3)))
+
+
+def kv_quantize_rows(x):
+    """Affine int8 quantization of K/V token rows ``x (..., H, D)`` —
+    one ``(scale, mid)`` pair per leading index, reduced over the last
+    two axes only.  Returns ``(q int8, scale, mid)`` with
+    ``scale/mid`` of shape ``x.shape[:-2]``.
+
+    The reduction never crosses a leading axis, so quantization is
+    *row-stable* exactly like :func:`rowdot`: a token row's int8 codes are
+    a pure elementwise function of that row's fp32 values, independent of
+    batch composition, seq bucket, or physical page — which is why the
+    shared-vs-cold bitwise contract survives int8 pools.  An all-zero row
+    (the trash page, uninitialized pool entries) maps to
+    ``scale = mid = 0`` and dequantizes to exact ``0.0``."""
+    import jax.numpy as jnp
+    lo = x.min(axis=(-2, -1))
+    hi = x.max(axis=(-2, -1))
+    scale = (hi - lo) / 254.0
+    mid = (hi + lo) * 0.5
+    q = jnp.round((x - mid[..., None, None])
+                  / jnp.where(scale > 0, scale, 1.0)[..., None, None])
+    return jnp.clip(q, -127.0, 127.0).astype("int8"), scale, mid
+
+
+def kv_dequantize(q, scale, mid):
+    """Inverse of :func:`kv_quantize_rows` — elementwise, row-stable:
+    ``q * scale + mid`` broadcast over the trailing ``(H, D)`` axes."""
+    return (q.astype("float32") * scale[..., None, None]
+            + mid[..., None, None])
 
 
 class CausalLM(HybridBlock):
@@ -178,7 +209,7 @@ class CausalLM(HybridBlock):
         return logits, jnp.stack([jnp.stack(ks), jnp.stack(vs)])
 
     def step_math(self, p, tokens, positions, tables, k_pages, v_pages,
-                  page_size):
+                  page_size, quant=None):
         """Pure fused decode step for one token per row.
 
         Writes each row's new K/V into its page (``tables`` routes padded
@@ -186,7 +217,15 @@ class CausalLM(HybridBlock):
         (fixed length ``max_pages * page_size`` — constant shape is what
         keeps one compiled program per batch bucket AND makes the math
         identical regardless of physical page placement), and returns the
-        next-token logits.  Also returns the updated page arrays."""
+        next-token logits.  Also returns the updated page arrays.
+
+        With ``quant`` — the ``(k_scale, k_mid, v_scale, v_mid)`` sidecar
+        pools of an int8 cache — the new token row is quantized before
+        the scatter (:func:`kv_quantize_rows`) and the gathered context
+        dequantized before the attention einsums
+        (:func:`kv_dequantize`); both are row-stable, so per-row bitwise
+        independence of batch composition holds in int8 exactly as in
+        fp32.  The updated sidecars are returned after the page arrays."""
         import jax
         import jax.numpy as jnp
         B = tokens.shape[0]
@@ -197,17 +236,36 @@ class CausalLM(HybridBlock):
                                  axis=1)[:, 0]
         woff = positions % page_size
         mask = jnp.arange(lctx)[None, :] <= positions[:, None]
-        state = {"k": k_pages, "v": v_pages, "i": 0}
+        state = {"k": k_pages, "v": v_pages, "i": 0,
+                 "q": list(quant) if quant is not None else None}
+
+        def gather(pool, i):
+            return pool[i][tables].reshape(B, lctx, H, D)
 
         def attend(q, k, v):
             i = state["i"]
             q = q.reshape(B, H, D)
             k = k.reshape(B, H, D)
             v = v.reshape(B, H, D)
-            state["k"] = state["k"].at[i, wp, woff].set(k)
-            state["v"] = state["v"].at[i, wp, woff].set(v)
-            kg = state["k"][i][tables].reshape(B, lctx, H, D)
-            vg = state["v"][i][tables].reshape(B, lctx, H, D)
+            if state["q"] is None:
+                state["k"] = state["k"].at[i, wp, woff].set(k)
+                state["v"] = state["v"].at[i, wp, woff].set(v)
+                kg = gather(state["k"], i)
+                vg = gather(state["v"], i)
+            else:
+                kq, ksc, kmd = kv_quantize_rows(k)
+                vq, vsc, vmd = kv_quantize_rows(v)
+                state["k"] = state["k"].at[i, wp, woff].set(kq)
+                state["v"] = state["v"].at[i, wp, woff].set(vq)
+                qs = state["q"]
+                for j, row in enumerate((ksc, kmd, vsc, vmd)):
+                    qs[j] = qs[j].at[i, wp, woff].set(row)
+                kg = kv_dequantize(gather(state["k"], i),
+                                   qs[0][i][tables].reshape(B, lctx),
+                                   qs[1][i][tables].reshape(B, lctx))
+                vg = kv_dequantize(gather(state["v"], i),
+                                   qs[2][i][tables].reshape(B, lctx),
+                                   qs[3][i][tables].reshape(B, lctx))
             s = jnp.einsum("bhd,blhd->bhl", q, kg)
             s = jnp.where(mask[:, None], s, -1e30)
             pr = jax.nn.softmax(s, axis=-1)
@@ -218,7 +276,8 @@ class CausalLM(HybridBlock):
             h = self._layer(p, i, h, attend)
         hf = _ln(h, p["lnf_g"], p["lnf_b"])
         logits = rowdot(hf, p["embed"].T)
-        return logits, state["k"], state["v"]
+        out = (logits, state["k"], state["v"])
+        return out if state["q"] is None else out + tuple(state["q"])
 
     def sample_math(self, logits, keys, steps, temps):
         """Per-row next-token choice on a deterministic per-request key

@@ -20,6 +20,15 @@ The page pools are donated to both the commit and step programs
 pre-call arrays are poisoned at sites ``decode.prefill_commit`` /
 ``decode.step`` exactly like the aggregated-optimizer and engine-segment
 donation sites.
+
+With an int8 cache (``kv_dtype="int8"``) the same two surfaces carry the
+quantization: the commit program scatter-*quantizes* the prefill's fp32
+K/V into the int8 pools (+ per-row scale/mid sidecars) and the step
+program gather-*dequantizes* before attending — both fused into the
+already-compiled per-bucket executables, so the dtype costs zero extra
+programs and ``warm()`` covers it exactly like fp32.  The pool argument
+list simply grows from ``(k, v)`` to ``(k, v, k_scale, k_mid, v_scale,
+v_mid)`` (all donated, all poisoned).
 """
 from __future__ import annotations
 
@@ -75,7 +84,8 @@ class DecodeRuntime:
 
     def __init__(self, block, cache=None, batch_buckets=(1, 2, 4, 8),
                  seq_buckets=None, page_size=16, num_pages=None,
-                 max_slots=None, mesh=None, name=None, warm=True):
+                 max_slots=None, kv_dtype=None, prefix_sharing=True,
+                 mesh=None, name=None, warm=True):
         if not getattr(block, "_active", False):
             block.hybridize()
         self._block = block
@@ -103,6 +113,7 @@ class DecodeRuntime:
                 max_pages_per_seq=max_pages,
                 max_slots=(max_slots if max_slots is not None
                            else 2 * self.max_batch),
+                kv_dtype=kv_dtype, prefix_sharing=prefix_sharing,
                 mesh=mesh)
         if cache.context_length > block.max_length:
             raise ValueError(
@@ -136,6 +147,7 @@ class DecodeRuntime:
             self._replicate = lambda x: jax.device_put(x, rep)
         self._step_fns = {}       # batch_bucket -> donated jit
         self._commit_fns = {}     # (batch_bucket, seq_bucket) -> donated jit
+        self._sample_fn = None    # batch-1 first-token sampler (prefix hits)
         self._prefill_sigs = set()
         self._warmed = False
         if warm:
@@ -193,6 +205,15 @@ class DecodeRuntime:
                           np.zeros((b, np_), "int32"),
                           np.zeros((b, 2), "uint32"),
                           np.zeros((b,), "int32"), np.zeros((b,), "float32"))
+            # the two programs OUTSIDE the bucket grid: the batch-1
+            # first-token sampler (prefix-hit admissions) and the cache's
+            # CoW page copy — drive both so no prefix hit compiles
+            # anything mid-traffic
+            self.sample_first(
+                np.zeros((self._block.vocab_size,), "float32"),
+                np.zeros((2,), "uint32"), 0.0)
+            if self.cache.prefix_sharing:
+                self.cache.warm_programs()
         self._warmed = True
         if _tel.enabled:
             _tel.count("decode.warmup_compiles",
@@ -228,24 +249,29 @@ class DecodeRuntime:
     def _build_step(self):
         import jax
         block, page_size = self._block, self.cache.page_size
+        quantized = self.cache.quantized
 
         def step(params, tokens, positions, tables, keys, steps, temps,
-                 k_pages, v_pages):
+                 *pools):
             p = block._params_dict(params)
-            logits, k_pages, v_pages = block.step_math(
-                p, tokens, positions, tables, k_pages, v_pages, page_size)
-            nxt = block.sample_math(logits, keys, steps, temps)
-            return nxt, k_pages, v_pages
+            out = block.step_math(
+                p, tokens, positions, tables, pools[0], pools[1], page_size,
+                quant=pools[2:] if quantized else None)
+            nxt = block.sample_math(out[0], keys, steps, temps)
+            return (nxt,) + tuple(out[1:])
 
-        return jax.jit(step, donate_argnums=(7, 8))
+        n = len(self.cache.pools)
+        return jax.jit(step, donate_argnums=tuple(range(7, 7 + n)))
 
     def _build_commit(self):
         import jax
         import jax.numpy as jnp
+        from .model import kv_quantize_rows
         block, page_size = self._block, self.cache.page_size
+        quantized = self.cache.quantized
 
         def commit(params, kv, logits, lengths, tables, keys, steps, temps,
-                   k_pages, v_pages):
+                   *pools):
             B, S = kv.shape[2], kv.shape[3]
             j = jnp.arange(S)[None, :]
             valid = j < lengths[:, None]
@@ -253,21 +279,36 @@ class DecodeRuntime:
                 valid, jnp.take_along_axis(tables, j // page_size, axis=1),
                 0)
             dest_off = jnp.broadcast_to(j % page_size, (B, S))
-            k_pages = k_pages.at[:, dest_page, dest_off].set(kv[0])
-            v_pages = v_pages.at[:, dest_page, dest_off].set(kv[1])
+            if quantized:
+                # scatter-quantize: per-row (L, B, S) scale/mid sidecars
+                # ride the same dest indices as the int8 values
+                kq, ksc, kmd = kv_quantize_rows(kv[0])
+                vq, vsc, vmd = kv_quantize_rows(kv[1])
+                new = [pools[0].at[:, dest_page, dest_off].set(kq),
+                       pools[1].at[:, dest_page, dest_off].set(vq)]
+                for pool, rows in zip(pools[2:], (ksc, kmd, vsc, vmd)):
+                    new.append(pool.at[:, dest_page, dest_off].set(rows))
+            else:
+                new = [pools[0].at[:, dest_page, dest_off].set(kv[0]),
+                       pools[1].at[:, dest_page, dest_off].set(kv[1])]
             first = block.sample_math(logits, keys, steps, temps)
-            return first, k_pages, v_pages
+            return (first,) + tuple(new)
 
-        return jax.jit(commit, donate_argnums=(8, 9))
+        n = len(self.cache.pools)
+        return jax.jit(commit, donate_argnums=tuple(range(8, 8 + n)))
 
     # ------------------------------------------------------------ execution
     def prefill(self, tokens, lengths, tables, keys, temps):
         """Prefill + commit one padded prompt group.
 
         ``tokens (B, S)`` / ``lengths (B,)`` padded to a grid bucket
-        (padded rows: length 1, all-trash table).  Returns the sampled
-        first token per row (host int32 array).  The page pools are
-        functionally updated in place (donated)."""
+        (padded rows: length 1, all-trash table).  Returns ``(first,
+        logits)`` — the sampled first token per row (host int32 array)
+        plus, when the cache shares prefixes, the host copy of the
+        last-position logits (``(B, vocab) float32``; the scheduler
+        publishes each row to the prefix index so an exact-repeat prompt
+        can skip this whole call).  The page pools are functionally
+        updated in place (donated)."""
         b, s = tokens.shape
         tok_nd = nd.array(tokens)
         len_nd = nd.array(lengths)
@@ -283,22 +324,24 @@ class DecodeRuntime:
             self._prefill_sigs.add(sig)
             commit = self._commit_fn(b, s)
             cache = self.cache
-            kp, vp = cache.k_pages, cache.v_pages
+            pools = cache.pools
             kv_raw, logits_raw = kv.data, logits.data
             if self._replicate is not None:
                 kv_raw = self._replicate(kv_raw)
                 logits_raw = self._replicate(logits_raw)
-            first, new_k, new_v = commit(
+            logits_host = (np.asarray(logits_raw, "float32")
+                           if cache.prefix_sharing else None)
+            out = commit(
                 self._params, kv_raw, logits_raw,
                 lengths.astype("int32"), tables.astype("int32"),
                 keys.astype("uint32"), np.zeros((b,), "int32"),
-                temps.astype("float32"), kp, vp)
+                temps.astype("float32"), *pools)
             if _san.donation:
                 # the commit donated the page pools: poison the pre-call
                 # arrays so any stray alias raises naming this site
-                _san.poison([kp, vp], "decode.prefill_commit")
-            cache.k_pages, cache.v_pages = new_k, new_v
-        return np.asarray(first)
+                _san.poison(list(pools), "decode.prefill_commit")
+            cache.set_pools(out[1:])
+        return np.asarray(out[0]), logits_host
 
     def step(self, tokens, positions, tables, keys, steps, temps):
         """One decode step for a batch padded to a batch bucket (padded
@@ -308,14 +351,30 @@ class DecodeRuntime:
         fn = self._step_fn(b)
         with _tel.span("decode.step", model=self.name, batch=b):
             cache = self.cache
-            kp, vp = cache.k_pages, cache.v_pages
-            nxt, new_k, new_v = fn(
+            pools = cache.pools
+            out = fn(
                 self._params, tokens.astype("int32"),
                 positions.astype("int32"), tables.astype("int32"),
                 keys.astype("uint32"), steps.astype("int32"),
-                temps.astype("float32"), kp, vp)
+                temps.astype("float32"), *pools)
             if _san.donation:
                 # the step donated the page pools (see prefill above)
-                _san.poison([kp, vp], "decode.step")
-            cache.k_pages, cache.v_pages = new_k, new_v
-        return np.asarray(nxt)
+                _san.poison(list(pools), "decode.step")
+            cache.set_pools(out[1:])
+        return np.asarray(out[0])
+
+    def sample_first(self, logits_row, key, temp):
+        """Sample a prefix-hit admission's first token from the cached
+        last-position logits — the batch-1 analog of the commit program's
+        sampler.  ``sample_math`` is row-stable, so given the bitwise-
+        identical logits row this returns the bitwise-identical token a
+        cold prefill would have sampled (step index 0, same fold-in)."""
+        if self._sample_fn is None:
+            import jax
+            self._sample_fn = jax.jit(self._block.sample_math)
+        tok = self._sample_fn(
+            np.asarray(logits_row, "float32")[None],
+            np.asarray(key, "uint32")[None],
+            np.zeros((1,), "int32"),
+            np.asarray([temp], "float32"))
+        return int(np.asarray(tok)[0])

@@ -216,7 +216,9 @@ class DecodeScheduler:
                 self._reject(
                     req, "kv_exhausted",
                     f"reservation of {n_pages} pages can never fit "
-                    f"({self._cache.usable_pages} usable)")
+                    f"({self._cache.usable_pages} usable, "
+                    f"{self._cache.reclaimable_pages()} reclaimable from "
+                    f"the shared-prefix cache)")
                 raise req.future.exception()
             if self._started:
                 self._respawn_worker_locked()
@@ -372,7 +374,11 @@ class DecodeScheduler:
                 len(self._active) + len(joining) < self._runtime.max_batch:
             req = self._queue[0]
             try:
-                req.slot = self._cache.alloc(req.n_pages)
+                # the prompt rides along: matched published prefix pages
+                # are acquired by refcount (and a full-prompt hit carries
+                # cached first-token logits) instead of allocated cold
+                req.slot = self._cache.alloc(req.n_pages,
+                                             prompt=req.prompt)
             except KVCacheExhausted:
                 break        # wait for evictions; deadline still applies
             except Exception as e:
@@ -402,15 +408,53 @@ class DecodeScheduler:
     # ------------------------------------------------------------ decode ops
     def _prefill(self, joining):
         """Prefill admitted requests grouped by seq bucket, each group
-        padded to a (batch, seq) grid point."""
+        padded to a (batch, seq) grid point.  Requests whose whole prompt
+        matched a published prefix never enter a group: their K/V is
+        already paged in and the cached logits yield the first token —
+        the prefix-hit TTFT path."""
         rt = self._runtime
         groups = {}
         for req in joining:
-            groups.setdefault(rt.seq_bucket_for(req.prompt.size),
-                              []).append(req)
+            if req.slot.prefix_logits is not None:
+                self._admit_prefix_hit(req)
+            else:
+                groups.setdefault(rt.seq_bucket_for(req.prompt.size),
+                                  []).append(req)
         for s, reqs in sorted(groups.items()):
             for i in range(0, len(reqs), rt.max_batch):
                 self._prefill_group(reqs[i:i + rt.max_batch], s)
+
+    def _admit_prefix_hit(self, req):
+        """A full-prompt prefix hit: admission IS the time-to-first-token
+        — one batch-1 sample over the cached last-position logits (row-
+        stable, so the token is bitwise what a cold prefill would have
+        sampled), no prefill program, no K/V recompute."""
+        rt = self._runtime
+        _flight.record("decode.prefix_hit", detail=rt.name)
+        t_pre = time.perf_counter()
+        first = rt.sample_first(req.slot.prefix_logits, req.key, req.temp)
+        req.slot.prefix_logits = None
+        now = time.perf_counter()
+        req.ttft_ms = (now - req.t_submit) * 1e3
+        if _tel.enabled:
+            _tel.count("decode.ttft_ms", round(req.ttft_ms, 3),
+                       model=rt.name)
+            _tel.record_span("decode.ttft", req.t_submit, now, model=rt.name)
+            _tel.observe("decode.ttft_ms", req.ttft_ms)
+            _tel.count("decode.tokens", 1, model=rt.name)
+            _tel.count("decode.prefill_skips", model=rt.name)
+            if req.ctx is not None:
+                _tel.record_span("decode.queue_wait", req.t_submit, t_pre,
+                                 tid=req.lane, trace=req.ctx, model=rt.name)
+                _tel.record_span("decode.prefix_hit", t_pre, now,
+                                 tid=req.lane, trace=req.ctx, model=rt.name)
+        req.cur = first
+        req.tokens.append(first)
+        req.step_idx = 1
+        if self._is_finished(req):
+            self._finish(req)
+        else:
+            self._active.append(req)
 
     def _prefill_group(self, reqs, s):
         rt, cache = self._runtime, self._cache
@@ -423,12 +467,22 @@ class DecodeScheduler:
         for r, req in enumerate(reqs):
             tokens[r, :req.prompt.size] = req.prompt
             lengths[r] = req.prompt.size
-            tables[r] = req.slot.page_table
+            # write_table: a partial prefix hit re-runs the full dense
+            # prefill (bitwise the cold computation) but masks its shared
+            # pages to the trash page at commit — their content is
+            # already paged in and possibly read by live sequences
+            tables[r] = req.slot.write_table()
             keys[r] = req.key
             temps[r] = req.temp
         _flight.record("decode.prefill", detail=rt.name, value=len(reqs))
         t_pre = time.perf_counter()
-        first = rt.prefill(tokens, lengths, tables, keys, temps)
+        first, logits = rt.prefill(tokens, lengths, tables, keys, temps)
+        if logits is not None:
+            # publish BEFORE any decode step: each slot's prompt pages
+            # hold exactly the prompt K/V right now (generated tokens
+            # land later), so the index copies/pins clean pages
+            for r, req in enumerate(reqs):
+                cache.publish(req.slot, req.prompt, logits[r])
         now = time.perf_counter()
         done = []
         for r, req in enumerate(reqs):
@@ -472,6 +526,16 @@ class DecodeScheduler:
         if _san.slots:
             for req in self._active:
                 cache.check_slot(req.slot)
+        if cache.prefix_sharing:
+            # copy-on-write fence: the page each row is about to write
+            # must be exclusively owned.  Admission already privatized
+            # every write-path page (shared pages only ever cover the
+            # prompt), so this is two refcount reads per row — but it is
+            # the guard that makes "a shared page is never scribbled on"
+            # an invariant instead of an accident.
+            for req in self._active:
+                cache.ensure_writable(req.slot,
+                                      req.position // cache.page_size)
         n = len(self._active)
         b = rt.batch_bucket_for(n)
         tokens = np.zeros((b,), "int32")
@@ -636,11 +700,13 @@ class DecodeSession:
     the running decode batch at step boundaries."""
 
     def __init__(self, block, batch_buckets=(1, 2, 4, 8), seq_buckets=None,
-                 page_size=16, num_pages=None, max_slots=None, mesh=None,
+                 page_size=16, num_pages=None, max_slots=None,
+                 kv_dtype=None, prefix_sharing=True, mesh=None,
                  queue_depth=256, warm=True, start=True, **scheduler_kwargs):
         self.runtime = DecodeRuntime(
             block, batch_buckets=batch_buckets, seq_buckets=seq_buckets,
             page_size=page_size, num_pages=num_pages, max_slots=max_slots,
+            kv_dtype=kv_dtype, prefix_sharing=prefix_sharing,
             mesh=mesh, warm=warm)
         self.cache = self.runtime.cache
         self.scheduler = DecodeScheduler(
