@@ -253,6 +253,8 @@ class PagedKVCache:
         self._page_gen = [0] * self.num_pages    # bumped on recycle
         self._prefix_pages = OrderedDict()       # chain hash -> page (LRU)
         self._page_hash = {}                     # page -> chain hash
+        self._chain_parent = {}                  # chain hash -> prev hash
+        self._chain_children = {}                # chain hash -> {next hashes}
         self._full_index = OrderedDict()         # prompt hash -> _FullEntry
         self.prefix_hits = 0
         self.prefix_misses = 0
@@ -370,12 +372,17 @@ class PagedKVCache:
         with self._lock:
             if not self._free_slots:
                 raise KVCacheExhausted(1, 0, what="slots")
-            shared, entry = [], None
+            shared, entry, fh = [], None, None
             if use_prefix:
-                entry = self._full_index.get(self._full_hash(prompt))
+                fh = self._full_hash(prompt)
+                entry = self._full_index.get(fh)
                 if entry is not None:
-                    self._full_index.move_to_end(self._full_hash(prompt))
+                    self._full_index.move_to_end(fh)
                     shared = list(entry.pages)
+                    for p in shared:
+                        h = self._page_hash.get(p)
+                        if h is not None:
+                            self._prefix_pages.move_to_end(h)
                 else:
                     for h in self._page_hashes(prompt):
                         p = self._prefix_pages.get(h)
@@ -383,12 +390,31 @@ class PagedKVCache:
                             break
                         self._prefix_pages.move_to_end(h)
                         shared.append(p)
+            # acquire the matched pages BEFORE any reclaim: with
+            # slot_refs still 0 the reclaimer could evict exactly the
+            # pages just matched and re-issue them as writable fresh
+            # pages, aliasing the shared prefix
+            for p in shared:
+                self._slot_refs[p] += 1
+            tail_src = None
             n_fresh = n_pages - len(shared)
             if entry is not None and entry.tail is not None:
                 n_fresh = max(n_fresh, 1)   # room for the private tail copy
+                # keep-alive ref on the index's tail page: holds it
+                # through reclaim and the device copy below (dropped
+                # once the copy lands)
+                tail_src = entry.tail
+                self._slot_refs[tail_src] += 1
             if n_fresh > len(self._free_pages):
-                self._reclaim_locked(n_fresh)
+                self._reclaim_locked(
+                    n_fresh, keep=(fh,) if entry is not None else ())
             if n_fresh > len(self._free_pages):
+                # roll back the acquisitions (releasing any page whose
+                # index pin was reclaimed above) before reporting
+                for p in shared:
+                    self._drop_slot_ref_locked(p)
+                if tail_src is not None:
+                    self._drop_slot_ref_locked(tail_src)
                 # not a hit/miss lookup: the scheduler retries this alloc
                 # at every boundary until pages free up, and counting each
                 # retry would skew prefix_hit_rate
@@ -398,13 +424,11 @@ class PagedKVCache:
             slot_id = self._free_slots.pop()
             fresh = [self._free_pages.pop() for _ in range(n_fresh)]
             pages = list(shared) + fresh
-            if entry is not None and entry.tail is not None:
+            if tail_src is not None:
                 # the entry's tail page is the index's immutable copy —
                 # give this sequence its own (copy-on-write at admission:
                 # its first generated token writes into this page)
-                tail_copy = (entry.tail, fresh[0])
-            for p in shared:
-                self._slot_refs[p] += 1
+                tail_copy = (tail_src, fresh[0])
             for p in fresh:
                 self._slot_refs[p] += 1
             slot = KVSlot(slot_id, self._gen[slot_id], pages,
@@ -420,6 +444,9 @@ class PagedKVCache:
             self.peak_pages = max(self.peak_pages, in_use)
         if tail_copy is not None:
             self._copy_page(*tail_copy)
+            with self._lock:
+                # drop the temporary keep-alive ref on the source page
+                self._drop_slot_ref_locked(tail_copy[0])
         if _san.slots:
             _san.register_kv_slot(self, slot_id, site)
         self._gauge(in_use)
@@ -455,9 +482,7 @@ class PagedKVCache:
             self._gen[slot.slot_id] += 1
             del self._live[slot.slot_id]
             for p in slot.pages:
-                self._slot_refs[p] -= 1
-                if self._slot_refs[p] == 0 and self._pin_refs[p] == 0:
-                    self._release_locked(p)
+                self._drop_slot_ref_locked(p)
             self._free_slots.append(slot.slot_id)
             in_use = self.num_pages - 1 - len(self._free_pages)
         self._gauge(in_use)
@@ -467,6 +492,11 @@ class PagedKVCache:
         the slots sanitizer's page-level poison)."""
         self._free_pages.append(page)
         self._page_gen[page] += 1
+
+    def _drop_slot_ref_locked(self, page):
+        self._slot_refs[page] -= 1
+        if self._slot_refs[page] == 0 and self._pin_refs[page] == 0:
+            self._release_locked(page)
 
     # ------------------------------------------------------- prefix index
     def publish(self, slot, prompt, logits_row=None):
@@ -485,7 +515,7 @@ class PagedKVCache:
         tail_copy = None
         with self._lock:
             hashes = self._page_hashes(prompt)
-            chain = []
+            chain, prev = [], None
             for i, h in enumerate(hashes):
                 p = self._prefix_pages.get(h)
                 if p is None:
@@ -495,7 +525,14 @@ class PagedKVCache:
                     self._prefix_pages[h] = p
                     self._page_hash[p] = h
                     self._pin_refs[p] += 1
+                    # chain links let eviction unpublish whole suffixes
+                    # (h encodes its predecessor, so the parent of a
+                    # published hash is the same across prompts)
+                    self._chain_parent[h] = prev
+                    if prev is not None:
+                        self._chain_children.setdefault(prev, set()).add(h)
                 chain.append(p)
+                prev = h
             fh = self._full_hash(prompt)
             if logits_row is None or fh in self._full_index:
                 self._gauge_prefix_locked()
@@ -532,6 +569,20 @@ class PagedKVCache:
             self._unpin_locked(entry.tail)
 
     def _unpublish_page_locked(self, h):
+        # unpublish the suffix first: links past ``h`` could never match
+        # again once ``h`` is gone (alloc stops at the first missing
+        # link), so leaving them pinned would just strand pages
+        for child in list(self._chain_children.get(h, ())):
+            if child in self._prefix_pages:
+                self._unpublish_page_locked(child)
+        self._chain_children.pop(h, None)
+        parent = self._chain_parent.pop(h, None)
+        if parent is not None:
+            kids = self._chain_children.get(parent)
+            if kids is not None:
+                kids.discard(h)
+                if not kids:
+                    del self._chain_children[parent]
         page = self._prefix_pages.pop(h)
         del self._page_hash[page]
         # a broken chain invalidates every full entry that rides it
@@ -545,16 +596,25 @@ class PagedKVCache:
         if self._pin_refs[page] == 0 and self._slot_refs[page] == 0:
             self._release_locked(page)
 
-    def _reclaim_locked(self, need_free):
+    def _reclaim_locked(self, need_free, keep=()):
         """Evict LRU index state until ``need_free`` pages are free (or
         nothing reclaimable remains): full entries first (their private
-        tail copies are pure cache), then whole published chains."""
-        while len(self._free_pages) < need_free and self._full_index:
-            h = next(iter(self._full_index))
-            self._drop_full_locked(h)
+        tail copies are pure cache), then whole published chains.
+        ``keep`` full-entry hashes are exempt — the entry an in-flight
+        alloc just matched must not be reclaimed out from under it.
+        Unpublishing a chain link takes its whole suffix with it, so the
+        surviving index state stays matchable."""
+        for fh in list(self._full_index):
+            if len(self._free_pages) >= need_free:
+                break
+            if fh in keep:
+                continue
+            self._drop_full_locked(fh)
         for h in list(self._prefix_pages):
             if len(self._free_pages) >= need_free:
                 break
+            if h not in self._prefix_pages:
+                continue    # already gone as part of an earlier suffix
             if self._slot_refs[self._prefix_pages[h]] == 0:
                 self._unpublish_page_locked(h)
 
@@ -566,7 +626,8 @@ class PagedKVCache:
             for fh in list(self._full_index):
                 self._drop_full_locked(fh)
             for h in list(self._prefix_pages):
-                self._unpublish_page_locked(h)
+                if h in self._prefix_pages:
+                    self._unpublish_page_locked(h)
             in_use = self.num_pages - 1 - len(self._free_pages)
             self._gauge_prefix_locked()
         self._gauge(in_use)
@@ -597,15 +658,17 @@ class PagedKVCache:
                     1, 0, reclaimable=self._reclaimable_locked())
             fresh = self._free_pages.pop()
             self._slot_refs[fresh] += 1
-            self._slot_refs[page] -= 1
-            if self._slot_refs[page] == 0 and self._pin_refs[page] == 0:
-                self._release_locked(page)
             slot.pages[page_idx] = fresh
             slot.page_table[page_idx] = fresh
             slot.page_gens[page_idx] = self._page_gen[fresh]
             if page_idx < slot.shared_pages:
                 slot.shared_pages = page_idx
         self._copy_page(page, fresh)
+        with self._lock:
+            # the slot's ref on the old page is dropped only AFTER the
+            # device copy: releasing it inside the lock above would let
+            # a concurrent reclaim recycle the copy's source page
+            self._drop_slot_ref_locked(page)
 
     def _copy_page(self, src, dst):
         """One jitted donated program copies page ``src`` onto ``dst``

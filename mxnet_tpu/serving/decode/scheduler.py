@@ -455,6 +455,7 @@ class DecodeScheduler:
             self._finish(req)
         else:
             self._active.append(req)
+        self._consecutive_failures = 0
 
     def _prefill_group(self, reqs, s):
         rt, cache = self._runtime, self._cache

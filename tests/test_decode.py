@@ -551,6 +551,103 @@ def test_prefix_cache_reclaimed_under_pressure():
     assert "reclaimable from the shared-prefix cache" in str(ei.value)
 
 
+def _publish_and_free(c, prompt):
+    """Publish ``prompt`` (chain + full entry) and leave its pages
+    pinned-only; returns the donor's page list."""
+    donor = c.alloc(len(prompt) // c.page_size, prompt=prompt)
+    c.publish(donor, prompt, logits_row=np.zeros(7, "float32"))
+    pages = list(donor.pages)
+    c.free(donor)
+    return pages
+
+
+def test_prefix_hit_survives_reclaim_pressure():
+    """Regression: a prefix-hit alloc under page pressure must never
+    reclaim the pages it just matched — pre-fix the reclaimer freed the
+    matched entry's pages (slot_refs still 0) and re-issued one as a
+    writable fresh page, aliasing the shared prefix."""
+    c = PagedKVCache(2, 2, 16, page_size=4, num_pages=8,
+                     max_pages_per_seq=4, max_slots=4)
+    p1 = np.arange(1, 9, dtype="int32")
+    p2 = np.arange(101, 109, dtype="int32")
+    _publish_and_free(c, p1)
+    p2_pages = _publish_and_free(c, p2)
+    blocker = c.alloc(3)                       # 0 free: hit must reclaim
+    s = c.alloc(3, prompt=p2)                  # full hit on p2
+    assert s.shared_pages == 2
+    assert len(set(s.pages)) == len(s.pages)   # no page aliased
+    assert s.pages[:2] == p2_pages[:2]         # matched pages kept intact
+    # the matched entry survived reclaim (p1, the cold one, was evicted)
+    assert c.stats()["prefix_cached_pages"] == 2
+    c.free(s)
+    c.free(blocker)
+    assert c.alloc(3, prompt=p2).shared_pages == 2
+
+
+def test_prefix_hit_exhausted_rolls_back():
+    """When even reclaim can't free a fresh page, the hit path must roll
+    back its acquisitions: the index stays intact and refcounts balance
+    (pre-fix the matched pages were double-counted or freed)."""
+    c = PagedKVCache(2, 2, 16, page_size=4, num_pages=6,
+                     max_pages_per_seq=4, max_slots=4)
+    p1 = np.arange(1, 9, dtype="int32")
+    _publish_and_free(c, p1)
+    blocker = c.alloc(3)                       # 0 free, 2 pinned by index
+    with pytest.raises(KVCacheExhausted):
+        c.alloc(3, prompt=p1)                  # hit, but no room for fresh
+    assert c.stats()["prefix_cached_pages"] == 2   # index untouched
+    c.free(blocker)
+    s = c.alloc(3, prompt=p1)                  # retry after pressure: hit
+    assert s.shared_pages == 2
+    assert len(set(s.pages)) == len(s.pages)
+    c.free(s)
+    c.drop_prefix_cache()
+    assert c.pages_in_use == 0
+    assert all(r == 0 for r in c._slot_refs)   # refcounts balanced
+
+
+def test_chain_eviction_unpublishes_suffix():
+    """Evicting a chain link takes its whole suffix: links past a missing
+    one can never match again, so leaving them pinned would strand pages
+    in the index (pre-fix they held HBM invisibly)."""
+    c = PagedKVCache(2, 2, 16, page_size=4, num_pages=6,
+                     max_pages_per_seq=4, max_slots=4)
+    prompt = np.arange(1, 13, dtype="int32")   # 3-page chain
+    donor = c.alloc(3, prompt=prompt)
+    c.publish(donor, prompt)                   # chain pins only, no entry
+    c.free(donor)
+    assert c.stats()["prefix_cached_pages"] == 3
+    blocker = c.alloc(2)                       # 0 free
+    s = c.alloc(1)                             # reclaim evicts the chain
+    # the LRU head link went, and the rest of the chain went WITH it —
+    # nothing is left pinned under unmatchable hashes
+    assert c.stats()["prefix_cached_pages"] == 0
+    assert c.stats()["reclaimable_pages"] == 0
+    c.free(s)
+    c.free(blocker)
+
+
+def test_full_hit_keeps_chain_hot():
+    """A full-entry hit must LRU-touch its chain hashes too: under later
+    pressure the genuinely cold chain is evicted first, not the chain the
+    hit just proved hot."""
+    c = PagedKVCache(2, 2, 16, page_size=4, num_pages=10,
+                     max_pages_per_seq=4, max_slots=4)
+    p1 = np.arange(1, 9, dtype="int32")
+    p2 = np.arange(101, 109, dtype="int32")
+    _publish_and_free(c, p1)
+    _publish_and_free(c, p2)
+    hot = c.alloc(2, prompt=p1)                # full hit: p1 is hot now
+    c.free(hot)
+    blockers = [c.alloc(4), c.alloc(1)]        # 0 free
+    trigger = c.alloc(2)                       # needs 2: evicts one chain
+    c.free(trigger)
+    for b in blockers:
+        c.free(b)
+    assert c.alloc(2, prompt=p1).shared_pages == 2   # hot chain survived
+    assert c.alloc(2, prompt=p2).shared_pages == 0   # cold chain evicted
+
+
 def test_stale_slot_sanitization_under_sharing():
     """The ISSUE 17 satellite: freeing one session of a shared prefix must
     NOT poison the survivor; the LAST free recycles (and poisons); a
@@ -718,7 +815,13 @@ def test_prefix_hit_skips_prefill_telemetry(runtime):
     try:
         p = _prompt(60, 9, 9)
         s.generate(p, max_new_tokens=4, seed=1, timeout=60)
-        s.generate(p, max_new_tokens=4, seed=2, timeout=60)
+        # a successful prefix-hit admission counts as circuit-breaker
+        # success exactly like a cold prefill does (max_new_tokens=1:
+        # the request finishes at admission, so no decode step runs
+        # that could reset the counter on the hit path's behalf)
+        s._consecutive_failures = 1
+        s.generate(p, max_new_tokens=1, seed=2, timeout=60)
+        assert s._consecutive_failures == 0
     finally:
         s.close(drain=False, timeout=10.0)
     c = telemetry.snapshot()["counters"]
