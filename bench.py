@@ -57,7 +57,7 @@ BASELINE.md).  All other configs are nested under ``"extra"``:
   (must be 0)
 
 Select a subset with
-BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,int8,io,e2e,eager,engine,optimizer,serving,decode,resilience.
+BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,int8,io,e2e,eager,engine,optimizer,serving,decode,gateway,resilience.
 The full json carries a ``telemetry`` sub-dict (recompile count,
 collective bytes, io wait ms — disable with BENCH_TELEMETRY=0) so each
 BENCH record carries its own diagnosis.
@@ -1359,6 +1359,203 @@ def bench_decode():
     }
 
 
+def bench_gateway():
+    """HTTP front door (``mxnet_tpu.serving.gateway``): what the wire
+    costs on top of the in-process scheduler, measured over real
+    localhost sockets.
+
+    Four numbers the gateway is accountable for:
+
+    - **req/s + p99** — concurrent buffered ``POST /v1/generate`` through
+      the shared ThreadingHTTPServer (HTTP parse, JSON, admission,
+      scheduler ride, response — the whole door).
+    - **TTFT, streamed vs buffered** — the point of SSE: the client holds
+      its first token after one decode step instead of after the whole
+      sequence.  Both paths carry the bitwise-identical token sequence
+      (asserted here, not assumed).
+    - **shed rate at 2x overload** — offered load at twice the admission
+      capacity must produce 429s (bounded queues, honest Retry-After) and
+      ZERO 5xx: pressure is a status code on a healthy box, never an
+      error.
+    - **cold start, with vs without a warm AOT program cache** — three
+      subprocess restarts via ``tests/aot_cache_worker.py``: no cache,
+      cache-populating, cache-warm.  The warm restart loads executables
+      off disk instead of tracing+compiling, and its tokens are bitwise
+      what the cold process produced.
+    """
+    import http.client
+    import subprocess
+    import sys as _sys
+    import tempfile
+    import time as _time
+    from concurrent.futures import ThreadPoolExecutor
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving.decode import DecodeSession, get_decode_model
+    from mxnet_tpu.serving.gateway import AdmissionController, Gateway
+
+    n_requests = int(os.environ.get("BENCH_GATEWAY_REQUESTS", "64"))
+    overload_cap = int(os.environ.get("BENCH_GATEWAY_CAPACITY", "8"))
+    mx.random.seed(0)
+    net = get_decode_model("decode_tiny", vocab_size=96, max_length=32,
+                           units=32, num_heads=2)
+    net.initialize()
+    was_on = telemetry.is_enabled()
+    telemetry.enable()
+    sess = DecodeSession(net, batch_buckets=(1, 2, 4, 8), seq_buckets=(8,),
+                         page_size=8, queue_depth=4 * n_requests)
+    gw = Gateway(capacity=4 * n_requests)
+    gw.add_decode("tiny", sess)
+
+    def post(body, timeout=120):
+        conn = http.client.HTTPConnection("127.0.0.1", gw.port,
+                                          timeout=timeout)
+        try:
+            conn.request("POST", "/v1/generate", json.dumps(body),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            return r.status, r.read()
+        finally:
+            conn.close()
+
+    def req(i, tokens=8):
+        return {"prompt": [1 + i % 90, 3, 7], "max_new_tokens": tokens,
+                "temperature": 0.8, "seed": i}
+
+    # ------------------------------------------------ throughput + latency
+    post(req(0))                                      # route warm
+    lat, lock = [], __import__("threading").Lock()
+
+    def client(i):
+        t0 = _time.perf_counter()
+        st, _ = post(req(i))
+        dt = _time.perf_counter() - t0
+        with lock:
+            lat.append((st, dt))
+
+    pool = ThreadPoolExecutor(max_workers=min(n_requests, 32))
+    t0 = _time.perf_counter()
+    list(pool.map(client, range(n_requests)))
+    wall = _time.perf_counter() - t0
+    pool.shutdown()
+    assert all(st == 200 for st, _ in lat), sorted({st for st, _ in lat})
+    times = sorted(dt for _, dt in lat)
+    http_stats = {
+        "n_requests": n_requests,
+        "req_per_sec": round(n_requests / wall, 2),
+        "latency_ms_p50": round(times[len(times) // 2] * 1e3, 2),
+        "latency_ms_p99": round(
+            times[min(len(times) - 1, int(len(times) * 0.99))] * 1e3, 2),
+    }
+
+    # ----------------------------------------------- TTFT streamed vs full
+    def streamed_once(i, tokens=16):
+        conn = http.client.HTTPConnection("127.0.0.1", gw.port,
+                                          timeout=120)
+        t0 = _time.perf_counter()
+        conn.request("POST", "/v1/generate",
+                     json.dumps(dict(req(i, tokens), stream=True)),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        ttft, toks = None, []
+        while True:
+            line = r.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            payload = line[len(b"data: "):]
+            if payload == b"[DONE]":
+                break
+            obj = json.loads(payload)
+            if "token" in obj:
+                if ttft is None:
+                    ttft = (_time.perf_counter() - t0) * 1e3
+                toks.append(obj["token"])
+        total = (_time.perf_counter() - t0) * 1e3
+        conn.close()
+        return ttft, total, toks
+
+    ttft_s, ttft_b = [], []
+    for i in range(5):
+        t, total, toks = streamed_once(100 + i)
+        ttft_s.append(t)
+        t0 = _time.perf_counter()
+        st, raw = post(req(100 + i, 16))
+        ttft_b.append((_time.perf_counter() - t0) * 1e3)
+        buffered = json.loads(raw)["token_ids"]
+        assert toks == buffered, (toks, buffered)   # the bitwise contract
+    ttft = {
+        "streamed_ms": round(sorted(ttft_s)[len(ttft_s) // 2], 2),
+        "buffered_ms": round(sorted(ttft_b)[len(ttft_b) // 2], 2),
+        "tokens_bitwise_identical": True,
+    }
+    ttft["streamed_advantage"] = round(
+        ttft["buffered_ms"] / max(ttft["streamed_ms"], 1e-9), 2)
+
+    # -------------------------------------------------- shed at 2x overload
+    gw.admission = AdmissionController(capacity=overload_cap)
+    offered = 2 * overload_cap
+    statuses = []
+
+    def overload_client(i):
+        st, _ = post(req(200 + i, 16))
+        with lock:
+            statuses.append(st)
+
+    pool = ThreadPoolExecutor(max_workers=offered)
+    list(pool.map(overload_client, range(2 * offered)))
+    pool.shutdown()
+    shed = sum(1 for s in statuses if s == 429)
+    overload = {
+        "capacity": overload_cap,
+        "offered_concurrency": offered,
+        "n_requests": len(statuses),
+        "n_ok": sum(1 for s in statuses if s == 200),
+        "n_shed_429": shed,
+        "shed_rate": round(shed / len(statuses), 4),
+        "n_5xx": sum(1 for s in statuses if s >= 500),
+    }
+
+    gw.close()
+    sess.close(drain=False)
+    if not was_on:
+        telemetry.disable()
+
+    # -------------------------------------------------- cold-start drill
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tests", "aot_cache_worker.py")
+    cache_dir = tempfile.mkdtemp(prefix="mxnet-aot-bench-")
+
+    def restart(arg):
+        out = subprocess.run(
+            [_sys.executable, worker, arg], check=True, timeout=600,
+            capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    no_cache = restart("")
+    populate = restart(cache_dir)
+    warm = restart(cache_dir)
+    assert warm["cache"]["misses"] == 0 and \
+        warm["cache"]["fallbacks"] == 0, warm
+    assert warm["token_ids"] == populate["token_ids"] == \
+        no_cache["token_ids"], "warm-AOT restart must be bitwise-identical"
+    cold_start = {
+        "no_cache_warm_s": no_cache["warm_s"],
+        "aot_populate_warm_s": populate["warm_s"],
+        "aot_warm_warm_s": warm["warm_s"],
+        "speedup_warm_vs_no_cache": round(
+            no_cache["warm_s"] / max(warm["warm_s"], 1e-9), 2),
+        "programs_loaded": warm["cache"]["hits"],
+        "restart_bitwise_identical": True,
+    }
+
+    return {"http": http_stats, "ttft": ttft, "overload_2x": overload,
+            "cold_start": cold_start}
+
+
 def bench_resilience():
     """Fault-tolerance latency numbers (``mxnet_tpu.resilience``): what a
     durable checkpoint costs on cadence (atomic tmp+rename commit with a
@@ -1688,8 +1885,8 @@ def main():
     sel = [s.strip() for s in
            os.environ.get("BENCH_CONFIGS",
                           "headline,infer,fp32,amp,bert,ssd,int8,io,e2e,"
-                          "eager,engine,optimizer,serving,decode,resilience"
-                          ).split(",")]
+                          "eager,engine,optimizer,serving,decode,gateway,"
+                          "resilience").split(",")]
     extra = {}
 
     # telemetry rides along for diagnosis (counters only — the configs
@@ -1793,6 +1990,11 @@ def main():
             extra["decode_serving"] = bench_decode()
         except Exception as e:           # pragma: no cover
             extra["decode_serving"] = {"error": repr(e)}
+    if "gateway" in sel:
+        try:
+            extra["gateway"] = bench_gateway()
+        except Exception as e:           # pragma: no cover
+            extra["gateway"] = {"error": repr(e)}
     if "resilience" in sel:
         try:
             extra["resilience"] = bench_resilience()

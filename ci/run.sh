@@ -23,6 +23,15 @@
 #                zero steady-state decode.compile_miss, zero leaked KV
 #                slots/pages after drain, >=1 mid-flight join, and zero
 #                sanitizer violations
+#   gateway    - HTTP front door smoke: test_gateway.py +
+#                test_aot_cache.py, then a 1000-request concurrent
+#                /v1/infer drill over real sockets under
+#                MXNET_SANITIZE=donation,slots (zero drops, zero
+#                non-200), streamed /v1/generate byte-identical to
+#                buffered, shed rate > 0 at 2x admission overload with
+#                zero 5xx, and a cold-start drill: a restart against a
+#                warm on-disk AOT program cache must warm >=5x faster
+#                than a no-cache restart and answer bitwise-identically
 #   resilience - fault-tolerance smoke: test_resilience.py +
 #                test_pod_checkpoint.py (sharded co-writer saves, async,
 #                elastic resume), plus a 20-step train loop under
@@ -68,8 +77,8 @@
 #                planted-divergence run must leave a post-mortem flight
 #                dump per host naming each host's last framework events
 # Usage: ci/run.sh [stage ...]   (default: unit gate telemetry optimizer
-#                                 serving decode resilience engine io
-#                                 analyze trace)
+#                                 serving decode gateway resilience
+#                                 engine io analyze trace)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -317,6 +326,168 @@ print("decode smoke ok:", len(shared), "generate() calls,",
       snap.get("decode.joins"), "joins,",
       f"prefix_hit_rate {stats['prefix_hit_rate']},",
       "bitwise shared==cold, 0 misses, 0 leaks, sanitizer clean")
+PY
+}
+
+stage_gateway() {
+  JAX_PLATFORMS=cpu python -m pytest tests/test_gateway.py \
+      tests/test_aot_cache.py -q
+  # 1k-request concurrent drill at the front door under the sanitizer:
+  # every /v1/infer answers 200 over real sockets; streamed /v1/generate
+  # carries byte-for-byte the buffered token sequence; at 2x admission
+  # overload the box sheds (429 + Retry-After) with ZERO 5xx — pressure
+  # is a status code on a healthy gateway, never an error
+  JAX_PLATFORMS=cpu MXNET_SANITIZE=donation,slots MXNET_TELEMETRY=1 \
+      python - <<'PY'
+import http.client
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.analysis import sanitizer
+from mxnet_tpu.serving import ModelRegistry, ModelRuntime
+from mxnet_tpu.serving.decode import DecodeSession, get_decode_model
+from mxnet_tpu.serving.gateway import AdmissionController, Gateway
+
+assert sanitizer.donation and sanitizer.slots
+assert telemetry.is_enabled()
+
+reg = ModelRegistry()
+net = mx.gluon.nn.HybridSequential()
+with net.name_scope():
+    net.add(mx.gluon.nn.Dense(32, activation="relu"))
+    net.add(mx.gluon.nn.Dense(8))
+net.initialize()
+rt = ModelRuntime(net, item_shapes=(16,), max_batch=8)
+reg.register("m", rt, max_latency_ms=1)
+
+mx.random.seed(0)
+dec = get_decode_model("decode_tiny", vocab_size=96, max_length=32,
+                       units=32, num_heads=2)
+dec.initialize()
+sess = DecodeSession(dec, batch_buckets=(1, 2, 4, 8), seq_buckets=(8,),
+                     page_size=8, queue_depth=256)
+gw = Gateway(registry=reg, capacity=256)
+gw.add_decode("tiny", sess)
+
+def post(path, body, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", gw.port,
+                                      timeout=timeout)
+    try:
+        conn.request("POST", path, json.dumps(body),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+# ---- 1000 concurrent /v1/infer requests, zero drops, zero non-200
+N = 1000
+x = np.random.RandomState(0).rand(16).astype("float32").tolist()
+ref = None
+statuses = []
+lock = threading.Lock()
+
+def client(i):
+    st, raw = post("/v1/infer", {"model": "m", "inputs": x})
+    out = json.loads(raw).get("outputs")
+    with lock:
+        statuses.append((st, out))
+
+with ThreadPoolExecutor(max_workers=16) as pool:
+    list(pool.map(client, range(N)))
+assert len(statuses) == N, f"dropped responses: {len(statuses)}/{N}"
+bad = sorted({st for st, _ in statuses if st != 200})
+assert not bad, f"non-200 under healthy load: {bad}"
+ref = statuses[0][1]
+assert all(out == ref for _, out in statuses), "answers diverged"
+
+# ---- streamed == buffered, byte for byte
+for i in range(6):
+    req = {"prompt": [2 + i, 5, 9], "max_new_tokens": 8,
+           "temperature": 0.8 * (i % 2), "seed": i}
+    st, raw = post("/v1/generate", req)
+    assert st == 200, raw
+    buffered = json.loads(raw)["token_ids"]
+    st, raw = post("/v1/generate", dict(req, stream=True))
+    assert st == 200
+    toks = []
+    for chunk in raw.decode().split("\n\n"):
+        chunk = chunk.strip()
+        if chunk.startswith("data: ") and chunk != "data: [DONE]":
+            obj = json.loads(chunk[len("data: "):])
+            if "token" in obj:
+                toks.append(obj["token"])
+    assert toks == buffered, \
+        f"SSE stream diverged from buffered: {toks} != {buffered}"
+
+# ---- 2x overload: shed rate > 0, zero 5xx on a healthy box
+gw.admission = AdmissionController(capacity=4)
+over = []
+
+def overload_client(i):
+    st, raw = post("/v1/generate",
+                   {"prompt": [7, 7, 7], "max_new_tokens": 16,
+                    "temperature": 0.8, "seed": i})
+    with lock:
+        over.append(st)
+
+with ThreadPoolExecutor(max_workers=8) as pool:
+    list(pool.map(overload_client, range(16)))
+shed = sum(1 for s in over if s == 429)
+assert shed > 0, f"2x overload produced no sheds: {over}"
+assert not any(s >= 500 for s in over), f"5xx on a healthy box: {over}"
+assert set(over) <= {200, 429}, over
+
+snap = telemetry.snapshot()["counters"]
+assert snap.get("gateway.requests", 0) >= N + 12
+assert sanitizer.stats()["violations"] == 0, sanitizer.stats()
+gw.close()
+sess.close(drain=False)
+reg.close()
+print("gateway drill ok:", N, "infer requests all 200,",
+      "6 streams byte-identical to buffered,",
+      f"shed {shed}/{len(over)} at 2x overload, 0 5xx, sanitizer clean")
+PY
+  # cold-start drill: three process restarts through the same on-disk AOT
+  # program cache — the cache-warm restart must load every program
+  # (0 misses), warm >=5x faster than the no-cache restart, and answer
+  # the fixed prompt bitwise-identically
+  JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+worker = os.path.join("tests", "aot_cache_worker.py")
+cache = tempfile.mkdtemp(prefix="mxnet-aot-ci-")
+
+def restart(arg):
+    out = subprocess.run([sys.executable, worker, arg], check=True,
+                         timeout=600, capture_output=True, text=True)
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+no_cache = restart("")
+populate = restart(cache)
+warm = restart(cache)
+assert populate["cache"]["stores"] > 0, populate
+assert warm["cache"]["misses"] == 0, warm
+assert warm["cache"]["fallbacks"] == 0, warm
+assert warm["cache"]["hits"] == populate["cache"]["stores"], warm
+assert warm["token_ids"] == populate["token_ids"] == no_cache["token_ids"], \
+    "warm-AOT restart must answer bitwise-identically"
+speedup = no_cache["warm_s"] / max(warm["warm_s"], 1e-9)
+assert speedup >= 5.0, \
+    f"warm AOT restart only {speedup:.1f}x faster " \
+    f"({no_cache['warm_s']}s -> {warm['warm_s']}s)"
+print(f"aot cold-start ok: {no_cache['warm_s']}s no-cache -> "
+      f"{warm['warm_s']}s warm ({speedup:.1f}x, "
+      f"{warm['cache']['hits']} programs loaded, bitwise restart)")
 PY
 }
 
@@ -745,7 +916,7 @@ PY
 
 stages=("$@")
 [ $# -eq 0 ] && stages=(unit gate telemetry optimizer serving decode
-                        resilience engine io analyze trace)
+                        gateway resilience engine io analyze trace)
 for s in "${stages[@]}"; do
   echo "=== ci stage: $s ==="
   "stage_$s"

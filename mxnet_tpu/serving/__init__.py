@@ -35,10 +35,22 @@ Minimal use::
     fut = srv.submit(image, deadline_ms=100)     # from any thread
     probs = fut.result()
 """
+from . import aot  # noqa: F401
 from . import decode  # noqa: F401
+from .aot import ProgramCache, model_signature  # noqa: F401
 from .batcher import Batcher, RequestRejected  # noqa: F401
 from .registry import ModelRegistry  # noqa: F401
 from .runtime import ModelRuntime, default_buckets  # noqa: F401
 
 __all__ = ["ModelRuntime", "Batcher", "ModelRegistry", "RequestRejected",
-           "default_buckets", "decode"]
+           "default_buckets", "decode", "aot", "ProgramCache",
+           "model_signature", "gateway"]
+
+
+def __getattr__(name):
+    # the gateway imports serving symbols — load it lazily to keep the
+    # package import acyclic
+    if name == "gateway":
+        from . import gateway
+        return gateway
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -60,10 +60,12 @@ from .scheduler import (  # noqa: F401
     DecodeScheduler,
     DecodeSession,
     GenerationResult,
+    TokenStream,
 )
 
 __all__ = ["CausalLM", "get_decode_model", "rowdot",
            "kv_quantize_rows", "kv_dequantize",
            "PagedKVCache", "KVSlot", "KVCacheExhausted", "pages_needed",
            "DecodeRuntime", "seq_bucket_ladder",
-           "DecodeScheduler", "DecodeSession", "GenerationResult"]
+           "DecodeScheduler", "DecodeSession", "GenerationResult",
+           "TokenStream"]

@@ -39,6 +39,7 @@ from ... import ndarray as nd
 from ...analysis import sanitizer as _san
 from ...gluon.block import io_signature
 from ...telemetry import bus as _tel
+from ..aot import as_program_cache
 from ..runtime import default_buckets
 from .kv_cache import PagedKVCache
 
@@ -80,12 +81,21 @@ class DecodeRuntime:
     warm : bool
         Compile the full grid + step ladder now (default).  Serving cold
         shapes later is counted as ``decode.compile_miss``.
+    aot_cache : str or ProgramCache, optional
+        Persistent program cache (``serving.aot``): a directory path (a
+        cache is derived from the model signature + full serving
+        geometry) or a ready :class:`~mxnet_tpu.serving.aot.ProgramCache`.
+        With a warm cache, :meth:`warm` deserializes the whole
+        prefill/commit grid + step ladder off disk — a restarted process
+        answers its first request without a single XLA compile, with
+        bitwise-identical outputs.  Ignored under a ``mesh`` (sharded
+        executables are not portably serializable).
     """
 
     def __init__(self, block, cache=None, batch_buckets=(1, 2, 4, 8),
                  seq_buckets=None, page_size=16, num_pages=None,
                  max_slots=None, kv_dtype=None, prefix_sharing=True,
-                 mesh=None, name=None, warm=True):
+                 mesh=None, name=None, warm=True, aot_cache=None):
         if not getattr(block, "_active", False):
             block.hybridize()
         self._block = block
@@ -149,6 +159,17 @@ class DecodeRuntime:
         self._commit_fns = {}     # (batch_bucket, seq_bucket) -> donated jit
         self._sample_fn = None    # batch-1 first-token sampler (prefix hits)
         self._prefill_sigs = set()
+        # every piece of serving geometry below shapes a compiled program
+        # — all of it salts the cache key, so e.g. a page_size change
+        # can never replay last deployment's executables
+        if self._replicate is not None:
+            aot_cache = None     # sharded: executables are mesh-bound
+        self.aot_cache = as_program_cache(
+            aot_cache, block,
+            salt=f"decode:{self.batch_buckets}:{self.seq_buckets}"
+                 f":pg{cache.page_size}:np{cache.num_pages}"
+                 f":mp{cache.max_pages_per_seq}:sl{cache.max_slots}"
+                 f":kv{cache.kv_dtype}:pfx{cache.prefix_sharing}")
         self._warmed = False
         if warm:
             self.warm()
@@ -192,7 +213,10 @@ class DecodeRuntime:
 
             with autograd.pause(train_mode=False):
                 self._prefill_sigs.update(
-                    self._block.compile_grid(make_example, grid).values())
+                    self._block.compile_grid(
+                        make_example, grid, cache=self.aot_cache).values())
+            if self.aot_cache is not None:
+                self._warm_aot(grid)
             np_ = self.cache.max_pages_per_seq
             for b, s in grid:
                 self.prefill(np.zeros((b, s), "int32"),
@@ -219,6 +243,48 @@ class DecodeRuntime:
             _tel.count("decode.warmup_compiles",
                        2 * len(grid) + len(self.batch_buckets),
                        model=self.name)
+
+    def _warm_aot(self, grid):
+        """Resolve every step / commit / first-token-sample program through
+        the persistent program cache: a valid on-disk entry deserializes
+        the byte-exact executable (zero trace, zero XLA compile); a miss
+        AOT-compiles and commits it for the next process.  The warm()
+        drive that follows then executes already-resolved programs."""
+        pc = self.aot_cache
+        block, cache = self._block, self.cache
+        np_ = cache.max_pages_per_seq
+        pools = tuple(cache.pools)
+        for b in self.batch_buckets:
+            if b in self._step_fns:
+                continue
+            args = (self._params, np.zeros((b,), "int32"),
+                    np.zeros((b,), "int32"), np.zeros((b, np_), "int32"),
+                    np.zeros((b, 2), "uint32"), np.zeros((b,), "int32"),
+                    np.zeros((b,), "float32")) + pools
+            fn, _, _ = pc.load_or_build(
+                f"step-b{b}", self._build_step(), args)
+            self._step_fns[b] = fn
+        for b, s in grid:
+            if (b, s) in self._commit_fns:
+                continue
+            args = (self._params,
+                    np.zeros((2, block.num_layers, b, s,
+                              block.num_heads, block.head_dim), "float32"),
+                    np.zeros((b, block.vocab_size), "float32"),
+                    np.zeros((b,), "int32"), np.zeros((b, np_), "int32"),
+                    np.zeros((b, 2), "uint32"), np.zeros((b,), "int32"),
+                    np.zeros((b,), "float32")) + pools
+            fn, _, _ = pc.load_or_build(
+                f"commit-b{b}-s{s}", self._build_commit(), args)
+            self._commit_fns[(b, s)] = fn
+        if self._sample_fn is None:
+            import jax
+            args = (np.zeros((1, block.vocab_size), "float32"),
+                    np.zeros((1, 2), "uint32"), np.zeros((1,), "int32"),
+                    np.zeros((1,), "float32"))
+            fn, _, _ = pc.load_or_build(
+                "sample_first", jax.jit(block.sample_math), args)
+            self._sample_fn = fn
 
     def _miss(self, kind, key):
         if _tel.enabled:

@@ -34,7 +34,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future, InvalidStateError
+from concurrent.futures import CancelledError, Future, InvalidStateError
 
 import numpy as np
 
@@ -48,7 +48,107 @@ from ..batcher import RequestRejected
 from .kv_cache import KVCacheExhausted, pages_needed
 from .runtime import DecodeRuntime
 
-__all__ = ["DecodeScheduler", "DecodeSession", "GenerationResult"]
+__all__ = ["DecodeScheduler", "DecodeSession", "GenerationResult",
+           "TokenStream"]
+
+
+class TokenStream:
+    """Incremental per-request token feed — the streaming (SSE) view of
+    one generation.  Iterating yields token ids the moment the producing
+    step boundary commits them; iteration ends when the request finishes
+    (the :class:`GenerationResult` is then available via :meth:`result`)
+    and re-raises the request's error if it was rejected, failed, or
+    cancelled.
+
+    The stream is an *observer*, not a fork: a request submitted with a
+    sink appends to the very same token list and resolves the very same
+    Future as a buffered one, and the per-request PRNG fold-in never sees
+    the sink — so the streamed and buffered token sequences are
+    bitwise-identical by construction (CI asserts it end-to-end over
+    HTTP)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._pending = deque()
+        self._done = False
+        self._result = None
+        self._exc = None
+        self._future = None       # attached by stream()/submit's caller
+
+    # ------------------------------- producer (scheduler worker thread)
+    def _put(self, token):
+        with self._cond:
+            self._pending.append(int(token))
+            self._cond.notify_all()
+
+    def _finish(self, result):
+        with self._cond:
+            if not self._done:
+                self._result = result
+                self._done = True
+                self._cond.notify_all()
+
+    def _fail(self, exc):
+        with self._cond:
+            if not self._done:
+                self._exc = exc
+                self._done = True
+                self._cond.notify_all()
+
+    # ---------------------------------------------------------- consumer
+    def next_token(self, timeout=None):
+        """Block for the next token id.  Raises ``StopIteration`` at end
+        of stream, the request's error on failure, ``TimeoutError`` when
+        nothing arrives in time."""
+        deadline = None if timeout is None \
+            else time.perf_counter() + timeout
+        with self._cond:
+            while True:
+                if self._pending:
+                    return self._pending.popleft()
+                if self._done:
+                    if self._exc is not None:
+                        raise self._exc
+                    raise StopIteration
+                remaining = None if deadline is None \
+                    else deadline - time.perf_counter()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"no token within {timeout:.3f}s")
+                self._cond.wait(timeout=remaining)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next_token()
+
+    def result(self, timeout=None):
+        """The finished request's :class:`GenerationResult` (blocks until
+        the request completes; tokens stay iterable — result() drains
+        nothing)."""
+        deadline = None if timeout is None \
+            else time.perf_counter() + timeout
+        with self._cond:
+            while not self._done:
+                remaining = None if deadline is None \
+                    else deadline - time.perf_counter()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError("request still running")
+                self._cond.wait(timeout=remaining)
+            if self._exc is not None:
+                raise self._exc
+            return self._result
+
+    @property
+    def done(self):
+        with self._cond:
+            return self._done
+
+    def cancel(self):
+        """Best-effort cancel of the underlying request (succeeds only
+        while it is still queued — the Batcher discipline)."""
+        return self._future.cancel() if self._future is not None else False
 
 
 class GenerationResult:
@@ -75,7 +175,8 @@ class GenerationResult:
 class _Request:
     __slots__ = ("prompt", "max_new", "temp", "key", "eos_id", "deadline",
                  "future", "t_submit", "n_pages", "slot", "tokens",
-                 "position", "step_idx", "cur", "ttft_ms", "ctx", "lane")
+                 "position", "step_idx", "cur", "ttft_ms", "ctx", "lane",
+                 "sink")
 
     def __init__(self, prompt, max_new, temp, key, eos_id, deadline,
                  t_submit, n_pages):
@@ -100,6 +201,9 @@ class _Request:
         # so one request reads as one horizontal track in Perfetto.
         self.ctx = None
         self.lane = None
+        # sink: TokenStream observing this request (None for buffered
+        # submits) — fed at exactly the points tokens land in `tokens`
+        self.sink = None
 
 
 class DecodeScheduler:
@@ -156,14 +260,21 @@ class DecodeScheduler:
 
     # --------------------------------------------------------------- client
     def submit(self, prompt, max_new_tokens=16, temperature=0.0, seed=0,
-               eos_id=None, deadline_ms=None):
+               eos_id=None, deadline_ms=None, sink=None):
         """Enqueue one generation request; returns a Future resolving to a
         :class:`GenerationResult`.
 
         Malformed requests (empty prompt, out-of-range ids, a prompt +
         budget that overflows the context window) raise synchronously.  A
         reservation larger than the whole KV cache is shed immediately
-        with ``reason="kv_exhausted"`` — it could never be admitted."""
+        with ``reason="kv_exhausted"`` — it could never be admitted.
+
+        ``sink`` (a :class:`TokenStream`) observes the request
+        incrementally: each token is pushed at the step boundary that
+        produced it, and the sink terminates with the same result or
+        error the Future resolves with.  The sink changes NOTHING about
+        scheduling or sampling — the buffered token stream stays
+        bitwise-identical."""
         t_submit = time.perf_counter()
         rt = self._runtime
         prompt = np.asarray(prompt, "int32").reshape(-1)
@@ -194,6 +305,7 @@ class DecodeScheduler:
                     if deadline_ms is not None else None)
         req = _Request(prompt, max_new, float(temperature), key,
                        eos_id, deadline, t_submit, n_pages)
+        req.sink = sink
         if _tel.enabled:
             # trace root: the request's id; its lane carries every hop
             # from here to eviction (admission, prefill, each ride)
@@ -245,6 +357,16 @@ class DecodeScheduler:
         """Synchronous convenience: ``submit(...).result()``."""
         return self.submit(prompt, **kwargs).result(timeout)
 
+    def stream(self, prompt, **kwargs):
+        """Submit and return a :class:`TokenStream` yielding token ids as
+        each step boundary commits them — the SSE data source.  Raises
+        synchronously exactly like :meth:`submit` (malformed request,
+        breaker open, impossible reservation)."""
+        sink = TokenStream()
+        future = self.submit(prompt, sink=sink, **kwargs)
+        sink._future = future
+        return sink
+
     def pending(self):
         with self._lock:
             return len(self._queue)
@@ -269,10 +391,13 @@ class DecodeScheduler:
                        reason=reason)
             _tel.instant("decode.rejection", model=self._runtime.name,
                          reason=reason)
+        exc = RequestRejected(reason, detail)
         try:
-            req.future.set_exception(RequestRejected(reason, detail))
+            req.future.set_exception(exc)
         except InvalidStateError:
             pass       # client cancel() won the race; nobody is waiting
+        if req.sink is not None:
+            req.sink._fail(exc)
 
     # --------------------------------------------------------------- worker
     def start(self):
@@ -338,9 +463,11 @@ class DecodeScheduler:
         self._shed_queue_locked("shutdown")
         for req in self._active:
             self._evict(req, "shutdown")
+            exc = RequestRejected("shutdown", "scheduler closed")
             if not req.future.done():
-                req.future.set_exception(
-                    RequestRejected("shutdown", "scheduler closed"))
+                req.future.set_exception(exc)
+            if req.sink is not None:
+                req.sink._fail(exc)
         self._active = []
 
     def _shed_queue_locked(self, reason):
@@ -360,8 +487,11 @@ class DecodeScheduler:
         now = time.perf_counter()
         for req in self._queue:
             if req.future.cancelled():
-                pass    # never entered the batch, held no slot: not an
-                #         eviction — the request simply vanishes
+                # never entered the batch, held no slot: not an eviction
+                # — the request simply vanishes (its stream, if any,
+                # still has to terminate)
+                if req.sink is not None:
+                    req.sink._fail(CancelledError())
             elif req.deadline is not None and now > req.deadline:
                 self._reject(req, "deadline",
                              "expired waiting for admission")
@@ -390,6 +520,8 @@ class DecodeScheduler:
                     req.future.set_exception(e)
                 except InvalidStateError:
                     pass      # client cancel() won the race
+                if req.sink is not None:
+                    req.sink._fail(e)
                 continue
             self._queue.popleft()
             # claim the future BEFORE it enters the batch: once RUNNING, a
@@ -398,6 +530,8 @@ class DecodeScheduler:
             # just-reserved slot here
             if not req.future.set_running_or_notify_cancel():
                 self._evict(req, "cancelled")
+                if req.sink is not None:
+                    req.sink._fail(CancelledError())
                 continue
             joining.append(req)
         if joining and _tel.enabled and was_running:
@@ -450,6 +584,8 @@ class DecodeScheduler:
                                  tid=req.lane, trace=req.ctx, model=rt.name)
         req.cur = first
         req.tokens.append(first)
+        if req.sink is not None:
+            req.sink._put(first)
         req.step_idx = 1
         if self._is_finished(req):
             self._finish(req)
@@ -506,6 +642,8 @@ class DecodeScheduler:
                                      batch_bucket=int(b))
             req.cur = int(first[r])
             req.tokens.append(req.cur)
+            if req.sink is not None:
+                req.sink._put(req.cur)
             req.step_idx = 1
             if self._is_finished(req):
                 done.append(req)
@@ -571,6 +709,8 @@ class DecodeScheduler:
         for r, req in enumerate(self._active):
             req.cur = int(nxt[r])
             req.tokens.append(req.cur)
+            if req.sink is not None:
+                req.sink._put(req.cur)
             req.position += 1
             req.step_idx += 1
             if self._is_finished(req):
@@ -591,8 +731,11 @@ class DecodeScheduler:
                            and req.cur == req.eos_id) else "length"
         self._evict(req, reason)
         latency = (time.perf_counter() - req.t_submit) * 1e3
-        req.future.set_result(GenerationResult(
-            req.tokens, reason, req.ttft_ms, latency, req.prompt.size))
+        res = GenerationResult(req.tokens, reason, req.ttft_ms, latency,
+                               req.prompt.size)
+        req.future.set_result(res)
+        if req.sink is not None:
+            req.sink._finish(res)
 
     def _evict(self, req, reason):
         """Free a sequence's KV slot the moment it leaves the batch —
@@ -629,10 +772,14 @@ class DecodeScheduler:
             if id(req) not in in_active and not req.future.done():
                 self._evict(req, "failed")
                 req.future.set_exception(exc)
+                if req.sink is not None:
+                    req.sink._fail(exc)
         for req in self._active:
             self._evict(req, "failed")
             if not req.future.done():
                 req.future.set_exception(exc)
+            if req.sink is not None:
+                req.sink._fail(exc)
         self._active = []
         if self._breaker_threshold is None:
             return
@@ -703,12 +850,13 @@ class DecodeSession:
     def __init__(self, block, batch_buckets=(1, 2, 4, 8), seq_buckets=None,
                  page_size=16, num_pages=None, max_slots=None,
                  kv_dtype=None, prefix_sharing=True, mesh=None,
-                 queue_depth=256, warm=True, start=True, **scheduler_kwargs):
+                 queue_depth=256, warm=True, start=True, aot_cache=None,
+                 **scheduler_kwargs):
         self.runtime = DecodeRuntime(
             block, batch_buckets=batch_buckets, seq_buckets=seq_buckets,
             page_size=page_size, num_pages=num_pages, max_slots=max_slots,
             kv_dtype=kv_dtype, prefix_sharing=prefix_sharing,
-            mesh=mesh, warm=warm)
+            mesh=mesh, warm=warm, aot_cache=aot_cache)
         self.cache = self.runtime.cache
         self.scheduler = DecodeScheduler(
             self.runtime, queue_depth=queue_depth, start=start,
@@ -719,6 +867,16 @@ class DecodeSession:
 
     def generate(self, prompt, timeout=None, **kwargs):
         return self.scheduler.generate(prompt, timeout=timeout, **kwargs)
+
+    def stream(self, prompt, **kwargs):
+        """Incremental generation: a :class:`TokenStream` yielding ids as
+        step boundaries commit them (the SSE data source)."""
+        return self.scheduler.stream(prompt, **kwargs)
+
+    def tokens(self, prompt, **kwargs):
+        """Iterate token ids incrementally — alias for :meth:`stream`
+        (the stream IS an iterator)."""
+        return self.scheduler.stream(prompt, **kwargs)
 
     @property
     def healthy(self):

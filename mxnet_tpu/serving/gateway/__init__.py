@@ -1,0 +1,48 @@
+"""``mxnet_tpu.serving.gateway`` — the network data plane.
+
+Everything below this package is in-process: ``Batcher`` futures,
+``DecodeSession`` streams, the registry's hot-swap.  The gateway is the
+piece that turns them into a *service* — a stdlib ``ThreadingHTTPServer``
+(no new dependencies) mounted on the shared ``telemetry.http`` route
+table, so one port answers:
+
+- ``POST /v1/generate`` — autoregressive decode.  ``stream=true``
+  answers Server-Sent Events, one frame per token, fed at each step
+  boundary from the scheduler's :class:`~mxnet_tpu.serving.decode.
+  TokenStream`; otherwise one JSON body at completion.  Both carry the
+  bitwise-identical token sequence.
+- ``POST /v1/infer`` — one-shot Batcher models by registry name.
+- ``GET /metrics`` / ``/healthz`` / ``/trace`` — the telemetry routes,
+  same server (breaker open ⇒ ``/healthz`` 503 the moment it happens).
+
+Admission control (:class:`AdmissionController`) gates every request
+with weighted per-model shares over a fixed in-flight capacity; sheds
+and the scheduler's own rejections map onto HTTP statuses (429 for
+pressure with ``Retry-After``, 503 for down-ness, 400/404 for caller
+errors) instead of surfacing as exceptions.
+
+The second pillar lives next door in :mod:`mxnet_tpu.serving.aot`: a
+persistent compiled-program cache so the process behind this gateway
+answers its first request hot — ``DecodeSession(aot_cache=dir)`` /
+``ModelRuntime(aot_cache=dir)`` load executables off disk instead of
+compiling them.
+
+Minimal use::
+
+    import mxnet_tpu as mx
+
+    net = mx.serving.decode.get_decode_model("decode_small")
+    net.initialize()
+    sess = mx.serving.decode.DecodeSession(net, aot_cache="/var/cache/mx")
+
+    gw = mx.serving.gateway.Gateway(capacity=64)
+    gw.add_decode("decode_small", sess, weight=2.0)
+    print(gw.port)       # POST /v1/generate is live
+
+    # curl -N -d '{"prompt": [5, 9, 2], "stream": true}' \\
+    #      http://127.0.0.1:<port>/v1/generate
+"""
+from .gateway import Gateway  # noqa: F401
+from .qos import AdmissionController  # noqa: F401
+
+__all__ = ["Gateway", "AdmissionController"]

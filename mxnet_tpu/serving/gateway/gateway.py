@@ -1,0 +1,377 @@
+"""The HTTP front door: routes, SSE streaming, shed→status mapping.
+
+Design rules (each one traceable in the handler code):
+
+- **One server.**  Routes mount on the shared ``telemetry.http`` route
+  table — ``/metrics``, ``/healthz``, ``/trace`` and the gateway's
+  ``/v1/*`` answer on the same port, shut down by the one atexit hook.
+- **The trace lane starts at the wire.**  A ``TraceContext`` is minted
+  the moment a request is parsed; ``submit()`` runs under it, so the
+  scheduler's whole per-request lane (queue wait, prefill, every ride)
+  hangs off the socket-level root.
+- **Shedding is a status code, not an exception.**  Every
+  ``RequestRejected`` reason maps to exactly one HTTP answer —
+  retryable pressure (``deadline`` / ``kv_exhausted`` / ``qos`` /
+  ``backpressure``) ⇒ 429, down-ness (``unhealthy`` breaker /
+  ``shutdown``) ⇒ 503 — both with ``Retry-After``.  Malformed ⇒ 400,
+  unknown model ⇒ 404.  5xx is reserved for actual bugs.
+- **Streaming is an observer.**  ``stream=true`` rides the scheduler's
+  :class:`~mxnet_tpu.serving.decode.TokenStream` — the buffered path's
+  token sequence is bitwise what the SSE frames carry (CI-asserted).
+
+SSE frame format (``Content-Type: text/event-stream``, connection
+closes at end of stream)::
+
+    data: {"token": 17, "index": 0}\n\n      # one per generated token
+    data: {"done": true, "finish_reason": "length", ...}\n\n
+    data: [DONE]\n\n
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from ...telemetry import bus as _tel
+from ...telemetry import http as _http
+from ...telemetry import trace as _trace
+from ..batcher import RequestRejected
+from .qos import AdmissionController
+
+__all__ = ["Gateway"]
+
+# RequestRejected reason -> HTTP status.  429: retry the same box later
+# (pressure, not failure).  503: this box is not serving (breaker open /
+# shutting down) — a balancer should fail over.
+_REJECT_STATUS = {
+    "deadline": 429,
+    "kv_exhausted": 429,
+    "backpressure": 429,
+    "qos": 429,
+    "shutdown": 503,
+    "unhealthy": 503,
+}
+
+
+class Gateway:
+    """HTTP front door over a :class:`~mxnet_tpu.serving.ModelRegistry`
+    (``POST /v1/infer``) and named decode sessions (``POST
+    /v1/generate``), with weighted QoS admission control.
+
+    Parameters
+    ----------
+    registry : ModelRegistry, optional
+        Batcher models served by ``/v1/infer``.
+    admission : AdmissionController, optional
+        Shared admission gate; built from ``capacity`` when omitted.
+    capacity : int
+        In-flight bound for the default controller.
+    port : int
+        Port for the shared telemetry/gateway server (0 = ephemeral; the
+        bound port is :attr:`port`).  If the server is already up, its
+        existing port wins — one process, one port.
+    default_deadline_ms : float, optional
+        Deadline applied to requests that don't carry one.
+    """
+
+    def __init__(self, registry=None, admission=None, capacity=64,
+                 port=0, default_deadline_ms=None, name="gateway"):
+        self.registry = registry
+        self.name = name
+        self.admission = admission if admission is not None \
+            else AdmissionController(capacity)
+        self.default_deadline_ms = default_deadline_ms
+        self._decode = {}
+        self._closed = False
+        self._mounts = [
+            ("POST", "/v1/generate", self._route_generate),
+            ("POST", "/v1/infer", self._route_infer),
+        ]
+        for method, path, fn in self._mounts:
+            _http.register_route(method, path, fn)
+        _http.register_health(f"gateway:{name}", self)
+        self.port = _http.start_server(port)
+
+    # ----------------------------------------------------------- model map
+    def add_decode(self, name, session, weight=None):
+        """Expose a :class:`~mxnet_tpu.serving.decode.DecodeSession` (or
+        ``DecodeScheduler``) as ``model=name`` on ``/v1/generate``."""
+        self._decode[name] = session
+        if weight is not None:
+            self.admission.set_weight(name, weight)
+        return session
+
+    def remove_decode(self, name):
+        self._decode.pop(name, None)
+
+    def set_weight(self, model, weight):
+        self.admission.set_weight(model, weight)
+
+    @property
+    def healthy(self):
+        return not self._closed
+
+    # ------------------------------------------------------------- helpers
+    def _resolve_decode(self, body):
+        name = body.get("model")
+        if name is None:
+            if len(self._decode) == 1:
+                name = next(iter(self._decode))
+            else:
+                return None, None
+        return name, self._decode.get(name)
+
+    def _count(self, route, model, status):
+        if _tel.enabled:
+            _tel.count("gateway.requests", route=route, model=str(model))
+            _tel.count("gateway.responses", status=int(status))
+
+    def _shed(self, h, route, model, exc):
+        """Answer a RequestRejected with its mapped status + Retry-After."""
+        status = _REJECT_STATUS.get(exc.reason, 503)
+        retry = self.admission.retry_after_s
+        if _tel.enabled:
+            _tel.count("gateway.shed", route=route, reason=exc.reason)
+        self._count(route, model, status)
+        h.send_json(status,
+                    {"error": exc.reason, "detail": str(exc)},
+                    headers={"Retry-After": f"{retry:g}"})
+
+    @staticmethod
+    def _bad_request(h, detail):
+        h.send_json(400, {"error": "bad_request", "detail": detail})
+
+    def _parse(self, h):
+        try:
+            body = json.loads(h.read_body().decode() or "{}")
+        except (ValueError, UnicodeDecodeError) as e:
+            self._bad_request(h, f"malformed JSON body: {e}")
+            return None
+        if not isinstance(body, dict):
+            self._bad_request(h, "body must be a JSON object")
+            return None
+        return body
+
+    # ---------------------------------------------------- POST /v1/generate
+    def _route_generate(self, h):
+        t_wire = time.perf_counter()
+        body = self._parse(h)
+        if body is None:
+            return
+        model, sess = self._resolve_decode(body)
+        if sess is None:
+            self._count("generate", model, 404)
+            h.send_json(404, {
+                "error": "unknown_model",
+                "detail": f"no decode model {model!r}; available: "
+                          f"{sorted(self._decode)}"})
+            return
+        stream = bool(body.get("stream"))
+        kwargs = {}
+        for k in ("max_new_tokens", "temperature", "seed", "eos_id",
+                  "deadline_ms"):
+            if body.get(k) is not None:
+                kwargs[k] = body[k]
+        if "deadline_ms" not in kwargs and \
+                self.default_deadline_ms is not None:
+            kwargs["deadline_ms"] = self.default_deadline_ms
+        if not self.admission.try_acquire(model):
+            self._shed(h, "generate", model,
+                       RequestRejected(
+                           "qos", f"model {model!r} is past its QoS share "
+                                  f"and the gateway is at capacity"))
+            return
+        try:
+            # the request's trace lane roots HERE, at the socket — the
+            # scheduler's submit/prefill/ride spans nest under the wire
+            ctx = _trace.start("gateway.request", route="generate",
+                               model=str(model),
+                               stream=stream) if _tel.enabled else None
+            try:
+                with _trace.use(ctx):
+                    if stream:
+                        src = sess.stream(body.get("prompt"), **kwargs)
+                    else:
+                        src = sess.submit(body.get("prompt"), **kwargs)
+            except RequestRejected as e:
+                self._shed(h, "generate", model, e)
+                return
+            except (TypeError, ValueError) as e:
+                self._count("generate", model, 400)
+                self._bad_request(h, str(e))
+                return
+            if _tel.enabled:
+                _tel.observe("gateway.queue_wait_ms",
+                             (time.perf_counter() - t_wire) * 1e3)
+            if stream:
+                self._stream_response(h, model, src, t_wire)
+            else:
+                self._buffered_response(h, model, src, t_wire)
+        finally:
+            self.admission.release(model)
+
+    def _buffered_response(self, h, model, future, t_wire):
+        try:
+            res = future.result()
+        except RequestRejected as e:
+            self._shed(h, "generate", model, e)
+            return
+        except Exception as e:     # noqa: BLE001 — a step failure is a 500
+            self._count("generate", model, 500)
+            h.send_json(500, {"error": "generation_failed",
+                              "detail": repr(e)})
+            return
+        payload = {"model": model, "token_ids": res.token_ids,
+                   "finish_reason": res.finish_reason,
+                   "ttft_ms": res.ttft_ms, "latency_ms": res.latency_ms}
+        if _tel.enabled:
+            # buffered TTFT at the HTTP layer: the client sees its first
+            # token only when the whole body lands
+            _tel.observe("gateway.ttft_buffered_ms",
+                         (time.perf_counter() - t_wire) * 1e3)
+            _tel.observe("gateway.bytes_out",
+                         float(len(json.dumps(payload)) + 1))
+        self._count("generate", model, 200)
+        h.send_json(200, payload)
+
+    def _stream_response(self, h, model, sink, t_wire):
+        h.send_response(200)
+        h.send_header("Content-Type", "text/event-stream")
+        h.send_header("Cache-Control", "no-cache")
+        h.send_header("Connection", "close")
+        h.end_headers()
+        h.close_connection = True
+        self._count("generate", model, 200)
+        bytes_out = 0
+        first = True
+        final = None
+        try:
+            for i, tok in enumerate(sink):
+                frame = ("data: " +
+                         json.dumps({"token": tok, "index": i}) +
+                         "\n\n").encode()
+                h.wfile.write(frame)
+                h.wfile.flush()
+                bytes_out += len(frame)
+                if first and _tel.enabled:
+                    _tel.observe("gateway.ttft_streamed_ms",
+                                 (time.perf_counter() - t_wire) * 1e3)
+                first = False
+            res = sink.result()
+            final = {"done": True, "finish_reason": res.finish_reason,
+                     "ttft_ms": res.ttft_ms, "latency_ms": res.latency_ms,
+                     "n_tokens": len(res.token_ids)}
+        except (BrokenPipeError, ConnectionResetError):
+            sink.cancel()      # client hung up mid-stream
+            return
+        except RequestRejected as e:
+            final = {"done": True, "error": e.reason, "detail": str(e)}
+            if _tel.enabled:
+                _tel.count("gateway.shed", route="generate",
+                           reason=e.reason)
+        except Exception as e:     # noqa: BLE001 — surfaced in-stream
+            final = {"done": True, "error": "generation_failed",
+                     "detail": repr(e)}
+        try:
+            for payload in (json.dumps(final), "[DONE]"):
+                frame = f"data: {payload}\n\n".encode()
+                h.wfile.write(frame)
+                bytes_out += len(frame)
+            h.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            return
+        finally:
+            if _tel.enabled:
+                _tel.observe("gateway.bytes_out", float(bytes_out))
+
+    # ------------------------------------------------------- POST /v1/infer
+    def _route_infer(self, h):
+        t_wire = time.perf_counter()
+        body = self._parse(h)
+        if body is None:
+            return
+        model = body.get("model")
+        if self.registry is None or model is None or \
+                model not in self.registry:
+            self._count("infer", model, 404)
+            avail = self.registry.names() if self.registry is not None \
+                else []
+            h.send_json(404, {"error": "unknown_model",
+                              "detail": f"no model {model!r}; available: "
+                                        f"{avail}"})
+            return
+        if body.get("inputs") is None:
+            self._count("infer", model, 400)
+            self._bad_request(h, "missing 'inputs'")
+            return
+        deadline_ms = body.get("deadline_ms", self.default_deadline_ms)
+        if not self.admission.try_acquire(model):
+            self._shed(h, "infer", model,
+                       RequestRejected(
+                           "qos", f"model {model!r} is past its QoS share "
+                                  f"and the gateway is at capacity"))
+            return
+        try:
+            ctx = _trace.start("gateway.request", route="infer",
+                               model=str(model)) if _tel.enabled else None
+            inputs = body["inputs"]
+            # multi-input models take {"multi_input": true, "inputs":
+            # [in0, in1, ...]} — one array per model input
+            payload = (tuple(np.asarray(x) for x in inputs)
+                       if body.get("multi_input") else np.asarray(inputs))
+            try:
+                with _trace.use(ctx):
+                    fut = self.registry.submit(model, payload,
+                                               deadline_ms=deadline_ms)
+            except RequestRejected as e:
+                self._shed(h, "infer", model, e)
+                return
+            except (TypeError, ValueError) as e:
+                self._count("infer", model, 400)
+                self._bad_request(h, str(e))
+                return
+            if _tel.enabled:
+                _tel.observe("gateway.queue_wait_ms",
+                             (time.perf_counter() - t_wire) * 1e3)
+            try:
+                out = fut.result()
+            except RequestRejected as e:
+                self._shed(h, "infer", model, e)
+                return
+            except Exception as e:     # noqa: BLE001 — a batch bug is a 500
+                self._count("infer", model, 500)
+                h.send_json(500, {"error": "inference_failed",
+                                  "detail": repr(e)})
+                return
+            if isinstance(out, tuple):
+                outputs = [np.asarray(o).tolist() for o in out]
+            else:
+                outputs = np.asarray(out).tolist()
+            resp = {"model": model, "outputs": outputs}
+            if _tel.enabled:
+                _tel.observe("gateway.bytes_out",
+                             float(len(json.dumps(resp)) + 1))
+            self._count("infer", model, 200)
+            h.send_json(200, resp)
+        finally:
+            self.admission.release(model)
+
+    # ------------------------------------------------------------- shutdown
+    def close(self):
+        """Unmount the gateway's routes and health probe.  The shared
+        server stays up (telemetry owns it; its single atexit hook is the
+        one shutdown path)."""
+        if self._closed:
+            return
+        self._closed = True
+        for method, path, fn in self._mounts:
+            _http.unregister_route(method, path, fn)
+        _http.unregister_health(f"gateway:{self.name}", self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

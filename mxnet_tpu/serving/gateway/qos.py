@@ -1,0 +1,135 @@
+"""QoS admission control — weighted per-model shares over one gateway.
+
+One port serves many models; without admission control one hot model's
+burst starves everyone behind the shared socket and queue machinery.
+The controller here is the classic weighted-share scheme, chosen for
+being *predictable under audit* rather than clever:
+
+- The gateway has a fixed ``capacity`` of concurrently in-flight
+  requests.
+- Each model gets a **guaranteed share** proportional to its QoS weight
+  (``capacity * w / sum(weights)``, floored at 1): a request under its
+  model's share is always admitted, no matter what the rest of the box
+  is doing.
+- Idle share is **borrowable**: a model past its share is still admitted
+  while total in-flight is under capacity, so the box never idles while
+  one queue has work.
+- Past both: **shed** — the gateway answers 429 with a ``Retry-After``
+  hint instead of queueing unboundedly (the queue behind a saturated
+  admission gate is where tail latency goes to die).
+
+In-flight totals can transiently exceed ``capacity`` by at most the
+share-rounding slack (every model simultaneously exercising a floored
+guarantee); that bounded overshoot is the price of shares that are
+guarantees, not hints.
+"""
+from __future__ import annotations
+
+import threading
+
+from ...telemetry import bus as _tel
+
+__all__ = ["AdmissionController"]
+
+
+class AdmissionController:
+    """Weighted-share admission over one gateway's in-flight requests.
+
+    Parameters
+    ----------
+    capacity : int
+        Target bound on concurrently in-flight (admitted, unanswered)
+        requests across all models.
+    default_weight : float
+        QoS weight for models without an explicit :meth:`set_weight`.
+    retry_after_s : float
+        The ``Retry-After`` hint attached to sheds.
+    """
+
+    def __init__(self, capacity=64, default_weight=1.0, retry_after_s=1.0):
+        if int(capacity) < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.default_weight = float(default_weight)
+        self.retry_after_s = float(retry_after_s)
+        self._lock = threading.Lock()
+        self._weights = {}
+        self._inflight = {}
+        self.admitted = 0
+        self.borrowed = 0
+        self.shed = 0
+
+    def set_weight(self, model, weight):
+        """Set a model's QoS weight (>0).  Takes effect on the next
+        admission decision — shares are computed live, not cached."""
+        w = float(weight)
+        if w <= 0:
+            raise ValueError(f"weight must be > 0, got {weight}")
+        with self._lock:
+            self._weights[model] = w
+
+    def weight(self, model):
+        with self._lock:
+            return self._weights.get(model, self.default_weight)
+
+    def _share_locked(self, model):
+        known = dict(self._weights)
+        known.setdefault(model, self.default_weight)
+        # every model currently holding in-flight work competes for the
+        # capacity, even without an explicit weight
+        for m in self._inflight:
+            known.setdefault(m, self.default_weight)
+        total_w = sum(known.values())
+        return max(1, int(self.capacity * known[model] / total_w))
+
+    def try_acquire(self, model):
+        """One admission decision.  Returns True (a matching
+        :meth:`release` is now owed) or False (shed — answer 429 with
+        :attr:`retry_after_s`)."""
+        with self._lock:
+            mine = self._inflight.get(model, 0)
+            total = sum(self._inflight.values())
+            if mine < self._share_locked(model):
+                pass                          # guaranteed share
+            elif total < self.capacity:
+                self.borrowed += 1            # idle capacity is borrowable
+            else:
+                self.shed += 1
+                if _tel.enabled:
+                    _tel.count("gateway.qos_shed", model=str(model))
+                return False
+            self._inflight[model] = mine + 1
+            self.admitted += 1
+        if _tel.enabled:
+            _tel.gauge("gateway.inflight", self.inflight(),
+                       model=str(model))
+        return True
+
+    def release(self, model):
+        with self._lock:
+            n = self._inflight.get(model, 0) - 1
+            if n > 0:
+                self._inflight[model] = n
+            else:
+                self._inflight.pop(model, None)
+
+    def inflight(self, model=None):
+        with self._lock:
+            if model is not None:
+                return self._inflight.get(model, 0)
+            return sum(self._inflight.values())
+
+    def snapshot(self):
+        with self._lock:
+            return {"capacity": self.capacity,
+                    "inflight": dict(self._inflight),
+                    "weights": dict(self._weights),
+                    "admitted": self.admitted,
+                    "borrowed": self.borrowed,
+                    "shed": self.shed}
+
+    def __repr__(self):
+        s = self.snapshot()
+        return (f"AdmissionController(capacity={s['capacity']}, "
+                f"inflight={sum(s['inflight'].values())}, "
+                f"shed={s['shed']})")

@@ -23,6 +23,7 @@ from .. import ndarray as nd
 from ..gluon.block import io_signature
 from ..ndarray import NDArray
 from ..telemetry import bus as _tel
+from .aot import as_program_cache
 
 __all__ = ["ModelRuntime", "default_buckets"]
 
@@ -64,10 +65,16 @@ class ModelRuntime:
         AOT-compile every bucket now (default).  Pass ``False`` only to
         warm later via :meth:`warm` — serving unwarmed shapes compiles
         mid-traffic and is counted as ``serving.compile_miss``.
+    aot_cache : str or ProgramCache, optional
+        Persistent program cache (``serving.aot``): a directory path (a
+        :class:`~mxnet_tpu.serving.aot.ProgramCache` is derived from the
+        model signature + bucket geometry) or a ready cache.  With a warm
+        cache, :meth:`warm` deserializes every bucket's executable off
+        disk instead of tracing + XLA-compiling it.
     """
 
     def __init__(self, block, item_shapes, dtype="float32", max_batch=32,
-                 buckets=None, name=None, warm=True):
+                 buckets=None, name=None, warm=True, aot_cache=None):
         if not getattr(block, "_active", False):
             block.hybridize()
         self._block = block
@@ -94,6 +101,12 @@ class ModelRuntime:
         # signatures known compiled for INFERENCE — the steady-state hot
         # path checks this O(1) set, not the block's full history
         self._compiled_sigs = set()
+        # bucket geometry is a compile input: a different ladder must not
+        # replay another runtime's programs
+        self.aot_cache = as_program_cache(
+            aot_cache, block,
+            salt=f"runtime:{self.buckets}:{self._item_shapes}"
+                 f":{self._dtypes}")
         if warm:
             self.warm()
 
@@ -126,7 +139,8 @@ class ModelRuntime:
         with _tel.span("serving.warmup", model=self.name,
                        buckets=len(self.buckets)):
             self._compiled_sigs.update(
-                self._block.compile_grid(make_example, self.buckets).values())
+                self._block.compile_grid(make_example, self.buckets,
+                                         cache=self.aot_cache).values())
         if _tel.enabled:
             _tel.count("serving.warmup_compiles", len(self.buckets),
                        model=self.name)

@@ -4,7 +4,8 @@ The serving-front-door roadmap item needs a readiness surface a load
 balancer / Prometheus scraper / engineer-with-curl can hit without
 touching the Python process.  This is it, deliberately tiny: a
 ``ThreadingHTTPServer`` on localhost (opt-in via ``MXNET_METRICS_PORT``
-or :func:`start_server`), three routes:
+or :func:`start_server`) dispatching through ONE mutable **route
+table**.  The built-in routes:
 
 - ``GET /metrics`` — Prometheus text exposition
   (:func:`..exporters.dump_metrics`): every counter, gauge, span
@@ -16,6 +17,14 @@ or :func:`start_server`), three routes:
   moment a breaker opens.
 - ``GET /trace`` — the current merged chrome trace
   (:func:`..trace.chrome_trace`), loadable straight into Perfetto.
+
+Other subsystems mount onto the SAME server via :func:`register_route` —
+``mxnet_tpu.serving.gateway`` adds ``POST /v1/generate`` /
+``POST /v1/infer`` this way, so one process exposes one port, and the
+one atexit hook here is the only shutdown path (no second server, no
+double-shutdown races).  A route handler receives the live
+``BaseHTTPRequestHandler`` — full control over the response, including
+chunked / SSE streaming straight to the socket.
 
 The server thread is a daemon AND registered with atexit for a bounded
 join, so interpreter exit never hangs on an open socket.
@@ -32,7 +41,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from . import exporters
 
 __all__ = ["start_server", "stop_server", "server_port",
-           "register_health", "unregister_health", "health"]
+           "register_health", "unregister_health", "health",
+           "register_route", "unregister_route", "routes"]
 
 # ------------------------------------------------------- health probe registry
 _health_lock = threading.Lock()
@@ -85,6 +95,59 @@ def health():
     return ok, report
 
 
+# -------------------------------------------------------------- route table
+_routes_lock = threading.Lock()
+_routes = {}        # (METHOD, path) -> callable(handler)
+
+
+def register_route(method, path, fn):
+    """Mount ``fn`` at ``(method, path)`` on the shared server.  ``fn``
+    receives the live ``BaseHTTPRequestHandler`` (use ``_send`` /
+    ``send_json`` / ``read_body``, or write to ``handler.wfile`` directly
+    for streaming responses).  Last registration wins — hot-swap by
+    re-registering."""
+    with _routes_lock:
+        _routes[(method.upper(), path)] = fn
+
+
+def unregister_route(method, path, fn=None):
+    """Unmount a route.  With ``fn`` given, remove only if the table still
+    points at it — a new owner's mount survives the old owner's close()."""
+    with _routes_lock:
+        key = (method.upper(), path)
+        cur = _routes.get(key)
+        if cur is None:
+            return
+        if fn is None or cur is fn:
+            del _routes[key]
+
+
+def routes():
+    """Snapshot of the mounted ``(method, path)`` pairs."""
+    with _routes_lock:
+        return sorted(_routes)
+
+
+def _route_metrics(h):
+    h._send(200, exporters.dump_metrics())
+
+
+def _route_healthz(h):
+    ok, report = health()
+    body = json.dumps({"ok": ok, "components": report}) + "\n"
+    h._send(200 if ok else 503, body, "application/json")
+
+
+def _route_trace(h):
+    from . import trace
+    h._send(200, json.dumps(trace.chrome_trace()), "application/json")
+
+
+register_route("GET", "/metrics", _route_metrics)
+register_route("GET", "/healthz", _route_healthz)
+register_route("GET", "/trace", _route_trace)
+
+
 # ----------------------------------------------------------------- the server
 _server_lock = threading.Lock()
 _server = None
@@ -92,35 +155,56 @@ _thread = None
 
 
 class _Handler(BaseHTTPRequestHandler):
+    # HTTP/1.1: fixed-length responses keep the connection alive (every
+    # _send sets Content-Length); streaming handlers opt out by sending
+    # ``Connection: close`` and writing until done (SSE frames)
+    protocol_version = "HTTP/1.1"
 
-    def _send(self, code, body, ctype="text/plain; charset=utf-8"):
+    def _send(self, code, body, ctype="text/plain; charset=utf-8",
+              headers=None):
         data = body.encode() if isinstance(body, str) else body
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(data)
 
-    def do_GET(self):
+    def send_json(self, code, obj, headers=None):
+        self._send(code, json.dumps(obj) + "\n", "application/json",
+                   headers=headers)
+
+    def read_body(self, limit=16 * 1024 * 1024):
+        """The request body (b"" when absent); 413-sized bodies raise."""
+        n = int(self.headers.get("Content-Length") or 0)
+        if n > limit:
+            raise ValueError(f"request body of {n} bytes exceeds {limit}")
+        return self.rfile.read(n) if n > 0 else b""
+
+    def _dispatch(self, method):
         path = self.path.split("?", 1)[0]
-        try:
-            if path == "/metrics":
-                self._send(200, exporters.dump_metrics())
-            elif path == "/healthz":
-                ok, report = health()
-                body = json.dumps({"ok": ok, "components": report}) + "\n"
-                self._send(200 if ok else 503, body, "application/json")
-            elif path == "/trace":
-                from . import trace
-                self._send(200, json.dumps(trace.chrome_trace()),
-                           "application/json")
-            else:
-                self._send(404, "not found\n")
-        except Exception as e:     # noqa: BLE001 — a scrape must not kill us
+        with _routes_lock:
+            fn = _routes.get((method, path))
+        if fn is None:
             try:
-                self._send(500, f"error: {e!r}\n")
+                self._send(404, "not found\n")
             except OSError:
                 pass
+            return
+        try:
+            fn(self)
+        except Exception as e:     # noqa: BLE001 — a request must not kill us
+            try:
+                self._send(500, f"error: {e!r}\n")
+            except (OSError, ValueError):
+                pass       # headers already sent / peer gone
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
 
     def log_message(self, *args):  # noqa: D102 — silence per-request stderr
         pass

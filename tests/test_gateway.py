@@ -1,0 +1,351 @@
+"""serving.gateway: the HTTP front door (ISSUE 18 tentpole).
+
+Covers route behaviour end-to-end over a real localhost socket: buffered
+vs SSE-streamed ``/v1/generate`` (bitwise-identical tokens), ``/v1/infer``
+through a ModelRegistry, QoS admission sheds as 429-with-Retry-After,
+error→status mapping, /healthz + /metrics on the same port, and the
+satellite: an atomic registry hot-swap under live concurrent HTTP
+traffic with zero dropped or torn responses.
+"""
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.serving import Batcher, ModelRegistry, ModelRuntime
+from mxnet_tpu.serving.decode import DecodeSession, get_decode_model
+from mxnet_tpu.serving.gateway import AdmissionController, Gateway
+from mxnet_tpu.telemetry import http as thttp
+
+ITEM = (24,)
+VOCAB = 96
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+    thttp.stop_server()
+
+
+@pytest.fixture(scope="module")
+def decode_sess():
+    mx.random.seed(0)
+    net = get_decode_model("decode_tiny", vocab_size=VOCAB, max_length=32,
+                           units=32, num_heads=2)
+    net.initialize()
+    sess = DecodeSession(net, batch_buckets=(1, 2), seq_buckets=(8,),
+                         page_size=8)
+    yield sess
+    sess.close(drain=False)
+
+
+def _make_net(const=None):
+    net = mx.gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(mx.gluon.nn.Dense(16, activation="relu"))
+        net.add(mx.gluon.nn.Dense(4))
+    net.initialize(mx.init.Constant(const) if const is not None else None)
+    return net
+
+
+def _post(port, path, body, timeout=60):
+    """POST json, return (status, headers-dict, raw-bytes).  Streaming
+    responses close the connection, so read() drains to EOF."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, json.dumps(body),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
+def _get(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def _sse_frames(raw):
+    """Parse an SSE body into the list of ``data:`` payload strings."""
+    out = []
+    for chunk in raw.decode().split("\n\n"):
+        chunk = chunk.strip()
+        if chunk.startswith("data: "):
+            out.append(chunk[len("data: "):])
+    return out
+
+
+# ---------------------------------------------------------------- admission
+def test_admission_guaranteed_share_and_borrowing():
+    ac = AdmissionController(capacity=4)
+    ac.set_weight("a", 3.0)
+    ac.set_weight("b", 1.0)
+    # a's guaranteed share is 3, b's is 1
+    assert all(ac.try_acquire("a") for _ in range(3))
+    assert ac.try_acquire("b")
+    # capacity reached and both are at/over share -> shed
+    assert not ac.try_acquire("b")
+    assert ac.shed == 1
+    # idle capacity is borrowable once someone releases
+    ac.release("a")
+    assert ac.try_acquire("b")          # borrows a's idle share
+    assert ac.borrowed >= 1
+    snap = ac.snapshot()
+    assert snap["inflight"] == {"a": 2, "b": 2}
+    with pytest.raises(ValueError):
+        ac.set_weight("a", 0)
+    with pytest.raises(ValueError):
+        AdmissionController(capacity=0)
+
+
+def test_admission_floored_share_always_admits_one():
+    ac = AdmissionController(capacity=2)
+    ac.set_weight("big", 100.0)
+    assert ac.try_acquire("big")
+    assert ac.try_acquire("big")
+    # tiny's proportional share rounds to 0 but floors at 1 — the
+    # bounded-overshoot contract: a guarantee, not a hint
+    assert ac.try_acquire("tiny")
+    assert ac.inflight() == 3
+
+
+# ------------------------------------------------------------- /v1/generate
+def test_generate_buffered_vs_streamed_bitwise(decode_sess):
+    with Gateway() as gw:
+        gw.add_decode("tiny", decode_sess)
+        req = {"model": "tiny", "prompt": [5, 9, 2],
+               "max_new_tokens": 8, "temperature": 0.8, "seed": 11}
+        st, _, raw = _post(gw.port, "/v1/generate", req)
+        assert st == 200
+        buffered = json.loads(raw)
+        assert buffered["model"] == "tiny"
+        assert len(buffered["token_ids"]) == 8
+        assert buffered["finish_reason"] == "length"
+
+        st, hdr, raw = _post(gw.port, "/v1/generate",
+                             dict(req, stream=True))
+        assert st == 200
+        assert hdr.get("Content-Type") == "text/event-stream"
+        frames = _sse_frames(raw)
+        assert frames[-1] == "[DONE]"
+        toks = [json.loads(f) for f in frames[:-1]]
+        done = toks.pop()
+        assert done["done"] is True and done["n_tokens"] == 8
+        assert done["finish_reason"] == "length"
+        assert [t["index"] for t in toks] == list(range(8))
+        # the bitwise contract: SSE carries exactly the buffered sequence
+        assert [t["token"] for t in toks] == buffered["token_ids"]
+
+
+def test_generate_default_model_and_errors(decode_sess):
+    with Gateway() as gw:
+        gw.add_decode("tiny", decode_sess)
+        # sole registered model is the default
+        st, _, raw = _post(gw.port, "/v1/generate",
+                           {"prompt": [1, 2], "max_new_tokens": 2})
+        assert st == 200 and json.loads(raw)["model"] == "tiny"
+        st, _, raw = _post(gw.port, "/v1/generate",
+                           {"model": "nope", "prompt": [1]})
+        assert st == 404 and json.loads(raw)["error"] == "unknown_model"
+        st, _, raw = _post(gw.port, "/v1/generate",
+                           {"model": "tiny", "prompt": []})
+        assert st == 400
+        # malformed JSON body
+        conn = http.client.HTTPConnection("127.0.0.1", gw.port, timeout=30)
+        try:
+            conn.request("POST", "/v1/generate", b"{nope",
+                         {"Content-Type": "application/json"})
+            assert conn.getresponse().status == 400
+        finally:
+            conn.close()
+
+
+def test_generate_qos_shed_is_429_with_retry_after(decode_sess):
+    with Gateway(capacity=1) as gw:
+        gw.add_decode("tiny", decode_sess)
+        assert gw.admission.try_acquire("tiny")   # hold the only slot
+        try:
+            telemetry.enable()
+            st, hdr, raw = _post(gw.port, "/v1/generate",
+                                 {"prompt": [3], "max_new_tokens": 1})
+            assert st == 429
+            assert float(hdr["Retry-After"]) > 0
+            assert json.loads(raw)["error"] == "qos"
+            by_label = telemetry.snapshot()["counters_by_label"]
+            assert any('reason="qos"' in k
+                       for k in by_label.get("gateway.shed", {}))
+        finally:
+            gw.admission.release("tiny")
+
+
+def test_streamed_shed_maps_like_buffered(decode_sess):
+    # a deadline that expires before admission -> 429, both paths
+    with Gateway() as gw:
+        gw.add_decode("tiny", decode_sess)
+        req = {"prompt": [4, 4], "max_new_tokens": 4, "deadline_ms": 0.0}
+        st, hdr, raw = _post(gw.port, "/v1/generate", req)
+        assert st == 429 and json.loads(raw)["error"] == "deadline"
+        assert "Retry-After" in hdr
+        # streamed: shed surfaces as an in-stream error frame (headers
+        # are already on the wire) and the stream still terminates
+        st, _, raw = _post(gw.port, "/v1/generate",
+                           dict(req, stream=True))
+        frames = _sse_frames(raw)
+        assert frames[-1] == "[DONE]"
+        payloads = [json.loads(f) for f in frames[:-1]]
+        assert payloads[-1].get("error") == "deadline"
+        assert not any("token" in p for p in payloads)
+
+
+# ---------------------------------------------------------------- /v1/infer
+def test_infer_roundtrip_and_errors():
+    reg = ModelRegistry()
+    rt = ModelRuntime(_make_net(), ITEM, max_batch=4)
+    reg.register("m", rt, max_latency_ms=2)
+    try:
+        with Gateway(registry=reg) as gw:
+            x = np.random.RandomState(0).rand(*ITEM).astype("float32")
+            st, _, raw = _post(gw.port, "/v1/infer",
+                               {"model": "m", "inputs": x.tolist()})
+            assert st == 200
+            body = json.loads(raw)
+            np.testing.assert_allclose(body["outputs"], rt(x),
+                                       rtol=1e-5, atol=1e-6)
+            st, _, _ = _post(gw.port, "/v1/infer",
+                             {"model": "ghost", "inputs": [1.0]})
+            assert st == 404
+            st, _, raw = _post(gw.port, "/v1/infer", {"model": "m"})
+            assert st == 400
+            assert "inputs" in json.loads(raw)["detail"]
+    finally:
+        reg.close()
+
+
+def test_infer_without_registry_is_404(decode_sess):
+    with Gateway() as gw:
+        st, _, raw = _post(gw.port, "/v1/infer",
+                           {"model": "m", "inputs": [1.0]})
+        assert st == 404
+
+
+# ------------------------------------------------- hot swap under live fire
+def test_registry_hot_swap_under_live_http_traffic():
+    """ISSUE 18 satellite: swap a model's weights while HTTP clients
+    hammer /v1/infer.  Every request must answer 200 with an output that
+    is exactly the old or the new model's — zero drops, zero torn reads,
+    and post-swap requests see the new weights."""
+    reg = ModelRegistry()
+    rt1 = ModelRuntime(_make_net(const=0.1), ITEM, max_batch=4, name="m")
+    rt2 = ModelRuntime(_make_net(const=0.3), ITEM, max_batch=4, name="m")
+    reg.register("m", rt1, max_latency_ms=1)
+    x = np.random.RandomState(1).rand(*ITEM).astype("float32")
+    ref1, ref2 = np.asarray(rt1(x)), np.asarray(rt2(x))
+    assert not np.allclose(ref1, ref2)
+
+    results = {}          # thread-name -> list of (status, outputs)
+    errors = []
+    n_threads, n_reqs = 4, 24
+    body = {"model": "m", "inputs": x.tolist()}
+
+    with Gateway(registry=reg, capacity=64) as gw:
+        def client(tag):
+            got = []
+            try:
+                for _ in range(n_reqs):
+                    st, _, raw = _post(gw.port, "/v1/infer", body)
+                    got.append((st, json.loads(raw).get("outputs")))
+            except Exception as e:        # noqa: BLE001 — fail the test
+                errors.append((tag, repr(e)))
+            results[tag] = got
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)                    # traffic in flight
+        reg.swap("m", rt2, max_latency_ms=1)
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads)
+        assert not errors, errors
+
+        # zero dropped requests: every client got every answer
+        assert all(len(results[i]) == n_reqs for i in range(n_threads))
+        flat = [r for got in results.values() for r in got]
+        assert all(st == 200 for st, _ in flat), \
+            sorted({st for st, _ in flat})
+        # zero torn responses: each output is exactly one model's answer
+        n_new = 0
+        for _, out in flat:
+            is_old = np.allclose(out, ref1, rtol=1e-5, atol=1e-6)
+            is_new = np.allclose(out, ref2, rtol=1e-5, atol=1e-6)
+            assert is_old ^ is_new, out
+            n_new += int(is_new)
+        assert n_new > 0                    # the swap actually landed
+        # and the steady state is the new weights
+        st, _, raw = _post(gw.port, "/v1/infer", body)
+        np.testing.assert_allclose(json.loads(raw)["outputs"], ref2,
+                                   rtol=1e-5, atol=1e-6)
+    reg.close()
+
+
+# ---------------------------------------------------- shared-port telemetry
+def test_healthz_metrics_and_routes_share_the_port(decode_sess):
+    telemetry.enable()
+    with Gateway() as gw:
+        gw.add_decode("tiny", decode_sess, weight=2.0)
+        st, raw = _get(gw.port, "/healthz")
+        assert st == 200
+        report = json.loads(raw)
+        assert report["components"].get("gateway:gateway") is True
+        _post(gw.port, "/v1/generate",
+              {"prompt": [7], "max_new_tokens": 2})
+        st, raw = _get(gw.port, "/metrics")
+        assert st == 200
+        text = raw.decode()
+        assert "gateway_requests" in text or "gateway.requests" in text
+        counters = telemetry.snapshot()["counters"]
+        assert counters.get("gateway.requests") == 1
+        assert counters.get("gateway.responses") == 1
+        hists = telemetry.snapshot()["histograms"]
+        assert "gateway.ttft_buffered_ms" in hists
+        assert "gateway.queue_wait_ms" in hists
+    # close() unmounted the routes: the port still answers, /v1 404s
+    port = thttp.server_port()
+    assert port is not None
+    st, _, _ = _post(port, "/v1/generate", {"prompt": [1]})
+    assert st == 404
+    st, raw = _get(port, "/healthz")
+    assert st == 200
+    assert "gateway:gateway" not in json.loads(raw)["components"]
+
+
+def test_unhealthy_gateway_flips_healthz(decode_sess):
+    gw = Gateway()
+    try:
+        gw.add_decode("tiny", decode_sess)
+        gw._closed = True                  # simulate a wedged front door
+        st, raw = _get(gw.port, "/healthz")
+        assert st == 503
+        assert json.loads(raw)["components"]["gateway:gateway"] is False
+        gw._closed = False
+        st, _ = _get(gw.port, "/healthz")
+        assert st == 200
+    finally:
+        gw._closed = False
+        gw.close()
