@@ -57,7 +57,7 @@ BASELINE.md).  All other configs are nested under ``"extra"``:
   (must be 0)
 
 Select a subset with
-BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,int8,io,e2e,eager,engine,optimizer,serving,decode,gateway,resilience.
+BENCH_CONFIGS=headline,infer,fp32,amp,bert,ssd,int8,io,e2e,eager,engine,optimizer,serving,decode,gateway,fleet,resilience.
 The full json carries a ``telemetry`` sub-dict (recompile count,
 collective bytes, io wait ms — disable with BENCH_TELEMETRY=0) so each
 BENCH record carries its own diagnosis.
@@ -1556,6 +1556,115 @@ def bench_gateway():
             "cold_start": cold_start}
 
 
+def bench_fleet():
+    """Process-isolation overhead + crash recovery (``serving.fleet``).
+
+    The same ``/v1/infer`` traffic is measured twice — once with the
+    models in-process behind the gateway, once proxied over the fleet's
+    unix-socket RPC to a crash-supervised device-owner — so the record
+    carries the *price* of crash isolation (req/s ratio, p50/p99 delta)
+    next to what it buys: the measured SIGKILL-to-first-200 recovery
+    time through the supervisor's AOT-warm respawn."""
+    import http.client
+    import signal as _signal
+    import tempfile
+    import threading
+    import time as _time
+    from concurrent.futures import ThreadPoolExecutor
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from mxnet_tpu.serving.fleet import Supervisor
+    from mxnet_tpu.serving.gateway import Gateway
+    from tests.fleet_builder import build
+
+    n_requests = int(os.environ.get("BENCH_FLEET_REQUESTS", "200"))
+    workers = int(os.environ.get("BENCH_FLEET_WORKERS", "8"))
+    body = json.dumps({"model": "tiny_dense", "inputs": [0.5] * 8,
+                       "deadline_ms": 60000})
+
+    def drive(port):
+        lat = []
+        lock = threading.Lock()
+
+        def one(_i):
+            t0 = _time.perf_counter()
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=120)
+            try:
+                conn.request("POST", "/v1/infer", body,
+                             {"Content-Type": "application/json"})
+                r = conn.getresponse()
+                raw = r.read()
+                assert r.status == 200, (r.status, raw)
+            finally:
+                conn.close()
+            with lock:
+                lat.append((_time.perf_counter() - t0) * 1e3)
+
+        for _ in range(8):               # warm the route + batcher
+            one(0)
+        lat.clear()
+        t0 = _time.perf_counter()
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            list(pool.map(one, range(n_requests)))
+        wall = _time.perf_counter() - t0
+        lat.sort()
+        return {"n": n_requests,
+                "req_per_s": round(n_requests / wall, 1),
+                "p50_ms": round(lat[len(lat) // 2], 3),
+                "p99_ms": round(lat[int(len(lat) * 0.99) - 1], 3)}
+
+    # ------------------------------------------------- in-process baseline
+    built = build()
+    gw = Gateway(registry=built["registry"], capacity=256)
+    for name, sess in built["decode"].items():
+        gw.add_decode(name, sess)
+    inproc = drive(gw.port)
+    gw.close()
+    for sess in built["decode"].values():
+        sess.close(drain=False)
+    built["registry"].close(drain=False)
+
+    # ----------------------------------------- proxy over the device-owner
+    d = tempfile.mkdtemp(prefix="mxnet-fleet-bench-")
+    sup = Supervisor("tests.fleet_builder:build",
+                     os.path.join(d, "owner.sock"),
+                     aot_cache=os.path.join(d, "aot"), heartbeat_s=0.3)
+    sup.start()
+    gw = Gateway(owner=sup, capacity=256)
+    proxy = drive(gw.port)
+
+    # ------------------------------ recovery: SIGKILL -> first proxied 200
+    os.kill(sup.owner_pid, _signal.SIGKILL)
+    t_kill = _time.perf_counter()
+    conn = http.client.HTTPConnection("127.0.0.1", gw.port, timeout=120)
+    try:
+        conn.request("POST", "/v1/infer", body,
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        raw = r.read()
+        assert r.status == 200, (r.status, raw)
+    finally:
+        conn.close()
+    recovery_s = round(_time.perf_counter() - t_kill, 2)
+    restarts = sup.restarts
+    gw.close()
+    sup.stop()
+
+    return {
+        "inproc": inproc,
+        "proxy": proxy,
+        "proxy_overhead": {
+            "req_per_s_ratio": round(
+                proxy["req_per_s"] / max(inproc["req_per_s"], 1e-9), 3),
+            "p50_delta_ms": round(proxy["p50_ms"] - inproc["p50_ms"], 3),
+            "p99_delta_ms": round(proxy["p99_ms"] - inproc["p99_ms"], 3),
+        },
+        "recovery": {"sigkill_to_first_200_s": recovery_s,
+                     "aot_warm": True, "restarts": restarts},
+    }
+
+
 def bench_resilience():
     """Fault-tolerance latency numbers (``mxnet_tpu.resilience``): what a
     durable checkpoint costs on cadence (atomic tmp+rename commit with a
@@ -1886,7 +1995,7 @@ def main():
            os.environ.get("BENCH_CONFIGS",
                           "headline,infer,fp32,amp,bert,ssd,int8,io,e2e,"
                           "eager,engine,optimizer,serving,decode,gateway,"
-                          "resilience").split(",")]
+                          "fleet,resilience").split(",")]
     extra = {}
 
     # telemetry rides along for diagnosis (counters only — the configs
@@ -1995,6 +2104,11 @@ def main():
             extra["gateway"] = bench_gateway()
         except Exception as e:           # pragma: no cover
             extra["gateway"] = {"error": repr(e)}
+    if "fleet" in sel:
+        try:
+            extra["fleet"] = bench_fleet()
+        except Exception as e:           # pragma: no cover
+            extra["fleet"] = {"error": repr(e)}
     if "resilience" in sel:
         try:
             extra["resilience"] = bench_resilience()

@@ -491,6 +491,159 @@ print(f"aot cold-start ok: {no_cache['warm_s']}s no-cache -> "
 PY
 }
 
+stage_fleet() {
+  JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q
+  # chaos drill: a proxy gateway over a crash-supervised device-owner.
+  # 200 concurrent HTTP requests while the owner is SIGKILLed twice
+  # (with a fleet.owner_spawn fault armed so one respawn attempt dies
+  # and is retried under backoff).  Contract: every answer is 200/429/
+  # 503 (zero 5xx from the crash path), every 200 SSE body terminates
+  # with [DONE] (no torn streams), each restart recovers AOT-warm in
+  # <=5s, the post-restart owner answers bitwise-identically to the
+  # pre-crash cold run, and nothing leaks: KV slots, admission slots,
+  # or the unix socket.
+  JAX_PLATFORMS=cpu MXNET_TELEMETRY=1 python - <<'PY'
+import http.client
+import json
+import os
+import signal
+import tempfile
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.resilience import faults
+from mxnet_tpu.serving.fleet import Supervisor
+from mxnet_tpu.serving.gateway import Gateway
+
+d = tempfile.mkdtemp(prefix="mxnet-fleet-ci-")
+sock_path = os.path.join(d, "owner.sock")
+sup = Supervisor("tests.fleet_builder:build", sock_path,
+                 aot_cache=os.path.join(d, "aot"), heartbeat_s=0.3)
+t0 = time.perf_counter()
+sup.start()
+cold_spawn_s = round(time.perf_counter() - t0, 2)
+gw = Gateway(owner=sup, capacity=256)
+
+def post(path, body, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", gw.port,
+                                      timeout=timeout)
+    try:
+        conn.request("POST", path, json.dumps(body),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+REF = {"model": "decode_tiny", "prompt": [5, 9, 2], "max_new_tokens": 8,
+       "temperature": 0.8, "seed": 11, "deadline_ms": 60000}
+st, raw = post("/v1/generate", REF)
+assert st == 200, (st, raw)
+ref_tokens = json.loads(raw)["token_ids"]
+assert len(ref_tokens) == 8
+
+N = 200
+results = []        # (kind, status, raw)
+lock = threading.Lock()
+
+def client(i):
+    kind = ("infer", "infer", "generate", "sse")[i % 4]
+    if kind == "infer":
+        st, raw = post("/v1/infer",
+                       {"model": "tiny_dense", "inputs": [0.5] * 8,
+                        "deadline_ms": 60000})
+    elif kind == "generate":
+        st, raw = post("/v1/generate",
+                       {"model": "decode_tiny", "prompt": [2 + i % 7, 5],
+                        "max_new_tokens": 6, "temperature": 0.8,
+                        "seed": i, "deadline_ms": 60000})
+    else:
+        st, raw = post("/v1/generate",
+                       {"model": "decode_tiny", "prompt": [1 + i % 5, 9],
+                        "max_new_tokens": 6, "temperature": 0.8,
+                        "seed": i, "stream": True, "deadline_ms": 60000})
+    with lock:
+        results.append((kind, st, raw))
+
+recoveries = []
+
+def killer():
+    faults.inject("fleet.owner_spawn", "fail:1")  # one respawn retried
+    for _ in range(2):
+        while True:
+            with lock:
+                done = len(results)
+            if done >= 20:
+                break
+            time.sleep(0.05)
+        pid = sup.owner_pid
+        os.kill(pid, signal.SIGKILL)
+        t_kill = time.perf_counter()
+        deadline = t_kill + 30.0
+        while time.perf_counter() < deadline:
+            try:
+                c = sup.client()
+                c.ping(timeout=2.0)
+                c.close()
+                break
+            except (OSError, TimeoutError):
+                time.sleep(0.05)
+        recoveries.append(round(time.perf_counter() - t_kill, 2))
+        time.sleep(1.5)     # let traffic flow between the two kills
+
+kt = threading.Thread(target=killer)
+kt.start()
+with ThreadPoolExecutor(max_workers=8) as pool:
+    list(pool.map(client, range(N)))
+kt.join(timeout=120)
+assert not kt.is_alive()
+
+assert len(results) == N, f"dropped responses: {len(results)}/{N}"
+bad = sorted({st for _, st, _ in results if st not in (200, 429, 503)})
+assert not bad, f"statuses outside 200/429/503 under owner crashes: {bad}"
+torn = [raw[-200:] for kind, st, raw in results
+        if kind == "sse" and st == 200
+        and not raw.rstrip().endswith(b"data: [DONE]")]
+assert not torn, f"torn SSE streams: {torn[:3]}"
+assert sup.restarts == 2, f"expected 2 restarts, saw {sup.restarts}"
+slow = [r for r in recoveries if r > 5.0]
+assert not slow, f"AOT-warm recovery must be <=5s, saw {recoveries}"
+
+# post-restart determinism: same request, bitwise the pre-crash answer
+st, raw = post("/v1/generate", REF)
+assert st == 200, (st, raw)
+assert json.loads(raw)["token_ids"] == ref_tokens, \
+    "post-restart owner diverged from the pre-crash cold run"
+
+# nothing leaks: KV pages/slots in the owner, admission slots here
+cli = sup.client()
+stats = cli.call("stats", timeout=30.0)
+dec = stats["decode"]["decode_tiny"]
+assert dec["pages_in_use"] == 0, dec
+assert dec["slots_in_use"] == 0, dec
+assert dec["pending"] == 0 and dec["active"] == 0, dec
+cli.close()
+assert gw.admission.inflight() == 0, gw.admission.snapshot()
+
+counters = telemetry.snapshot()["counters"]
+n5xx = sum(1 for _, st, _ in results if st >= 500 and st != 503)
+n_unavail = sum(1 for _, st, _ in results if st == 503)
+gw.close()
+sup.stop()
+assert not os.path.exists(sock_path), "owner socket leaked past stop()"
+print(f"fleet chaos drill ok: {N} requests through 2 SIGKILLs "
+      f"(+1 injected spawn failure), statuses 200/429/503 only "
+      f"({n_unavail} x 503), 0 torn SSE, recoveries {recoveries}s "
+      f"(cold spawn {cold_spawn_s}s), bitwise post-restart, "
+      f"{int(counters.get('gateway.infer_retries', 0))} infer retries, "
+      f"0 leaked pages/slots/sockets")
+PY
+  # SIGTERM drain drill rides in the pytest run above
+  # (tests/test_gateway.py::test_sigterm_drains_gracefully)
+}
+
 stage_resilience() {
   JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q
   JAX_PLATFORMS=cpu MXNET_FAULTS="checkpoint.write:fail:2" python - <<'PY'
@@ -916,7 +1069,7 @@ PY
 
 stages=("$@")
 [ $# -eq 0 ] && stages=(unit gate telemetry optimizer serving decode
-                        gateway resilience engine io analyze trace)
+                        gateway fleet resilience engine io analyze trace)
 for s in "${stages[@]}"; do
   echo "=== ci stage: $s ==="
   "stage_$s"

@@ -72,6 +72,16 @@ Known sites (see docs/resilience.md for the full table):
 ``io.worker_spawn`` / ``io.shm_slot``
                        decode-pool worker spawn (parent) / shm-slot fill
                        (worker, hard-kills via ``os._exit``)
+``fleet.rpc_send``     before a fleet RPC frame is written — an injected
+                       fault behaves exactly like a torn socket; the
+                       client fails outstanding calls with ``OwnerGone``
+                       and redials under its retry policy
+``fleet.rpc_recv``     before a fleet RPC frame is read — same torn-
+                       socket semantics on the receive side
+``fleet.owner_spawn``  supervisor's device-owner fork/exec, before the
+                       spawn — a ``fail`` is retried under the
+                       supervisor's backoff policy like a real transient
+                       exec error (the chaos-drill restart path)
 =====================  =====================================================
 """
 from __future__ import annotations

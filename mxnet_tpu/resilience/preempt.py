@@ -114,6 +114,13 @@ class PreemptionHandler:
     def triggered(self):
         return self._event.is_set()
 
+    def wait(self, timeout=None):
+        """Block until preemption triggers (or ``timeout`` elapses);
+        returns :attr:`triggered`.  Drain watchers (the serving
+        gateway's SIGTERM → stop-admitting path) park here instead of
+        polling."""
+        return self._event.wait(timeout)
+
     def trigger(self):
         """Mark preemption without a signal — for tests and external
         schedulers that deliver shutdown notice through other channels."""

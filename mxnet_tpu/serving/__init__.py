@@ -44,13 +44,16 @@ from .runtime import ModelRuntime, default_buckets  # noqa: F401
 
 __all__ = ["ModelRuntime", "Batcher", "ModelRegistry", "RequestRejected",
            "default_buckets", "decode", "aot", "ProgramCache",
-           "model_signature", "gateway"]
+           "model_signature", "gateway", "fleet"]
 
 
 def __getattr__(name):
-    # the gateway imports serving symbols — load it lazily to keep the
-    # package import acyclic
+    # the gateway and fleet import serving symbols — load them lazily to
+    # keep the package import acyclic
     if name == "gateway":
         from . import gateway
         return gateway
+    if name == "fleet":
+        from . import fleet
+        return fleet
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

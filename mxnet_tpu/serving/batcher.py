@@ -144,8 +144,10 @@ class Batcher:
         self._breaker_cooldown = float(breaker_cooldown_ms) / 1e3
         self._consecutive_failures = 0
         self._breaker_open_until = 0.0
-        # readiness surface: /healthz flips the moment the breaker opens
-        _http.register_health(f"batcher:{runtime.name}", self)
+        # readiness surface: /readyz flips the moment the breaker opens
+        # (an open breaker means "route away", not "restart the process",
+        # so it belongs to readiness, not liveness)
+        _http.register_ready(f"batcher:{runtime.name}", self)
         if start:
             self.start()
 
@@ -379,13 +381,20 @@ class Batcher:
             return False
         return True
 
+    @property
+    def breaker_remaining_s(self):
+        """Seconds until an open circuit breaker lets traffic probe again
+        (0.0 when closed) — the honest ``Retry-After`` for ``unhealthy``
+        sheds."""
+        return max(0.0, self._breaker_open_until - time.perf_counter())
+
     # ------------------------------------------------------------- shutdown
     def close(self, drain=True, timeout=30.0):
         """Stop the batcher.  ``drain=True`` (default) serves everything
         already queued before returning — the hot-swap path, so in-flight
         requests complete against the old weights; ``drain=False`` rejects
         the queue with ``reason="shutdown"``."""
-        _http.unregister_health(f"batcher:{self._runtime.name}", self)
+        _http.unregister_ready(f"batcher:{self._runtime.name}", self)
         with self._lock:
             if self._closed:
                 return

@@ -74,6 +74,7 @@ class TokenStream:
         self._result = None
         self._exc = None
         self._future = None       # attached by stream()/submit's caller
+        self._abort = None        # scheduler abort hook for running requests
 
     # ------------------------------- producer (scheduler worker thread)
     def _put(self, token):
@@ -146,9 +147,18 @@ class TokenStream:
             return self._done
 
     def cancel(self):
-        """Best-effort cancel of the underlying request (succeeds only
-        while it is still queued — the Batcher discipline)."""
-        return self._future.cancel() if self._future is not None else False
+        """Best-effort cancel of the underlying request.  While queued
+        the Future cancels outright; once running, the scheduler aborts
+        the request at the next step boundary — the slot is evicted with
+        ``reason="aborted"`` and every KV page freed (the
+        client-hung-up-mid-stream path: decoding to completion for a
+        departed reader would burn batch rows for nobody)."""
+        cancelled = self._future.cancel() \
+            if self._future is not None else False
+        if not cancelled and self._abort is not None and not self.done:
+            self._abort()
+            return True
+        return cancelled
 
 
 class GenerationResult:
@@ -176,7 +186,7 @@ class _Request:
     __slots__ = ("prompt", "max_new", "temp", "key", "eos_id", "deadline",
                  "future", "t_submit", "n_pages", "slot", "tokens",
                  "position", "step_idx", "cur", "ttft_ms", "ctx", "lane",
-                 "sink")
+                 "sink", "aborted")
 
     def __init__(self, prompt, max_new, temp, key, eos_id, deadline,
                  t_submit, n_pages):
@@ -204,6 +214,9 @@ class _Request:
         # sink: TokenStream observing this request (None for buffered
         # submits) — fed at exactly the points tokens land in `tokens`
         self.sink = None
+        # aborted: client hung up / cancelled a RUNNING request; swept
+        # out of the batch (slot freed) at the next step boundary
+        self.aborted = False
 
 
 class DecodeScheduler:
@@ -253,8 +266,10 @@ class DecodeScheduler:
         self._breaker_cooldown = float(breaker_cooldown_ms) / 1e3
         self._consecutive_failures = 0
         self._breaker_open_until = 0.0
-        # readiness surface: /healthz flips the moment the breaker opens
-        _http.register_health(f"decode:{runtime.name}", self)
+        # readiness surface: /readyz flips the moment the breaker opens
+        # (liveness /healthz is for process-level probes — an open
+        # breaker means "route traffic away", not "restart me")
+        _http.register_ready(f"decode:{runtime.name}", self)
         if start:
             self.start()
 
@@ -306,6 +321,11 @@ class DecodeScheduler:
         req = _Request(prompt, max_new, float(temperature), key,
                        eos_id, deadline, t_submit, n_pages)
         req.sink = sink
+        if sink is not None:
+            # the sink's cancel() reaches back here once the request is
+            # RUNNING (Future.cancel no longer can): flag it for the
+            # worker's boundary sweep
+            sink._abort = lambda: self._abort_request(req)
         if _tel.enabled:
             # trace root: the request's id; its lane carries every hop
             # from here to eviction (admission, prefill, each ride)
@@ -371,6 +391,13 @@ class DecodeScheduler:
         with self._lock:
             return len(self._queue)
 
+    def _abort_request(self, req):
+        """Mark a running request for eviction at the next boundary (the
+        worker owns the batch; this thread only raises the flag)."""
+        with self._lock:
+            req.aborted = True
+            self._not_empty.notify()
+
     def active(self):
         """Sequences currently in the decode batch (approximate — read
         without joining the step boundary)."""
@@ -384,6 +411,13 @@ class DecodeScheduler:
                 time.perf_counter() < self._breaker_open_until:
             return False
         return True
+
+    @property
+    def breaker_remaining_s(self):
+        """Seconds until an open circuit breaker lets traffic probe
+        again (0.0 when closed) — the honest ``Retry-After`` value for
+        ``reason="unhealthy"`` sheds."""
+        return max(0.0, self._breaker_open_until - time.perf_counter())
 
     def _reject(self, req, reason, detail):
         if _tel.enabled:
@@ -443,6 +477,7 @@ class DecodeScheduler:
         joins and step the batch outside it.  The ONE body both the live
         worker and ``close()``'s inline settle run, so the two paths can
         never diverge."""
+        self._sweep_aborted()
         with self._lock:
             joining = self._admit_locked()
             self._not_full.notify_all()
@@ -456,6 +491,26 @@ class DecodeScheduler:
                 self._step()
         except BaseException as e:
             self._fail_active(e, joining)
+
+    def _sweep_aborted(self):
+        """Evict requests whose client gave up (stream cancel / hung-up
+        SSE reader) before spending another step on them.  Runs on the
+        worker thread at the boundary, before admission — the freed
+        pages are allocatable in the same boundary."""
+        if not any(req.aborted for req in self._active):
+            return
+        still = []
+        for req in self._active:
+            if not req.aborted:
+                still.append(req)
+                continue
+            self._evict(req, "aborted")
+            exc = CancelledError()
+            if not req.future.done():
+                req.future.set_exception(exc)
+            if req.sink is not None:
+                req.sink._fail(exc)
+        self._active = still
 
     def _abort_locked(self):
         """Non-drain shutdown: shed the queue, fail the active batch,
@@ -798,7 +853,7 @@ class DecodeScheduler:
         """Stop the scheduler.  ``drain=True`` (default) finishes every
         queued and active request first; ``drain=False`` rejects the
         queue (``reason="shutdown"``) and fails active requests."""
-        _http.unregister_health(f"decode:{self._runtime.name}", self)
+        _http.unregister_ready(f"decode:{self._runtime.name}", self)
         with self._lock:
             if self._closed:
                 return
@@ -881,6 +936,10 @@ class DecodeSession:
     @property
     def healthy(self):
         return self.scheduler.healthy
+
+    @property
+    def breaker_remaining_s(self):
+        return self.scheduler.breaker_remaining_s
 
     def stats(self):
         s = self.cache.stats()

@@ -1,0 +1,33 @@
+"""Crash-isolated serving fleet: device-owner process + supervisor.
+
+One box, two roles: stateless front-ends (the HTTP gateway, many
+processes if you like) and ONE :mod:`device-owner <.owner>` process that
+holds the chips, compiled programs and KV cache.  They speak the
+:mod:`length-prefixed crc-framed RPC <.transport>` over a Unix socket;
+the :mod:`supervisor <.supervisor>` keeps the owner alive (heartbeats,
+crash detection, exponential-backoff restart, AOT-warm re-spawn) so a
+model crash costs seconds of 503s instead of the whole service.
+"""
+__all__ = ["OwnerClient", "OwnerGone", "RemoteError", "FrameError",
+           "RPCServer", "OwnerService", "load_builder", "Supervisor"]
+
+_EXPORTS = {
+    "OwnerClient": "transport", "OwnerGone": "transport",
+    "RemoteError": "transport", "FrameError": "transport",
+    "RPCServer": "transport",
+    "OwnerService": "owner", "load_builder": "owner",
+    "Supervisor": "supervisor",
+}
+
+
+def __getattr__(name):
+    # lazy on purpose: `python -m mxnet_tpu.serving.fleet.owner` must not
+    # have the package pre-import the owner module (runpy double-import),
+    # and transport-only clients shouldn't pay for subprocess machinery
+    mod_name = _EXPORTS.get(name)
+    if mod_name is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    mod = importlib.import_module(f".{mod_name}", __name__)
+    return getattr(mod, name)

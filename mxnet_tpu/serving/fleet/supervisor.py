@@ -1,0 +1,284 @@
+"""Crash supervision for the device-owner process.
+
+The supervisor is the part of the fleet that never does anything clever:
+it spawns the owner, watches it (``waitpid`` + PING/PONG heartbeats over
+the RPC socket), and when the owner dies — a model bug, an XLA abort,
+an OOM kill, a chaos-drill SIGKILL — restarts it with exponential
+backoff.  Restart is cheap *by construction*: the owner re-warms from
+the persistent AOT :class:`~mxnet_tpu.serving.aot.ProgramCache`, so the
+replacement answers bitwise-identically to its predecessor in a couple
+of seconds instead of recompiling for minutes.
+
+Spawn itself is a fault site (``fleet.owner_spawn``) drilled by CI: an
+injected spawn failure is retried under a
+:class:`~mxnet_tpu.resilience.retry.RetryPolicy` exactly like a real
+transient fork/exec error.
+
+Telemetry: ``fleet.owner_restarts`` counts deaths, the flight recorder
+gets ``fleet.owner_spawn`` / ``fleet.owner_death`` beats (post-mortems
+of a crash loop read like a story), and ``fleet.owner_up`` is the 0/1
+gauge readiness probes key off.
+"""
+from __future__ import annotations
+
+import os
+import select
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from ...resilience import faults as _faults
+from ...resilience.retry import RetryPolicy
+from ...telemetry import bus as _tel
+from ...telemetry import flight as _flight
+from .transport import OwnerClient
+
+__all__ = ["Supervisor"]
+
+
+class Supervisor:
+    """Spawn, watch and restart one device-owner process.
+
+    Parameters
+    ----------
+    spec : str
+        Model builder, ``"pkg.module:callable"`` (see :mod:`.owner`).
+    socket_path : str
+        The Unix socket the owner binds (parent directory must exist).
+    aot_cache : str, optional
+        Persistent program-cache dir handed to every incarnation — what
+        makes restart warm and bitwise-identical.
+    heartbeat_s : float
+        PING interval while the owner looks alive.
+    max_missed : int
+        Consecutive heartbeat failures (with the process still running)
+        before the owner is declared wedged and killed for restart.
+    ready_timeout_s : float
+        How long one spawn may take to come up (build + bind).
+    backoff : RetryPolicy, optional
+        Restart pacing — ``backoff(attempt)`` spaces consecutive crash
+        restarts; reset after ``stable_s`` of uptime.  Also the spawn
+        retry policy (``fleet.owner_spawn`` faults).
+    stable_s : float
+        Uptime after which the crash counter resets (a crash every
+        other day should not inherit a crash-loop's backoff).
+    """
+
+    def __init__(self, spec, socket_path, aot_cache=None,
+                 heartbeat_s=0.5, max_missed=4, ready_timeout_s=60.0,
+                 backoff=None, stable_s=30.0, name="owner"):
+        self.spec = spec
+        self.socket_path = socket_path
+        self.aot_cache = aot_cache
+        self.heartbeat_s = float(heartbeat_s)
+        self.max_missed = int(max_missed)
+        self.ready_timeout_s = float(ready_timeout_s)
+        self.backoff = backoff if backoff is not None else RetryPolicy(
+            max_attempts=5, base_delay_ms=200.0, max_delay_ms=5000.0,
+            jitter=0.25, seed=0)
+        self.stable_s = float(stable_s)
+        self.name = name
+        self._lock = threading.Lock()
+        self._proc = None
+        self._generation = 0
+        self._restarts = 0
+        self._consecutive = 0
+        self._started_at = 0.0
+        self._stop = threading.Event()
+        self._watcher = None
+        # heartbeat client: no redial policy of its own — a failed ping
+        # IS the signal; the watch loop decides what it means
+        self._hb = OwnerClient(socket_path,
+                               retry=RetryPolicy(max_attempts=1))
+
+    # ------------------------------------------------------------ probes
+    @property
+    def owner_pid(self):
+        with self._lock:
+            return self._proc.pid if self._proc is not None else None
+
+    @property
+    def restarts(self):
+        with self._lock:
+            return self._restarts
+
+    @property
+    def generation(self):
+        with self._lock:
+            return self._generation
+
+    @property
+    def alive(self):
+        """The owner process exists and has not exited."""
+        with self._lock:
+            proc = self._proc
+        return proc is not None and proc.poll() is None
+
+    def client(self, retry=None):
+        """A fresh :class:`OwnerClient` for this owner's socket (each
+        front-end thread pool shares one; make as many as you like)."""
+        return OwnerClient(self.socket_path, retry=retry)
+
+    # ------------------------------------------------------------- spawn
+    def _spawn_once(self, generation):
+        """One spawn attempt: fork/exec the owner module and wait for
+        its ready byte.  Fault site ``fleet.owner_spawn`` fires first —
+        an injected fault behaves like a failed exec and is retried by
+        the caller's policy."""
+        if _faults.active:
+            _faults.check("fleet.owner_spawn")
+        rfd, wfd = os.pipe()
+        try:
+            cmd = [sys.executable, "-m", "mxnet_tpu.serving.fleet.owner",
+                   "--spec", self.spec, "--socket", self.socket_path,
+                   "--generation", str(generation),
+                   "--ready-fd", str(wfd)]
+            if self.aot_cache:
+                cmd += ["--aot-cache", str(self.aot_cache)]
+            proc = subprocess.Popen(cmd, pass_fds=(wfd,))
+        finally:
+            os.close(wfd)
+        try:
+            readable, _, _ = select.select([rfd], [], [],
+                                           self.ready_timeout_s)
+            byte = os.read(rfd, 1) if readable else b""
+        finally:
+            os.close(rfd)
+        if byte != b"R":
+            # died during build, or wedged before bind — reap and let
+            # the retry policy decide whether to try again
+            proc.kill()
+            proc.wait()
+            raise OSError(
+                f"owner (generation {generation}) died during startup")
+        return proc
+
+    def start(self):
+        """Spawn the first owner and the watch thread.  Blocks until the
+        owner is serving (or the spawn policy gives up)."""
+        with self._lock:
+            if self._watcher is not None:
+                return self
+            generation = self._generation
+        t0 = time.perf_counter()
+        proc = self.backoff.call(self._spawn_once, generation,
+                                 site="fleet.owner_spawn")
+        _flight.record("fleet.owner_spawn", value=generation)
+        if _tel.enabled:
+            _tel.gauge("fleet.owner_up", 1)
+            _tel.count("fleet.owner_spawn_ms",
+                       round((time.perf_counter() - t0) * 1e3, 3))
+        with self._lock:
+            self._proc = proc
+            self._started_at = time.monotonic()
+            self._watcher = threading.Thread(
+                target=self._watch, daemon=True, name="fleet-supervisor")
+            self._watcher.start()
+        return self
+
+    # ------------------------------------------------------------- watch
+    def _watch(self):
+        missed = 0
+        while not self._stop.is_set():
+            with self._lock:
+                proc = self._proc
+            if proc is None:
+                return
+            rc = proc.poll()
+            if rc is not None:
+                if self._stop.is_set():
+                    return
+                self._restart(f"exit {rc}" if rc >= 0
+                              else f"signal {-rc}")
+                missed = 0
+                continue
+            try:
+                self._hb.ping(timeout=max(2.0, self.heartbeat_s * 4))
+                missed = 0
+            except Exception:       # noqa: BLE001 — any ping failure counts
+                missed += 1
+                if missed >= self.max_missed and not self._stop.is_set():
+                    # running but deaf: wedged accept loop or a hung
+                    # runtime — kill it ourselves, then restart
+                    proc.kill()
+                    proc.wait()
+                    self._restart("heartbeats lost")
+                    missed = 0
+                    continue
+            self._stop.wait(self.heartbeat_s)
+
+    def _restart(self, why):
+        with self._lock:
+            uptime = time.monotonic() - self._started_at
+            if uptime >= self.stable_s:
+                self._consecutive = 0
+            self._consecutive += 1
+            attempt = self._consecutive
+            self._restarts += 1
+            self._generation += 1
+            generation = self._generation
+            self._proc = None
+        _flight.record("fleet.owner_death", detail=why,
+                       value=generation - 1)
+        if _tel.enabled:
+            _tel.gauge("fleet.owner_up", 0)
+            _tel.count("fleet.owner_restarts")
+            _tel.instant("fleet.owner_restart", why=why,
+                         generation=generation,
+                         uptime_s=round(uptime, 3))
+        delay = self.backoff.backoff(attempt)
+        if self._stop.wait(delay):
+            return
+        t0 = time.perf_counter()
+        try:
+            proc = self.backoff.call(self._spawn_once, generation,
+                                     site="fleet.owner_spawn")
+        except OSError:
+            # spawn policy gave up: stay down, keep watching — a later
+            # manual start() is the operator's move; readiness stays red
+            _flight.record("fleet.owner_spawn_failed", value=generation)
+            return
+        recovery_s = time.perf_counter() - t0
+        _flight.record("fleet.owner_spawn", value=generation)
+        if _tel.enabled:
+            _tel.gauge("fleet.owner_up", 1)
+            _tel.count("fleet.owner_recovery_ms",
+                       round(recovery_s * 1e3, 3))
+        with self._lock:
+            self._proc = proc
+            self._started_at = time.monotonic()
+
+    # -------------------------------------------------------------- stop
+    def stop(self, timeout=15.0):
+        """Graceful teardown: SIGTERM the owner (drain), escalate to
+        SIGKILL past ``timeout``, reap, unlink the socket."""
+        self._stop.set()
+        with self._lock:
+            watcher, self._watcher = self._watcher, None
+            proc, self._proc = self._proc, None
+        if watcher is not None:
+            watcher.join(timeout=max(timeout, self.heartbeat_s * 4))
+        self._hb.close()
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        if _tel.enabled:
+            _tel.gauge("fleet.owner_up", 0)
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

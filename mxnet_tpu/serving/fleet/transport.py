@@ -1,0 +1,631 @@
+"""Fault-tolerant RPC transport between front-end and device-owner.
+
+The fleet topology splits one box into a crash-isolated pair: N
+stateless front-end processes (gateways) and ONE device-owner process
+holding the chips, the compiled programs and the KV cache.  This module
+is the wire between them — deliberately small, auditable, and built to
+*fail loudly and recover quietly*:
+
+- **Framing.**  Every message is one length-prefixed, crc32-checked
+  frame over a ``AF_UNIX`` stream socket (same-box, same-user trust
+  domain; no TCP stack, no accidental remote exposure)::
+
+      !4sBII  = magic b"MXF1" | kind | payload_len | crc32(payload)
+
+  A bad magic, an oversized length or a crc mismatch raises
+  :class:`FrameError` and tears the connection down — a torn write is
+  *never* half-parsed into a wrong request.
+- **Payloads** are pickled dicts restricted at load time to
+  numpy/builtins (same discipline as ``serving.aot``'s restricted
+  unpickler): the socket lives in the filesystem with 0700 ownership,
+  but a poisoned peer still must not get arbitrary-object construction.
+- **Deadlines ride the wire.**  A request carries its *remaining*
+  budget (``deadline_ms``); the owner re-anchors it on receipt, so
+  queue time in the owner counts against the same budget the client
+  started with.
+- **Trace contexts ride the wire** (``trace=(trace_id, span_id)``), so
+  a request's lane in the merged chrome trace spans both processes.
+- **Heartbeats** are first-class frames (PING/PONG), cheaper than a
+  method call and answered even while every worker thread is busy.
+- **Reconnect is policy-driven.**  :class:`OwnerClient` recovers from a
+  dead owner by redialing under a :class:`~mxnet_tpu.resilience.retry.
+  RetryPolicy` (bounded attempts, exponential backoff + jitter); every
+  in-flight call fails with :class:`OwnerGone` — a ``ConnectionError``
+  — so callers can distinguish "the owner crashed" (retryable for
+  idempotent work) from "the model rejected you".
+
+Fault sites: ``fleet.rpc_send`` (before a frame is written) and
+``fleet.rpc_recv`` (before a frame is read) — an injected fault behaves
+exactly like a torn socket, which is how CI drills the reconnect path
+without killing anything.
+"""
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+import zlib
+from collections import deque
+
+from ...resilience import faults as _faults
+from ...resilience.retry import RetryPolicy
+from ...telemetry import bus as _tel
+
+__all__ = ["FrameError", "OwnerGone", "RemoteError", "send_frame",
+           "recv_frame", "OwnerClient", "RPCServer",
+           "REQ", "RES", "STREAM", "PING", "PONG", "CANCEL"]
+
+_HEADER = struct.Struct("!4sBII")
+_MAGIC = b"MXF1"
+# a frame is one request/response body, not a bulk tensor channel; 256MB
+# bounds a corrupted length field before it becomes an allocation bomb
+MAX_FRAME = 256 * 1024 * 1024
+
+# frame kinds
+REQ = 0        # client -> owner: {"id", "method", "params", ...}
+RES = 1        # owner -> client: terminal {"id", "ok", ...}
+STREAM = 2     # owner -> client: non-terminal {"id", "token", ...}
+PING = 3       # either direction: {"id"}
+PONG = 4       # answer to PING: {"id", "pid", "generation"}
+CANCEL = 5     # client -> owner: {"id"} — abort a running request
+
+
+class FrameError(ConnectionError):
+    """A malformed frame (bad magic / oversized / crc mismatch).  The
+    connection it arrived on is poisoned and must be torn down."""
+
+
+class OwnerGone(ConnectionError):
+    """The transport to the device-owner died (crash, restart, torn
+    frame).  Idempotent callers may retry after reconnect."""
+
+
+class RemoteError(RuntimeError):
+    """The owner answered with a non-rejection error.  ``detail`` is the
+    remote ``repr``; the local stack never sees the remote exception
+    object (no cross-process pickle of arbitrary exceptions)."""
+
+    def __init__(self, detail):
+        super().__init__(detail)
+        self.detail = detail
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Payloads may reference numpy + builtin containers, nothing else —
+    the aot.py discipline: a poisoned frame is refused, not executed."""
+
+    _ALLOWED_MODULES = ("numpy", "builtins", "collections")
+
+    def find_class(self, module, name):
+        if module.split(".", 1)[0] in self._ALLOWED_MODULES:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"fleet frame references forbidden {module}.{name}")
+
+
+def _dumps(obj):
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _loads(data):
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+def send_frame(sock, kind, payload, lock=None):
+    """Serialize + frame + write ``payload`` (a dict) as one ``kind``
+    frame.  ``lock`` serializes concurrent writers on a shared socket.
+    Fault site ``fleet.rpc_send`` fires before the write — an injected
+    fault is indistinguishable from a torn socket."""
+    if _faults.active:
+        _faults.check("fleet.rpc_send")
+    data = _dumps(payload)
+    frame = _HEADER.pack(_MAGIC, kind, len(data),
+                         zlib.crc32(data) & 0xffffffff) + data
+    if lock is not None:
+        with lock:
+            sock.sendall(frame)
+    else:
+        sock.sendall(frame)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise OwnerGone("peer closed the socket")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_frame(sock):
+    """Read one frame; returns ``(kind, payload_dict)``.  Raises
+    :class:`FrameError` on a malformed frame, :class:`OwnerGone` on EOF.
+    Fault site ``fleet.rpc_recv`` fires before the read."""
+    if _faults.active:
+        _faults.check("fleet.rpc_recv")
+    head = _recv_exact(sock, _HEADER.size)
+    magic, kind, length, crc = _HEADER.unpack(head)
+    if magic != _MAGIC:
+        raise FrameError(f"bad frame magic {magic!r}")
+    if length > MAX_FRAME:
+        raise FrameError(f"frame of {length} bytes exceeds {MAX_FRAME}")
+    data = _recv_exact(sock, length)
+    if (zlib.crc32(data) & 0xffffffff) != crc:
+        raise FrameError("frame crc mismatch (torn write?)")
+    return kind, _loads(data)
+
+
+class _Call:
+    """One outstanding request on the client: a condition-guarded inbox
+    the reader thread feeds (stream frames + one terminal)."""
+
+    __slots__ = ("cond", "frames", "terminal", "failed")
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.frames = deque()
+        self.terminal = None
+        self.failed = None
+
+    def push(self, frame, terminal=False):
+        with self.cond:
+            if terminal:
+                self.terminal = frame
+            else:
+                self.frames.append(frame)
+            self.cond.notify_all()
+
+    def fail(self, exc):
+        with self.cond:
+            if self.terminal is None and self.failed is None:
+                self.failed = exc
+                self.cond.notify_all()
+
+    def next(self, timeout=None):
+        """Next stream frame, or the terminal (returned, not yielded).
+        Returns ``(frame, is_terminal)``."""
+        deadline = None if timeout is None \
+            else time.perf_counter() + timeout
+        with self.cond:
+            while True:
+                if self.frames:
+                    return self.frames.popleft(), False
+                if self.failed is not None:
+                    raise self.failed
+                if self.terminal is not None:
+                    return self.terminal, True
+                remaining = None if deadline is None \
+                    else deadline - time.perf_counter()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError("no RPC answer within the timeout")
+                self.cond.wait(timeout=remaining)
+
+
+class OwnerClient:
+    """Client half of the fleet transport: request/response correlation,
+    token streaming, heartbeats, and policy-driven reconnect.
+
+    One client owns one socket; a background reader thread demuxes
+    frames to outstanding calls by id.  Any transport failure fails
+    *every* outstanding call with :class:`OwnerGone` and marks the
+    client disconnected; the next :meth:`call`/:meth:`ping` redials
+    under ``retry`` (so a supervisor-restarted owner is transparently
+    picked back up, counted as ``fleet.reconnects``).
+
+    Parameters
+    ----------
+    socket_path : str
+        The owner's ``AF_UNIX`` socket.
+    retry : RetryPolicy, optional
+        Reconnect policy (default: 6 attempts, 50ms base exponential
+        backoff).  ``None`` disables redialing — one strike and out.
+    connect_timeout_s : float
+        Per-dial timeout.
+    """
+
+    def __init__(self, socket_path, retry=None, connect_timeout_s=5.0):
+        self.socket_path = socket_path
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=6, base_delay_ms=50.0, max_delay_ms=1000.0)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self._lock = threading.Lock()
+        self._wlock = threading.Lock()
+        self._sock = None
+        self._reader = None
+        self._calls = {}
+        self._next_id = 0
+        self._closed = False
+        self.reconnects = 0
+
+    # ---------------------------------------------------------- connection
+    @property
+    def connected(self):
+        with self._lock:
+            return self._sock is not None
+
+    def _dial_once(self):
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self.connect_timeout_s)
+        try:
+            sock.connect(self.socket_path)
+        except OSError:
+            sock.close()
+            raise
+        sock.settimeout(None)
+        return sock
+
+    def connect(self):
+        """Dial (idempotent).  Retries under the client's policy; raises
+        the last ``OSError`` when every attempt fails."""
+        with self._lock:
+            if self._closed:
+                raise OwnerGone("client is closed")
+            if self._sock is not None:
+                return self
+            redial = self._reader is not None     # a reader ever existed
+        sock = self.retry.call(self._dial_once, site="fleet.connect")
+        with self._lock:
+            if self._closed:
+                sock.close()
+                raise OwnerGone("client is closed")
+            self._sock = sock
+            self._reader = threading.Thread(
+                target=self._read_loop, args=(sock,), daemon=True,
+                name="fleet-client-reader")
+            self._reader.start()
+            if redial:
+                self.reconnects += 1
+                if _tel.enabled:
+                    _tel.count("fleet.reconnects")
+        return self
+
+    def _read_loop(self, sock):
+        try:
+            while True:
+                kind, payload = recv_frame(sock)
+                call = None
+                with self._lock:
+                    call = self._calls.get(payload.get("id"))
+                if call is None:
+                    continue              # cancelled / unknown — drop
+                if kind in (RES, PONG):
+                    call.push((kind, payload), terminal=True)
+                    with self._lock:
+                        self._calls.pop(payload.get("id"), None)
+                elif kind == STREAM:
+                    call.push((kind, payload))
+        except (ConnectionError, OSError, pickle.UnpicklingError,
+                EOFError) as e:
+            self._disconnect(e)
+
+    def _disconnect(self, cause):
+        exc = cause if isinstance(cause, OwnerGone) else \
+            OwnerGone(f"transport to owner failed: {cause!r}")
+        with self._lock:
+            sock, self._sock = self._sock, None
+            calls, self._calls = self._calls, {}
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for call in calls.values():
+            call.fail(exc)
+        if _tel.enabled:
+            _tel.count("fleet.transport_failures")
+
+    # --------------------------------------------------------------- calls
+    def _register(self, kind, payload):
+        """Allocate an id, register the call inbox, send the frame.  A
+        send failure tears the connection down and raises OwnerGone."""
+        self.connect()
+        call = _Call()
+        with self._lock:
+            if self._sock is None:
+                raise OwnerGone("not connected")
+            self._next_id += 1
+            rid = self._next_id
+            payload = dict(payload, id=rid)
+            self._calls[rid] = call
+            sock = self._sock
+        try:
+            send_frame(sock, kind, payload, lock=self._wlock)
+        except (ConnectionError, OSError) as e:
+            self._disconnect(e)
+            raise OwnerGone(f"send failed: {e!r}") from e
+        return rid, call
+
+    @staticmethod
+    def _unwrap(payload):
+        if payload.get("ok"):
+            return payload.get("result")
+        kind = payload.get("error_kind", "error")
+        if kind == "rejected":
+            # late import: batcher -> telemetry.http -> (no cycle back)
+            from ..batcher import RequestRejected
+            raise RequestRejected(payload.get("reason", "unknown"),
+                                  payload.get("detail", ""))
+        if kind == "unknown_model":
+            raise KeyError(payload.get("detail", "unknown model"))
+        if kind == "bad_request":
+            raise ValueError(payload.get("detail", "bad request"))
+        raise RemoteError(payload.get("detail", "remote error"))
+
+    def call(self, method, params=None, deadline_ms=None, timeout=None,
+             trace=None):
+        """One request/terminal-response round trip.  ``deadline_ms`` is
+        the remaining budget shipped to the owner; ``timeout`` bounds the
+        local wait (default: deadline + 30s slack, or forever)."""
+        if timeout is None and deadline_ms is not None:
+            timeout = deadline_ms / 1e3 + 30.0
+        payload = {"method": method, "params": params or {}}
+        if deadline_ms is not None:
+            payload["deadline_ms"] = float(deadline_ms)
+        if trace is not None:
+            payload["trace"] = (trace.trace_id, trace.span_id)
+        if _tel.enabled:
+            _tel.count("fleet.rpc_calls", method=method)
+        _rid, call = self._register(REQ, payload)
+        (_kind, answer), _terminal = call.next(timeout=timeout)
+        return self._unwrap(answer)
+
+    def stream(self, method, params=None, deadline_ms=None, timeout=None,
+               trace=None):
+        """Start a streaming call; returns a :class:`ClientStream`
+        yielding non-terminal frames, with the terminal result (or
+        error) surfaced at the end of iteration."""
+        payload = {"method": method, "params": params or {},
+                   "stream": True}
+        if deadline_ms is not None:
+            payload["deadline_ms"] = float(deadline_ms)
+        if trace is not None:
+            payload["trace"] = (trace.trace_id, trace.span_id)
+        if _tel.enabled:
+            _tel.count("fleet.rpc_calls", method=method)
+        rid, call = self._register(REQ, payload)
+        if timeout is None and deadline_ms is not None:
+            timeout = deadline_ms / 1e3 + 30.0
+        return ClientStream(self, rid, call, timeout)
+
+    def cancel(self, rid):
+        """Best-effort: tell the owner to abort request ``rid`` (fire and
+        forget — a dead transport means the owner is gone anyway)."""
+        with self._lock:
+            sock = self._sock
+        if sock is None:
+            return
+        try:
+            send_frame(sock, CANCEL, {"id": rid}, lock=self._wlock)
+        except (ConnectionError, OSError):
+            pass
+
+    def ping(self, timeout=2.0):
+        """Heartbeat round trip; returns the PONG payload (pid,
+        generation).  Raises on a dead/absent owner."""
+        _rid, call = self._register(PING, {})
+        (_kind, answer), _ = call.next(timeout=timeout)
+        return answer
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+        self._disconnect(OwnerGone("client closed"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class ClientStream:
+    """Iterator over one streaming RPC: yields each STREAM frame's
+    payload; ``result()`` (after exhaustion) returns the terminal
+    payload unwrapped.  Transport death mid-stream raises
+    :class:`OwnerGone` from the iterator — the caller decides how to
+    degrade (the gateway turns it into a terminal SSE error frame)."""
+
+    def __init__(self, client, rid, call, timeout):
+        self._client = client
+        self._rid = rid
+        self._call = call
+        self._timeout = timeout
+        self._terminal = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._terminal is not None:
+            raise StopIteration
+        (_kind, payload), terminal = self._call.next(timeout=self._timeout)
+        if terminal:
+            self._terminal = payload
+            raise StopIteration
+        return payload
+
+    def result(self):
+        """The unwrapped terminal result (drains remaining frames)."""
+        while self._terminal is None:
+            try:
+                next(self)
+            except StopIteration:
+                break
+        return OwnerClient._unwrap(self._terminal)
+
+    def cancel(self):
+        """Abort the remote request (client hung up / lost interest)."""
+        self._client.cancel(self._rid)
+
+
+class RPCServer:
+    """Owner-side half: accept loop on an ``AF_UNIX`` socket, one reader
+    thread per connection, one worker thread per in-flight request (a
+    request may be a multi-second decode — heartbeats must still answer
+    while it runs).
+
+    ``service`` duck-type::
+
+        service.handle(method, params, deadline_ms, trace,
+                       emit, register_cancel) -> result
+            # emit(dict) writes one STREAM frame (None for unary calls);
+            # register_cancel(key) names the running request so a CANCEL
+            # frame can be routed to service.cancel(key)
+        service.cancel(key)          # abort a running request (optional)
+        service.pong() -> dict       # extra PONG payload fields
+
+    ``handle`` runs on the per-request thread; raising
+    ``RequestRejected`` / ``KeyError`` / ``ValueError`` maps to typed
+    error payloads, anything else to ``error_kind="error"`` with the
+    repr — the server never dies from a handler exception.
+    """
+
+    def __init__(self, socket_path, service, backlog=64):
+        self.socket_path = socket_path
+        self.service = service
+        self._lock = threading.Lock()
+        self._conns = set()
+        self._closed = False
+        # stale socket from a SIGKILLed predecessor: the supervisor owns
+        # the path's lifecycle, but unlink defensively so a crashed owner
+        # never blocks its own restart
+        try:
+            os.unlink(socket_path)
+        except OSError:
+            pass
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(socket_path)
+        os.chmod(socket_path, 0o700)
+        self._sock.listen(backlog)
+        self._accepter = threading.Thread(target=self._accept_loop,
+                                          daemon=True, name="fleet-accept")
+        self._accepter.start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return                     # closed
+            with self._lock:
+                if self._closed:
+                    conn.close()
+                    return
+                self._conns.add(conn)
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             daemon=True, name="fleet-conn").start()
+
+    def _conn_loop(self, conn):
+        wlock = threading.Lock()
+        running = {}            # id -> cancel key, for CANCEL routing
+        running_lock = threading.Lock()
+        try:
+            while True:
+                kind, payload = recv_frame(conn)
+                if kind == PING:
+                    pong = {"id": payload.get("id")}
+                    try:
+                        pong.update(self.service.pong())
+                    except Exception:     # noqa: BLE001 — pong is best-effort
+                        pass
+                    try:
+                        send_frame(conn, PONG, pong, lock=wlock)
+                    except (ConnectionError, OSError):
+                        return
+                elif kind == CANCEL:
+                    with running_lock:
+                        key = running.get(payload.get("id"))
+                    if key is not None and \
+                            hasattr(self.service, "cancel"):
+                        try:
+                            self.service.cancel(key)
+                        except Exception:  # noqa: BLE001 — cancel is advisory
+                            pass
+                elif kind == REQ:
+                    threading.Thread(
+                        target=self._serve_one,
+                        args=(conn, wlock, payload, running, running_lock),
+                        daemon=True, name="fleet-request").start()
+        except (ConnectionError, OSError, pickle.UnpicklingError,
+                EOFError):
+            pass
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_one(self, conn, wlock, payload, running, running_lock):
+        rid = payload.get("id")
+        streaming = bool(payload.get("stream"))
+
+        def emit(frame):
+            send_frame(conn, STREAM, dict(frame, id=rid), lock=wlock)
+
+        def register_cancel(key):
+            with running_lock:
+                running[rid] = key
+
+        answer = {"id": rid}
+        try:
+            result = self.service.handle(
+                payload.get("method"), payload.get("params") or {},
+                payload.get("deadline_ms"), payload.get("trace"),
+                emit if streaming else None, register_cancel)
+            answer.update(ok=True, result=result)
+        except (ConnectionError, OSError):
+            return                      # peer is gone; nothing to answer
+        except Exception as e:          # noqa: BLE001 — typed error payloads
+            answer.update(ok=False, **self._error_payload(e))
+        finally:
+            with running_lock:
+                running.pop(rid, None)
+        try:
+            send_frame(conn, RES, answer, lock=wlock)
+        except (ConnectionError, OSError):
+            pass
+
+    @staticmethod
+    def _error_payload(e):
+        from ..batcher import RequestRejected
+        if isinstance(e, RequestRejected):
+            return {"error_kind": "rejected", "reason": e.reason,
+                    "detail": str(e)}
+        if isinstance(e, KeyError):
+            return {"error_kind": "unknown_model", "detail": str(e)}
+        if isinstance(e, (TypeError, ValueError)):
+            return {"error_kind": "bad_request", "detail": str(e)}
+        return {"error_kind": "error", "detail": repr(e)}
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            conns = list(self._conns)
+            self._conns.clear()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass

@@ -12,8 +12,20 @@ table, so one port answers:
   TokenStream`; otherwise one JSON body at completion.  Both carry the
   bitwise-identical token sequence.
 - ``POST /v1/infer`` — one-shot Batcher models by registry name.
-- ``GET /metrics`` / ``/healthz`` / ``/trace`` — the telemetry routes,
-  same server (breaker open ⇒ ``/healthz`` 503 the moment it happens).
+  Idempotent, so in fleet proxy mode a device-owner crash mid-call is
+  transparently retried against the restarted owner within the
+  request's deadline.
+- ``GET /metrics`` / ``/healthz`` / ``/readyz`` / ``/trace`` — the
+  telemetry routes, same server.  ``/healthz`` is liveness (restart me);
+  ``/readyz`` is readiness (route away) — breaker open, drain, or a
+  dead device-owner flip ``/readyz`` 503 the moment they happen while
+  liveness stays green.
+
+``Gateway(owner=...)`` is **proxy mode**: the models live in a separate
+crash-supervised device-owner process (:mod:`mxnet_tpu.serving.fleet`)
+and every ``/v1/*`` request rides the fleet RPC transport — the
+degradation matrix in docs/serving.md spells out exactly what each
+failure turns into (never a torn SSE stream, never a bug-path 5xx).
 
 Admission control (:class:`AdmissionController`) gates every request
 with weighted per-model shares over a fixed in-flight capacity; sheds

@@ -119,6 +119,52 @@ class AdmissionController:
                 return self._inflight.get(model, 0)
             return sum(self._inflight.values())
 
+    def compute_retry_after(self, reason, queue_depth=0, active=0,
+                            breaker_remaining_s=None, inflight=None):
+        """A live ``Retry-After`` hint for one shed, in seconds.
+
+        A constant hint lies in both directions — too short synchronizes
+        a retry storm against a box that is still drowning, too long
+        parks clients a balancer could have served here in a second.  So
+        each shed reason derives its hint from the state that caused it:
+
+        - ``unhealthy``: the breaker's actual remaining cool-down
+          (clamped to >= 0.1s) — retrying before it can close is pure
+          waste; ``breaker_remaining_s=None`` falls back to 5x base.
+        - ``shutdown``: this process is going away — a long hint
+          (>= 10s) tells well-behaved clients to fail over, not camp.
+        - ``owner_unavailable``: the device-owner died and the
+          supervisor is restarting it — an AOT-warm respawn lands in a
+          couple of seconds, so hint just past that.
+        - ``qos``: over the model's weighted share — scale base by how
+          contended the gateway is (``1 + inflight/capacity``).
+        - ``backpressure`` / ``deadline``: queue pressure — scale base
+          by the live queue depth against capacity.
+        - ``kv_exhausted``: pages free up as sequences finish — scale
+          base by how many sequences are actively decoding.
+
+        Unknown reasons get the base hint.  Everything rounds to ms so
+        header values are stable in tests and logs."""
+        base = self.retry_after_s
+        cap = max(1, self.capacity)
+        if inflight is None:
+            inflight = self.inflight()
+        if reason == "unhealthy":
+            if breaker_remaining_s is not None and breaker_remaining_s > 0:
+                return round(max(0.1, breaker_remaining_s), 3)
+            return round(base * 5.0, 3)
+        if reason == "shutdown":
+            return round(max(10.0, base * 10.0), 3)
+        if reason == "owner_unavailable":
+            return round(max(2.0, base * 2.0), 3)
+        if reason == "qos":
+            return round(base * (1.0 + inflight / cap), 3)
+        if reason in ("backpressure", "deadline"):
+            return round(base * (1.0 + queue_depth / cap), 3)
+        if reason == "kv_exhausted":
+            return round(base * (1.0 + active / cap), 3)
+        return round(base, 3)
+
     def snapshot(self):
         with self._lock:
             return {"capacity": self.capacity,

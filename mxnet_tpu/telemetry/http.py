@@ -10,11 +10,18 @@ table**.  The built-in routes:
 - ``GET /metrics`` — Prometheus text exposition
   (:func:`..exporters.dump_metrics`): every counter, gauge, span
   aggregate and histogram the bus holds.
-- ``GET /healthz`` — 200 when every registered health probe says healthy,
-  503 otherwise.  ``Batcher`` and ``DecodeScheduler`` auto-register
-  their circuit-breaker state on construction (weakly — a dropped
-  component never pins or poisons the endpoint), so the route flips the
-  moment a breaker opens.
+- ``GET /healthz`` — **liveness**: 200 when every registered health
+  probe says healthy, 503 otherwise.  Liveness answers "should the
+  orchestrator restart this process?" — so it covers process-level
+  wedges only, never load or drain state.
+- ``GET /readyz`` — **readiness**: 200 when every readiness probe says
+  ready.  Readiness answers "should a balancer route traffic here right
+  now?" — ``Batcher`` and ``DecodeScheduler`` auto-register their
+  circuit-breaker state on construction (weakly — a dropped component
+  never pins or poisons the endpoint), the gateway registers its
+  drain/owner-connectivity state, so the route flips the moment a
+  breaker opens, a drain starts, or the device-owner goes away, without
+  ever telling the orchestrator to kill a perfectly live process.
 - ``GET /trace`` — the current merged chrome trace
   (:func:`..trace.chrome_trace`), loadable straight into Perfetto.
 
@@ -42,49 +49,51 @@ from . import exporters
 
 __all__ = ["start_server", "stop_server", "server_port",
            "register_health", "unregister_health", "health",
+           "register_ready", "unregister_ready", "readiness",
            "register_route", "unregister_route", "routes"]
 
-# ------------------------------------------------------- health probe registry
+# ------------------------------------------- health/readiness probe registries
+# Two registries, one mechanic.  Liveness (``/healthz``) is "restart me
+# if false"; readiness (``/readyz``) is "don't route to me right now".
+# Conflating them is the classic outage amplifier: a breaker opening
+# under load flips readiness, and a liveness probe wired to the same
+# surface would have the orchestrator kill-looping a healthy process.
 _health_lock = threading.Lock()
 _health = {}        # name -> weakref to an object with .healthy
+_ready = {}         # name -> weakref to an object with .ready (or .healthy)
 
 
-def register_health(name, obj):
-    """Register ``obj`` (anything exposing ``.healthy`` — property or
-    nullary method) under ``name``.  Weakly referenced: a collected
-    component silently drops out instead of failing health forever."""
+def _register(registry, name, obj):
     with _health_lock:
-        _health[name] = weakref.ref(obj)
+        registry[name] = weakref.ref(obj)
 
 
-def unregister_health(name, obj=None):
-    """Remove a probe.  With ``obj`` given, remove only if the entry still
-    points at it — so ``registry.swap()`` patterns where a new component
-    registered under the same name don't get torn down by the old one's
-    close()."""
+def _unregister(registry, name, obj):
     with _health_lock:
-        ref = _health.get(name)
+        ref = registry.get(name)
         if ref is None:
             return
         if obj is None or ref() is obj or ref() is None:
-            del _health[name]
+            del registry[name]
 
 
-def health():
-    """``(ok, {name: bool})`` across live probes.  A probe that raises
-    counts as unhealthy; a dead weakref is dropped."""
+def _report(registry, attrs):
     with _health_lock:
-        items = list(_health.items())
+        items = list(registry.items())
     report, ok = {}, True
     for name, ref in items:
         obj = ref()
         if obj is None:
             with _health_lock:
-                if _health.get(name) is ref:
-                    del _health[name]
+                if registry.get(name) is ref:
+                    del registry[name]
             continue
         try:
-            h = obj.healthy
+            h = None
+            for attr in attrs:
+                h = getattr(obj, attr, None)
+                if h is not None:
+                    break
             if callable(h):
                 h = h()
             h = bool(h)
@@ -93,6 +102,47 @@ def health():
         report[name] = h
         ok = ok and h
     return ok, report
+
+
+def register_health(name, obj):
+    """Register a **liveness** probe: ``obj`` (anything exposing
+    ``.healthy`` — property or nullary method) under ``name``.  Weakly
+    referenced: a collected component silently drops out instead of
+    failing health forever."""
+    _register(_health, name, obj)
+
+
+def unregister_health(name, obj=None):
+    """Remove a liveness probe.  With ``obj`` given, remove only if the
+    entry still points at it — so ``registry.swap()`` patterns where a new
+    component registered under the same name don't get torn down by the
+    old one's close()."""
+    _unregister(_health, name, obj)
+
+
+def health():
+    """``(ok, {name: bool})`` across live liveness probes.  A probe that
+    raises counts as unhealthy; a dead weakref is dropped."""
+    return _report(_health, ("healthy",))
+
+
+def register_ready(name, obj):
+    """Register a **readiness** probe under ``name``: ``obj.ready`` is
+    consulted, falling back to ``obj.healthy`` (so breaker-bearing
+    components register once and mean it).  Weakly referenced, like
+    :func:`register_health`."""
+    _register(_ready, name, obj)
+
+
+def unregister_ready(name, obj=None):
+    """Remove a readiness probe (same ``obj``-guard as
+    :func:`unregister_health`)."""
+    _unregister(_ready, name, obj)
+
+
+def readiness():
+    """``(ok, {name: bool})`` across live readiness probes."""
+    return _report(_ready, ("ready", "healthy"))
 
 
 # -------------------------------------------------------------- route table
@@ -138,6 +188,12 @@ def _route_healthz(h):
     h._send(200 if ok else 503, body, "application/json")
 
 
+def _route_readyz(h):
+    ok, report = readiness()
+    body = json.dumps({"ok": ok, "components": report}) + "\n"
+    h._send(200 if ok else 503, body, "application/json")
+
+
 def _route_trace(h):
     from . import trace
     h._send(200, json.dumps(trace.chrome_trace()), "application/json")
@@ -145,6 +201,7 @@ def _route_trace(h):
 
 register_route("GET", "/metrics", _route_metrics)
 register_route("GET", "/healthz", _route_healthz)
+register_route("GET", "/readyz", _route_readyz)
 register_route("GET", "/trace", _route_trace)
 
 

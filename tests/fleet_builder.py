@@ -1,0 +1,50 @@
+"""Deterministic model builder for fleet drills (owner ``--spec``).
+
+The device-owner process imports this module's :func:`build` to
+construct its models — a tiny decode model (same geometry as the AOT
+cold-start drill) plus a one-layer infer model behind a registry.  The
+weights are seeded, so every incarnation of the owner — including every
+supervisor restart — answers bitwise-identically to its predecessor;
+the chaos drill's post-crash equality assertion rests on exactly this.
+
+``build(aot_cache=...)`` re-warms from the persistent program cache, so
+a restart costs program *loads*, not XLA compiles.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_empty(aot_cache=None):
+    """Model-free owner for supervisor unit drills: spawn cost is the
+    interpreter + framework import, no XLA compiles."""
+    from mxnet_tpu.serving import ModelRegistry
+    return {"registry": ModelRegistry(), "decode": {}}
+
+
+def build(aot_cache=None):
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.serving import ModelRegistry, ModelRuntime
+    from mxnet_tpu.serving.decode import DecodeSession, get_decode_model
+
+    mx.random.seed(0)
+    net = get_decode_model("decode_tiny", vocab_size=96, max_length=32,
+                           units=32, num_heads=2)
+    net.initialize()
+    sess = DecodeSession(net, batch_buckets=(1, 2), seq_buckets=(8,),
+                         page_size=8, aot_cache=aot_cache)
+
+    mx.random.seed(1)
+    dense = nn.Dense(4)
+    dense.initialize()
+    dense(nd.zeros((1, 8)))          # shape inference before compile
+    rt = ModelRuntime(dense, item_shapes=(8,), max_batch=8,
+                      aot_cache=aot_cache)
+    registry = ModelRegistry()
+    registry.register("tiny_dense", rt, max_latency_ms=2.0)
+
+    return {"registry": registry, "decode": {"decode_tiny": sess}}

@@ -1,0 +1,61 @@
+"""Subprocess worker for the SIGTERM graceful-drain drill (ISSUE 19
+satellite).
+
+Serves a one-model gateway whose batcher holds requests for its full
+``max_latency_ms`` window, so the parent can have a request *in flight*
+when it sends SIGTERM.  A :class:`PreemptionHandler` wired through
+``Gateway.install_preemption`` flips the gateway to draining: the
+in-flight request must complete 200, new submits must shed 503
+``shutdown``, and the process must exit 0 once traffic stops.
+
+Prints ``PORT <n>`` when serving and ``DRAINED`` after a clean drain.
+"""
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.resilience import PreemptionHandler
+    from mxnet_tpu.serving import ModelRegistry, ModelRuntime
+    from mxnet_tpu.serving.gateway import Gateway
+
+    handler = PreemptionHandler(signals=(signal.SIGTERM,))
+
+    mx.random.seed(1)
+    dense = mx.gluon.nn.Dense(4)
+    dense.initialize()
+    dense(nd.zeros((1, 8)))             # shape inference before compile
+    rt = ModelRuntime(dense, item_shapes=(8,), max_batch=8)
+    registry = ModelRegistry()
+    # a long flush window: one submitted item sits in the batch for
+    # ~500ms, giving the parent room to SIGTERM around it
+    registry.register("tiny_dense", rt, max_latency_ms=500.0)
+
+    gw = Gateway(registry=registry, capacity=8)
+    gw.install_preemption(handler)
+    print(f"PORT {gw.port}", flush=True)
+
+    handler.wait()                      # SIGTERM lands here
+    deadline = time.perf_counter() + 10.0
+    while time.perf_counter() < deadline and gw.admission.inflight() > 0:
+        time.sleep(0.02)                # in-flight requests finish
+    leaked = gw.admission.inflight()
+    gw.close()
+    registry.close(drain=True)
+    if leaked:
+        print(f"LEAKED {leaked}", flush=True)
+        sys.exit(3)
+    print("DRAINED", flush=True)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -349,3 +349,209 @@ def test_unhealthy_gateway_flips_healthz(decode_sess):
     finally:
         gw._closed = False
         gw.close()
+
+
+# ------------------------------------------- ISSUE 19: graceful degradation
+def test_compute_retry_after_per_reason():
+    """Every shed reason derives its Retry-After from the live state
+    that caused it — not one constant that synchronizes retry storms."""
+    ac = AdmissionController(capacity=10, retry_after_s=1.0)
+    # breaker open: hint == the actual remaining cool-down
+    assert ac.compute_retry_after("unhealthy",
+                                  breaker_remaining_s=3.25) == 3.25
+    assert ac.compute_retry_after("unhealthy",
+                                  breaker_remaining_s=0.01) == 0.1
+    assert ac.compute_retry_after("unhealthy") == 5.0     # no breaker info
+    # shutdown: long — clients should fail over, not camp
+    assert ac.compute_retry_after("shutdown") >= 10.0
+    # owner crash: sized past an AOT-warm supervisor respawn
+    assert ac.compute_retry_after("owner_unavailable") >= 2.0
+    # qos: scales with gateway contention
+    assert ac.compute_retry_after("qos", inflight=0) == 1.0
+    assert ac.compute_retry_after("qos", inflight=10) == 2.0
+    # queue pressure: scales with live queue depth
+    assert ac.compute_retry_after("backpressure", queue_depth=5) == 1.5
+    assert ac.compute_retry_after("deadline", queue_depth=10) == 2.0
+    # kv pressure: scales with actively decoding sequences
+    assert ac.compute_retry_after("kv_exhausted", active=10) == 2.0
+    assert ac.compute_retry_after("kv_exhausted", active=0) == 1.0
+    # unknown reasons get the base hint
+    assert ac.compute_retry_after("???") == 1.0
+
+
+def test_shed_headers_carry_live_retry_after(decode_sess):
+    """HTTP-level: each reachable shed reason answers with the header
+    computed from live state."""
+    gw = Gateway(capacity=1)
+    try:
+        gw.add_decode("tiny", decode_sess)
+        # qos: fill the only slot, then shed
+        assert gw.admission.try_acquire("tiny")
+        st, hdrs, raw = _post(gw.port, "/v1/generate",
+                              {"model": "tiny", "prompt": [1]})
+        assert st == 429
+        assert json.loads(raw)["error"] == "qos"
+        assert float(hdrs["Retry-After"]) == pytest.approx(
+            gw.admission.compute_retry_after("qos"), abs=0.5)
+        gw.admission.release("tiny")
+        # shutdown: drain flips every new request to 503 + long hint
+        gw.drain()
+        st, hdrs, raw = _post(gw.port, "/v1/generate",
+                              {"model": "tiny", "prompt": [1]})
+        assert st == 503
+        assert json.loads(raw)["error"] == "shutdown"
+        assert float(hdrs["Retry-After"]) >= 10.0
+    finally:
+        gw._draining.clear()
+        gw.close()
+
+
+def test_drain_flips_readyz_not_healthz(decode_sess):
+    """Liveness says "restart me", readiness says "route away": a drain
+    must flip only readiness, or the balancer's health check kills a
+    process that is finishing real work."""
+    gw = Gateway()
+    try:
+        gw.add_decode("tiny", decode_sess)
+        assert _get(gw.port, "/healthz")[0] == 200
+        assert _get(gw.port, "/readyz")[0] == 200
+        gw.drain()
+        assert gw.draining
+        st, raw = _get(gw.port, "/readyz")
+        assert st == 503
+        assert json.loads(raw)["components"]["gateway:gateway"] is False
+        assert _get(gw.port, "/healthz")[0] == 200        # still alive
+    finally:
+        gw._draining.clear()
+        gw.close()
+
+
+def test_open_breaker_flips_readyz_not_healthz():
+    """A batcher's open circuit breaker is a routing signal, not a
+    liveness failure."""
+    net = _make_net(0.1)
+    rt = ModelRuntime(net, item_shapes=ITEM, max_batch=2)
+    reg = ModelRegistry()
+    reg.register("m", rt, max_latency_ms=1.0)
+    gw = Gateway(registry=reg)
+    try:
+        assert _get(gw.port, "/readyz")[0] == 200
+        b = reg.get("m")
+        b._breaker_open_until = time.perf_counter() + 60.0
+        st, raw = _get(gw.port, "/readyz")
+        assert st == 503
+        assert json.loads(raw)["components"][f"batcher:{rt.name}"] is False
+        assert _get(gw.port, "/healthz")[0] == 200
+        b._breaker_open_until = 0.0
+        assert _get(gw.port, "/readyz")[0] == 200
+    finally:
+        gw.close()
+        reg.close(drain=False)
+
+
+def test_sse_client_disconnect_aborts_decode(decode_sess):
+    """Satellite 1: the SSE reader hangs up mid-stream -> the gateway
+    aborts the decode via the scheduler, the KV pages come back, and
+    the eviction is accounted reason="aborted" — no leaked slots, no
+    tokens decoded for nobody."""
+    from mxnet_tpu.resilience import faults
+
+    import socket as socketlib
+
+    telemetry.enable()
+    gw = Gateway()
+    sock = None
+    try:
+        gw.add_decode("tiny", decode_sess)
+        base_pages = decode_sess.stats()["pages_in_use"]
+        with faults.scope("decode.step:delay:40ms"):   # slow the decode
+            body = json.dumps({"model": "tiny", "prompt": [5, 9, 2],
+                               "max_new_tokens": 29,
+                               "stream": True}).encode()
+            sock = socketlib.create_connection(("127.0.0.1", gw.port),
+                                               timeout=30)
+            sock.sendall(b"POST /v1/generate HTTP/1.1\r\n"
+                         b"Host: x\r\nContent-Type: application/json\r\n"
+                         b"Content-Length: %d\r\n\r\n" % len(body) + body)
+            buf = b""
+            while b"data: " not in buf:    # headers + first token frame
+                chunk = sock.recv(4096)
+                assert chunk, "stream closed before first token"
+                buf += chunk
+            assert b" 200 " in buf.split(b"\r\n", 1)[0]
+            sock.close()                   # ...and vanish mid-stream
+            sock = None
+            # the abort lands at the next step boundary
+            deadline = time.perf_counter() + 15.0
+            aborted = 0
+            while time.perf_counter() < deadline:
+                by_label = telemetry.snapshot()["counters_by_label"]
+                aborted = sum(
+                    v for k, v in
+                    by_label.get("decode.evictions", {}).items()
+                    if 'reason="aborted"' in k)
+                if aborted and \
+                        decode_sess.stats()["pages_in_use"] <= base_pages:
+                    break
+                time.sleep(0.05)
+        assert aborted >= 1
+        stats = decode_sess.stats()
+        assert stats["pages_in_use"] <= base_pages      # pages came back
+        assert stats["active"] == 0 and stats["pending"] == 0
+        counters = telemetry.snapshot()["counters"]
+        assert counters.get("gateway.client_disconnects", 0) >= 1
+        # the admission slot was released too
+        assert gw.admission.inflight() == 0
+    finally:
+        if sock is not None:
+            sock.close()
+        gw.close()
+
+
+def test_sigterm_drains_gracefully():
+    """Satellite 4 (subprocess drill): SIGTERM mid-request -> the
+    in-flight request completes 200, new submits shed 503 shutdown,
+    and the worker exits 0."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    worker = os.path.join(os.path.dirname(__file__),
+                          "gateway_drain_worker.py")
+    proc = subprocess.Popen([sys.executable, worker],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("PORT ")
+        port = int(line.split()[1])
+
+        results = {}
+
+        def inflight():
+            results["inflight"] = _post(
+                port, "/v1/infer",
+                {"model": "tiny_dense", "inputs": [0.5] * 8}, timeout=30)
+
+        t = threading.Thread(target=inflight, daemon=True)
+        t.start()
+        time.sleep(0.15)                 # request is inside the batcher
+        proc.send_signal(signal.SIGTERM)
+        time.sleep(0.15)                 # drain has flipped
+        st, hdrs, raw = _post(port, "/v1/infer",
+                              {"model": "tiny_dense",
+                               "inputs": [0.5] * 8}, timeout=10)
+        assert st == 503
+        assert json.loads(raw)["error"] == "shutdown"
+        assert float(hdrs["Retry-After"]) >= 10.0
+        t.join(timeout=30)
+        st, _, raw = results["inflight"]
+        assert st == 200                 # in-flight work was not dropped
+        assert len(json.loads(raw)["outputs"]) == 4
+        out, _ = proc.communicate(timeout=30)
+        assert "DRAINED" in out
+        assert proc.returncode == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()

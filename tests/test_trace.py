@@ -460,11 +460,14 @@ class TestHttpEndpoint:
         rt = mx.serving.ModelRuntime(net, item_shapes=(8,), max_batch=2)
         b = mx.serving.Batcher(rt, start=False)
         try:
-            ok, report = http.health()
+            # batchers report *readiness* (route away), not liveness
+            ok, report = http.readiness()
             assert report.get(f"batcher:{rt.name}") is True and ok
+            _ok, live = http.health()
+            assert f"batcher:{rt.name}" not in live
         finally:
             b.close(drain=False)
-        _ok, report = http.health()
+        _ok, report = http.readiness()
         assert f"batcher:{rt.name}" not in report
 
     def test_shutdown_ordering_is_bounded(self):
