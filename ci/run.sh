@@ -22,7 +22,10 @@
 #                under MXNET_SANITIZE=donation,slots must finish with
 #                zero steady-state decode.compile_miss, zero leaked KV
 #                slots/pages after drain, >=1 mid-flight join, and zero
-#                sanitizer violations
+#                sanitizer violations; then a speculative-decoding drill
+#                (ngram drafter on a repetitive workload) — spec streams
+#                bitwise == non-spec, acceptance_rate > 0.3, zero misses
+#                / leaks / violations
 #   gateway    - HTTP front door smoke: test_gateway.py +
 #                test_aot_cache.py, then a 1000-request concurrent
 #                /v1/infer drill over real sockets under
@@ -326,6 +329,60 @@ print("decode smoke ok:", len(shared), "generate() calls,",
       snap.get("decode.joins"), "joins,",
       f"prefix_hit_rate {stats['prefix_hit_rate']},",
       "bitwise shared==cold, 0 misses, 0 leaks, sanitizer clean")
+PY
+  # speculative decoding drill: ngram self-drafting on a repetitive
+  # workload must (a) hand every request a token stream bitwise equal to
+  # the non-speculative run — greedy AND sampled — (b) accept > 30% of
+  # proposed draft tokens, (c) take zero steady-state compile misses and
+  # leak nothing, all under the donation+slots sanitizers
+  JAX_PLATFORMS=cpu MXNET_SANITIZE=donation,slots MXNET_TELEMETRY=1 \
+      python - <<'PY'
+import numpy as np
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.analysis import sanitizer
+from mxnet_tpu.serving.decode import (DecodeSession, NgramDrafter,
+                                      get_decode_model)
+
+net = get_decode_model("decode_tiny", vocab_size=96, max_length=64,
+                       units=32, num_heads=2)
+net.initialize()
+
+rng = np.random.RandomState(7)
+motifs = [list(rng.randint(1, 96, 4)) for _ in range(4)]
+reqs = [dict(prompt=motifs[i % 4] * 3,
+             max_new_tokens=10 + i % 6,
+             temperature=0.7 * (i % 3 == 0), seed=40 + i)
+        for i in range(12)]
+
+def run(drafter):
+    sess = DecodeSession(net, batch_buckets=(1, 2, 4), seq_buckets=(16,),
+                         page_size=8, drafter=drafter, spec_k=4,
+                         start=False)
+    telemetry.reset()
+    futs = [sess.submit(**r) for r in reqs]
+    sess.close(drain=True)
+    toks = [f.result().token_ids for f in futs]
+    snap = telemetry.snapshot()["counters"]
+    assert not snap.get("decode.compile_miss"), \
+        f"steady-state recompiles: {snap.get('decode.compile_miss')}"
+    assert sess.cache.pages_in_use == 0, "leaked KV pages"
+    assert sess.cache.slots_in_use == 0, "leaked KV slots"
+    return toks, snap
+
+plain, _ = run(None)
+spec, snap = run(NgramDrafter())
+assert spec == plain, "speculative streams diverged from non-speculative"
+prop = snap.get("decode.spec_proposed", 0)
+acc = snap.get("decode.spec_accepted", 0)
+assert prop > 0 and acc / prop > 0.3, \
+    f"acceptance too low on repetitive workload: {acc}/{prop}"
+assert snap.get("decode.spec_steps", 0) >= 1
+assert sanitizer.stats()["violations"] == 0, sanitizer.stats()
+print("speculative drill ok:", len(spec), "streams bitwise == non-spec,",
+      f"acceptance {acc}/{prop} = {acc / prop:.2f},",
+      snap.get("decode.spec_bonus", 0), "bonus tokens,",
+      "0 misses, 0 leaks, sanitizer clean")
 PY
 }
 

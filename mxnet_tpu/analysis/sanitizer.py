@@ -64,7 +64,8 @@ __all__ = ["SanitizerError", "DonatedBufferError", "StaleSlotError",
            "enable", "disable", "configure", "scope", "modes", "active",
            "donation", "slots", "collectives", "poison",
            "register_slot_view", "register_kv_slot", "check_kv_slot",
-           "check_kv_pages", "check_buffer", "stats", "reset"]
+           "check_kv_pages", "check_kv_write_span", "check_buffer",
+           "stats", "reset"]
 
 MODES = ("donation", "slots", "collectives")
 
@@ -371,6 +372,35 @@ def check_kv_pages(cache, slot):
         return
     for page, gen in zip(slot.pages, slot.page_gens):
         if cache.page_generation(page) != gen:
+            with _lock:
+                site = _kv_slots.get((id(cache), int(slot.slot_id)),
+                                     "<unregistered>")
+            _violation(StaleKVSlotError(site, slot.slot_id, page=page))
+
+
+def check_kv_write_span(cache, slot, position, n_tokens):
+    """Write fence for the speculative *verify* step: the fused program
+    is about to scatter candidate K/V at ``n_tokens`` consecutive
+    positions starting at ``position``.  Every page covering that span
+    must be generation-fresh AND exclusively owned by the slot
+    (refcount 1, unpinned) — a shared or recycled page here means the
+    verify scatter would scribble over a neighbour's (or the prefix
+    index's) K/V, which the single-token write fence
+    (:func:`check_kv_pages` + ``ensure_writable``) can't see because it
+    only covers the *current* position's page.  Span positions past the
+    slot's page table are legal: the program routes those writes to the
+    trash page.  Callers guard on ``sanitizer.slots``."""
+    if not slots:
+        return
+    ps = cache.page_size
+    first = int(position) // ps
+    last = (int(position) + max(int(n_tokens) - 1, 0)) // ps
+    for idx in range(first, min(last, len(slot.pages) - 1) + 1):
+        page = slot.pages[idx]
+        fresh = cache.page_generation(page) == slot.page_gens[idx]
+        shared = cache.prefix_sharing and (
+            cache._slot_refs[page] > 1 or cache._pin_refs[page] > 0)
+        if not fresh or shared:
             with _lock:
                 site = _kv_slots.get((id(cache), int(slot.slot_id)),
                                      "<unregistered>")

@@ -29,6 +29,12 @@ discipline (PAPER.md design point #2) to that loop:
   boundaries, finished sequences free their KV slots immediately, and
   the serving backpressure/deadline/circuit-breaker machinery carries
   over with KV exhaustion as a new shed condition.
+- :class:`NgramDrafter` / :class:`ModelDrafter` (``speculate.py``) —
+  speculative decoding over the fused per-bucket **verify** program:
+  a drafter proposes ``k`` tokens, one donated step scores them all,
+  and deterministic-equality acceptance commits the matching prefix —
+  the emitted stream stays bitwise-identical to non-speculative
+  decode (greedy and sampled), the draft only changes tokens/step.
 
 Minimal use::
 
@@ -52,7 +58,9 @@ from .model import (  # noqa: F401
     CausalLM,
     get_decode_model,
     kv_dequantize,
+    kv_dequantize_fp8,
     kv_quantize_rows,
+    kv_quantize_rows_fp8,
     rowdot,
 )
 from .runtime import DecodeRuntime, seq_bucket_ladder  # noqa: F401
@@ -62,10 +70,18 @@ from .scheduler import (  # noqa: F401
     GenerationResult,
     TokenStream,
 )
+from .speculate import (  # noqa: F401
+    Drafter,
+    ModelDrafter,
+    NgramDrafter,
+    SpecState,
+)
 
 __all__ = ["CausalLM", "get_decode_model", "rowdot",
            "kv_quantize_rows", "kv_dequantize",
+           "kv_quantize_rows_fp8", "kv_dequantize_fp8",
            "PagedKVCache", "KVSlot", "KVCacheExhausted", "pages_needed",
            "DecodeRuntime", "seq_bucket_ladder",
            "DecodeScheduler", "DecodeSession", "GenerationResult",
-           "TokenStream"]
+           "TokenStream",
+           "Drafter", "NgramDrafter", "ModelDrafter", "SpecState"]

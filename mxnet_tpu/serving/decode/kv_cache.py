@@ -210,23 +210,30 @@ class PagedKVCache:
         self.context_length = self.max_pages_per_seq * self.page_size
         self.dtype = str(dtype)
         kv_dtype = self.dtype if kv_dtype is None else str(kv_dtype)
-        kv_dtype = {"fp32": "float32", "float": "float32"}.get(
+        kv_dtype = {"fp32": "float32", "float": "float32",
+                    "fp8": "fp8_e4m3", "float8_e4m3fn": "fp8_e4m3"}.get(
             kv_dtype, kv_dtype)
-        if kv_dtype not in ("float32", "int8"):
+        if kv_dtype not in ("float32", "int8", "fp8_e4m3"):
             raise ValueError(
-                f"kv_dtype must be 'float32' or 'int8', got {kv_dtype!r}")
+                f"kv_dtype must be 'float32', 'int8' or 'fp8_e4m3', "
+                f"got {kv_dtype!r}")
         self.kv_dtype = kv_dtype
-        self.quantized = kv_dtype == "int8"
+        self.quantized = kv_dtype in ("int8", "fp8_e4m3")
+        # sidecar arity: int8 carries per-row (scale, mid) for K and V;
+        # fp8 e4m3 keeps sign+mantissa so a per-row scale alone suffices
+        self.num_sidecars = {"float32": 0, "int8": 4, "fp8_e4m3": 2}[
+            kv_dtype]
         self.prefix_sharing = bool(prefix_sharing)
         self._prefix_entry_cap = int(prefix_entries)
         shape = (self.num_layers, self.num_pages, self.page_size,
                  self.num_heads, self.head_dim)
-        pool_dtype = "int8" if self.quantized else self.dtype
+        pool_dtype = {"float32": self.dtype, "int8": "int8",
+                      "fp8_e4m3": "float8_e4m3fn"}[kv_dtype]
         k = jnp.zeros(shape, pool_dtype)
         v = jnp.zeros(shape, pool_dtype)
         qshape = shape[:3]
-        quant = (tuple(jnp.zeros(qshape, "float32") for _ in range(4))
-                 if self.quantized else ())
+        quant = tuple(jnp.zeros(qshape, "float32")
+                      for _ in range(self.num_sidecars))
         if mesh is not None:
             import jax
             from jax.sharding import NamedSharding, PartitionSpec
@@ -271,8 +278,10 @@ class PagedKVCache:
         """Device bytes one token position costs across K+V pools (all
         layers), including the int8 scale/zero sidecars."""
         row = self.num_heads * self.head_dim
-        if self.quantized:
-            per_layer = 2 * (row + 2 * 4)    # int8 values + scale/zero f32
+        if self.kv_dtype == "int8":
+            per_layer = 2 * (row + 2 * 4)    # int8 values + scale/mid f32
+        elif self.kv_dtype == "fp8_e4m3":
+            per_layer = 2 * (row + 4)        # fp8 values + scale f32
         else:
             per_layer = 2 * row * np.dtype(self.dtype).itemsize
         return self.num_layers * per_layer
@@ -286,7 +295,8 @@ class PagedKVCache:
     def pools(self):
         """Every device pool array the commit/step programs thread
         through (and donate): ``(k, v)`` in fp32, ``(k, v, k_scale,
-        k_zero, v_scale, v_zero)`` in int8."""
+        k_zero, v_scale, v_zero)`` in int8, ``(k, v, k_scale, v_scale)``
+        in fp8_e4m3."""
         return (self.k_pages, self.v_pages) + self._quant
 
     def set_pools(self, arrays):

@@ -35,7 +35,7 @@ from ...gluon.block import HybridBlock
 from ...ndarray import NDArray, invoke_fn
 
 __all__ = ["CausalLM", "get_decode_model", "rowdot", "kv_quantize_rows",
-           "kv_dequantize"]
+           "kv_dequantize", "kv_quantize_rows_fp8", "kv_dequantize_fp8"]
 
 
 def rowdot(x, w):
@@ -88,6 +88,70 @@ def kv_dequantize(q, scale, mid):
     ``q * scale + mid`` broadcast over the trailing ``(H, D)`` axes."""
     return (q.astype("float32") * scale[..., None, None]
             + mid[..., None, None])
+
+
+def kv_quantize_rows_fp8(x):
+    """fp8 (e4m3) quantization of K/V token rows ``x (..., H, D)`` —
+    per-row *scale only* (e4m3 keeps a sign bit and enough mantissa that
+    a symmetric absmax scale suffices; no ``mid``), reduced over the last
+    two axes.  Returns ``(q float8_e4m3fn, scale)`` with ``scale`` of
+    shape ``x.shape[:-2]``.  Row-stable like :func:`kv_quantize_rows`;
+    an all-zero row maps to ``scale = 0`` and dequantizes to exact 0."""
+    import jax.numpy as jnp
+    amax = jnp.abs(x).max(axis=(-2, -1))
+    scale = amax / 448.0                 # e4m3fn finite max
+    q = x / jnp.where(scale > 0, scale, 1.0)[..., None, None]
+    return q.astype(jnp.float8_e4m3fn), scale
+
+
+def kv_dequantize_fp8(q, scale):
+    """Inverse of :func:`kv_quantize_rows_fp8` — ``q * scale`` broadcast
+    over the trailing ``(H, D)`` axes."""
+    return q.astype("float32") * scale[..., None, None]
+
+
+def _kv_scatter(state, i, wp, woff, k, v):
+    """Write one layer's new K/V rows into the paged pools at
+    ``(page, offset)``, quantizing by sidecar arity: ``None`` = raw fp32,
+    2 sidecars = fp8 per-row scale, 4 = int8 per-row scale/mid.  ``wp`` /
+    ``woff`` may be ``(B,)`` (step) or ``(B, K+1)`` (verify); ``k`` / ``v``
+    carry matching leading axes plus trailing ``(H, D)``."""
+    qs = state["q"]
+    if qs is None:
+        state["k"] = state["k"].at[i, wp, woff].set(k)
+        state["v"] = state["v"].at[i, wp, woff].set(v)
+        return
+    if len(qs) == 2:
+        kq, ksc = kv_quantize_rows_fp8(k)
+        vq, vsc = kv_quantize_rows_fp8(v)
+        rows = (ksc, vsc)
+    else:
+        kq, ksc, kmd = kv_quantize_rows(k)
+        vq, vsc, vmd = kv_quantize_rows(v)
+        rows = (ksc, kmd, vsc, vmd)
+    state["k"] = state["k"].at[i, wp, woff].set(kq)
+    state["v"] = state["v"].at[i, wp, woff].set(vq)
+    for j, row in enumerate(rows):
+        qs[j] = qs[j].at[i, wp, woff].set(row)
+
+
+def _kv_gather(state, i, tables, B, lctx, H, D):
+    """Gather one layer's full paged context ``(B, lctx, H, D)`` for every
+    row, dequantizing through whichever sidecars the pool carries."""
+    def g(pool):
+        return pool[i][tables].reshape(B, lctx, H, D)
+
+    def side(j):
+        return state["q"][j][i][tables].reshape(B, lctx)
+
+    qs = state["q"]
+    if qs is None:
+        return g(state["k"]), g(state["v"])
+    if len(qs) == 2:
+        return (kv_dequantize_fp8(g(state["k"]), side(0)),
+                kv_dequantize_fp8(g(state["v"]), side(1)))
+    return (kv_dequantize(g(state["k"]), side(0), side(1)),
+            kv_dequantize(g(state["v"]), side(2), side(3)))
 
 
 class CausalLM(HybridBlock):
@@ -219,13 +283,13 @@ class CausalLM(HybridBlock):
         identical regardless of physical page placement), and returns the
         next-token logits.  Also returns the updated page arrays.
 
-        With ``quant`` — the ``(k_scale, k_mid, v_scale, v_mid)`` sidecar
-        pools of an int8 cache — the new token row is quantized before
-        the scatter (:func:`kv_quantize_rows`) and the gathered context
-        dequantized before the attention einsums
-        (:func:`kv_dequantize`); both are row-stable, so per-row bitwise
-        independence of batch composition holds in int8 exactly as in
-        fp32.  The updated sidecars are returned after the page arrays."""
+        With ``quant`` — the sidecar pools of a quantized cache:
+        ``(k_scale, k_mid, v_scale, v_mid)`` for int8, ``(k_scale,
+        v_scale)`` for fp8 — the new token row is quantized before the
+        scatter and the gathered context dequantized before the attention
+        einsums; both are row-stable, so per-row bitwise independence of
+        batch composition holds quantized exactly as in fp32.  The
+        updated sidecars are returned after the page arrays."""
         import jax
         import jax.numpy as jnp
         B = tokens.shape[0]
@@ -239,38 +303,83 @@ class CausalLM(HybridBlock):
         state = {"k": k_pages, "v": v_pages, "i": 0,
                  "q": list(quant) if quant is not None else None}
 
-        def gather(pool, i):
-            return pool[i][tables].reshape(B, lctx, H, D)
-
         def attend(q, k, v):
             i = state["i"]
             q = q.reshape(B, H, D)
-            k = k.reshape(B, H, D)
-            v = v.reshape(B, H, D)
-            if state["q"] is None:
-                state["k"] = state["k"].at[i, wp, woff].set(k)
-                state["v"] = state["v"].at[i, wp, woff].set(v)
-                kg = gather(state["k"], i)
-                vg = gather(state["v"], i)
-            else:
-                kq, ksc, kmd = kv_quantize_rows(k)
-                vq, vsc, vmd = kv_quantize_rows(v)
-                state["k"] = state["k"].at[i, wp, woff].set(kq)
-                state["v"] = state["v"].at[i, wp, woff].set(vq)
-                qs = state["q"]
-                for j, row in enumerate((ksc, kmd, vsc, vmd)):
-                    qs[j] = qs[j].at[i, wp, woff].set(row)
-                kg = kv_dequantize(gather(state["k"], i),
-                                   qs[0][i][tables].reshape(B, lctx),
-                                   qs[1][i][tables].reshape(B, lctx))
-                vg = kv_dequantize(gather(state["v"], i),
-                                   qs[2][i][tables].reshape(B, lctx),
-                                   qs[3][i][tables].reshape(B, lctx))
+            _kv_scatter(state, i, wp, woff,
+                        k.reshape(B, H, D), v.reshape(B, H, D))
+            kg, vg = _kv_gather(state, i, tables, B, lctx, H, D)
             s = jnp.einsum("bhd,blhd->bhl", q, kg)
             s = jnp.where(mask[:, None], s, -1e30)
             pr = jax.nn.softmax(s, axis=-1)
             state["i"] = i + 1
             return jnp.einsum("bhl,blhd->bhd", pr, vg).reshape(B, -1)
+
+        for i in range(self.num_layers):
+            h = self._layer(p, i, h, attend)
+        hf = _ln(h, p["lnf_g"], p["lnf_b"])
+        logits = rowdot(hf, p["embed"].T)
+        out = (logits, state["k"], state["v"])
+        return out if state["q"] is None else out + tuple(state["q"])
+
+    def verify_math(self, p, tokens, positions, n_draft, tables, k_pages,
+                    v_pages, page_size, quant=None):
+        """Pure fused speculative *verify*: ``K+1`` tokens per row in one
+        program.  ``tokens (B, K+1)`` is ``[cur, d_1 .. d_K]`` — the row's
+        current token followed by its drafted continuation, padded past
+        ``n_draft (B,)`` — at positions ``positions + (0 .. K)``.
+
+        Per layer the program scatters all ``K+1`` candidate K/V rows into
+        the row's own reserved pages (offsets past ``n_draft``, or past the
+        page-table range, are routed to trash page 0), gathers the same
+        fixed-length paged context the single-token step gathers, and
+        attends with a causal mask *extension*: query offset ``j`` sees
+        context positions ``<= positions + j`` — which includes the
+        candidate K/V written at offsets ``< j`` this very call.  By
+        induction over offsets and layers, offset ``j``'s logits are
+        bitwise what the non-speculative step would produce after emitting
+        ``d_1 .. d_j`` — the property the deterministic acceptance rule in
+        the runtime's verify program builds on.  Returns
+        ``(logits (B, K+1, V), k_pages, v_pages[, sidecars...])``.
+
+        Rejected candidates need no explicit rollback: their K/V sits at
+        positions strictly greater than the row's post-verify position, so
+        every later query masks them until they are overwritten by the
+        next boundary's writes at those same positions."""
+        import jax
+        import jax.numpy as jnp
+        B, K1 = tokens.shape
+        H, D = self.num_heads, self.head_dim
+        n_tab = tables.shape[1]
+        lctx = n_tab * page_size
+        offs = jnp.arange(K1, dtype="int32")[None, :]
+        pos = positions[:, None] + offs                       # (B, K+1)
+        h = (p["embed"][tokens]
+             + p["pos_embed"][jnp.minimum(pos, self.max_length - 1)])
+        page_idx = pos // page_size
+        owned = jnp.take_along_axis(
+            tables, jnp.minimum(page_idx, n_tab - 1), axis=1)
+        # invalid offsets (padding past n_draft, or positions past the
+        # row's reserved pages) write to trash page 0 — never into a
+        # neighbour's (or this row's own committed) pages
+        valid = (offs <= n_draft[:, None]) & (page_idx < n_tab)
+        wp = jnp.where(valid, owned, 0)
+        woff = pos % page_size
+        mask = jnp.arange(lctx)[None, None, :] <= pos[:, :, None]
+        state = {"k": k_pages, "v": v_pages, "i": 0,
+                 "q": list(quant) if quant is not None else None}
+
+        def attend(q, k, v):
+            i = state["i"]
+            q = q.reshape(B, K1, H, D)
+            _kv_scatter(state, i, wp, woff,
+                        k.reshape(B, K1, H, D), v.reshape(B, K1, H, D))
+            kg, vg = _kv_gather(state, i, tables, B, lctx, H, D)
+            s = jnp.einsum("bqhd,blhd->bhql", q, kg)
+            s = jnp.where(mask[:, None], s, -1e30)
+            pr = jax.nn.softmax(s, axis=-1)
+            state["i"] = i + 1
+            return jnp.einsum("bhql,blhd->bqhd", pr, vg).reshape(B, K1, -1)
 
         for i in range(self.num_layers):
             h = self._layer(p, i, h, attend)
@@ -289,13 +398,22 @@ class CausalLM(HybridBlock):
         import jax
         import jax.numpy as jnp
         greedy = jnp.argmax(logits, -1).astype("int32")
-        folded = jax.vmap(jax.random.fold_in)(keys, steps)
-        u = jax.vmap(lambda kk: jax.random.uniform(
-            kk, (logits.shape[-1],), minval=1e-7, maxval=1.0))(folded)
-        g = -jnp.log(-jnp.log(u))
-        t = jnp.where(temps > 0, temps, 1.0)[:, None]
-        sampled = jnp.argmax(logits / t + g, -1).astype("int32")
-        return jnp.where(temps > 0, sampled, greedy)
+
+        def with_gumbel(_):
+            folded = jax.vmap(jax.random.fold_in)(keys, steps)
+            u = jax.vmap(lambda kk: jax.random.uniform(
+                kk, (logits.shape[-1],), minval=1e-7, maxval=1.0))(folded)
+            g = -jnp.log(-jnp.log(u))
+            t = jnp.where(temps > 0, temps, 1.0)[:, None]
+            sampled = jnp.argmax(logits / t + g, -1).astype("int32")
+            return jnp.where(temps > 0, sampled, greedy)
+
+        # all-greedy batches skip the Gumbel streams entirely (threefry
+        # is the hot op at decode shapes); any sampled row takes the
+        # full branch, whose per-row folds are untouched — either way
+        # the returned tokens are bitwise the unconditional computation
+        return jax.lax.cond(jnp.any(temps > 0), with_gumbel,
+                            lambda _: greedy, None)
 
     # ------------------------------------------------------- gluon frontend
     def hybrid_forward(self, F, tokens, lengths, **params):

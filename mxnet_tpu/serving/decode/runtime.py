@@ -95,7 +95,8 @@ class DecodeRuntime:
     def __init__(self, block, cache=None, batch_buckets=(1, 2, 4, 8),
                  seq_buckets=None, page_size=16, num_pages=None,
                  max_slots=None, kv_dtype=None, prefix_sharing=True,
-                 mesh=None, name=None, warm=True, aot_cache=None):
+                 mesh=None, name=None, warm=True, aot_cache=None,
+                 spec_buckets=()):
         if not getattr(block, "_active", False):
             block.hybridize()
         self._block = block
@@ -142,6 +143,20 @@ class DecodeRuntime:
                 f"seq buckets {self.seq_buckets} exceed the cache context "
                 f"({cache.context_length} tokens)")
         self.max_prompt_len = self.seq_buckets[-1]
+        # speculative-decode ladder: one fused verify program per
+        # (batch bucket, k bucket) — empty tuple means no speculative
+        # programs are built or warmed (zero cost for plain decode)
+        self.spec_buckets = tuple(sorted(set(
+            int(k) for k in spec_buckets)))
+        if self.spec_buckets and self.spec_buckets[0] < 1:
+            raise ValueError(
+                f"spec buckets {self.spec_buckets} must be >= 1")
+        if self.spec_buckets and \
+                self.spec_buckets[-1] >= cache.context_length:
+            raise ValueError(
+                f"spec bucket cap {self.spec_buckets[-1]} exceeds the "
+                f"cache context ({cache.context_length} tokens)")
+        self.max_spec_k = self.spec_buckets[-1] if self.spec_buckets else 0
         self._params = block.param_leaves()
         # sharded cache: the page pools live distributed over the mesh,
         # while the block's params (and the CachedOp prefill outputs) are
@@ -157,6 +172,7 @@ class DecodeRuntime:
             self._replicate = lambda x: jax.device_put(x, rep)
         self._step_fns = {}       # batch_bucket -> donated jit
         self._commit_fns = {}     # (batch_bucket, seq_bucket) -> donated jit
+        self._verify_fns = {}     # (batch_bucket, spec_k) -> donated jit
         self._sample_fn = None    # batch-1 first-token sampler (prefix hits)
         self._prefill_sigs = set()
         # every piece of serving geometry below shapes a compiled program
@@ -169,7 +185,8 @@ class DecodeRuntime:
             salt=f"decode:{self.batch_buckets}:{self.seq_buckets}"
                  f":pg{cache.page_size}:np{cache.num_pages}"
                  f":mp{cache.max_pages_per_seq}:sl{cache.max_slots}"
-                 f":kv{cache.kv_dtype}:pfx{cache.prefix_sharing}")
+                 f":kv{cache.kv_dtype}:pfx{cache.prefix_sharing}"
+                 f":spec{self.spec_buckets}")
         self._warmed = False
         if warm:
             self.warm()
@@ -192,6 +209,17 @@ class DecodeRuntime:
         raise ValueError(
             f"prompt of {n} tokens exceeds the largest seq bucket "
             f"{self.max_prompt_len}")
+
+    def spec_bucket_for(self, k):
+        """Smallest warmed verify-k bucket covering ``k`` drafted tokens
+        (callers clamp per-row k to ``max_spec_k``, so the cap always
+        covers)."""
+        for kb in self.spec_buckets:
+            if kb >= k:
+                return kb
+        raise ValueError(
+            f"draft of {k} tokens exceeds the spec bucket cap "
+            f"{self.max_spec_k}")
 
     # --------------------------------------------------------------- warmup
     def warm(self):
@@ -229,6 +257,18 @@ class DecodeRuntime:
                           np.zeros((b, np_), "int32"),
                           np.zeros((b, 2), "uint32"),
                           np.zeros((b,), "int32"), np.zeros((b,), "float32"))
+            # speculative verify ladder: one fused program per (batch, k)
+            # bucket, driven with n_draft=0 against all-trash tables —
+            # exactly like the step programs above
+            for b in self.batch_buckets:
+                for k in self.spec_buckets:
+                    self.verify(np.zeros((b, k + 1), "int32"),
+                                np.zeros((b,), "int32"),
+                                np.zeros((b,), "int32"),
+                                np.zeros((b, np_), "int32"),
+                                np.zeros((b, 2), "uint32"),
+                                np.zeros((b,), "int32"),
+                                np.zeros((b,), "float32"))
             # the two programs OUTSIDE the bucket grid: the batch-1
             # first-token sampler (prefix-hit admissions) and the cache's
             # CoW page copy — drive both so no prefix hit compiles
@@ -241,7 +281,8 @@ class DecodeRuntime:
         self._warmed = True
         if _tel.enabled:
             _tel.count("decode.warmup_compiles",
-                       2 * len(grid) + len(self.batch_buckets),
+                       2 * len(grid) + len(self.batch_buckets)
+                       * (1 + len(self.spec_buckets)),
                        model=self.name)
 
     def _warm_aot(self, grid):
@@ -264,6 +305,19 @@ class DecodeRuntime:
             fn, _, _ = pc.load_or_build(
                 f"step-b{b}", self._build_step(), args)
             self._step_fns[b] = fn
+        for b in self.batch_buckets:
+            for k in self.spec_buckets:
+                if (b, k) in self._verify_fns:
+                    continue
+                args = (self._params, np.zeros((b, k + 1), "int32"),
+                        np.zeros((b,), "int32"), np.zeros((b,), "int32"),
+                        np.zeros((b, np_), "int32"),
+                        np.zeros((b, 2), "uint32"),
+                        np.zeros((b,), "int32"),
+                        np.zeros((b,), "float32")) + pools
+                fn, _, _ = pc.load_or_build(
+                    f"verify-b{b}-k{k}", self._build_verify(), args)
+                self._verify_fns[(b, k)] = fn
         for b, s in grid:
             if (b, s) in self._commit_fns:
                 continue
@@ -328,6 +382,58 @@ class DecodeRuntime:
 
         n = len(self.cache.pools)
         return jax.jit(step, donate_argnums=tuple(range(7, 7 + n)))
+
+    def _verify_fn(self, bucket_b, bucket_k):
+        key = (bucket_b, bucket_k)
+        fn = self._verify_fns.get(key)
+        if fn is None:
+            if self._warmed:
+                self._miss("verify", key)
+            fn = self._build_verify()
+            self._verify_fns[key] = fn
+        return fn
+
+    def _build_verify(self):
+        """The fused speculative verify program: score ``k`` drafted
+        tokens (plus the current one) in ONE donated call, sample the
+        target's token at every offset through the per-request
+        ``fold_in(key, step + j)`` streams, and count the accepted
+        prefix — never a Python loop per token.
+
+        Acceptance is *deterministic equality*: offset ``j``'s target
+        sample uses exactly the fold the non-speculative step ``j``
+        would, over bitwise the same logits (see
+        :meth:`CausalLM.verify_math`), so the emitted stream —
+        ``target[0 .. n_acc]`` — is always bitwise the non-speculative
+        stream, for greedy AND sampled temperatures."""
+        import jax
+        import jax.numpy as jnp
+        block, page_size = self._block, self.cache.page_size
+        quantized = self.cache.quantized
+
+        def verify(params, tokens, positions, n_draft, tables, keys,
+                   steps, temps, *pools):
+            p = block._params_dict(params)
+            out = block.verify_math(
+                p, tokens, positions, n_draft, tables, pools[0], pools[1],
+                page_size, quant=pools[2:] if quantized else None)
+            B, K1 = tokens.shape
+            flat = out[0].reshape(B * K1, -1)
+            # per-offset fold: row (b, j) samples with (key_b, step_b + j)
+            # — bitwise the fold non-speculative step j would use
+            target = block.sample_math(
+                flat, jnp.repeat(keys, K1, axis=0),
+                (steps[:, None]
+                 + jnp.arange(K1, dtype="int32")[None, :]).reshape(-1),
+                jnp.repeat(temps, K1)).reshape(B, K1)
+            ok = ((tokens[:, 1:] == target[:, :-1])
+                  & (jnp.arange(1, K1, dtype="int32")[None, :]
+                     <= n_draft[:, None]))
+            n_acc = jnp.cumprod(ok.astype("int32"), axis=1).sum(axis=1)
+            return (target, n_acc) + tuple(out[1:])
+
+        n = len(self.cache.pools)
+        return jax.jit(verify, donate_argnums=tuple(range(8, 8 + n)))
 
     def _build_commit(self):
         import jax
@@ -428,6 +534,33 @@ class DecodeRuntime:
                 _san.poison(list(pools), "decode.step")
             cache.set_pools(out[1:])
         return np.asarray(out[0])
+
+    def verify(self, tokens, positions, n_draft, tables, keys, steps,
+               temps):
+        """One fused speculative verify step for a batch padded to a
+        batch bucket.  ``tokens (B, K+1)`` is ``[cur, d_1 .. d_K]`` per
+        row (draft columns past ``n_draft`` padded with 0; rows that are
+        not speculating this boundary ride with ``n_draft = 0`` — their
+        result is bitwise the plain step's).  Returns host arrays
+        ``(target (B, K+1) int32, n_acc (B,) int32)``: the target-model
+        samples at every offset and the accepted-draft count — the row's
+        emitted tokens are ``target[:n_acc + 1]``."""
+        b, k1 = tokens.shape
+        fn = self._verify_fn(b, k1 - 1)
+        with _tel.span("decode.verify", model=self.name, batch=b,
+                       k=k1 - 1):
+            cache = self.cache
+            pools = cache.pools
+            out = fn(
+                self._params, tokens.astype("int32"),
+                positions.astype("int32"), n_draft.astype("int32"),
+                tables.astype("int32"), keys.astype("uint32"),
+                steps.astype("int32"), temps.astype("float32"), *pools)
+            if _san.donation:
+                # the verify donated the page pools (see step above)
+                _san.poison(list(pools), "decode.verify")
+            cache.set_pools(out[2:])
+        return np.asarray(out[0]), np.asarray(out[1])
 
     def sample_first(self, logits_row, key, temp):
         """Sample a prefix-hit admission's first token from the cached

@@ -47,9 +47,12 @@ from ...telemetry import trace as _trace
 from ..batcher import RequestRejected
 from .kv_cache import KVCacheExhausted, pages_needed
 from .runtime import DecodeRuntime
+from .speculate import SpecState, resolve_drafter
 
 __all__ = ["DecodeScheduler", "DecodeSession", "GenerationResult",
            "TokenStream"]
+
+_NO_DRAFT = np.zeros((0,), "int32")
 
 
 class TokenStream:
@@ -186,7 +189,7 @@ class _Request:
     __slots__ = ("prompt", "max_new", "temp", "key", "eos_id", "deadline",
                  "future", "t_submit", "n_pages", "slot", "tokens",
                  "position", "step_idx", "cur", "ttft_ms", "ctx", "lane",
-                 "sink", "aborted")
+                 "sink", "aborted", "spec", "spec_state")
 
     def __init__(self, prompt, max_new, temp, key, eos_id, deadline,
                  t_submit, n_pages):
@@ -217,6 +220,11 @@ class _Request:
         # aborted: client hung up / cancelled a RUNNING request; swept
         # out of the batch (slot freed) at the next step boundary
         self.aborted = False
+        # spec: this request rides the speculative verify path (degrades
+        # to False if the drafter fails to attach); spec_state carries
+        # the adaptive per-request spec_k + acceptance window
+        self.spec = False
+        self.spec_state = None
 
 
 class DecodeScheduler:
@@ -235,14 +243,40 @@ class DecodeScheduler:
     breaker_threshold / breaker_cooldown_ms
         Circuit breaker on consecutive prefill/step failures (None
         disables) — same semantics as ``serving.Batcher``.
+    drafter : Drafter | "ngram" | CausalLM | None
+        Enables speculative decoding: requests ride the fused verify
+        program with this drafter's proposals (the runtime must have
+        been built with ``spec_buckets``).  Output streams stay bitwise
+        identical to non-speculative decode — acceptance is
+        deterministic-equality against the target's own fold_in sample
+        stream, so the drafter only ever changes tokens *per step*.
+    spec_k : int | None
+        Initial per-request draft length (adapts within
+        ``[1, runtime.max_spec_k]`` from each request's windowed
+        acceptance rate); default: the runtime's largest spec bucket.
     """
 
     def __init__(self, runtime, queue_depth=256, start=True,
-                 breaker_threshold=8, breaker_cooldown_ms=1000.0):
+                 breaker_threshold=8, breaker_cooldown_ms=1000.0,
+                 drafter=None, spec_k=None):
         if not isinstance(runtime, DecodeRuntime):
             raise TypeError(f"need a DecodeRuntime, got {type(runtime)}")
         self._runtime = runtime
         self._cache = runtime.cache
+        self._drafter = resolve_drafter(drafter)
+        if self._drafter is not None and not runtime.spec_buckets:
+            raise ValueError(
+                "speculative decoding needs a runtime built with "
+                "spec_buckets (the verify-program ladder); got none")
+        self._spec_k0 = runtime.max_spec_k if spec_k is None \
+            else int(spec_k)
+        if self._drafter is not None and not \
+                (1 <= self._spec_k0 <= runtime.max_spec_k):
+            raise ValueError(
+                f"spec_k must be in [1, {runtime.max_spec_k}], "
+                f"got {self._spec_k0}")
+        if self._drafter is not None:
+            self._drafter.bind(runtime)
         if int(queue_depth) < 1:
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
         self.queue_depth = int(queue_depth)
@@ -275,9 +309,14 @@ class DecodeScheduler:
 
     # --------------------------------------------------------------- client
     def submit(self, prompt, max_new_tokens=16, temperature=0.0, seed=0,
-               eos_id=None, deadline_ms=None, sink=None):
+               eos_id=None, deadline_ms=None, sink=None, speculate=None):
         """Enqueue one generation request; returns a Future resolving to a
         :class:`GenerationResult`.
+
+        ``speculate`` opts one request in/out of the speculative verify
+        path (default: speculate iff the scheduler has a drafter).  The
+        token stream is bitwise-identical either way — speculation only
+        changes how many tokens each step commits.
 
         Malformed requests (empty prompt, out-of-range ids, a prompt +
         budget that overflows the context window) raise synchronously.  A
@@ -318,8 +357,16 @@ class DecodeScheduler:
         key = np.array([seed >> 32, seed & 0xffffffff], "uint32")
         deadline = (t_submit + deadline_ms / 1e3
                     if deadline_ms is not None else None)
+        if speculate and self._drafter is None:
+            raise ValueError(
+                "speculate=True but the scheduler has no drafter")
         req = _Request(prompt, max_new, float(temperature), key,
                        eos_id, deadline, t_submit, n_pages)
+        req.spec = (self._drafter is not None if speculate is None
+                    else bool(speculate))
+        if req.spec:
+            req.spec_state = SpecState(self._spec_k0,
+                                       self._runtime.max_spec_k)
         req.sink = sink
         if sink is not None:
             # the sink's cancel() reaches back here once the request is
@@ -604,6 +651,19 @@ class DecodeScheduler:
         rt = self._runtime
         groups = {}
         for req in joining:
+            if req.spec:
+                # a failing drafter degrades the request to plain decode
+                # (bitwise the same stream, just one token per step) —
+                # drafts are never worth failing a request over
+                try:
+                    self._drafter.attach(req)
+                except Exception as e:
+                    req.spec = False
+                    _flight.record("decode.spec_degraded",
+                                   detail=f"{rt.name}: {e!r}")
+                    if _tel.enabled:
+                        _tel.count("decode.spec_degraded", model=rt.name)
+        for req in joining:
             if req.slot.prefix_logits is not None:
                 self._admit_prefix_hit(req)
             else:
@@ -713,13 +773,22 @@ class DecodeScheduler:
 
     def _step(self):
         """One decode step over the active batch, padded to a batch
-        bucket.  Injectable mid-decode crash: ``decode.step``."""
+        bucket.  Injectable mid-decode crash: ``decode.step``.
+
+        With a drafter bound, boundaries where at least one active row
+        produced a draft ride the fused verify program instead
+        (:meth:`_spec_step`) — non-speculating rows ride along with
+        ``n_draft = 0``, which is bitwise the plain step for them."""
         rt, cache = self._runtime, self._cache
         if _faults.active:
             _faults.check("decode.step")
         if _san.slots:
             for req in self._active:
                 cache.check_slot(req.slot)
+        drafts = self._collect_drafts()
+        if drafts is not None:
+            self._spec_step(drafts)
+            return
         if cache.prefix_sharing:
             # copy-on-write fence: the page each row is about to write
             # must be exclusively owned.  Admission already privatized
@@ -775,6 +844,140 @@ class DecodeScheduler:
         self._active = still
         self._consecutive_failures = 0
 
+    def _collect_drafts(self):
+        """Per-row draft proposals for this boundary, or ``None`` when
+        nobody speculates (no drafter, every row opted out / budget-
+        capped to zero, the drafter errored, or every draft came back
+        empty) — the caller then runs the plain step program."""
+        if self._drafter is None:
+            return None
+        ks = []
+        for req in self._active:
+            k = 0
+            if req.spec:
+                # budget cap: the verify commits at most k+1 tokens, so
+                # k never exceeds the remaining budget minus one — the
+                # last written position stays inside the page
+                # reservation (prompt + max_new - 2)
+                k = min(req.spec_state.k,
+                        req.max_new - len(req.tokens) - 1)
+            ks.append(max(k, 0))
+        if not any(ks):
+            return None
+        try:
+            proposed = self._drafter.propose_batch(self._active, ks)
+        except Exception as e:
+            _flight.record("decode.spec_draft_failure",
+                           detail=f"{self._runtime.name}: {e!r}")
+            if _tel.enabled:
+                _tel.count("decode.spec_draft_failures",
+                           model=self._runtime.name)
+            return None
+        vocab = self._runtime.block.vocab_size
+        drafts, any_draft = [], False
+        for d, k in zip(proposed, ks):
+            d = np.asarray(d, "int32").reshape(-1)[:k]
+            if d.size and (d.min() < 0 or d.max() >= vocab):
+                d = _NO_DRAFT      # drafter bug: ids outside the vocab
+            drafts.append(d)
+            any_draft = any_draft or d.size > 0
+        return drafts if any_draft else None
+
+    def _spec_step(self, drafts):
+        """One fused draft-verify step: write candidate K/V, score all
+        drafted positions against the target's own deterministic sample
+        stream, commit the accepted prefix plus the target's token at
+        the first mismatch (or the bonus token when everything matched).
+        Rolled-back K/V needs no cleanup — positions past the new
+        ``req.position`` stay causally masked until a later boundary
+        overwrites them."""
+        rt, cache = self._runtime, self._cache
+        n = len(self._active)
+        kb = rt.spec_bucket_for(max(d.size for d in drafts))
+        b = rt.batch_bucket_for(n)
+        tokens = np.zeros((b, kb + 1), "int32")
+        positions = np.zeros((b,), "int32")
+        n_draft = np.zeros((b,), "int32")
+        tables = np.zeros((b, cache.max_pages_per_seq), "int32")
+        keys = np.zeros((b, 2), "uint32")
+        steps = np.zeros((b,), "int32")
+        temps = np.zeros((b,), "float32")
+        for r, (req, d) in enumerate(zip(self._active, drafts)):
+            tokens[r, 0] = req.cur
+            if d.size:
+                tokens[r, 1:1 + d.size] = d
+            positions[r] = req.position
+            n_draft[r] = d.size
+            tables[r] = req.slot.page_table
+            keys[r] = req.key
+            steps[r] = req.step_idx
+            temps[r] = req.temp
+            if cache.prefix_sharing:
+                # the verify writes positions [position, position + k]:
+                # privatize EVERY page that span touches, not just the
+                # current one (a draft can cross a page boundary)
+                first = req.position // cache.page_size
+                last = (req.position + int(d.size)) // cache.page_size
+                for idx in range(first, last + 1):
+                    cache.ensure_writable(req.slot, idx)
+            if _san.slots:
+                _san.check_kv_write_span(cache, req.slot, req.position,
+                                         int(d.size) + 1)
+        _flight.record("decode.spec_verify", detail=rt.name, value=n)
+        t0 = time.perf_counter()
+        target, n_acc = rt.verify(tokens, positions, n_draft, tables,
+                                  keys, steps, temps)
+        t1 = time.perf_counter()
+        committed = 0
+        still = []
+        for r, (req, d) in enumerate(zip(self._active, drafts)):
+            m = int(n_acc[r])
+            finished = False
+            for t in target[r, :m + 1]:
+                req.cur = int(t)
+                req.tokens.append(req.cur)
+                if req.sink is not None:
+                    req.sink._put(req.cur)
+                req.position += 1
+                req.step_idx += 1
+                committed += 1
+                if self._is_finished(req):
+                    finished = True
+                    break          # eos mid-commit: drop the tail
+            if d.size:
+                req.spec_state.observe(int(d.size), m)
+                if _tel.enabled:
+                    _tel.count("decode.spec_proposed", int(d.size),
+                               model=rt.name)
+                    _tel.count("decode.spec_accepted", m, model=rt.name)
+                    if m == d.size:
+                        _tel.count("decode.spec_bonus", model=rt.name)
+                    _tel.observe("decode.spec_accept_rate",
+                                 m / int(d.size))
+            if finished:
+                self._finish(req)
+            else:
+                if d.size:
+                    try:
+                        self._drafter.observe(req, int(d.size), m)
+                    except Exception:
+                        req.spec = False
+                still.append(req)
+        if _tel.enabled:
+            _tel.count("decode.steps", model=rt.name)
+            _tel.count("decode.spec_steps", model=rt.name)
+            _tel.count("decode.tokens", committed, model=rt.name)
+            _tel.observe("decode.step_ms", (t1 - t0) * 1e3)
+            _tel.observe("decode.spec_tokens_per_step", committed / n)
+            for req in self._active:
+                if req.ctx is not None:
+                    _tel.record_span("decode.ride_step", t0, t1,
+                                     tid=req.lane, trace=req.ctx,
+                                     model=rt.name, batch=n,
+                                     spec_k=int(kb))
+        self._active = still
+        self._consecutive_failures = 0
+
     @staticmethod
     def _is_finished(req):
         if req.eos_id is not None and req.cur == req.eos_id:
@@ -796,6 +999,11 @@ class DecodeScheduler:
         """Free a sequence's KV slot the moment it leaves the batch —
         continuous batching's whole point is that the next arrival can
         take these pages at the very next boundary."""
+        if req.spec and self._drafter is not None:
+            try:
+                self._drafter.detach(req)
+            except Exception:
+                pass          # a leaky drafter must not block eviction
         if req.slot is not None:
             self._cache.free(req.slot)
             req.slot = None
@@ -906,15 +1114,25 @@ class DecodeSession:
                  page_size=16, num_pages=None, max_slots=None,
                  kv_dtype=None, prefix_sharing=True, mesh=None,
                  queue_depth=256, warm=True, start=True, aot_cache=None,
+                 drafter=None, spec_k=4, spec_buckets=None,
                  **scheduler_kwargs):
+        if spec_buckets is None:
+            # a drafter implies speculative decoding: one verify bucket
+            # wide enough for the requested spec_k (adaptive per-request
+            # k stays within it)
+            spec_buckets = (int(spec_k),) if drafter is not None else ()
         self.runtime = DecodeRuntime(
             block, batch_buckets=batch_buckets, seq_buckets=seq_buckets,
             page_size=page_size, num_pages=num_pages, max_slots=max_slots,
             kv_dtype=kv_dtype, prefix_sharing=prefix_sharing,
-            mesh=mesh, warm=warm, aot_cache=aot_cache)
+            mesh=mesh, warm=warm, aot_cache=aot_cache,
+            spec_buckets=spec_buckets)
         self.cache = self.runtime.cache
         self.scheduler = DecodeScheduler(
             self.runtime, queue_depth=queue_depth, start=start,
+            drafter=drafter,
+            spec_k=(min(int(spec_k), self.runtime.max_spec_k)
+                    if drafter is not None else None),
             **scheduler_kwargs)
 
     def submit(self, prompt, **kwargs):

@@ -1,0 +1,36 @@
+"""Standalone gateway front-end process for the multi-front-end drill.
+
+Builds a proxy :class:`Gateway` over an EXISTING fleet owner socket (it
+does not spawn or supervise the owner — that is the parent's
+supervisor's job), prints its bound HTTP port as one JSON line on
+stdout, then serves until stdin closes.  Running two of these against
+one socket is the scale-out topology: N stateless HTTP front doors, one
+device-owning process, crash domains fully separated.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--socket", required=True,
+                    help="AF_UNIX path of the running fleet owner")
+    ap.add_argument("--capacity", type=int, default=64)
+    args = ap.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from mxnet_tpu.serving.gateway import Gateway
+    gw = Gateway(owner=args.socket, capacity=args.capacity,
+                 name=f"frontend-{os.getpid()}")
+    print(json.dumps({"port": gw.port, "pid": os.getpid()}), flush=True)
+    # serve until the parent closes our stdin (or kills us)
+    while sys.stdin.readline():
+        pass
+    gw.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -828,3 +828,398 @@ def test_prefix_hit_skips_prefill_telemetry(runtime):
     assert c.get("decode.prefill_skips", 0) >= 1       # second skipped
     assert c.get("decode.prefix_hits", 0) >= 1
     assert c.get("decode.compile_miss") in (None, 0)   # fast path warmed
+
+
+# -------------------------------------------------- fp8 KV pools (ISSUE 20)
+def test_fp8_quantize_roundtrip_row_stable():
+    import jax.numpy as jnp
+    from mxnet_tpu.serving.decode import (kv_dequantize_fp8,
+                                          kv_quantize_rows_fp8)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 7, 2, 16).astype("float32"))
+    q, scale = kv_quantize_rows_fp8(x)
+    assert q.dtype == jnp.float8_e4m3fn and scale.shape == (4, 7)
+    xr = np.asarray(kv_dequantize_fp8(q, scale))
+    xn = np.asarray(x)
+    # e4m3 keeps 3 mantissa bits: relative error <= 2^-4 in the normal
+    # range, absolute error bounded by the row scale in the subnormals
+    err = np.abs(xr - xn)
+    bound = np.abs(xn) / 16.0 + np.asarray(scale)[..., None, None] * 2e-3
+    assert (err <= bound + 1e-7).all(), float((err - bound).max())
+    # row stability: a row's codes don't depend on its neighbors
+    q2, s2 = kv_quantize_rows_fp8(x[1:3])
+    assert (np.asarray(q2).view("uint8")
+            == np.asarray(q[1:3]).view("uint8")).all()
+    assert (np.asarray(s2) == np.asarray(scale[1:3])).all()
+    # all-zero rows (trash page) dequantize to exactly 0.0
+    qz, sz = kv_quantize_rows_fp8(jnp.zeros((1, 2, 16)))
+    assert (np.asarray(kv_dequantize_fp8(qz, sz)) == 0.0).all()
+
+
+def test_fp8_pool_geometry_between_fp32_and_int8():
+    """fp8 stores 1-byte values with ONE f32 sidecar row per pool
+    (absmax scale — no midpoint), vs int8's two (scale + mid): fp8
+    pages are strictly cheaper than int8 pages and far cheaper than
+    fp32."""
+    def mk(kvd):
+        return PagedKVCache(2, 2, 16, page_size=8, num_pages=4,
+                            max_pages_per_seq=2, max_slots=2,
+                            kv_dtype=kvd)
+    fp32, fp8, i8 = mk(None), mk("fp8_e4m3"), mk("int8")
+    assert fp8.kv_bytes_per_token < fp32.kv_bytes_per_token
+    assert fp8.num_sidecars == 2 and i8.num_sidecars == 4
+    assert fp8.kv_bytes_per_token < i8.kv_bytes_per_token
+    assert fp8.page_bytes < i8.page_bytes
+    # the pools really are fp8
+    import jax.numpy as jnp
+    k_pool = fp8.pools[0]
+    assert k_pool.dtype == jnp.float8_e4m3fn
+
+
+@pytest.fixture(scope="module")
+def fp8_session():
+    net = get_decode_model("decode_tiny", vocab_size=VOCAB, max_length=32,
+                           units=32, num_heads=2)
+    net.initialize()
+    from mxnet_tpu.serving.decode import DecodeSession
+    sess = DecodeSession(net, batch_buckets=(1, 2), seq_buckets=(8, 16),
+                         page_size=8, kv_dtype="fp8_e4m3")
+    yield sess
+    sess.close(drain=False)
+
+
+def test_fp8_session_deterministic_and_shared(fp8_session):
+    sess = fp8_session
+    assert sess.cache.quantized and sess.stats()["kv_dtype"] == "fp8_e4m3"
+    p = _prompt(3, 6, 12)
+    r1 = sess.generate(p, max_new_tokens=5, temperature=0.8, seed=4,
+                       timeout=120)
+    r2 = sess.generate(p, max_new_tokens=5, temperature=0.8, seed=4,
+                       timeout=120)
+    # fp8 quantization is elementwise-deterministic: the shared-vs-cold
+    # bitwise contract holds exactly like fp32/int8 (r2 rode the index)
+    assert r1.token_ids == r2.token_ids
+    assert sess.stats()["prefix_hits"] >= 1
+    assert sess.cache.pages_in_use == 0
+
+
+# ------------------------------------- speculative decoding (ISSUE 20)
+from mxnet_tpu.serving.decode import (Drafter, ModelDrafter,  # noqa: E402
+                                      NgramDrafter, SpecState)
+
+
+@pytest.fixture(scope="module")
+def spec_runtime():
+    """One warmed speculative runtime (verify ladder k=3) shared by the
+    whole speculative block — its own net so reference schedulers built
+    on it are exactly comparable."""
+    net = get_decode_model("decode_tiny", vocab_size=VOCAB, max_length=32,
+                           units=32, num_heads=2)
+    net.initialize()
+    rt = DecodeRuntime(net, batch_buckets=(1, 2, 4), seq_buckets=(8, 16),
+                       page_size=8, spec_buckets=(3,))
+    yield rt
+
+
+def _rep_prompt(i, n=9):
+    """Motif-cycling prompt — the workload prompt-lookup drafting eats."""
+    rng = np.random.RandomState(2000 + i)
+    motif = list(rng.randint(1, VOCAB, 3))
+    return (motif * ((n // 3) + 1))[:n]
+
+
+def _spec_reqs(n=10):
+    return [dict(prompt=_rep_prompt(i), max_new_tokens=4 + i % 5,
+                 temperature=0.7 * (i % 3 == 0), seed=500 + i)
+            for i in range(n)]
+
+
+def _reference(spec_runtime, reqs):
+    """Non-speculative streams from a drafterless scheduler on the SAME
+    runtime (plain step programs, same weights)."""
+    s = DecodeScheduler(spec_runtime)
+    try:
+        return [s.generate(timeout=120, **r).token_ids for r in reqs]
+    finally:
+        s.close(drain=False, timeout=10.0)
+
+
+def test_spec_state_adapts_from_own_window():
+    st = SpecState(2, 4)
+    for _ in range(3):
+        st.observe(2, 2)
+    assert st.k == 2                      # needs >= 4 observations
+    st.observe(2, 2)
+    assert st.k == 3                      # hot window grows
+    st.observe(3, 3)
+    assert st.k == 4 and st.acceptance_rate == 1.0
+    st.observe(4, 4)
+    assert st.k == 4                      # capped at k_max
+    cold = SpecState(3, 4)
+    for _ in range(6):
+        cold.observe(3, 0)
+    assert cold.k == 1                    # shrinks, floors at 1
+    cold.observe(0, 0)                    # zero-proposal rounds ignored
+    assert cold.k == 1
+
+
+def test_ngram_drafter_proposes_cycle_continuation():
+    class R:
+        prompt = np.array([5, 9, 2, 5, 9, 2, 5], "int32")
+        tokens = []
+    d = NgramDrafter()
+    got = d.propose(R(), 3)
+    assert got.tolist() == [9, 2, 5]      # continuation of latest [5]->...
+    # longest suffix wins: trailing [2, 5] matches at position 2
+    class R2:
+        prompt = np.array([1, 2, 3, 4], "int32")
+        tokens = []
+    assert d.propose(R2(), 3).size == 0   # no repeat: no draft
+
+
+def test_spec_continuous_and_solo_bitwise_with_zero_misses(spec_runtime):
+    """THE tentpole contract: speculative streams — greedy and sampled,
+    solo and continuous-batched, under donation+slots sanitizers — are
+    bitwise the non-speculative streams, with zero steady-state compile
+    misses and zero leaks."""
+    reqs = _spec_reqs()
+    ref = _reference(spec_runtime, reqs)
+    spec_runtime.cache.drop_prefix_cache()
+    s = DecodeScheduler(spec_runtime, drafter=NgramDrafter(), spec_k=3)
+    try:
+        with sanitizer.scope("donation,slots"):
+            solo = [s.generate(timeout=120, **r).token_ids for r in reqs]
+            assert solo == ref
+            spec_runtime.cache.drop_prefix_cache()
+            telemetry.enable()
+            telemetry.reset()
+            futs = []
+            for i, r in enumerate(reqs):
+                futs.append(s.submit(**r))
+                time.sleep(0.002 * (i % 4))
+            cont = [f.result(120).token_ids for f in futs]
+            assert sanitizer.stats()["violations"] == 0
+        snap = telemetry.snapshot()["counters"]
+        telemetry.disable()
+    finally:
+        sanitizer.reset()
+        s.close(drain=False, timeout=10.0)
+    assert cont == ref
+    assert not snap.get("decode.compile_miss"), snap
+    assert snap.get("decode.spec_steps", 0) >= 1
+    assert snap.get("decode.spec_accepted", 0) >= 1   # drafting worked
+    assert spec_runtime.cache.pages_in_use == 0
+    assert spec_runtime.cache.slots_in_use == 0
+
+
+def test_spec_mixed_batch_with_non_spec_rows(spec_runtime):
+    """Speculating and opted-out requests share the same boundary: the
+    opted-out rows ride the verify with n_draft=0 (bitwise the plain
+    step) and every stream still matches the non-spec reference."""
+    reqs = _spec_reqs(8)
+    ref = _reference(spec_runtime, reqs)
+    spec_runtime.cache.drop_prefix_cache()
+    s = DecodeScheduler(spec_runtime, drafter=NgramDrafter(), spec_k=3)
+    try:
+        futs = [s.submit(speculate=(i % 2 == 0), **r)
+                for i, r in enumerate(reqs)]
+        got = [f.result(120).token_ids for f in futs]
+    finally:
+        s.close(drain=False, timeout=10.0)
+    assert got == ref
+
+
+class _ScriptedDrafter(Drafter):
+    """Drafts from a scripted continuation table (prompt tuple -> the
+    known reference stream), optionally corrupted — the deterministic
+    way to pin acceptance behavior."""
+
+    name = "scripted"
+
+    def __init__(self, table, corrupt=False, overshoot=False):
+        self.table = table
+        self.corrupt = corrupt
+        self.overshoot = overshoot
+
+    def propose(self, req, k):
+        ref = self.table[tuple(int(t) for t in req.prompt)]
+        done = len(req.tokens)
+        if self.overshoot:
+            k = k + 7          # deliberately ignore the budget cap
+        cont = np.asarray(ref[done:done + k], "int32")
+        if self.corrupt and cont.size:
+            cont = (cont + 1) % VOCAB       # never equals the target
+        return cont
+
+
+def _table(reqs, ref):
+    return {tuple(r["prompt"]): t for r, t in zip(reqs, ref)}
+
+
+def test_spec_oracle_drafts_commit_bonus_tokens(spec_runtime):
+    """All-accepted rounds commit k+1 tokens (the bonus) and finish in
+    far fewer verify steps than tokens; rejected-at-position-0 rounds
+    still emit exactly the target's token. Both streams stay bitwise."""
+    reqs = _spec_reqs(4)
+    ref = _reference(spec_runtime, reqs)
+    spec_runtime.cache.drop_prefix_cache()
+    telemetry.enable()
+    for drafter, expect_accepts in (
+            (_ScriptedDrafter(_table(reqs, ref)), True),
+            (_ScriptedDrafter(_table(reqs, ref), corrupt=True), False)):
+        telemetry.reset()
+        s = DecodeScheduler(spec_runtime, drafter=drafter, spec_k=3)
+        try:
+            got = [s.generate(timeout=120, **r).token_ids for r in reqs]
+        finally:
+            s.close(drain=False, timeout=10.0)
+        assert got == ref
+        snap = telemetry.snapshot()["counters"]
+        if expect_accepts:
+            assert snap.get("decode.spec_bonus", 0) >= 1
+            assert snap["decode.spec_accepted"] > 0
+        else:
+            # acceptance at position 0: every draft token mismatches,
+            # every verify commits exactly one target token
+            assert snap.get("decode.spec_accepted", 0) == 0
+            assert snap.get("decode.spec_bonus", 0) == 0
+        spec_runtime.cache.drop_prefix_cache()
+    telemetry.disable()
+
+
+def test_spec_draft_overshoot_is_budget_capped(spec_runtime):
+    """A drafter ignoring its k (longer than the remaining budget) is
+    truncated by the scheduler: writes stay inside the page
+    reservation, the stream is exact, nothing leaks."""
+    reqs = [dict(prompt=_rep_prompt(i), max_new_tokens=3,
+                 temperature=0.0, seed=900 + i) for i in range(3)]
+    ref = _reference(spec_runtime, reqs)
+    spec_runtime.cache.drop_prefix_cache()
+    s = DecodeScheduler(
+        spec_runtime,
+        drafter=_ScriptedDrafter(_table(reqs, ref), overshoot=True),
+        spec_k=3)
+    try:
+        with sanitizer.scope("donation,slots"):
+            got = [s.generate(timeout=120, **r).token_ids for r in reqs]
+            assert sanitizer.stats()["violations"] == 0
+    finally:
+        sanitizer.reset()
+        s.close(drain=False, timeout=10.0)
+    assert got == ref
+    assert all(len(t) == 3 for t in got)
+    assert spec_runtime.cache.pages_in_use == 0
+
+
+def test_spec_k0_budget_falls_back_to_plain_step(spec_runtime):
+    """max_new_tokens=2 leaves zero draft budget after the first token
+    (k <= max_new - generated - 1 = 0): the scheduler must run the
+    plain step, not a degenerate verify."""
+    reqs = [dict(prompt=_rep_prompt(i), max_new_tokens=2,
+                 temperature=0.0, seed=950 + i) for i in range(3)]
+    ref = _reference(spec_runtime, reqs)
+    spec_runtime.cache.drop_prefix_cache()
+    telemetry.enable()
+    telemetry.reset()
+    s = DecodeScheduler(spec_runtime, drafter=NgramDrafter(), spec_k=3)
+    try:
+        got = [s.generate(timeout=120, **r).token_ids for r in reqs]
+    finally:
+        s.close(drain=False, timeout=10.0)
+    snap = telemetry.snapshot()["counters"]
+    telemetry.disable()
+    assert got == ref
+    assert snap.get("decode.spec_steps", 0) == 0      # plain steps only
+    assert snap.get("decode.steps", 0) >= 1
+
+
+def test_spec_prefix_hit_session_speculates(spec_runtime):
+    """A full-prompt prefix hit (admission IS the first token) must
+    still enter speculative mode for its decode steps — and stay
+    bitwise with the cold non-spec stream for the same (prompt, seed)."""
+    p = _rep_prompt(7)
+    kw = dict(max_new_tokens=6, temperature=0.8, seed=777)
+    ref = _reference(spec_runtime, [dict(prompt=p, **kw)])[0]
+    spec_runtime.cache.drop_prefix_cache()
+    telemetry.enable()
+    telemetry.reset()
+    s = DecodeScheduler(spec_runtime, drafter=NgramDrafter(), spec_k=3)
+    try:
+        first = s.generate(p, timeout=120, **kw).token_ids   # publishes
+        hit = s.generate(p, timeout=120, **kw).token_ids     # prefix hit
+    finally:
+        s.close(drain=False, timeout=10.0)
+    snap = telemetry.snapshot()["counters"]
+    telemetry.disable()
+    assert first == ref and hit == ref
+    assert snap.get("decode.prefix_hits", 0) >= 1
+    assert snap.get("decode.spec_steps", 0) >= 1
+
+
+def test_spec_drafter_failure_degrades_not_fails(spec_runtime):
+    """Any drafter exception degrades the affected boundary/request to
+    plain decode — requests never fail because a draft misfired."""
+    class Exploding(Drafter):
+        def __init__(self):
+            self.calls = 0
+
+        def propose_batch(self, reqs, ks):
+            self.calls += 1
+            raise RuntimeError("draft boom")
+
+    reqs = _spec_reqs(3)
+    ref = _reference(spec_runtime, reqs)
+    spec_runtime.cache.drop_prefix_cache()
+    d = Exploding()
+    s = DecodeScheduler(spec_runtime, drafter=d, spec_k=3)
+    try:
+        got = [s.generate(timeout=120, **r).token_ids for r in reqs]
+    finally:
+        s.close(drain=False, timeout=10.0)
+    assert got == ref and d.calls >= 1
+    assert spec_runtime.cache.pages_in_use == 0
+
+
+def test_model_drafter_self_draft_high_acceptance(spec_runtime):
+    """ModelDrafter with the TARGET net as its own draft model: greedy
+    requests accept every draft (the drafter computes exactly the
+    target's argmax), so verify rounds commit bonus tokens — and its
+    private KV cache frees every slot on detach."""
+    reqs = [dict(prompt=_rep_prompt(i), max_new_tokens=7,
+                 temperature=0.0, seed=600 + i) for i in range(4)]
+    ref = _reference(spec_runtime, reqs)
+    spec_runtime.cache.drop_prefix_cache()
+    telemetry.enable()
+    telemetry.reset()
+    d = ModelDrafter(spec_runtime.block)
+    s = DecodeScheduler(spec_runtime, drafter=d, spec_k=3)
+    try:
+        got = [s.generate(timeout=300, **r).token_ids for r in reqs]
+    finally:
+        s.close(drain=False, timeout=10.0)
+    snap = telemetry.snapshot()["counters"]
+    telemetry.disable()
+    assert got == ref
+    assert snap.get("decode.spec_bonus", 0) >= 1
+    acc = snap.get("decode.spec_accepted", 0)
+    prop = snap.get("decode.spec_proposed", 0)
+    assert prop > 0 and acc / prop > 0.8          # greedy self-draft
+    assert d.runtime.cache.stats()["pages_in_use"] == 0
+    assert d.runtime.cache.stats()["slots_in_use"] == 0
+    assert spec_runtime.cache.pages_in_use == 0
+
+
+def test_spec_validation_errors(spec_runtime, runtime):
+    with pytest.raises(ValueError, match="spec_buckets"):
+        DecodeScheduler(runtime, drafter=NgramDrafter(), start=False)
+    with pytest.raises(ValueError, match="spec_k"):
+        DecodeScheduler(spec_runtime, drafter=NgramDrafter(), spec_k=9,
+                        start=False)
+    s = DecodeScheduler(spec_runtime)          # no drafter
+    try:
+        with pytest.raises(ValueError, match="no drafter"):
+            s.submit(_rep_prompt(0), speculate=True)
+    finally:
+        s.close(drain=False, timeout=10.0)
+    with pytest.raises(ValueError, match="unknown drafter"):
+        DecodeScheduler(spec_runtime, drafter="nope", start=False)
